@@ -1,0 +1,13 @@
+"""elephas_tpu — distributed deep learning on TPU with JAX/XLA.
+
+A TPU-native framework with the capability surface of Elephas (distributed
+training, inference and evaluation of compiled models in synchronous,
+asynchronous and hogwild modes; a parameter-server layer; MLlib-style and
+ML-pipeline integration; save/load with embedded distributed config), built
+on jax.sharding meshes, jit-compiled steps and XLA collectives instead of
+Spark jobs and pickled RPC.
+"""
+__version__ = "0.1.0"
+
+from . import models, utils
+from .data import Dataset

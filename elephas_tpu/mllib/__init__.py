@@ -1,0 +1,3 @@
+from .adapter import from_matrix, from_vector, to_matrix, to_vector
+from .linalg import (DenseMatrix, DenseVector, LabeledPoint, Matrices, Matrix,
+                     Vector, Vectors)

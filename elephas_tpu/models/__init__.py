@@ -1,0 +1,13 @@
+from . import activations, initializers, losses, metrics, optimizers
+from .core import BaseModel, History, Model, Sequential, model_from_json
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     GlobalAveragePooling2D, Input, InputLayer, KTensor,
+                     Layer, LayerNormalization, MaxPooling2D, Multiply,
+                     Reshape, register_layer, reset_layer_uids)
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, AdamW, Nadam,
+                         Optimizer, RMSprop)
+from .optimizers import deserialize as deserialize_optimizer
+from .optimizers import get as get_optimizer
+from .optimizers import serialize as serialize_optimizer
+from .saving import load_model, save_model

@@ -1,0 +1,688 @@
+"""Model core: Sequential and functional-graph models with jitted training.
+
+A model is a *pure function* over a parameter pytree plus a serializable
+architecture config. Nothing here holds device state implicitly: ``fit``,
+``evaluate`` and ``predict`` are convenience loops over jit-compiled steps,
+and the same ``apply`` is what the distributed layer shards over a device
+mesh.
+
+Design notes (TPU-first):
+- All steps are ``jax.jit``-compiled once per batch shape; static shapes and
+  Python-free inner loops keep XLA's MXU tiling and fusion intact.
+- Parameters live in ``{layer_name: {param_name: array}}`` pytrees; weight
+  exchange with the distributed layer is via ordered flat lists (the
+  reference's ``get_weights``/``set_weights`` currency,
+  ``elephas/spark_model.py:63``, ``elephas/worker.py:34``).
+- BatchNorm moving statistics are a separate non-trainable collection
+  threaded through the train step, keeping ``apply`` pure.
+
+Capability parity: Keras ``Sequential``/functional ``Model`` usage in the
+reference (``/root/reference/tests/conftest.py``, ``examples/*.py``),
+``model.to_json``/``model_from_json`` with custom objects
+(``elephas/worker.py:31``), compile/fit/evaluate/predict/train_on_batch.
+"""
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import losses as losses_mod
+from . import metrics as metrics_mod
+from . import optimizers as optimizers_mod
+from .layers import (InputLayer, KTensor, Layer, deserialize_layer,
+                     serialize_layer)
+
+_MODEL_UID = [0]
+
+
+def _auto_name(prefix: str) -> str:
+    _MODEL_UID[0] += 1
+    return f"{prefix}_{_MODEL_UID[0]}"
+
+
+class History:
+    """Training history: dict of per-epoch metric lists (Keras-compatible)."""
+
+    def __init__(self):
+        self.history: Dict[str, List[float]] = {}
+
+    def append(self, name: str, value: float):
+        self.history.setdefault(name, []).append(float(value))
+
+
+class BaseModel:
+    """Shared machinery for Sequential and functional models."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__.lower())
+        self.params: Optional[Dict] = None
+        self.built = False
+        self.optimizer: Optional[optimizers_mod.Optimizer] = None
+        self.loss = None
+        self.metrics: List = []
+        self.metrics_names: List[str] = ["loss"]
+        self.custom_objects: Dict[str, Any] = {}
+        self._loss_fn: Optional[Callable] = None
+        self._metric_fns: List[Callable] = []
+        self._opt_state = None
+        self._tx = None
+        self._rng_seed: Optional[int] = None
+        self._step_counter = 0
+        self._jit_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ graph
+    @property
+    def layers(self) -> List[Layer]:
+        raise NotImplementedError
+
+    def _ordered_nodes(self) -> List[Tuple[Layer, List[int], int]]:
+        """Topo-ordered (layer, input slot indices, output slot) triples."""
+        raise NotImplementedError
+
+    def _input_shapes(self) -> List[Tuple]:
+        raise NotImplementedError
+
+    @property
+    def output_shape(self) -> Tuple:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ build
+    def build(self, input_shape: Optional[Tuple] = None, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def _ensure_built(self, x: Optional[np.ndarray] = None):
+        if not self.built:
+            shape = tuple(np.asarray(x).shape[1:]) if x is not None else None
+            self.build(input_shape=shape)
+
+    # ------------------------------------------------------------- params api
+    def _weight_entries(self) -> List[Tuple[str, str]]:
+        """Ordered (layer_name, param_name) pairs defining weight order."""
+        entries = []
+        for layer in self.layers:
+            if not self.params or layer.name not in self.params:
+                continue
+            layer_params = self.params[layer.name]
+            order = [k for k in layer.weight_order if k in layer_params]
+            order += [k for k in sorted(layer_params) if k not in order]
+            for key in order:
+                entries.append((layer.name, key))
+        return entries
+
+    def get_weights(self) -> List[np.ndarray]:
+        """Model weights as an ordered flat list of numpy arrays."""
+        if self.params is None:
+            raise ValueError("Model must be built before get_weights()")
+        return [np.asarray(self.params[ln][pn]) for ln, pn in self._weight_entries()]
+
+    def set_weights(self, weights: Sequence[np.ndarray]):
+        """Load weights from an ordered flat list of arrays."""
+        if self.params is None:
+            raise ValueError("Model must be built before set_weights()")
+        entries = self._weight_entries()
+        if len(entries) != len(weights):
+            raise ValueError(
+                f"Expected {len(entries)} weight arrays, got {len(weights)}")
+        new_params = {ln: dict(lp) for ln, lp in self.params.items()}
+        for (ln, pn), w in zip(entries, weights):
+            current = new_params[ln][pn]
+            w = jnp.asarray(w, dtype=current.dtype)
+            if w.shape != current.shape:
+                raise ValueError(
+                    f"Shape mismatch for {ln}/{pn}: {w.shape} vs {current.shape}")
+            new_params[ln][pn] = w
+        self.params = new_params
+        self._invalidate_jit()
+
+    def _split_params(self, params: Dict) -> Tuple[Dict, Dict]:
+        """Split into (trainable, non-trainable) collections."""
+        trainable, state = {}, {}
+        for layer in self.layers:
+            if layer.name not in params:
+                continue
+            non_trainable = set(getattr(layer, "non_trainable", ()))
+            t = {k: v for k, v in params[layer.name].items() if k not in non_trainable}
+            s = {k: v for k, v in params[layer.name].items() if k in non_trainable}
+            if t:
+                trainable[layer.name] = t
+            if s:
+                state[layer.name] = s
+        return trainable, state
+
+    @staticmethod
+    def _merge_params(trainable: Dict, state: Dict) -> Dict:
+        merged = {ln: dict(lp) for ln, lp in trainable.items()}
+        for ln, lp in state.items():
+            merged.setdefault(ln, {}).update(lp)
+        return merged
+
+    # ------------------------------------------------------------------ apply
+    def apply(self, params: Dict, inputs, training: bool = False, rng=None):
+        """Pure forward pass. Safe to jit/vmap/shard_map."""
+        y, _ = self._apply_internal(params, inputs, training, rng,
+                                    collect_updates=False)
+        return y
+
+    def _apply_internal(self, params, inputs, training, rng, collect_updates):
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- compile
+    def compile(self, optimizer="rmsprop", loss=None, metrics=None,
+                custom_objects: Optional[Dict] = None, seed: Optional[int] = None):
+        """Attach optimizer, loss and metrics; builds params if shapes known."""
+        custom_objects = {**self.custom_objects, **(custom_objects or {})}
+        self.custom_objects = custom_objects
+        self.optimizer = optimizers_mod.get(optimizer)
+        if loss is None:
+            raise ValueError("compile() requires a loss")
+        self.loss = loss
+        self._loss_fn = losses_mod.get(loss, custom_objects)
+        self.metrics = list(metrics or [])
+        names, fns = metrics_mod.resolve_metrics(self.metrics, loss=loss,
+                                                 custom_objects=custom_objects)
+        self.metrics_names = ["loss"] + names
+        self._metric_fns = fns
+        self._tx = self.optimizer.to_optax()
+        self._opt_state = None
+        if seed is not None:
+            self._rng_seed = seed
+        if not self.built:
+            try:
+                self.build()
+            except (ValueError, TypeError):
+                pass  # input shape unknown; built lazily at first fit
+        self._invalidate_jit()
+        return self
+
+    @property
+    def compiled(self) -> bool:
+        return self._loss_fn is not None
+
+    def _invalidate_jit(self):
+        self._jit_cache = {}
+
+    # ------------------------------------------------------------- rng helper
+    def _next_key(self):
+        if self._rng_seed is None:
+            self._rng_seed = int(np.random.SeedSequence().generate_state(1)[0])
+        self._step_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._rng_seed),
+                                  self._step_counter)
+
+    # ------------------------------------------------------------ data prep
+    def _prepare_y(self, y: np.ndarray) -> np.ndarray:
+        y = np.asarray(y)
+        loss_name = losses_mod.serialize(self.loss) if self.loss is not None else ""
+        if loss_name == "sparse_categorical_crossentropy":
+            return y.astype(np.int32)
+        y = y.astype(np.float32)
+        out_rank = len(self.output_shape) + 1  # + batch dim
+        if y.ndim == out_rank - 1:
+            y = y[..., None]
+        return y
+
+    @staticmethod
+    def _prepare_x(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x)
+        if np.issubdtype(x.dtype, np.integer):
+            return x
+        return x.astype(np.float32)
+
+    # ------------------------------------------------------------- train step
+    def _build_train_step(self):
+        tx = self._tx
+        loss_fn = self._loss_fn
+        metric_fns = list(self._metric_fns)
+
+        def step(trainable, state, opt_state, key, xb, yb):
+            def objective(tr):
+                params = self._merge_params(tr, state)
+                preds, updates = self._apply_internal(params, xb, True, key,
+                                                      collect_updates=True)
+                per_sample = loss_fn(yb, preds)
+                return jnp.mean(per_sample), (preds, updates)
+
+            (loss_val, (preds, updates)), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            opt_updates, opt_state = tx.update(grads, opt_state, trainable)
+            trainable = optax.apply_updates(trainable, opt_updates)
+            new_state = {ln: {**state.get(ln, {}), **lu} for ln, lu in updates.items()}
+            for ln in state:
+                new_state.setdefault(ln, state[ln])
+            metric_vals = [jnp.mean(fn(yb, preds)) for fn in metric_fns]
+            return trainable, new_state, opt_state, loss_val, metric_vals
+
+        return jax.jit(step)
+
+    def _build_eval_step(self):
+        loss_fn = self._loss_fn
+        metric_fns = list(self._metric_fns)
+
+        def step(params, xb, yb):
+            preds = self.apply(params, xb, training=False)
+            vals = [jnp.mean(loss_fn(yb, preds))]
+            vals += [jnp.mean(fn(yb, preds)) for fn in metric_fns]
+            return vals
+
+        return jax.jit(step)
+
+    def _build_predict_step(self):
+        def step(params, xb):
+            return self.apply(params, xb, training=False)
+
+        return jax.jit(step)
+
+    def _get_jitted(self, kind: str):
+        if kind not in self._jit_cache:
+            if kind == "train":
+                self._jit_cache[kind] = self._build_train_step()
+            elif kind == "eval":
+                self._jit_cache[kind] = self._build_eval_step()
+            elif kind == "predict":
+                self._jit_cache[kind] = self._build_predict_step()
+        return self._jit_cache[kind]
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0,
+            validation_split: float = 0.0, validation_data=None,
+            shuffle: bool = True, **kwargs) -> History:
+        """Train with mini-batch SGD. Returns a Keras-style History."""
+        if not self.compiled:
+            raise RuntimeError("compile() the model before fit()")
+        self._ensure_built(x)
+        x = self._prepare_x(x)
+        y = self._prepare_y(y)
+
+        if validation_data is None and validation_split and 0.0 < validation_split < 1.0:
+            split_at = int(x.shape[0] * (1.0 - validation_split))
+            x, x_val = x[:split_at], x[split_at:]
+            y, y_val = y[:split_at], y[split_at:]
+            validation_data = (x_val, y_val)
+
+        n = x.shape[0]
+        trainable, state = self._split_params(self.params)
+        if self._opt_state is None:
+            self._opt_state = self._tx.init(trainable)
+        opt_state = self._opt_state
+        step = self._get_jitted("train")
+        history = History()
+        shuffle_rng = np.random.default_rng(self._rng_seed)
+
+        for epoch in range(int(epochs)):
+            order = shuffle_rng.permutation(n) if shuffle else np.arange(n)
+            losses_sum, counts, metric_sums = 0.0, 0, None
+            for start in range(0, max(n, 1), batch_size):
+                idx = order[start:start + batch_size]
+                if idx.size == 0:
+                    continue
+                xb, yb = x[idx], y[idx]
+                key = self._next_key()
+                trainable, state, opt_state, loss_val, metric_vals = step(
+                    trainable, state, opt_state, key, xb, yb)
+                bsz = idx.size
+                losses_sum += float(loss_val) * bsz
+                counts += bsz
+                vals = [float(v) for v in metric_vals]
+                metric_sums = ([s + v * bsz for s, v in zip(metric_sums, vals)]
+                               if metric_sums else [v * bsz for v in vals])
+            if counts:
+                history.append("loss", losses_sum / counts)
+                for name, total in zip(self.metrics_names[1:], metric_sums or []):
+                    history.append(name, total / counts)
+            if validation_data is not None:
+                self.params = self._merge_params(trainable, state)
+                val_results = self.evaluate(validation_data[0], validation_data[1],
+                                            batch_size=batch_size, verbose=0)
+                val_results = (val_results if isinstance(val_results, list)
+                               else [val_results])
+                for name, value in zip(self.metrics_names, val_results):
+                    history.append("val_" + name, value)
+            if verbose:
+                msg = " - ".join(f"{k}: {v[-1]:.4f}" for k, v in history.history.items())
+                print(f"Epoch {epoch + 1}/{epochs} - {msg}")
+
+        self.params = self._merge_params(trainable, state)
+        self._opt_state = opt_state
+        return history
+
+    def train_on_batch(self, x, y):
+        """Single optimization step on one batch; returns [loss, *metrics]."""
+        if not self.compiled:
+            raise RuntimeError("compile() the model before train_on_batch()")
+        self._ensure_built(x)
+        x = self._prepare_x(x)
+        y = self._prepare_y(y)
+        trainable, state = self._split_params(self.params)
+        if self._opt_state is None:
+            self._opt_state = self._tx.init(trainable)
+        step = self._get_jitted("train")
+        trainable, state, self._opt_state, loss_val, metric_vals = step(
+            trainable, state, self._opt_state, self._next_key(), x, y)
+        self.params = self._merge_params(trainable, state)
+        if metric_vals:
+            return [float(loss_val)] + [float(v) for v in metric_vals]
+        return float(loss_val)
+
+    # --------------------------------------------------------------- evaluate
+    def evaluate(self, x, y, batch_size: int = 32, verbose: int = 0,
+                 **kwargs) -> Union[List[float], float]:
+        """Sample-weighted mean of loss and metrics over the dataset."""
+        if not self.compiled:
+            raise RuntimeError("compile() the model before evaluate()")
+        self._ensure_built(x)
+        x = self._prepare_x(x)
+        y = self._prepare_y(y)
+        step = self._get_jitted("eval")
+        n = x.shape[0]
+        sums = None
+        for start in range(0, n, batch_size):
+            xb, yb = x[start:start + batch_size], y[start:start + batch_size]
+            vals = [float(v) * xb.shape[0] for v in step(self.params, xb, yb)]
+            sums = [s + v for s, v in zip(sums, vals)] if sums else vals
+        results = [s / n for s in sums] if sums else [0.0]
+        return results if len(results) > 1 else results[0]
+
+    # ---------------------------------------------------------------- predict
+    def predict(self, x, batch_size: int = 32, verbose: int = 0,
+                **kwargs) -> np.ndarray:
+        """Forward inference in fixed-size batches (last batch padded so a
+        single compiled executable serves the whole pass)."""
+        self._ensure_built(x)
+        x = self._prepare_x(x)
+        step = self._get_jitted("predict")
+        n = x.shape[0]
+        outputs = []
+        for start in range(0, n, batch_size):
+            xb = x[start:start + batch_size]
+            real = xb.shape[0]
+            if real < batch_size and n > batch_size:
+                pad = np.zeros((batch_size - real,) + xb.shape[1:], dtype=xb.dtype)
+                xb = np.concatenate([xb, pad], axis=0)
+            out = np.asarray(step(self.params, xb))
+            outputs.append(out[:real])
+        if not outputs:
+            return np.zeros((0,) + tuple(self.output_shape), dtype=np.float32)
+        return np.concatenate(outputs, axis=0)
+
+    # ------------------------------------------------------------------- json
+    def get_config(self) -> Dict:
+        raise NotImplementedError
+
+    def to_json(self, **kwargs) -> str:
+        return json.dumps({"class_name": type(self).__name__,
+                           "config": self.get_config()}, **kwargs)
+
+    def save(self, filepath: str, overwrite: bool = True,
+             include_optimizer: bool = True):
+        from .saving import save_model
+
+        save_model(self, filepath, overwrite=overwrite,
+                   include_optimizer=include_optimizer)
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"', "-" * 60]
+        total = 0
+        for layer in self.layers:
+            count = 0
+            if self.params and layer.name in self.params:
+                count = sum(int(np.prod(v.shape)) for v in self.params[layer.name].values())
+            total += count
+            lines.append(f"{layer.name:<30}{type(layer).__name__:<20}{count:>10,}")
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Sequential(BaseModel):
+    """Linear stack of layers (Keras Sequential analog)."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self._layers: List[Layer] = []
+        for layer in layers or []:
+            self.add(layer)
+
+    @property
+    def layers(self) -> List[Layer]:
+        return self._layers
+
+    def add(self, layer: Layer):
+        if not isinstance(layer, Layer):
+            raise TypeError(f"Sequential.add expects a Layer, got {type(layer)}")
+        self._layers.append(layer)
+        self.built = False
+        return self
+
+    def _declared_input_shape(self) -> Optional[Tuple]:
+        for layer in self._layers:
+            if isinstance(layer, InputLayer):
+                return layer.shape
+            if layer.input_spec is not None:
+                return tuple(layer.input_spec)
+            break
+        return None
+
+    def build(self, input_shape: Optional[Tuple] = None, seed: Optional[int] = None):
+        if input_shape is None:
+            input_shape = self._declared_input_shape()
+        if input_shape is None:
+            raise ValueError(
+                "Cannot build Sequential model: supply input_shape/input_dim "
+                "on the first layer or call build(input_shape=...)")
+        if seed is not None:
+            self._rng_seed = seed
+        if self._rng_seed is None:
+            self._rng_seed = int(np.random.SeedSequence().generate_state(1)[0])
+        key = jax.random.PRNGKey(self._rng_seed)
+        params = {}
+        shape = tuple(input_shape)
+        self._built_input_shape = shape
+        for i, layer in enumerate(self._layers):
+            layer_key = jax.random.fold_in(key, i)
+            layer_params = layer.build(layer_key, shape)
+            if layer_params:
+                params[layer.name] = layer_params
+            shape = layer.compute_output_shape(shape)
+        self._output_shape = shape
+        self.params = params
+        self.built = True
+        self._opt_state = None
+        self._invalidate_jit()
+        return self
+
+    @property
+    def output_shape(self) -> Tuple:
+        if not self.built:
+            raise ValueError("Model not built")
+        return self._output_shape
+
+    def _apply_internal(self, params, inputs, training, rng, collect_updates):
+        updates: Dict[str, Dict] = {}
+        x = inputs
+        for i, layer in enumerate(self._layers):
+            layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            layer_params = params.get(layer.name, {})
+            if collect_updates and hasattr(layer, "batch_stats") and training:
+                mean, var = layer.batch_stats(layer_params, x)
+                m = layer.momentum
+                updates[layer.name] = {
+                    "moving_mean": m * layer_params["moving_mean"] + (1 - m) * mean,
+                    "moving_variance": m * layer_params["moving_variance"] + (1 - m) * var,
+                }
+            x = layer.call(layer_params, x, training, layer_rng)
+        return x, updates
+
+    def get_config(self) -> Dict:
+        return {"name": self.name,
+                "layers": [serialize_layer(layer) for layer in self._layers]}
+
+    @classmethod
+    def from_config(cls, config: Dict, custom_objects: Optional[Dict] = None):
+        model = cls(name=config.get("name"))
+        for spec in config["layers"]:
+            model.add(deserialize_layer(spec, custom_objects))
+        model.custom_objects = custom_objects or {}
+        for layer in model._layers:
+            layer._custom_objects = model.custom_objects
+        try:
+            model.build()
+        except ValueError:
+            pass
+        return model
+
+
+class Model(BaseModel):
+    """Functional-API model over a DAG of layer calls."""
+
+    def __init__(self, inputs=None, outputs=None, name: Optional[str] = None):
+        super().__init__(name=name)
+        if inputs is None or outputs is None:
+            raise ValueError("Model requires inputs= and outputs=")
+        self.inputs: List[KTensor] = list(inputs) if isinstance(
+            inputs, (list, tuple)) else [inputs]
+        self.outputs: List[KTensor] = list(outputs) if isinstance(
+            outputs, (list, tuple)) else [outputs]
+        self._nodes = self._topo_sort()
+        self.build()
+
+    # each node: (ktensor, layer, input ktensors)
+    def _topo_sort(self):
+        order, seen = [], set()
+
+        def visit(t: KTensor):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            if t.history is None:
+                raise ValueError("Disconnected tensor in graph")
+            layer, parents = t.history
+            for p in parents:
+                visit(p)
+            order.append((t, layer, parents))
+
+        for out in self.outputs:
+            visit(out)
+        names = [layer.name for _, layer, _ in order]
+        if len(names) != len(set(names)):
+            raise ValueError("Layer reuse (shared layers) is not supported yet")
+        return order
+
+    @property
+    def layers(self) -> List[Layer]:
+        return [layer for _, layer, _ in self._nodes]
+
+    def build(self, input_shape=None, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng_seed = seed
+        if self._rng_seed is None:
+            self._rng_seed = int(np.random.SeedSequence().generate_state(1)[0])
+        key = jax.random.PRNGKey(self._rng_seed)
+        params = {}
+        shapes: Dict[int, Tuple] = {}
+        for i, (t, layer, parents) in enumerate(self._nodes):
+            if isinstance(layer, InputLayer):
+                shapes[id(t)] = layer.shape
+                continue
+            in_shapes = [shapes[id(p)] for p in parents]
+            arg = in_shapes if len(in_shapes) > 1 else in_shapes[0]
+            layer_params = layer.build(jax.random.fold_in(key, i), arg)
+            if layer_params:
+                params[layer.name] = layer_params
+            shapes[id(t)] = layer.compute_output_shape(arg)
+        self._output_shape = shapes[id(self.outputs[0])]
+        self.params = params
+        self.built = True
+        self._opt_state = None
+        self._invalidate_jit()
+        return self
+
+    @property
+    def output_shape(self) -> Tuple:
+        return self._output_shape
+
+    def _apply_internal(self, params, inputs, training, rng, collect_updates):
+        updates: Dict[str, Dict] = {}
+        values: Dict[int, Any] = {}
+        input_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(input_list) != len(self.inputs):
+            raise ValueError(f"Model expects {len(self.inputs)} inputs, "
+                             f"got {len(input_list)}")
+        # bind by the user-declared inputs= order, not graph-traversal order
+        for placeholder, array in zip(self.inputs, input_list):
+            values[id(placeholder)] = array
+        for i, (t, layer, parents) in enumerate(self._nodes):
+            if isinstance(layer, InputLayer):
+                if id(t) not in values:
+                    raise ValueError(
+                        f"Input tensor for layer {layer.name!r} missing from inputs=")
+                continue
+            args = [values[id(p)] for p in parents]
+            arg = args if len(args) > 1 else args[0]
+            layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
+            layer_params = params.get(layer.name, {})
+            if collect_updates and hasattr(layer, "batch_stats") and training:
+                mean, var = layer.batch_stats(layer_params, arg)
+                m = layer.momentum
+                updates[layer.name] = {
+                    "moving_mean": m * layer_params["moving_mean"] + (1 - m) * mean,
+                    "moving_variance": m * layer_params["moving_variance"] + (1 - m) * var,
+                }
+            values[id(t)] = layer.call(layer_params, arg, training, layer_rng)
+        outs = [values[id(o)] for o in self.outputs]
+        return (outs if len(outs) > 1 else outs[0]), updates
+
+    def get_config(self) -> Dict:
+        tensor_names: Dict[int, str] = {}
+        layer_specs = []
+        for t, layer, parents in self._nodes:
+            tensor_names[id(t)] = layer.name
+            spec = serialize_layer(layer)
+            spec["name"] = layer.name
+            spec["inbound"] = [tensor_names[id(p)] for p in parents]
+            layer_specs.append(spec)
+        return {
+            "name": self.name,
+            "layers": layer_specs,
+            "input_layers": [t.history[0].name for t in self.inputs],
+            "output_layers": [tensor_names[id(t)] for t in self.outputs],
+        }
+
+    @classmethod
+    def from_config(cls, config: Dict, custom_objects: Optional[Dict] = None):
+        produced: Dict[str, KTensor] = {}
+        for spec in config["layers"]:
+            layer = deserialize_layer(spec, custom_objects)
+            if isinstance(layer, InputLayer):
+                produced[layer.name] = layer._output
+                continue
+            inbound = [produced[name] for name in spec["inbound"]]
+            produced[layer.name] = layer(inbound if len(inbound) > 1 else inbound[0])
+        inputs = [produced[name] for name in config["input_layers"]]
+        outputs = [produced[name] for name in config["output_layers"]]
+        model = cls(inputs=inputs, outputs=outputs, name=config.get("name"))
+        model.custom_objects = custom_objects or {}
+        for layer in model.layers:
+            layer._custom_objects = model.custom_objects
+        return model
+
+
+def model_from_json(json_string: str,
+                    custom_objects: Optional[Dict] = None) -> BaseModel:
+    """Rebuild a model from its JSON architecture config.
+
+    (Parity: Keras ``model_from_json`` as used at ``elephas/worker.py:31``.)
+    """
+    spec = json.loads(json_string)
+    class_name = spec.get("class_name")
+    config = spec.get("config", {})
+    if class_name == "Sequential":
+        return Sequential.from_config(config, custom_objects)
+    if class_name in ("Model", "Functional"):
+        return Model.from_config(config, custom_objects)
+    raise ValueError(f"Unknown model class: {class_name!r}")

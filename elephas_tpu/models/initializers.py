@@ -1,0 +1,91 @@
+"""Weight initializers (Keras-compatible defaults: glorot_uniform kernels,
+zeros biases)."""
+from typing import Callable, Dict, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape: Sequence[int]):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: (kh, kw, in, out)
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def glorot_normal(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    stddev = float(np.sqrt(2.0 / (fan_in + fan_out)))
+    return stddev * jax.random.normal(key, shape, dtype)
+
+
+def he_uniform(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = float(np.sqrt(6.0 / fan_in))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    stddev = float(np.sqrt(2.0 / fan_in))
+    return stddev * jax.random.normal(key, shape, dtype)
+
+
+def lecun_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    stddev = float(np.sqrt(1.0 / fan_in))
+    return stddev * jax.random.normal(key, shape, dtype)
+
+
+def random_uniform(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, -0.05, 0.05)
+
+
+def random_normal(key, shape, dtype=jnp.float32):
+    return 0.05 * jax.random.normal(key, shape, dtype)
+
+
+def truncated_normal(key, shape, dtype=jnp.float32):
+    return 0.05 * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+_INITIALIZERS: Dict[str, Callable] = {
+    "zeros": zeros,
+    "ones": ones,
+    "glorot_uniform": glorot_uniform,
+    "glorot_normal": glorot_normal,
+    "he_uniform": he_uniform,
+    "he_normal": he_normal,
+    "lecun_normal": lecun_normal,
+    "random_uniform": random_uniform,
+    "random_normal": random_normal,
+    "truncated_normal": truncated_normal,
+}
+
+
+def get(identifier: Union[str, Callable]) -> Callable:
+    if callable(identifier):
+        return identifier
+    if identifier in _INITIALIZERS:
+        return _INITIALIZERS[identifier]
+    raise ValueError(f"Unknown initializer: {identifier!r}")

@@ -1,0 +1,570 @@
+"""Layer zoo: serializable, functionally-pure building blocks.
+
+Each layer is a *config object*; parameters live outside the layer in a
+pytree (``{layer_name: {param_name: array}}``), so the whole model is a pure
+function ``apply(params, x)`` that jits, vmaps, and shards without hidden
+state. Layers know how to
+
+- ``build(key, input_shape) -> params`` (shapes exclude the batch dim),
+- ``compute_output_shape(input_shape)``,
+- ``call(params, inputs, training, rng)``,
+- round-trip through ``get_config``/``from_config`` for model JSON.
+
+Calling a layer on a :class:`KTensor` records a node in a functional graph
+(Keras functional-API analog, see :mod:`.core`).
+
+Capability parity target: the layer surface used by the reference's models
+and examples (Dense/Activation/Dropout chains, ``/root/reference/tests/conftest.py``,
+``examples/*.py``), extended with conv/pool/norm/embedding/attention blocks
+for the model families the TPU framework ships.
+"""
+import collections
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import activations as activations_mod
+from . import initializers
+
+_LAYER_UIDS: Dict[str, int] = collections.defaultdict(int)
+
+
+def _unique_name(prefix: str) -> str:
+    _LAYER_UIDS[prefix] += 1
+    count = _LAYER_UIDS[prefix]
+    return prefix if count == 1 else f"{prefix}_{count - 1}"
+
+
+def reset_layer_uids():
+    """Reset auto-naming counters (used by tests for determinism)."""
+    _LAYER_UIDS.clear()
+
+
+class KTensor:
+    """Symbolic tensor flowing through the functional-API graph.
+
+    ``shape`` excludes the batch dimension. ``history`` is the producing
+    ``(layer, inbound KTensors)`` pair, or None for placeholders.
+    """
+
+    def __init__(self, shape: Tuple, history=None):
+        self.shape = tuple(shape)
+        self.history = history
+
+    def __repr__(self):
+        return f"KTensor(shape={self.shape})"
+
+
+def Input(shape: Sequence[int], name: Optional[str] = None) -> KTensor:
+    """Create a symbolic model input (batch dimension implicit)."""
+    layer = InputLayer(shape=tuple(shape), name=name)
+    return layer._output
+
+
+class Layer:
+    """Base layer. Subclasses override build/compute_output_shape/call."""
+
+    #: ordering of weight arrays for get_weights()/set_weights()
+    weight_order: Tuple[str, ...] = ()
+
+    def __init__(self, name: Optional[str] = None, **kwargs):
+        prefix = kwargs.pop("name_prefix", None) or type(self).__name__.lower()
+        self.name = name or _unique_name(prefix)
+        self.input_spec: Optional[Tuple] = kwargs.pop("input_shape", None)
+        input_dim = kwargs.pop("input_dim", None)
+        if input_dim is not None:
+            self.input_spec = (input_dim,)
+        self.built_input_shape: Optional[Tuple] = None
+        self._custom_objects: Dict[str, Any] = {}
+
+    # -- graph recording -----------------------------------------------------
+    def __call__(self, inputs: Union[KTensor, List[KTensor]]):
+        in_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if not all(isinstance(t, KTensor) for t in in_list):
+            raise TypeError(
+                "Layers are called on symbolic KTensors (from Input(...)); to "
+                "run data through a model use model.predict / model.apply.")
+        shapes = [t.shape for t in in_list]
+        out_shape = self.compute_output_shape(shapes if len(shapes) > 1 else shapes[0])
+        return KTensor(out_shape, history=(self, list(in_list)))
+
+    # -- to be overridden ----------------------------------------------------
+    def build(self, key, input_shape) -> Dict[str, jnp.ndarray]:
+        self.built_input_shape = tuple(input_shape) if not isinstance(
+            input_shape, list) else [tuple(s) for s in input_shape]
+        return {}
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def call(self, params: Dict[str, jnp.ndarray], inputs, training: bool, rng):
+        raise NotImplementedError
+
+    # -- serialization -------------------------------------------------------
+    def get_config(self) -> Dict:
+        config: Dict[str, Any] = {"name": self.name}
+        if self.input_spec is not None:
+            config["input_shape"] = list(self.input_spec)
+        return config
+
+    @classmethod
+    def from_config(cls, config: Dict, custom_objects: Optional[Dict] = None):
+        config = dict(config)
+        if "input_shape" in config and config["input_shape"] is not None:
+            config["input_shape"] = tuple(config["input_shape"])
+        obj = cls(**config)
+        obj._custom_objects = custom_objects or {}
+        return obj
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class InputLayer(Layer):
+    def __init__(self, shape: Tuple, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, name_prefix="input", **kwargs)
+        self.shape = tuple(shape)
+        self._output = KTensor(self.shape, history=(self, []))
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+    def call(self, params, inputs, training, rng):
+        return inputs
+
+    def get_config(self):
+        return {"name": self.name, "shape": list(self.shape)}
+
+    @classmethod
+    def from_config(cls, config, custom_objects=None):
+        return cls(shape=tuple(config["shape"]), name=config.get("name"))
+
+
+class Dense(Layer):
+    """Fully-connected layer: ``y = act(x @ kernel + bias)``.
+
+    The workhorse of the MXU — a (batch, in) x (in, out) matmul that XLA
+    tiles onto the systolic array; the fused activation rides along as an
+    epilogue instead of a separate HBM round-trip.
+    """
+
+    weight_order = ("kernel", "bias")
+
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", bias_initializer="zeros",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+
+    def _activation_fn(self):
+        return activations_mod.get(self.activation, self._custom_objects)
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        in_dim = int(input_shape[-1]) if len(input_shape) else 1
+        k_kernel, k_bias = jax.random.split(key)
+        params = {"kernel": initializers.get(self.kernel_initializer)(
+            k_kernel, (in_dim, self.units))}
+        if self.use_bias:
+            params["bias"] = initializers.get(self.bias_initializer)(
+                k_bias, (self.units,))
+        return params
+
+    def compute_output_shape(self, input_shape):
+        if not len(input_shape):
+            return (self.units,)
+        return tuple(input_shape[:-1]) + (self.units,)
+
+    def call(self, params, inputs, training, rng):
+        if inputs.ndim == 1:  # scalar feature per sample
+            inputs = inputs[:, None]
+        y = inputs @ params["kernel"]
+        if self.use_bias:
+            y = y + params["bias"]
+        return self._activation_fn()(y)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({
+            "units": self.units,
+            "activation": activations_mod.serialize(self.activation),
+            "use_bias": self.use_bias,
+        })
+        return config
+
+
+class Activation(Layer):
+    def __init__(self, activation, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.activation = activation
+
+    def call(self, params, inputs, training, rng):
+        return activations_mod.get(self.activation, self._custom_objects)(inputs)
+
+    def get_config(self):
+        config = super().get_config()
+        config["activation"] = activations_mod.serialize(self.activation)
+        return config
+
+
+class Dropout(Layer):
+    def __init__(self, rate: float, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.rate = float(rate)
+
+    def call(self, params, inputs, training, rng):
+        if not training or self.rate <= 0.0:
+            return inputs
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, inputs.shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+    def get_config(self):
+        config = super().get_config()
+        config["rate"] = self.rate
+        return config
+
+
+class Flatten(Layer):
+    def compute_output_shape(self, input_shape):
+        size = 1
+        for d in input_shape:
+            size *= int(d)
+        return (size,)
+
+    def call(self, params, inputs, training, rng):
+        return inputs.reshape(inputs.shape[0], -1)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int], name: Optional[str] = None,
+                 **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.target_shape = tuple(target_shape)
+
+    def compute_output_shape(self, input_shape):
+        return self.target_shape
+
+    def call(self, params, inputs, training, rng):
+        return inputs.reshape((inputs.shape[0],) + self.target_shape)
+
+    def get_config(self):
+        config = super().get_config()
+        config["target_shape"] = list(self.target_shape)
+        return config
+
+
+def _pair(value) -> Tuple[int, int]:
+    if isinstance(value, (tuple, list)):
+        return int(value[0]), int(value[1])
+    return int(value), int(value)
+
+
+class Conv2D(Layer):
+    """2-D convolution, NHWC layout (TPU-native ordering)."""
+
+    weight_order = ("kernel", "bias")
+
+    def __init__(self, filters: int, kernel_size, strides=1, padding: str = "valid",
+                 activation=None, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", bias_initializer="zeros",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.filters = int(filters)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.lower()
+        self.activation = activation
+        self.use_bias = bool(use_bias)
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        in_ch = int(input_shape[-1])
+        k_kernel, k_bias = jax.random.split(key)
+        kernel_shape = self.kernel_size + (in_ch, self.filters)
+        params = {"kernel": initializers.get(self.kernel_initializer)(
+            k_kernel, kernel_shape)}
+        if self.use_bias:
+            params["bias"] = initializers.get(self.bias_initializer)(
+                k_bias, (self.filters,))
+        return params
+
+    def _out_spatial(self, size, k, s):
+        if self.padding == "same":
+            return -(-size // s)
+        return (size - k) // s + 1
+
+    def compute_output_shape(self, input_shape):
+        h, w, _ = input_shape
+        return (self._out_spatial(h, self.kernel_size[0], self.strides[0]),
+                self._out_spatial(w, self.kernel_size[1], self.strides[1]),
+                self.filters)
+
+    def call(self, params, inputs, training, rng):
+        y = lax.conv_general_dilated(
+            inputs, params["kernel"], window_strides=self.strides,
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"]
+        return activations_mod.get(self.activation, self._custom_objects)(y)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({
+            "filters": self.filters,
+            "kernel_size": list(self.kernel_size),
+            "strides": list(self.strides),
+            "padding": self.padding,
+            "activation": activations_mod.serialize(self.activation),
+            "use_bias": self.use_bias,
+        })
+        return config
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding: str = "valid",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding.lower()
+
+    def _out_spatial(self, size, k, s):
+        if self.padding == "same":
+            return -(-size // s)
+        return (size - k) // s + 1
+
+    def compute_output_shape(self, input_shape):
+        h, w, c = input_shape
+        return (self._out_spatial(h, self.pool_size[0], self.strides[0]),
+                self._out_spatial(w, self.pool_size[1], self.strides[1]), c)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"pool_size": list(self.pool_size),
+                       "strides": list(self.strides), "padding": self.padding})
+        return config
+
+
+class MaxPooling2D(_Pool2D):
+    def call(self, params, inputs, training, rng):
+        return lax.reduce_window(
+            inputs, -jnp.inf, lax.max,
+            (1,) + self.pool_size + (1,), (1,) + self.strides + (1,),
+            self.padding.upper())
+
+
+class AveragePooling2D(_Pool2D):
+    def call(self, params, inputs, training, rng):
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        summed = lax.reduce_window(inputs, 0.0, lax.add, window, strides,
+                                   self.padding.upper())
+        counts = lax.reduce_window(jnp.ones_like(inputs), 0.0, lax.add, window,
+                                   strides, self.padding.upper())
+        return summed / counts
+
+
+class GlobalAveragePooling2D(Layer):
+    def compute_output_shape(self, input_shape):
+        return (input_shape[-1],)
+
+    def call(self, params, inputs, training, rng):
+        return jnp.mean(inputs, axis=(1, 2))
+
+
+class Embedding(Layer):
+    weight_order = ("embeddings",)
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 embeddings_initializer="random_uniform",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.embeddings_initializer = embeddings_initializer
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        return {"embeddings": initializers.get(self.embeddings_initializer)(
+            key, (self.input_dim, self.output_dim))}
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+    def call(self, params, inputs, training, rng):
+        return jnp.take(params["embeddings"], inputs.astype(jnp.int32), axis=0)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"input_dim": self.input_dim, "output_dim": self.output_dim})
+        return config
+
+
+class LayerNormalization(Layer):
+    weight_order = ("gamma", "beta")
+
+    def __init__(self, epsilon: float = 1e-5, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.epsilon = float(epsilon)
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        dim = int(input_shape[-1])
+        return {"gamma": jnp.ones((dim,)), "beta": jnp.zeros((dim,))}
+
+    def call(self, params, inputs, training, rng):
+        mean = jnp.mean(inputs, axis=-1, keepdims=True)
+        var = jnp.var(inputs, axis=-1, keepdims=True)
+        normed = (inputs - mean) * lax.rsqrt(var + self.epsilon)
+        return normed * params["gamma"] + params["beta"]
+
+    def get_config(self):
+        config = super().get_config()
+        config["epsilon"] = self.epsilon
+        return config
+
+
+class BatchNormalization(Layer):
+    """Batch normalization.
+
+    Moving statistics are non-trainable weights updated outside the gradient
+    path: the train step returns batch-stat updates alongside gradients (see
+    ``training.py``), keeping the layer function pure so it shards/jits like
+    everything else.
+    """
+
+    weight_order = ("gamma", "beta", "moving_mean", "moving_variance")
+    non_trainable = ("moving_mean", "moving_variance")
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        dim = int(input_shape[-1])
+        return {"gamma": jnp.ones((dim,)), "beta": jnp.zeros((dim,)),
+                "moving_mean": jnp.zeros((dim,)),
+                "moving_variance": jnp.ones((dim,))}
+
+    def call(self, params, inputs, training, rng):
+        axes = tuple(range(inputs.ndim - 1))
+        if training:
+            mean = jnp.mean(inputs, axis=axes)
+            var = jnp.var(inputs, axis=axes)
+        else:
+            mean, var = params["moving_mean"], params["moving_variance"]
+        normed = (inputs - mean) * lax.rsqrt(var + self.epsilon)
+        return normed * params["gamma"] + params["beta"]
+
+    def batch_stats(self, params, inputs):
+        """Fresh batch statistics for moving-average updates."""
+        axes = tuple(range(inputs.ndim - 1))
+        return jnp.mean(inputs, axis=axes), jnp.var(inputs, axis=axes)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({"momentum": self.momentum, "epsilon": self.epsilon})
+        return config
+
+
+class _Merge(Layer):
+    """Base for multi-input merge layers."""
+
+    def compute_output_shape(self, input_shapes):
+        return tuple(input_shapes[0])
+
+
+class Add(_Merge):
+    def call(self, params, inputs, training, rng):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = out + t
+        return out
+
+
+class Multiply(_Merge):
+    def call(self, params, inputs, training, rng):
+        out = inputs[0]
+        for t in inputs[1:]:
+            out = out * t
+        return out
+
+
+class Concatenate(_Merge):
+    def __init__(self, axis: int = -1, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.axis = int(axis)
+
+    def compute_output_shape(self, input_shapes):
+        # axis counts the batch dim (Keras semantics): axis=0 is invalid,
+        # axis>0 maps to index axis-1 of the batch-less symbolic shape.
+        ref = list(input_shapes[0])
+        if self.axis == 0:
+            raise ValueError("Cannot concatenate along the batch axis (0)")
+        idx = self.axis - 1 if self.axis > 0 else len(ref) + self.axis
+        total = sum(int(s[idx]) for s in input_shapes)
+        ref[idx] = total
+        return tuple(ref)
+
+    def call(self, params, inputs, training, rng):
+        return jnp.concatenate(list(inputs), axis=self.axis)
+
+    def get_config(self):
+        config = super().get_config()
+        config["axis"] = self.axis
+        return config
+
+
+_LAYERS = {
+    "InputLayer": InputLayer,
+    "Dense": Dense,
+    "Activation": Activation,
+    "Dropout": Dropout,
+    "Flatten": Flatten,
+    "Reshape": Reshape,
+    "Conv2D": Conv2D,
+    "MaxPooling2D": MaxPooling2D,
+    "AveragePooling2D": AveragePooling2D,
+    "GlobalAveragePooling2D": GlobalAveragePooling2D,
+    "Embedding": Embedding,
+    "LayerNormalization": LayerNormalization,
+    "BatchNormalization": BatchNormalization,
+    "Add": Add,
+    "Multiply": Multiply,
+    "Concatenate": Concatenate,
+}
+
+
+def register_layer(cls, name: Optional[str] = None):
+    """Register a custom Layer subclass for deserialization."""
+    _LAYERS[name or cls.__name__] = cls
+    return cls
+
+
+def deserialize_layer(spec: Dict, custom_objects: Optional[Dict] = None) -> Layer:
+    class_name = spec["class_name"]
+    cls = None
+    if custom_objects and class_name in custom_objects:
+        cls = custom_objects[class_name]
+    elif class_name in _LAYERS:
+        cls = _LAYERS[class_name]
+    if cls is None:
+        raise ValueError(f"Unknown layer class: {class_name!r}")
+    return cls.from_config(spec.get("config", {}), custom_objects=custom_objects)
+
+
+def serialize_layer(layer: Layer) -> Dict:
+    return {"class_name": type(layer).__name__, "config": layer.get_config()}

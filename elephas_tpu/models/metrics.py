@@ -1,0 +1,95 @@
+"""Metric functions.
+
+Metrics map ``(y_true, y_pred)`` to per-sample values; the framework reports
+sample-weighted means, which makes distributed evaluation exactly equal to
+single-process evaluation (every metric is a per-sample mean, so shard-wise
+sample-count-weighted averaging is lossless — the property the reference's
+distributed evaluate relies on, ``elephas/spark_model.py:300-308``).
+
+``'acc'``/``'accuracy'`` is resolved against the compiled loss, matching
+Keras's behavior of picking binary/categorical/sparse accuracy automatically.
+"""
+from typing import Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+
+from . import losses as losses_mod
+
+
+def binary_accuracy(y_true, y_pred):
+    match = (y_true > 0.5) == (y_pred > 0.5)
+    return jnp.mean(match.astype(jnp.float32).reshape(match.shape[0], -1), axis=-1)
+
+
+def categorical_accuracy(y_true, y_pred):
+    return (jnp.argmax(y_true, axis=-1) == jnp.argmax(y_pred, axis=-1)).astype(jnp.float32)
+
+
+def sparse_categorical_accuracy(y_true, y_pred):
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim:
+        labels = labels[..., 0]
+    return (labels == jnp.argmax(y_pred, axis=-1)).astype(jnp.float32)
+
+
+_METRICS: Dict[str, Callable] = {
+    "binary_accuracy": binary_accuracy,
+    "categorical_accuracy": categorical_accuracy,
+    "sparse_categorical_accuracy": sparse_categorical_accuracy,
+    "mean_squared_error": losses_mod.mean_squared_error,
+    "mse": losses_mod.mean_squared_error,
+    "mean_absolute_error": losses_mod.mean_absolute_error,
+    "mae": losses_mod.mean_absolute_error,
+    "mean_absolute_percentage_error": losses_mod.mean_absolute_percentage_error,
+    "mape": losses_mod.mean_absolute_percentage_error,
+    "mean_squared_logarithmic_error": losses_mod.mean_squared_logarithmic_error,
+    "msle": losses_mod.mean_squared_logarithmic_error,
+    "cosine_similarity": losses_mod.cosine_similarity,
+    "logcosh": losses_mod.log_cosh,
+}
+
+
+def resolve_accuracy(loss_name: Optional[str]) -> Callable:
+    """Pick the accuracy flavor matching the compiled loss (Keras semantics)."""
+    if loss_name == "sparse_categorical_crossentropy":
+        return sparse_categorical_accuracy
+    if loss_name == "binary_crossentropy":
+        return binary_accuracy
+    if loss_name == "categorical_crossentropy":
+        return categorical_accuracy
+    return categorical_accuracy
+
+
+def get(identifier: Union[str, Callable], loss=None,
+        custom_objects: Optional[Dict[str, Callable]] = None) -> Callable:
+    """Resolve a metric from a name or callable."""
+    if callable(identifier):
+        return identifier
+    if custom_objects and identifier in custom_objects:
+        return custom_objects[identifier]
+    if identifier in ("acc", "accuracy"):
+        loss_name = loss if isinstance(loss, str) else getattr(loss, "__name__", None)
+        return resolve_accuracy(loss_name)
+    if identifier in _METRICS:
+        return _METRICS[identifier]
+    raise ValueError(f"Unknown metric: {identifier!r}")
+
+
+def serialize(identifier: Union[str, Callable]) -> str:
+    if isinstance(identifier, str):
+        return identifier
+    for name, fn in _METRICS.items():
+        if fn is identifier:
+            return name
+    return getattr(identifier, "__name__", str(identifier))
+
+
+def resolve_metrics(metrics: Optional[List], loss=None,
+                    custom_objects: Optional[Dict] = None):
+    """Resolve a metrics list to (names, callables)."""
+    metrics = metrics or []
+    names, fns = [], []
+    for m in metrics:
+        names.append(serialize(m) if not isinstance(m, str) else m)
+        fns.append(get(m, loss=loss, custom_objects=custom_objects))
+    return names, fns

@@ -1,0 +1,174 @@
+"""Optimizers: thin serializable wrappers around optax transforms.
+
+The reference serializes Keras optimizer configs into its distributed config
+and rebuilds them on every worker (``elephas/spark_model.py:54``,
+``elephas/worker.py:30``). Here each optimizer is a named config object that
+lowers to an ``optax.GradientTransformation``; (de)serialization round-trips
+through the same ``{'class_name', 'config'}`` shape so optimizer settings
+travel inside model JSON and checkpoint manifests.
+"""
+from typing import Dict, Union
+
+import optax
+
+
+class Optimizer:
+    """Base class: named hyperparameter bundle lowering to optax."""
+
+    def __init__(self, learning_rate: float = 0.01, **kwargs):
+        self.learning_rate = float(learning_rate)
+        self.kwargs = kwargs
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def get_config(self) -> Dict:
+        return {"learning_rate": self.learning_rate, **self.kwargs}
+
+    @classmethod
+    def from_config(cls, config: Dict) -> "Optimizer":
+        config = dict(config)
+        if "lr" in config:  # legacy Keras alias
+            config["learning_rate"] = config.pop("lr")
+        return cls(**config)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.momentum = float(momentum)
+        self.nesterov = bool(nesterov)
+
+    def to_optax(self):
+        return optax.sgd(self.learning_rate,
+                         momentum=self.momentum if self.momentum else None,
+                         nesterov=self.nesterov)
+
+    def get_config(self):
+        return {"learning_rate": self.learning_rate, "momentum": self.momentum,
+                "nesterov": self.nesterov}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-7, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.beta_1, self.beta_2, self.epsilon = float(beta_1), float(beta_2), float(epsilon)
+
+    def to_optax(self):
+        return optax.adam(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+                          eps=self.epsilon)
+
+    def get_config(self):
+        return {"learning_rate": self.learning_rate, "beta_1": self.beta_1,
+                "beta_2": self.beta_2, "epsilon": self.epsilon}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate: float = 0.001, weight_decay: float = 0.004,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.weight_decay = float(weight_decay)
+
+    def to_optax(self):
+        return optax.adamw(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+                           eps=self.epsilon, weight_decay=self.weight_decay)
+
+    def get_config(self):
+        config = super().get_config()
+        config["weight_decay"] = self.weight_decay
+        return config
+
+
+class RMSprop(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, rho: float = 0.9,
+                 momentum: float = 0.0, epsilon: float = 1e-7, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.rho, self.momentum, self.epsilon = float(rho), float(momentum), float(epsilon)
+
+    def to_optax(self):
+        return optax.rmsprop(self.learning_rate, decay=self.rho, eps=self.epsilon,
+                             momentum=self.momentum if self.momentum else None)
+
+    def get_config(self):
+        return {"learning_rate": self.learning_rate, "rho": self.rho,
+                "momentum": self.momentum, "epsilon": self.epsilon}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, epsilon: float = 1e-7, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.epsilon = float(epsilon)
+
+    def to_optax(self):
+        return optax.adagrad(self.learning_rate, eps=self.epsilon)
+
+    def get_config(self):
+        return {"learning_rate": self.learning_rate, "epsilon": self.epsilon}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, rho: float = 0.95,
+                 epsilon: float = 1e-7, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.rho, self.epsilon = float(rho), float(epsilon)
+
+    def to_optax(self):
+        return optax.adadelta(self.learning_rate, rho=self.rho, eps=self.epsilon)
+
+    def get_config(self):
+        return {"learning_rate": self.learning_rate, "rho": self.rho,
+                "epsilon": self.epsilon}
+
+
+class Nadam(Adam):
+    def to_optax(self):
+        return optax.nadam(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+                           eps=self.epsilon)
+
+
+_OPTIMIZERS = {
+    "SGD": SGD, "sgd": SGD,
+    "Adam": Adam, "adam": Adam,
+    "AdamW": AdamW, "adamw": AdamW,
+    "RMSprop": RMSprop, "rmsprop": RMSprop,
+    "Adagrad": Adagrad, "adagrad": Adagrad,
+    "Adadelta": Adadelta, "adadelta": Adadelta,
+    "Nadam": Nadam, "nadam": Nadam,
+}
+
+
+def serialize(optimizer: Optimizer) -> Dict:
+    return {"class_name": type(optimizer).__name__, "config": optimizer.get_config()}
+
+
+def deserialize(config: Dict) -> Optimizer:
+    cls = _OPTIMIZERS.get(config["class_name"])
+    if cls is None:
+        raise ValueError(f"Unknown optimizer: {config['class_name']!r}")
+    return cls.from_config(config.get("config", {}))
+
+
+def get(identifier: Union[str, Dict, Optimizer]) -> Optimizer:
+    """Resolve an optimizer from a name, serialized dict or instance."""
+    if isinstance(identifier, Optimizer):
+        return identifier
+    if isinstance(identifier, dict):
+        return deserialize(identifier)
+    if isinstance(identifier, str):
+        cls = _OPTIMIZERS.get(identifier)
+        if cls is None:
+            raise ValueError(f"Unknown optimizer: {identifier!r}")
+        return cls()
+    raise ValueError(f"Cannot interpret optimizer: {identifier!r}")

@@ -1,0 +1,67 @@
+"""Model persistence: HDF5 files with architecture JSON + weight datasets.
+
+Layout mirrors the spirit of Keras h5 files so the distributed layer can
+append its ``distributed_config`` attribute exactly like the reference does
+(``elephas/spark_model.py:117-122``):
+
+    attrs:  model_config (JSON), training_config (JSON, optional)
+    group ``model_weights``: one dataset per weight, ordered index names
+
+``.keras``-suffixed paths are accepted and stored in the same container
+format (parity with the reference's accepted extensions,
+``elephas/spark_model.py:104-111``).
+"""
+import json
+from pathlib import Path
+from typing import Dict, Optional
+
+import h5py
+import numpy as np
+
+from . import losses as losses_mod
+from . import metrics as metrics_mod
+from . import optimizers as optimizers_mod
+from .core import BaseModel, model_from_json
+
+
+def save_model(model: BaseModel, filepath: str, overwrite: bool = True,
+               include_optimizer: bool = True):
+    path = Path(filepath)
+    if path.exists() and not overwrite:
+        raise FileExistsError(f"{filepath} exists and overwrite=False")
+    with h5py.File(filepath, "w") as f:
+        f.attrs["model_config"] = model.to_json().encode("utf8")
+        group = f.create_group("model_weights")
+        if model.built:
+            for i, w in enumerate(model.get_weights()):
+                group.create_dataset(f"weight_{i}", data=np.asarray(w))
+        if include_optimizer and model.compiled:
+            training_config = {
+                "optimizer": optimizers_mod.serialize(model.optimizer),
+                "loss": losses_mod.serialize(model.loss),
+                "metrics": [metrics_mod.serialize(m) for m in model.metrics],
+            }
+            f.attrs["training_config"] = json.dumps(training_config).encode("utf8")
+
+
+def load_model(filepath: str, custom_objects: Optional[Dict] = None) -> BaseModel:
+    with h5py.File(filepath, "r") as f:
+        model_config = f.attrs["model_config"]
+        if isinstance(model_config, bytes):
+            model_config = model_config.decode("utf8")
+        model = model_from_json(model_config, custom_objects)
+        group = f.get("model_weights")
+        if group is not None and len(group):
+            weights = [np.asarray(group[f"weight_{i}"]) for i in range(len(group))]
+            if not model.built:
+                model.build()
+            model.set_weights(weights)
+        training_config = f.attrs.get("training_config")
+        if training_config is not None:
+            if isinstance(training_config, bytes):
+                training_config = training_config.decode("utf8")
+            cfg = json.loads(training_config)
+            model.compile(optimizer=optimizers_mod.deserialize(cfg["optimizer"]),
+                          loss=cfg["loss"], metrics=cfg.get("metrics", []),
+                          custom_objects=custom_objects)
+    return model

@@ -1,0 +1,71 @@
+"""Parameter algebra for lists of weight arrays and JAX pytrees.
+
+This is the whole "gradient algebra" of the distributed layer: workers ship
+weight *deltas* (weights-before-training minus weights-after-training) and the
+driver or parameter server folds them into the master parameters.
+
+Capability parity with the reference's elementwise list-of-ndarray operations
+(``elephas/utils/functional_utils.py:6-43``), generalized to arbitrary JAX
+pytrees so that model parameters never need to be flattened to apply algebra.
+"""
+from typing import Any, List, Sequence
+
+import jax
+import numpy as np
+
+Params = List[np.ndarray]
+
+
+def add_params(param_list_left: Sequence[np.ndarray],
+               param_list_right: Sequence[np.ndarray]) -> Params:
+    """Elementwise sum of two lists of weight arrays."""
+    return [x + y for x, y in zip(param_list_left, param_list_right)]
+
+
+def subtract_params(param_list_left: Sequence[np.ndarray],
+                    param_list_right: Sequence[np.ndarray]) -> Params:
+    """Elementwise difference of two lists of weight arrays (left - right)."""
+    return [x - y for x, y in zip(param_list_left, param_list_right)]
+
+
+def get_neutral(array_list: Sequence[np.ndarray]) -> Params:
+    """Zero-valued arrays with the same shapes/dtypes as the input list."""
+    return [np.zeros_like(x) for x in array_list]
+
+
+def divide_by(array_list: Sequence[np.ndarray], num_workers: int) -> Params:
+    """Divide every array in the list by a scalar (worker count)."""
+    return [x / num_workers for x in array_list]
+
+
+# ---------------------------------------------------------------------------
+# Pytree generalizations — the native currency of the TPU framework. Model
+# parameters are pytrees; these are used inside jitted code where the
+# list-based forms above are used at the (numpy) wire boundary.
+# ---------------------------------------------------------------------------
+
+def tree_add(left: Any, right: Any) -> Any:
+    """Elementwise sum of two pytrees of arrays."""
+    return jax.tree_util.tree_map(lambda x, y: x + y, left, right)
+
+
+def tree_subtract(left: Any, right: Any) -> Any:
+    """Elementwise difference of two pytrees of arrays (left - right)."""
+    return jax.tree_util.tree_map(lambda x, y: x - y, left, right)
+
+
+def tree_zeros_like(tree: Any) -> Any:
+    """Zero pytree with the same structure/shapes/dtypes."""
+    return jax.tree_util.tree_map(
+        lambda x: np.zeros_like(x) if isinstance(x, np.ndarray) else jax.numpy.zeros_like(x),
+        tree)
+
+
+def tree_divide(tree: Any, denominator) -> Any:
+    """Divide every leaf by a scalar."""
+    return jax.tree_util.tree_map(lambda x: x / denominator, tree)
+
+
+def tree_scale(tree: Any, factor) -> Any:
+    """Multiply every leaf by a scalar."""
+    return jax.tree_util.tree_map(lambda x: x * factor, tree)

@@ -1,0 +1,85 @@
+"""Model-type classification and loss-name mapping.
+
+The ML-pipeline layer needs to know whether a trained model is a classifier
+(output column = probability vector) or a regressor (output column = scalar).
+The mapping is inferred from the compiled loss name with a user-extensible
+registry, mirroring the reference's behavior
+(``elephas/utils/model_utils.py:9-70``).
+"""
+import json
+from enum import Enum
+
+
+class ModelType(Enum):
+    CLASSIFICATION = 1
+    REGRESSION = 2
+
+
+class _Singleton(type):
+    """Metaclass giving each subclass a single shared instance."""
+    _instances = {}
+
+    def __call__(cls, *args):
+        if cls not in cls._instances:
+            cls._instances[cls] = super(_Singleton, cls).__call__(*args)
+        return cls._instances[cls]
+
+
+class Singleton(_Singleton("SingletonMeta", (object,), {})):
+    pass
+
+
+class LossModelTypeMapper(Singleton):
+    """Registry mapping loss names to :class:`ModelType`.
+
+    Built-in regression losses: mse/mae families, logcosh, cosine similarity.
+    Built-in classification losses: the crossentropy family. Custom losses
+    (callables or names) can be registered with :meth:`register_loss`.
+    """
+
+    def __init__(self):
+        self._mapping = {
+            "mean_squared_error": ModelType.REGRESSION,
+            "mean_absolute_error": ModelType.REGRESSION,
+            "mse": ModelType.REGRESSION,
+            "mae": ModelType.REGRESSION,
+            "cosine_proximity": ModelType.REGRESSION,
+            "cosine_similarity": ModelType.REGRESSION,
+            "mean_absolute_percentage_error": ModelType.REGRESSION,
+            "mape": ModelType.REGRESSION,
+            "mean_squared_logarithmic_error": ModelType.REGRESSION,
+            "msle": ModelType.REGRESSION,
+            "logcosh": ModelType.REGRESSION,
+            "log_cosh": ModelType.REGRESSION,
+            "huber": ModelType.REGRESSION,
+            "binary_crossentropy": ModelType.CLASSIFICATION,
+            "categorical_crossentropy": ModelType.CLASSIFICATION,
+            "sparse_categorical_crossentropy": ModelType.CLASSIFICATION,
+        }
+
+    def get_model_type(self, loss):
+        if callable(loss):
+            loss = getattr(loss, "__name__", str(loss))
+        return self._mapping.get(loss)
+
+    def register_loss(self, loss, model_type):
+        if callable(loss):
+            loss = loss.__name__
+        self._mapping.update({loss: model_type})
+
+
+class ModelTypeEncoder(json.JSONEncoder):
+    """JSON encoder that persists :class:`ModelType` enum members."""
+
+    def default(self, obj):
+        if isinstance(obj, ModelType):
+            return {"__enum__": str(obj)}
+        return json.JSONEncoder.default(self, obj)
+
+
+def as_enum(d):
+    """``object_hook`` reconstructing :class:`ModelType` members from JSON."""
+    if "__enum__" in d:
+        _, member = d["__enum__"].split(".")
+        return getattr(ModelType, member)
+    return d

@@ -1,0 +1,15 @@
+"""Notebook environment detection.
+
+Used by the parameter-server layer to pick thread-friendly defaults when
+running inside IPython/Jupyter (parity with
+``elephas/utils/notebook_utils.py:1-9``).
+"""
+
+
+def is_running_in_notebook() -> bool:
+    try:
+        from IPython import get_ipython
+
+        return get_ipython() is not None
+    except ImportError:
+        return False

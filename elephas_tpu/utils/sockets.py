@@ -1,0 +1,65 @@
+"""Socket helpers and framed transport for the parameter-server layer.
+
+Address discovery mirrors the reference (``elephas/utils/sockets.py:6-21``):
+workers locate the parameter server via an environment variable or, absent
+that, the host's own address (valid because in single-controller JAX the
+coordinator process lives on host 0). ``ELEPHAS_TPU_MASTER_IP`` is the native
+variable; ``SPARK_LOCAL_IP`` is honored for drop-in compatibility.
+
+The wire frame replaces the reference's 20-byte ASCII length + pickle
+(``elephas/utils/sockets.py:45-71``) with an 8-byte little-endian length
+prefix followed by an ETPU typed-tensor payload (:mod:`.tensor_codec`) — no
+arbitrary code execution on receive, and a format a C++ peer can speak.
+"""
+import os
+import socket
+from socket import gethostbyname, gethostname
+from typing import List, Sequence
+
+import numpy as np
+
+from .tensor_codec import decode_tensors, encode_tensors, KIND_WEIGHTS
+
+LENGTH_BYTES = 8
+
+
+def determine_master(port: int = 4000) -> str:
+    """Determine ``host:port`` of the master/parameter server.
+
+    Resolution order: ``$ELEPHAS_TPU_MASTER_IP``, ``$SPARK_LOCAL_IP`` (for
+    compatibility with reference deployments), then this host's address.
+    """
+    host = os.environ.get("ELEPHAS_TPU_MASTER_IP") or os.environ.get("SPARK_LOCAL_IP")
+    if not host:
+        try:
+            host = gethostbyname(gethostname())
+        except socket.gaierror:
+            host = "127.0.0.1"
+    return host + ":" + str(port)
+
+
+def _receive_all(sock: socket.socket, num_bytes: int) -> bytes:
+    """Read exactly ``num_bytes`` bytes from the socket."""
+    chunks = []
+    remaining = num_bytes
+    while remaining > 0:
+        data = sock.recv(remaining)
+        if not data:
+            raise ConnectionError("socket closed while reading frame")
+        chunks.append(data)
+        remaining -= len(data)
+    return b"".join(chunks)
+
+
+def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS):
+    """Send a list of arrays as one length-prefixed ETPU frame."""
+    payload = encode_tensors(arrays, kind)
+    sock.sendall(len(payload).to_bytes(LENGTH_BYTES, "little"))
+    sock.sendall(payload)
+
+
+def receive(sock: socket.socket) -> List[np.ndarray]:
+    """Receive one length-prefixed ETPU frame; returns the array list."""
+    length = int.from_bytes(_receive_all(sock, LENGTH_BYTES), "little")
+    arrays, _ = decode_tensors(_receive_all(sock, length))
+    return arrays

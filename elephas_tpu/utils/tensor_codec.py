@@ -1,0 +1,111 @@
+"""Typed, length-prefixed binary wire format for tensor payloads.
+
+The reference ships weights between workers and its parameter server as
+pickled Python objects over HTTP/TCP (``elephas/utils/sockets.py:45-71``,
+``elephas/parameter/client.py:54-91``). Pickle is unsafe to deserialize from
+the network and slow. This module replaces it with a typed tensor protocol:
+
+    header:  magic b"ETPU" | u8 version | u8 kind | u32 count
+    per tensor: u8 dtype-code | u8 ndim | u64[ndim] dims | raw little-endian bytes
+
+``kind`` distinguishes payload semantics (plain weight list, delta list,
+scalar metadata). The codec round-trips a flat list of numpy arrays — the
+currency of the parameter-server layer — without executing any embedded code.
+
+A C++ implementation of the same format (``native/tensor_codec.cpp``) is used
+when built; this module is the canonical specification and pure-Python
+fallback.
+"""
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+MAGIC = b"ETPU"
+VERSION = 1
+
+KIND_WEIGHTS = 0
+KIND_DELTA = 1
+KIND_SCALARS = 2
+
+_DTYPE_CODES = {
+    np.dtype("float32"): 0,
+    np.dtype("float64"): 1,
+    np.dtype("int32"): 2,
+    np.dtype("int64"): 3,
+    np.dtype("uint8"): 4,
+    np.dtype("bool"): 5,
+    np.dtype("float16"): 6,
+    np.dtype("int8"): 7,
+    np.dtype("uint32"): 8,
+    np.dtype("uint64"): 9,
+}
+try:  # ml_dtypes provides bfloat16 as a numpy extension dtype
+    import ml_dtypes  # noqa: F401
+
+    _DTYPE_CODES[np.dtype(ml_dtypes.bfloat16)] = 10
+except Exception:  # pragma: no cover - optional
+    pass
+
+_CODE_DTYPES = {}
+for _dt, _code in _DTYPE_CODES.items():
+    _CODE_DTYPES.setdefault(_code, _dt)
+
+
+class CodecError(ValueError):
+    pass
+
+
+def encode_tensors(arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS) -> bytes:
+    """Serialize a list of numpy arrays into the ETPU wire format."""
+    parts = [MAGIC, struct.pack("<BBI", VERSION, kind, len(arrays))]
+    for arr in arrays:
+        arr = np.asarray(arr)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        if arr.dtype not in _DTYPE_CODES:
+            arr = arr.astype(np.float32)
+        code = _DTYPE_CODES[arr.dtype]
+        parts.append(struct.pack("<BB", code, arr.ndim))
+        parts.append(struct.pack("<%dQ" % arr.ndim, *arr.shape))
+        parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
+def decode_tensors(payload: bytes) -> tuple:
+    """Deserialize an ETPU payload. Returns ``(arrays, kind)``."""
+    if len(payload) < 10 or payload[:4] != MAGIC:
+        raise CodecError("not an ETPU payload")
+    version, kind, count = struct.unpack_from("<BBI", payload, 4)
+    if version != VERSION:
+        raise CodecError(f"unsupported ETPU version {version}")
+    offset = 10
+    arrays: List[np.ndarray] = []
+    for _ in range(count):
+        if offset + 2 > len(payload):
+            raise CodecError("truncated tensor header")
+        code, ndim = struct.unpack_from("<BB", payload, offset)
+        offset += 2
+        if code not in _CODE_DTYPES:
+            raise CodecError(f"unknown dtype code {code}")
+        if offset + 8 * ndim > len(payload):
+            raise CodecError("truncated shape header")
+        dims = struct.unpack_from("<%dQ" % ndim, payload, offset)
+        offset += 8 * ndim
+        dtype = _CODE_DTYPES[code]
+        nbytes = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize if ndim else dtype.itemsize
+        if offset + nbytes > len(payload):
+            raise CodecError("truncated tensor body")
+        arr = np.frombuffer(payload[offset:offset + nbytes], dtype=dtype).reshape(dims)
+        offset += nbytes
+        arrays.append(arr.copy())
+    return arrays, kind
+
+
+def encode_weights(weights: Sequence[np.ndarray]) -> bytes:
+    return encode_tensors(weights, KIND_WEIGHTS)
+
+
+def decode_weights(payload: bytes) -> List[np.ndarray]:
+    arrays, _ = decode_tensors(payload)
+    return arrays

@@ -1,0 +1,36 @@
+"""Linalg adapter tests (mirror of ``/root/reference/tests/mllib/test_adapter.py``)."""
+import numpy as np
+
+from elephas_tpu.mllib.adapter import (from_matrix, from_vector, to_matrix,
+                                       to_vector)
+from elephas_tpu.mllib.linalg import Matrices, Vectors
+
+
+def test_to_matrix():
+    x = np.ones((4, 2))
+    mat = to_matrix(x)
+    assert mat.numRows == 4
+    assert mat.numCols == 2
+
+
+def test_from_matrix():
+    mat = Matrices.dense(1, 2, [13, 37])
+    x = from_matrix(mat)
+    assert x.shape == (1, 2)
+
+
+def test_matrix_round_trip():
+    x = np.arange(12, dtype=float).reshape(3, 4)
+    assert np.array_equal(from_matrix(to_matrix(x)), x)
+
+
+def test_to_vector():
+    x = np.ones((3,))
+    vector = to_vector(x)
+    assert len(vector) == 3
+
+
+def test_from_vector():
+    vector = Vectors.dense([4, 2])
+    x = from_vector(vector)
+    assert x.shape == (2,)

@@ -1,0 +1,198 @@
+"""Model-layer tests: training decreases loss, shapes, JSON round-trips,
+functional API, optimizers, save/load."""
+import numpy as np
+import pytest
+
+import elephas_tpu.models as M
+
+
+def _toy_classification(n=256, dim=20, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 3, size=(classes, dim))
+    labels = rng.integers(0, classes, size=n)
+    x = (centers[labels] + rng.normal(0, 0.5, size=(n, dim))).astype(np.float32)
+    return x, np.eye(classes, dtype=np.float32)[labels]
+
+
+def test_fit_decreases_loss():
+    x, y = _toy_classification()
+    model = M.Sequential([M.Dense(32, activation="relu", input_dim=20),
+                          M.Dense(4, activation="softmax")])
+    model.compile(M.SGD(learning_rate=0.5), "categorical_crossentropy", ["acc"], seed=0)
+    history = model.fit(x, y, epochs=5, batch_size=32)
+    assert history.history["loss"][-1] < history.history["loss"][0]
+    assert history.history["acc"][-1] > 0.5
+
+
+def test_validation_split_history_keys():
+    x, y = _toy_classification()
+    model = M.Sequential([M.Dense(8, activation="relu", input_dim=20),
+                          M.Dense(4, activation="softmax")])
+    model.compile("sgd", "categorical_crossentropy", ["acc"], seed=0)
+    history = model.fit(x, y, epochs=2, batch_size=32, validation_split=0.2)
+    assert set(history.history) == {"loss", "acc", "val_loss", "val_acc"}
+    assert all(len(v) == 2 for v in history.history.values())
+
+
+def test_evaluate_matches_manual_loss():
+    x, y = _toy_classification(n=64)
+    model = M.Sequential([M.Dense(4, activation="softmax", input_dim=20)])
+    model.compile("sgd", "categorical_crossentropy", seed=0)
+    loss = model.evaluate(x, y, batch_size=16)
+    preds = model.predict(x, batch_size=16)
+    eps = 1e-7
+    p = np.clip(preds, eps, 1.0)
+    p = p / p.sum(-1, keepdims=True)
+    manual = float(np.mean(-np.sum(y * np.log(p), axis=-1)))
+    assert loss == pytest.approx(manual, abs=1e-4)
+
+
+def test_evaluate_returns_list_with_metrics_scalar_without():
+    x, y = _toy_classification(n=64)
+    model = M.Sequential([M.Dense(4, activation="softmax", input_dim=20)])
+    model.compile("sgd", "categorical_crossentropy", ["acc"], seed=0)
+    out = model.evaluate(x, y)
+    assert isinstance(out, list) and len(out) == 2
+
+    model2 = M.Sequential([M.Dense(4, activation="softmax", input_dim=20)])
+    model2.compile("sgd", "categorical_crossentropy", seed=0)
+    assert np.isscalar(model2.evaluate(x, y))
+
+
+def test_predict_batching_consistent():
+    x, _ = _toy_classification(n=70)
+    model = M.Sequential([M.Dense(4, activation="softmax", input_dim=20)])
+    model.compile("sgd", "categorical_crossentropy", seed=0)
+    full = model.predict(x, batch_size=70)
+    batched = model.predict(x, batch_size=16)
+    np.testing.assert_allclose(full, batched, atol=1e-5)
+
+
+def test_train_on_batch():
+    x, y = _toy_classification(n=32)
+    model = M.Sequential([M.Dense(4, activation="softmax", input_dim=20)])
+    model.compile(M.SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"], seed=0)
+    before = model.get_weights()
+    out = model.train_on_batch(x, y)
+    after = model.get_weights()
+    assert isinstance(out, list) and len(out) == 2
+    assert not np.array_equal(before[0], after[0])
+
+
+def test_regression_scalar_labels():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 13)).astype(np.float32)
+    y = (x @ rng.normal(size=13) + 1.0).astype(np.float32)
+    model = M.Sequential([M.Dense(16, activation="relu", input_shape=(13,)),
+                          M.Dense(1, activation="linear")])
+    model.compile(M.SGD(learning_rate=0.01), "mse", ["mae"], seed=0)
+    history = model.fit(x, y, epochs=5, batch_size=32)
+    assert history.history["loss"][-1] < history.history["loss"][0]
+    preds = model.predict(x)
+    assert preds.shape == (128, 1)
+
+
+def test_json_round_trip_preserves_forward():
+    x, _ = _toy_classification(n=16)
+    model = M.Sequential([M.Dense(8, activation="relu", input_dim=20),
+                          M.Dropout(0.5),
+                          M.Dense(4, activation="softmax")])
+    model.compile("adam", "categorical_crossentropy", seed=0)
+    clone = M.model_from_json(model.to_json())
+    clone.set_weights(model.get_weights())
+    np.testing.assert_allclose(np.asarray(clone.apply(clone.params, x)),
+                               np.asarray(model.apply(model.params, x)), atol=1e-6)
+
+
+def test_custom_activation_round_trip():
+    import jax
+
+    def custom_activation(v):
+        return jax.nn.sigmoid(v) + 1
+
+    model = M.Sequential([M.Dense(4, input_dim=3, activation=custom_activation),
+                          M.Dense(1, activation="sigmoid")])
+    model.compile("sgd", "binary_crossentropy", seed=0)
+    clone = M.model_from_json(model.to_json(),
+                              custom_objects={"custom_activation": custom_activation})
+    clone.set_weights(model.get_weights())
+    x = np.random.default_rng(0).random((4, 3), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(clone.apply(clone.params, x)),
+                               np.asarray(model.apply(model.params, x)), atol=1e-6)
+
+
+def test_functional_api_multi_branch():
+    inp = M.Input(shape=(12,))
+    a = M.Dense(8, activation="relu")(inp)
+    b = M.Dense(8, activation="tanh")(inp)
+    merged = M.Concatenate()([a, b])
+    out = M.Dense(2, activation="softmax")(merged)
+    model = M.Model(inputs=inp, outputs=out)
+    model.compile("sgd", "categorical_crossentropy", seed=0)
+    x = np.random.default_rng(0).random((6, 12), dtype=np.float32)
+    preds = model.predict(x)
+    assert preds.shape == (6, 2)
+    clone = M.model_from_json(model.to_json())
+    clone.set_weights(model.get_weights())
+    np.testing.assert_allclose(clone.predict(x), preds, atol=1e-6)
+
+
+def test_conv_model_shapes():
+    model = M.Sequential([
+        M.Conv2D(4, 3, activation="relu", input_shape=(8, 8, 1)),
+        M.MaxPooling2D(2),
+        M.Flatten(),
+        M.Dense(2, activation="softmax"),
+    ])
+    model.compile("sgd", "categorical_crossentropy", seed=0)
+    x = np.random.default_rng(0).random((5, 8, 8, 1), dtype=np.float32)
+    assert model.predict(x).shape == (5, 2)
+
+
+def test_batchnorm_updates_moving_stats():
+    model = M.Sequential([M.Dense(8, input_dim=4),
+                          M.BatchNormalization(),
+                          M.Dense(1)])
+    model.compile(M.SGD(learning_rate=0.01), "mse", seed=0)
+    bn = [l for l in model.layers if isinstance(l, M.BatchNormalization)][0]
+    before = np.asarray(model.params[bn.name]["moving_mean"]).copy()
+    x = np.random.default_rng(0).normal(5.0, 1.0, size=(64, 4)).astype(np.float32)
+    y = np.ones((64,), dtype=np.float32)
+    model.fit(x, y, epochs=1, batch_size=32)
+    after = np.asarray(model.params[bn.name]["moving_mean"])
+    assert not np.allclose(before, after)
+
+
+def test_sparse_categorical_loss():
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 10), dtype=np.float32)
+    y = rng.integers(0, 3, size=64)
+    model = M.Sequential([M.Dense(3, activation="softmax", input_dim=10)])
+    model.compile("sgd", "sparse_categorical_crossentropy", ["acc"], seed=0)
+    history = model.fit(x, y, epochs=1, batch_size=16)
+    assert "acc" in history.history
+
+
+def test_optimizer_serialization_round_trip():
+    for opt in [M.SGD(learning_rate=0.1, momentum=0.9, nesterov=True),
+                M.Adam(learning_rate=0.01), M.RMSprop(), M.Adagrad(),
+                M.Adadelta(), M.Nadam(), M.AdamW()]:
+        payload = M.serialize_optimizer(opt)
+        clone = M.deserialize_optimizer(payload)
+        assert type(clone) is type(opt)
+        assert clone.get_config() == opt.get_config()
+
+
+def test_save_load_h5(tmp_path):
+    x, y = _toy_classification(n=32)
+    model = M.Sequential([M.Dense(8, activation="relu", input_dim=20),
+                          M.Dense(4, activation="softmax")])
+    model.compile(M.SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"], seed=0)
+    model.fit(x, y, epochs=1, batch_size=16)
+    path = str(tmp_path / "model.h5")
+    model.save(path)
+    loaded = M.load_model(path)
+    assert loaded.compiled
+    np.testing.assert_allclose(loaded.predict(x), model.predict(x), atol=1e-6)
+    assert isinstance(loaded.optimizer, M.SGD)
+    assert loaded.optimizer.learning_rate == pytest.approx(0.1)

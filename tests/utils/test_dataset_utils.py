@@ -1,0 +1,100 @@
+"""Dataset conversion tests (mirror of the reference's rdd_utils tests,
+``/root/reference/tests/utils/test_rdd_utils.py``)."""
+import numpy as np
+
+from elephas_tpu.utils import dataset_utils
+
+
+def test_to_dataset():
+    features = np.ones((5, 10))
+    labels = np.ones((5,))
+    ds = dataset_utils.to_dataset(features, labels)
+    assert ds.count() == 5
+    first = ds.first()
+    assert first[0].shape == (10,)
+    assert first[1] == 1.0
+
+
+def test_to_labeled_points_categorical():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, True)
+    assert lp_ds.count() == 2
+    first = lp_ds.first()
+    assert len(first.features) == 10
+    assert first.label == 2.0
+
+
+def test_to_labeled_points_not_categorical():
+    features = np.ones((2, 10))
+    labels = np.asarray([[2.0], [1.0]])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, False)
+    assert lp_ds.count() == 2
+    assert lp_ds.first().label == 2.0
+
+
+def test_from_labeled_points():
+    features = np.ones((2, 10))
+    labels = np.asarray([2.0, 1.0])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, False)
+    x, y = dataset_utils.from_labeled_points(lp_ds, False, None)
+    assert x.shape == features.shape
+    assert y.shape == labels.shape
+
+
+def test_from_labeled_points_categorical():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, True)
+    x, y = dataset_utils.from_labeled_points(lp_ds, True, 3)
+    assert x.shape == features.shape
+    assert y.shape == labels.shape
+
+
+def test_encode_label():
+    encoded = dataset_utils.encode_label(3, 10)
+    assert len(encoded) == 10
+    for i in range(10):
+        assert encoded[i] == (1 if i == 3 else 0)
+
+
+def test_lp_to_dataset_categorical():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, True)
+    ds = dataset_utils.lp_to_dataset(lp_ds, categorical=True, nb_classes=3)
+    first = ds.first()
+    assert first[0].shape == (10,)
+    assert first[1].shape == (3,)
+
+
+def test_lp_to_dataset_not_categorical():
+    features = np.ones((2, 10))
+    labels = np.asarray([2.0, 1.0])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, False)
+    ds = dataset_utils.lp_to_dataset(lp_ds, categorical=False, nb_classes=3)
+    first = ds.first()
+    assert first[0].shape == (10,)
+    assert first[1] == 2.0
+
+
+def test_lp_to_dataset_categorical_nb_classes_inferred():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    lp_ds = dataset_utils.to_labeled_points(features, labels, True)
+    ds = dataset_utils.lp_to_dataset(lp_ds, categorical=True)
+    assert ds.first()[1].shape == (3,)
+
+
+def test_dataset_partitioning():
+    features = np.arange(10).reshape(10, 1).astype(float)
+    labels = np.arange(10).astype(float)
+    ds = dataset_utils.to_dataset(features, labels, num_partitions=3)
+    sizes = ds.partition_sizes()
+    assert sizes == [4, 3, 3]
+    parts = ds.partitions()
+    assert len(parts) == 3
+    # contiguous, order preserving
+    assert np.array_equal(parts[0][1], np.array([0, 1, 2, 3.0]))
+    re = ds.repartition(2)
+    assert re.partition_sizes() == [5, 5]

@@ -1,0 +1,43 @@
+import numpy as np
+
+from elephas_tpu.utils import functional_utils
+
+
+def test_add_params():
+    pairs = [(np.ones((4, 2)), np.ones((4, 2))), (np.ones(3), 2 * np.ones(3))]
+    left = [p[0] for p in pairs]
+    right = [p[1] for p in pairs]
+    out = functional_utils.add_params(left, right)
+    assert np.array_equal(out[0], 2 * np.ones((4, 2)))
+    assert np.array_equal(out[1], 3 * np.ones(3))
+
+
+def test_subtract_params():
+    left = [3 * np.ones((2, 2))]
+    right = [np.ones((2, 2))]
+    out = functional_utils.subtract_params(left, right)
+    assert np.array_equal(out[0], 2 * np.ones((2, 2)))
+
+
+def test_get_neutral():
+    out = functional_utils.get_neutral([np.ones((3, 3)), np.ones(5)])
+    assert np.array_equal(out[0], np.zeros((3, 3)))
+    assert np.array_equal(out[1], np.zeros(5))
+
+
+def test_divide_by():
+    out = functional_utils.divide_by([4 * np.ones(4)], 4)
+    assert np.array_equal(out[0], np.ones(4))
+
+
+def test_tree_ops():
+    tree_a = {"layer": {"kernel": np.ones((2, 2)), "bias": np.ones(2)}}
+    tree_b = {"layer": {"kernel": np.ones((2, 2)), "bias": 3 * np.ones(2)}}
+    summed = functional_utils.tree_add(tree_a, tree_b)
+    assert np.array_equal(np.asarray(summed["layer"]["bias"]), 4 * np.ones(2))
+    diff = functional_utils.tree_subtract(tree_b, tree_a)
+    assert np.array_equal(np.asarray(diff["layer"]["bias"]), 2 * np.ones(2))
+    halved = functional_utils.tree_divide(tree_b, 2)
+    assert np.array_equal(np.asarray(halved["layer"]["bias"]), 1.5 * np.ones(2))
+    zeros = functional_utils.tree_zeros_like(tree_a)
+    assert np.array_equal(np.asarray(zeros["layer"]["kernel"]), np.zeros((2, 2)))

@@ -1,0 +1,32 @@
+import json
+
+from elephas_tpu.utils.model_utils import (LossModelTypeMapper, ModelType,
+                                           ModelTypeEncoder, as_enum)
+
+
+def test_builtin_mapping():
+    mapper = LossModelTypeMapper()
+    assert mapper.get_model_type("mse") == ModelType.REGRESSION
+    assert mapper.get_model_type("mean_absolute_error") == ModelType.REGRESSION
+    assert mapper.get_model_type("categorical_crossentropy") == ModelType.CLASSIFICATION
+    assert mapper.get_model_type("binary_crossentropy") == ModelType.CLASSIFICATION
+
+
+def test_custom_loss_registration():
+    def my_custom_loss(y_true, y_pred):
+        return y_true - y_pred
+
+    LossModelTypeMapper().register_loss(my_custom_loss, ModelType.REGRESSION)
+    assert LossModelTypeMapper().get_model_type("my_custom_loss") == ModelType.REGRESSION
+    assert LossModelTypeMapper().get_model_type(my_custom_loss) == ModelType.REGRESSION
+
+
+def test_singleton():
+    assert LossModelTypeMapper() is LossModelTypeMapper()
+
+
+def test_enum_json_round_trip():
+    payload = json.dumps({"model_type": ModelType.CLASSIFICATION},
+                         cls=ModelTypeEncoder)
+    decoded = json.loads(payload, object_hook=as_enum)
+    assert decoded["model_type"] == ModelType.CLASSIFICATION

@@ -1,0 +1,92 @@
+"""RWLock tests (the reference left these as an empty stub,
+``/root/reference/tests/utils/test_rwlock.py:1``)."""
+import threading
+import time
+
+from elephas_tpu.utils.rwlock import RWLock
+
+
+def test_multiple_readers():
+    lock = RWLock()
+    acquired = []
+
+    def reader():
+        lock.acquire_read()
+        acquired.append(1)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=2)
+    assert len(acquired) == 4
+    for _ in range(4):
+        lock.release()
+
+
+def test_writer_excludes_readers():
+    lock = RWLock()
+    lock.acquire_write()
+    got_read = threading.Event()
+
+    def reader():
+        lock.acquire_read()
+        got_read.set()
+        lock.release()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.05)
+    assert not got_read.is_set()
+    lock.release()
+    t.join(timeout=2)
+    assert got_read.is_set()
+
+
+def test_writer_priority_over_new_readers():
+    lock = RWLock()
+    lock.acquire_read()
+    writer_done = threading.Event()
+    reader_done = threading.Event()
+
+    def writer():
+        lock.acquire_write()
+        writer_done.set()
+        lock.release()
+
+    def late_reader():
+        lock.acquire_read()
+        reader_done.set()
+        lock.release()
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    time.sleep(0.05)
+    rt = threading.Thread(target=late_reader)
+    rt.start()
+    time.sleep(0.05)
+    # neither can proceed while the first read lock is held
+    assert not writer_done.is_set() and not reader_done.is_set()
+    lock.release()
+    wt.join(timeout=2)
+    rt.join(timeout=2)
+    assert writer_done.is_set() and reader_done.is_set()
+
+
+def test_counter_consistency_under_contention():
+    lock = RWLock()
+    state = {"value": 0}
+
+    def writer():
+        for _ in range(50):
+            lock.acquire_write()
+            v = state["value"]
+            state["value"] = v + 1
+            lock.release()
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert state["value"] == 200

@@ -1,0 +1,21 @@
+import numpy as np
+
+from elephas_tpu.models import SGD, Activation, Dense, Sequential
+from elephas_tpu.utils.serialization import dict_to_model, model_to_dict
+
+
+def test_model_dict_round_trip():
+    model = Sequential()
+    model.add(Dense(16, input_dim=8))
+    model.add(Activation("relu"))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(SGD(learning_rate=0.1), "binary_crossentropy", ["acc"], seed=3)
+
+    payload = model_to_dict(model)
+    assert set(payload.keys()) == {"model", "weights"}
+
+    rebuilt = dict_to_model(payload)
+    x = np.random.default_rng(0).random((4, 8), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(rebuilt.apply(rebuilt.params, x)),
+                               np.asarray(model.apply(model.params, x)),
+                               atol=1e-6)

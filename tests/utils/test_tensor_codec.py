@@ -1,0 +1,38 @@
+import numpy as np
+import pytest
+
+from elephas_tpu.utils import tensor_codec
+
+
+def test_round_trip_mixed_dtypes():
+    arrays = [
+        np.random.rand(4, 3).astype(np.float32),
+        np.arange(10, dtype=np.int64),
+        np.array(3.5, dtype=np.float64),
+        np.zeros((2, 0, 3), dtype=np.float32),
+        np.array([True, False]),
+    ]
+    payload = tensor_codec.encode_tensors(arrays, tensor_codec.KIND_DELTA)
+    decoded, kind = tensor_codec.decode_tensors(payload)
+    assert kind == tensor_codec.KIND_DELTA
+    assert len(decoded) == len(arrays)
+    for orig, back in zip(arrays, decoded):
+        assert orig.dtype == back.dtype
+        assert np.array_equal(orig, back)
+
+
+def test_rejects_garbage():
+    with pytest.raises(tensor_codec.CodecError):
+        tensor_codec.decode_tensors(b"not a payload at all")
+
+
+def test_rejects_truncated():
+    payload = tensor_codec.encode_weights([np.ones((8, 8), dtype=np.float32)])
+    with pytest.raises(tensor_codec.CodecError):
+        tensor_codec.decode_tensors(payload[:-10])
+
+
+def test_empty_list():
+    decoded, kind = tensor_codec.decode_tensors(tensor_codec.encode_weights([]))
+    assert decoded == []
+    assert kind == tensor_codec.KIND_WEIGHTS
