@@ -1,0 +1,119 @@
+"""Benchmark: distributed MNIST-MLP training throughput on real hardware.
+
+Measures samples/sec of the framework's synchronous data-parallel training
+(``TPUModel`` with ``sync_mode='step'`` — the benchmark configuration) on
+the reference's canonical workload (MNIST-shape 784-128-128-10 MLP, SGD
+lr=0.1, batch 64: ``examples/mnist_mlp_spark_synchronous.py`` in the
+reference), and compares against a hand-rolled pure-JAX training loop of
+the same model/batch on the same hardware — the ">=90% of single-process
+JAX throughput" bar from BASELINE.md.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": R}
+where vs_baseline = framework_throughput / pure_jax_throughput.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def _data(n=8192, dim=784, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, dim), dtype=np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def bench_framework(x, y, batch_size, epochs=3):
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    model = Sequential([Dense(128, input_dim=784), Activation("relu"),
+                        Dense(128), Activation("relu"),
+                        Dense(10), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy", seed=0)
+    tpu_model = TPUModel(model, mode="synchronous", sync_mode="step",
+                         batch_size=batch_size)
+    dataset = to_dataset(x, y)
+    # warmup: compile
+    tpu_model.fit(dataset, epochs=1, batch_size=batch_size, verbose=0,
+                  validation_split=0.0)
+    start = time.perf_counter()
+    tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=0,
+                  validation_split=0.0)
+    elapsed = time.perf_counter() - start
+    return (x.shape[0] * epochs) / elapsed
+
+
+def bench_pure_jax(x, y, batch_size, epochs=3):
+    """Hand-rolled minimal JAX training loop — the baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def glorot(k, shape):
+        limit = np.sqrt(6.0 / (shape[0] + shape[1]))
+        return jax.random.uniform(k, shape, jnp.float32, -limit, limit)
+
+    params = {
+        "w1": glorot(k1, (784, 128)), "b1": jnp.zeros(128),
+        "w2": glorot(k2, (128, 128)), "b2": jnp.zeros(128),
+        "w3": glorot(k3, (128, 10)), "b3": jnp.zeros(10),
+    }
+
+    def loss_fn(p, xb, yb):
+        h = jax.nn.relu(xb @ p["w1"] + p["b1"])
+        h = jax.nn.relu(h @ p["w2"] + p["b2"])
+        logits = h @ p["w3"] + p["b3"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.sum(yb * logp, axis=-1))
+
+    lr = 0.1
+
+    @jax.jit
+    def step(p, xb, yb):
+        grads = jax.grad(loss_fn)(p, xb, yb)
+        return jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+
+    n = x.shape[0]
+    nb = n // batch_size
+    rng = np.random.default_rng(0)
+
+    def run_epochs(p, count):
+        # same workload as the framework: shuffled mini-batch SGD per epoch
+        for _ in range(count):
+            order = rng.permutation(n)
+            xs, ys = x[order], y[order]
+            for i in range(nb):
+                xb = xs[i * batch_size:(i + 1) * batch_size]
+                yb = ys[i * batch_size:(i + 1) * batch_size]
+                p = step(p, xb, yb)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), p)
+        return p
+
+    params = run_epochs(params, 1)  # warmup/compile
+    start = time.perf_counter()
+    params = run_epochs(params, epochs)
+    elapsed = time.perf_counter() - start
+    return (nb * batch_size * epochs) / elapsed
+
+
+def main():
+    batch_size = 64
+    x, y = _data()
+    framework = bench_framework(x, y, batch_size)
+    pure = bench_pure_jax(x, y, batch_size)
+    print(json.dumps({
+        "metric": "mnist_mlp_sync_samples_per_sec",
+        "value": round(framework, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(framework / pure, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

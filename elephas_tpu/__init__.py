@@ -11,3 +11,4 @@ __version__ = "0.1.0"
 
 from . import models, utils
 from .data import Dataset
+from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

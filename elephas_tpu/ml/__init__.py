@@ -1,0 +1,3 @@
+from .adapter import df_to_dataset, from_data_frame, to_data_frame
+from .pipeline import (Estimator, Transformer, load_ml_estimator,
+                       load_ml_transformer)

@@ -1,0 +1,60 @@
+"""DataFrame bridge: pandas DataFrames <-> Datasets.
+
+The reference bridges Spark DataFrames of (features Vector, label) rows into
+RDDs (``elephas/ml/adapter.py:11-47``); the TPU framework's tabular currency
+is a pandas DataFrame with a features column holding dense vectors.
+"""
+from typing import Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..data.dataset import Dataset
+from ..mllib.linalg import DenseVector, LabeledPoint
+from ..utils.dataset_utils import encode_label, from_labeled_points
+
+
+def to_data_frame(features: np.ndarray, labels: np.ndarray,
+                  categorical: bool = False) -> pd.DataFrame:
+    """Build a ``features``/``label`` DataFrame from numpy arrays.
+
+    One-hot labels collapse to class indices when ``categorical`` is set.
+    """
+    rows = []
+    for x, y in zip(features, labels):
+        label = float(np.argmax(y)) if categorical else float(np.asarray(y).reshape(-1)[0])
+        rows.append({"features": DenseVector(np.asarray(x)), "label": label})
+    return pd.DataFrame(rows)
+
+
+def from_data_frame(df: pd.DataFrame, categorical: bool = False,
+                    nb_classes: Optional[int] = None
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """DataFrame back to numpy feature/label arrays."""
+    points = Dataset([LabeledPoint(row["label"], row["features"])
+                      for _, row in df.iterrows()])
+    return from_labeled_points(points, categorical, nb_classes)
+
+
+def _cell_to_array(cell) -> np.ndarray:
+    if isinstance(cell, DenseVector):
+        return cell.toArray()
+    return np.asarray(cell, dtype=np.float64)
+
+
+def df_to_dataset(df: pd.DataFrame, categorical: bool = False,
+                  nb_classes: Optional[int] = None,
+                  features_col: str = "features",
+                  label_col: str = "label") -> Dataset:
+    """DataFrame into a feature/label pair Dataset (parity:
+    ``df_to_simple_rdd``, ``elephas/ml/adapter.py:28-47``)."""
+    features = np.stack([_cell_to_array(cell) for cell in df[features_col]])
+    raw_labels = df[label_col].to_numpy()
+    if categorical:
+        if not nb_classes:
+            nb_classes = int(np.max(raw_labels)) + 1
+        labels = np.stack([encode_label(label, nb_classes)
+                           for label in raw_labels])
+    else:
+        labels = raw_labels.astype(np.float64)
+    return Dataset((features, labels))

@@ -1,0 +1,344 @@
+"""Parameter mixin config system for the ML-pipeline layer.
+
+A standalone analog of ``pyspark.ml.param.Params`` carrying the same 16-mixin
+surface and defaults as the reference (``elephas/ml/params.py:4-259``):
+model config, mode (default ``asynchronous``), frequency (``epoch``),
+nb_classes (10), categorical (True), epochs (10), batch_size (32),
+verbosity (0), validation_split (0.1), num_workers (8), optimizer config,
+metrics (``['acc']``), loss, custom objects ({}), inference batch size
+(None), and the features/label/output column trio.
+"""
+from typing import Any, Dict
+
+
+class Param:
+    """A named, documented parameter belonging to a Params subclass."""
+
+    def __init__(self, parent: "Params", name: str, doc: str):
+        self.parent = parent
+        self.name = name
+        self.doc = doc
+
+    # identity is the name: cooperative multiple-inheritance re-runs mixin
+    # __init__s, and a re-created Param must keep addressing the same map slot
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return isinstance(other, Param) and other.name == self.name
+
+    def __repr__(self):
+        return f"Param({self.name})"
+
+
+class Params:
+    """Base class: explicit values in ``_paramMap`` shadow defaults in
+    ``_defaultParamMap``."""
+
+    def __init__(self):
+        if not hasattr(self, "_paramMap"):
+            self._paramMap: Dict[Param, Any] = {}
+            self._defaultParamMap: Dict[Param, Any] = {}
+        super().__init__()
+
+    def _param_by_name(self, name: str) -> Param:
+        for param in list(self._paramMap) + list(self._defaultParamMap):
+            if param.name == name:
+                return param
+        for attr in vars(self).values():
+            if isinstance(attr, Param) and attr.name == name:
+                return attr
+        raise KeyError(f"No param named {name!r}")
+
+    def _set(self, **kwargs):
+        for name, value in kwargs.items():
+            self._paramMap[self._param_by_name(name)] = value
+        return self
+
+    def _setDefault(self, **kwargs):
+        for name, value in kwargs.items():
+            self._defaultParamMap[self._param_by_name(name)] = value
+        return self
+
+    def getOrDefault(self, param: Param):
+        if param in self._paramMap:
+            return self._paramMap[param]
+        if param in self._defaultParamMap:
+            return self._defaultParamMap[param]
+        raise KeyError(f"Param {param.name!r} is not set and has no default")
+
+    def explainParams(self) -> str:
+        lines = []
+        for param in sorted({*self._paramMap, *self._defaultParamMap},
+                            key=lambda p: p.name):
+            lines.append(f"{param.name}: {param.doc} "
+                         f"(current: {self.getOrDefault(param)!r})")
+        return "\n".join(lines)
+
+
+class HasModelConfig(Params):
+    """Mandatory: serialized model architecture as a JSON string."""
+
+    def __init__(self):
+        super().__init__()
+        self.model_config = Param(self, "model_config",
+                                  "Serialized model architecture JSON")
+
+    def set_model_config(self, model_config):
+        self._paramMap[self.model_config] = model_config
+        return self
+
+    def get_model_config(self):
+        return self.getOrDefault(self.model_config)
+
+    # migration aliases (reference: HasKerasModelConfig)
+    set_keras_model_config = set_model_config
+    get_keras_model_config = get_model_config
+
+
+class HasMode(Params):
+    def __init__(self):
+        super().__init__()
+        self.mode = Param(self, "mode", "training mode")
+        self._setDefault(mode="asynchronous")
+
+    def set_mode(self, mode):
+        self._paramMap[self.mode] = mode
+        return self
+
+    def get_mode(self):
+        return self.getOrDefault(self.mode)
+
+
+class HasFrequency(Params):
+    def __init__(self):
+        super().__init__()
+        self.frequency = Param(self, "frequency", "update frequency")
+        self._setDefault(frequency="epoch")
+
+    def set_frequency(self, frequency):
+        self._paramMap[self.frequency] = frequency
+        return self
+
+    def get_frequency(self):
+        return self.getOrDefault(self.frequency)
+
+
+class HasNumberOfClasses(Params):
+    def __init__(self):
+        super().__init__()
+        self.nb_classes = Param(self, "nb_classes", "number of classes")
+        self._setDefault(nb_classes=10)
+
+    def set_nb_classes(self, nb_classes):
+        self._paramMap[self.nb_classes] = nb_classes
+        return self
+
+    def get_nb_classes(self):
+        return self.getOrDefault(self.nb_classes)
+
+
+class HasCategoricalLabels(Params):
+    def __init__(self):
+        super().__init__()
+        self.categorical = Param(self, "categorical",
+                                 "whether labels are categorical")
+        self._setDefault(categorical=True)
+
+    def set_categorical_labels(self, categorical):
+        self._paramMap[self.categorical] = categorical
+        return self
+
+    def get_categorical_labels(self):
+        return self.getOrDefault(self.categorical)
+
+
+class HasEpochs(Params):
+    def __init__(self):
+        super().__init__()
+        self.epochs = Param(self, "epochs", "number of epochs")
+        self._setDefault(epochs=10)
+
+    def set_epochs(self, epochs):
+        self._paramMap[self.epochs] = epochs
+        return self
+
+    def get_epochs(self):
+        return self.getOrDefault(self.epochs)
+
+
+class HasBatchSize(Params):
+    def __init__(self):
+        super().__init__()
+        self.batch_size = Param(self, "batch_size", "batch size")
+        self._setDefault(batch_size=32)
+
+    def set_batch_size(self, batch_size):
+        self._paramMap[self.batch_size] = batch_size
+        return self
+
+    def get_batch_size(self):
+        return self.getOrDefault(self.batch_size)
+
+
+class HasVerbosity(Params):
+    def __init__(self):
+        super().__init__()
+        self.verbose = Param(self, "verbose", "verbosity level")
+        self._setDefault(verbose=0)
+
+    def set_verbosity(self, verbose):
+        self._paramMap[self.verbose] = verbose
+        return self
+
+    def get_verbosity(self):
+        return self.getOrDefault(self.verbose)
+
+
+class HasValidationSplit(Params):
+    def __init__(self):
+        super().__init__()
+        self.validation_split = Param(self, "validation_split",
+                                      "validation split fraction")
+        self._setDefault(validation_split=0.1)
+
+    def set_validation_split(self, validation_split):
+        self._paramMap[self.validation_split] = validation_split
+        return self
+
+    def get_validation_split(self):
+        return self.getOrDefault(self.validation_split)
+
+
+class HasNumberOfWorkers(Params):
+    def __init__(self):
+        super().__init__()
+        self.num_workers = Param(self, "num_workers", "number of workers")
+        self._setDefault(num_workers=8)
+
+    def set_num_workers(self, num_workers):
+        self._paramMap[self.num_workers] = num_workers
+        return self
+
+    def get_num_workers(self):
+        return self.getOrDefault(self.num_workers)
+
+
+class HasOptimizerConfig(Params):
+    def __init__(self):
+        super().__init__()
+        self.optimizer_config = Param(self, "optimizer_config",
+                                      "serialized optimizer config")
+        self._setDefault(optimizer_config=None)
+
+    def set_optimizer_config(self, optimizer_config):
+        self._paramMap[self.optimizer_config] = optimizer_config
+        return self
+
+    def get_optimizer_config(self):
+        return self.getOrDefault(self.optimizer_config)
+
+
+class HasMetrics(Params):
+    def __init__(self):
+        super().__init__()
+        self.metrics = Param(self, "metrics", "training metrics")
+        self._setDefault(metrics=["acc"])
+
+    def set_metrics(self, metrics):
+        self._paramMap[self.metrics] = metrics
+        return self
+
+    def get_metrics(self):
+        return self.getOrDefault(self.metrics)
+
+
+class HasLoss(Params):
+    def __init__(self):
+        super().__init__()
+        self.loss = Param(self, "loss", "loss function name")
+
+    def set_loss(self, loss):
+        self._paramMap[self.loss] = loss
+        return self
+
+    def get_loss(self):
+        return self.getOrDefault(self.loss)
+
+
+class HasCustomObjects(Params):
+    def __init__(self):
+        super().__init__()
+        self.custom_objects = Param(self, "custom_objects",
+                                    "custom objects registry")
+        self._setDefault(custom_objects={})
+
+    def set_custom_objects(self, custom_objects):
+        self._paramMap[self.custom_objects] = custom_objects
+        return self
+
+    def get_custom_objects(self):
+        return self.getOrDefault(self.custom_objects)
+
+
+class HasInferenceBatchSize(Params):
+    def __init__(self):
+        super().__init__()
+        self.inference_batch_size = Param(
+            self, "inference_batch_size",
+            "bounded-memory batch size for transform-time inference")
+        self._setDefault(inference_batch_size=None)
+
+    def set_inference_batch_size(self, batch_size):
+        self._paramMap[self.inference_batch_size] = batch_size
+        return self
+
+    def get_inference_batch_size(self):
+        return self.getOrDefault(self.inference_batch_size)
+
+
+class HasFeaturesCol(Params):
+    def __init__(self):
+        super().__init__()
+        self.featuresCol = Param(self, "featuresCol", "features column name")
+        self._setDefault(featuresCol="features")
+
+    def setFeaturesCol(self, value):
+        self._paramMap[self.featuresCol] = value
+        return self
+
+    def getFeaturesCol(self):
+        return self.getOrDefault(self.featuresCol)
+
+
+class HasLabelCol(Params):
+    def __init__(self):
+        super().__init__()
+        self.labelCol = Param(self, "labelCol", "label column name")
+        self._setDefault(labelCol="label")
+
+    def setLabelCol(self, value):
+        self._paramMap[self.labelCol] = value
+        return self
+
+    def getLabelCol(self):
+        return self.getOrDefault(self.labelCol)
+
+
+class HasOutputCol(Params):
+    def __init__(self):
+        super().__init__()
+        self.outputCol = Param(self, "outputCol", "output column name")
+        self._setDefault(outputCol="prediction")
+
+    def setOutputCol(self, value):
+        self._paramMap[self.outputCol] = value
+        return self
+
+    def getOutputCol(self):
+        return self.getOrDefault(self.outputCol)
+
+
+# migration alias for the reference's mixin name
+HasKerasModelConfig = HasModelConfig
+HasKerasOptimizerConfig = HasOptimizerConfig

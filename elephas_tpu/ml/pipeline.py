@@ -1,0 +1,247 @@
+"""ML-pipeline layer: Estimator -> fitted Transformer over DataFrames.
+
+Capability mirror of ``elephas/ml_model.py:25-269``: the Estimator carries
+all compile/train settings as Params, ``fit(df)`` trains a distributed
+:class:`~elephas_tpu.tpu_model.TPUModel` and returns a fitted Transformer
+whose ``transform(df)`` appends a prediction column — a probability list
+for classifiers, a scalar for regressors (decided by the loss->ModelType
+mapping), with optional bounded-memory batched inference.
+"""
+import json
+import warnings
+from typing import Optional
+
+import h5py
+import numpy as np
+import pandas as pd
+
+from ..models import get_optimizer, model_from_json
+from ..tpu_model import TPUModel
+from ..utils.model_utils import (LossModelTypeMapper, ModelType,
+                                 ModelTypeEncoder, as_enum)
+from .adapter import df_to_dataset
+from .params import (HasBatchSize, HasCategoricalLabels, HasCustomObjects,
+                     HasEpochs, HasFeaturesCol, HasFrequency,
+                     HasInferenceBatchSize, HasLabelCol, HasLoss, HasMetrics,
+                     HasMode, HasModelConfig, HasNumberOfClasses,
+                     HasNumberOfWorkers, HasOptimizerConfig, HasOutputCol,
+                     HasValidationSplit, HasVerbosity)
+
+
+class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
+                HasFeaturesCol, HasLabelCol, HasMode, HasEpochs, HasBatchSize,
+                HasFrequency, HasVerbosity, HasNumberOfClasses,
+                HasNumberOfWorkers, HasOutputCol, HasLoss, HasMetrics,
+                HasOptimizerConfig, HasCustomObjects):
+    """Configurable distributed-training estimator.
+
+    ``fit(df)`` -> trained :class:`Transformer`.
+    """
+
+    def __init__(self, **kwargs):
+        # initialize every mixin exactly once
+        HasCategoricalLabels.__init__(self)
+        HasValidationSplit.__init__(self)
+        HasModelConfig.__init__(self)
+        HasFeaturesCol.__init__(self)
+        HasLabelCol.__init__(self)
+        HasMode.__init__(self)
+        HasEpochs.__init__(self)
+        HasBatchSize.__init__(self)
+        HasFrequency.__init__(self)
+        HasVerbosity.__init__(self)
+        HasNumberOfClasses.__init__(self)
+        HasNumberOfWorkers.__init__(self)
+        HasOutputCol.__init__(self)
+        HasLoss.__init__(self)
+        HasMetrics.__init__(self)
+        HasOptimizerConfig.__init__(self)
+        HasCustomObjects.__init__(self)
+        self.set_params(**kwargs)
+
+    def set_params(self, **kwargs):
+        """Set any subset of params by name."""
+        return self._set(**kwargs)
+
+    def get_config(self) -> dict:
+        return {"model_config": self.get_model_config(),
+                "mode": self.get_mode(),
+                "frequency": self.get_frequency(),
+                "num_workers": self.get_num_workers(),
+                "categorical": self.get_categorical_labels(),
+                "loss": self.get_loss(),
+                "metrics": self.get_metrics(),
+                "validation_split": self.get_validation_split(),
+                "featuresCol": self.getFeaturesCol(),
+                "labelCol": self.getLabelCol(),
+                "epochs": self.get_epochs(),
+                "batch_size": self.get_batch_size(),
+                "verbose": self.get_verbosity(),
+                "nb_classes": self.get_nb_classes(),
+                "outputCol": self.getOutputCol()}
+
+    def save(self, file_name: str):
+        with h5py.File(file_name, mode="w") as f:
+            f.attrs["distributed_config"] = json.dumps({
+                "class_name": self.__class__.__name__,
+                "config": self.get_config(),
+            }).encode("utf8")
+
+    def get_model(self):
+        return model_from_json(self.get_model_config(),
+                               self.get_custom_objects())
+
+    def fit(self, df: pd.DataFrame) -> "Transformer":
+        """Train on a features/label DataFrame; return a fitted Transformer."""
+        dataset = df_to_dataset(df, categorical=self.get_categorical_labels(),
+                                nb_classes=self.get_nb_classes(),
+                                features_col=self.getFeaturesCol(),
+                                label_col=self.getLabelCol())
+        dataset = dataset.repartition(self.get_num_workers())
+        model = model_from_json(self.get_model_config(),
+                                self.get_custom_objects())
+        loss = self.get_loss()
+        optimizer_config = self.get_optimizer_config()
+        optimizer = (get_optimizer(optimizer_config) if optimizer_config
+                     else "sgd")
+        model.compile(loss=loss, optimizer=optimizer,
+                      metrics=self.get_metrics(),
+                      custom_objects=self.get_custom_objects())
+
+        tpu_model = TPUModel(model=model, mode=self.get_mode(),
+                             frequency=self.get_frequency(),
+                             num_workers=self.get_num_workers(),
+                             custom_objects=self.get_custom_objects())
+        tpu_model.fit(dataset, epochs=self.get_epochs(),
+                      batch_size=self.get_batch_size(),
+                      verbose=self.get_verbosity(),
+                      validation_split=self.get_validation_split())
+
+        return Transformer(
+            labelCol=self.getLabelCol(),
+            outputCol=self.getOutputCol(),
+            featuresCol=self.getFeaturesCol(),
+            model_config=tpu_model.master_network.to_json(),
+            weights=tpu_model.master_network.get_weights(),
+            custom_objects=self.get_custom_objects(),
+            model_type=LossModelTypeMapper().get_model_type(loss),
+            history=tpu_model.training_histories)
+
+    # deprecated setter trio kept for migration parity
+    # (``elephas/ml_model.py:114-127``)
+    def setFeaturesCol(self, value):
+        warnings.warn("setFeaturesCol is deprecated - supply featuresCol in "
+                      "the constructor, i.e. Estimator(featuresCol='foo')",
+                      DeprecationWarning)
+        return self._set(featuresCol=value)
+
+    def setLabelCol(self, value):
+        warnings.warn("setLabelCol is deprecated - supply labelCol in the "
+                      "constructor, i.e. Estimator(labelCol='foo')",
+                      DeprecationWarning)
+        return self._set(labelCol=value)
+
+    def setOutputCol(self, value):
+        warnings.warn("setOutputCol is deprecated - supply outputCol in the "
+                      "constructor, i.e. Estimator(outputCol='foo')",
+                      DeprecationWarning)
+        return self._set(outputCol=value)
+
+
+def load_ml_estimator(file_name: str) -> Estimator:
+    with h5py.File(file_name, mode="r") as f:
+        conf = f.attrs.get("distributed_config")
+        if isinstance(conf, bytes):
+            conf = conf.decode("utf8")
+        elephas_conf = json.loads(conf)
+    return Estimator(**elephas_conf.get("config"))
+
+
+class Transformer(HasModelConfig, HasLabelCol, HasOutputCol, HasFeaturesCol,
+                  HasCustomObjects, HasInferenceBatchSize):
+    """Fitted model: ``transform(df)`` appends the prediction column."""
+
+    def __init__(self, **kwargs):
+        HasModelConfig.__init__(self)
+        HasLabelCol.__init__(self)
+        HasOutputCol.__init__(self)
+        HasFeaturesCol.__init__(self)
+        HasCustomObjects.__init__(self)
+        HasInferenceBatchSize.__init__(self)
+        self.weights = kwargs.pop("weights", None)
+        self.model_type = kwargs.pop("model_type", None)
+        self._history = kwargs.pop("history", [])
+        self.set_params(**kwargs)
+
+    @property
+    def history(self):
+        return self._history
+
+    def set_params(self, **kwargs):
+        return self._set(**kwargs)
+
+    def get_config(self) -> dict:
+        return {"model_config": self.get_model_config(),
+                "labelCol": self.getLabelCol(),
+                "featuresCol": self.getFeaturesCol(),
+                "outputCol": self.getOutputCol(),
+                "weights": [np.asarray(w).tolist()
+                            for w in (self.weights or [])],
+                "model_type": self.model_type}
+
+    def save(self, file_name: str):
+        with h5py.File(file_name, mode="w") as f:
+            f.attrs["distributed_config"] = json.dumps({
+                "class_name": self.__class__.__name__,
+                "config": self.get_config(),
+            }, cls=ModelTypeEncoder).encode("utf8")
+
+    def get_model(self):
+        model = model_from_json(self.get_model_config(),
+                                self.get_custom_objects())
+        if self.weights is not None:
+            model.set_weights(self.weights)
+        return model
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        """Append the prediction column; classification yields probability
+        lists, regression yields scalars (``elephas/ml_model.py:191-256``)."""
+        from .adapter import _cell_to_array
+        from ..parallel.sync_trainer import build_sharded_predict
+
+        output_col = self.getOutputCol()
+        features_col = self.getFeaturesCol()
+        model = self.get_model()
+        predict_fn = build_sharded_predict(model)
+
+        features = np.stack([_cell_to_array(cell)
+                             for cell in df[features_col]])
+        inference_batch_size = self.get_inference_batch_size()
+        if inference_batch_size is not None and inference_batch_size > 0:
+            # bounded-memory batched inference
+            preds = [predict_fn(features[i:i + inference_batch_size],
+                                batch_size=inference_batch_size)
+                     for i in range(0, len(features), inference_batch_size)]
+            predictions = np.vstack(preds) if preds else np.zeros((0,))
+        else:
+            predictions = predict_fn(features)
+
+        results_df = df.copy()
+        if self.model_type == ModelType.REGRESSION:
+            results_df[output_col] = [float(np.asarray(p).reshape(-1)[0])
+                                      for p in predictions]
+        else:
+            results_df[output_col] = [np.asarray(p).astype(float).tolist()
+                                      for p in predictions]
+        return results_df
+
+
+def load_ml_transformer(file_name: str) -> Transformer:
+    with h5py.File(file_name, mode="r") as f:
+        conf = f.attrs.get("distributed_config")
+        if isinstance(conf, bytes):
+            conf = conf.decode("utf8")
+        elephas_conf = json.loads(conf, object_hook=as_enum)
+    config = elephas_conf.get("config")
+    config["weights"] = [np.array(w) for w in config["weights"]]
+    return Transformer(**config)

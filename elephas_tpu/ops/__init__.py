@@ -1,0 +1,2 @@
+from .attention import attention, blockwise_attention
+from .ring_attention import ring_attention, ring_attention_sharded

@@ -1,0 +1,80 @@
+"""Attention ops: reference softmax attention + blockwise variants.
+
+The reference framework has no attention (its largest model is an MLP;
+long-context is absent per SURVEY.md §5), but the TPU framework treats
+long-context as first-class: :mod:`.ring_attention` scales sequence length
+across the mesh, and this module holds the single-device building blocks.
+
+All shapes are ``(batch, heads, seq, head_dim)``.
+"""
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              causal: bool = False,
+              mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Plain softmax attention (reference implementation / XLA-fused path)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[2])[:, None]
+        k_pos = jnp.arange(k.shape[2])[None, :]
+        scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+@partial(jax.jit, static_argnames=("block_size", "causal"))
+def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        block_size: int = 512,
+                        causal: bool = False) -> jnp.ndarray:
+    """Memory-bounded attention via online softmax over key/value blocks.
+
+    The flash-attention recurrence: never materializes the full
+    ``(seq, seq)`` score matrix, so HBM footprint is O(seq * block).
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    nb = -(-sk // block_size)
+    pad = nb * block_size - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    k_blocks = k.reshape(b, h, nb, block_size, d)
+    v_blocks = v.reshape(b, h, nb, block_size, d)
+    q_pos = jnp.arange(sq)[:, None]
+
+    def body(carry, inputs):
+        o, l, m = carry
+        k_blk, v_blk, blk_idx = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        k_pos = blk_idx * block_size + jnp.arange(block_size)[None, :]
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        return (o_new, l_new, m_new), None
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((b, h, sq), dtype=q.dtype)
+    m0 = jnp.full((b, h, sq), NEG_INF, dtype=q.dtype)
+    ks = jnp.moveaxis(k_blocks, 2, 0)
+    vs = jnp.moveaxis(v_blocks, 2, 0)
+    (o, l, _), _ = jax.lax.scan(body, (o0, l0, m0),
+                                (ks, vs, jnp.arange(nb)))
+    return o / jnp.maximum(l, 1e-20)[..., None]

@@ -1,0 +1,83 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long sequences are sharded across devices along a ``seq`` mesh axis; each
+device holds a query shard and streams key/value shards around the ring
+with ``lax.ppermute`` (compiled to ICI neighbor exchanges on TPU), folding
+each incoming block into a flash-attention online-softmax accumulator. HBM
+and VMEM footprint per device is O(seq/P), enabling context lengths that
+cannot fit on one chip — the "long-context first-class" requirement the
+TPU framework adds over the reference (SURVEY.md §5 lists it absent there).
+
+Communication overlaps with compute: at ring step i every device computes
+scores against the shard it currently holds while the next shard is in
+flight — the classic ring-attention schedule.
+
+Use inside ``shard_map`` with ``q, k, v`` already sharded on the sequence
+axis; see :func:`ring_attention_sharded` for the wrapped entry point.
+"""
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+NEG_INF = -1e30
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Attention over a ring; call inside ``shard_map``.
+
+    :param q, k, v: local shards, shape ``(batch, heads, seq_local, head_dim)``
+    :param axis_name: mesh axis carrying the sequence shards
+    :param causal: apply a causal mask over *global* positions
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q_pos = my_idx * sq + jnp.arange(sq)[:, None]
+
+    def step(i, carry):
+        o, l, m, k_cur, v_cur = carry
+        kv_idx = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = kv_idx * k_cur.shape[2] + jnp.arange(k_cur.shape[2])[None, :]
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        # rotate k/v shards one hop around the ring (ICI neighbor exchange)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, l_new, m_new, k_next, v_next
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((b, h, sq), dtype=q.dtype)
+    m0 = jnp.full((b, h, sq), NEG_INF, dtype=q.dtype)
+    o, l, m, _, _ = lax.fori_loop(0, axis_size, step, (o0, l0, m0, k, v))
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           mesh: Mesh, seq_axis: str = "seq",
+                           causal: bool = False,
+                           batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """shard_map wrapper: global ``(batch, heads, seq, head_dim)`` arrays in,
+    sequence sharded over ``seq_axis`` (and optionally batch over
+    ``batch_axis``), global attention out."""
+    batch_spec = batch_axis if batch_axis else None
+    spec = PartitionSpec(batch_spec, None, seq_axis, None)
+
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

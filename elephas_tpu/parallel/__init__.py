@@ -1,0 +1,4 @@
+from .mesh import data_mesh, make_mesh, replicate, shard_leading, worker_mesh
+from .sync_trainer import (SyncAverageTrainer, SyncStepTrainer,
+                           build_sharded_evaluate, build_sharded_predict,
+                           stack_shards)

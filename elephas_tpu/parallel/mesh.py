@@ -1,0 +1,62 @@
+"""Device-mesh helpers.
+
+The reference's "cluster" is a set of Spark executors; ours is a
+``jax.sharding.Mesh`` over TPU chips. Intra-mesh communication rides ICI via
+XLA collectives inserted by the partitioner — there is no hand-written
+transport on the compute path (the NCCL analog the survey calls for,
+SURVEY.md §2.3).
+"""
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def worker_mesh(num_workers: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the ``workers`` axis.
+
+    Picks the largest device count that evenly divides ``num_workers`` so a
+    stacked per-worker computation shards cleanly; falls back to a single
+    device when nothing divides (e.g. 3 workers on 8 chips -> 1 device,
+    still correct, just unsharded).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d = 1
+    for candidate in range(min(num_workers, len(devices)), 0, -1):
+        if num_workers % candidate == 0:
+            d = candidate
+            break
+    return Mesh(np.array(devices[:d]), ("workers",))
+
+
+def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the ``data`` axis using all visible devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), ("data",))
+
+
+def make_mesh(axis_sizes: Tuple[Tuple[str, int], ...],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """N-D mesh from ``(axis_name, size)`` pairs (sizes must multiply to the
+    device count used)."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = [name for name, _ in axis_sizes]
+    sizes = [size for _, size in axis_sizes]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh of size {total} exceeds {len(devices)} devices")
+    grid = np.array(devices[:total]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def shard_leading(mesh: Mesh, axis: str, array):
+    """Place an array with its leading dim sharded over ``axis``."""
+    spec = PartitionSpec(axis, *([None] * (np.ndim(array) - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree across the mesh."""
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)

@@ -1,0 +1,422 @@
+"""Synchronous data-parallel training over a device mesh.
+
+Two flavors, matching SURVEY.md §2.2:
+
+- :class:`SyncAverageTrainer` — the reference's ``synchronous`` semantics
+  (each worker trains a full local model copy for all epochs on its
+  partition, the driver averages the weight *deltas*,
+  ``elephas/spark_model.py:217-228`` + ``elephas/worker.py:11-49``) —
+  re-expressed the TPU way: all worker replicas are stacked on a leading
+  ``workers`` axis sharded over the mesh, local training is a
+  ``lax.scan`` over epochs×batches vmapped across workers, and the final
+  delta average is a mean over the sharded axis (an XLA all-reduce over
+  ICI). One jit-compiled program replaces one Spark job; there is no
+  driver-side numpy merge loop.
+
+- :class:`SyncStepTrainer` — true per-step synchronous SGD: the global
+  batch is sharded over the ``data`` axis, parameters are replicated, and
+  XLA inserts the gradient all-reduce (psum) automatically. Strictly
+  stronger convergence than epoch-level model averaging and the benchmark
+  configuration (SURVEY.md §7 step 4).
+
+Shard-size edge cases (uneven partitions, empty partitions, the
+reference's "skip training when partition <= batch_size" rule,
+``elephas/worker.py:41``) are handled with static padding + per-sample
+masks so XLA sees fixed shapes.
+"""
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..models import losses as losses_mod
+from ..models import metrics as metrics_mod
+from ..models.core import BaseModel
+from .mesh import worker_mesh
+
+
+def _pad_to(arr: np.ndarray, size: int) -> np.ndarray:
+    if arr.shape[0] == size:
+        return arr
+    pad = np.zeros((size - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def stack_shards(shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 pad_multiple: int = 1):
+    """Stack uneven (x, y) shards into masked fixed-shape arrays.
+
+    Returns ``(X, Y, SW, sizes)`` with leading worker axis; ``SW`` is 1.0
+    for real samples, 0.0 for padding.
+    """
+    sizes = np.array([x.shape[0] for x, _ in shards], dtype=np.int64)
+    target = int(max(1, sizes.max()))
+    if pad_multiple > 1:
+        target = int(-(-target // pad_multiple) * pad_multiple)
+    xs, ys, ws = [], [], []
+    for x, y in shards:
+        n = x.shape[0]
+        xs.append(_pad_to(np.asarray(x), target))
+        ys.append(_pad_to(np.asarray(y), target))
+        w = np.zeros(target, dtype=np.float32)
+        w[:n] = 1.0
+        ws.append(w)
+    return np.stack(xs), np.stack(ys), np.stack(ws), sizes
+
+
+class SyncAverageTrainer:
+    """Vectorized 'local training + delta averaging' on a worker mesh."""
+
+    def __init__(self, model: BaseModel, optimizer, loss, metrics=None,
+                 custom_objects: Optional[Dict] = None):
+        self.model = model
+        self.tx = optimizer.to_optax()
+        self.loss_fn = losses_mod.get(loss, custom_objects)
+        self.metric_fns = list(metrics or [])
+
+    def run(self, weights: List[np.ndarray],
+            shards: Sequence[Tuple[np.ndarray, np.ndarray]],
+            epochs: int, batch_size: int, validation_split: float = 0.0,
+            shuffle: bool = True, seed: int = 0):
+        """Train all workers in one jitted program.
+
+        Returns ``(new_weights, histories)`` where histories is a list (one
+        per worker) of Keras-style dicts.
+        """
+        model = self.model
+        model.set_weights(weights)
+        params0 = model.params
+        num_workers = len(shards)
+
+        # normalize dtypes/label ranks exactly as single-process fit does
+        # (e.g. rank-1 regression labels -> (n, 1) to match the output rank)
+        shards = [(model._prepare_x(x), model._prepare_y(y))
+                  for x, y in shards]
+        X, Y, SW, sizes = stack_shards(shards, pad_multiple=batch_size)
+        # training mask: reference semantics — validation split carves off
+        # the LAST fraction of each partition; training skipped entirely
+        # when the partition is not larger than one batch.
+        train_counts = (sizes * (1.0 - validation_split)).astype(np.int64)
+        ar = np.arange(X.shape[1])[None, :]
+        SW_train = (SW * (ar < train_counts[:, None])).astype(np.float32)
+        active = (sizes > batch_size).astype(np.float32)
+
+        n_pad = X.shape[1]
+        nb = max(1, n_pad // batch_size)
+        mesh = worker_mesh(num_workers)
+        tx, loss_fn, metric_fns = self.tx, self.loss_fn, self.metric_fns
+        epochs = int(epochs)
+
+        def local_train(params0, x, y, sw, active_w, key):
+            trainable0, state0 = model._split_params(params0)
+            opt_state0 = tx.init(trainable0)
+
+            def epoch_body(carry, key_e):
+                trainable, state, opt_state = carry
+                perm = (jax.random.permutation(key_e, n_pad) if shuffle
+                        else jnp.arange(n_pad))
+                xs = x[perm].reshape((nb, batch_size) + x.shape[1:])
+                ys = y[perm].reshape((nb, batch_size) + y.shape[1:])
+                sws = sw[perm].reshape((nb, batch_size))
+
+                def batch_body(carry2, batch):
+                    trainable, state, opt_state, i = carry2
+                    xb, yb, swb = batch
+                    key_b = jax.random.fold_in(key_e, i)
+
+                    def objective(tr):
+                        params = model._merge_params(tr, state)
+                        preds, updates = model._apply_internal(
+                            params, xb, True, key_b, collect_updates=True)
+                        per = loss_fn(yb, preds)
+                        count = jnp.sum(swb)
+                        mean_loss = jnp.sum(per * swb) / jnp.maximum(count, 1.0)
+                        return mean_loss, (preds, updates, count)
+
+                    (lval, (preds, updates, count)), grads = jax.value_and_grad(
+                        objective, has_aux=True)(trainable)
+                    opt_up, opt_state = tx.update(grads, opt_state, trainable)
+                    trainable = optax.apply_updates(trainable, opt_up)
+                    new_state = {ln: {**state.get(ln, {}), **lu}
+                                 for ln, lu in updates.items()}
+                    for ln in state:
+                        new_state.setdefault(ln, state[ln])
+                    stats = [lval * count, count]
+                    for fn in metric_fns:
+                        per_m = fn(yb, preds)
+                        stats.append(jnp.sum(per_m * swb))
+                    return (trainable, new_state, opt_state, i + 1), jnp.stack(stats)
+
+                (trainable, state, opt_state, _), stats = jax.lax.scan(
+                    batch_body, (trainable, state, opt_state, 0), (xs, ys, sws))
+                totals = jnp.sum(stats, axis=0)
+                count = jnp.maximum(totals[1], 1.0)
+                epoch_stats = jnp.concatenate(
+                    [totals[0:1] / count, totals[2:] / count])
+                return (trainable, state, opt_state), epoch_stats
+
+            keys = jax.random.split(key, epochs)
+            (trainable, state, _), history = jax.lax.scan(
+                epoch_body, (trainable0, state0, opt_state0), keys)
+            params_final = model._merge_params(trainable, state)
+            delta = jax.tree_util.tree_map(
+                lambda a, b: (a - b) * active_w, params0, params_final)
+            return delta, history
+
+        def all_workers(params0, X, Y, SW, active, keys):
+            deltas, histories = jax.vmap(
+                local_train, in_axes=(None, 0, 0, 0, 0, 0))(
+                    params0, X, Y, SW, active, keys)
+            # delta average over the sharded worker axis -> all-reduce
+            mean_delta = jax.tree_util.tree_map(
+                lambda d: jnp.mean(d, axis=0), deltas)
+            new_params = jax.tree_util.tree_map(
+                lambda p, d: p - d, params0, mean_delta)
+            return new_params, histories
+
+        from .mesh import replicate, shard_leading
+
+        with mesh:
+            X_d = shard_leading(mesh, "workers", X)
+            Y_d = shard_leading(mesh, "workers", Y)
+            SW_d = shard_leading(mesh, "workers", SW_train)
+            active_d = shard_leading(mesh, "workers", jnp.asarray(active))
+            keys = jax.random.split(jax.random.PRNGKey(seed), num_workers)
+            keys_d = shard_leading(mesh, "workers", keys)
+            params_d = replicate(mesh, params0)
+            new_params, histories = jax.jit(all_workers)(
+                params_d, X_d, Y_d, SW_d, active_d, keys_d)
+
+        model.params = jax.device_get(new_params)
+        new_weights = model.get_weights()
+
+        histories = np.asarray(jax.device_get(histories))  # (W, epochs, 1+M)
+        metric_names = ["loss"] + [metrics_mod.serialize(fn) if not isinstance(fn, str)
+                                   else fn for fn in self.metric_fns]
+        history_dicts = []
+        for w in range(num_workers):
+            if active[w] == 0.0:
+                history_dicts.append(None)  # parity: untrained partitions yield no history
+                continue
+            hist = {}
+            for j, name in enumerate(metric_names):
+                hist[name] = [float(v) for v in histories[w, :, j]]
+            history_dicts.append(hist)
+        return new_weights, history_dicts
+
+
+class SyncStepTrainer:
+    """True per-step synchronous data-parallel SGD, one jit dispatch per epoch.
+
+    Global batches are sharded over the ``data`` axis, parameters are
+    replicated; XLA inserts the cross-device gradient all-reduce. The whole
+    epoch — on-device shuffle + ``lax.scan`` over batches — is a single
+    compiled program, so host<->device round-trips (the throughput killer on
+    remote-attached TPUs) happen once per epoch, not once per step. This is
+    the benchmark configuration (SURVEY.md §7's design stance).
+    """
+
+    def __init__(self, model: BaseModel, optimizer, loss, metrics=None,
+                 custom_objects: Optional[Dict] = None, mesh=None,
+                 donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.tx = optimizer.to_optax()
+        self.loss_fn = losses_mod.get(loss, custom_objects)
+        self.metric_fns = list(metrics or [])
+        from .mesh import data_mesh
+
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self._epoch_fn = None
+        self._donate = donate
+
+    def _build_epoch_fn(self, nb: int, batch_size: int, shuffle: bool):
+        model, tx, loss_fn = self.model, self.tx, self.loss_fn
+        metric_fns = self.metric_fns
+        n_pad = nb * batch_size
+
+        def step(carry, batch):
+            trainable, state, opt_state, key = carry
+            xb, yb, swb = batch
+            key, sub = jax.random.split(key)
+
+            def objective(tr):
+                params = model._merge_params(tr, state)
+                preds, updates = model._apply_internal(params, xb, True, sub,
+                                                       collect_updates=True)
+                per = loss_fn(yb, preds)
+                count = jnp.maximum(jnp.sum(swb), 1.0)
+                return jnp.sum(per * swb) / count, (preds, updates, count)
+
+            (lval, (preds, updates, count)), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            opt_up, opt_state = tx.update(grads, opt_state, trainable)
+            trainable = optax.apply_updates(trainable, opt_up)
+            new_state = {ln: {**state.get(ln, {}), **lu}
+                         for ln, lu in updates.items()}
+            for ln in state:
+                new_state.setdefault(ln, state[ln])
+            stats = [lval * count, count]
+            stats += [jnp.sum(fn(yb, preds) * swb) for fn in metric_fns]
+            return (trainable, new_state, opt_state, key), jnp.stack(stats)
+
+        def epoch(trainable, state, opt_state, key, x, y, sw):
+            if shuffle:
+                perm_key, key = jax.random.split(key)
+                perm = jax.random.permutation(perm_key, n_pad)
+                x, y, sw = x[perm], y[perm], sw[perm]
+            xs = x.reshape((nb, batch_size) + x.shape[1:])
+            ys = y.reshape((nb, batch_size) + y.shape[1:])
+            sws = sw.reshape((nb, batch_size))
+            (trainable, state, opt_state, _), stats = jax.lax.scan(
+                step, (trainable, state, opt_state, key), (xs, ys, sws))
+            totals = jnp.sum(stats, axis=0)
+            count = jnp.maximum(totals[1], 1.0)
+            epoch_stats = jnp.concatenate([totals[0:1] / count,
+                                           totals[2:] / count])
+            return trainable, state, opt_state, epoch_stats
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(epoch, donate_argnums=donate)
+
+    def fit(self, weights: List[np.ndarray], x: np.ndarray, y: np.ndarray,
+            epochs: int, batch_size: int, validation_split: float = 0.0,
+            shuffle: bool = True, seed: int = 0, verbose: int = 0):
+        """Train; returns (new_weights, history dict)."""
+        from .mesh import replicate, shard_leading
+
+        model = self.model
+        model.set_weights(weights)
+        x = model._prepare_x(x)
+        y = model._prepare_y(y)
+        if validation_split and 0.0 < validation_split < 1.0:
+            split_at = int(x.shape[0] * (1.0 - validation_split))
+            x, y = x[:split_at], y[:split_at]
+
+        mesh = self.mesh
+        ndev = int(np.prod(mesh.devices.shape))
+        # round the global batch up to a device multiple; mask the padding
+        global_batch = int(-(-batch_size // ndev) * ndev)
+        n = x.shape[0]
+        nb = max(1, -(-n // global_batch))
+        n_pad = nb * global_batch
+
+        sw = np.zeros(n_pad, dtype=np.float32)
+        sw[:n] = 1.0
+        # transfer the (padded) epoch data and parameters once
+        x_d = shard_leading(mesh, "data", _pad_to(x, n_pad))
+        y_d = shard_leading(mesh, "data", _pad_to(y, n_pad))
+        sw_d = shard_leading(mesh, "data", sw)
+
+        trainable, state = model._split_params(model.params)
+        trainable = replicate(mesh, trainable)
+        state = replicate(mesh, state)
+        opt_state = jax.jit(self.tx.init)(trainable)
+
+        epoch_fn = self._build_epoch_fn(nb, global_batch, shuffle)
+        base_key = jax.random.PRNGKey(seed)
+        metric_names = ["loss"] + [metrics_mod.serialize(fn)
+                                   for fn in self.metric_fns]
+        epoch_stats = []
+        for epoch_idx in range(int(epochs)):
+            key = jax.random.fold_in(base_key, epoch_idx)
+            trainable, state, opt_state, stats = epoch_fn(
+                trainable, state, opt_state, key, x_d, y_d, sw_d)
+            epoch_stats.append(stats)  # stays on device; fetched at the end
+            if verbose:
+                vals = np.asarray(stats)
+                print(f"Epoch {epoch_idx + 1}/{epochs} - " + " - ".join(
+                    f"{name}: {val:.4f}"
+                    for name, val in zip(metric_names, vals)))
+
+        history: Dict[str, List[float]] = {}
+        for stats in np.asarray(jax.device_get(epoch_stats)):
+            for name, val in zip(metric_names, stats):
+                history.setdefault(name, []).append(float(val))
+
+        model.params = self.model._merge_params(
+            jax.device_get(trainable), jax.device_get(state))
+        return model.get_weights(), history
+
+
+def build_sharded_predict(model: BaseModel, mesh=None):
+    """Order-preserving sharded inference.
+
+    The reference preserves order by tagging rows with indices, shuffling
+    them through executors and re-sorting (``elephas/spark_model.py:257-266``).
+    Contiguous sharding makes that dance unnecessary: rows are padded to a
+    device multiple, sharded, predicted, and sliced back — order never
+    changes.
+    """
+    from .mesh import data_mesh, replicate, shard_leading
+
+    mesh = mesh if mesh is not None else data_mesh()
+    ndev = int(np.prod(mesh.devices.shape))
+
+    jit_apply = jax.jit(lambda params, xb: model.apply(params, xb, training=False))
+
+    def predict(x: np.ndarray, batch_size: int = 1024) -> np.ndarray:
+        x = model._prepare_x(x)
+        n = x.shape[0]
+        if n == 0:
+            return np.zeros((0,) + tuple(model.output_shape), dtype=np.float32)
+        chunk = int(-(-min(batch_size, n) // ndev) * ndev)
+        params = replicate(mesh, model.params)
+        outs = []
+        for start in range(0, n, chunk):
+            xb = _pad_to(x[start:start + chunk], chunk)
+            real = min(chunk, n - start)
+            xb = shard_leading(mesh, "data", xb)
+            out = np.asarray(jax.device_get(jit_apply(params, xb)))
+            outs.append(out[:real])
+        return np.concatenate(outs, axis=0)
+
+    return predict
+
+
+def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
+                           custom_objects=None, mesh=None):
+    """Sharded masked evaluation; exactly equals single-process evaluation
+    because every metric is a per-sample mean (sample-count weighting,
+    parity with ``elephas/spark_model.py:300-308``)."""
+    from .mesh import data_mesh, replicate, shard_leading
+
+    mesh = mesh if mesh is not None else data_mesh()
+    ndev = int(np.prod(mesh.devices.shape))
+    loss_fn = losses_mod.get(loss, custom_objects)
+    metric_fns = list(metrics or [])
+
+    def batch_stats(params, xb, yb, swb):
+        preds = model.apply(params, xb, training=False)
+        vals = [jnp.sum(loss_fn(yb, preds) * swb)]
+        vals += [jnp.sum(fn(yb, preds) * swb) for fn in metric_fns]
+        vals.append(jnp.sum(swb))
+        return jnp.stack(vals)
+
+    jit_stats = jax.jit(batch_stats)
+
+    def evaluate(x: np.ndarray, y: np.ndarray, batch_size: int = 1024):
+        x = model._prepare_x(x)
+        y = model._prepare_y(y)
+        n = x.shape[0]
+        chunk = int(-(-min(batch_size, max(n, 1)) // ndev) * ndev)
+        params = replicate(mesh, model.params)
+        totals = None
+        for start in range(0, n, chunk):
+            real = min(chunk, n - start)
+            swb = np.zeros(chunk, dtype=np.float32)
+            swb[:real] = 1.0
+            vals = np.asarray(jax.device_get(jit_stats(
+                params,
+                shard_leading(mesh, "data", _pad_to(x[start:start + chunk], chunk)),
+                shard_leading(mesh, "data", _pad_to(y[start:start + chunk], chunk)),
+                shard_leading(mesh, "data", swb))))
+            totals = vals if totals is None else totals + vals
+        count = max(totals[-1], 1.0)
+        results = [float(v / count) for v in totals[:-1]]
+        return results if len(results) > 1 else results[0]
+
+    return evaluate

@@ -1,0 +1,3 @@
+from .client import BaseParameterClient, HttpClient, SocketClient
+from .factory import ClientServerFactory, HttpFactory, SocketFactory
+from .server import BaseParameterServer, HttpServer, SocketServer

@@ -1,0 +1,98 @@
+"""Parameter-server clients: pull weights, push deltas.
+
+(Parity surface: ``elephas/parameter/client.py:13-91``; payloads are typed
+ETPU tensor frames instead of pickle.)
+"""
+import abc
+import socket
+import urllib.request
+from typing import List
+
+import numpy as np
+
+from ..utils.sockets import determine_master, receive, send
+from ..utils.tensor_codec import (KIND_DELTA, decode_weights, encode_tensors,
+                                  encode_weights)
+
+
+class BaseParameterClient(abc.ABC):
+    """Clients can retrieve current parameters and send delta updates."""
+
+    client_type = "base"
+
+    @classmethod
+    def get_client(cls, client_type: str, port: int = 4000) -> "BaseParameterClient":
+        try:
+            return next(c for c in cls.__subclasses__()
+                        if c.client_type == client_type)(port)
+        except StopIteration:
+            raise ValueError("Parameter server mode has to be either `http` or "
+                             "`socket`, got {}".format(client_type))
+
+    @abc.abstractmethod
+    def update_parameters(self, delta: List[np.ndarray]):
+        """Send a weight-delta update to the server."""
+
+    @abc.abstractmethod
+    def get_parameters(self) -> List[np.ndarray]:
+        """Retrieve the current master weights."""
+
+
+#: default network timeout (seconds) — a dead parameter server must surface
+#: as an error in the training loop, not a hang
+DEFAULT_TIMEOUT = 120.0
+
+
+class HttpClient(BaseParameterClient):
+    """Talks to :class:`~elephas_tpu.parameter.server.HttpServer`."""
+
+    client_type = "http"
+
+    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT):
+        self.master_url = determine_master(port=port)
+        self.headers = {"Content-Type": "application/elephas-tpu"}
+        self.timeout = timeout
+
+    def get_parameters(self) -> List[np.ndarray]:
+        request = urllib.request.Request(
+            f"http://{self.master_url}/parameters", headers=self.headers)
+        with urllib.request.urlopen(request, timeout=self.timeout) as response:
+            return decode_weights(response.read())
+
+    def update_parameters(self, delta: List[np.ndarray]):
+        request = urllib.request.Request(
+            f"http://{self.master_url}/update",
+            encode_tensors(delta, KIND_DELTA), headers=self.headers)
+        with urllib.request.urlopen(request, timeout=self.timeout) as response:
+            return response.read()
+
+
+class SocketClient(BaseParameterClient):
+    """Talks to :class:`~elephas_tpu.parameter.server.SocketServer`."""
+
+    client_type = "socket"
+
+    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT):
+        self.port = port
+        self.timeout = timeout
+
+    def _connect(self) -> socket.socket:
+        host = determine_master(port=self.port).split(":")[0]
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect((host, self.port))
+        return sock
+
+    def get_parameters(self) -> List[np.ndarray]:
+        with self._connect() as sock:
+            sock.sendall(b"g")
+            return receive(sock)
+
+    def update_parameters(self, delta: List[np.ndarray]):
+        with self._connect() as sock:
+            sock.sendall(b"u")
+            send(sock, delta, kind=KIND_DELTA)
+            ack = sock.recv(1)  # block until the server has applied the delta
+            if ack != b"k":
+                raise ConnectionError("parameter server did not acknowledge "
+                                      "the update")

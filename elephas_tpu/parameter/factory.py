@@ -1,0 +1,47 @@
+"""Matched client/server construction keyed by transport type.
+
+(Parity surface: ``elephas/parameter/factory.py:6-42``.)
+"""
+from abc import ABC, abstractmethod
+
+from .client import HttpClient, SocketClient
+from .server import HttpServer, SocketServer
+
+
+class ClientServerFactory(ABC):
+    _type = "base"
+
+    @classmethod
+    def get_factory(cls, _type: str) -> "ClientServerFactory":
+        try:
+            return next(c for c in cls.__subclasses__() if c._type == _type)()
+        except StopIteration:
+            raise ValueError("Unknown factory type {}".format(_type))
+
+    @abstractmethod
+    def create_client(self, *args, **kwargs):
+        pass
+
+    @abstractmethod
+    def create_server(self, *args, **kwargs):
+        pass
+
+
+class HttpFactory(ClientServerFactory):
+    _type = "http"
+
+    def create_client(self, *args, **kwargs):
+        return HttpClient(*args, **kwargs)
+
+    def create_server(self, *args, **kwargs):
+        return HttpServer(*args, **kwargs)
+
+
+class SocketFactory(ClientServerFactory):
+    _type = "socket"
+
+    def create_client(self, *args, **kwargs):
+        return SocketClient(*args, **kwargs)
+
+    def create_server(self, *args, **kwargs):
+        return SocketServer(*args, **kwargs)

@@ -1,0 +1,230 @@
+"""Parameter servers: HTTP and raw-TCP weight services.
+
+Async/hogwild training exchanges weight deltas through a parameter server
+process on the coordinator host (the reference's Flask/raw-socket pair,
+``elephas/parameter/server.py:42-233``). Differences here, by design:
+
+- Payloads are typed ETPU tensor frames (:mod:`..utils.tensor_codec`),
+  never pickle — nothing executable crosses the wire.
+- The HTTP server is a stdlib ``ThreadingHTTPServer`` in a daemon thread
+  (no Flask dependency, no fork: forking a process with a live JAX runtime
+  is unsafe, and the weight state is plain numpy anyway).
+- Locking policy is the reference's exactly: a writer-priority RWLock
+  serializes pulls/pushes in ``asynchronous`` mode and is bypassed in
+  ``hogwild`` mode (lock-free HOGWILD!-style updates).
+
+Both servers hold the authoritative weights as a flat numpy list — the
+wire currency — so no JAX device state lives on the serving threads.
+"""
+import abc
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.functional_utils import subtract_params
+from ..utils.rwlock import RWLock
+from ..utils.sockets import determine_master, receive, send
+from ..utils.tensor_codec import decode_weights, encode_weights
+
+
+class BaseParameterServer(abc.ABC):
+    """Holds master weights; serves pulls and applies pushed deltas."""
+
+    def __init__(self, model: Dict[str, Any], port: int, mode: str, **kwargs):
+        self.port = port
+        self.mode = mode
+        self.custom_objects = kwargs.get("custom_objects")
+        # ``model`` is the model_to_dict payload; the server only needs the
+        # weight list (the architecture rides along for parity/save paths).
+        self.model_config = model.get("model")
+        self.weights: List[np.ndarray] = [np.asarray(w, dtype=np.float32)
+                                          for w in model["weights"]]
+        self.lock = RWLock()
+
+    def get_weights(self) -> List[np.ndarray]:
+        if self.mode == "asynchronous":
+            self.lock.acquire_read()
+        try:
+            return [w.copy() for w in self.weights]
+        finally:
+            if self.mode == "asynchronous":
+                self.lock.release()
+
+    def apply_delta(self, delta: List[np.ndarray]):
+        if self.mode == "asynchronous":
+            self.lock.acquire_write()
+        try:
+            self.weights = subtract_params(self.weights, delta)
+        finally:
+            if self.mode == "asynchronous":
+                self.lock.release()
+
+    @abc.abstractmethod
+    def start(self):
+        """Start serving."""
+
+    @abc.abstractmethod
+    def stop(self):
+        """Stop serving."""
+
+
+class HttpServer(BaseParameterServer):
+    """HTTP parameter server: ``GET /parameters`` and ``POST /update``.
+
+    (Parity surface: ``elephas/parameter/server.py:42-137``.)
+    """
+
+    def __init__(self, model: Dict[str, Any], port: int, mode: str, **kwargs):
+        super().__init__(model, port, mode, **kwargs)
+        self.master_url: Optional[str] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/"):
+                    body = b"elephas_tpu"
+                elif self.path.startswith("/parameters"):
+                    body = encode_weights(server.get_weights())
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/elephas-tpu")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if not self.path.startswith("/update"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                delta = decode_weights(self.rfile.read(length))
+                server.apply_delta(delta)
+                body = b"Update done"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        host = determine_master(self.port).split(":")[0]
+        self._httpd = ThreadingHTTPServer((host, self.port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.master_url = determine_master(self.port)
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+            self._httpd = None
+            self._thread = None
+
+
+class SocketServer(BaseParameterServer):
+    """Raw-TCP parameter server with a 1-byte opcode protocol:
+    ``'g'`` = get weights, ``'u'`` = apply update.
+
+    (Parity surface: ``elephas/parameter/server.py:140-233``; framing is the
+    length-prefixed ETPU format instead of pickled payloads.)
+    """
+
+    def __init__(self, model: Dict[str, Any], port: int, mode: str, **kwargs):
+        super().__init__(model, port, mode, **kwargs)
+        self.socket: Optional[socket.socket] = None
+        self.runs = False
+        self.connections: List[threading.Thread] = []
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self.thread is not None:
+            self.stop()
+        ready = threading.Event()
+        self.thread = threading.Thread(target=self._serve, args=(ready,),
+                                       daemon=True)
+        self.thread.start()
+        if not ready.wait(timeout=10):
+            raise RuntimeError("SocketServer failed to start listening")
+
+    def stop(self):
+        self.runs = False
+        if self.socket is not None:
+            # unblock accept() with a self-connection, then close
+            try:
+                host = determine_master(self.port).split(":")[0]
+                with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                    s.settimeout(1.0)
+                    s.connect((host, self.port))
+            except OSError:
+                pass
+        if self.thread is not None:
+            self.thread.join(timeout=5)
+            self.thread = None
+        for t in self.connections:
+            t.join(timeout=1)
+        self.connections = []
+        if self.socket is not None:
+            try:
+                self.socket.close()
+            except OSError:
+                pass
+            self.socket = None
+
+    def _serve(self, ready: threading.Event):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        host = determine_master(self.port).split(":")[0]
+        sock.bind((host, self.port))
+        sock.listen(16)
+        self.socket = sock
+        self.runs = True
+        ready.set()
+        while self.runs:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                break
+            if not self.runs:
+                conn.close()
+                break
+            t = threading.Thread(target=self._listen, args=(conn,), daemon=True)
+            t.start()
+            self.connections.append(t)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _listen(self, conn: socket.socket):
+        with conn:
+            while self.runs:
+                try:
+                    opcode = conn.recv(1)
+                except OSError:
+                    return
+                if not opcode:
+                    return
+                if opcode == b"u":
+                    delta = receive(conn)
+                    self.apply_delta(delta)
+                    try:
+                        conn.sendall(b"k")  # ack: delta applied
+                    except OSError:
+                        return
+                elif opcode == b"g":
+                    send(conn, self.get_weights())

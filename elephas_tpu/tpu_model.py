@@ -1,0 +1,407 @@
+"""TPUModel: the distributed training/inference/evaluation API.
+
+The capability mirror of the reference's ``SparkModel``/``SparkMLlibModel``
+(``elephas/spark_model.py:28-352``), re-architected single-controller:
+
+- The "cluster" is a :class:`jax.sharding.Mesh`; "broadcast" is replicated
+  sharding; "collect + driver merge" is an all-reduce inside one jitted
+  program (synchronous mode), so the reference's O(params x workers) numpy
+  merge loop on the driver does not exist here.
+- ``mode='synchronous'`` keeps the reference's *semantics* (each worker
+  trains a full local copy, deltas are averaged once,
+  ``elephas/spark_model.py:217-228``) by default (``sync_mode='average'``);
+  ``sync_mode='step'`` switches to true per-step synchronous SGD — the
+  benchmark configuration.
+- ``mode='asynchronous' | 'hogwild'`` run parameter-server training with
+  the reference's pull/train/push loop at ``epoch`` or ``batch``
+  frequency over HTTP or raw-TCP transports.
+- Distributed predict preserves input order by construction (contiguous
+  shards) instead of the reference's zipWithIndex/sortBy dance; distributed
+  evaluate is the sample-count-weighted reduction.
+"""
+import json
+import subprocess
+from copy import deepcopy
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+from uuid import uuid4
+
+import h5py
+import numpy as np
+
+from .data.dataset import Dataset
+from .mllib.adapter import from_matrix, from_vector, to_matrix, to_vector
+from .mllib.linalg import Matrix, Vector
+from .models import deserialize_optimizer, get_optimizer, serialize_optimizer
+from .models.core import BaseModel
+from .models.saving import load_model
+from .parameter.factory import ClientServerFactory
+from .utils.dataset_utils import lp_to_dataset, to_dataset
+from .utils.serialization import model_to_dict
+from .worker import AsyncWorker
+
+
+class TPUModel:
+    """Distributed model: train/predict/evaluate over a TPU device mesh.
+
+    :param model: compiled :class:`~elephas_tpu.models.Sequential` or
+        :class:`~elephas_tpu.models.Model`
+    :param mode: ``asynchronous`` (default), ``synchronous`` or ``hogwild``
+    :param frequency: ``epoch`` or ``batch`` — async update granularity
+    :param parameter_server_mode: ``http`` or ``socket``
+    :param num_workers: worker/partition count (defaults to dataset
+        partitioning, which defaults to the device count)
+    :param custom_objects: registry for custom layers/activations/losses
+    :param batch_size: training/inference batch size default
+    :param port: parameter-server port
+    :param sync_mode: ``average`` (reference model-averaging semantics) or
+        ``step`` (per-step sync SGD; throughput configuration)
+    """
+
+    def __init__(self, model: BaseModel, mode: str = "asynchronous",
+                 frequency: str = "epoch", parameter_server_mode: str = "http",
+                 num_workers: Optional[int] = None,
+                 custom_objects: Optional[Dict] = None, batch_size: int = 32,
+                 port: int = 4000, *args, **kwargs):
+        self._training_histories: List = []
+        self._master_network = model
+        if not model.compiled:
+            raise Exception(
+                "Compile your model before initializing an elephas_tpu model "
+                "with it")
+        if not model.built:
+            raise Exception(
+                "Build your model (known input shape) before initializing an "
+                "elephas_tpu model with it")
+        self.mode = mode
+        self.frequency = frequency
+        self.num_workers = num_workers
+        self.weights = model.get_weights()
+        self.master_optimizer = serialize_optimizer(model.optimizer)
+        self.master_loss = model.loss
+        self.master_metrics = list(model.metrics or [])
+        self.custom_objects = custom_objects or {}
+        self.parameter_server_mode = parameter_server_mode
+        self.batch_size = batch_size
+        self.port = port
+        self.sync_mode = kwargs.pop("sync_mode", "average")
+        self.kwargs = kwargs
+
+        self.serialized_model = model_to_dict(model)
+        self.parameter_server = None
+        self.client = None
+        if self.mode != "synchronous":
+            factory = ClientServerFactory.get_factory(self.parameter_server_mode)
+            self.parameter_server = factory.create_server(
+                self.serialized_model, self.port, self.mode,
+                custom_objects=self.custom_objects)
+            self.client = factory.create_client(self.port)
+
+        self._replica = None  # lazily-built worker replica for predict/eval
+        self._predict_fn = None
+        self._evaluate_fn = None
+
+    # ------------------------------------------------------------------ admin
+    def get_config(self) -> Dict:
+        base_config = {
+            "parameter_server_mode": self.parameter_server_mode,
+            "mode": self.mode,
+            "frequency": self.frequency,
+            "num_workers": self.num_workers,
+            "batch_size": self.batch_size,
+        }
+        config = base_config.copy()
+        if self.sync_mode != "average":
+            config["sync_mode"] = self.sync_mode
+        config.update(self.kwargs)
+        return config
+
+    @property
+    def training_histories(self):
+        return self._training_histories
+
+    @property
+    def master_network(self) -> BaseModel:
+        return self._master_network
+
+    @master_network.setter
+    def master_network(self, network: BaseModel):
+        self._master_network = network
+
+    def start_server(self):
+        self.parameter_server.start()
+
+    def stop_server(self):
+        self.parameter_server.stop()
+
+    # ------------------------------------------------------------------- save
+    def save(self, file_name: str, overwrite: bool = False,
+             to_hadoop: bool = False):
+        """Save model + distributed config to h5/keras, optionally pushing
+        the file to a Hadoop cluster (parity: ``elephas/spark_model.py:92-134``)."""
+        assert (file_name[-3:] == ".h5" or file_name[-6:] == ".keras"), \
+            "File name must end with either '.h5' or '.keras'"
+
+        if overwrite and not to_hadoop and Path(file_name).exists():
+            Path(file_name).unlink()
+
+        if to_hadoop:
+            cluster_file_path = deepcopy(file_name)
+            file_name = str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
+
+        model = self._master_network
+        model.save(file_name, overwrite=True)
+        with h5py.File(file_name, mode="a") as f:
+            f.attrs["distributed_config"] = json.dumps({
+                "class_name": self.__class__.__name__,
+                "config": self.get_config(),
+            }).encode("utf8")
+
+        if to_hadoop:
+            cli = ["hadoop", "fs", "-moveFromLocal"]
+            if overwrite:
+                cli.append("-f")
+            cli.extend([file_name, cluster_file_path])
+            subprocess.run(cli)
+
+    # ------------------------------------------------------------------- data
+    def _as_dataset(self, data, with_labels: bool = True) -> Dataset:
+        if isinstance(data, Dataset):
+            ds = data
+        elif isinstance(data, tuple) and len(data) == 2:
+            ds = to_dataset(data[0], data[1])
+        elif isinstance(data, np.ndarray):
+            ds = Dataset((data,))
+        elif isinstance(data, (list,)):
+            ds = Dataset.from_pairs(data) if with_labels else Dataset((np.asarray(data),))
+        else:
+            raise ValueError(f"Cannot interpret training data: {type(data)}")
+        if not ds.is_columnar:
+            ds = Dataset.from_pairs(ds.rows(), num_partitions=ds._num_partitions)
+        return ds
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, dataset: Union[Dataset, tuple], **kwargs):
+        """Distributed training over a partitioned dataset.
+
+        :param dataset: pair :class:`Dataset` or ``(features, labels)``
+        :param epochs, batch_size, verbose, validation_split: as in Keras
+        """
+        ds = self._as_dataset(dataset)
+        if self.num_workers:
+            ds = ds.repartition(self.num_workers)
+
+        if self.mode in ["asynchronous", "synchronous", "hogwild"]:
+            self._fit(ds, **kwargs)
+        else:
+            raise ValueError(
+                "Choose from one of the modes: asynchronous, synchronous "
+                "or hogwild")
+
+    def _fit(self, ds: Dataset, **kwargs):
+        train_config = dict(kwargs)
+        train_config.setdefault("batch_size", self.batch_size)
+        self._invalidate_replica()
+
+        if self.mode == "synchronous":
+            if self.sync_mode == "step":
+                self._fit_sync_step(ds, **train_config)
+            else:
+                self._fit_sync_average(ds, **train_config)
+        elif self.mode in ("asynchronous", "hogwild"):
+            self._fit_async(ds, **train_config)
+        else:
+            raise ValueError("Unsupported mode {}".format(self.mode))
+
+    def _worker_metric_fns(self):
+        from .models import metrics as metrics_mod
+
+        return [metrics_mod.get(m, loss=self.master_loss,
+                                custom_objects=self.custom_objects)
+                for m in self.master_metrics]
+
+    def _fit_sync_average(self, ds: Dataset, epochs: int = 10,
+                          batch_size: int = 32, verbose: int = 0,
+                          validation_split: float = 0.1, **kwargs):
+        from .parallel.sync_trainer import SyncAverageTrainer
+
+        replica = self._get_replica()
+        trainer = SyncAverageTrainer(
+            replica, deserialize_optimizer(self.master_optimizer),
+            self.master_loss, self._worker_metric_fns(), self.custom_objects)
+        shards = ds.partitions()
+        new_weights, histories = trainer.run(
+            self._master_network.get_weights(), shards, epochs=epochs,
+            batch_size=batch_size, validation_split=validation_split,
+            seed=kwargs.get("seed", 0))
+        for history in histories:
+            if history is not None:
+                self._training_histories.append(history)
+        self._master_network.set_weights(new_weights)
+
+    def _fit_sync_step(self, ds: Dataset, epochs: int = 10,
+                       batch_size: int = 32, verbose: int = 0,
+                       validation_split: float = 0.1, **kwargs):
+        from .parallel.sync_trainer import SyncStepTrainer
+
+        replica = self._get_replica()
+        trainer = SyncStepTrainer(
+            replica, deserialize_optimizer(self.master_optimizer),
+            self.master_loss, self._worker_metric_fns(), self.custom_objects)
+        x, y = ds.to_arrays()
+        new_weights, history = trainer.fit(
+            self._master_network.get_weights(), x, y, epochs=epochs,
+            batch_size=batch_size, validation_split=validation_split,
+            seed=kwargs.get("seed", 0), verbose=verbose)
+        self._training_histories.append(history)
+        self._master_network.set_weights(new_weights)
+
+    def _fit_async(self, ds: Dataset, epochs: int = 10, batch_size: int = 32,
+                   verbose: int = 0, validation_split: float = 0.1, **kwargs):
+        import concurrent.futures
+
+        self.start_server()
+        try:
+            train_config = {"epochs": epochs, "batch_size": batch_size,
+                            "verbose": verbose,
+                            "validation_split": validation_split}
+            model_json = self._master_network.to_json()
+            init = self._master_network.get_weights()
+            shards = ds.partitions()
+
+            def run_worker(shard):
+                x_w, y_w = shard
+                worker = AsyncWorker(
+                    model_json, init, self.client, train_config,
+                    self.frequency, self.master_optimizer, self.master_loss,
+                    self.master_metrics, self.custom_objects, port=self.port)
+                worker.train(np.asarray(x_w), np.asarray(y_w))
+
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=len(shards)) as pool:
+                futures = [pool.submit(run_worker, shard) for shard in shards]
+                for f in futures:
+                    f.result()
+            new_parameters = self.client.get_parameters()
+            self._master_network.set_weights(new_parameters)
+        finally:
+            self.stop_server()
+
+    # ------------------------------------------------------------ predict/eval
+    def _invalidate_replica(self):
+        self._replica = None
+        self._predict_fn = None
+        self._evaluate_fn = None
+
+    def _get_replica(self) -> BaseModel:
+        """A worker copy of the master network (master stays untouched
+        during distributed execution, as with the reference's broadcast)."""
+        from .models.core import model_from_json
+
+        if self._replica is None:
+            self._replica = model_from_json(self._master_network.to_json(),
+                                            self.custom_objects)
+        self._replica.set_weights(self._master_network.get_weights())
+        return self._replica
+
+    def predict(self, data: Union[Dataset, np.ndarray],
+                batch_size: Optional[int] = None) -> np.ndarray:
+        """Distributed inference; returns predictions in input order."""
+        from .parallel.sync_trainer import build_sharded_predict
+
+        if isinstance(data, Dataset):
+            if data.is_columnar:
+                x = data.columns[0]
+            else:
+                x = np.asarray(data.rows())
+        else:
+            x = np.asarray(data)
+        replica = self._get_replica()
+        if self._predict_fn is None:
+            self._predict_fn = build_sharded_predict(replica)
+        return self._predict_fn(x,
+                                batch_size=batch_size or max(self.batch_size, 256))
+
+    def evaluate(self, x_test: np.ndarray, y_test: np.ndarray,
+                 **kwargs) -> Union[List[float], float]:
+        """Distributed evaluation: sample-count-weighted loss/metric means
+        (parity: ``elephas/spark_model.py:274-308``)."""
+        from .parallel.sync_trainer import build_sharded_evaluate
+
+        replica = self._get_replica()
+        if self._evaluate_fn is None:
+            self._evaluate_fn = build_sharded_evaluate(
+                replica, self.master_loss, self._worker_metric_fns(),
+                self.custom_objects)
+        return self._evaluate_fn(np.asarray(x_test), np.asarray(y_test),
+                                 batch_size=kwargs.get("batch_size",
+                                                       max(self.batch_size, 256)))
+
+
+class TPUMatrixModel(TPUModel):
+    """Distributed model over LabeledPoint datasets and dense linalg types
+    (capability mirror of ``SparkMLlibModel``, ``elephas/spark_model.py:311-352``)."""
+
+    def __init__(self, model: BaseModel, mode: str = "asynchronous",
+                 frequency: str = "epoch", parameter_server_mode: str = "http",
+                 num_workers: int = 4, custom_objects: Optional[Dict] = None,
+                 batch_size: int = 32, port: int = 4000, *args, **kwargs):
+        super().__init__(model=model, mode=mode, frequency=frequency,
+                         parameter_server_mode=parameter_server_mode,
+                         num_workers=num_workers, custom_objects=custom_objects,
+                         batch_size=batch_size, port=port, *args, **kwargs)
+
+    def fit(self, labeled_points: Dataset, epochs: int = 10,
+            batch_size: int = 32, verbose: int = 0,
+            validation_split: float = 0.1, categorical: bool = False,
+            nb_classes: Optional[int] = None):
+        """Train on a Dataset of LabeledPoints."""
+        ds = lp_to_dataset(labeled_points, categorical, nb_classes)
+        ds = ds.repartition(self.num_workers)
+        self._fit(ds, epochs=epochs, batch_size=batch_size, verbose=verbose,
+                  validation_split=validation_split)
+
+    def predict(self, mllib_data: Union[Matrix, Vector]):
+        """Predict on a dense Matrix or Vector, returning the same type."""
+        if isinstance(mllib_data, Matrix):
+            return to_matrix(self._master_network.predict(
+                from_matrix(mllib_data)))
+        elif isinstance(mllib_data, Vector):
+            return to_vector(self._master_network.predict(
+                from_vector(mllib_data)[None, :])[0])
+        else:
+            raise ValueError(
+                "Provide either a Matrix or Vector, got {}".format(
+                    type(mllib_data)))
+
+
+def load_tpu_model(file_name: str, from_hadoop: bool = False,
+                   custom_objects: Optional[Dict] = None
+                   ) -> Union[TPUModel, TPUMatrixModel]:
+    """Load a distributed model saved by :meth:`TPUModel.save`
+    (parity: ``elephas/spark_model.py:355-389``)."""
+    assert (file_name[-3:] == ".h5" or file_name[-6:] == ".keras"), \
+        "File name must end with either '.h5' or '.keras'"
+
+    if from_hadoop:
+        temp_file = str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
+        subprocess.run(["hadoop", "fs", "-copyToLocal", file_name, temp_file])
+        file_name = temp_file
+
+    model = load_model(file_name, custom_objects)
+    with h5py.File(file_name, mode="r") as f:
+        dist_conf = f.attrs.get("distributed_config")
+        if isinstance(dist_conf, bytes):
+            dist_conf = dist_conf.decode("utf8")
+        elephas_conf = json.loads(dist_conf)
+    class_name = elephas_conf.get("class_name")
+    config = elephas_conf.get("config")
+
+    if from_hadoop:
+        Path(file_name).unlink()
+
+    if class_name == TPUModel.__name__:
+        return TPUModel(model=model, **config)
+    elif class_name == TPUMatrixModel.__name__:
+        return TPUMatrixModel(model=model, **config)
+    raise ValueError(f"Unknown distributed model class {class_name!r}")

@@ -9,3 +9,5 @@ from .serialization import dict_to_model, model_to_dict
 from .sockets import determine_master, receive, send
 from .dataset_utils import (encode_label, from_labeled_points, lp_to_dataset,
                             to_dataset, to_labeled_points)
+from .checkpoint import CheckpointManager
+from .tracing import StepTimer, annotate, profiler_trace

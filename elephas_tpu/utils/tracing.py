@@ -1,0 +1,76 @@
+"""Step timing and profiler hooks.
+
+The reference has no tracing/profiling at all (progress reporting is bare
+``print``, SURVEY.md §5); this module provides real step timing plus
+``jax.profiler`` trace capture as the upgrade the survey calls for.
+"""
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+
+class StepTimer:
+    """Collects per-step wall times and derives throughput."""
+
+    def __init__(self):
+        self.durations: List[float] = []
+        self._start: Optional[float] = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.durations.append(time.perf_counter() - self._start)
+        self._start = None
+        return False
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        self.__exit__()
+
+    @property
+    def total(self) -> float:
+        return sum(self.durations)
+
+    @property
+    def mean(self) -> float:
+        return self.total / len(self.durations) if self.durations else 0.0
+
+    def samples_per_sec(self, samples_per_step: int) -> float:
+        return samples_per_step / self.mean if self.mean else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        durations = sorted(self.durations)
+        n = len(durations)
+        if not n:
+            return {"steps": 0}
+        return {
+            "steps": n,
+            "total_s": self.total,
+            "mean_s": self.mean,
+            "p50_s": durations[n // 2],
+            "p99_s": durations[min(n - 1, int(n * 0.99))],
+        }
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str] = None):
+    """Capture a ``jax.profiler`` trace (viewable in TensorBoard/Perfetto)
+    around the wrapped block; no-op when ``logdir`` is None."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Named trace span (shows up in profiler timelines)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,128 @@
+"""Per-partition training workers.
+
+The reference ships these closures to Spark executors via
+``rdd.mapPartitions`` (``elephas/worker.py:11-131``). Here workers are
+driven by the single-controller :class:`~elephas_tpu.tpu_model.TPUModel`:
+
+- Synchronous training normally runs *all* workers inside one jitted,
+  mesh-sharded program (:class:`~elephas_tpu.parallel.SyncAverageTrainer`);
+  :class:`SyncWorker` is the per-partition scalar implementation of the
+  same semantics, used as a reference/fallback path and for tests.
+- :class:`AsyncWorker` mirrors the reference's asynchronous executor loop
+  exactly: pull global weights from the parameter server, train locally
+  for one epoch (or one batch), push the weight delta
+  (``elephas/worker.py:76-131``). Workers run as coordinator-host threads,
+  each driving jit-compiled local steps.
+"""
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .models import deserialize_optimizer, model_from_json
+from .parameter import BaseParameterClient
+from .utils.functional_utils import subtract_params
+
+
+class SyncWorker:
+    """Train a full local model copy on one partition; return the weight
+    delta and training history (parity: ``elephas/worker.py:11-49``)."""
+
+    def __init__(self, json_config: str, parameters: List[np.ndarray],
+                 train_config: Dict[str, Any], master_optimizer,
+                 master_loss, master_metrics,
+                 custom_objects: Optional[Dict] = None):
+        self.json = json_config
+        self.parameters = parameters
+        self.train_config = dict(train_config)
+        self.master_optimizer = master_optimizer
+        self.master_loss = master_loss
+        self.master_metrics = master_metrics
+        self.custom_objects = custom_objects or {}
+        self.model = None
+
+    def train(self, x_train: np.ndarray, y_train: np.ndarray):
+        """Returns ``[delta, history_dict_or_None]``."""
+        history = None
+        self.model = model_from_json(self.json, self.custom_objects)
+        self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
+                           loss=self.master_loss, metrics=self.master_metrics,
+                           custom_objects=self.custom_objects)
+        self.model.set_weights(self.parameters)
+
+        weights_before = self.model.get_weights()
+        batch_size = self.train_config.get("batch_size", 32)
+        if x_train.shape[0] > batch_size:
+            history = self.model.fit(x_train, y_train, **self.train_config)
+        weights_after = self.model.get_weights()
+        deltas = subtract_params(weights_before, weights_after)
+        return [deltas, history.history if history else None]
+
+
+class AsyncWorker:
+    """Asynchronous worker: exchanges weight deltas with a parameter server
+    at epoch or batch frequency (parity: ``elephas/worker.py:52-131``)."""
+
+    def __init__(self, json_config: str, parameters: List[np.ndarray],
+                 client: Union[BaseParameterClient, str],
+                 train_config: Dict[str, Any], frequency: str,
+                 master_optimizer, master_loss, master_metrics,
+                 custom_objects: Optional[Dict] = None, port: int = 4000):
+        if isinstance(client, BaseParameterClient):
+            self.client = client
+        else:
+            self.client = BaseParameterClient.get_client(client, port)
+        self.json = json_config
+        self.parameters = parameters
+        self.train_config = dict(train_config)
+        self.frequency = frequency
+        self.master_optimizer = master_optimizer
+        self.master_loss = master_loss
+        self.master_metrics = master_metrics
+        self.custom_objects = custom_objects or {}
+        self.model = None
+
+    def train(self, x_train: np.ndarray, y_train: np.ndarray):
+        if x_train.size == 0:
+            return
+
+        self.model = model_from_json(self.json, self.custom_objects)
+        self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
+                           loss=self.master_loss, metrics=self.master_metrics,
+                           custom_objects=self.custom_objects)
+        self.model.set_weights(self.parameters)
+
+        train_config = dict(self.train_config)
+        epochs = train_config.get("epochs", 1)
+        batch_size = train_config.get("batch_size", 32)
+        nb_train_sample = x_train.shape[0]
+        nb_batch = int(np.ceil(nb_train_sample / float(batch_size)))
+        batches = [(i * batch_size, min(nb_train_sample, (i + 1) * batch_size))
+                   for i in range(nb_batch)]
+
+        if self.frequency == "epoch":
+            for _ in range(epochs):
+                weights_before = self.client.get_parameters()
+                self.model.set_weights(weights_before)
+                if x_train.shape[0] > batch_size:
+                    per_epoch = dict(train_config)
+                    per_epoch["epochs"] = 1
+                    self.model.fit(x_train, y_train, **per_epoch)
+                weights_after = self.model.get_weights()
+                self.client.update_parameters(
+                    subtract_params(weights_before, weights_after))
+        elif self.frequency == "batch":
+            for _ in range(epochs):
+                if x_train.shape[0] > batch_size:
+                    for batch_start, batch_end in batches:
+                        weights_before = self.client.get_parameters()
+                        self.model.set_weights(weights_before)
+                        self.model.train_on_batch(
+                            x_train[batch_start:batch_end],
+                            y_train[batch_start:batch_end])
+                        weights_after = self.model.get_weights()
+                        self.client.update_parameters(
+                            subtract_params(weights_before, weights_after))
+        else:
+            raise ValueError(
+                "frequency parameter can be `epoch` or `batch`, got {}".format(
+                    self.frequency))

@@ -1,0 +1,44 @@
+"""Shared synthetic datasets for the examples (the environment has no
+network egress, so MNIST/Boston are replaced by learnable synthetic
+problems with the same shapes)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def mnist_like(n_train=6000, n_test=1000, dim=784, classes=10, seed=7):
+    centers = np.random.default_rng(123).normal(0.0, 2.0, (classes, dim))
+    rng = np.random.default_rng(seed)
+
+    def split(n, s):
+        r = np.random.default_rng(s)
+        labels = r.integers(0, classes, n)
+        x = centers[labels] + r.normal(0.0, 1.0, (n, dim))
+        x = (x - x.min()) / (x.max() - x.min())
+        return x.astype("float32"), np.eye(classes, dtype="float32")[labels]
+
+    x_train, y_train = split(n_train, seed)
+    x_test, y_test = split(n_test, seed + 1)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def housing_like(n_train=404, n_test=102, dim=13, seed=11):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0.0, 1.0, dim)
+    x_train = rng.normal(0.0, 1.0, (n_train, dim)).astype("float32")
+    x_test = rng.normal(0.0, 1.0, (n_test, dim)).astype("float32")
+    y_train = (x_train @ w + 20.0 + rng.normal(0, 0.5, n_train)).astype("float32")
+    y_test = (x_test @ w + 20.0).astype("float32")
+    return (x_train, y_train), (x_test, y_test)
+
+
+def otto_like(n=2000, dim=93, classes=9, seed=13):
+    """Tabular multi-class problem shaped like the Otto product dataset."""
+    centers = np.random.default_rng(99).normal(0.0, 1.5, (classes, dim))
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    x = np.abs(centers[labels] + rng.normal(0.0, 1.0, (n, dim)))
+    return x.astype("float32"), labels.astype("int64")

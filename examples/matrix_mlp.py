@@ -1,0 +1,32 @@
+"""LabeledPoint training with TPUMatrixModel.
+
+Port of ``examples/mllib_mlp.py`` from the reference: train on a Dataset of
+LabeledPoints and predict on dense linalg types.
+"""
+from common import mnist_like
+
+from elephas_tpu.mllib import to_matrix
+from elephas_tpu.models import SGD, Dense, Sequential
+from elephas_tpu.tpu_model import TPUMatrixModel
+from elephas_tpu.utils import to_labeled_points
+
+batch_size = 64
+epochs = 3
+
+(x_train, y_train), (x_test, y_test) = mnist_like()
+
+model = Sequential()
+model.add(Dense(128, input_dim=784, activation="relu"))
+model.add(Dense(128, activation="relu"))
+model.add(Dense(10, activation="softmax"))
+model.compile(SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"])
+
+lp_dataset = to_labeled_points(x_train, y_train, categorical=True)
+
+tpu_model = TPUMatrixModel(model, frequency="epoch", mode="synchronous",
+                           num_workers=4)
+tpu_model.fit(lp_dataset, epochs=epochs, batch_size=batch_size, verbose=0,
+              validation_split=0.1, categorical=True, nb_classes=10)
+
+preds = tpu_model.predict(to_matrix(x_test[:8]))
+print("Predictions:", preds.toArray().argmax(axis=1))

@@ -1,0 +1,48 @@
+"""ML-pipeline classification: Estimator -> Transformer over a DataFrame.
+
+Port of ``examples/ml_mlp_classification.py`` from the reference.
+"""
+import numpy as np
+from common import mnist_like
+
+from elephas_tpu.ml import Estimator, to_data_frame
+from elephas_tpu.models import (SGD, Activation, Dense, Dropout, Sequential,
+                                serialize_optimizer)
+
+(x_train, y_train), (x_test, y_test) = mnist_like(n_train=2000, n_test=400)
+
+model = Sequential()
+model.add(Dense(128, input_dim=784))
+model.add(Activation("relu"))
+model.add(Dropout(0.2))
+model.add(Dense(128))
+model.add(Activation("relu"))
+model.add(Dropout(0.2))
+model.add(Dense(10))
+model.add(Activation("softmax"))
+model.build()
+
+train_df = to_data_frame(x_train, y_train, categorical=True)
+test_df = to_data_frame(x_test, y_test, categorical=True)
+
+estimator = Estimator(
+    model_config=model.to_json(),
+    optimizer_config=serialize_optimizer(SGD(learning_rate=0.1)),
+    loss="categorical_crossentropy",
+    metrics=["acc"],
+    mode="synchronous",
+    categorical=True,
+    nb_classes=10,
+    epochs=5,
+    batch_size=64,
+    validation_split=0.1,
+    num_workers=4,
+    verbose=0,
+)
+
+fitted = estimator.fit(train_df)
+result = fitted.transform(test_df)
+
+accuracy = np.mean([int(np.argmax(p)) == int(label) for p, label
+                    in zip(result["prediction"], result["label"])])
+print("Pipeline test accuracy:", accuracy)

@@ -1,0 +1,39 @@
+"""ML-pipeline regression (port of ``examples/ml_mlp_regression.py``)."""
+import numpy as np
+from common import housing_like
+
+from elephas_tpu.ml import Estimator, to_data_frame
+from elephas_tpu.models import Adam, Dense, Sequential, serialize_optimizer
+
+(x_train, y_train), (x_test, y_test) = housing_like()
+
+model = Sequential()
+model.add(Dense(64, activation="relu", input_shape=(13,)))
+model.add(Dense(64, activation="relu"))
+model.add(Dense(1, activation="linear"))
+model.build()
+
+train_df = to_data_frame(x_train, y_train, categorical=False)
+test_df = to_data_frame(x_test, y_test, categorical=False)
+
+estimator = Estimator(
+    model_config=model.to_json(),
+    optimizer_config=serialize_optimizer(Adam(learning_rate=0.01)),
+    loss="mse",
+    metrics=["mae"],
+    mode="synchronous",
+    categorical=False,
+    nb_classes=1,
+    epochs=30,
+    batch_size=64,
+    validation_split=0.1,
+    num_workers=2,
+    verbose=0,
+)
+
+fitted = estimator.fit(train_df)
+result = fitted.transform(test_df)
+
+mae = np.mean([abs(pred - label) for pred, label
+               in zip(result["prediction"], result["label"])])
+print("Pipeline test MAE:", mae)

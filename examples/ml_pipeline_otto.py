@@ -1,0 +1,61 @@
+"""End-to-end tabular pipeline: label indexing + feature scaling + training.
+
+Port of ``examples/ml_pipeline_otto.py`` from the reference (Spark
+StringIndexer + StandardScaler + ElephasEstimator pipeline), with the
+preprocessing stages done in numpy/pandas.
+"""
+import numpy as np
+from common import otto_like
+
+from elephas_tpu.ml import Estimator, to_data_frame
+from elephas_tpu.models import (Adam, Activation, Dense, Dropout, Sequential,
+                                serialize_optimizer)
+
+x, labels = otto_like()
+
+# "StringIndexer": map raw labels to contiguous indices
+classes, indexed = np.unique(labels, return_inverse=True)
+nb_classes = len(classes)
+
+# "StandardScaler": zero-mean unit-variance features
+mean, std = x.mean(axis=0), x.std(axis=0) + 1e-8
+x = (x - mean) / std
+
+split = int(0.8 * len(x))
+train_df = to_data_frame(x[:split], indexed[:split].astype(float),
+                         categorical=False)
+test_df = to_data_frame(x[split:], indexed[split:].astype(float),
+                        categorical=False)
+
+model = Sequential()
+model.add(Dense(256, input_dim=x.shape[1]))
+model.add(Activation("relu"))
+model.add(Dropout(0.3))
+model.add(Dense(256))
+model.add(Activation("relu"))
+model.add(Dropout(0.3))
+model.add(Dense(nb_classes))
+model.add(Activation("softmax"))
+model.build()
+
+estimator = Estimator(
+    model_config=model.to_json(),
+    optimizer_config=serialize_optimizer(Adam(learning_rate=0.001)),
+    loss="categorical_crossentropy",
+    metrics=["acc"],
+    mode="synchronous",
+    categorical=True,
+    nb_classes=nb_classes,
+    epochs=8,
+    batch_size=128,
+    validation_split=0.1,
+    num_workers=4,
+    verbose=0,
+)
+
+fitted = estimator.fit(train_df)
+result = fitted.transform(test_df)
+
+accuracy = np.mean([int(np.argmax(p)) == int(label) for p, label
+                    in zip(result["prediction"], result["label"])])
+print("Otto-style pipeline accuracy:", accuracy)

@@ -1,0 +1,33 @@
+"""MNIST-style MLP, asynchronous parameter-server training.
+
+Port of ``examples/mnist_mlp_spark_asynchronous.py`` from the reference.
+"""
+from common import mnist_like
+
+from elephas_tpu.models import SGD, Activation, Dense, Dropout, Sequential
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils import to_dataset
+
+batch_size = 64
+epochs = 3
+
+(x_train, y_train), (x_test, y_test) = mnist_like()
+
+model = Sequential()
+model.add(Dense(128, input_dim=784, activation="relu"))
+model.add(Dropout(0.2))
+model.add(Dense(128, activation="relu"))
+model.add(Dropout(0.2))
+model.add(Dense(10, activation="softmax"))
+model.compile(SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"])
+
+dataset = to_dataset(x_train, y_train)
+
+tpu_model = TPUModel(model, frequency="epoch", mode="asynchronous",
+                     num_workers=4, port=4001)
+tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=1,
+              validation_split=0.1)
+
+score = tpu_model.evaluate(x_test, y_test)
+print("Test loss:", score[0])
+print("Test accuracy:", score[1])

@@ -1,0 +1,53 @@
+"""Sharded Transformer LM training: dp x tp x sp over a device mesh.
+
+Beyond-the-reference example: trains the flagship transformer with
+tensor-parallel parameters, batch-sharded data and ring attention over a
+sequence axis — the long-context/distributed-first path. Runs on any
+device count (single chip: replicated; 8 devices: 2x2x2 mesh).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elephas_tpu.models.transformer import (TransformerConfig, init_params,
+                                            make_train_step, shard_params)
+
+config = TransformerConfig(vocab_size=512, num_layers=4, num_heads=8,
+                           d_model=256, d_ff=512, max_seq_len=256)
+
+n = len(jax.devices())
+if n >= 8:
+    dp, tp, sp = 2, 2, 2
+elif n >= 4:
+    dp, tp, sp = 2, 2, 1
+elif n >= 2:
+    dp, tp, sp = 2, 1, 1
+else:
+    dp, tp, sp = 1, 1, 1
+mesh = Mesh(np.array(jax.devices()[:dp * tp * sp]).reshape(dp, tp, sp),
+            ("data", "model", "seq"))
+print(f"mesh: data={dp} model={tp} seq={sp}")
+
+params = shard_params(init_params(config, jax.random.PRNGKey(0)), config, mesh)
+tx = optax.adam(3e-4)
+opt_state = jax.jit(tx.init)(params)
+
+# synthetic token stream with local structure so the LM has something to learn
+rng = np.random.default_rng(0)
+base = rng.integers(0, config.vocab_size, 128)
+tokens = np.stack([np.roll(base, i) for i in range(8 * dp)]).astype(np.int32)
+tokens = jax.device_put(tokens[:, :128], NamedSharding(mesh, P("data", "seq")))
+
+step = make_train_step(config, tx, mesh=mesh,
+                       seq_axis="seq" if sp > 1 else None)
+for i in range(20):
+    params, opt_state, loss = step(params, opt_state, tokens)
+    if i % 5 == 0:
+        print(f"step {i}: loss {float(loss):.4f}")
+print(f"final loss: {float(loss):.4f}")

@@ -12,10 +12,18 @@ problem (13 features), matching the shapes of the reference's fixtures
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Plain env vars are not enough here: the environment's sitecustomize pins
+# JAX_PLATFORMS to the TPU plugin, so force the platform through jax.config
+# before any backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest
@@ -58,9 +66,12 @@ def classification_model_functional():
     return Model(inputs=input_layer, outputs=output)
 
 
-def _make_classification(n, dim, classes, seed):
+def _make_classification(n, dim, classes, seed, centers_seed=123):
+    # class centers are fixed across train/test splits; only the sampling
+    # noise differs, so the task is learnable and generalizes
+    centers = np.random.default_rng(centers_seed).normal(0.0, 2.0,
+                                                         size=(classes, dim))
     rng = np.random.default_rng(seed)
-    centers = rng.normal(0.0, 2.0, size=(classes, dim))
     labels = rng.integers(0, classes, size=n)
     x = centers[labels] + rng.normal(0.0, 1.0, size=(n, dim))
     x = (x - x.min()) / (x.max() - x.min())

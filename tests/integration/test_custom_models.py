@@ -1,0 +1,38 @@
+"""Custom activation across all modes (mirror of
+``/root/reference/tests/integration/test_custom_models.py``)."""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from elephas_tpu.models import SGD, Dense, Sequential
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils.dataset_utils import to_dataset
+
+
+@pytest.mark.parametrize("mode", ["synchronous", "asynchronous", "hogwild"])
+def test_training_custom_activation(mode):
+    def custom_activation(x):
+        return jax.nn.sigmoid(x) + 1
+
+    model = Sequential()
+    model.add(Dense(1, input_dim=1, activation=custom_activation))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(SGD(learning_rate=0.1), "binary_crossentropy", ["acc"],
+                  custom_objects={"custom_activation": custom_activation},
+                  seed=0)
+
+    x_train = np.random.rand(100)
+    y_train = np.zeros(100)
+    x_test = np.random.rand(10)
+    y_test = np.zeros(10)
+    y_train[:50] = 1
+
+    tpu_model = TPUModel(model, frequency="epoch", mode=mode,
+                         custom_objects={"custom_activation": custom_activation},
+                         port=4000 + random.randint(0, 800))
+    tpu_model.fit(to_dataset(x_train, y_train), epochs=1, batch_size=16,
+                  verbose=0, validation_split=0.1)
+    assert tpu_model.predict(x_test) is not None
+    assert tpu_model.evaluate(x_test, y_test) is not None

@@ -1,0 +1,157 @@
+"""End-to-end distributed training sweep.
+
+Mirror of the reference's crown-jewel test
+(``/root/reference/tests/integration/test_end_to_end.py``): a parametrized
+sweep over mode x parameter-server transport x worker count, with the parity
+oracle — distributed predict must equal the master network's predict
+element-wise, and distributed evaluate must match the master network's
+evaluate within abs_tol 0.01.
+"""
+from itertools import count
+from math import isclose
+
+import numpy as np
+import pytest
+
+from elephas_tpu.models import SGD
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils.dataset_utils import to_dataset
+
+
+def _generate_port_number(port=3000, _count=count(1)):
+    return port + next(_count)
+
+
+SWEEP = [
+    ("synchronous", None, None),
+    ("synchronous", None, 2),
+    ("asynchronous", "http", None),
+    ("asynchronous", "http", 2),
+    ("asynchronous", "socket", None),
+    ("asynchronous", "socket", 2),
+    ("hogwild", "http", None),
+    ("hogwild", "http", 2),
+    ("hogwild", "socket", None),
+    ("hogwild", "socket", 2),
+]
+
+
+@pytest.mark.parametrize("mode,parameter_server_mode,num_workers", SWEEP)
+def test_training_classification(mode, parameter_server_mode, num_workers,
+                                 mnist_data, classification_model):
+    batch_size = 64
+    epochs = 3
+
+    x_train, y_train, x_test, y_test = mnist_data
+    x_train, y_train = x_train[:1000], y_train[:1000]
+
+    classification_model.compile(SGD(learning_rate=0.1),
+                                 "categorical_crossentropy", ["acc"], seed=0)
+    dataset = to_dataset(x_train, y_train)
+
+    tpu_model = TPUModel(classification_model, frequency="epoch",
+                         num_workers=num_workers, mode=mode,
+                         parameter_server_mode=parameter_server_mode or "http",
+                         port=_generate_port_number())
+    tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=0,
+                  validation_split=0.1)
+
+    predictions = tpu_model.predict(x_test)
+    evals = tpu_model.evaluate(x_test, y_test)
+
+    # dataset input and ndarray input agree
+    test_ds = to_dataset(x_test, np.zeros(len(x_test)))
+    from elephas_tpu.data import Dataset
+
+    ds_predictions = tpu_model.predict(Dataset((x_test,)))
+    assert [np.argmax(p) for p in predictions] == \
+        [np.argmax(p) for p in ds_predictions]
+
+    # distributed predict == master predict
+    master_preds = tpu_model.master_network.predict(x_test)
+    assert [np.argmax(p) for p in predictions] == \
+        [np.argmax(p) for p in master_preds]
+
+    # distributed evaluate == master evaluate
+    master_evals = tpu_model.master_network.evaluate(x_test, y_test)
+    assert isclose(evals[0], master_evals[0], abs_tol=0.01)
+    assert isclose(evals[1], master_evals[1], abs_tol=0.01)
+
+
+@pytest.mark.parametrize("mode,parameter_server_mode,num_workers", SWEEP)
+def test_training_regression(mode, parameter_server_mode, num_workers,
+                             housing_data, regression_model):
+    x_train, y_train, x_test, y_test = housing_data
+    dataset = to_dataset(x_train, y_train)
+
+    batch_size = 64
+    epochs = 3
+    regression_model.compile(SGD(learning_rate=1e-7), "mse",
+                             ["mae", "mean_absolute_percentage_error"], seed=0)
+    tpu_model = TPUModel(regression_model, frequency="epoch", mode=mode,
+                         num_workers=num_workers,
+                         parameter_server_mode=parameter_server_mode or "http",
+                         port=_generate_port_number())
+    tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=0,
+                  validation_split=0.1)
+
+    predictions = tpu_model.predict(x_test)
+    evals = tpu_model.evaluate(x_test, y_test)
+
+    master_preds = tpu_model.master_network.predict(x_test)
+    assert all(np.isclose(p, m, 0.01) for p, m in zip(predictions, master_preds))
+
+    master_evals = tpu_model.master_network.evaluate(x_test, y_test)
+    for got, want in zip(evals, master_evals):
+        assert isclose(got, want, abs_tol=0.01)
+
+
+def test_training_regression_no_metrics(housing_data, regression_model):
+    x_train, y_train, x_test, y_test = housing_data
+    dataset = to_dataset(x_train, y_train)
+
+    regression_model.compile(SGD(learning_rate=1e-7), "mse", seed=0)
+    tpu_model = TPUModel(regression_model, frequency="epoch",
+                         mode="synchronous", port=_generate_port_number())
+    tpu_model.fit(dataset, epochs=1, batch_size=64, verbose=0,
+                  validation_split=0.1)
+
+    predictions = tpu_model.predict(x_test)
+    master_preds = tpu_model.master_network.predict(x_test)
+    assert all(np.isclose(p, m, 0.01) for p, m in zip(predictions, master_preds))
+
+    # scalar return when no metrics are compiled
+    evals = tpu_model.evaluate(x_test, y_test)
+    master_evals = tpu_model.master_network.evaluate(x_test, y_test)
+    assert np.isscalar(evals)
+    assert isclose(evals, master_evals, abs_tol=0.01)
+
+
+def test_sync_step_mode(mnist_data, classification_model):
+    """The per-step sync-SGD fast path trains and keeps the oracle."""
+    x_train, y_train, x_test, y_test = mnist_data
+    classification_model.compile(SGD(learning_rate=0.1),
+                                 "categorical_crossentropy", ["acc"], seed=0)
+    tpu_model = TPUModel(classification_model, mode="synchronous",
+                         sync_mode="step", port=_generate_port_number())
+    tpu_model.fit(to_dataset(x_train[:512], y_train[:512]), epochs=2,
+                  batch_size=64, validation_split=0.1)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][-1] < history["loss"][0]
+    predictions = tpu_model.predict(x_test)
+    master_preds = tpu_model.master_network.predict(x_test)
+    assert np.allclose(predictions, master_preds, atol=1e-4)
+
+
+def test_sync_average_scalar_labels_learn(housing_data, regression_model):
+    """Regression guard: rank-1 labels must be rank-aligned before the
+    masked loss (a silent (n,1)-(n,) broadcast once trained on garbage)."""
+    x_train, y_train, _, _ = housing_data
+    regression_model.compile(SGD(learning_rate=0.01), "mse", seed=0)
+    before = regression_model.evaluate(x_train, y_train)
+    tpu_model = TPUModel(regression_model, mode="synchronous", num_workers=2,
+                         port=_generate_port_number())
+    tpu_model.fit(to_dataset(x_train, y_train), epochs=10, batch_size=32,
+                  validation_split=0.0)
+    after = regression_model.evaluate(x_train, y_train)
+    assert after < before * 0.9

@@ -1,0 +1,56 @@
+"""DataFrame adapter tests (mirror of ``/root/reference/tests/ml/test_adapter.py``)."""
+import numpy as np
+
+from elephas_tpu.ml import adapter
+
+
+def test_to_data_frame():
+    features = np.ones((2, 10))
+    labels = np.asarray([[2.0], [1.0]])
+    df = adapter.to_data_frame(features, labels, categorical=False)
+    assert len(df) == 2
+
+
+def test_to_data_frame_cat():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    df = adapter.to_data_frame(features, labels, categorical=True)
+    assert len(df) == 2
+    assert df["label"].tolist() == [2.0, 1.0]
+
+
+def test_from_data_frame():
+    features = np.ones((2, 10))
+    labels = np.asarray([2.0, 1.0])
+    df = adapter.to_data_frame(features, labels, categorical=False)
+    x, y = adapter.from_data_frame(df, categorical=False)
+    assert features.shape == x.shape
+    assert labels.shape == y.shape
+
+
+def test_from_data_frame_cat():
+    features = np.ones((2, 10))
+    labels = np.asarray([[0, 0, 1.0], [0, 1.0, 0]])
+    df = adapter.to_data_frame(features, labels, categorical=True)
+    x, y = adapter.from_data_frame(df, categorical=True, nb_classes=3)
+    assert features.shape == x.shape
+    assert labels.shape == y.shape
+
+
+def test_df_to_dataset():
+    features = np.ones((2, 10))
+    labels = np.asarray([2.0, 1.0])
+    df = adapter.to_data_frame(features, labels, categorical=False)
+    ds = adapter.df_to_dataset(df, False)
+    assert ds.count() == 2
+
+
+def test_df_to_dataset_renamed_columns():
+    features = np.ones((3, 5))
+    labels = np.asarray([0.0, 1.0, 2.0])
+    df = adapter.to_data_frame(features, labels, categorical=False)
+    df = df.rename(columns={"features": "f", "label": "l"})
+    ds = adapter.df_to_dataset(df, categorical=True, nb_classes=3,
+                               features_col="f", label_col="l")
+    assert ds.count() == 3
+    assert ds.first()[1].shape == (3,)

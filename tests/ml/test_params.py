@@ -1,0 +1,130 @@
+"""Param mixin defaults + setters (mirror of
+``/root/reference/tests/ml/test_params.py``)."""
+from elephas_tpu.ml.params import (HasBatchSize, HasCategoricalLabels,
+                                   HasCustomObjects, HasEpochs,
+                                   HasFeaturesCol, HasFrequency,
+                                   HasInferenceBatchSize, HasKerasModelConfig,
+                                   HasLabelCol, HasLoss, HasMetrics, HasMode,
+                                   HasModelConfig, HasNumberOfClasses,
+                                   HasNumberOfWorkers, HasOptimizerConfig,
+                                   HasOutputCol, HasValidationSplit,
+                                   HasVerbosity)
+
+
+def test_has_model_config():
+    param = HasModelConfig()
+    config = '{"class_name": "Sequential"}'
+    param.set_model_config(config)
+    assert param.get_model_config() == config
+    # migration alias
+    assert param.get_keras_model_config() == config
+    assert HasKerasModelConfig is HasModelConfig
+
+
+def test_has_mode():
+    param = HasMode()
+    assert param.get_mode() == "asynchronous"
+    param.set_mode("synchronous")
+    assert param.get_mode() == "synchronous"
+
+
+def test_has_frequency():
+    param = HasFrequency()
+    assert param.get_frequency() == "epoch"
+    param.set_frequency("batch")
+    assert param.get_frequency() == "batch"
+
+
+def test_has_number_of_classes():
+    param = HasNumberOfClasses()
+    assert param.get_nb_classes() == 10
+    param.set_nb_classes(42)
+    assert param.get_nb_classes() == 42
+
+
+def test_has_categorical_labels():
+    param = HasCategoricalLabels()
+    assert param.get_categorical_labels() is True
+    param.set_categorical_labels(False)
+    assert param.get_categorical_labels() is False
+
+
+def test_has_epochs():
+    param = HasEpochs()
+    assert param.get_epochs() == 10
+    param.set_epochs(3)
+    assert param.get_epochs() == 3
+
+
+def test_has_batch_size():
+    param = HasBatchSize()
+    assert param.get_batch_size() == 32
+    param.set_batch_size(64)
+    assert param.get_batch_size() == 64
+
+
+def test_has_verbosity():
+    param = HasVerbosity()
+    assert param.get_verbosity() == 0
+    param.set_verbosity(2)
+    assert param.get_verbosity() == 2
+
+
+def test_has_validation_split():
+    param = HasValidationSplit()
+    assert param.get_validation_split() == 0.1
+    param.set_validation_split(0.2)
+    assert param.get_validation_split() == 0.2
+
+
+def test_has_number_of_workers():
+    param = HasNumberOfWorkers()
+    assert param.get_num_workers() == 8
+    param.set_num_workers(2)
+    assert param.get_num_workers() == 2
+
+
+def test_has_optimizer_config():
+    param = HasOptimizerConfig()
+    assert param.get_optimizer_config() is None
+    param.set_optimizer_config({"class_name": "SGD", "config": {}})
+    assert param.get_optimizer_config()["class_name"] == "SGD"
+
+
+def test_has_metrics():
+    param = HasMetrics()
+    assert param.get_metrics() == ["acc"]
+    param.set_metrics(["mae"])
+    assert param.get_metrics() == ["mae"]
+
+
+def test_has_loss():
+    param = HasLoss()
+    param.set_loss("mse")
+    assert param.get_loss() == "mse"
+
+
+def test_has_custom_objects():
+    param = HasCustomObjects()
+    assert param.get_custom_objects() == {}
+    param.set_custom_objects({"foo": int})
+    assert param.get_custom_objects() == {"foo": int}
+
+
+def test_has_inference_batch_size():
+    param = HasInferenceBatchSize()
+    assert param.get_inference_batch_size() is None
+    param.set_inference_batch_size(128)
+    assert param.get_inference_batch_size() == 128
+
+
+def test_column_params():
+    fc, lc, oc = HasFeaturesCol(), HasLabelCol(), HasOutputCol()
+    assert fc.getFeaturesCol() == "features"
+    assert lc.getLabelCol() == "label"
+    assert oc.getOutputCol() == "prediction"
+    fc.setFeaturesCol("f")
+    lc.setLabelCol("l")
+    oc.setOutputCol("o")
+    assert (fc.getFeaturesCol(), lc.getLabelCol(), oc.getOutputCol()) == \
+        ("f", "l", "o")

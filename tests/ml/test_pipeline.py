@@ -1,0 +1,164 @@
+"""ML-pipeline tests (mirror of ``/root/reference/tests/test_ml_model.py``):
+estimator config round trips, fit -> transform flows for classification and
+regression, renamed columns, custom objects, probability outputs, batched
+inference equality, save/load."""
+import numpy as np
+import pytest
+
+from elephas_tpu.ml import (Estimator, Transformer, load_ml_estimator,
+                            load_ml_transformer, to_data_frame)
+from elephas_tpu.models import SGD, serialize_optimizer
+from elephas_tpu.utils.model_utils import ModelType
+
+
+def _class_df(mnist_data, n=400):
+    x_train, y_train, x_test, y_test = mnist_data
+    train_df = to_data_frame(x_train[:n], y_train[:n], categorical=True)
+    test_df = to_data_frame(x_test[:100], y_test[:100], categorical=True)
+    return train_df, test_df
+
+
+def _estimator(model, loss="categorical_crossentropy", **overrides):
+    config = dict(model_config=model.to_json(),
+                  optimizer_config=serialize_optimizer(SGD(learning_rate=0.1)),
+                  mode="synchronous", loss=loss, metrics=["acc"],
+                  categorical=True, nb_classes=10, epochs=8, batch_size=64,
+                  validation_split=0.1, num_workers=2, verbose=0)
+    config.update(overrides)
+    return Estimator(**config)
+
+
+def test_estimator_save_load_config(tmp_path, classification_model):
+    classification_model.build()
+    estimator = _estimator(classification_model)
+    path = str(tmp_path / "estimator.h5")
+    estimator.save(path)
+    loaded = load_ml_estimator(path)
+    assert loaded.get_config() == estimator.get_config()
+
+
+def test_classification_pipeline(mnist_data, classification_model):
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data)
+    estimator = _estimator(classification_model)
+    transformer = estimator.fit(train_df)
+    assert isinstance(transformer, Transformer)
+    result = transformer.transform(test_df)
+    assert "prediction" in result.columns
+    first = result["prediction"].iloc[0]
+    assert isinstance(first, list) and len(first) == 10
+    # probabilities
+    assert abs(sum(first) - 1.0) < 1e-3
+    # sanity: trained model does better than chance on separable data
+    correct = sum(1 for _, row in result.iterrows()
+                  if int(np.argmax(row["prediction"])) == int(row["label"]))
+    assert correct / len(result) > 0.5
+
+
+def test_classification_pipeline_functional(mnist_data,
+                                            classification_model_functional):
+    train_df, test_df = _class_df(mnist_data, n=300)
+    estimator = _estimator(classification_model_functional)
+    transformer = estimator.fit(train_df)
+    result = transformer.transform(test_df)
+    assert len(result["prediction"].iloc[0]) == 10
+
+
+def test_regression_pipeline(housing_data, regression_model):
+    x_train, y_train, x_test, y_test = housing_data
+    regression_model.build(seed=0)
+    train_df = to_data_frame(x_train, y_train, categorical=False)
+    test_df = to_data_frame(x_test, y_test, categorical=False)
+    estimator = _estimator(regression_model, loss="mse", categorical=False,
+                           metrics=["mae"], nb_classes=1,
+                           optimizer_config=serialize_optimizer(
+                               SGD(learning_rate=1e-7)))
+    transformer = estimator.fit(train_df)
+    result = transformer.transform(test_df)
+    assert "prediction" in result.columns
+    assert isinstance(result["prediction"].iloc[0], float)
+
+
+def test_renamed_columns_constructor(mnist_data, classification_model):
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    train_df = train_df.rename(columns={"features": "f", "label": "l"})
+    test_df = test_df.rename(columns={"features": "f", "label": "l"})
+    estimator = _estimator(classification_model, featuresCol="f", labelCol="l",
+                           outputCol="out")
+    transformer = estimator.fit(train_df)
+    result = transformer.transform(test_df)
+    assert "out" in result.columns
+
+
+def test_renamed_columns_deprecated_setters(mnist_data, classification_model):
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    train_df = train_df.rename(columns={"features": "f", "label": "l"})
+    test_df = test_df.rename(columns={"features": "f", "label": "l"})
+    estimator = _estimator(classification_model)
+    with pytest.deprecated_call():
+        estimator.setFeaturesCol("f")
+    with pytest.deprecated_call():
+        estimator.setLabelCol("l")
+    with pytest.deprecated_call():
+        estimator.setOutputCol("out")
+    transformer = estimator.fit(train_df)
+    result = transformer.transform(test_df)
+    assert "out" in result.columns
+
+
+def test_custom_objects_in_estimator(mnist_data):
+    import jax
+
+    from elephas_tpu.models import Dense, Sequential
+
+    def custom_activation(x):
+        return jax.nn.sigmoid(x) + 1
+
+    model = Sequential([Dense(32, input_dim=784, activation=custom_activation),
+                        Dense(10, activation="softmax")])
+    model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    estimator = _estimator(model)
+    estimator.set_custom_objects({"custom_activation": custom_activation})
+    transformer = estimator.fit(train_df)
+    result = transformer.transform(test_df)
+    assert len(result["prediction"].iloc[0]) == 10
+
+
+def test_batched_vs_unbatched_inference_equal(mnist_data,
+                                              classification_model):
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    estimator = _estimator(classification_model)
+    transformer = estimator.fit(train_df)
+
+    unbatched = transformer.transform(test_df)
+    transformer.set_inference_batch_size(17)
+    batched = transformer.transform(test_df)
+    for a, b in zip(unbatched["prediction"], batched["prediction"]):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_transformer_save_load(tmp_path, mnist_data, classification_model):
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    estimator = _estimator(classification_model)
+    transformer = estimator.fit(train_df)
+    path = str(tmp_path / "transformer.h5")
+    transformer.save(path)
+    loaded = load_ml_transformer(path)
+    assert loaded.model_type == ModelType.CLASSIFICATION
+    a = transformer.transform(test_df)
+    b = loaded.transform(test_df)
+    for pa, pb in zip(a["prediction"], b["prediction"]):
+        np.testing.assert_allclose(pa, pb, atol=1e-5)
+
+
+def test_model_type_from_loss():
+    from elephas_tpu.utils.model_utils import LossModelTypeMapper
+
+    assert LossModelTypeMapper().get_model_type("mse") == ModelType.REGRESSION
+    assert (LossModelTypeMapper().get_model_type("categorical_crossentropy")
+            == ModelType.CLASSIFICATION)

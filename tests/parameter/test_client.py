@@ -1,0 +1,13 @@
+import pytest
+
+from elephas_tpu.parameter import BaseParameterClient, HttpClient, SocketClient
+
+
+def test_client_factory_dispatch():
+    assert isinstance(BaseParameterClient.get_client("http", 4000), HttpClient)
+    assert isinstance(BaseParameterClient.get_client("socket", 4000), SocketClient)
+
+
+def test_client_factory_unknown():
+    with pytest.raises(ValueError):
+        BaseParameterClient.get_client("carrier-pigeon", 4000)

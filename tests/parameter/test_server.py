@@ -1,0 +1,107 @@
+"""Direct parameter-server tests (the reference left these as a TODO stub,
+``/root/reference/tests/parameter/test_server.py:1``)."""
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu.models import Dense, SGD, Sequential
+from elephas_tpu.parameter import (HttpClient, HttpServer, SocketClient,
+                                   SocketServer)
+from elephas_tpu.utils.serialization import model_to_dict
+
+_PORT = [5100]
+
+
+def _next_port():
+    _PORT[0] += 1
+    return _PORT[0]
+
+
+def _serialized_model():
+    model = Sequential([Dense(4, input_dim=3), Dense(1)])
+    model.compile(SGD(learning_rate=0.1), "mse", seed=1)
+    return model_to_dict(model)
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_get_and_update_parameters(server_cls, client_cls):
+    port = _next_port()
+    payload = _serialized_model()
+    server = server_cls(payload, port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port)
+        weights = client.get_parameters()
+        assert len(weights) == len(payload["weights"])
+        for got, want in zip(weights, payload["weights"]):
+            np.testing.assert_allclose(got, np.asarray(want), atol=1e-6)
+
+        delta = [np.ones_like(np.asarray(w)) for w in weights]
+        client.update_parameters(delta)
+        updated = client.get_parameters()
+        for got, before in zip(updated, weights):
+            np.testing.assert_allclose(got, np.asarray(before) - 1.0, atol=1e-6)
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_concurrent_updates_all_applied(server_cls, client_cls):
+    """asynchronous mode: every delta must be applied exactly once."""
+    port = _next_port()
+    payload = _serialized_model()
+    server = server_cls(payload, port, "asynchronous")
+    server.start()
+    try:
+        initial = [np.asarray(w).copy() for w in payload["weights"]]
+        n_threads, n_updates = 4, 8
+
+        def pusher():
+            client = client_cls(port)
+            for _ in range(n_updates):
+                client.update_parameters(
+                    [np.ones_like(w) for w in initial])
+
+        threads = [threading.Thread(target=pusher) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        final = client_cls(port).get_parameters()
+        total = n_threads * n_updates
+        for got, start in zip(final, initial):
+            np.testing.assert_allclose(got, start - total, atol=1e-5)
+    finally:
+        server.stop()
+
+
+def test_socket_server_restart():
+    port = _next_port()
+    server = SocketServer(_serialized_model(), port, "asynchronous")
+    server.start()
+    server.stop()
+    server.start()
+    try:
+        client = SocketClient(port)
+        assert len(client.get_parameters()) == 4
+    finally:
+        server.stop()
+
+
+def test_hogwild_mode_lock_free_still_serves():
+    port = _next_port()
+    server = HttpServer(_serialized_model(), port, "hogwild")
+    server.start()
+    try:
+        client = HttpClient(port)
+        client.update_parameters([np.zeros_like(np.asarray(w))
+                                  for w in client.get_parameters()])
+        assert len(client.get_parameters()) == 4
+    finally:
+        server.stop()

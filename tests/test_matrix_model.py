@@ -1,0 +1,27 @@
+"""TPUMatrixModel (LabeledPoint training) tests — mirror of
+``/root/reference/tests/test_mllib_model.py``."""
+import numpy as np
+
+from elephas_tpu.mllib import to_matrix, to_vector
+from elephas_tpu.models import SGD
+from elephas_tpu.tpu_model import TPUMatrixModel
+from elephas_tpu.utils.dataset_utils import to_labeled_points
+
+
+def test_matrix_model_training_and_predict(mnist_data, classification_model):
+    x_train, y_train, x_test, _ = mnist_data
+    x_train, y_train = x_train[:400], y_train[:400]
+    classification_model.compile(SGD(learning_rate=0.1),
+                                 "categorical_crossentropy", ["acc"], seed=0)
+
+    lp_ds = to_labeled_points(x_train, y_train, categorical=True)
+    model = TPUMatrixModel(classification_model, mode="synchronous",
+                           num_workers=2)
+    model.fit(lp_ds, epochs=2, batch_size=32, verbose=0,
+              validation_split=0.1, categorical=True, nb_classes=10)
+
+    matrix_preds = model.predict(to_matrix(x_test[:16]))
+    assert matrix_preds.toArray().shape == (16, 10)
+
+    vector_preds = model.predict(to_vector(x_test[0]))
+    assert len(vector_preds) == 10

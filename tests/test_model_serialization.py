@@ -1,0 +1,56 @@
+"""Distributed model save/load round trips (mirror of
+``/root/reference/tests/test_model_serialization.py``)."""
+import numpy as np
+
+from elephas_tpu.models import SGD, Activation, Dense, Dropout, Input, Model, Sequential
+from elephas_tpu.tpu_model import TPUMatrixModel, TPUModel, load_tpu_model
+
+
+def test_tpu_model_save_load_sequential(tmp_path, classification_model):
+    classification_model.compile(SGD(), "categorical_crossentropy", ["acc"], seed=0)
+    tpu_model = TPUModel(classification_model, frequency="epoch",
+                         mode="synchronous")
+    path = str(tmp_path / "elephas_sequential.h5")
+    tpu_model.save(path)
+    loaded = load_tpu_model(path)
+    assert isinstance(loaded, TPUModel)
+    assert loaded.mode == "synchronous"
+    assert loaded.frequency == "epoch"
+    x = np.random.default_rng(0).random((4, 784), dtype=np.float32)
+    np.testing.assert_allclose(loaded.master_network.predict(x),
+                               classification_model.predict(x), atol=1e-5)
+
+
+def test_tpu_model_save_load_extra_kwargs(tmp_path, classification_model):
+    classification_model.compile(SGD(), "categorical_crossentropy", ["acc"], seed=0)
+    tpu_model = TPUModel(classification_model, mode="synchronous",
+                         custom_metadata="experiment-7")
+    path = str(tmp_path / "with_kwargs.h5")
+    tpu_model.save(path)
+    loaded = load_tpu_model(path)
+    assert loaded.kwargs.get("custom_metadata") == "experiment-7"
+
+
+def test_tpu_model_save_load_functional(tmp_path,
+                                        classification_model_functional):
+    classification_model_functional.compile(
+        SGD(), "categorical_crossentropy", ["acc"], seed=0)
+    tpu_model = TPUModel(classification_model_functional, mode="synchronous")
+    path = str(tmp_path / "functional.h5")
+    tpu_model.save(path)
+    loaded = load_tpu_model(path)
+    x = np.random.default_rng(0).random((4, 784), dtype=np.float32)
+    np.testing.assert_allclose(loaded.master_network.predict(x),
+                               classification_model_functional.predict(x),
+                               atol=1e-5)
+
+
+def test_matrix_model_save_load(tmp_path, classification_model):
+    classification_model.compile(SGD(), "categorical_crossentropy", ["acc"], seed=0)
+    model = TPUMatrixModel(classification_model, mode="synchronous",
+                           num_workers=2)
+    path = str(tmp_path / "matrix.h5")
+    model.save(path)
+    loaded = load_tpu_model(path)
+    assert isinstance(loaded, TPUMatrixModel)
+    assert loaded.num_workers == 2

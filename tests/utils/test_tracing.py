@@ -1,0 +1,33 @@
+import time
+
+from elephas_tpu.utils.tracing import StepTimer, profiler_trace
+
+
+def test_step_timer_collects_durations():
+    timer = StepTimer()
+    for _ in range(3):
+        with timer:
+            time.sleep(0.01)
+    assert len(timer.durations) == 3
+    assert timer.mean >= 0.01
+    summary = timer.summary()
+    assert summary["steps"] == 3
+    assert summary["p50_s"] >= 0.01
+    assert timer.samples_per_sec(64) > 0
+
+
+def test_profiler_trace_noop_without_logdir():
+    with profiler_trace(None):
+        pass  # must not raise
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "trace")
+    with profiler_trace(logdir):
+        jnp.ones(4).sum().block_until_ready()
+    import os
+
+    assert os.path.exists(logdir)
