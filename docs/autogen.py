@@ -1,0 +1,124 @@
+"""Introspection-based documentation generator.
+
+Walks the public API and renders one markdown page per module from
+docstrings and signatures (capability mirror of the reference's
+``docs/autogen.py`` mkdocs generator). Output goes to ``docs/sources/``;
+``docs/mkdocs.yml`` holds the nav.
+
+Usage: ``python docs/autogen.py``
+"""
+import inspect
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+PAGES = [
+    ("TPUModel", "elephas_tpu.tpu_model",
+     ["TPUModel", "TPUMatrixModel", "load_tpu_model"]),
+    ("Models", "elephas_tpu.models.core",
+     ["Sequential", "Model", "BaseModel", "model_from_json"]),
+    ("Layers", "elephas_tpu.models.layers",
+     ["Dense", "Activation", "Dropout", "Flatten", "Reshape", "Conv2D",
+      "MaxPooling2D", "AveragePooling2D", "GlobalAveragePooling2D",
+      "Embedding", "LayerNormalization", "BatchNormalization", "Add",
+      "Multiply", "Concatenate", "Input"]),
+    ("Optimizers", "elephas_tpu.models.optimizers",
+     ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "Adadelta", "Nadam"]),
+    ("Workers", "elephas_tpu.worker", ["SyncWorker", "AsyncWorker"]),
+    ("Parameter servers", "elephas_tpu.parameter.server",
+     ["BaseParameterServer", "HttpServer", "SocketServer"]),
+    ("Parameter clients", "elephas_tpu.parameter.client",
+     ["BaseParameterClient", "HttpClient", "SocketClient"]),
+    ("Parallel trainers", "elephas_tpu.parallel.sync_trainer",
+     ["SyncAverageTrainer", "SyncStepTrainer", "build_sharded_predict",
+      "build_sharded_evaluate"]),
+    ("Mesh utilities", "elephas_tpu.parallel.mesh",
+     ["worker_mesh", "data_mesh", "make_mesh", "shard_leading", "replicate"]),
+    ("Multi-host", "elephas_tpu.parallel.multihost",
+     ["initialize_multihost", "is_coordinator", "host_local_slice",
+      "global_batch_from_host_data"]),
+    ("ML pipeline", "elephas_tpu.ml.pipeline",
+     ["Estimator", "Transformer", "load_ml_estimator", "load_ml_transformer"]),
+    ("DataFrame adapters", "elephas_tpu.ml.adapter",
+     ["to_data_frame", "from_data_frame", "df_to_dataset"]),
+    ("Datasets", "elephas_tpu.data.dataset", ["Dataset"]),
+    ("Dataset utilities", "elephas_tpu.utils.dataset_utils",
+     ["to_dataset", "to_labeled_points", "from_labeled_points",
+      "lp_to_dataset", "encode_label"]),
+    ("Linalg", "elephas_tpu.mllib.linalg",
+     ["DenseVector", "DenseMatrix", "LabeledPoint", "Vectors", "Matrices"]),
+    ("Attention ops", "elephas_tpu.ops.attention",
+     ["attention", "blockwise_attention"]),
+    ("Ring attention", "elephas_tpu.ops.ring_attention",
+     ["ring_attention", "ring_attention_sharded"]),
+    ("Transformer", "elephas_tpu.models.transformer",
+     ["TransformerConfig", "init_params", "param_specs", "forward",
+      "lm_loss", "make_train_step", "shard_params"]),
+    ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
+    ("Tracing", "elephas_tpu.utils.tracing",
+     ["StepTimer", "profiler_trace", "annotate"]),
+    ("Wire codec", "elephas_tpu.utils.tensor_codec",
+     ["encode_tensors", "decode_tensors", "encode", "decode"]),
+]
+
+
+def _doc(obj) -> str:
+    return inspect.getdoc(obj) or "*(no docstring)*"
+
+
+def _signature(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def render_page(title: str, module_name: str, names) -> str:
+    import importlib
+
+    module = importlib.import_module(module_name)
+    lines = [f"# {title}", "", f"`{module_name}`", ""]
+    if module.__doc__:
+        lines += [inspect.cleandoc(module.__doc__), ""]
+    for name in names:
+        obj = getattr(module, name)
+        lines.append(f"## {name}")
+        lines.append("")
+        if inspect.isclass(obj):
+            lines.append(f"```python\n{name}{_signature(obj.__init__)}\n```")
+            lines += ["", _doc(obj), ""]
+            for meth_name, meth in sorted(vars(obj).items()):
+                if meth_name.startswith("_") or not callable(meth):
+                    continue
+                lines.append(f"### {name}.{meth_name}")
+                lines.append(f"```python\n{meth_name}{_signature(meth)}\n```")
+                lines += ["", _doc(meth), ""]
+        elif callable(obj):
+            lines.append(f"```python\n{name}{_signature(obj)}\n```")
+            lines += ["", _doc(obj), ""]
+        else:
+            lines += [_doc(obj), ""]
+    return "\n".join(lines)
+
+
+def main(out_dir: str = None):
+    out = Path(out_dir) if out_dir else ROOT / "docs" / "sources"
+    out.mkdir(parents=True, exist_ok=True)
+    nav = []
+    for title, module_name, names in PAGES:
+        slug = title.lower().replace(" ", "-").replace("/", "-")
+        (out / f"{slug}.md").write_text(render_page(title, module_name, names))
+        nav.append((title, f"{slug}.md"))
+        print(f"wrote {slug}.md")
+    mkdocs = ["site_name: elephas_tpu", "nav:", "  - Home: index.md"]
+    mkdocs += [f"  - {title}: {page}" for title, page in nav]
+    (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")
+    index = ROOT / "README.md"
+    (out / "index.md").write_text(index.read_text())
+    print("wrote mkdocs.yml and index.md")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

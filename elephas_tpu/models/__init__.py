@@ -10,4 +10,5 @@ from .optimizers import (SGD, Adadelta, Adagrad, Adam, AdamW, Nadam,
 from .optimizers import deserialize as deserialize_optimizer
 from .optimizers import get as get_optimizer
 from .optimizers import serialize as serialize_optimizer
+from .resnet import build_resnet, build_resnet8
 from .saving import load_model, save_model

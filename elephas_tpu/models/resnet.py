@@ -1,0 +1,73 @@
+"""ResNet model family built on the functional layer API.
+
+CIFAR-style residual networks (He et al.) assembled from the framework's
+own layers — Conv2D/BatchNorm/Add — exercising the functional graph,
+merge layers and batch-stat threading end to end. NHWC layout, MXU-sized
+channel counts.
+"""
+from typing import Optional, Tuple
+
+from .core import Model
+from .layers import (Activation, Add, BatchNormalization, Conv2D, Dense,
+                     GlobalAveragePooling2D, Input)
+
+
+def _conv_bn_relu(x, filters, kernel_size=3, strides=1, activation=True,
+                  name=None):
+    x = Conv2D(filters, kernel_size, strides=strides, padding="same",
+               use_bias=False, name=None if name is None else name + "_conv")(x)
+    x = BatchNormalization(name=None if name is None else name + "_bn")(x)
+    if activation:
+        x = Activation("relu",
+                       name=None if name is None else name + "_relu")(x)
+    return x
+
+
+def _basic_block(x, filters, strides=1, name=None):
+    shortcut = x
+    y = _conv_bn_relu(x, filters, strides=strides,
+                      name=None if name is None else name + "_a")
+    y = _conv_bn_relu(y, filters, activation=False,
+                      name=None if name is None else name + "_b")
+    if strides != 1 or x.shape[-1] != filters:
+        shortcut = Conv2D(filters, 1, strides=strides, padding="same",
+                          use_bias=False,
+                          name=None if name is None else name + "_proj")(x)
+        shortcut = BatchNormalization(
+            name=None if name is None else name + "_proj_bn")(shortcut)
+    out = Add(name=None if name is None else name + "_add")([y, shortcut])
+    return Activation("relu",
+                      name=None if name is None else name + "_out")(out)
+
+
+def build_resnet(input_shape: Tuple[int, int, int] = (32, 32, 3),
+                 num_classes: int = 10, depth: int = 20,
+                 width: int = 16, name: Optional[str] = None) -> Model:
+    """CIFAR-style ResNet: ``depth`` must be 6n+2 (20, 32, 44, 56...)."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("depth must be 6n+2 (e.g. 20, 32, 44)")
+    blocks_per_stage = (depth - 2) // 6
+
+    inputs = Input(shape=input_shape)
+    x = _conv_bn_relu(inputs, width)
+    filters = width
+    for stage in range(3):
+        for block in range(blocks_per_stage):
+            strides = 2 if stage > 0 and block == 0 else 1
+            x = _basic_block(x, filters, strides=strides)
+        filters *= 2
+    x = GlobalAveragePooling2D()(x)
+    outputs = Dense(num_classes, activation="softmax")(x)
+    return Model(inputs=inputs, outputs=outputs, name=name or f"resnet{depth}")
+
+
+def build_resnet8(input_shape=(32, 32, 3), num_classes=10) -> Model:
+    """Tiny 8-layer variant for tests/smoke runs."""
+    inputs = Input(shape=input_shape)
+    x = _conv_bn_relu(inputs, 16)
+    x = _basic_block(x, 16)
+    x = _basic_block(x, 32, strides=2)
+    x = _basic_block(x, 64, strides=2)
+    x = GlobalAveragePooling2D()(x)
+    outputs = Dense(num_classes, activation="softmax")(x)
+    return Model(inputs=inputs, outputs=outputs, name="resnet8")

@@ -1,0 +1,110 @@
+"""Multi-host (DCN) execution helpers.
+
+Scaling past one TPU host follows the single-controller JAX recipe
+(SURVEY.md §7 step 7): every host runs the same program,
+``jax.distributed.initialize`` wires the processes together over DCN, the
+global mesh spans all hosts' devices (collectives ride ICI within a slice
+and DCN across), and the parameter server for async modes binds on the
+coordinator host (process 0) — workers reach it via
+``ELEPHAS_TPU_MASTER_IP``.
+
+Data is host-sharded: each process loads only its slice of the dataset
+(:func:`host_local_slice`) and builds global arrays with
+``jax.make_array_from_process_local_data``.
+"""
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None):
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Arguments default to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID``) and to TPU-pod auto-detection when none are set.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None:
+        try:
+            jax.distributed.initialize()  # TPU-pod auto-detection
+        except Exception:
+            pass  # single-process run
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id=process_id if process_id is not None
+        else int(os.environ.get("JAX_PROCESS_ID", "0")))
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — where the parameter server and checkpoint
+    writes live."""
+    return jax.process_index() == 0
+
+
+def coordinator_bind_env(port: int = 4000) -> Optional[str]:
+    """Share the coordinator's address with every process.
+
+    Process 0 resolves its own IP and broadcasts it to all hosts (env vars
+    do not cross host boundaries); every process then sets
+    ``ELEPHAS_TPU_MASTER_IP`` locally so ``determine_master`` resolves the
+    parameter server to the coordinator. Single-process runs just set the
+    local env var.
+    """
+    import socket as pysocket
+
+    if "ELEPHAS_TPU_MASTER_IP" in os.environ:
+        return os.environ["ELEPHAS_TPU_MASTER_IP"]
+
+    if is_coordinator():
+        try:
+            host = pysocket.gethostbyname(pysocket.gethostname())
+        except pysocket.gaierror:
+            host = "127.0.0.1"
+    else:
+        host = ""
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        encoded = np.zeros(64, dtype=np.uint8)
+        raw = host.encode("utf8")[:64]
+        encoded[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        encoded = multihost_utils.broadcast_one_to_all(encoded)
+        host = bytes(np.asarray(encoded)).rstrip(b"\x00").decode("utf8")
+
+    os.environ["ELEPHAS_TPU_MASTER_IP"] = host
+    return host
+
+
+def global_data_mesh() -> Mesh:
+    """1-D ``data`` mesh over every device of every host."""
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def host_local_slice(n: int) -> Tuple[int, int]:
+    """Row range [lo, hi) of a length-``n`` dataset this host should load
+    (contiguous, balanced across processes)."""
+    p = jax.process_count()
+    i = jax.process_index()
+    base, extra = divmod(n, p)
+    lo = i * base + min(i, extra)
+    return lo, lo + base + (1 if i < extra else 0)
+
+
+def global_batch_from_host_data(mesh: Mesh, host_array: np.ndarray,
+                                axis: str = "data"):
+    """Assemble a globally-sharded array from per-host local rows."""
+    spec = PartitionSpec(axis, *([None] * (host_array.ndim - 1)))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), host_array)

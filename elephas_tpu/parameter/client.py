@@ -11,8 +11,7 @@ from typing import List
 import numpy as np
 
 from ..utils.sockets import determine_master, receive, send
-from ..utils.tensor_codec import (KIND_DELTA, decode_weights, encode_tensors,
-                                  encode_weights)
+from ..utils.tensor_codec import KIND_DELTA, decode_weights, encode
 
 
 class BaseParameterClient(abc.ABC):
@@ -62,7 +61,7 @@ class HttpClient(BaseParameterClient):
     def update_parameters(self, delta: List[np.ndarray]):
         request = urllib.request.Request(
             f"http://{self.master_url}/update",
-            encode_tensors(delta, KIND_DELTA), headers=self.headers)
+            bytes(encode(delta, KIND_DELTA)), headers=self.headers)
         with urllib.request.urlopen(request, timeout=self.timeout) as response:
             return response.read()
 

@@ -111,7 +111,12 @@ class HttpServer(BaseParameterServer):
                     self.end_headers()
                     return
                 length = int(self.headers.get("Content-Length", "0"))
-                delta = decode_weights(self.rfile.read(length))
+                try:
+                    delta = decode_weights(self.rfile.read(length))
+                except Exception:  # malformed payload -> clean 400, not a 500
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 server.apply_delta(delta)
                 body = b"Update done"
                 self.send_response(200)

@@ -1,0 +1,185 @@
+"""ctypes bindings for the native ETPU codec (``native/etpu_codec.cpp``).
+
+The Python codec in :mod:`.tensor_codec` is the canonical spec and always
+available; this module loads the C++ implementation when built (run
+``native/build.sh`` or :func:`build`) and exposes byte-identical
+encode/decode plus single-syscall-loop framed socket I/O. The parameter
+server layer uses it transparently when present.
+"""
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .tensor_codec import _CODE_DTYPES, _DTYPE_CODES, CodecError, KIND_WEIGHTS
+
+_LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libetpu.so"
+_lib = None
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library with g++; returns True on success."""
+    if _LIB_PATH.exists() and not force:
+        return True
+    script = _LIB_PATH.parent / "build.sh"
+    try:
+        subprocess.run(["sh", str(script)], check=True, capture_output=True)
+        return _LIB_PATH.exists()
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.etpu_encoded_size.restype = ctypes.c_int64
+    lib.etpu_encoded_size.argtypes = [ctypes.c_int32, ctypes.c_char_p,
+                                      ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+    lib.etpu_encode.restype = ctypes.c_int32
+    lib.etpu_encode.argtypes = [ctypes.c_int32,
+                                ctypes.POINTER(ctypes.c_void_p),
+                                ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.c_uint8, ctypes.c_char_p]
+    lib.etpu_decode_probe.restype = ctypes.c_int32
+    lib.etpu_decode_probe.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int32),
+                                      ctypes.POINTER(ctypes.c_int32),
+                                      ctypes.POINTER(ctypes.c_uint8)]
+    lib.etpu_decode_describe.restype = ctypes.c_int32
+    lib.etpu_decode_describe.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                         ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.POINTER(ctypes.c_uint64),
+                                         ctypes.POINTER(ctypes.c_int64)]
+    lib.etpu_send_frame.restype = ctypes.c_int32
+    # accept any buffer (bytes OR the zero-copy bytearray encode returns)
+    lib.etpu_send_frame.argtypes = [ctypes.c_int32, ctypes.c_void_p,
+                                    ctypes.c_int64]
+    lib.etpu_recv_frame_len.restype = ctypes.c_int64
+    lib.etpu_recv_frame_len.argtypes = [ctypes.c_int32]
+    lib.etpu_recv_frame_body.restype = ctypes.c_int32
+    lib.etpu_recv_frame_body.argtypes = [ctypes.c_int32, ctypes.c_char_p,
+                                         ctypes.c_int64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _describe_arrays(arrays: Sequence[np.ndarray]):
+    normalized = []
+    codes = bytearray()
+    ndims = bytearray()
+    dims: List[int] = []
+    for arr in arrays:
+        arr = np.asarray(arr)
+        if arr.dtype not in _DTYPE_CODES:
+            arr = arr.astype(np.float32)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        normalized.append(arr)
+        codes.append(_DTYPE_CODES[arr.dtype])
+        ndims.append(arr.ndim)
+        dims.extend(int(d) for d in arr.shape)
+    dims_arr = (ctypes.c_uint64 * max(len(dims), 1))(*dims)
+    return normalized, bytes(codes), bytes(ndims), dims_arr
+
+
+def encode_tensors_native(arrays: Sequence[np.ndarray],
+                          kind: int = KIND_WEIGHTS) -> Optional[bytes]:
+    """Native encode; returns None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arrays, codes, ndims, dims = _describe_arrays(arrays)
+    size = lib.etpu_encoded_size(len(arrays), codes, ndims, dims)
+    if size < 0:
+        raise CodecError("native encode: bad dtype")
+    out = bytearray(size)
+    buf = (ctypes.c_char * size).from_buffer(out)
+    ptrs = (ctypes.c_void_p * max(len(arrays), 1))()
+    for i, arr in enumerate(arrays):
+        ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p)
+    if lib.etpu_encode(len(arrays), ptrs, codes, ndims, dims, kind, buf) != 0:
+        raise CodecError("native encode failed")
+    del buf  # release the exported buffer so the bytearray is usable
+    return out  # bytearray: bytes-like for sendall/urllib without a copy
+
+
+def decode_tensors_native(payload: bytes) -> Optional[Tuple[List[np.ndarray], int]]:
+    """Native decode; returns None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    count = ctypes.c_int32()
+    total_dims = ctypes.c_int32()
+    kind = ctypes.c_uint8()
+    rc = lib.etpu_decode_probe(payload, len(payload), ctypes.byref(count),
+                               ctypes.byref(total_dims), ctypes.byref(kind))
+    if rc != 0:
+        raise CodecError(f"native decode: malformed payload (code {rc})")
+    n = count.value
+    codes = ctypes.create_string_buffer(max(n, 1))
+    ndims = ctypes.create_string_buffer(max(n, 1))
+    dims = (ctypes.c_uint64 * max(total_dims.value, 1))()
+    offsets = (ctypes.c_int64 * max(n, 1))()
+    lib.etpu_decode_describe(payload, len(payload), codes, ndims, dims, offsets)
+    arrays = []
+    dim_pos = 0
+    for i in range(n):
+        code = codes.raw[i]
+        ndim = ndims.raw[i]
+        shape = tuple(dims[dim_pos:dim_pos + ndim])
+        dim_pos += ndim
+        dtype = _CODE_DTYPES[code]
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if ndim \
+            else dtype.itemsize
+        start = offsets[i]
+        arr = np.frombuffer(payload[start:start + nbytes],
+                            dtype=dtype).reshape(shape).copy()
+        arrays.append(arr)
+    return arrays, kind.value
+
+
+def send_frame_native(fd: int, payload) -> bool:
+    """Send one frame; ``payload`` may be bytes or bytearray (zero copy)."""
+    lib = _load()
+    if lib is None:
+        return False
+    if isinstance(payload, bytearray):
+        buf = (ctypes.c_char * len(payload)).from_buffer(payload)
+        rc = lib.etpu_send_frame(fd, ctypes.cast(buf, ctypes.c_void_p),
+                                 len(payload))
+        del buf
+    else:
+        data = bytes(payload)  # held alive for the duration of the call
+        rc = lib.etpu_send_frame(fd, data, len(data))
+    if rc != 0:
+        raise ConnectionError("native send_frame failed")
+    return True
+
+
+def recv_frame_native(fd: int) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    length = lib.etpu_recv_frame_len(fd)
+    if length < 0:
+        raise ConnectionError("socket closed while reading frame")
+    if length > (1 << 34):
+        raise ConnectionError(f"frame length {length} exceeds limit")
+    out = bytearray(int(length))
+    buf = (ctypes.c_char * int(length)).from_buffer(out)
+    if lib.etpu_recv_frame_body(fd, buf, length) != 0:
+        raise ConnectionError("socket closed while reading frame body")
+    del buf
+    return bytes(out)  # decode slices this; one copy to immutable bytes

@@ -18,9 +18,12 @@ from typing import List, Sequence
 
 import numpy as np
 
-from .tensor_codec import decode_tensors, encode_tensors, KIND_WEIGHTS
+from .tensor_codec import KIND_WEIGHTS, decode, encode
 
 LENGTH_BYTES = 8
+#: refuse frames above this size — a corrupt length prefix must not drive a
+#: multi-GB allocation
+MAX_FRAME_BYTES = 1 << 34
 
 
 def determine_master(port: int = 4000) -> str:
@@ -51,15 +54,46 @@ def _receive_all(sock: socket.socket, num_bytes: int) -> bytes:
     return b"".join(chunks)
 
 
+def _use_native(sock: socket.socket) -> bool:
+    """Native framing only on blocking sockets (Python timeouts put the fd
+    in non-blocking mode, which the native loops do not handle)."""
+    if sock.gettimeout() is not None:
+        return False
+    from . import native
+
+    return native.available()
+
+
 def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS):
-    """Send a list of arrays as one length-prefixed ETPU frame."""
-    payload = encode_tensors(arrays, kind)
+    """Send a list of arrays as one length-prefixed ETPU frame.
+
+    Uses the native C++ codec + single-syscall-loop framing when built and
+    the socket is in blocking mode.
+    """
+    payload = encode(arrays, kind)
+    if _use_native(sock):
+        from . import native
+
+        native.send_frame_native(sock.fileno(), payload)
+        return
     sock.sendall(len(payload).to_bytes(LENGTH_BYTES, "little"))
     sock.sendall(payload)
 
 
 def receive(sock: socket.socket) -> List[np.ndarray]:
-    """Receive one length-prefixed ETPU frame; returns the array list."""
+    """Receive one length-prefixed ETPU frame; returns the array list.
+
+    The transport is chosen up front (native or Python) and errors
+    propagate: once any bytes of a frame are consumed, falling back to the
+    other implementation would desync the stream.
+    """
+    if _use_native(sock):
+        from . import native
+
+        arrays, _ = decode(native.recv_frame_native(sock.fileno()))
+        return arrays
     length = int.from_bytes(_receive_all(sock, LENGTH_BYTES), "little")
-    arrays, _ = decode_tensors(_receive_all(sock, length))
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {length} exceeds limit")
+    arrays, _ = decode(_receive_all(sock, length))
     return arrays

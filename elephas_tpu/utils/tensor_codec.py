@@ -93,7 +93,12 @@ def decode_tensors(payload: bytes) -> tuple:
         dims = struct.unpack_from("<%dQ" % ndim, payload, offset)
         offset += 8 * ndim
         dtype = _CODE_DTYPES[code]
-        nbytes = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize if ndim else dtype.itemsize
+        count_elems = 1
+        for d in dims:  # python ints: no silent overflow on hostile dims
+            count_elems *= d
+        if count_elems > (1 << 40):
+            raise CodecError("tensor too large / hostile dims")
+        nbytes = count_elems * dtype.itemsize
         if offset + nbytes > len(payload):
             raise CodecError("truncated tensor body")
         arr = np.frombuffer(payload[offset:offset + nbytes], dtype=dtype).reshape(dims)
@@ -102,10 +107,40 @@ def decode_tensors(payload: bytes) -> tuple:
     return arrays, kind
 
 
+def encode(arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS) -> bytes:
+    """Encode, preferring the native C++ implementation when built."""
+    try:
+        from . import native
+
+        out = native.encode_tensors_native(arrays, kind)
+        if out is not None:
+            return out
+    except CodecError:
+        raise
+    except Exception:
+        pass
+    return encode_tensors(arrays, kind)
+
+
+def decode(payload: bytes) -> tuple:
+    """Decode, preferring the native C++ implementation when built."""
+    try:
+        from . import native
+
+        out = native.decode_tensors_native(payload)
+        if out is not None:
+            return out
+    except CodecError:
+        raise
+    except Exception:
+        pass
+    return decode_tensors(payload)
+
+
 def encode_weights(weights: Sequence[np.ndarray]) -> bytes:
-    return encode_tensors(weights, KIND_WEIGHTS)
+    return encode(weights, KIND_WEIGHTS)
 
 
 def decode_weights(payload: bytes) -> List[np.ndarray]:
-    arrays, _ = decode_tensors(payload)
+    arrays, _ = decode(payload)
     return arrays

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native ETPU codec library in place.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -shared -fPIC -std=c++17 -o libetpu.so etpu_codec.cpp
+echo "built $(pwd)/libetpu.so"

@@ -1,0 +1,228 @@
+// ETPU typed tensor wire codec + framed socket I/O — native implementation.
+//
+// Same wire format as elephas_tpu/utils/tensor_codec.py (the canonical
+// spec):
+//   header:  "ETPU" | u8 version | u8 kind | u32 count        (little endian)
+//   tensor:  u8 dtype-code | u8 ndim | u64[ndim] dims | raw LE bytes
+//
+// The Python layer hands raw pointers via ctypes; this library does the
+// header packing/parsing and bulk memcpy in one pass, and provides
+// single-loop framed send/recv over a connected socket fd so large weight
+// payloads move without Python-level chunk bookkeeping.
+//
+// Build: see native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#include <sys/socket.h>
+#include <unistd.h>
+#include <errno.h>
+
+extern "C" {
+
+static const char MAGIC[4] = {'E', 'T', 'P', 'U'};
+static const uint8_t VERSION = 1;
+
+// dtype code -> element size in bytes; must match tensor_codec._DTYPE_CODES
+static const int64_t ITEM_SIZES[] = {
+    4,  // 0 float32
+    8,  // 1 float64
+    4,  // 2 int32
+    8,  // 3 int64
+    1,  // 4 uint8
+    1,  // 5 bool
+    2,  // 6 float16
+    1,  // 7 int8
+    4,  // 8 uint32
+    8,  // 9 uint64
+    2,  // 10 bfloat16
+};
+static const int NUM_DTYPES = 11;
+
+// Largest sane per-dimension extent / element count (2^40). Anything above
+// is a malformed or hostile payload, not a real tensor.
+static const uint64_t MAX_EXTENT = (uint64_t)1 << 40;
+
+static int64_t num_elements(const uint64_t* dims, uint8_t ndim) {
+    uint64_t n = 1;
+    for (uint8_t i = 0; i < ndim; ++i) {
+        uint64_t d = dims[i];
+        if (d > MAX_EXTENT) return -1;
+        if (d != 0 && n > MAX_EXTENT / (d ? d : 1)) return -1;
+        n *= d;
+    }
+    if (n > MAX_EXTENT) return -1;
+    return (int64_t)n;
+}
+
+// Total payload size for an array list described by parallel arrays.
+// dims_flat holds each tensor's dims consecutively (sum(ndims) entries).
+int64_t etpu_encoded_size(int32_t count, const uint8_t* dtype_codes,
+                          const uint8_t* ndims, const uint64_t* dims_flat) {
+    int64_t size = 10;  // magic + version + kind + count
+    const uint64_t* dims = dims_flat;
+    for (int32_t i = 0; i < count; ++i) {
+        if (dtype_codes[i] >= NUM_DTYPES) return -1;
+        int64_t n = num_elements(dims, ndims[i]);
+        if (n < 0) return -1;
+        size += 2 + 8 * (int64_t)ndims[i];
+        size += n * ITEM_SIZES[dtype_codes[i]];
+        dims += ndims[i];
+    }
+    return size;
+}
+
+// Encode into out (caller allocates etpu_encoded_size bytes).
+// data_ptrs[i] must be C-contiguous little-endian element data.
+int32_t etpu_encode(int32_t count, const void* const* data_ptrs,
+                    const uint8_t* dtype_codes, const uint8_t* ndims,
+                    const uint64_t* dims_flat, uint8_t kind, uint8_t* out) {
+    uint8_t* p = out;
+    std::memcpy(p, MAGIC, 4); p += 4;
+    *p++ = VERSION;
+    *p++ = kind;
+    uint32_t c = (uint32_t)count;
+    std::memcpy(p, &c, 4); p += 4;
+    const uint64_t* dims = dims_flat;
+    for (int32_t i = 0; i < count; ++i) {
+        if (dtype_codes[i] >= NUM_DTYPES) return -1;
+        *p++ = dtype_codes[i];
+        *p++ = ndims[i];
+        std::memcpy(p, dims, 8 * (size_t)ndims[i]);
+        p += 8 * (size_t)ndims[i];
+        int64_t nbytes = num_elements(dims, ndims[i]) * ITEM_SIZES[dtype_codes[i]];
+        std::memcpy(p, data_ptrs[i], (size_t)nbytes);
+        p += nbytes;
+        dims += ndims[i];
+    }
+    return 0;
+}
+
+// First pass over a payload: validate and report tensor count and total
+// dims entries, so the caller can size the description buffers.
+// Returns 0 on success, negative error codes on malformed input.
+int32_t etpu_decode_probe(const uint8_t* payload, int64_t len,
+                          int32_t* out_count, int32_t* out_total_dims,
+                          uint8_t* out_kind) {
+    if (len < 10 || std::memcmp(payload, MAGIC, 4) != 0) return -1;
+    if (payload[4] != VERSION) return -2;
+    *out_kind = payload[5];
+    uint32_t count;
+    std::memcpy(&count, payload + 6, 4);
+    int64_t offset = 10;
+    int32_t total_dims = 0;
+    for (uint32_t i = 0; i < count; ++i) {
+        if (offset + 2 > len) return -3;
+        uint8_t code = payload[offset];
+        uint8_t ndim = payload[offset + 1];
+        offset += 2;
+        if (code >= NUM_DTYPES) return -4;
+        if (offset + 8 * (int64_t)ndim > len) return -5;
+        uint64_t dims_buf[255];
+        std::memcpy(dims_buf, payload + offset, 8 * (size_t)ndim);
+        int64_t n = num_elements(dims_buf, ndim);
+        if (n < 0) return -7;  // overflow / hostile dims
+        offset += 8 * (int64_t)ndim;
+        int64_t nbytes = n * ITEM_SIZES[code];
+        if (nbytes > len - offset) return -6;
+        offset += nbytes;
+        total_dims += ndim;
+    }
+    *out_count = (int32_t)count;
+    *out_total_dims = total_dims;
+    return 0;
+}
+
+// Second pass: fill per-tensor descriptions. The caller then builds numpy
+// arrays directly over payload[data_offsets[i] : ...] (zero copy until the
+// final .copy()).
+int32_t etpu_decode_describe(const uint8_t* payload, int64_t len,
+                             uint8_t* dtype_codes, uint8_t* ndims,
+                             uint64_t* dims_flat, int64_t* data_offsets) {
+    uint32_t count;
+    std::memcpy(&count, payload + 6, 4);
+    int64_t offset = 10;
+    uint64_t* dims = dims_flat;
+    for (uint32_t i = 0; i < count; ++i) {
+        uint8_t code = payload[offset];
+        uint8_t ndim = payload[offset + 1];
+        offset += 2;
+        dtype_codes[i] = code;
+        ndims[i] = ndim;
+        std::memcpy(dims, payload + offset, 8 * (size_t)ndim);
+        offset += 8 * (size_t)ndim;
+        data_offsets[i] = offset;
+        int64_t n = 1;
+        for (uint8_t d = 0; d < ndim; ++d) n *= (int64_t)dims[d];
+        offset += n * ITEM_SIZES[code];
+        dims += ndim;
+    }
+    (void)len;
+    return 0;
+}
+
+// ---------------------------------------------------------------- framing
+// 8-byte little-endian length prefix + payload, single syscall loops.
+
+int32_t etpu_send_frame(int32_t fd, const uint8_t* payload, int64_t len) {
+    uint8_t header[8];
+    uint64_t l = (uint64_t)len;
+    std::memcpy(header, &l, 8);
+    const uint8_t* bufs[2] = {header, payload};
+    int64_t lens[2] = {8, len};
+    for (int part = 0; part < 2; ++part) {
+        const uint8_t* buf = bufs[part];
+        int64_t remaining = lens[part];
+        while (remaining > 0) {
+            ssize_t sent = ::send(fd, buf, (size_t)remaining, MSG_NOSIGNAL);
+            if (sent < 0) {
+                if (errno == EINTR) continue;
+                return -1;
+            }
+            buf += sent;
+            remaining -= sent;
+        }
+    }
+    return 0;
+}
+
+// Reads the 8-byte length prefix; returns the payload length (so the
+// caller can allocate) or a negative error.
+int64_t etpu_recv_frame_len(int32_t fd) {
+    uint8_t header[8];
+    int64_t remaining = 8;
+    uint8_t* p = header;
+    while (remaining > 0) {
+        ssize_t got = ::recv(fd, p, (size_t)remaining, 0);
+        if (got < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (got == 0) return -2;  // peer closed
+        p += got;
+        remaining -= got;
+    }
+    uint64_t len;
+    std::memcpy(&len, header, 8);
+    return (int64_t)len;
+}
+
+int32_t etpu_recv_frame_body(int32_t fd, uint8_t* out, int64_t len) {
+    int64_t remaining = len;
+    uint8_t* p = out;
+    while (remaining > 0) {
+        ssize_t got = ::recv(fd, p, (size_t)remaining, 0);
+        if (got < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        if (got == 0) return -2;
+        p += got;
+        remaining -= got;
+    }
+    return 0;
+}
+
+}  // extern "C"

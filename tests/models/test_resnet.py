@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from elephas_tpu.models import model_from_json
+from elephas_tpu.models.resnet import build_resnet, build_resnet8
+
+
+def test_resnet8_trains_and_round_trips():
+    model = build_resnet8()
+    model.compile("adam", "categorical_crossentropy", ["acc"], seed=0)
+    x = np.random.default_rng(0).random((16, 32, 32, 3), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[np.random.default_rng(1).integers(0, 10, 16)]
+    history = model.fit(x, y, epochs=2, batch_size=8)
+    assert history.history["loss"][-1] < history.history["loss"][0]
+    preds = model.predict(x[:4])
+    assert preds.shape == (4, 10)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-4)
+    clone = model_from_json(model.to_json())
+    clone.set_weights(model.get_weights())
+    np.testing.assert_allclose(clone.predict(x[:4]), preds, atol=1e-4)
+
+
+def test_resnet_depth_validation():
+    with pytest.raises(ValueError):
+        build_resnet(depth=21)
+
+
+def test_resnet20_structure():
+    model = build_resnet(depth=20)
+    assert model.built
+    assert model.output_shape == (10,)
+
+
+def test_resnet8_distributed_sync():
+    from elephas_tpu import TPUModel
+    from elephas_tpu.utils import to_dataset
+
+    model = build_resnet8()
+    model.compile("adam", "categorical_crossentropy", seed=0)
+    x = np.random.default_rng(0).random((48, 32, 32, 3), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[np.random.default_rng(1).integers(0, 10, 48)]
+    tpu_model = TPUModel(model, mode="synchronous", num_workers=2)
+    tpu_model.fit(to_dataset(x, y), epochs=1, batch_size=16)
+    preds = tpu_model.predict(x[:4])
+    np.testing.assert_allclose(preds, model.predict(x[:4]), atol=1e-5)

@@ -1,0 +1,30 @@
+"""Multi-host helpers (single-process paths; the multi-process wiring is
+exercised by the driver's dryrun and real pods)."""
+import numpy as np
+
+from elephas_tpu.parallel.multihost import (global_batch_from_host_data,
+                                            global_data_mesh,
+                                            host_local_slice, is_coordinator)
+
+
+def test_is_coordinator_single_process():
+    assert is_coordinator()
+
+
+def test_host_local_slice_covers_everything():
+    lo, hi = host_local_slice(100)
+    assert (lo, hi) == (0, 100)
+
+
+def test_global_data_mesh_spans_devices():
+    import jax
+
+    mesh = global_data_mesh()
+    assert int(np.prod(mesh.devices.shape)) == len(jax.devices())
+
+
+def test_global_batch_from_host_data():
+    mesh = global_data_mesh()
+    local = np.arange(16, dtype=np.float32).reshape(16, 1)
+    arr = global_batch_from_host_data(mesh, local)
+    np.testing.assert_array_equal(np.asarray(arr), local)

@@ -1,0 +1,86 @@
+"""Native C++ codec: byte-parity with the canonical Python implementation."""
+import numpy as np
+import pytest
+
+from elephas_tpu.utils import native
+from elephas_tpu.utils import tensor_codec as tc
+
+pytestmark = pytest.mark.skipif(
+    not (native.build() and native.available()),
+    reason="native library not built and no compiler available")
+
+
+ARRAYS = [
+    np.random.default_rng(0).random((64, 32)).astype(np.float32),
+    np.arange(17, dtype=np.int64),
+    np.array(2.5),
+    np.zeros((3, 0, 2), dtype=np.float32),
+    np.array([True, False, True]),
+    np.arange(6, dtype=np.int32).reshape(2, 3),
+]
+
+
+def test_encode_byte_identical():
+    py = tc.encode_tensors(ARRAYS, tc.KIND_DELTA)
+    nat = native.encode_tensors_native(ARRAYS, tc.KIND_DELTA)
+    assert py == bytes(nat)
+
+
+def test_decode_matches_python():
+    payload = tc.encode_tensors(ARRAYS, tc.KIND_WEIGHTS)
+    py_arrays, py_kind = tc.decode_tensors(payload)
+    nat_arrays, nat_kind = native.decode_tensors_native(payload)
+    assert py_kind == nat_kind
+    for a, b in zip(py_arrays, nat_arrays):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b)
+
+
+def test_cross_decode():
+    """Python decodes native payloads and vice versa."""
+    nat_payload = bytes(native.encode_tensors_native(ARRAYS))
+    py_arrays, _ = tc.decode_tensors(nat_payload)
+    for a, b in zip(ARRAYS, py_arrays):
+        assert np.array_equal(np.asarray(a), b)
+
+
+@pytest.mark.parametrize("mutilate", [
+    lambda p: b"garbage",
+    lambda p: p[:8],
+    lambda p: p[:12],
+    lambda p: p[:-5],
+    lambda p: b"XXXX" + p[4:],
+])
+def test_native_rejects_malformed(mutilate):
+    payload = tc.encode_tensors([np.zeros((4, 4), dtype=np.float32)])
+    with pytest.raises(tc.CodecError):
+        native.decode_tensors_native(mutilate(payload))
+
+
+def test_dispatch_prefers_native_and_round_trips():
+    payload = tc.encode(ARRAYS, tc.KIND_WEIGHTS)
+    arrays, kind = tc.decode(bytes(payload))
+    assert kind == tc.KIND_WEIGHTS
+    for a, b in zip(ARRAYS, arrays):
+        assert np.array_equal(np.asarray(a), b)
+
+
+def test_native_framed_sockets():
+    import socket
+    import threading
+
+    server, client = socket.socketpair()
+    received = {}
+
+    def reader():
+        payload = native.recv_frame_native(server.fileno())
+        received["arrays"], _ = tc.decode_tensors(payload)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    payload = bytes(native.encode_tensors_native(ARRAYS[:2]))
+    native.send_frame_native(client.fileno(), payload)
+    t.join(timeout=5)
+    server.close()
+    client.close()
+    assert np.array_equal(received["arrays"][0], ARRAYS[0])
