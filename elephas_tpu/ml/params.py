@@ -1,12 +1,13 @@
 """Parameter mixin config system for the ML-pipeline layer.
 
-A standalone analog of ``pyspark.ml.param.Params`` carrying the same 16-mixin
-surface and defaults as the reference (``elephas/ml/params.py:4-259``):
-model config, mode (default ``asynchronous``), frequency (``epoch``),
-nb_classes (10), categorical (True), epochs (10), batch_size (32),
-verbosity (0), validation_split (0.1), num_workers (8), optimizer config,
-metrics (``['acc']``), loss, custom objects ({}), inference batch size
-(None), and the features/label/output column trio.
+A standalone analog of ``pyspark.ml.param.Params`` carrying the reference's
+16-mixin surface and defaults (``elephas/ml/params.py:4-259``) — model
+config, mode (default ``asynchronous``), frequency (``epoch``), nb_classes
+(10), categorical (True), epochs (10), batch_size (32), verbosity (0),
+validation_split (0.1), num_workers (8), optimizer config, metrics
+(``['acc']``), loss, custom objects ({}), inference batch size (None), and
+the features/label/output column trio — plus one TPU-native addition:
+sync_mode (default ``average``; ``step`` = per-step sync SGD).
 """
 from typing import Any, Dict
 
@@ -122,6 +123,33 @@ class HasFrequency(Params):
 
     def get_frequency(self):
         return self.getOrDefault(self.frequency)
+
+
+class HasSyncMode(Params):
+    """Synchronous-mode flavor: ``average`` (reference model-averaging,
+    ``elephas/spark_model.py:217-228``) or ``step`` (true per-step sync SGD,
+    the TPU-native benchmark configuration)."""
+
+    def __init__(self):
+        super().__init__()
+        self.sync_mode = Param(self, "sync_mode",
+                               "synchronous flavor: 'average' or 'step'")
+        self._setDefault(sync_mode="average")
+
+    def _set(self, **kwargs):
+        # constructor kwargs route through Params._set, not the named
+        # setter — validate here so a typo fails at construction, not fit()
+        if ("sync_mode" in kwargs
+                and kwargs["sync_mode"] not in ("average", "step")):
+            raise ValueError("sync_mode must be 'average' or 'step', got "
+                             f"{kwargs['sync_mode']!r}")
+        return super()._set(**kwargs)
+
+    def set_sync_mode(self, sync_mode):
+        return self._set(sync_mode=sync_mode)
+
+    def get_sync_mode(self):
+        return self.getOrDefault(self.sync_mode)
 
 
 class HasNumberOfClasses(Params):

@@ -25,14 +25,14 @@ from .params import (HasBatchSize, HasCategoricalLabels, HasCustomObjects,
                      HasInferenceBatchSize, HasLabelCol, HasLoss, HasMetrics,
                      HasMode, HasModelConfig, HasNumberOfClasses,
                      HasNumberOfWorkers, HasOptimizerConfig, HasOutputCol,
-                     HasValidationSplit, HasVerbosity)
+                     HasSyncMode, HasValidationSplit, HasVerbosity)
 
 
 class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
                 HasFeaturesCol, HasLabelCol, HasMode, HasEpochs, HasBatchSize,
                 HasFrequency, HasVerbosity, HasNumberOfClasses,
                 HasNumberOfWorkers, HasOutputCol, HasLoss, HasMetrics,
-                HasOptimizerConfig, HasCustomObjects):
+                HasOptimizerConfig, HasCustomObjects, HasSyncMode):
     """Configurable distributed-training estimator.
 
     ``fit(df)`` -> trained :class:`Transformer`.
@@ -57,6 +57,7 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
         HasMetrics.__init__(self)
         HasOptimizerConfig.__init__(self)
         HasCustomObjects.__init__(self)
+        HasSyncMode.__init__(self)
         self.set_params(**kwargs)
 
     def set_params(self, **kwargs):
@@ -78,7 +79,8 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
                 "batch_size": self.get_batch_size(),
                 "verbose": self.get_verbosity(),
                 "nb_classes": self.get_nb_classes(),
-                "outputCol": self.getOutputCol()}
+                "outputCol": self.getOutputCol(),
+                "sync_mode": self.get_sync_mode()}
 
     def save(self, file_name: str):
         with h5py.File(file_name, mode="w") as f:
@@ -111,7 +113,8 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
         tpu_model = TPUModel(model=model, mode=self.get_mode(),
                              frequency=self.get_frequency(),
                              num_workers=self.get_num_workers(),
-                             custom_objects=self.get_custom_objects())
+                             custom_objects=self.get_custom_objects(),
+                             sync_mode=self.get_sync_mode())
         tpu_model.fit(dataset, epochs=self.get_epochs(),
                       batch_size=self.get_batch_size(),
                       verbose=self.get_verbosity(),

@@ -29,8 +29,15 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
     ``JAX_PROCESS_ID``) and to TPU-pod auto-detection when none are set.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOTE: the guard must not touch the XLA backend — jax.process_count()
+    # would initialize it, after which jax.distributed.initialize() fails.
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        if _jax_distributed.global_state.client is not None:
+            return  # already initialized
+    except (ImportError, AttributeError):
+        pass  # private API moved: fall through and let initialize() decide
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
@@ -63,14 +70,21 @@ def coordinator_bind_env(port: int = 4000) -> Optional[str]:
     """
     import socket as pysocket
 
-    if "ELEPHAS_TPU_MASTER_IP" in os.environ:
-        return os.environ["ELEPHAS_TPU_MASTER_IP"]
+    preset = os.environ.get("ELEPHAS_TPU_MASTER_IP")
+    if preset is not None and jax.process_count() <= 1:
+        return preset
 
     if is_coordinator():
-        try:
-            host = pysocket.gethostbyname(pysocket.gethostname())
-        except pysocket.gaierror:
-            host = "127.0.0.1"
+        # a preset on the coordinator wins and is broadcast to every host;
+        # presets on non-coordinator hosts are overwritten so all processes
+        # agree AND all enter the collective below (a per-host early return
+        # would deadlock the others in broadcast_one_to_all)
+        host = preset
+        if not host:
+            try:
+                host = pysocket.gethostbyname(pysocket.gethostname())
+            except pysocket.gaierror:
+                host = "127.0.0.1"
     else:
         host = ""
 

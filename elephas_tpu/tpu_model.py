@@ -85,6 +85,10 @@ class TPUModel:
         self.batch_size = batch_size
         self.port = port
         self.sync_mode = kwargs.pop("sync_mode", "average")
+        if self.sync_mode not in ("average", "step"):
+            raise ValueError(
+                "sync_mode must be 'average' or 'step', got "
+                f"{self.sync_mode!r}")
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)

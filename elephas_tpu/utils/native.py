@@ -13,7 +13,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .tensor_codec import _CODE_DTYPES, _DTYPE_CODES, CodecError, KIND_WEIGHTS
+from .tensor_codec import (_CODE_DTYPES, _DTYPE_CODES, CodecError,
+                           KIND_WEIGHTS, MAX_FRAME_BYTES)
 
 _LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libetpu.so"
 _lib = None
@@ -115,15 +116,21 @@ def encode_tensors_native(arrays: Sequence[np.ndarray],
     return out  # bytearray: bytes-like for sendall/urllib without a copy
 
 
-def decode_tensors_native(payload: bytes) -> Optional[Tuple[List[np.ndarray], int]]:
-    """Native decode; returns None when the library is unavailable."""
+def decode_tensors_native(payload) -> Optional[Tuple[List[np.ndarray], int]]:
+    """Native decode of ``bytes`` or ``bytearray`` (the zero-copy receive
+    path); returns None when the library is unavailable."""
     lib = _load()
     if lib is None:
         return None
+    if isinstance(payload, bytearray):
+        # c_char arrays decay to c_char_p params without copying the buffer
+        raw = (ctypes.c_char * len(payload)).from_buffer(payload)
+    else:
+        raw = payload
     count = ctypes.c_int32()
     total_dims = ctypes.c_int32()
     kind = ctypes.c_uint8()
-    rc = lib.etpu_decode_probe(payload, len(payload), ctypes.byref(count),
+    rc = lib.etpu_decode_probe(raw, len(payload), ctypes.byref(count),
                                ctypes.byref(total_dims), ctypes.byref(kind))
     if rc != 0:
         raise CodecError(f"native decode: malformed payload (code {rc})")
@@ -132,7 +139,7 @@ def decode_tensors_native(payload: bytes) -> Optional[Tuple[List[np.ndarray], in
     ndims = ctypes.create_string_buffer(max(n, 1))
     dims = (ctypes.c_uint64 * max(total_dims.value, 1))()
     offsets = (ctypes.c_int64 * max(n, 1))()
-    lib.etpu_decode_describe(payload, len(payload), codes, ndims, dims, offsets)
+    lib.etpu_decode_describe(raw, len(payload), codes, ndims, dims, offsets)
     arrays = []
     dim_pos = 0
     for i in range(n):
@@ -141,11 +148,11 @@ def decode_tensors_native(payload: bytes) -> Optional[Tuple[List[np.ndarray], in
         shape = tuple(dims[dim_pos:dim_pos + ndim])
         dim_pos += ndim
         dtype = _CODE_DTYPES[code]
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if ndim \
-            else dtype.itemsize
+        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
         start = offsets[i]
-        arr = np.frombuffer(payload[start:start + nbytes],
-                            dtype=dtype).reshape(shape).copy()
+        # one allocation per tensor: frombuffer views the payload in place
+        arr = np.frombuffer(payload, dtype=dtype, count=count,
+                            offset=start).reshape(shape).copy()
         arrays.append(arr)
     return arrays, kind.value
 
@@ -168,18 +175,18 @@ def send_frame_native(fd: int, payload) -> bool:
     return True
 
 
-def recv_frame_native(fd: int) -> Optional[bytes]:
+def recv_frame_native(fd: int) -> Optional[bytearray]:
     lib = _load()
     if lib is None:
         return None
     length = lib.etpu_recv_frame_len(fd)
     if length < 0:
         raise ConnectionError("socket closed while reading frame")
-    if length > (1 << 34):
+    if length > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame length {length} exceeds limit")
     out = bytearray(int(length))
     buf = (ctypes.c_char * int(length)).from_buffer(out)
     if lib.etpu_recv_frame_body(fd, buf, length) != 0:
         raise ConnectionError("socket closed while reading frame body")
     del buf
-    return bytes(out)  # decode slices this; one copy to immutable bytes
+    return out  # bytes-like; decode reads it in place without another copy

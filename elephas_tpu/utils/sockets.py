@@ -18,12 +18,9 @@ from typing import List, Sequence
 
 import numpy as np
 
-from .tensor_codec import KIND_WEIGHTS, decode, encode
+from .tensor_codec import KIND_WEIGHTS, MAX_FRAME_BYTES, decode, encode
 
 LENGTH_BYTES = 8
-#: refuse frames above this size — a corrupt length prefix must not drive a
-#: multi-GB allocation
-MAX_FRAME_BYTES = 1 << 34
 
 
 def determine_master(port: int = 4000) -> str:

@@ -24,6 +24,10 @@ import numpy as np
 MAGIC = b"ETPU"
 VERSION = 1
 
+#: refuse frames above this size — a corrupt length prefix must not drive a
+#: multi-GB allocation. Shared by the Python and native transports.
+MAX_FRAME_BYTES = 1 << 34
+
 KIND_WEIGHTS = 0
 KIND_DELTA = 1
 KIND_SCALARS = 2
