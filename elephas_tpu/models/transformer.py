@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import attention
+from ..ops.pallas_attention import flash_attention
 from ..ops.ring_attention import ring_attention_sharded
 
 
@@ -40,6 +41,15 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    #: single-device attention implementation: ``auto`` picks the Pallas
+    #: flash kernel on TPU and the XLA-fused path elsewhere; ``flash`` /
+    #: ``xla`` force one. Ring attention (mesh + seq_axis) overrides this.
+    attention_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("auto", "flash", "xla"):
+            raise ValueError("attention_impl must be 'auto', 'flash' or "
+                             f"'xla', got {self.attention_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -145,6 +155,13 @@ def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
         if mesh is not None and seq_axis is not None:
             o = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis=seq_axis,
                                        causal=True, batch_axis=batch_axis)
+        elif mesh is None and (c.attention_impl == "flash" or (
+                c.attention_impl == "auto"
+                and jax.default_backend() == "tpu")):
+            # the Pallas kernel is single-device only: under a mesh the SPMD
+            # partitioner has no sharding rule for the Mosaic call, so
+            # sharded-but-not-sequence-parallel runs stay on the einsum path
+            o = flash_attention(q, k, v, causal=True)
         else:
             o = attention(q, k, v, causal=True)
         attn_out = jnp.einsum("bhtk,hkd->btd", o,

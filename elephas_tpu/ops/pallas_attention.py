@@ -1,0 +1,335 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The reference framework has no attention at all (SURVEY.md §5 — its largest
+model is an MLP), so this module is pure TPU-native upside: the flagship
+transformer's hot op written against the MXU/VMEM directly instead of
+through XLA's generic fusion.
+
+Design (flash-attention v2 recurrence):
+
+- Forward grid ``(batch*heads, q_blocks, kv_blocks)`` — the kv axis is the
+  innermost (sequential) grid dimension, so the online-softmax accumulators
+  live in VMEM scratch across kv steps while ``BlockSpec`` index maps
+  stream q/k/v tiles HBM -> VMEM. Never materializes the ``(seq, seq)``
+  score matrix.
+- Backward is two kernels sharing the saved per-row logsumexp: ``dq`` over
+  ``(bh, q_blocks, kv_blocks)`` and ``dk/dv`` over ``(bh, kv_blocks,
+  q_blocks)``; ``delta = rowsum(dO * O)`` is precomputed with plain jnp.
+- All accumulation is f32 regardless of input dtype (bf16 inputs hit the
+  MXU; softmax statistics stay f32 for stability).
+- Ragged sequence lengths are handled by padding to block multiples and
+  masking both key and query validity inside the kernels.
+
+On non-TPU backends the same kernels run via the Pallas interpreter
+(``interpret=True``), which is how the CPU test suite exercises them.
+"""
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+__all__ = ["flash_attention"]
+
+
+def _use_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# --------------------------------------------------------------------- fwd
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sq: int, sk: int, block_q: int, block_k: int,
+                causal: bool, scale: float):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # skip blocks strictly above the causal diagonal
+    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_reached)
+    def _():
+        # native-dtype operands into the MXU (bf16 multiply, f32 accumulate
+        # via preferred_element_type) — casting to f32 first would force a
+        # 4x-slower f32 MXU pass
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        # fully-masked rows (query padding): keep p exactly zero
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        # lse is (block_q, 1): trailing dims (block_q, 1) satisfy the TPU
+        # (8, 128)-or-full-dim tile rule, which a (1, block_q) block doesn't
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+
+    qr = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))).reshape(
+        b * h, sq_p, d)
+    kr = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))).reshape(
+        b * h, sk_p, d)
+    vr = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))).reshape(
+        b * h, sk_p, d)
+
+    grid = (b * h, sq_p // block_q, sk_p // block_k)
+    kernel = functools.partial(_fwd_kernel, sq=sq, sk=sk, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_use_interpret(interpret),
+    )(qr, kr, vr)
+    return (o[:, :sq].reshape(b, h, sq, d),
+            lse[:, :sq, 0].reshape(b, h, sq))
+
+
+# --------------------------------------------------------------------- bwd
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, sq: int, sk: int, block_q: int, block_k: int,
+               causal: bool, scale: float):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_reached)
+    def _():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < sk
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, sq: int, sk: int,
+                block_q: int, block_k: int, causal: bool, scale: float):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(diag_reached)
+    def _():
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # mask BOTH query padding (q_pos >= sq would use garbage lse) and
+        # key validity/causality
+        valid = (k_pos < sk) & (q_pos < sq)
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
+
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def prep(x, s_pad):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - x.shape[2]),
+                           (0, 0))).reshape(b * h, s_pad, x.shape[3])
+
+    qr, dor = prep(q, sq_p), prep(g, sq_p)
+    kr, vr = prep(k, sk_p), prep(v, sk_p)
+    # rows as (bh, seq, 1): trailing block dims (block_q, 1) fit TPU tiling
+    lser = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - sq))).reshape(
+        b * h, sq_p, 1)
+    deltar = jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq))).reshape(
+        b * h, sq_p, 1)
+
+    interp = _use_interpret(interpret)
+    common = dict(sq=sq, sk=sk, block_q=block_q, block_k=block_k,
+                  causal=causal, scale=scale)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(b * h, sq_p // block_q, sk_p // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interp,
+    )(qr, kr, vr, dor, lser, deltar)[0]
+
+    # kv-major grid: swap the roles of the two trailing grid axes
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0))
+    k_spec_t = pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **common),
+        grid=(b * h, sk_p // block_k, sq_p // block_q),
+        in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[k_spec_t, k_spec_t],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interp,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    return (dq[:, :sq].reshape(b, h, sq, d),
+            dk[:, :sk].reshape(b, h, sk, d),
+            dv[:, :sk].reshape(b, h, sk, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    return _bwd(causal, block_q, block_k, interpret, residuals, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False, block_q: int = 256,
+                    block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention over ``(batch, heads, seq, head_dim)`` tensors.
+
+    Differentiable (custom VJP with Pallas backward kernels). ``interpret``
+    defaults to auto: compiled on TPU, interpreter elsewhere. Block sizes
+    should stay multiples of the f32 min tile (8, 128) on real hardware;
+    sequence lengths need not be multiples of the block size.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (batch, heads, seq, head_dim), got "
+                         f"{q.shape}")
+    # clamp blocks for short sequences, rounding to 32 rows — a multiple of
+    # every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
+    block_q = min(block_q, _round_up(q.shape[2], 32))
+    block_k = min(block_k, _round_up(k.shape[2], 32))
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

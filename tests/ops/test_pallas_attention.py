@@ -1,0 +1,79 @@
+"""Pallas flash-attention parity tests (interpreter mode on the CPU mesh).
+
+Oracle = the plain XLA softmax attention in ``elephas_tpu.ops.attention``,
+for both outputs and gradients, over causal/non-causal and ragged
+(non-block-multiple) sequence lengths.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.ops.attention import attention
+from elephas_tpu.ops.pallas_attention import flash_attention
+
+
+def _qkv(key, b=2, h=2, sq=32, sk=32, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, sq, d), dtype)
+    k = jax.random.normal(kk, (b, h, sk, d), dtype)
+    v = jax.random.normal(kv, (b, h, sk, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk,block", [(32, 32, 16), (40, 40, 16),
+                                         (17, 29, 8)])
+def test_forward_matches_reference(causal, sq, sk, block):
+    if causal and sq != sk:
+        pytest.skip("causal requires square attention")
+    q, k, v = _qkv(jax.random.PRNGKey(0), sq=sq, sk=sk)
+    got = flash_attention(q, k, v, causal=causal, block_q=block,
+                          block_k=block, interpret=True)
+    want = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,block", [(32, 16), (27, 8)])
+def test_gradients_match_reference(causal, sq, block):
+    q, k, v = _qkv(jax.random.PRNGKey(1), sq=sq, sk=sq)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=block,
+                            block_k=block, interpret=True)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(attention(q, k, v, causal=causal)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5,
+                                   rtol=5e-4, err_msg=f"d{name}")
+
+
+def test_bfloat16_inputs():
+    q, k, v = _qkv(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                          interpret=True)
+    want = attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                     v.astype(jnp.float32), causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want), atol=3e-2)
+
+
+def test_jit_and_vmap_compose():
+    q, k, v = _qkv(jax.random.PRNGKey(3), b=1, h=2, sq=16, sk=16, d=8)
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                               interpret=True)
+
+    np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                               np.asarray(attention(q, k, v, causal=True)),
+                               atol=2e-5, rtol=2e-5)
