@@ -311,18 +311,21 @@ class BaseModel:
         history = History()
         shuffle_rng = np.random.default_rng(self._rng_seed)
 
+        from ..utils.native import batch_iterator
+
         for epoch in range(int(epochs)):
             order = shuffle_rng.permutation(n) if shuffle else np.arange(n)
             losses_sum, counts, metric_sums = 0.0, 0, None
-            for start in range(0, max(n, 1), batch_size):
-                idx = order[start:start + batch_size]
-                if idx.size == 0:
-                    continue
-                xb, yb = x[idx], y[idx]
+            # shuffled gather + prefetch runs in the native loader's
+            # background thread when built; numpy fallback otherwise.
+            # copy=False is safe here: each batch is consumed by the jitted
+            # step (device transfer at dispatch) before the next iteration
+            for xb, yb in batch_iterator((x, y), order, batch_size,
+                                         copy=False):
                 key = self._next_key()
                 trainable, state, opt_state, loss_val, metric_vals = step(
                     trainable, state, opt_state, key, xb, yb)
-                bsz = idx.size
+                bsz = xb.shape[0]
                 losses_sum += float(loss_val) * bsz
                 counts += bsz
                 vals = [float(v) for v in metric_vals]

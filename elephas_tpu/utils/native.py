@@ -20,13 +20,26 @@ _LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libetpu.
 _lib = None
 
 
+def _stale() -> bool:
+    """True when libetpu.so is older than any native source file."""
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    sources = list(_LIB_PATH.parent.glob("*.cpp")) + [
+        _LIB_PATH.parent / "build.sh"]
+    return any(s.exists() and s.stat().st_mtime > lib_mtime for s in sources)
+
+
 def build(force: bool = False) -> bool:
-    """Compile the native library with g++; returns True on success."""
-    if _LIB_PATH.exists() and not force:
+    """Compile the native library with g++ when missing or out of date;
+    returns True on success."""
+    global _lib
+    if not force and not _stale():
         return True
     script = _LIB_PATH.parent / "build.sh"
     try:
         subprocess.run(["sh", str(script)], check=True, capture_output=True)
+        _lib = None  # drop any handle to the replaced library
         return _LIB_PATH.exists()
     except (subprocess.CalledProcessError, FileNotFoundError):
         return False
@@ -68,6 +81,17 @@ def _load():
     lib.etpu_recv_frame_body.restype = ctypes.c_int32
     lib.etpu_recv_frame_body.argtypes = [ctypes.c_int32, ctypes.c_char_p,
                                          ctypes.c_int64]
+    if hasattr(lib, "etpu_loader_create"):  # absent in pre-loader builds
+        lib.etpu_loader_create.restype = ctypes.c_void_p
+        lib.etpu_loader_create.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_int32]
+        lib.etpu_loader_next.restype = ctypes.c_int64
+        lib.etpu_loader_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_void_p)]
+        lib.etpu_loader_destroy.restype = None
+        lib.etpu_loader_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -173,6 +197,105 @@ def send_frame_native(fd: int, payload) -> bool:
     if rc != 0:
         raise ConnectionError("native send_frame failed")
     return True
+
+
+class NativeBatchLoader:
+    """Background-prefetched shuffled batch iterator over aligned columns.
+
+    Wraps the C++ producer thread in ``native/etpu_loader.cpp``: batch N+1
+    gathers on a worker thread while the caller consumes batch N. The
+    random-access shuffle gather (the expensive part) happens off-thread;
+    by default each batch is then copied out of the ring buffer so the
+    yielded arrays are ordinarily-owned numpy arrays. ``copy=False`` yields
+    zero-copy views instead — valid ONLY until the next iteration (safe
+    for fit loops, where the device transfer happens at step dispatch, but
+    not for ``list(loader)``).
+    """
+
+    def __init__(self, columns, order, batch_size: int, depth: int = 3,
+                 copy: bool = True):
+        self._copy = copy
+        lib = _load()
+        if lib is None or not hasattr(lib, "etpu_loader_create"):
+            raise RuntimeError("native loader unavailable")
+        self._lib = lib
+        # keep the borrowed arrays alive for the loader's lifetime
+        self._columns = [np.ascontiguousarray(c) for c in columns]
+        self._order = np.ascontiguousarray(order, dtype=np.uint64)
+        nrows = self._columns[0].shape[0]
+        if any(c.shape[0] != nrows for c in self._columns):
+            raise ValueError("columns must share the leading dimension")
+        # order may address any subset/permutation of the rows
+        if len(self._order) and int(self._order.max()) >= nrows:
+            raise ValueError("order index out of range")
+        self.batch_size = int(batch_size)
+        ncols = len(self._columns)
+        ptrs = (ctypes.c_void_p * ncols)(
+            *[c.ctypes.data_as(ctypes.c_void_p).value for c in self._columns])
+        row_bytes = (ctypes.c_uint64 * ncols)(
+            *[c.dtype.itemsize * int(np.prod(c.shape[1:], dtype=np.int64))
+              for c in self._columns])
+        self._handle = lib.etpu_loader_create(
+            ncols, ptrs, row_bytes, len(self._order),
+            self._order.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            self.batch_size, depth)
+        if self._handle is None:
+            if len(self._order) == 0:
+                self._handle = None  # served as an empty iterator
+            else:
+                raise RuntimeError("etpu_loader_create failed")
+        self._out = (ctypes.c_void_p * ncols)()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is None:
+            raise StopIteration
+        rows = self._lib.etpu_loader_next(self._handle, self._out)
+        if rows < 0:
+            self.close()
+            raise RuntimeError("native loader failed")
+        if rows == 0:
+            self.close()
+            raise StopIteration
+        batch = []
+        for c, ptr in zip(self._columns, self._out):
+            shape = (int(rows),) + c.shape[1:]
+            count = int(rows) * int(np.prod(c.shape[1:], dtype=np.int64))
+            buf = (ctypes.c_char * (count * c.dtype.itemsize)).from_address(ptr)
+            arr = np.frombuffer(buf, dtype=c.dtype, count=count).reshape(shape)
+            batch.append(arr.copy() if self._copy else arr)
+        return tuple(batch)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.etpu_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()
+
+
+def batch_iterator(columns, order, batch_size: int, copy: bool = True):
+    """Shuffled batch iterator: native prefetching loader when built,
+    pure-numpy gather otherwise. Yields tuples of per-column batches.
+
+    ``copy=False`` skips the copy out of the loader's ring buffer: batches
+    are then only valid until the next iteration (fine for a train loop
+    that consumes each batch before advancing, wrong for ``list()``).
+    """
+    try:
+        loader = NativeBatchLoader(columns, order, batch_size, copy=copy)
+    except RuntimeError:  # library not built — use the Python gather
+        loader = None
+    if loader is not None:
+        yield from loader
+        return
+    n = len(order)
+    for start in range(0, n, batch_size):
+        idx = order[start:start + batch_size]
+        yield tuple(np.asarray(c)[idx] for c in columns)
 
 
 def recv_frame_native(fd: int) -> Optional[bytearray]:

@@ -1,6 +1,7 @@
 #!/bin/sh
-# Build the native ETPU codec library in place.
+# Build the native ETPU library (wire codec + batch loader) in place.
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -shared -fPIC -std=c++17 -o libetpu.so etpu_codec.cpp
+g++ -O3 -shared -fPIC -pthread -std=c++17 -o libetpu.so \
+    etpu_codec.cpp etpu_loader.cpp
 echo "built $(pwd)/libetpu.so"

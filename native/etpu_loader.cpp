@@ -1,0 +1,163 @@
+// ETPU native batch loader: background gather + prefetch for training.
+//
+// The Python fit loop's per-batch host work is a fancy-index gather
+// (x[order[i:i+b]], y[...]) that runs serially with device dispatch. This
+// loader moves the gather into a producer thread over a ring of
+// pre-allocated batch buffers, so batch N+1 (and N+2, ...) assembles while
+// the device runs batch N.
+//
+// Protocol (ctypes, see elephas_tpu/utils/native.py):
+//   h = etpu_loader_create(ncols, col_ptrs, row_bytes, nrows, order,
+//                          batch_size, depth)
+//   n = etpu_loader_next(h, out_ptrs)   // rows in batch; 0 = epoch done
+//                                       // blocks until the slot is filled;
+//                                       // implicitly recycles the slot
+//                                       // returned by the previous call
+//   etpu_loader_destroy(h)
+//
+// The column base pointers and the order array are BORROWED for the
+// loader's lifetime — the Python side must keep the arrays alive and
+// unchanged until destroy. Buffers returned by next() stay valid until the
+// following next()/destroy call.
+//
+// Build: native/build.sh (g++ -O3 -shared -fPIC -pthread).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+struct EtpuLoader {
+    int ncols;
+    std::vector<const uint8_t*> cols;
+    std::vector<uint64_t> row_bytes;
+    uint64_t nrows;
+    std::vector<uint64_t> order;
+    uint64_t batch_size;
+    uint64_t nbatches;
+    int depth;
+
+    // ring of depth slots, each holding ncols buffers
+    std::vector<std::vector<std::vector<uint8_t>>> slots;
+    std::vector<int64_t> slot_batch;  // batch index held, -1 = free
+
+    std::mutex mu;
+    std::condition_variable filled_cv;
+    std::condition_variable free_cv;
+    uint64_t next_serve = 0;   // batch the consumer will take next
+    int64_t served_slot = -1;  // slot handed out by the previous next()
+    bool stop = false;
+    std::thread producer;
+};
+
+static void producer_loop(EtpuLoader* L) {
+    for (uint64_t b = 0; b < L->nbatches; ++b) {
+        int slot = (int)(b % (uint64_t)L->depth);
+        {
+            std::unique_lock<std::mutex> lk(L->mu);
+            L->free_cv.wait(lk, [&] {
+                return L->stop || L->slot_batch[slot] < 0;
+            });
+            if (L->stop) return;
+        }
+        uint64_t lo = b * L->batch_size;
+        uint64_t hi = lo + L->batch_size;
+        if (hi > L->nrows) hi = L->nrows;
+        uint64_t rows = hi - lo;
+        for (int c = 0; c < L->ncols; ++c) {
+            uint64_t rb = L->row_bytes[c];
+            uint8_t* dst = L->slots[slot][c].data();
+            const uint8_t* src = L->cols[c];
+            for (uint64_t r = 0; r < rows; ++r) {
+                std::memcpy(dst + r * rb, src + L->order[lo + r] * rb, rb);
+            }
+        }
+        {
+            std::lock_guard<std::mutex> lk(L->mu);
+            L->slot_batch[slot] = (int64_t)b;
+        }
+        L->filled_cv.notify_one();
+    }
+}
+
+void* etpu_loader_create(int32_t ncols, const void** col_ptrs,
+                         const uint64_t* row_bytes, uint64_t nrows,
+                         const uint64_t* order, uint64_t batch_size,
+                         int32_t depth) {
+    if (ncols <= 0 || nrows == 0 || batch_size == 0 || depth <= 0)
+        return nullptr;
+    EtpuLoader* L = new EtpuLoader();
+    L->ncols = ncols;
+    L->nrows = nrows;
+    L->batch_size = batch_size;
+    L->nbatches = (nrows + batch_size - 1) / batch_size;
+    L->depth = depth;
+    // slot buffers only ever hold min(batch_size, nrows) rows — don't let
+    // an oversized batch_size drive a huge (or fatal) allocation
+    uint64_t slot_rows = batch_size < nrows ? batch_size : nrows;
+    try {
+        L->cols.resize(ncols);
+        L->row_bytes.resize(ncols);
+        for (int c = 0; c < ncols; ++c) {
+            L->cols[c] = (const uint8_t*)col_ptrs[c];
+            L->row_bytes[c] = row_bytes[c];
+        }
+        L->order.assign(order, order + nrows);
+        L->slots.resize(depth);
+        L->slot_batch.assign(depth, -1);
+        for (int s = 0; s < depth; ++s) {
+            L->slots[s].resize(ncols);
+            for (int c = 0; c < ncols; ++c)
+                L->slots[s][c].resize(slot_rows * row_bytes[c]);
+        }
+    } catch (const std::bad_alloc&) {
+        delete L;  // surface as a create failure, not std::terminate
+        return nullptr;
+    }
+    L->producer = std::thread(producer_loop, L);
+    return L;
+}
+
+int64_t etpu_loader_next(void* handle, void** out_ptrs) {
+    EtpuLoader* L = (EtpuLoader*)handle;
+    if (!L) return -1;
+    std::unique_lock<std::mutex> lk(L->mu);
+    // recycle the slot from the previous call
+    if (L->served_slot >= 0) {
+        L->slot_batch[L->served_slot] = -1;
+        L->served_slot = -1;
+        L->free_cv.notify_one();
+    }
+    if (L->next_serve >= L->nbatches) return 0;  // epoch exhausted
+    int slot = (int)(L->next_serve % (uint64_t)L->depth);
+    L->filled_cv.wait(lk, [&] {
+        return L->slot_batch[slot] == (int64_t)L->next_serve;
+    });
+    for (int c = 0; c < L->ncols; ++c)
+        out_ptrs[c] = L->slots[slot][c].data();
+    uint64_t lo = L->next_serve * L->batch_size;
+    uint64_t hi = lo + L->batch_size;
+    if (hi > L->nrows) hi = L->nrows;
+    L->served_slot = slot;
+    L->next_serve += 1;
+    return (int64_t)(hi - lo);
+}
+
+void etpu_loader_destroy(void* handle) {
+    EtpuLoader* L = (EtpuLoader*)handle;
+    if (!L) return;
+    {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->stop = true;
+    }
+    L->free_cv.notify_all();
+    L->filled_cv.notify_all();
+    if (L->producer.joinable()) L->producer.join();
+    delete L;
+}
+
+}  // extern "C"
