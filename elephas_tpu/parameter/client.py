@@ -1,17 +1,33 @@
-"""Parameter-server clients: pull weights, push deltas.
+"""Parameter-server clients: pull weights, push deltas, probe health.
 
 (Parity surface: ``elephas/parameter/client.py:13-91``; payloads are typed
-ETPU tensor frames instead of pickle.)
+ETPU tensor frames instead of pickle. Upgrades over the reference: network
+timeouts, transient-failure retry with exponential backoff, and health
+probes — the reference has no failure detection at all, SURVEY.md §5.)
 """
 import abc
 import socket
+import time
+import urllib.error
 import urllib.request
+import uuid
 from typing import List
 
 import numpy as np
 
 from ..utils.sockets import determine_master, receive, send
 from ..utils.tensor_codec import KIND_DELTA, decode_weights, encode
+
+#: default network timeout (seconds) — a dead parameter server must surface
+#: as an error in the training loop, not a hang
+DEFAULT_TIMEOUT = 120.0
+
+#: transient-failure policy: attempts = 1 + MAX_RETRIES, sleeping
+#: BACKOFF * 2**attempt between tries
+MAX_RETRIES = 3
+BACKOFF = 0.2
+
+_TRANSIENT = (ConnectionError, socket.timeout, urllib.error.URLError, OSError)
 
 
 class BaseParameterClient(abc.ABC):
@@ -28,6 +44,33 @@ class BaseParameterClient(abc.ABC):
             raise ValueError("Parameter server mode has to be either `http` or "
                              "`socket`, got {}".format(client_type))
 
+    def _with_retry(self, op, describe: str):
+        """Run ``op`` with exponential-backoff retry on transient network
+        failures, bounded by an overall wall-clock deadline (default
+        ``2 * timeout``) so a dead server fails the call in bounded time
+        instead of timeout-times-attempts.
+
+        Updates carry idempotency ids (stable across resends), so the
+        server skips a delta whose first application's ack was lost.
+        """
+        deadline = time.monotonic() + (
+            self.deadline if self.deadline is not None else 2 * self.timeout)
+        for attempt in range(self.max_retries + 1):
+            try:
+                return op()
+            except _TRANSIENT as err:
+                # 4xx means a protocol/caller bug, not a flaky network
+                if (isinstance(err, urllib.error.HTTPError)
+                        and err.code < 500):
+                    raise
+                pause = self.backoff * (2 ** attempt)
+                if (attempt == self.max_retries
+                        or time.monotonic() + pause > deadline):
+                    raise ConnectionError(
+                        f"{describe} failed after {attempt + 1} attempt(s): "
+                        f"{err}") from err
+                time.sleep(pause)
+
     @abc.abstractmethod
     def update_parameters(self, delta: List[np.ndarray]):
         """Send a weight-delta update to the server."""
@@ -36,10 +79,9 @@ class BaseParameterClient(abc.ABC):
     def get_parameters(self) -> List[np.ndarray]:
         """Retrieve the current master weights."""
 
-
-#: default network timeout (seconds) — a dead parameter server must surface
-#: as an error in the training loop, not a hang
-DEFAULT_TIMEOUT = 120.0
+    @abc.abstractmethod
+    def health_check(self) -> bool:
+        """True when the server answers its liveness probe."""
 
 
 class HttpClient(BaseParameterClient):
@@ -47,23 +89,47 @@ class HttpClient(BaseParameterClient):
 
     client_type = "http"
 
-    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT):
+    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
+                 max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
+                 deadline: float = None):
         self.master_url = determine_master(port=port)
         self.headers = {"Content-Type": "application/elephas-tpu"}
         self.timeout = timeout
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.deadline = deadline
 
     def get_parameters(self) -> List[np.ndarray]:
-        request = urllib.request.Request(
-            f"http://{self.master_url}/parameters", headers=self.headers)
-        with urllib.request.urlopen(request, timeout=self.timeout) as response:
-            return decode_weights(response.read())
+        def op():
+            request = urllib.request.Request(
+                f"http://{self.master_url}/parameters", headers=self.headers)
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                return decode_weights(response.read())
+        return self._with_retry(op, "get_parameters")
 
     def update_parameters(self, delta: List[np.ndarray]):
-        request = urllib.request.Request(
-            f"http://{self.master_url}/update",
-            bytes(encode(delta, KIND_DELTA)), headers=self.headers)
-        with urllib.request.urlopen(request, timeout=self.timeout) as response:
-            return response.read()
+        payload = bytes(encode(delta, KIND_DELTA))
+        # one id per logical update, stable across retries: the server
+        # drops duplicates so a lost ack can't double-apply the delta
+        headers = dict(self.headers, **{"X-Update-Id": uuid.uuid4().hex})
+
+        def op():
+            request = urllib.request.Request(
+                f"http://{self.master_url}/update", payload, headers=headers)
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                return response.read()
+        return self._with_retry(op, "update_parameters")
+
+    def health_check(self) -> bool:
+        try:
+            request = urllib.request.Request(
+                f"http://{self.master_url}/health", headers=self.headers)
+            with urllib.request.urlopen(request, timeout=5.0) as response:
+                return response.status == 200
+        except _TRANSIENT:
+            return False
 
 
 class SocketClient(BaseParameterClient):
@@ -71,27 +137,46 @@ class SocketClient(BaseParameterClient):
 
     client_type = "socket"
 
-    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT):
+    def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
+                 max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
+                 deadline: float = None):
         self.port = port
         self.timeout = timeout
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.deadline = deadline
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout=None) -> socket.socket:
         host = determine_master(port=self.port).split(":")[0]
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.settimeout(self.timeout)
+        sock.settimeout(timeout if timeout is not None else self.timeout)
         sock.connect((host, self.port))
         return sock
 
     def get_parameters(self) -> List[np.ndarray]:
-        with self._connect() as sock:
-            sock.sendall(b"g")
-            return receive(sock)
+        def op():
+            with self._connect() as sock:
+                sock.sendall(b"g")
+                return receive(sock)
+        return self._with_retry(op, "get_parameters")
 
     def update_parameters(self, delta: List[np.ndarray]):
-        with self._connect() as sock:
-            sock.sendall(b"u")
-            send(sock, delta, kind=KIND_DELTA)
-            ack = sock.recv(1)  # block until the server has applied the delta
-            if ack != b"k":
-                raise ConnectionError("parameter server did not acknowledge "
-                                      "the update")
+        update_id = uuid.uuid4().hex.encode("ascii")  # stable across retries
+
+        def op():
+            with self._connect() as sock:
+                sock.sendall(b"U" + update_id)
+                send(sock, delta, kind=KIND_DELTA)
+                ack = sock.recv(1)  # block until the delta is applied
+                if ack != b"k":
+                    raise ConnectionError("parameter server did not "
+                                          "acknowledge the update")
+        return self._with_retry(op, "update_parameters")
+
+    def health_check(self) -> bool:
+        try:
+            with self._connect(timeout=5.0) as sock:
+                sock.sendall(b"h")
+                return sock.recv(1) == b"k"
+        except _TRANSIENT:
+            return False

@@ -19,6 +19,8 @@ wire currency — so no JAX device state lives on the serving threads.
 import abc
 import socket
 import threading
+import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -43,6 +45,20 @@ class BaseParameterServer(abc.ABC):
         self.weights: List[np.ndarray] = [np.asarray(w, dtype=np.float32)
                                           for w in model["weights"]]
         self.lock = RWLock()
+        #: applied-update counter — cheap liveness/progress signal surfaced
+        #: through the health endpoints (own lock: hogwild bypasses the
+        #: weight RWLock, and a bare += would lose increments across threads)
+        self.num_updates = 0
+        self._counter_lock = threading.Lock()
+        # idempotency window: update ids already applied, so a client retry
+        # whose first attempt's ack was lost cannot double-apply a delta.
+        # Time-based retention (>= the client's worst-case retry horizon)
+        # with a generous count cap — a busy cluster must not evict an id
+        # before its retry can arrive.
+        self._seen_ids: "OrderedDict[str, float]" = OrderedDict()
+        self._seen_lock = threading.Lock()
+        self._seen_ttl = 600.0
+        self._seen_cap = 1 << 17
 
     def get_weights(self) -> List[np.ndarray]:
         if self.mode == "asynchronous":
@@ -53,7 +69,12 @@ class BaseParameterServer(abc.ABC):
             if self.mode == "asynchronous":
                 self.lock.release()
 
-    def apply_delta(self, delta: List[np.ndarray]):
+    def apply_delta(self, delta: List[np.ndarray],
+                    update_id: Optional[str] = None):
+        if update_id is not None:
+            with self._seen_lock:
+                if update_id in self._seen_ids:
+                    return  # duplicate resend from a client retry
         if self.mode == "asynchronous":
             self.lock.acquire_write()
         try:
@@ -61,6 +82,20 @@ class BaseParameterServer(abc.ABC):
         finally:
             if self.mode == "asynchronous":
                 self.lock.release()
+        if update_id is not None:
+            # record only AFTER a successful apply: if the apply raised, the
+            # client's resend must not hit the duplicate branch and get a
+            # success ack for a delta that was never applied
+            now = time.monotonic()
+            with self._seen_lock:
+                self._seen_ids[update_id] = now
+                while self._seen_ids and (
+                        len(self._seen_ids) > self._seen_cap
+                        or next(iter(self._seen_ids.values()))
+                        < now - self._seen_ttl):
+                    self._seen_ids.popitem(last=False)
+        with self._counter_lock:
+            self.num_updates += 1
 
     @abc.abstractmethod
     def start(self):
@@ -93,6 +128,13 @@ class HttpServer(BaseParameterServer):
             def do_GET(self):
                 if self.path.rstrip("/") in ("", "/"):
                     body = b"elephas_tpu"
+                elif self.path.startswith("/health"):
+                    # liveness + progress: workers and orchestrators probe
+                    # this to detect a dead/stuck server (reference has no
+                    # failure detection at all, SURVEY.md par.5)
+                    body = (b'{"status": "ok", "mode": "%s", '
+                            b'"num_updates": %d}'
+                            % (server.mode.encode(), server.num_updates))
                 elif self.path.startswith("/parameters"):
                     body = encode_weights(server.get_weights())
                 else:
@@ -117,7 +159,8 @@ class HttpServer(BaseParameterServer):
                     self.send_response(400)
                     self.end_headers()
                     return
-                server.apply_delta(delta)
+                server.apply_delta(delta,
+                                   update_id=self.headers.get("X-Update-Id"))
                 body = b"Update done"
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
@@ -142,7 +185,8 @@ class HttpServer(BaseParameterServer):
 
 class SocketServer(BaseParameterServer):
     """Raw-TCP parameter server with a 1-byte opcode protocol:
-    ``'g'`` = get weights, ``'u'`` = apply update.
+    ``'g'`` = get weights, ``'u'`` = apply update, ``'U'`` = apply update
+    with a 32-byte idempotency id (safe to resend), ``'h'`` = health probe.
 
     (Parity surface: ``elephas/parameter/server.py:140-233``; framing is the
     length-prefixed ETPU format instead of pickled payloads.)
@@ -224,12 +268,26 @@ class SocketServer(BaseParameterServer):
                     return
                 if not opcode:
                     return
-                if opcode == b"u":
+                if opcode in (b"u", b"U"):
+                    update_id = None
+                    if opcode == b"U":
+                        raw = bytearray()
+                        while len(raw) < 32:
+                            chunk = conn.recv(32 - len(raw))
+                            if not chunk:
+                                return
+                            raw += chunk
+                        update_id = raw.decode("ascii", "replace")
                     delta = receive(conn)
-                    self.apply_delta(delta)
+                    self.apply_delta(delta, update_id=update_id)
                     try:
                         conn.sendall(b"k")  # ack: delta applied
                     except OSError:
                         return
                 elif opcode == b"g":
                     send(conn, self.get_weights())
+                elif opcode == b"h":
+                    try:
+                        conn.sendall(b"k")  # alive
+                    except OSError:
+                        return

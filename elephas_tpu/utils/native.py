@@ -7,6 +7,7 @@ encode/decode plus single-syscall-loop framed socket I/O. The parameter
 server layer uses it transparently when present.
 """
 import ctypes
+import os
 import subprocess
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
@@ -32,16 +33,29 @@ def _stale() -> bool:
 
 def build(force: bool = False) -> bool:
     """Compile the native library with g++ when missing or out of date;
-    returns True on success."""
+    returns True on success.
+
+    Compiles to a temp name and renames over the target: the .so may be
+    dlopened by this (or another) process, and rewriting the mapped inode
+    in place could SIGBUS it — rename gives readers the old inode until
+    they reload.
+    """
     global _lib
     if not force and not _stale():
         return True
     script = _LIB_PATH.parent / "build.sh"
+    tmp_name = f"{_LIB_PATH.name}.tmp.{os.getpid()}"
     try:
-        subprocess.run(["sh", str(script)], check=True, capture_output=True)
-        _lib = None  # drop any handle to the replaced library
+        subprocess.run(["sh", str(script), tmp_name], check=True,
+                       capture_output=True)
+        os.replace(_LIB_PATH.parent / tmp_name, _LIB_PATH)
+        _lib = None  # force a fresh CDLL of the new inode on next _load
         return _LIB_PATH.exists()
-    except (subprocess.CalledProcessError, FileNotFoundError):
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+        try:
+            (_LIB_PATH.parent / tmp_name).unlink()
+        except OSError:
+            pass
         return False
 
 

@@ -1,7 +1,11 @@
 #!/bin/sh
 # Build the native ETPU library (wire codec + batch loader) in place.
+# Optional $1: output filename (default libetpu.so) — the Python build()
+# helper compiles to a temp name and rename(2)s over the target so a
+# library already dlopened by a live process is never rewritten in place.
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -shared -fPIC -pthread -std=c++17 -o libetpu.so \
+OUT="${1:-libetpu.so}"
+g++ -O3 -shared -fPIC -pthread -std=c++17 -o "$OUT" \
     etpu_codec.cpp etpu_loader.cpp
-echo "built $(pwd)/libetpu.so"
+echo "built $(pwd)/$OUT"

@@ -105,3 +105,99 @@ def test_hogwild_mode_lock_free_still_serves():
         assert len(client.get_parameters()) == 4
     finally:
         server.stop()
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_health_check_and_update_counter(server_cls, client_cls):
+    port = _next_port()
+    server = server_cls(_serialized_model(), port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port)
+        assert client.health_check() is True
+        assert server.num_updates == 0
+        delta = [np.zeros_like(np.asarray(w))
+                 for w in client.get_parameters()]
+        client.update_parameters(delta)
+        client.update_parameters(delta)
+        assert server.num_updates == 2
+    finally:
+        server.stop()
+    assert client.health_check() is False
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_client_retries_through_server_restart(server_cls, client_cls):
+    """A pull issued while the server is briefly down succeeds once it
+    comes back (transient-failure retry with backoff)."""
+    port = _next_port()
+    payload = _serialized_model()
+    client = client_cls(port, timeout=5.0, max_retries=6, backoff=0.3)
+
+    server = server_cls(payload, port, "asynchronous")
+    restarter = threading.Timer(0.8, server.start)
+    restarter.start()
+    try:
+        weights = client.get_parameters()  # server not up yet: must retry
+        assert len(weights) == len(payload["weights"])
+    finally:
+        restarter.join()
+        server.stop()
+
+
+@pytest.mark.parametrize("client_cls", [HttpClient, SocketClient])
+def test_client_fails_fast_on_dead_server(client_cls):
+    port = _next_port()  # nothing listening
+    client = client_cls(port, timeout=1.0, max_retries=1, backoff=0.05)
+    with pytest.raises(OSError):
+        client.get_parameters()
+    assert client.health_check() is False
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_duplicate_update_id_applied_once(server_cls, client_cls):
+    """A resent update (same idempotency id, e.g. after a lost ack) must
+    not double-apply the delta."""
+    import urllib.request
+
+    from elephas_tpu.utils.sockets import send as frame_send
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA, encode
+
+    port = _next_port()
+    payload = _serialized_model()
+    server = server_cls(payload, port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port)
+        before = client.get_parameters()
+        delta = [np.ones_like(np.asarray(w)) for w in before]
+
+        if client_cls is HttpClient:
+            body = bytes(encode(delta, KIND_DELTA))
+            headers = {"X-Update-Id": "f" * 32}
+            for _ in range(2):
+                req = urllib.request.Request(
+                    f"http://{client.master_url}/update", body,
+                    headers=headers)
+                urllib.request.urlopen(req, timeout=10).read()
+        else:
+            import socket as pysocket
+            for _ in range(2):
+                with pysocket.create_connection(("127.0.0.1", port),
+                                                timeout=10) as sock:
+                    sock.sendall(b"U" + b"f" * 32)
+                    frame_send(sock, delta, kind=KIND_DELTA)
+                    assert sock.recv(1) == b"k"
+
+        after = client.get_parameters()
+        assert server.num_updates == 1
+        for got, orig in zip(after, before):
+            np.testing.assert_allclose(got, np.asarray(orig) - 1.0, atol=1e-6)
+    finally:
+        server.stop()
