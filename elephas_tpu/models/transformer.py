@@ -45,11 +45,26 @@ class TransformerConfig:
     #: flash kernel on TPU and the XLA-fused path elsewhere; ``flash`` /
     #: ``xla`` force one. Ring attention (mesh + seq_axis) overrides this.
     attention_impl: str = "auto"
+    #: mixture-of-experts MLP: >1 replaces every dense MLP block with
+    #: ``num_experts`` gated experts sharded over the ``model`` mesh axis
+    #: (expert parallelism — each device owns E/tp experts and XLA
+    #: all-reduces the combined output back into the residual stream)
+    num_experts: int = 0
+    #: tokens route to the top-k experts. k=1 is Switch-style (output
+    #: scaled by the raw softmax probability, keeping router gradient
+    #: alive); k>1 renormalizes the selected probabilities (Mixtral-style)
+    expert_top_k: int = 2
+    #: weight of the load-balancing auxiliary loss (Switch eq. 4) added to
+    #: the LM loss — 0 disables it
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "flash", "xla"):
             raise ValueError("attention_impl must be 'auto', 'flash' or "
                              f"'xla', got {self.attention_impl!r}")
+        if self.num_experts > 1 and not (
+                1 <= self.expert_top_k <= self.num_experts):
+            raise ValueError("expert_top_k must be in [1, num_experts]")
 
     @property
     def head_dim(self) -> int:
@@ -76,8 +91,8 @@ def init_params(config: TransformerConfig, key) -> Dict:
                      "beta": jnp.zeros((c.d_model,), c.param_dtype)},
     }
     for i in range(c.num_layers):
-        lk = jax.random.split(keys[2 + i], 6)
-        params[f"layer_{i}"] = {
+        lk = jax.random.split(keys[2 + i], 7)
+        layer = {
             "ln1": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
                     "beta": jnp.zeros((c.d_model,), c.param_dtype)},
             "attn": {
@@ -88,13 +103,24 @@ def init_params(config: TransformerConfig, key) -> Dict:
             },
             "ln2": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
                     "beta": jnp.zeros((c.d_model,), c.param_dtype)},
-            "mlp": {
+        }
+        if c.num_experts > 1:
+            layer["moe"] = {
+                "gate": dense(lk[6], (c.d_model, c.num_experts), c.d_model),
+                "w1": dense(lk[4], (c.num_experts, c.d_model, c.d_ff),
+                            c.d_model),
+                "b1": jnp.zeros((c.num_experts, c.d_ff), c.param_dtype),
+                "w2": dense(lk[5], (c.num_experts, c.d_ff, c.d_model), c.d_ff),
+                "b2": jnp.zeros((c.num_experts, c.d_model), c.param_dtype),
+            }
+        else:
+            layer["mlp"] = {
                 "w1": dense(lk[4], (c.d_model, c.d_ff), c.d_model),
                 "b1": jnp.zeros((c.d_ff,), c.param_dtype),
                 "w2": dense(lk[5], (c.d_ff, c.d_model), c.d_ff),
                 "b2": jnp.zeros((c.d_model,), c.param_dtype),
-            },
-        }
+            }
+        params[f"layer_{i}"] = layer
     return params
 
 
@@ -111,7 +137,7 @@ def param_specs(config: TransformerConfig, model_axis: str = "model") -> Dict:
         "final_ln": {"gamma": P(None), "beta": P(None)},
     }
     for i in range(config.num_layers):
-        specs[f"layer_{i}"] = {
+        layer_specs = {
             "ln1": {"gamma": P(None), "beta": P(None)},
             "attn": {
                 "wq": P(None, model_axis, None),
@@ -120,9 +146,24 @@ def param_specs(config: TransformerConfig, model_axis: str = "model") -> Dict:
                 "wo": P(model_axis, None, None),
             },
             "ln2": {"gamma": P(None), "beta": P(None)},
-            "mlp": {"w1": P(None, model_axis), "b1": P(model_axis),
-                    "w2": P(model_axis, None), "b2": P(None)},
         }
+        if config.num_experts > 1:
+            # expert parallelism: the expert dimension shards over the
+            # model axis, so each device holds and computes E/tp experts;
+            # the gate is replicated and XLA all-reduces the weighted
+            # combine back into the (replicated) residual stream
+            layer_specs["moe"] = {
+                "gate": P(None, None),
+                "w1": P(model_axis, None, None),
+                "b1": P(model_axis, None),
+                "w2": P(model_axis, None, None),
+                "b2": P(model_axis, None),
+            }
+        else:
+            layer_specs["mlp"] = {"w1": P(None, model_axis),
+                                  "b1": P(model_axis),
+                                  "w2": P(model_axis, None), "b2": P(None)}
+        specs[f"layer_{i}"] = layer_specs
     return specs
 
 
@@ -130,6 +171,52 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return ((x - mean) * jax.lax.rsqrt(var + eps)) * gamma + beta
+
+
+def _moe_block(h, moe, config: "TransformerConfig"):
+    """Gated mixture-of-experts MLP with dense (einsum) dispatch.
+
+    Every expert runs on its owning device for all tokens and the top-k
+    gate zeroes the rest — dense dispatch trades routed-FLOP savings for
+    perfectly static shapes (no capacity overflow, XLA-friendly) while
+    still *distributing* expert compute over the mesh via the
+    expert-sharded parameters.
+
+    The router runs in f32 (bf16 logits would tie-break wrongly and the
+    module's contract keeps softmaxes f32). Gating: full softmax first,
+    then top-k selection — for k=1 the output is scaled by the raw
+    probability (Switch style: renormalizing a single entry to 1.0 would
+    starve the router of gradient), for k>1 the selected probabilities
+    are renormalized (Mixtral style).
+
+    Returns ``(out, aux)`` where ``aux`` is the Switch load-balancing
+    loss term for this block (f32 scalar).
+    """
+    c = config
+    gate_logits = (h.astype(jnp.float32)
+                   @ moe["gate"].astype(jnp.float32))  # (b, t, E)
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    if c.expert_top_k < c.num_experts:
+        kth = jnp.sort(probs, axis=-1)[..., -c.expert_top_k][..., None]
+        gates = jnp.where(probs >= kth, probs, 0.0)
+        if c.expert_top_k > 1:
+            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    else:
+        gates = probs
+    # Switch aux loss (eq. 4): num_experts * sum_e f_e * P_e, where f_e is
+    # the fraction of tokens whose top choice is e and P_e the mean router
+    # probability of e — minimized by a uniform routing distribution
+    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), c.num_experts,
+                          dtype=jnp.float32)
+    aux = c.num_experts * jnp.sum(jnp.mean(top1, axis=(0, 1))
+                                  * jnp.mean(probs, axis=(0, 1)))
+    gates = gates.astype(c.dtype)
+    he = jax.nn.gelu(
+        jnp.einsum("btd,edf->betf", h, moe["w1"].astype(c.dtype))
+        + moe["b1"].astype(c.dtype)[None, :, None, :])
+    out = (jnp.einsum("betf,efd->betd", he, moe["w2"].astype(c.dtype))
+           + moe["b2"].astype(c.dtype)[None, :, None, :])
+    return jnp.einsum("betd,bte->btd", out, gates), aux
 
 
 def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
@@ -140,10 +227,24 @@ def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     When ``mesh`` and ``seq_axis`` are given, attention runs as ring
     attention with k/v shards streaming over the ``seq_axis`` ring.
     """
+    logits, _ = forward_with_aux(params, tokens, config, mesh=mesh,
+                                 seq_axis=seq_axis, batch_axis=batch_axis)
+    return logits
+
+
+def forward_with_aux(params: Dict, tokens: jnp.ndarray,
+                     config: TransformerConfig,
+                     mesh: Optional[Mesh] = None,
+                     seq_axis: Optional[str] = None,
+                     batch_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
+                                                                jnp.ndarray]:
+    """Like :func:`forward` but also returns the summed MoE auxiliary
+    (load-balancing) loss — 0.0 for dense configs."""
     c = config
     seq_len = tokens.shape[1]
     x = params["embed"]["tokens"][tokens] + params["embed"]["pos"][:seq_len]
     x = x.astype(c.dtype)
+    aux_total = jnp.zeros((), jnp.float32)
 
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
@@ -169,28 +270,37 @@ def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
         x = x + attn_out
         h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
         h = h.astype(c.dtype)
-        h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
-                        + layer["mlp"]["b1"].astype(c.dtype))
-        h = h @ layer["mlp"]["w2"].astype(c.dtype) + layer["mlp"]["b2"].astype(c.dtype)
+        if c.num_experts > 1:
+            h, aux = _moe_block(h, layer["moe"], c)
+            aux_total = aux_total + aux
+        else:
+            h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
+                            + layer["mlp"]["b1"].astype(c.dtype))
+            h = (h @ layer["mlp"]["w2"].astype(c.dtype)
+                 + layer["mlp"]["b2"].astype(c.dtype))
         x = x + h
 
     x = _layer_norm(x.astype(jnp.float32), params["final_ln"]["gamma"],
                     params["final_ln"]["beta"])
     # tied embedding head; f32 logits for a stable softmax
-    return x @ params["embed"]["tokens"].T.astype(jnp.float32)
+    return x @ params["embed"]["tokens"].T.astype(jnp.float32), aux_total
 
 
 def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
             batch_axis: Optional[str] = None) -> jnp.ndarray:
-    """Next-token cross-entropy (mean over all positions)."""
-    logits = forward(params, tokens, config, mesh=mesh, seq_axis=seq_axis,
-                     batch_axis=batch_axis)
+    """Next-token cross-entropy (mean over all positions), plus the
+    weighted MoE load-balancing auxiliary loss for MoE configs."""
+    logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
+                                   seq_axis=seq_axis, batch_axis=batch_axis)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    loss = -jnp.mean(picked)
+    if config.num_experts > 1 and config.moe_aux_weight:
+        loss = loss + config.moe_aux_weight * aux
+    return loss
 
 
 def make_train_step(config: TransformerConfig, tx,
