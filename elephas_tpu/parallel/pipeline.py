@@ -1,0 +1,101 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+The reference cannot do any model parallelism (``README.md:319-321`` calls
+it "practically impossible" under Spark); on TPU it is a mesh axis. This
+module implements the classic microbatched pipeline schedule as a pure
+function under ``shard_map``:
+
+- stage parameters are stacked along a leading axis sharded over ``pipe``
+  (device s holds stage s),
+- the batch splits into M microbatches; at tick t stage 0 injects
+  microbatch t while every stage processes the activation it received
+  last tick and ``ppermute``s its output to the next stage,
+- after ``M + S - 1`` ticks the last stage has produced every microbatch;
+  outputs are gathered with a masked ``psum`` so the result is replicated.
+
+The schedule lives inside one ``lax.scan`` — XLA sees a static loop of
+S-way-parallel stage computations with neighbor-only ICI transfers, which
+is exactly the hardware-shaped formulation of GPipe. Differentiable end
+to end (``shard_map``/``ppermute``/``scan`` all have transpose rules), so
+``jax.grad`` of a pipelined loss just works; the backward pass is the
+reverse pipeline.
+"""
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["make_pipeline_fn", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    along a new leading axis — the axis that shards over ``pipe``."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)
+
+
+def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, axis: str = "pipe",
+                     num_microbatches: int = None):
+    """Build ``fn(stacked_params, x) -> y`` running ``stage_fn`` as a
+    microbatched pipeline over ``mesh[axis]``.
+
+    :param stage_fn: ``(stage_params, x_micro) -> y_micro``, shape
+        preserving (the activation flowing between stages must keep one
+        shape, as in a stack of transformer blocks).
+    :param num_microbatches: number of microbatches M (default: pipeline
+        depth). The batch dimension must divide by M.
+    """
+    num_stages = mesh.shape[axis]
+    M = num_microbatches or num_stages
+
+    def pipelined(stacked_params, x):
+        leading = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        if leading != num_stages:
+            raise ValueError(
+                f"stacked params hold {leading} stages but mesh axis "
+                f"{axis!r} has {num_stages} devices — a mismatched stack "
+                "would silently drop stages")
+        if x.shape[0] % M:
+            raise ValueError(f"batch {x.shape[0]} not divisible by "
+                             f"{M} microbatches")
+        micro = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+        def per_device(params_local, micro_local):
+            # params_local leading dim is 1 (this device's stage slice)
+            stage_params = jax.tree_util.tree_map(lambda p: p[0],
+                                                  params_local)
+            idx = jax.lax.axis_index(axis)
+            num_ticks = M + num_stages - 1
+            state0 = jnp.zeros_like(micro_local[0])
+
+            def tick(state, t):
+                # stage 0 injects microbatch t (clamped; injections past
+                # M-1 never reach the collected output window)
+                inject = jax.lax.dynamic_index_in_dim(
+                    micro_local, jnp.minimum(t, M - 1), axis=0,
+                    keepdims=False)
+                x_in = jnp.where(idx == 0, inject, state)
+                y = stage_fn(stage_params, x_in)
+                # neighbor-only transfer: stage s -> s+1 over ICI
+                state_next = jax.lax.ppermute(
+                    y, axis, [(s, s + 1) for s in range(num_stages - 1)])
+                return state_next, y
+
+            _, ys = jax.lax.scan(tick, state0, jnp.arange(num_ticks))
+            # microbatch m finishes on the LAST stage at tick m + S - 1;
+            # mask everyone else and psum to replicate the result
+            outs = jax.lax.dynamic_slice_in_dim(ys, num_stages - 1, M,
+                                                axis=0)
+            outs = jnp.where(idx == num_stages - 1, outs,
+                             jnp.zeros_like(outs))
+            return jax.lax.psum(outs, axis)
+
+        in_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        y = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(in_spec, P()), out_specs=P(),
+                          check_vma=False)(stacked_params, micro)
+        return y.reshape(x.shape[0:1] + y.shape[2:])
+
+    return pipelined

@@ -168,8 +168,11 @@ def test_moe_top1_routes_to_single_expert():
 
 def test_moe_router_receives_gradient():
     """The gate must train even with top_k=1 (Switch scaling keeps the
-    router gradient alive) — and the aux loss pushes toward balance."""
-    config = _moe_config(num_experts=4, expert_top_k=1, num_layers=1)
+    router gradient alive). aux_weight=0 isolates the scaling path — the
+    aux loss would otherwise feed the gate a gradient by itself and mask
+    a regression to hard routing."""
+    config = _moe_config(num_experts=4, expert_top_k=1, num_layers=1,
+                         moe_aux_weight=0.0)
     params = init_params(config, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
                                 config.vocab_size)
