@@ -1,4 +1,6 @@
 from . import activations, initializers, losses, metrics, optimizers
+from .callbacks import (Callback, EarlyStopping, LambdaCallback,
+                        ModelCheckpoint)
 from .core import BaseModel, History, Model, Sequential, model_from_json
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,

@@ -72,6 +72,8 @@ class BaseModel:
         self._rng_seed: Optional[int] = None
         self._step_counter = 0
         self._jit_cache: Dict[str, Any] = {}
+        #: callbacks set this mid-fit to end training after the epoch
+        self.stop_training = False
 
     # ------------------------------------------------------------------ graph
     @property
@@ -136,6 +138,75 @@ class BaseModel:
             new_params[ln][pn] = w
         self.params = new_params
         self._invalidate_jit()
+
+    # -------------------------------------------------- checkpoint state api
+    def training_state(self) -> Dict:
+        """Full resumable training state as a dict-of-arrays pytree:
+        model params plus the optimizer state's leaves (dict-keyed, so
+        both the orbax and npz checkpoint backends can store it)."""
+        if self.params is None:
+            raise ValueError("Model must be built before training_state()")
+        leaves = (jax.tree_util.tree_leaves(self._opt_state)
+                  if self._opt_state is not None else [])
+        return {"params": self.params,
+                "opt_state_leaves": {f"leaf_{i}": leaf
+                                     for i, leaf in enumerate(leaves)}}
+
+    def restore_training_state(self, directory: str,
+                               step: Optional[int] = None) -> Optional[int]:
+        """Restore params + optimizer state saved by
+        :class:`~elephas_tpu.models.callbacks.ModelCheckpoint`; returns the
+        restored step.
+
+        The model must be built and compiled with the same architecture.
+        Auto-generated layer names differ between model instances (the uid
+        counter keeps running), so param-bearing layers are renamed to the
+        checkpoint's names positionally (order taken from the manifest's
+        model json) before the state is adopted — this also makes the
+        optimizer-state leaf order match the saved flatten order.
+        """
+        import json as _json
+
+        from ..utils.checkpoint import CheckpointManager
+
+        if not self.built:
+            raise RuntimeError("build()/compile() the model (same "
+                               "architecture) before restore_training_state")
+        manager = CheckpointManager(directory)
+        state = manager.restore(step)
+        saved_params = state["params"]
+        manifest = manager.manifest()
+        if "model" in manifest:
+            specs = _json.loads(manifest["model"])["config"]["layers"]
+            names = [s.get("name") or s["config"]["name"] for s in specs]
+            saved_order = [n for n in names if n in saved_params]
+        else:  # no manifest: fall back to the stored key order
+            saved_order = list(saved_params)
+        current = [layer for layer in self.layers
+                   if self.params and layer.name in self.params]
+        if len(current) != len(saved_order):
+            raise ValueError(
+                f"checkpoint has {len(saved_order)} parameterized layers, "
+                f"model has {len(current)} — architectures differ")
+        for layer, saved_name in zip(current, saved_order):
+            layer.name = saved_name
+        self.params = {ln: {pn: jnp.asarray(v) for pn, v in lp.items()}
+                       for ln, lp in saved_params.items()}
+        leaves_dict = state.get("opt_state_leaves") or {}
+        if leaves_dict:
+            if self._tx is None:
+                raise RuntimeError(
+                    "checkpoint contains optimizer state but the model is "
+                    "not compiled — compile() first (compiling after the "
+                    "restore would silently reset the optimizer moments)")
+            trainable, _ = self._split_params(self.params)
+            ref = self._tx.init(trainable)
+            treedef = jax.tree_util.tree_structure(ref)
+            leaves = [jnp.asarray(leaves_dict[f"leaf_{i}"])
+                      for i in range(len(leaves_dict))]
+            self._opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._invalidate_jit()
+        return step if step is not None else manager.latest_step()
 
     def _split_params(self, params: Dict) -> Tuple[Dict, Dict]:
         """Split into (trainable, non-trainable) collections."""
@@ -288,8 +359,14 @@ class BaseModel:
     # -------------------------------------------------------------------- fit
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32, verbose: int = 0,
             validation_split: float = 0.0, validation_data=None,
-            shuffle: bool = True, **kwargs) -> History:
-        """Train with mini-batch SGD. Returns a Keras-style History."""
+            shuffle: bool = True, callbacks=None, **kwargs) -> History:
+        """Train with mini-batch SGD. Returns a Keras-style History.
+
+        ``callbacks`` is a list of
+        :class:`~elephas_tpu.models.callbacks.Callback` objects; a callback
+        may set ``model.stop_training = True`` (e.g. EarlyStopping) to end
+        training after the current epoch.
+        """
         if not self.compiled:
             raise RuntimeError("compile() the model before fit()")
         self._ensure_built(x)
@@ -312,31 +389,44 @@ class BaseModel:
         shuffle_rng = np.random.default_rng(self._rng_seed)
 
         from ..utils.native import batch_iterator
+        from .callbacks import CallbackList
+
+        self.stop_training = False
+        cbs = CallbackList(callbacks, self)
+        cbs.train_begin()
 
         for epoch in range(int(epochs)):
+            cbs.epoch_begin(epoch)
             order = shuffle_rng.permutation(n) if shuffle else np.arange(n)
             losses_sum, counts, metric_sums = 0.0, 0, None
             # shuffled gather + prefetch runs in the native loader's
             # background thread when built; numpy fallback otherwise.
             # copy=False is safe here: each batch is consumed by the jitted
             # step (device transfer at dispatch) before the next iteration
-            for xb, yb in batch_iterator((x, y), order, batch_size,
-                                         copy=False):
+            for batch_idx, (xb, yb) in enumerate(
+                    batch_iterator((x, y), order, batch_size, copy=False)):
                 key = self._next_key()
                 trainable, state, opt_state, loss_val, metric_vals = step(
                     trainable, state, opt_state, key, xb, yb)
                 bsz = xb.shape[0]
-                losses_sum += float(loss_val) * bsz
+                batch_loss = float(loss_val)
+                losses_sum += batch_loss * bsz
                 counts += bsz
                 vals = [float(v) for v in metric_vals]
                 metric_sums = ([s + v * bsz for s, v in zip(metric_sums, vals)]
                                if metric_sums else [v * bsz for v in vals])
+                if cbs:
+                    cbs.batch_end(batch_idx, {"loss": batch_loss,
+                                              "size": bsz})
             if counts:
                 history.append("loss", losses_sum / counts)
                 for name, total in zip(self.metrics_names[1:], metric_sums or []):
                     history.append(name, total / counts)
+            # sync model state each epoch so callbacks (checkpointing,
+            # weight snapshots) observe the current weights
+            self.params = self._merge_params(trainable, state)
+            self._opt_state = opt_state
             if validation_data is not None:
-                self.params = self._merge_params(trainable, state)
                 val_results = self.evaluate(validation_data[0], validation_data[1],
                                             batch_size=batch_size, verbose=0)
                 val_results = (val_results if isinstance(val_results, list)
@@ -346,9 +436,21 @@ class BaseModel:
             if verbose:
                 msg = " - ".join(f"{k}: {v[-1]:.4f}" for k, v in history.history.items())
                 print(f"Epoch {epoch + 1}/{epochs} - {msg}")
+            cbs.epoch_end(epoch, {k: v[-1] for k, v in history.history.items()
+                                  if v})
+            if cbs:
+                # a callback may have mutated the model (set_weights,
+                # restore) — re-adopt its state so the next epoch trains
+                # from what the callback left behind
+                trainable, state = self._split_params(self.params)
+                if self._opt_state is not None:
+                    opt_state = self._opt_state
+            if self.stop_training:
+                break
 
         self.params = self._merge_params(trainable, state)
         self._opt_state = opt_state
+        cbs.train_end()
         return history
 
     def train_on_batch(self, x, y):

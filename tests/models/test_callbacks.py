@@ -1,0 +1,158 @@
+"""Callback tests: EarlyStopping, ModelCheckpoint (+resume), Lambda hooks."""
+import numpy as np
+import pytest
+
+from elephas_tpu.models import (SGD, Dense, EarlyStopping, LambdaCallback,
+                                ModelCheckpoint, Sequential)
+
+
+def _model(lr=0.05):
+    model = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+    model.compile(SGD(learning_rate=lr), "mse", seed=0)
+    return model
+
+
+def _data(n=128):
+    rng = np.random.default_rng(0)
+    x = rng.random((n, 4), dtype=np.float32)
+    y = (x @ rng.random((4, 1), dtype=np.float32)).astype(np.float32)
+    return x, y
+
+
+def test_lambda_hooks_fire_in_order():
+    x, y = _data()
+    events = []
+    cb = LambdaCallback(
+        on_train_begin=lambda logs: events.append("train_begin"),
+        on_epoch_begin=lambda e, logs: events.append(f"epoch_begin_{e}"),
+        on_batch_end=lambda b, logs: events.append("batch"),
+        on_epoch_end=lambda e, logs: events.append(f"epoch_end_{e}"),
+        on_train_end=lambda logs: events.append("train_end"))
+    _model().fit(x, y, epochs=2, batch_size=64, verbose=0, callbacks=[cb])
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert events.count("batch") == 4  # 2 epochs x 2 batches
+    assert "epoch_begin_0" in events and "epoch_end_1" in events
+    # epoch-end logs carry the loss
+    logs_seen = []
+    cb2 = LambdaCallback(on_epoch_end=lambda e, logs: logs_seen.append(logs))
+    _model().fit(x, y, epochs=1, batch_size=64, verbose=0, callbacks=[cb2])
+    assert "loss" in logs_seen[0]
+
+
+def test_early_stopping_halts_training():
+    x, y = _data()
+    model = _model(lr=0.0)  # loss cannot improve
+    history = model.fit(x, y, epochs=20, batch_size=64, verbose=0,
+                        callbacks=[EarlyStopping(monitor="loss", patience=2)])
+    # first epoch sets best, then patience=2 more, stop on the 4th
+    assert len(history.history["loss"]) == 4
+
+
+def test_early_stopping_restores_best_weights():
+    x, y = _data()
+    model = _model()
+    snapshots = []
+    cb_snap = LambdaCallback(
+        on_epoch_end=lambda e, logs: snapshots.append(
+            [np.copy(w) for w in model.get_weights()]))
+    es = EarlyStopping(monitor="loss", patience=0, min_delta=1e9,
+                       restore_best_weights=True)
+    model.fit(x, y, epochs=10, batch_size=64, verbose=0,
+              callbacks=[cb_snap, es])
+    assert es.stopped_epoch == 1  # epoch 0 is 'best', epoch 1 not improved
+    for got, want in zip(model.get_weights(), snapshots[0]):
+        np.testing.assert_allclose(got, want)
+
+
+def test_model_checkpoint_and_resume(tmp_path):
+    from elephas_tpu.models import Adam
+
+    def adam_model():
+        model = Sequential([Dense(8, input_dim=4, activation="relu"),
+                            Dense(1)])
+        model.compile(Adam(learning_rate=0.01), "mse", seed=0)
+        return model
+
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "ckpts")
+    model = adam_model()
+    model.fit(x, y, epochs=3, batch_size=32, verbose=0,
+              callbacks=[ModelCheckpoint(ckpt_dir)])
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    manager = CheckpointManager(ckpt_dir)
+    assert manager.latest_step() == 2
+    preds_before = model.predict(x[:8])
+
+    # fresh model resumes: params AND optimizer (Adam moment) state
+    # round-trip despite different auto-generated layer names
+    resumed = adam_model()
+    resumed.build(seed=1)  # different init - must be overwritten
+    step = resumed.restore_training_state(ckpt_dir)
+    assert step == 2
+    np.testing.assert_allclose(np.asarray(resumed.predict(x[:8])),
+                               np.asarray(preds_before), atol=1e-6)
+    import jax
+
+    got_leaves = jax.tree_util.tree_leaves(resumed._opt_state)
+    want_leaves = jax.tree_util.tree_leaves(model._opt_state)
+    assert len(got_leaves) == len(want_leaves) > 0
+    for a, b in zip(got_leaves, want_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # training continues from the restored state without error
+    resumed.fit(x, y, epochs=1, batch_size=32, verbose=0,
+                callbacks=[ModelCheckpoint(ckpt_dir)])
+    assert CheckpointManager(ckpt_dir).latest_step() == 3  # epoch offset
+
+
+def test_model_checkpoint_save_best_only(tmp_path):
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "best")
+    model = _model(lr=0.0)  # loss never improves after the first epoch
+    model.fit(x, y, epochs=4, batch_size=64, verbose=0,
+              callbacks=[ModelCheckpoint(ckpt_dir, monitor="loss",
+                                         save_best_only=True)])
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    assert CheckpointManager(ckpt_dir).steps() == [0]
+
+
+def test_early_stopping_reusable_across_fits():
+    x, y = _data()
+    es = EarlyStopping(monitor="loss", patience=2)
+    m1 = _model(lr=0.0)
+    h1 = m1.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
+    assert len(h1.history["loss"]) == 4
+    # state must reset: a second fit runs its own full patience cycle
+    m2 = _model(lr=0.0)
+    h2 = m2.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
+    assert len(h2.history["loss"]) == 4
+
+
+def test_early_stopping_warns_on_missing_monitor():
+    import warnings as w
+
+    x, y = _data()
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        _model().fit(x, y, epochs=2, batch_size=64, verbose=0,
+                     callbacks=[EarlyStopping(monitor="val_loss")])
+    assert any("val_loss" in str(c.message) for c in caught)
+
+
+def test_callback_set_weights_takes_effect():
+    """A callback mutating weights at epoch end must shape the next epoch
+    (Keras semantics), not be overwritten by fit's local state."""
+    x, y = _data()
+    model = _model()
+    zeros = None
+
+    def zero_weights(epoch, logs):
+        nonlocal zeros
+        if epoch == 0:
+            zeros = [np.zeros_like(w) for w in model.get_weights()]
+            model.set_weights(zeros)
+    cb = LambdaCallback(on_epoch_end=zero_weights)
+    model.fit(x, y, epochs=1, batch_size=64, verbose=0, callbacks=[cb])
+    for w, z in zip(model.get_weights(), zeros):
+        np.testing.assert_allclose(w, z)
