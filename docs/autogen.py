@@ -51,12 +51,20 @@ PAGES = [
      ["DenseVector", "DenseMatrix", "LabeledPoint", "Vectors", "Matrices"]),
     ("Attention ops", "elephas_tpu.ops.attention",
      ["attention", "blockwise_attention"]),
+    ("Flash attention (Pallas)", "elephas_tpu.ops.pallas_attention",
+     ["flash_attention"]),
     ("Ring attention", "elephas_tpu.ops.ring_attention",
      ["ring_attention", "ring_attention_sharded"]),
     ("Transformer", "elephas_tpu.models.transformer",
      ["TransformerConfig", "init_params", "param_specs", "forward",
-      "lm_loss", "make_train_step", "shard_params"]),
+      "forward_with_aux", "lm_loss", "make_train_step", "shard_params"]),
+    ("Pipeline parallelism", "elephas_tpu.parallel.pipeline",
+     ["make_pipeline_fn", "stack_stage_params"]),
+    ("Callbacks", "elephas_tpu.models.callbacks",
+     ["Callback", "EarlyStopping", "ModelCheckpoint", "LambdaCallback"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
+    ("Native acceleration", "elephas_tpu.utils.native",
+     ["build", "available", "NativeBatchLoader", "batch_iterator"]),
     ("Tracing", "elephas_tpu.utils.tracing",
      ["StepTimer", "profiler_trace", "annotate"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
@@ -107,8 +115,11 @@ def main(out_dir: str = None):
     out = Path(out_dir) if out_dir else ROOT / "docs" / "sources"
     out.mkdir(parents=True, exist_ok=True)
     nav = []
+    import re
+
     for title, module_name, names in PAGES:
-        slug = title.lower().replace(" ", "-").replace("/", "-")
+        slug = re.sub(r"[^a-z0-9]+", "-",
+                      title.lower()).strip("-")
         (out / f"{slug}.md").write_text(render_page(title, module_name, names))
         nav.append((title, f"{slug}.md"))
         print(f"wrote {slug}.md")

@@ -134,13 +134,16 @@ class EarlyStopping(Callback):
                                       for w in self.model.get_weights()]
         else:
             self.wait += 1
-            if self.wait > self.patience:
+            # Keras semantics: stop once `patience` epochs pass without
+            # improvement
+            if self.wait >= self.patience:
                 self.stopped_epoch = epoch
                 self.model.stop_training = True
 
     def on_train_end(self, logs=None):
-        if (self.restore_best_weights and self.stopped_epoch is not None
-                and self._best_weights is not None):
+        # restore the best epoch's weights whether or not the stop
+        # triggered (epochs may simply have run out mid-plateau)
+        if self.restore_best_weights and self._best_weights is not None:
             self.model.set_weights(self._best_weights)
 
 
@@ -167,21 +170,35 @@ class ModelCheckpoint(Callback):
         self.mode = mode
         self.best = math.inf if mode == "min" else -math.inf
         self._epoch_offset = 0
+        self._warned_missing = False
 
     def on_train_begin(self, logs=None):
-        # continuing a resumed run: number epochs after the restored step
+        # instance may be reused across fit() calls: reset the best and
+        # number epochs after any already-checkpointed step
+        self.best = math.inf if self.mode == "min" else -math.inf
+        self._warned_missing = False
         latest = self.manager.latest_step()
         self._epoch_offset = (latest + 1) if latest is not None else 0
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_best_only:
             value = (logs or {}).get(self.monitor)
-            if value is not None:
-                improved = (float(value) < self.best if self.mode == "min"
-                            else float(value) > self.best)
-                if not improved:
-                    return
-                self.best = float(value)
+            if value is None:
+                # can't judge "best" without the metric — skip the save
+                # (saving anyway would quietly degrade to save-always)
+                if not self._warned_missing:
+                    warnings.warn(
+                        f"ModelCheckpoint(save_best_only=True) conditioned "
+                        f"on {self.monitor!r}, which is not in the epoch "
+                        f"logs {sorted(logs or {})} — no checkpoints will "
+                        "be written")
+                    self._warned_missing = True
+                return
+            improved = (float(value) < self.best if self.mode == "min"
+                        else float(value) > self.best)
+            if not improved:
+                return
+            self.best = float(value)
         self.manager.save(self._epoch_offset + epoch,
                           self.model.training_state(),
                           model_json=self.model.to_json())

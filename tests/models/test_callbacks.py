@@ -44,8 +44,9 @@ def test_early_stopping_halts_training():
     model = _model(lr=0.0)  # loss cannot improve
     history = model.fit(x, y, epochs=20, batch_size=64, verbose=0,
                         callbacks=[EarlyStopping(monitor="loss", patience=2)])
-    # first epoch sets best, then patience=2 more, stop on the 4th
-    assert len(history.history["loss"]) == 4
+    # first epoch sets best, then patience=2 non-improving epochs -> stop
+    # (Keras semantics: wait >= patience)
+    assert len(history.history["loss"]) == 3
 
 
 def test_early_stopping_restores_best_weights():
@@ -122,11 +123,11 @@ def test_early_stopping_reusable_across_fits():
     es = EarlyStopping(monitor="loss", patience=2)
     m1 = _model(lr=0.0)
     h1 = m1.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
-    assert len(h1.history["loss"]) == 4
+    assert len(h1.history["loss"]) == 3
     # state must reset: a second fit runs its own full patience cycle
     m2 = _model(lr=0.0)
     h2 = m2.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
-    assert len(h2.history["loss"]) == 4
+    assert len(h2.history["loss"]) == 3
 
 
 def test_early_stopping_warns_on_missing_monitor():
@@ -156,3 +157,37 @@ def test_callback_set_weights_takes_effect():
     model.fit(x, y, epochs=1, batch_size=64, verbose=0, callbacks=[cb])
     for w, z in zip(model.get_weights(), zeros):
         np.testing.assert_allclose(w, z)
+
+
+def test_restore_best_weights_without_early_stop():
+    """Best weights restore at train end even when epochs run out before
+    patience triggers."""
+    x, y = _data()
+    model = _model()
+    snapshots = []
+    cb_snap = LambdaCallback(
+        on_epoch_end=lambda e, logs: snapshots.append(
+            [np.copy(w) for w in model.get_weights()]))
+    es = EarlyStopping(monitor="loss", patience=50, min_delta=1e9,
+                       restore_best_weights=True)
+    model.fit(x, y, epochs=3, batch_size=64, verbose=0,
+              callbacks=[cb_snap, es])
+    assert es.stopped_epoch is None  # never triggered
+    for got, want in zip(model.get_weights(), snapshots[0]):
+        np.testing.assert_allclose(got, want)
+
+
+def test_model_checkpoint_warns_on_missing_monitor(tmp_path):
+    import warnings as w
+
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "warn")
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        _model().fit(x, y, epochs=2, batch_size=64, verbose=0,
+                     callbacks=[ModelCheckpoint(ckpt_dir, monitor="val_loss",
+                                                save_best_only=True)])
+    assert any("val_loss" in str(c.message) for c in caught)
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    assert CheckpointManager(ckpt_dir).steps() == []  # nothing written
