@@ -27,7 +27,7 @@ from elephas_tpu.parallel import make_pipeline_fn, stack_stage_params
 # ---------------------------------------------------------- expert parallel
 n = len(jax.devices())
 dp = 2 if n >= 2 else 1
-tp = n // dp if n // dp in (1, 2, 4) else 4
+tp = max(d for d in (1, 2, 4) if d <= n // dp)
 mesh = Mesh(np.array(jax.devices()[:dp * tp]).reshape(dp, tp),
             ("data", "model"))
 print(f"mesh: data={dp} model(/expert)={tp}")
@@ -52,7 +52,7 @@ for i in range(20):
 print(f"[moe] final loss: {float(loss):.4f}")
 
 # --------------------------------------------------------------- pipelined
-pipe = min(4, n)
+pipe = max(d for d in (1, 2, 4) if d <= n)  # divisors of the batch (16)
 if pipe > 1:
     pipe_mesh = Mesh(np.array(jax.devices()[:pipe]), ("pipe",))
 
