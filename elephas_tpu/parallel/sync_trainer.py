@@ -284,8 +284,16 @@ class SyncStepTrainer:
 
     def fit(self, weights: List[np.ndarray], x: np.ndarray, y: np.ndarray,
             epochs: int, batch_size: int, validation_split: float = 0.0,
-            shuffle: bool = True, seed: int = 0, verbose: int = 0):
-        """Train; returns (new_weights, history dict)."""
+            shuffle: bool = True, seed: int = 0, verbose: int = 0,
+            epoch_callback=None):
+        """Train; returns (new_weights, history dict).
+
+        ``epoch_callback(epoch_idx, logs) -> bool`` fires after each epoch
+        with that epoch's metric means; returning True stops training. When
+        set, the replica model's params are synced from device before each
+        call (so the callback can snapshot/checkpoint weights) — this costs
+        a device fetch per epoch, so it is opt-in.
+        """
         from .mesh import replicate, shard_leading
 
         model = self.model
@@ -326,11 +334,22 @@ class SyncStepTrainer:
             trainable, state, opt_state, stats = epoch_fn(
                 trainable, state, opt_state, key, x_d, y_d, sw_d)
             epoch_stats.append(stats)  # stays on device; fetched at the end
+            if verbose or epoch_callback is not None:
+                vals = np.asarray(stats)  # one host fetch for both users
             if verbose:
-                vals = np.asarray(stats)
                 print(f"Epoch {epoch_idx + 1}/{epochs} - " + " - ".join(
                     f"{name}: {val:.4f}"
                     for name, val in zip(metric_names, vals)))
+            if epoch_callback is not None:
+                logs = {name: float(val)
+                        for name, val in zip(metric_names, vals)}
+                # sync the resumable training state (params AND optimizer
+                # moments) so checkpoint callbacks capture all of it
+                model.params = model._merge_params(jax.device_get(trainable),
+                                                   jax.device_get(state))
+                model._opt_state = jax.device_get(opt_state)
+                if epoch_callback(epoch_idx, logs):
+                    break
 
         history: Dict[str, List[float]] = {}
         for stats in np.asarray(jax.device_get(epoch_stats)):

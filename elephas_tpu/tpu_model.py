@@ -207,15 +207,41 @@ class TPUModel:
         train_config.setdefault("batch_size", self.batch_size)
         self._invalidate_replica()
 
+        # driver-level callbacks: per-epoch hooks for sync_mode='step'
+        # (whose epoch loop runs on the driver); round-level (one
+        # epoch_end per fit) for model-averaging and async modes, whose
+        # epochs run inside one compiled program / inside the workers
+        from .models.callbacks import CallbackList
+
+        callbacks = train_config.pop("callbacks", None)
+        cbs = CallbackList(callbacks, self._master_network)
+        self._master_network.stop_training = False
+        cbs.train_begin()
+        histories_before = len(self._training_histories)
+
         if self.mode == "synchronous":
             if self.sync_mode == "step":
-                self._fit_sync_step(ds, **train_config)
+                self._fit_sync_step(ds, callbacks=cbs, **train_config)
             else:
                 self._fit_sync_average(ds, **train_config)
         elif self.mode in ("asynchronous", "hogwild"):
             self._fit_async(ds, **train_config)
         else:
             raise ValueError("Unsupported mode {}".format(self.mode))
+
+        if cbs and not (self.mode == "synchronous"
+                        and self.sync_mode == "step"):
+            # round logs: mean of each metric's final value across THIS
+            # fit's worker histories (async workers report none — the logs
+            # are then empty, never stale data from an earlier fit)
+            new_histories = self._training_histories[histories_before:]
+            sums: Dict[str, list] = {}
+            for hist in new_histories:
+                for k, v in hist.items():
+                    if v:
+                        sums.setdefault(k, []).append(v[-1])
+            cbs.epoch_end(0, {k: float(np.mean(v)) for k, v in sums.items()})
+        cbs.train_end()
 
     def _worker_metric_fns(self):
         from .models import metrics as metrics_mod
@@ -245,7 +271,8 @@ class TPUModel:
 
     def _fit_sync_step(self, ds: Dataset, epochs: int = 10,
                        batch_size: int = 32, verbose: int = 0,
-                       validation_split: float = 0.1, **kwargs):
+                       validation_split: float = 0.1, callbacks=None,
+                       **kwargs):
         from .parallel.sync_trainer import SyncStepTrainer
 
         replica = self._get_replica()
@@ -253,12 +280,29 @@ class TPUModel:
             replica, deserialize_optimizer(self.master_optimizer),
             self.master_loss, self._worker_metric_fns(), self.custom_objects)
         x, y = ds.to_arrays()
+
+        epoch_callback = None
+        if callbacks:
+            def epoch_callback(epoch_idx, logs):
+                # the trainer synced the replica's resumable state from
+                # device; adopt it so callbacks observe current weights
+                # and checkpoint the optimizer moments too
+                self._master_network.set_weights(replica.get_weights())
+                self._master_network._opt_state = replica._opt_state
+                callbacks.epoch_end(epoch_idx, logs)
+                return bool(getattr(self._master_network, "stop_training",
+                                    False))
+
         new_weights, history = trainer.fit(
             self._master_network.get_weights(), x, y, epochs=epochs,
             batch_size=batch_size, validation_split=validation_split,
-            seed=kwargs.get("seed", 0), verbose=verbose)
+            seed=kwargs.get("seed", 0), verbose=verbose,
+            epoch_callback=epoch_callback)
         self._training_histories.append(history)
-        self._master_network.set_weights(new_weights)
+        if not (callbacks and epochs):
+            self._master_network.set_weights(new_weights)
+        # else: the master adopted each epoch's weights in epoch_callback,
+        # and any callback mutation of them wins over the trainer result
 
     def _fit_async(self, ds: Dataset, epochs: int = 10, batch_size: int = 32,
                    verbose: int = 0, validation_split: float = 0.1, **kwargs):

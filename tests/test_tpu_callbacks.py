@@ -1,0 +1,103 @@
+"""Driver-level callbacks on TPUModel.fit: per-epoch hooks for per-step
+sync SGD, round-level hooks for model-averaging and async modes."""
+import numpy as np
+
+from elephas_tpu.models import (SGD, Dense, EarlyStopping, LambdaCallback,
+                                ModelCheckpoint, Sequential)
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils.dataset_utils import to_dataset
+
+
+def _model(lr=0.05):
+    model = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+    model.compile(SGD(learning_rate=lr), "mse", seed=0)
+    return model
+
+
+def _data(n=128):
+    rng = np.random.default_rng(0)
+    x = rng.random((n, 4), dtype=np.float32)
+    y = (x @ rng.random((4, 1), dtype=np.float32)).astype(np.float32)
+    return x, y
+
+
+def test_sync_step_per_epoch_hooks_and_early_stop():
+    x, y = _data()
+    tpu_model = TPUModel(_model(lr=0.0), mode="synchronous",
+                         sync_mode="step", num_workers=2)
+    epochs_seen = []
+    cb = LambdaCallback(on_epoch_end=lambda e, logs: epochs_seen.append(
+        (e, logs.get("loss"))))
+    # min_delta > 0: reshuffled f32 reductions can move a 'constant' loss
+    # by an ulp across epochs, which must not reset the patience counter
+    es = EarlyStopping(monitor="loss", patience=2, min_delta=1e-6)
+    tpu_model.fit(to_dataset(x, y), epochs=20, batch_size=32, verbose=0,
+                  validation_split=0.0, callbacks=[cb, es])
+    # lr=0: no improvement after the first epoch -> stop after patience
+    assert len(epochs_seen) == 3
+    assert all(isinstance(loss, float) for _, loss in epochs_seen)
+
+
+def test_sync_step_checkpoint_per_epoch(tmp_path):
+    from elephas_tpu.models import Adam
+
+    def adam_model():
+        m = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+        m.compile(Adam(learning_rate=0.01), "mse", seed=0)
+        return m
+
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "tpu_ckpts")
+    tpu_model = TPUModel(adam_model(), mode="synchronous", sync_mode="step",
+                         num_workers=2)
+    tpu_model.fit(to_dataset(x, y), epochs=3, batch_size=32, verbose=0,
+                  validation_split=0.0,
+                  callbacks=[ModelCheckpoint(ckpt_dir)])
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    assert CheckpointManager(ckpt_dir).steps() == [0, 1, 2]
+    # the checkpointed state is the master's trained weights AND the
+    # trainer's optimizer moments (full mid-training resume)
+    import jax
+
+    restored = adam_model()
+    restored.build()
+    restored.restore_training_state(ckpt_dir)
+    np.testing.assert_allclose(
+        np.asarray(restored.predict(x[:4])),
+        np.asarray(tpu_model.master_network.predict(x[:4])), atol=1e-5)
+    opt_leaves = jax.tree_util.tree_leaves(restored._opt_state)
+    assert len(opt_leaves) > 0  # Adam moments survived the round trip
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in opt_leaves)
+
+
+def test_sync_average_round_level_hooks():
+    x, y = _data()
+    tpu_model = TPUModel(_model(), mode="synchronous", num_workers=2)
+    events = []
+    cb = LambdaCallback(
+        on_train_begin=lambda logs: events.append("begin"),
+        on_epoch_end=lambda e, logs: events.append(("round", e, logs)),
+        on_train_end=lambda logs: events.append("end"))
+    tpu_model.fit(to_dataset(x, y), epochs=2, batch_size=32, verbose=0,
+                  validation_split=0.0, callbacks=[cb])
+    assert events[0] == "begin" and events[-1] == "end"
+    rounds = [e for e in events if isinstance(e, tuple)]
+    assert len(rounds) == 1  # one averaged round per fit
+    assert "loss" in rounds[0][2]
+
+
+def test_async_round_level_hooks():
+    import random
+
+    x, y = _data()
+    tpu_model = TPUModel(_model(), mode="hogwild",
+                         parameter_server_mode="socket",
+                         port=random.randint(4100, 8900), num_workers=2)
+    events = []
+    cb = LambdaCallback(
+        on_train_begin=lambda logs: events.append("begin"),
+        on_train_end=lambda logs: events.append("end"))
+    tpu_model.fit(to_dataset(x, y), epochs=1, batch_size=32, verbose=0,
+                  validation_split=0.0, callbacks=[cb])
+    assert events == ["begin", "end"]
