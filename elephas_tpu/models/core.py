@@ -401,8 +401,13 @@ class BaseModel:
             losses_sum, counts, metric_sums = 0.0, 0, None
             # shuffled gather + prefetch runs in the native loader's
             # background thread when built; numpy fallback otherwise.
-            # copy=False is safe here: each batch is consumed by the jitted
-            # step (device transfer at dispatch) before the next iteration
+            # INVARIANT: copy=False hands out views of the loader's ring
+            # buffer, and a slot is only safe to recycle because the
+            # float(loss_val) below blocks on the step — which has fully
+            # consumed xb/yb — before the next batch is requested. If that
+            # per-batch host fetch is ever deferred (e.g. for throughput),
+            # switch to copy=True or block_until_ready the step outputs,
+            # or the loader will overwrite buffers still in use.
             for batch_idx, (xb, yb) in enumerate(
                     batch_iterator((x, y), order, batch_size, copy=False)):
                 key = self._next_key()

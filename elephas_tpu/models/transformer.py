@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import attention
-from ..ops.pallas_attention import flash_attention
+from ..ops.pallas_attention import flash_attention, flash_attention_sharded
 from ..ops.ring_attention import ring_attention_sharded
 
 
@@ -167,6 +167,50 @@ def param_specs(config: TransformerConfig, model_axis: str = "model") -> Dict:
     return specs
 
 
+def _mesh_divides(mesh: Mesh, axis: Optional[str], dim: int) -> bool:
+    """True when ``dim`` splits evenly over mesh axis ``axis`` (vacuously
+    true for axis=None) — the shard_map divisibility precondition."""
+    if axis is None:
+        return True
+    size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis)
+    return size is not None and dim % size == 0
+
+
+def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
+                          seq_axis: Optional[str], batch_axis: Optional[str],
+                          model_axis: Optional[str], batch: int,
+                          backend: Optional[str] = None,
+                          n_devices: Optional[int] = None) -> str:
+    """Decide the attention execution path: ``'ring'`` (sequence-parallel),
+    ``'flash_sharded'`` (Pallas kernel per device under shard_map),
+    ``'flash'`` (bare Pallas kernel, single device) or ``'xla'``.
+
+    Pure given ``backend``/``n_devices`` (injected in tests; defaulted from
+    the live JAX runtime otherwise). Encodes the safety rules: the bare
+    Mosaic call has no SPMD partitioning rule, so ``'auto'`` only picks it
+    when exactly one device is visible, and under a mesh the kernel is
+    reached exclusively through shard_map with divisible batch/head dims.
+    """
+    c = config
+    if mesh is not None and seq_axis is not None:
+        return "ring"
+    backend = backend if backend is not None else jax.default_backend()
+    if mesh is not None:
+        if (c.attention_impl != "xla"
+                and (c.attention_impl == "flash" or backend == "tpu")
+                and _mesh_divides(mesh, batch_axis, batch)
+                and _mesh_divides(mesh, model_axis, c.num_heads)):
+            return "flash_sharded"
+        return "xla"
+    n_devices = (n_devices if n_devices is not None
+                 else len(jax.devices()))
+    if c.attention_impl == "flash" or (c.attention_impl == "auto"
+                                       and backend == "tpu"
+                                       and n_devices == 1):
+        return "flash"
+    return "xla"
+
+
 def _layer_norm(x, gamma, beta, eps=1e-5):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
@@ -197,8 +241,13 @@ def _moe_block(h, moe, config: "TransformerConfig"):
                    @ moe["gate"].astype(jnp.float32))  # (b, t, E)
     probs = jax.nn.softmax(gate_logits, axis=-1)
     if c.expert_top_k < c.num_experts:
-        kth = jnp.sort(probs, axis=-1)[..., -c.expert_top_k][..., None]
-        gates = jnp.where(probs >= kth, probs, 0.0)
+        # exact top-k via lax.top_k indices: a >=kth-value threshold would
+        # select MORE than k experts when probabilities tie (common for
+        # duplicated token contexts), silently changing the gate mass
+        _, topi = jax.lax.top_k(probs, c.expert_top_k)
+        mask = jnp.sum(jax.nn.one_hot(topi, c.num_experts,
+                                      dtype=probs.dtype), axis=-2)
+        gates = probs * mask
         if c.expert_top_k > 1:
             gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
     else:
@@ -221,14 +270,16 @@ def _moe_block(h, moe, config: "TransformerConfig"):
 
 def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
-            batch_axis: Optional[str] = None) -> jnp.ndarray:
+            batch_axis: Optional[str] = None,
+            model_axis: Optional[str] = None) -> jnp.ndarray:
     """Token ids ``(batch, seq)`` -> logits ``(batch, seq, vocab)``.
 
     When ``mesh`` and ``seq_axis`` are given, attention runs as ring
     attention with k/v shards streaming over the ``seq_axis`` ring.
     """
     logits, _ = forward_with_aux(params, tokens, config, mesh=mesh,
-                                 seq_axis=seq_axis, batch_axis=batch_axis)
+                                 seq_axis=seq_axis, batch_axis=batch_axis,
+                                 model_axis=model_axis)
     return logits
 
 
@@ -236,7 +287,8 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                      config: TransformerConfig,
                      mesh: Optional[Mesh] = None,
                      seq_axis: Optional[str] = None,
-                     batch_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
+                     batch_axis: Optional[str] = None,
+                     model_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
                                                                 jnp.ndarray]:
     """Like :func:`forward` but also returns the summed MoE auxiliary
     (load-balancing) loss — 0.0 for dense configs."""
@@ -245,6 +297,8 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
     x = params["embed"]["tokens"][tokens] + params["embed"]["pos"][:seq_len]
     x = x.astype(c.dtype)
     aux_total = jnp.zeros((), jnp.float32)
+    attn_impl = select_attention_impl(c, mesh, seq_axis, batch_axis,
+                                      model_axis, tokens.shape[0])
 
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
@@ -253,15 +307,17 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
         q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
         k = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wk"].astype(c.dtype))
         v = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wv"].astype(c.dtype))
-        if mesh is not None and seq_axis is not None:
+        if attn_impl == "ring":
             o = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis=seq_axis,
                                        causal=True, batch_axis=batch_axis)
-        elif mesh is None and (c.attention_impl == "flash" or (
-                c.attention_impl == "auto"
-                and jax.default_backend() == "tpu")):
-            # the Pallas kernel is single-device only: under a mesh the SPMD
-            # partitioner has no sharding rule for the Mosaic call, so
-            # sharded-but-not-sequence-parallel runs stay on the einsum path
+        elif attn_impl == "flash_sharded":
+            # dp/tp meshes hit the Pallas kernel through shard_map (batch
+            # pinned to the data axis, heads to the Megatron model axis —
+            # attention needs no cross-device communication)
+            o = flash_attention_sharded(q, k, v, mesh, causal=True,
+                                        batch_axis=batch_axis,
+                                        head_axis=model_axis)
+        elif attn_impl == "flash":
             o = flash_attention(q, k, v, causal=True)
         else:
             o = attention(q, k, v, causal=True)
@@ -288,11 +344,13 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
 
 def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
-            batch_axis: Optional[str] = None) -> jnp.ndarray:
+            batch_axis: Optional[str] = None,
+            model_axis: Optional[str] = None) -> jnp.ndarray:
     """Next-token cross-entropy (mean over all positions), plus the
     weighted MoE load-balancing auxiliary loss for MoE configs."""
     logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
-                                   seq_axis=seq_axis, batch_axis=batch_axis)
+                                   seq_axis=seq_axis, batch_axis=batch_axis,
+                                   model_axis=model_axis)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -315,7 +373,8 @@ def make_train_step(config: TransformerConfig, tx,
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(
             params, tokens, config, mesh=mesh, seq_axis=seq_axis,
-            batch_axis=data_axis if mesh is not None else None)
+            batch_axis=data_axis if mesh is not None else None,
+            model_axis=model_axis if mesh is not None else None)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss

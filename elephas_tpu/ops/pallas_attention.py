@@ -34,7 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_sharded"]
 
 
 def _use_interpret(interpret: Optional[bool]) -> bool:
@@ -333,3 +333,34 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     block_q = min(block_q, _round_up(q.shape[2], 32))
     block_k = min(block_k, _round_up(k.shape[2], 32))
     return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def flash_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                            mesh, causal: bool = False,
+                            batch_axis: Optional[str] = None,
+                            head_axis: Optional[str] = None,
+                            block_q: int = 256, block_k: int = 512,
+                            interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention under a device mesh.
+
+    The Mosaic kernel has no SPMD partitioning rule, so a bare
+    :func:`flash_attention` inside a GSPMD-jitted program either fails to
+    partition or replicates. Attention is independent per (batch, head), so
+    dp/tp sharding needs no communication at all: ``shard_map`` pins the
+    batch axis to ``batch_axis`` (data parallel) and the head axis to
+    ``head_axis`` (Megatron tensor parallel — the same axis the qkv/out
+    projections shard over), and each device runs the kernel on its local
+    ``(b/dp, h/tp, seq, d)`` block. Sequence parallelism is NOT handled
+    here — that is :func:`~elephas_tpu.ops.ring_attention.ring_attention_sharded`.
+    """
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as _P
+
+    spec = _P(batch_axis, head_axis, None, None)
+    fn = jax.shard_map(
+        _partial(flash_attention, causal=causal, block_q=block_q,
+                 block_k=block_k, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

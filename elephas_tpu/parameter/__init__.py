@@ -1,3 +1,5 @@
 from .client import BaseParameterClient, HttpClient, SocketClient
-from .factory import ClientServerFactory, HttpFactory, SocketFactory
+from .factory import (ClientServerFactory, HttpFactory, SocketFactory,
+                      Transport, available_transports, get_transport,
+                      register_transport)
 from .server import BaseParameterServer, HttpServer, SocketServer

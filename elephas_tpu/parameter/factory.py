@@ -1,47 +1,87 @@
-"""Matched client/server construction keyed by transport type.
+"""Transport registry: matched parameter client/server construction.
 
-(Parity surface: ``elephas/parameter/factory.py:6-42``.)
+Async/hogwild training needs a client and server speaking the same
+transport (capability parity with ``elephas/parameter/factory.py:6-42``,
+which dispatches via an abstract-factory subclass scan). Here a transport
+is a plain registry entry — registering a new one is one call, and the
+registry itself is the single source of truth for what transports exist.
 """
-from abc import ABC, abstractmethod
+from typing import Dict, NamedTuple, Type
 
-from .client import HttpClient, SocketClient
-from .server import HttpServer, SocketServer
+from .client import BaseParameterClient, HttpClient, SocketClient
+from .server import BaseParameterServer, HttpServer, SocketServer
 
 
-class ClientServerFactory(ABC):
-    _type = "base"
+class Transport(NamedTuple):
+    """A matched (client, server) pair for one wire protocol."""
 
-    @classmethod
-    def get_factory(cls, _type: str) -> "ClientServerFactory":
-        try:
-            return next(c for c in cls.__subclasses__() if c._type == _type)()
-        except StopIteration:
-            raise ValueError("Unknown factory type {}".format(_type))
+    client_cls: Type[BaseParameterClient]
+    server_cls: Type[BaseParameterServer]
 
-    @abstractmethod
-    def create_client(self, *args, **kwargs):
-        pass
+    def create_client(self, *args, **kwargs) -> BaseParameterClient:
+        return self.client_cls(*args, **kwargs)
 
-    @abstractmethod
-    def create_server(self, *args, **kwargs):
-        pass
+    def create_server(self, *args, **kwargs) -> BaseParameterServer:
+        return self.server_cls(*args, **kwargs)
+
+
+_TRANSPORTS: Dict[str, Transport] = {}
+
+
+def register_transport(name: str, client_cls: Type[BaseParameterClient],
+                       server_cls: Type[BaseParameterServer]) -> None:
+    """Register (or replace) a named transport."""
+    _TRANSPORTS[name] = Transport(client_cls, server_cls)
+
+
+def get_transport(name: str) -> Transport:
+    """Look up a registered transport by name (e.g. ``'http'``)."""
+    try:
+        return _TRANSPORTS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown transport {name!r}; registered: "
+            f"{sorted(_TRANSPORTS)}") from None
+
+
+def available_transports():
+    """Names of all registered transports."""
+    return sorted(_TRANSPORTS)
+
+
+register_transport("http", HttpClient, HttpServer)
+register_transport("socket", SocketClient, SocketServer)
+
+
+class ClientServerFactory:
+    """Back-compat shim over the registry: ``get_factory(name)`` returns the
+    :class:`Transport`, which has the same ``create_client``/``create_server``
+    surface the old factory objects exposed.
+
+    New transports are added with :func:`register_transport` (the single
+    extension point) — there is no subclass auto-registration.
+    """
+
+    @staticmethod
+    def get_factory(name: str) -> Transport:
+        return get_transport(name)
 
 
 class HttpFactory(ClientServerFactory):
-    _type = "http"
+    """Back-compat alias for ``get_transport('http')``."""
 
     def create_client(self, *args, **kwargs):
-        return HttpClient(*args, **kwargs)
+        return get_transport("http").create_client(*args, **kwargs)
 
     def create_server(self, *args, **kwargs):
-        return HttpServer(*args, **kwargs)
+        return get_transport("http").create_server(*args, **kwargs)
 
 
 class SocketFactory(ClientServerFactory):
-    _type = "socket"
+    """Back-compat alias for ``get_transport('socket')``."""
 
     def create_client(self, *args, **kwargs):
-        return SocketClient(*args, **kwargs)
+        return get_transport("socket").create_client(*args, **kwargs)
 
     def create_server(self, *args, **kwargs):
-        return SocketServer(*args, **kwargs)
+        return get_transport("socket").create_server(*args, **kwargs)

@@ -59,6 +59,11 @@ class BaseParameterServer(abc.ABC):
         self._seen_lock = threading.Lock()
         self._seen_ttl = 600.0
         self._seen_cap = 1 << 17
+        # ids whose apply is still in flight: a duplicate resend arriving
+        # while the original is mid-apply (the lost-ack retry scenario)
+        # waits on the latch instead of racing past the _seen_ids check
+        # and double-applying the delta
+        self._in_flight: Dict[str, threading.Event] = {}
 
     def get_weights(self) -> List[np.ndarray]:
         if self.mode == "asynchronous":
@@ -72,28 +77,48 @@ class BaseParameterServer(abc.ABC):
     def apply_delta(self, delta: List[np.ndarray],
                     update_id: Optional[str] = None):
         if update_id is not None:
-            with self._seen_lock:
-                if update_id in self._seen_ids:
-                    return  # duplicate resend from a client retry
-        if self.mode == "asynchronous":
-            self.lock.acquire_write()
+            # claim the id before applying. A duplicate of a completed
+            # apply returns immediately; a duplicate of an IN-FLIGHT apply
+            # waits on its latch and re-checks — it must neither double-
+            # apply nor ack before the first apply has actually landed.
+            while True:
+                with self._seen_lock:
+                    if update_id in self._seen_ids:
+                        return  # duplicate resend from a client retry
+                    latch = self._in_flight.get(update_id)
+                    if latch is None:
+                        latch = threading.Event()
+                        self._in_flight[update_id] = latch
+                        break  # we own the apply for this id
+                latch.wait(timeout=60.0)
         try:
-            self.weights = subtract_params(self.weights, delta)
-        finally:
             if self.mode == "asynchronous":
-                self.lock.release()
+                self.lock.acquire_write()
+            try:
+                self.weights = subtract_params(self.weights, delta)
+            finally:
+                if self.mode == "asynchronous":
+                    self.lock.release()
+        except BaseException:
+            if update_id is not None:
+                # failed apply: release the claim WITHOUT recording the id,
+                # so the client's resend retries the apply instead of being
+                # acked for a delta that never landed
+                with self._seen_lock:
+                    self._in_flight.pop(update_id, None)
+                latch.set()
+            raise
         if update_id is not None:
-            # record only AFTER a successful apply: if the apply raised, the
-            # client's resend must not hit the duplicate branch and get a
-            # success ack for a delta that was never applied
             now = time.monotonic()
             with self._seen_lock:
                 self._seen_ids[update_id] = now
+                self._in_flight.pop(update_id, None)
                 while self._seen_ids and (
                         len(self._seen_ids) > self._seen_cap
                         or next(iter(self._seen_ids.values()))
                         < now - self._seen_ttl):
                     self._seen_ids.popitem(last=False)
+            latch.set()
         with self._counter_lock:
             self.num_updates += 1
 

@@ -1,0 +1,62 @@
+"""Transport-registry tests (the parameter client/server pairing layer)."""
+import pytest
+
+from elephas_tpu.parameter import (BaseParameterClient, BaseParameterServer,
+                                   ClientServerFactory, HttpClient, HttpServer,
+                                   SocketClient, SocketServer, Transport,
+                                   available_transports, get_transport,
+                                   register_transport)
+
+
+def test_registry_pairs():
+    assert available_transports() == ["http", "socket"]
+    http = get_transport("http")
+    assert http.client_cls is HttpClient and http.server_cls is HttpServer
+    sock = get_transport("socket")
+    assert sock.client_cls is SocketClient and sock.server_cls is SocketServer
+
+
+def test_unknown_transport():
+    with pytest.raises(ValueError, match="carrier-pigeon"):
+        get_transport("carrier-pigeon")
+
+
+def test_transport_constructs_matched_pair():
+    transport = get_transport("http")
+    client = transport.create_client(4000)
+    assert isinstance(client, HttpClient)
+
+
+def test_back_compat_factory_shim():
+    transport = ClientServerFactory.get_factory("socket")
+    assert isinstance(transport, Transport)
+    assert isinstance(transport.create_client(4001), SocketClient)
+
+
+def test_register_custom_transport():
+    class NullClient(BaseParameterClient):
+        def get_parameters(self):
+            return []
+
+        def update_parameters(self, delta):
+            pass
+
+        def health_check(self):
+            return True
+
+    class NullServer(BaseParameterServer):
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+    register_transport("null", NullClient, NullServer)
+    try:
+        t = get_transport("null")
+        assert t.client_cls is NullClient
+        assert "null" in available_transports()
+    finally:
+        from elephas_tpu.parameter.factory import _TRANSPORTS
+
+        _TRANSPORTS.pop("null", None)

@@ -201,3 +201,37 @@ def test_duplicate_update_id_applied_once(server_cls, client_cls):
             np.testing.assert_allclose(got, np.asarray(orig) - 1.0, atol=1e-6)
     finally:
         server.stop()
+
+
+def test_concurrent_duplicate_update_id_applied_once(monkeypatch):
+    """The lost-ack race: a duplicate arriving while the ORIGINAL apply is
+    still in flight must wait on the per-id latch, not double-apply."""
+    import time as time_mod
+
+    from elephas_tpu.parameter import server as server_mod
+
+    payload = _serialized_model()
+    server = HttpServer(payload, _next_port(), "asynchronous")
+    initial = [w.copy() for w in server.weights]
+
+    real_subtract = server_mod.subtract_params
+
+    def slow_subtract(weights, delta):
+        time_mod.sleep(0.3)  # hold the apply in flight while the dup arrives
+        return real_subtract(weights, delta)
+
+    monkeypatch.setattr(server_mod, "subtract_params", slow_subtract)
+    delta = [np.ones_like(w) for w in initial]
+
+    threads = [threading.Thread(
+        target=server.apply_delta, args=(delta,), kwargs={"update_id": "dup"})
+        for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+    assert server.num_updates == 1
+    assert not server._in_flight
+    for got, start in zip(server.get_weights(), initial):
+        np.testing.assert_allclose(got, start - 1.0, atol=1e-6)
