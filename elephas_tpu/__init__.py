@@ -9,6 +9,17 @@ Spark jobs and pickled RPC.
 """
 __version__ = "0.1.0"
 
+# multi-host launches: jax.distributed.initialize must run before anything
+# touches the XLA backend, so hook it at import (no-op unless
+# JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES are set and no backend is up)
+import os as _os
+
+if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or _os.environ.get("JAX_NUM_PROCESSES")):
+    from .parallel.multihost import maybe_initialize_from_env as _mh_init
+
+    _mh_init()
+
 from . import models, utils
 from .data import Dataset
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

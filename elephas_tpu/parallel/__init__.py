@@ -1,7 +1,9 @@
-from .mesh import data_mesh, make_mesh, replicate, shard_leading, worker_mesh
-from .multihost import (coordinator_bind_env, global_batch_from_host_data,
-                        global_data_mesh, host_local_slice,
-                        initialize_multihost, is_coordinator)
+from .mesh import (data_mesh, make_mesh, replicate, shard_leading,
+                   spans_processes, worker_mesh)
+from .multihost import (barrier, coordinator_bind_env, ensure_multihost,
+                        global_batch_from_host_data, global_data_mesh,
+                        host_local_slice, initialize_multihost,
+                        is_coordinator)
 from .pipeline import make_pipeline_fn, stack_stage_params
 from .sync_trainer import (SyncAverageTrainer, SyncStepTrainer,
                            build_sharded_evaluate, build_sharded_predict,

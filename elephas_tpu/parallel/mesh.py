@@ -17,16 +17,27 @@ def worker_mesh(num_workers: int, devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over the ``workers`` axis.
 
     Picks the largest device count that evenly divides ``num_workers`` so a
-    stacked per-worker computation shards cleanly; falls back to a single
-    device when nothing divides (e.g. 3 workers on 8 chips -> 1 device,
-    still correct, just unsharded).
+    stacked per-worker computation shards cleanly; falls back to fewer
+    devices when nothing divides (still correct, just less parallel) — and
+    says so, because silently running 5 workers on 1 of 8 chips is a perf
+    cliff the user should hear about.
     """
+    import warnings
+
     devices = list(devices if devices is not None else jax.devices())
     d = 1
     for candidate in range(min(num_workers, len(devices)), 0, -1):
         if num_workers % candidate == 0:
             d = candidate
             break
+    ideal = min(num_workers, len(devices))
+    if d < ideal:
+        warnings.warn(
+            f"num_workers={num_workers} does not divide across "
+            f"{len(devices)} devices; the sync-average job will use only "
+            f"{d} device(s). Pick a worker count that is a multiple (or "
+            f"divisor) of the device count for full utilization.",
+            RuntimeWarning, stacklevel=2)
     return Mesh(np.array(devices[:d]), ("workers",))
 
 
@@ -50,13 +61,32 @@ def make_mesh(axis_sizes: Tuple[Tuple[str, int], ...],
     return Mesh(grid, tuple(names))
 
 
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of other processes (multi-host
+    DCN execution) — placement must then go through global-array assembly
+    instead of a plain ``device_put``."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _place(array, sharding, mesh: Mesh):
+    if spans_processes(mesh):
+        # every process holds the full array (single-controller API
+        # contract) and uploads only the shards of its addressable
+        # devices; the result is one global jax.Array spanning hosts
+        array = np.asarray(array)
+        return jax.make_array_from_callback(array.shape, sharding,
+                                            lambda idx: array[idx])
+    return jax.device_put(array, sharding)
+
+
 def shard_leading(mesh: Mesh, axis: str, array):
     """Place an array with its leading dim sharded over ``axis``."""
     spec = PartitionSpec(axis, *([None] * (np.ndim(array) - 1)))
-    return jax.device_put(array, NamedSharding(mesh, spec))
+    return _place(array, NamedSharding(mesh, spec), mesh)
 
 
 def replicate(mesh: Mesh, tree):
     """Replicate a pytree across the mesh."""
     sharding = NamedSharding(mesh, PartitionSpec())
-    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
+    return jax.tree_util.tree_map(lambda a: _place(a, sharding, mesh), tree)

@@ -53,6 +53,70 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         else int(os.environ.get("JAX_PROCESS_ID", "0")))
 
 
+def ensure_multihost() -> bool:
+    """Entry-point hook for :meth:`TPUModel.fit`: initialize the JAX
+    distributed runtime when the standard env vars say this is a
+    multi-process launch, and report whether the run spans processes.
+
+    Deliberately env-gated — a plain single-host run must not trigger
+    coordinator auto-detection (which could stall probing for a pod).
+
+    Best-effort by construction: ``jax.distributed.initialize`` must run
+    before anything touches the XLA backend, and building/compiling a
+    model already does. If the backend beat us to it, warn with the fix
+    (call :func:`initialize_multihost` — or ``elephas_tpu`` import-time
+    auto-init — before building models) instead of crashing the fit.
+    """
+    if (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_NUM_PROCESSES")):
+        try:
+            initialize_multihost()
+        except RuntimeError as err:
+            import warnings
+
+            warnings.warn(
+                "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES are set but the "
+                "distributed runtime could not be initialized here "
+                f"({err}); jax.distributed.initialize must run before any "
+                "JAX backend use. Import elephas_tpu (which auto-"
+                "initializes from these env vars) or call "
+                "elephas_tpu.parallel.initialize_multihost() before "
+                "building models. Continuing single-process.",
+                RuntimeWarning, stacklevel=2)
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def maybe_initialize_from_env():
+    """Import-time hook: initialize the distributed runtime iff the
+    standard env vars are present AND no XLA backend exists yet. Safe to
+    call unconditionally; never raises."""
+    if not (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_NUM_PROCESSES")):
+        return
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return
+    except (ImportError, AttributeError):
+        pass
+    try:
+        initialize_multihost()
+    except Exception:
+        pass  # fit()'s ensure_multihost will surface the warning
+
+
+def barrier(name: str):
+    """Cross-process rendezvous (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def is_coordinator() -> bool:
     """True on process 0 — where the parameter server and checkpoint
     writes live."""

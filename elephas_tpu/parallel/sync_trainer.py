@@ -166,6 +166,10 @@ class SyncAverageTrainer:
                 lambda a, b: (a - b) * active_w, params0, params_final)
             return delta, history
 
+        from .mesh import spans_processes
+
+        multihost = spans_processes(mesh)
+
         def all_workers(params0, X, Y, SW, active, keys):
             deltas, histories = jax.vmap(
                 local_train, in_axes=(None, 0, 0, 0, 0, 0))(
@@ -175,10 +179,17 @@ class SyncAverageTrainer:
                 lambda d: jnp.mean(d, axis=0), deltas)
             new_params = jax.tree_util.tree_map(
                 lambda p, d: p - d, params0, mean_delta)
+            if multihost:
+                # per-worker histories stay sharded over the worker axis —
+                # replicate them so every host can fetch the full set
+                histories = jax.lax.with_sharding_constraint(
+                    histories, NamedSharding(mesh, PartitionSpec()))
             return new_params, histories
 
         from .mesh import replicate, shard_leading
+        from ..utils.tracing import StepTimer
 
+        self.timer = timer = StepTimer()
         with mesh:
             X_d = shard_leading(mesh, "workers", X)
             Y_d = shard_leading(mesh, "workers", Y)
@@ -187,10 +198,12 @@ class SyncAverageTrainer:
             keys = jax.random.split(jax.random.PRNGKey(seed), num_workers)
             keys_d = shard_leading(mesh, "workers", keys)
             params_d = replicate(mesh, params0)
+            timer.start()
             new_params, histories = jax.jit(all_workers)(
                 params_d, X_d, Y_d, SW_d, active_d, keys_d)
 
-        model.params = jax.device_get(new_params)
+        model.params = jax.device_get(new_params)  # forces completion
+        timer.stop()
         new_weights = model.get_weights()
 
         histories = np.asarray(jax.device_get(histories))  # (W, epochs, 1+M)
@@ -204,6 +217,10 @@ class SyncAverageTrainer:
             hist = {}
             for j, name in enumerate(metric_names):
                 hist[name] = [float(v) for v in histories[w, :, j]]
+            # all workers run inside one compiled program, so the only
+            # observable wall time is the whole fit's (compile excluded on
+            # warm runs); surfaced per the survey's tracing requirement
+            hist["fit_time"] = [timer.total]
             history_dicts.append(hist)
         return new_weights, history_dicts
 
@@ -285,7 +302,7 @@ class SyncStepTrainer:
     def fit(self, weights: List[np.ndarray], x: np.ndarray, y: np.ndarray,
             epochs: int, batch_size: int, validation_split: float = 0.0,
             shuffle: bool = True, seed: int = 0, verbose: int = 0,
-            epoch_callback=None):
+            epoch_callback=None, timing: bool = True):
         """Train; returns (new_weights, history dict).
 
         ``epoch_callback(epoch_idx, logs) -> bool`` fires after each epoch
@@ -293,6 +310,12 @@ class SyncStepTrainer:
         set, the replica model's params are synced from device before each
         call (so the callback can snapshot/checkpoint weights) — this costs
         a device fetch per epoch, so it is opt-in.
+
+        With ``timing=True`` (default) each epoch's wall time lands in
+        ``history['epoch_time']`` — real time, not dispatch time, because
+        the per-epoch stats fetch forces the epoch program to complete.
+        ``timing=False`` skips that host round-trip (pure-throughput runs
+        on remote-attached TPUs) unless verbose/callbacks need it anyway.
         """
         from .mesh import replicate, shard_leading
 
@@ -328,14 +351,22 @@ class SyncStepTrainer:
         base_key = jax.random.PRNGKey(seed)
         metric_names = ["loss"] + [metrics_mod.serialize(fn)
                                    for fn in self.metric_fns]
+        from ..utils.tracing import StepTimer
+
+        self.timer = timer = StepTimer()
         epoch_stats = []
         for epoch_idx in range(int(epochs)):
             key = jax.random.fold_in(base_key, epoch_idx)
+            timer.start()
             trainable, state, opt_state, stats = epoch_fn(
                 trainable, state, opt_state, key, x_d, y_d, sw_d)
             epoch_stats.append(stats)  # stays on device; fetched at the end
-            if verbose or epoch_callback is not None:
-                vals = np.asarray(stats)  # one host fetch for both users
+            if timing or verbose or epoch_callback is not None:
+                # one host fetch serves timing, verbose and callbacks — and
+                # fetching the stats forces the dispatched epoch program to
+                # complete, which is what makes the recorded time real
+                vals = np.asarray(stats)
+            timer.stop()
             if verbose:
                 print(f"Epoch {epoch_idx + 1}/{epochs} - " + " - ".join(
                     f"{name}: {val:.4f}"
@@ -355,6 +386,8 @@ class SyncStepTrainer:
         for stats in np.asarray(jax.device_get(epoch_stats)):
             for name, val in zip(metric_names, stats):
                 history.setdefault(name, []).append(float(val))
+        if timing:
+            history["epoch_time"] = list(timer.durations)
 
         model.params = self.model._merge_params(
             jax.device_get(trainable), jax.device_get(state))
@@ -370,12 +403,18 @@ def build_sharded_predict(model: BaseModel, mesh=None):
     device multiple, sharded, predicted, and sliced back — order never
     changes.
     """
-    from .mesh import data_mesh, replicate, shard_leading
+    from .mesh import data_mesh, replicate, shard_leading, spans_processes
 
     mesh = mesh if mesh is not None else data_mesh()
     ndev = int(np.prod(mesh.devices.shape))
 
-    jit_apply = jax.jit(lambda params, xb: model.apply(params, xb, training=False))
+    # multi-host meshes: all-gather the predictions onto every host (a
+    # host cannot device_get shards living on another host's devices)
+    out_sharding = (NamedSharding(mesh, PartitionSpec())
+                    if spans_processes(mesh) else None)
+    jit_apply = jax.jit(
+        lambda params, xb: model.apply(params, xb, training=False),
+        out_shardings=out_sharding)
 
     def predict(x: np.ndarray, batch_size: int = 1024) -> np.ndarray:
         x = model._prepare_x(x)
@@ -401,7 +440,7 @@ def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
     """Sharded masked evaluation; exactly equals single-process evaluation
     because every metric is a per-sample mean (sample-count weighting,
     parity with ``elephas/spark_model.py:300-308``)."""
-    from .mesh import data_mesh, replicate, shard_leading
+    from .mesh import data_mesh, replicate, shard_leading, spans_processes
 
     mesh = mesh if mesh is not None else data_mesh()
     ndev = int(np.prod(mesh.devices.shape))
@@ -415,7 +454,9 @@ def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
         vals.append(jnp.sum(swb))
         return jnp.stack(vals)
 
-    jit_stats = jax.jit(batch_stats)
+    jit_stats = jax.jit(batch_stats, out_shardings=(
+        NamedSharding(mesh, PartitionSpec())
+        if spans_processes(mesh) else None))
 
     def evaluate(x: np.ndarray, y: np.ndarray, batch_size: int = 1024):
         x = model._prepare_x(x)

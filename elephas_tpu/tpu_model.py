@@ -35,7 +35,7 @@ from .mllib.linalg import Matrix, Vector
 from .models import deserialize_optimizer, get_optimizer, serialize_optimizer
 from .models.core import BaseModel
 from .models.saving import load_model
-from .parameter.factory import ClientServerFactory
+from .parameter.factory import get_transport
 from .utils.dataset_utils import lp_to_dataset, to_dataset
 from .utils.serialization import model_to_dict
 from .worker import AsyncWorker
@@ -95,11 +95,11 @@ class TPUModel:
         self.parameter_server = None
         self.client = None
         if self.mode != "synchronous":
-            factory = ClientServerFactory.get_factory(self.parameter_server_mode)
-            self.parameter_server = factory.create_server(
+            transport = get_transport(self.parameter_server_mode)
+            self.parameter_server = transport.create_server(
                 self.serialized_model, self.port, self.mode,
                 custom_objects=self.custom_objects)
-            self.client = factory.create_client(self.port)
+            self.client = transport.create_client(self.port)
 
         self._replica = None  # lazily-built worker replica for predict/eval
         self._predict_fn = None
@@ -188,9 +188,20 @@ class TPUModel:
     def fit(self, dataset: Union[Dataset, tuple], **kwargs):
         """Distributed training over a partitioned dataset.
 
+        Multi-host (DCN) execution: when launched as a JAX-distributed
+        program (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES`` env, or
+        TPU-pod auto-detection via :func:`initialize_multihost`), every
+        process calls ``fit`` with the same dataset. Synchronous modes
+        train over the global mesh spanning all hosts' devices; async
+        modes start the parameter server on the coordinator and run each
+        host's workers against it over DCN.
+
         :param dataset: pair :class:`Dataset` or ``(features, labels)``
         :param epochs, batch_size, verbose, validation_split: as in Keras
         """
+        from .parallel.multihost import ensure_multihost
+
+        ensure_multihost()
         ds = self._as_dataset(dataset)
         if self.num_workers:
             ds = ds.repartition(self.num_workers)
@@ -308,32 +319,89 @@ class TPUModel:
                    verbose: int = 0, validation_split: float = 0.1, **kwargs):
         import concurrent.futures
 
-        self.start_server()
+        import jax
+
+        from .parallel.multihost import (barrier, coordinator_bind_env,
+                                         is_coordinator)
+
+        multi = jax.process_count() > 1
+        if multi:
+            # the PS lives on the coordinator host; broadcast its address
+            # so every process's clients resolve to it over DCN, then
+            # rebuild this process's client against the resolved address
+            # (the HTTP client binds its URL at construction)
+            coordinator_bind_env(self.port)
+            transport = get_transport(self.parameter_server_mode)
+            self.client = transport.create_client(self.port)
+        serving = (not multi) or is_coordinator()
+
+        # Multi-host discipline: a barrier skipped by ONE process hangs
+        # every other process forever (sync_global_devices has no
+        # timeout), so a local failure must not short-circuit the barrier
+        # sequence — record it, drain the same barriers as everyone else,
+        # then raise. Peers of a failed process fail in bounded time too:
+        # clients give up after their retry deadline against a dead PS.
+        failure = None
         try:
-            train_config = {"epochs": epochs, "batch_size": batch_size,
-                            "verbose": verbose,
-                            "validation_split": validation_split}
-            model_json = self._master_network.to_json()
-            init = self._master_network.get_weights()
-            shards = ds.partitions()
+            if serving:
+                self.start_server()
+        except Exception as err:
+            failure = err
+        if multi:
+            barrier("elephas_tpu_ps_up")  # workers must not race a down PS
+        try:
+            if failure is None:
+                train_config = {"epochs": epochs, "batch_size": batch_size,
+                                "verbose": verbose,
+                                "validation_split": validation_split}
+                model_json = self._master_network.to_json()
+                init = self._master_network.get_weights()
+                shards = ds.partitions()
+                if multi:
+                    # every process sees the same partition list (same
+                    # dataset, same repartition); each takes a disjoint
+                    # strided slice
+                    shards = shards[jax.process_index()::jax.process_count()]
 
-            def run_worker(shard):
-                x_w, y_w = shard
-                worker = AsyncWorker(
-                    model_json, init, self.client, train_config,
-                    self.frequency, self.master_optimizer, self.master_loss,
-                    self.master_metrics, self.custom_objects, port=self.port)
-                worker.train(np.asarray(x_w), np.asarray(y_w))
+                def run_worker(shard):
+                    x_w, y_w = shard
+                    worker = AsyncWorker(
+                        model_json, init, self.client, train_config,
+                        self.frequency, self.master_optimizer,
+                        self.master_loss, self.master_metrics,
+                        self.custom_objects, port=self.port)
+                    worker.train(np.asarray(x_w), np.asarray(y_w))
 
-            with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=len(shards)) as pool:
-                futures = [pool.submit(run_worker, shard) for shard in shards]
-                for f in futures:
-                    f.result()
-            new_parameters = self.client.get_parameters()
-            self._master_network.set_weights(new_parameters)
-        finally:
-            self.stop_server()
+                if shards:
+                    with concurrent.futures.ThreadPoolExecutor(
+                            max_workers=len(shards)) as pool:
+                        futures = [pool.submit(run_worker, shard)
+                                   for shard in shards]
+                        for f in futures:
+                            f.result()
+        except Exception as err:
+            failure = err
+        if multi:
+            barrier("elephas_tpu_workers_done")
+        try:
+            if failure is None:
+                # every process pulls the final weights BEFORE the
+                # coordinator tears the server down, so all hosts leave
+                # fit() in agreement
+                new_parameters = self.client.get_parameters()
+                self._master_network.set_weights(new_parameters)
+        except Exception as err:
+            failure = err
+        if multi:
+            barrier("elephas_tpu_params_pulled")
+        if serving:
+            try:
+                self.stop_server()
+            except Exception:
+                if failure is None:
+                    raise
+        if failure is not None:
+            raise failure
 
     # ------------------------------------------------------------ predict/eval
     def _invalidate_replica(self):

@@ -1,0 +1,63 @@
+"""Subprocess entry point for the multi-host integration tests.
+
+Each invocation is one JAX-distributed process (CPU backend, 2 local
+virtual devices) running the SAME TPUModel.fit program — the
+single-controller multi-host recipe. Results are written to
+``<outdir>/weights_<pid>.npz`` for the parent test to compare.
+
+Usage: python mh_driver.py <mode> <sync_mode> <pid> <nprocs> <jax_port> \
+       <ps_port> <outdir>
+"""
+import os
+import sys
+
+
+def main():
+    mode, sync_mode, pid, nprocs, jax_port, ps_port, outdir = sys.argv[1:8]
+    pid, nprocs, jax_port, ps_port = (int(pid), int(nprocs), int(jax_port),
+                                      int(ps_port))
+
+    import jax
+
+    # the env's sitecustomize pins JAX_PLATFORMS to the TPU plugin; tests
+    # must override through jax.config BEFORE any backend initialization
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2 if nprocs > 1 else 4)
+    if nprocs > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{jax_port}",
+            num_processes=nprocs, process_id=pid)
+
+    import numpy as np
+
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+
+    # deterministic separable 3-class problem, identical on every process
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w_true = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w_true, axis=1)]
+
+    model = Sequential([Dense(16, input_dim=8, activation="relu"),
+                        Dense(3, activation="softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  metrics=["acc"], seed=0)
+
+    kwargs = {"sync_mode": sync_mode} if mode == "synchronous" else {}
+    tpu_model = TPUModel(model, mode=mode, num_workers=4, batch_size=32,
+                         port=ps_port, parameter_server_mode="http", **kwargs)
+    tpu_model.fit((x, y), epochs=3, batch_size=32, validation_split=0.0,
+                  verbose=0)
+
+    weights = tpu_model.master_network.get_weights()
+    np.savez(os.path.join(outdir, f"weights_{pid}.npz"),
+             *[np.asarray(w) for w in weights])
+    # distributed predict must also work across hosts
+    preds = tpu_model.predict(x[:32])
+    np.savez(os.path.join(outdir, f"preds_{pid}.npz"), preds=np.asarray(preds))
+    print(f"proc {pid}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
