@@ -152,6 +152,23 @@ class HasSyncMode(Params):
         return self.getOrDefault(self.sync_mode)
 
 
+class HasSeed(Params):
+    """Deterministic-run seed for weight init and data shuffling. ``None``
+    (default) draws from entropy — set it for reproducible training runs
+    (an upgrade over the reference, which has no seeding at all)."""
+
+    def __init__(self):
+        super().__init__()
+        self.seed = Param(self, "seed", "RNG seed; None -> entropy")
+        self._setDefault(seed=None)
+
+    def set_seed(self, seed):
+        return self._set(seed=seed)
+
+    def get_seed(self):
+        return self.getOrDefault(self.seed)
+
+
 class HasNumberOfClasses(Params):
     def __init__(self):
         super().__init__()

@@ -25,14 +25,14 @@ from .params import (HasBatchSize, HasCategoricalLabels, HasCustomObjects,
                      HasInferenceBatchSize, HasLabelCol, HasLoss, HasMetrics,
                      HasMode, HasModelConfig, HasNumberOfClasses,
                      HasNumberOfWorkers, HasOptimizerConfig, HasOutputCol,
-                     HasSyncMode, HasValidationSplit, HasVerbosity)
+                     HasSeed, HasSyncMode, HasValidationSplit, HasVerbosity)
 
 
 class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
                 HasFeaturesCol, HasLabelCol, HasMode, HasEpochs, HasBatchSize,
                 HasFrequency, HasVerbosity, HasNumberOfClasses,
                 HasNumberOfWorkers, HasOutputCol, HasLoss, HasMetrics,
-                HasOptimizerConfig, HasCustomObjects, HasSyncMode):
+                HasOptimizerConfig, HasCustomObjects, HasSyncMode, HasSeed):
     """Configurable distributed-training estimator.
 
     ``fit(df)`` -> trained :class:`Transformer`.
@@ -58,6 +58,7 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
         HasOptimizerConfig.__init__(self)
         HasCustomObjects.__init__(self)
         HasSyncMode.__init__(self)
+        HasSeed.__init__(self)
         self.set_params(**kwargs)
 
     def set_params(self, **kwargs):
@@ -80,7 +81,8 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
                 "verbose": self.get_verbosity(),
                 "nb_classes": self.get_nb_classes(),
                 "outputCol": self.getOutputCol(),
-                "sync_mode": self.get_sync_mode()}
+                "sync_mode": self.get_sync_mode(),
+                "seed": self.get_seed()}
 
     def save(self, file_name: str):
         with h5py.File(file_name, mode="w") as f:
@@ -106,9 +108,11 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
         optimizer_config = self.get_optimizer_config()
         optimizer = (get_optimizer(optimizer_config) if optimizer_config
                      else "sgd")
+        seed = self.get_seed()
         model.compile(loss=loss, optimizer=optimizer,
                       metrics=self.get_metrics(),
-                      custom_objects=self.get_custom_objects())
+                      custom_objects=self.get_custom_objects(),
+                      seed=seed)
 
         tpu_model = TPUModel(model=model, mode=self.get_mode(),
                              frequency=self.get_frequency(),
@@ -118,7 +122,8 @@ class Estimator(HasCategoricalLabels, HasValidationSplit, HasModelConfig,
         tpu_model.fit(dataset, epochs=self.get_epochs(),
                       batch_size=self.get_batch_size(),
                       verbose=self.get_verbosity(),
-                      validation_split=self.get_validation_split())
+                      validation_split=self.get_validation_split(),
+                      **({} if seed is None else {"seed": seed}))
 
         return Transformer(
             labelCol=self.getLabelCol(),

@@ -19,11 +19,15 @@ def _class_df(mnist_data, n=400):
 
 
 def _estimator(model, loss="categorical_crossentropy", **overrides):
+    # lr=0.05: measured stable for this task across init seeds (0.1 sits
+    # on the divergence threshold — loss oscillates and accuracy is
+    # init-seed-dependent); seed=0 pins weight init so runs are
+    # deterministic
     config = dict(model_config=model.to_json(),
-                  optimizer_config=serialize_optimizer(SGD(learning_rate=0.1)),
+                  optimizer_config=serialize_optimizer(SGD(learning_rate=0.05)),
                   mode="synchronous", loss=loss, metrics=["acc"],
                   categorical=True, nb_classes=10, epochs=15, batch_size=64,
-                  validation_split=0.1, num_workers=2, verbose=0)
+                  validation_split=0.1, num_workers=2, verbose=0, seed=0)
     config.update(overrides)
     return Estimator(**config)
 
@@ -51,11 +55,11 @@ def test_classification_pipeline(mnist_data, classification_model):
     assert isinstance(first, list) and len(first) == 10
     # probabilities
     assert abs(sum(first) - 1.0) < 1e-3
-    # sanity: trained model does clearly better than chance (0.1) on
-    # separable data
+    # deterministic config converges hard on this separable task — hold
+    # it to a real bar, not barely-above-chance
     correct = sum(1 for _, row in result.iterrows()
                   if int(np.argmax(row["prediction"])) == int(row["label"]))
-    assert correct / len(result) > 0.3
+    assert correct / len(result) > 0.8
 
 
 def test_classification_pipeline_functional(mnist_data,
