@@ -59,7 +59,9 @@ PAGES = [
      ["TransformerConfig", "init_params", "param_specs", "forward",
       "forward_with_aux", "lm_loss", "make_train_step", "shard_params"]),
     ("Pipeline parallelism", "elephas_tpu.parallel.pipeline",
-     ["make_pipeline_fn", "stack_stage_params"]),
+     ["make_pipeline_fn", "stack_stage_params", "split_transformer_stages",
+      "merge_transformer_stages", "shard_pipelined_params",
+      "make_pipelined_lm_loss", "make_pipelined_train_step"]),
     ("Callbacks", "elephas_tpu.models.callbacks",
      ["Callback", "EarlyStopping", "ModelCheckpoint", "LambdaCallback"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),

@@ -217,6 +217,67 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return ((x - mean) * jax.lax.rsqrt(var + eps)) * gamma + beta
 
 
+def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
+                attn_fn) -> jnp.ndarray:
+    """Pre-LN attention sublayer with residual; ``attn_fn(q, k, v) -> o``
+    supplies the attention implementation."""
+    h = _layer_norm(x, layer["ln1"]["gamma"], layer["ln1"]["beta"])
+    h = h.astype(c.dtype)
+    q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
+    k = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wk"].astype(c.dtype))
+    v = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wv"].astype(c.dtype))
+    o = attn_fn(q, k, v)
+    return x + jnp.einsum("bhtk,hkd->btd", o,
+                          layer["attn"]["wo"].astype(c.dtype))
+
+
+def _mlp_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig) -> jnp.ndarray:
+    """Pre-LN dense MLP sublayer with residual."""
+    h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
+    h = h.astype(c.dtype)
+    h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
+                    + layer["mlp"]["b1"].astype(c.dtype))
+    h = (h @ layer["mlp"]["w2"].astype(c.dtype)
+         + layer["mlp"]["b2"].astype(c.dtype))
+    return x + h
+
+
+def block_apply(layer: Dict, x: jnp.ndarray, config: TransformerConfig,
+                attn_fn=None) -> jnp.ndarray:
+    """One full dense transformer block ``(batch, seq, d_model) ->
+    same shape`` — the shape-preserving unit the GPipe pipeline stages
+    (:mod:`~elephas_tpu.parallel.pipeline`) are built from. Defaults to
+    causal XLA attention (each pipeline stage sees full local sequence)."""
+    if attn_fn is None:
+        attn_fn = partial(attention, causal=True)
+    x = _attn_apply(layer, x, config, attn_fn)
+    return _mlp_apply(layer, x, config)
+
+
+def embed_apply(embed: Dict, tokens: jnp.ndarray,
+                config: TransformerConfig) -> jnp.ndarray:
+    """Token + positional embedding -> activations in the compute dtype.
+    Shared by the monolithic forward and the pipelined LM entry."""
+    x = embed["tokens"][tokens] + embed["pos"][:tokens.shape[1]]
+    return x.astype(config.dtype)
+
+
+def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final layer norm + tied-embedding head; f32 logits for a stable
+    softmax. Shared by the monolithic forward and the pipelined LM exit."""
+    x = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
+                    final_ln["beta"])
+    return x @ embed["tokens"].T.astype(jnp.float32)
+
+
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over all positions."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
 def _moe_block(h, moe, config: "TransformerConfig"):
     """Gated mixture-of-experts MLP with dense (einsum) dispatch.
 
@@ -293,53 +354,38 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
     """Like :func:`forward` but also returns the summed MoE auxiliary
     (load-balancing) loss — 0.0 for dense configs."""
     c = config
-    seq_len = tokens.shape[1]
-    x = params["embed"]["tokens"][tokens] + params["embed"]["pos"][:seq_len]
-    x = x.astype(c.dtype)
+    x = embed_apply(params["embed"], tokens, c)
     aux_total = jnp.zeros((), jnp.float32)
     attn_impl = select_attention_impl(c, mesh, seq_axis, batch_axis,
                                       model_axis, tokens.shape[0])
+    if attn_impl == "ring":
+        attn_fn = partial(ring_attention_sharded, mesh=mesh,
+                          seq_axis=seq_axis, causal=True,
+                          batch_axis=batch_axis)
+    elif attn_impl == "flash_sharded":
+        # dp/tp meshes hit the Pallas kernel through shard_map (batch
+        # pinned to the data axis, heads to the Megatron model axis —
+        # attention needs no cross-device communication)
+        attn_fn = partial(flash_attention_sharded, mesh=mesh, causal=True,
+                          batch_axis=batch_axis, head_axis=model_axis)
+    elif attn_impl == "flash":
+        attn_fn = partial(flash_attention, causal=True)
+    else:
+        attn_fn = partial(attention, causal=True)
 
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
-        h = _layer_norm(x, layer["ln1"]["gamma"], layer["ln1"]["beta"])
-        h = h.astype(c.dtype)
-        q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
-        k = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wk"].astype(c.dtype))
-        v = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wv"].astype(c.dtype))
-        if attn_impl == "ring":
-            o = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis=seq_axis,
-                                       causal=True, batch_axis=batch_axis)
-        elif attn_impl == "flash_sharded":
-            # dp/tp meshes hit the Pallas kernel through shard_map (batch
-            # pinned to the data axis, heads to the Megatron model axis —
-            # attention needs no cross-device communication)
-            o = flash_attention_sharded(q, k, v, mesh, causal=True,
-                                        batch_axis=batch_axis,
-                                        head_axis=model_axis)
-        elif attn_impl == "flash":
-            o = flash_attention(q, k, v, causal=True)
-        else:
-            o = attention(q, k, v, causal=True)
-        attn_out = jnp.einsum("bhtk,hkd->btd", o,
-                              layer["attn"]["wo"].astype(c.dtype))
-        x = x + attn_out
-        h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
-        h = h.astype(c.dtype)
+        x = _attn_apply(layer, x, c, attn_fn)
         if c.num_experts > 1:
+            h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
+            h = h.astype(c.dtype)
             h, aux = _moe_block(h, layer["moe"], c)
             aux_total = aux_total + aux
+            x = x + h
         else:
-            h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
-                            + layer["mlp"]["b1"].astype(c.dtype))
-            h = (h @ layer["mlp"]["w2"].astype(c.dtype)
-                 + layer["mlp"]["b2"].astype(c.dtype))
-        x = x + h
+            x = _mlp_apply(layer, x, c)
 
-    x = _layer_norm(x.astype(jnp.float32), params["final_ln"]["gamma"],
-                    params["final_ln"]["beta"])
-    # tied embedding head; f32 logits for a stable softmax
-    return x @ params["embed"]["tokens"].T.astype(jnp.float32), aux_total
+    return head_logits(params["embed"], params["final_ln"], x), aux_total
 
 
 def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
@@ -351,11 +397,7 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
                                    seq_axis=seq_axis, batch_axis=batch_axis,
                                    model_axis=model_axis)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(picked)
+    loss = next_token_loss(logits, tokens)
     if config.num_experts > 1 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
     return loss

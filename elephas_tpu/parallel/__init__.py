@@ -4,7 +4,10 @@ from .multihost import (barrier, coordinator_bind_env, ensure_multihost,
                         global_batch_from_host_data, global_data_mesh,
                         host_local_slice, initialize_multihost,
                         is_coordinator)
-from .pipeline import make_pipeline_fn, stack_stage_params
+from .pipeline import (make_pipeline_fn, make_pipelined_lm_loss,
+                       make_pipelined_train_step, merge_transformer_stages,
+                       shard_pipelined_params, split_transformer_stages,
+                       stack_stage_params)
 from .sync_trainer import (SyncAverageTrainer, SyncStepTrainer,
                            build_sharded_evaluate, build_sharded_predict,
                            stack_shards)

@@ -70,10 +70,16 @@ def spans_processes(mesh: Mesh) -> bool:
 
 
 def _place(array, sharding, mesh: Mesh):
-    if spans_processes(mesh):
+    import os
+
+    force = os.environ.get("ELEPHAS_TPU_FORCE_GLOBAL_ASSEMBLY", "")
+    if spans_processes(mesh) or force.lower() not in ("", "0", "false"):
         # every process holds the full array (single-controller API
         # contract) and uploads only the shards of its addressable
-        # devices; the result is one global jax.Array spanning hosts
+        # devices; the result is one global jax.Array spanning hosts.
+        # The env flag forces this path on single-process meshes so the
+        # multi-host assembly code is exercised by dryruns/CI without
+        # real multi-process launches.
         array = np.asarray(array)
         return jax.make_array_from_callback(array.shape, sharding,
                                             lambda idx: array[idx])

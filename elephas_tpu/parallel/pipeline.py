@@ -20,13 +20,16 @@ to end (``shard_map``/``ppermute``/``scan`` all have transpose rules), so
 ``jax.grad`` of a pipelined loss just works; the backward pass is the
 reverse pipeline.
 """
-from typing import Callable
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_pipeline_fn", "stack_stage_params"]
+__all__ = ["make_pipeline_fn", "stack_stage_params",
+           "split_transformer_stages", "merge_transformer_stages",
+           "shard_pipelined_params", "make_pipelined_lm_loss",
+           "make_pipelined_train_step"]
 
 
 def stack_stage_params(per_stage_params):
@@ -99,3 +102,134 @@ def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, axis: str = "pipe",
         return y.reshape(x.shape[0:1] + y.shape[2:])
 
     return pipelined
+
+
+# --------------------------------------------------------- pipelined LM
+# End-to-end pipeline-parallel training of the flagship transformer:
+# embedding and LM head live OUTSIDE the shape-preserving stage stack
+# (they change the activation shape, so they cannot be pipeline stages),
+# the transformer blocks flow through the GPipe schedule above, and the
+# optimizer steps over the stage-stacked parameter pytree. Gradient
+# accumulation across microbatches is inherent: the loss averages over
+# the full batch, so differentiating through the pipeline's scan sums
+# each stage's gradient contributions over all of its microbatches —
+# exactly GPipe's accumulate-then-apply semantics, derived by transpose
+# instead of hand-scheduled.
+
+def split_transformer_stages(params: Dict, config, num_stages: int) -> Dict:
+    """Rearrange a :func:`~elephas_tpu.models.transformer.init_params`
+    pytree for pipeline execution:
+
+    ``{"embed", "final_ln", "stages"}`` where ``stages`` stacks the
+    ``layer_i`` subtrees as ``(num_stages, layers_per_stage, ...)`` —
+    leading axis sharded over ``pipe``, second axis looped inside a stage.
+    """
+    L = config.num_layers
+    if L % num_stages:
+        raise ValueError(f"{L} layers do not split into {num_stages} "
+                         "equal pipeline stages")
+    per_stage = L // num_stages
+    stages = [
+        jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[params[f"layer_{s * per_stage + j}"] for j in range(per_stage)])
+        for s in range(num_stages)]
+    return {"embed": params["embed"], "final_ln": params["final_ln"],
+            "stages": stack_stage_params(stages)}
+
+
+def merge_transformer_stages(pipe_params: Dict, config) -> Dict:
+    """Inverse of :func:`split_transformer_stages` — back to the flat
+    ``layer_i`` layout (checkpoint interop, parity tests)."""
+    stages = pipe_params["stages"]
+    num_stages = jax.tree_util.tree_leaves(stages)[0].shape[0]
+    per_stage = config.num_layers // num_stages
+    params = {"embed": pipe_params["embed"],
+              "final_ln": pipe_params["final_ln"]}
+    for s in range(num_stages):
+        for j in range(per_stage):
+            params[f"layer_{s * per_stage + j}"] = jax.tree_util.tree_map(
+                lambda p: p[s, j], stages)
+    return params
+
+
+def shard_pipelined_params(pipe_params: Dict, mesh: Mesh,
+                           axis: str = "pipe") -> Dict:
+    """Place the pipelined pytree: stage stack sharded over ``axis``
+    (device s holds stage s's layers), embed/head replicated."""
+    def put(path_is_stage, p):
+        if path_is_stage:
+            spec = P(axis, *([None] * (p.ndim - 1)))
+        else:
+            spec = P()
+        return jax.device_put(p, NamedSharding(mesh, spec))
+
+    return {
+        "embed": jax.tree_util.tree_map(lambda p: put(False, p),
+                                        pipe_params["embed"]),
+        "final_ln": jax.tree_util.tree_map(lambda p: put(False, p),
+                                           pipe_params["final_ln"]),
+        "stages": jax.tree_util.tree_map(lambda p: put(True, p),
+                                         pipe_params["stages"]),
+    }
+
+
+def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
+                           num_microbatches: Optional[int] = None):
+    """Build ``loss(pipe_params, tokens)`` — next-token cross-entropy of
+    the transformer LM with its blocks running as a GPipe pipeline.
+
+    Dense configs only: MoE blocks route over the ``model`` axis, which
+    composes with tp, not pp-stage stacking. Attention inside a stage is
+    always the XLA path (each stage owns the full local sequence; the
+    Pallas kernel would need its own shard_map nesting).
+    """
+    from ..models.transformer import (block_apply, embed_apply, head_logits,
+                                      next_token_loss)
+
+    if config.num_experts > 1:
+        raise ValueError(
+            "pipelined LM training supports dense configs only "
+            f"(num_experts={config.num_experts}); shard experts over the "
+            "'model' axis with make_train_step instead")
+    num_stages = mesh.shape[axis]
+    per_stage = config.num_layers // num_stages
+    if config.num_layers % num_stages:
+        raise ValueError(f"{config.num_layers} layers do not split into "
+                         f"{num_stages} equal pipeline stages")
+
+    def stage_fn(stage_params, x):
+        for j in range(per_stage):
+            layer = jax.tree_util.tree_map(lambda p: p[j], stage_params)
+            x = block_apply(layer, x, config)
+        return x
+
+    pipe_fn = make_pipeline_fn(stage_fn, mesh, axis=axis,
+                               num_microbatches=num_microbatches)
+
+    def loss(pipe_params, tokens):
+        x = embed_apply(pipe_params["embed"], tokens, config)
+        x = pipe_fn(pipe_params["stages"], x)
+        logits = head_logits(pipe_params["embed"], pipe_params["final_ln"], x)
+        return next_token_loss(logits, tokens)
+
+    return loss
+
+
+def make_pipelined_train_step(config, tx, mesh: Mesh, axis: str = "pipe",
+                              num_microbatches: Optional[int] = None):
+    """Jitted ``(pipe_params, opt_state, tokens) -> (pipe_params,
+    opt_state, loss)``: forward + backward through the pipeline (gradient
+    accumulation over microbatches via the scan transpose) and an optax
+    update over the stage-stacked pytree, all in one compiled program."""
+    loss_fn = make_pipelined_lm_loss(config, mesh, axis=axis,
+                                     num_microbatches=num_microbatches)
+
+    def step(pipe_params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(pipe_params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, pipe_params)
+        pipe_params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                             pipe_params, updates)
+        return pipe_params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
