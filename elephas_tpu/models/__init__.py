@@ -12,5 +12,6 @@ from .optimizers import (SGD, Adadelta, Adagrad, Adam, AdamW, Nadam,
 from .optimizers import deserialize as deserialize_optimizer
 from .optimizers import get as get_optimizer
 from .optimizers import serialize as serialize_optimizer
-from .resnet import build_resnet, build_resnet8
+from .resnet import (build_resnet, build_resnet8, build_resnet50,
+                     build_resnet_imagenet)
 from .saving import load_model, save_model

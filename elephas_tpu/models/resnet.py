@@ -5,11 +5,11 @@ own layers — Conv2D/BatchNorm/Add — exercising the functional graph,
 merge layers and batch-stat threading end to end. NHWC layout, MXU-sized
 channel counts.
 """
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 from .core import Model
 from .layers import (Activation, Add, BatchNormalization, Conv2D, Dense,
-                     GlobalAveragePooling2D, Input)
+                     GlobalAveragePooling2D, Input, MaxPooling2D)
 
 
 def _conv_bn_relu(x, filters, kernel_size=3, strides=1, activation=True,
@@ -59,6 +59,60 @@ def build_resnet(input_shape: Tuple[int, int, int] = (32, 32, 3),
     x = GlobalAveragePooling2D()(x)
     outputs = Dense(num_classes, activation="softmax")(x)
     return Model(inputs=inputs, outputs=outputs, name=name or f"resnet{depth}")
+
+
+def _bottleneck_block(x, filters, strides=1, name=None):
+    """ImageNet-style bottleneck (He et al. §4): 1x1 reduce -> 3x3 ->
+    1x1 expand (4x), projection shortcut on shape change. The 3x3 conv
+    carries the stride (the 'ResNet v1.5' placement every modern
+    implementation and benchmark uses — it keeps more spatial
+    information than striding the 1x1 and is MXU-friendlier)."""
+    expansion = 4
+    shortcut = x
+    n = (lambda s: None if name is None else f"{name}_{s}")
+    y = _conv_bn_relu(x, filters, kernel_size=1, name=n("a"))
+    y = _conv_bn_relu(y, filters, kernel_size=3, strides=strides, name=n("b"))
+    y = _conv_bn_relu(y, filters * expansion, kernel_size=1,
+                      activation=False, name=n("c"))
+    if strides != 1 or x.shape[-1] != filters * expansion:
+        shortcut = Conv2D(filters * expansion, 1, strides=strides,
+                          padding="same", use_bias=False, name=n("proj"))(x)
+        shortcut = BatchNormalization(name=n("proj_bn"))(shortcut)
+    out = Add(name=n("add"))([y, shortcut])
+    return Activation("relu", name=n("out"))(out)
+
+
+def build_resnet_imagenet(input_shape: Tuple[int, int, int] = (224, 224, 3),
+                          num_classes: int = 1000,
+                          stage_blocks: Sequence[int] = (3, 4, 6, 3),
+                          name: Optional[str] = None) -> Model:
+    """ImageNet-family ResNet with bottleneck blocks: 7x7/2 stem, 3x3/2
+    max pool, four stages at 64/128/256/512 base filters (x4 expansion).
+    ``stage_blocks`` (3,4,6,3) -> ResNet-50, (3,4,23,3) -> ResNet-101,
+    (3,8,36,3) -> ResNet-152."""
+    inputs = Input(shape=input_shape)
+    x = _conv_bn_relu(inputs, 64, kernel_size=7, strides=2, name="stem")
+    x = MaxPooling2D(pool_size=3, strides=2, padding="same",
+                     name="stem_pool")(x)
+    filters = 64
+    for stage, blocks in enumerate(stage_blocks):
+        for block in range(blocks):
+            strides = 2 if stage > 0 and block == 0 else 1
+            x = _bottleneck_block(x, filters, strides=strides,
+                                  name=f"s{stage}b{block}")
+        filters *= 2
+    x = GlobalAveragePooling2D()(x)
+    outputs = Dense(num_classes, activation="softmax")(x)
+    depth = 2 + 3 * sum(stage_blocks)
+    return Model(inputs=inputs, outputs=outputs,
+                 name=name or f"resnet{depth}")
+
+
+def build_resnet50(input_shape: Tuple[int, int, int] = (224, 224, 3),
+                   num_classes: int = 1000) -> Model:
+    """ResNet-50 (the BASELINE.md benchmark workload)."""
+    return build_resnet_imagenet(input_shape, num_classes,
+                                 stage_blocks=(3, 4, 6, 3), name="resnet50")
 
 
 def build_resnet8(input_shape=(32, 32, 3), num_classes=10) -> Model:

@@ -2,7 +2,9 @@ import numpy as np
 import pytest
 
 from elephas_tpu.models import model_from_json
-from elephas_tpu.models.resnet import build_resnet, build_resnet8
+from elephas_tpu.models.resnet import (build_resnet, build_resnet8,
+                                       build_resnet50,
+                                       build_resnet_imagenet)
 
 
 def test_resnet8_trains_and_round_trips():
@@ -29,6 +31,42 @@ def test_resnet20_structure():
     model = build_resnet(depth=20)
     assert model.built
     assert model.output_shape == (10,)
+
+
+def test_resnet50_structure_and_forward():
+    """The BASELINE workload: bottleneck blocks, correct depth and
+    parameter count, probability outputs."""
+    model = build_resnet50(input_shape=(64, 64, 3), num_classes=10)
+    assert model.built
+    n_params = sum(int(np.prod(np.asarray(w).shape))
+                   for w in model.get_weights())
+    # 23.5M backbone + 10-class head (25.6M with the 1000-class head)
+    assert 23_000_000 < n_params < 24_000_000
+    x = np.random.default_rng(0).random((2, 64, 64, 3), dtype=np.float32)
+    model.compile("adam", "categorical_crossentropy", seed=0)
+    preds = model.predict(x)
+    assert preds.shape == (2, 10)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_bottleneck_resnet_sync_step_training():
+    """Small bottleneck-family net through the sync-step trainer (the
+    benchmark configuration) — loss must drop."""
+    from elephas_tpu import TPUModel
+    from elephas_tpu.utils import to_dataset
+
+    model = build_resnet_imagenet(input_shape=(32, 32, 3), num_classes=10,
+                                  stage_blocks=(1, 1))
+    model.compile("adam", "categorical_crossentropy", seed=0)
+    x = np.random.default_rng(0).random((32, 32, 32, 3), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[
+        np.random.default_rng(1).integers(0, 10, 32)]
+    tpu_model = TPUModel(model, mode="synchronous", sync_mode="step",
+                         num_workers=4)
+    tpu_model.fit(to_dataset(x, y), epochs=3, batch_size=8,
+                  validation_split=0.0)
+    hist = tpu_model.training_histories[-1]
+    assert hist["loss"][-1] < hist["loss"][0]
 
 
 def test_resnet8_distributed_sync():
