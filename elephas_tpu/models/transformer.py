@@ -57,6 +57,16 @@ class TransformerConfig:
     #: weight of the load-balancing auxiliary loss (Switch eq. 4) added to
     #: the LM loss — 0 disables it
     moe_aux_weight: float = 0.01
+    #: expert dispatch: ``dense`` computes every expert for every token and
+    #: lets the gate zero the rest (static shapes, exact, FLOPs scale with
+    #: ``num_experts``); ``routed`` scatters tokens into per-expert
+    #: capacity buffers so FLOPs scale with ``expert_top_k`` (tokens over
+    #: capacity are dropped, Switch-style); ``auto`` picks routed for
+    #: large expert counts and dense for tiny ones / expert-sharded meshes
+    moe_dispatch: str = "auto"
+    #: routed-dispatch expert capacity = ``ceil(capacity_factor * top_k *
+    #: tokens / num_experts)`` — 1.0 is exact-balance, >1 gives headroom
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "flash", "xla"):
@@ -65,6 +75,11 @@ class TransformerConfig:
         if self.num_experts > 1 and not (
                 1 <= self.expert_top_k <= self.num_experts):
             raise ValueError("expert_top_k must be in [1, num_experts]")
+        if self.moe_dispatch not in ("auto", "dense", "routed"):
+            raise ValueError("moe_dispatch must be 'auto', 'dense' or "
+                             f"'routed', got {self.moe_dispatch!r}")
+        if self.moe_capacity_factor <= 0:
+            raise ValueError("moe_capacity_factor must be positive")
 
     @property
     def head_dim(self) -> int:
@@ -278,14 +293,29 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(picked)
 
 
-def _moe_block(h, moe, config: "TransformerConfig"):
-    """Gated mixture-of-experts MLP with dense (einsum) dispatch.
+def select_moe_dispatch(config: "TransformerConfig",
+                        mesh: Optional[Mesh] = None,
+                        model_axis: Optional[str] = None) -> str:
+    """Resolve ``config.moe_dispatch`` to ``'dense'`` or ``'routed'``.
 
-    Every expert runs on its owning device for all tokens and the top-k
-    gate zeroes the rest — dense dispatch trades routed-FLOP savings for
-    perfectly static shapes (no capacity overflow, XLA-friendly) while
-    still *distributing* expert compute over the mesh via the
-    expert-sharded parameters.
+    ``auto`` picks routed dispatch (FLOPs ∝ top_k) once the expert count
+    is big enough for the savings to matter, but stays dense when the
+    experts are sharded over a mesh axis (expert parallelism keeps the
+    per-device einsum; routed's scatter indices would force GSPMD to
+    regather the expert-sharded capacity buffers)."""
+    if config.moe_dispatch != "auto":
+        return config.moe_dispatch
+    expert_sharded = (mesh is not None and model_axis is not None
+                      and dict(zip(mesh.axis_names,
+                                   mesh.devices.shape)).get(model_axis, 1) > 1)
+    if config.num_experts > 4 and not expert_sharded:
+        return "routed"
+    return "dense"
+
+
+def _moe_gates(h, moe, config: "TransformerConfig"):
+    """Shared router: f32 softmax probabilities, exact top-k selection and
+    the Switch load-balancing aux loss.
 
     The router runs in f32 (bf16 logits would tie-break wrongly and the
     module's contract keeps softmaxes f32). Gating: full softmax first,
@@ -294,32 +324,54 @@ def _moe_block(h, moe, config: "TransformerConfig"):
     starve the router of gradient), for k>1 the selected probabilities
     are renormalized (Mixtral style).
 
+    Returns ``(probs, gate_vals, topi, aux)`` with ``gate_vals``/``topi``
+    of shape ``(..., top_k)``.
+    """
+    c = config
+    gate_logits = (h.astype(jnp.float32)
+                   @ moe["gate"].astype(jnp.float32))  # (..., E)
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    # exact top-k via lax.top_k indices: a >=kth-value threshold would
+    # select MORE than k experts when probabilities tie (common for
+    # duplicated token contexts), silently changing the gate mass
+    gate_vals, topi = jax.lax.top_k(probs, c.expert_top_k)
+    if c.expert_top_k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    # Switch aux loss (eq. 4): num_experts * sum_e f_e * P_e, where f_e is
+    # the fraction of tokens whose top choice is e and P_e the mean router
+    # probability of e — minimized by a uniform routing distribution
+    lead_axes = tuple(range(probs.ndim - 1))
+    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), c.num_experts,
+                          dtype=jnp.float32)
+    aux = c.num_experts * jnp.sum(jnp.mean(top1, axis=lead_axes)
+                                  * jnp.mean(probs, axis=lead_axes))
+    return probs, gate_vals, topi, aux
+
+
+def _moe_block(h, moe, config: "TransformerConfig",
+               dispatch: Optional[str] = None):
+    """Gated mixture-of-experts MLP.
+
+    Dense dispatch runs every expert on all tokens and lets the top-k
+    gate zero the rest — trades routed-FLOP savings for perfectly static
+    shapes while still *distributing* expert compute over the mesh via
+    the expert-sharded parameters. Routed dispatch
+    (:func:`_moe_block_routed`) scatters tokens into per-expert capacity
+    buffers so FLOPs scale with ``top_k`` instead of ``num_experts``.
+
     Returns ``(out, aux)`` where ``aux`` is the Switch load-balancing
     loss term for this block (f32 scalar).
     """
     c = config
-    gate_logits = (h.astype(jnp.float32)
-                   @ moe["gate"].astype(jnp.float32))  # (b, t, E)
-    probs = jax.nn.softmax(gate_logits, axis=-1)
-    if c.expert_top_k < c.num_experts:
-        # exact top-k via lax.top_k indices: a >=kth-value threshold would
-        # select MORE than k experts when probabilities tie (common for
-        # duplicated token contexts), silently changing the gate mass
-        _, topi = jax.lax.top_k(probs, c.expert_top_k)
-        mask = jnp.sum(jax.nn.one_hot(topi, c.num_experts,
-                                      dtype=probs.dtype), axis=-2)
-        gates = probs * mask
-        if c.expert_top_k > 1:
-            gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
-    else:
-        gates = probs
-    # Switch aux loss (eq. 4): num_experts * sum_e f_e * P_e, where f_e is
-    # the fraction of tokens whose top choice is e and P_e the mean router
-    # probability of e — minimized by a uniform routing distribution
-    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), c.num_experts,
-                          dtype=jnp.float32)
-    aux = c.num_experts * jnp.sum(jnp.mean(top1, axis=(0, 1))
-                                  * jnp.mean(probs, axis=(0, 1)))
+    if dispatch is None:
+        dispatch = select_moe_dispatch(c)
+    if dispatch == "routed":
+        return _moe_block_routed(h, moe, c)
+    probs, gate_vals, topi, aux = _moe_gates(h, moe, c)
+    # scatter the (renormalized) top-k gate values back onto the E axis
+    gates = jnp.sum(jax.nn.one_hot(topi, c.num_experts,
+                                   dtype=gate_vals.dtype)
+                    * gate_vals[..., None], axis=-2)
     gates = gates.astype(c.dtype)
     he = jax.nn.gelu(
         jnp.einsum("btd,edf->betf", h, moe["w1"].astype(c.dtype))
@@ -327,6 +379,62 @@ def _moe_block(h, moe, config: "TransformerConfig"):
     out = (jnp.einsum("betf,efd->betd", he, moe["w2"].astype(c.dtype))
            + moe["b2"].astype(c.dtype)[None, :, None, :])
     return jnp.einsum("betd,bte->btd", out, gates), aux
+
+
+def _moe_block_routed(h, moe, config: "TransformerConfig"):
+    """Capacity-factor routed MoE dispatch (Switch Transformer §2.2).
+
+    Tokens scatter into per-expert buffers of capacity
+    ``C = ceil(capacity_factor * top_k * N / E)``; each expert runs its
+    MLP once over its ``(C, d_model)`` buffer, and outputs gather back to
+    token order weighted by the gate. Per-token expert FLOPs are
+    ``capacity_factor * top_k * 2 * d_model * d_ff`` — independent of
+    ``num_experts`` (dense dispatch pays ``num_experts``×). Assignments
+    beyond an expert's capacity are dropped (their gate contribution is
+    zero — the token passes through on the residual stream only), with
+    earlier tokens and higher-ranked choices winning: the static-shape
+    price of routing, bounded by the aux loss keeping the router
+    balanced. All shapes are static: XLA-friendly scatter-add/gather, no
+    host sync.
+    """
+    c = config
+    B, T, D = h.shape
+    N = B * T
+    k = c.expert_top_k
+    E = c.num_experts
+    capacity = int(np.ceil(c.moe_capacity_factor * k * N / E))
+    capacity = min(max(capacity, 1), N)
+
+    hf = h.reshape(N, D)
+    probs, gate_vals, topi, aux = _moe_gates(hf, moe, c)
+
+    # flatten assignments token-major so earlier tokens (and, within a
+    # token, higher-ranked choices) win the capacity race
+    experts = topi.reshape(N * k)              # (N*k,)
+    assign = jax.nn.one_hot(experts, E, dtype=jnp.int32)  # (N*k, E)
+    # position of each assignment within its expert's buffer
+    pos_in_expert = jnp.cumsum(assign, axis=0) - assign
+    pos = jnp.sum(pos_in_expert * assign, axis=-1)        # (N*k,)
+    keep = pos < capacity
+
+    token_idx = jnp.arange(N * k) // k
+    xs = hf[token_idx]                                    # (N*k, D)
+    # out-of-capacity scatters land on mode='drop'; their gathers below
+    # are masked through the zeroed gate
+    buf = jnp.zeros((E, capacity, D), c.dtype)
+    buf = buf.at[experts, pos].add(xs.astype(c.dtype), mode="drop")
+
+    he = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", buf, moe["w1"].astype(c.dtype))
+        + moe["b1"].astype(c.dtype)[:, None, :])
+    out_buf = (jnp.einsum("ecf,efd->ecd", he, moe["w2"].astype(c.dtype))
+               + moe["b2"].astype(c.dtype)[:, None, :])
+
+    gate_flat = (gate_vals.reshape(N * k)
+                 * keep.astype(gate_vals.dtype)).astype(c.dtype)
+    picked = out_buf[experts, jnp.minimum(pos, capacity - 1)]  # (N*k, D)
+    out = jnp.sum((picked * gate_flat[:, None]).reshape(N, k, D), axis=1)
+    return out.reshape(B, T, D), aux
 
 
 def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
@@ -373,13 +481,15 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
     else:
         attn_fn = partial(attention, causal=True)
 
+    moe_dispatch = (select_moe_dispatch(c, mesh, model_axis)
+                    if c.num_experts > 1 else None)
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
         x = _attn_apply(layer, x, c, attn_fn)
         if c.num_experts > 1:
             h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
             h = h.astype(c.dtype)
-            h, aux = _moe_block(h, layer["moe"], c)
+            h, aux = _moe_block(h, layer["moe"], c, dispatch=moe_dispatch)
             aux_total = aux_total + aux
             x = x + h
         else:

@@ -274,3 +274,102 @@ def test_moe_sharded_matches_unsharded():
     sharded = np.asarray(jax.jit(lambda p, t: forward(p, t, config))(
         params_sharded, tokens_sharded))
     np.testing.assert_allclose(expected, sharded, atol=2e-3)
+
+
+def test_moe_routed_matches_dense_when_nothing_drops():
+    """With capacity_factor = E/k the capacity equals the token count, so
+    no assignment can drop and routed dispatch must agree with dense
+    dispatch exactly (same router, same experts, different data path)."""
+    from elephas_tpu.models.transformer import _moe_block
+
+    config = _moe_config(num_experts=4, expert_top_k=2, num_layers=1,
+                         moe_capacity_factor=2.0)  # C = N: lossless
+    params = init_params(config, jax.random.PRNGKey(0))
+    moe = params["layer_0"]["moe"]
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 8, config.d_model),
+                          jnp.float32)
+    dense, aux_d = _moe_block(h, moe, config, dispatch="dense")
+    routed, aux_r = _moe_block(h, moe, config, dispatch="routed")
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(dense),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux_r), float(aux_d), rtol=1e-6)
+
+
+def test_moe_routed_flops_scale_with_top_k_not_experts():
+    """The point of routed dispatch: expert-MLP FLOPs stay ~constant as
+    num_experts grows (dense doubles when E doubles)."""
+    from elephas_tpu.models.transformer import _moe_block
+
+    def flops(num_experts, dispatch):
+        config = _moe_config(num_experts=num_experts, expert_top_k=2,
+                             num_layers=1, moe_capacity_factor=1.0)
+        params = init_params(config, jax.random.PRNGKey(0))
+        moe = params["layer_0"]["moe"]
+        h = jnp.zeros((4, 32, config.d_model), jnp.float32)
+        lowered = jax.jit(
+            lambda hh, mm: _moe_block(hh, mm, config, dispatch=dispatch)
+        ).lower(h, moe)
+        return lowered.cost_analysis()["flops"]
+
+    dense8, dense16 = flops(8, "dense"), flops(16, "dense")
+    routed8, routed16 = flops(8, "routed"), flops(16, "routed")
+    assert dense16 > 1.7 * dense8          # dense pays num_experts x
+    assert routed16 < 1.3 * routed8        # routed pays top_k x
+    assert routed8 < 0.5 * dense8          # and wins outright at E=8
+
+
+def test_moe_routed_drops_over_capacity_tokens():
+    """Assignments beyond an expert's capacity contribute nothing: with a
+    gate forced to a single expert and capacity < N, exactly the first
+    `capacity` tokens (token-major priority) produce output."""
+    from elephas_tpu.models.transformer import _moe_block
+
+    config = _moe_config(num_experts=4, expert_top_k=1, num_layers=1,
+                         moe_capacity_factor=1.0)  # C = N/E = 2
+    params = init_params(config, jax.random.PRNGKey(0))
+    moe = dict(params["layer_0"]["moe"])
+    # rig the router: a zero gate gives every token identical logits, and
+    # top_k tie-breaks to expert 0 — all 8 tokens chase one expert
+    moe["gate"] = jnp.zeros_like(moe["gate"])
+    h = jax.random.normal(jax.random.PRNGKey(2), (1, 8, config.d_model),
+                          jnp.float32)
+    out, _ = _moe_block(h, moe, config, dispatch="routed")
+    out = np.asarray(out)
+    capacity = 2  # ceil(1.0 * 1 * 8 / 4)
+    assert np.abs(out[0, :capacity]).max() > 0
+    np.testing.assert_allclose(out[0, capacity:], 0.0, atol=1e-7)
+
+
+def test_moe_routed_trains_and_router_gets_gradient():
+    config = _moe_config(num_experts=8, expert_top_k=2,
+                         moe_dispatch="routed", moe_aux_weight=0.0)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                config.vocab_size)
+    grads = jax.grad(lm_loss)(params, tokens, config)
+    assert np.abs(np.asarray(grads["layer_0"]["moe"]["gate"])).max() > 0
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
+
+
+def test_moe_dispatch_auto_selection():
+    from elephas_tpu.models.transformer import select_moe_dispatch
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    small = _moe_config(num_experts=4)
+    big = _moe_config(num_experts=8)
+    assert select_moe_dispatch(small) == "dense"
+    assert select_moe_dispatch(big) == "routed"
+    # expert-sharded mesh keeps the per-device einsum path
+    assert select_moe_dispatch(big, mesh, "model") == "dense"
+    # dp-only usage of the same mesh still routes
+    assert select_moe_dispatch(big, mesh, None) == "routed"
+    forced = _moe_config(num_experts=2, moe_dispatch="routed")
+    assert select_moe_dispatch(forced, mesh, "model") == "routed"
