@@ -193,16 +193,21 @@ class Transformer(HasModelConfig, HasLabelCol, HasOutputCol, HasFeaturesCol,
                 "labelCol": self.getLabelCol(),
                 "featuresCol": self.getFeaturesCol(),
                 "outputCol": self.getOutputCol(),
-                "weights": [np.asarray(w).tolist()
-                            for w in (self.weights or [])],
                 "model_type": self.model_type}
 
     def save(self, file_name: str):
+        # weights go into h5 datasets, not the JSON config attr: the
+        # reference JSON-encodes full weights as nested Python lists
+        # (``elephas/ml_model.py:172-186``), which at TPU-scale weight
+        # counts is an OOM/file-size bomb and loses dtype
         with h5py.File(file_name, mode="w") as f:
             f.attrs["distributed_config"] = json.dumps({
                 "class_name": self.__class__.__name__,
                 "config": self.get_config(),
             }, cls=ModelTypeEncoder).encode("utf8")
+            group = f.create_group("model_weights")
+            for i, w in enumerate(self.weights or []):
+                group.create_dataset(f"weight_{i}", data=np.asarray(w))
 
     def get_model(self):
         model = model_from_json(self.get_model_config(),
@@ -250,6 +255,11 @@ def load_ml_transformer(file_name: str) -> Transformer:
         if isinstance(conf, bytes):
             conf = conf.decode("utf8")
         elephas_conf = json.loads(conf, object_hook=as_enum)
-    config = elephas_conf.get("config")
-    config["weights"] = [np.array(w) for w in config["weights"]]
+        config = elephas_conf.get("config")
+        group = f.get("model_weights")
+        if group is not None:
+            config["weights"] = [np.asarray(group[f"weight_{i}"])
+                                 for i in range(len(group))]
+        elif "weights" in config:  # files written by older versions
+            config["weights"] = [np.array(w) for w in config["weights"]]
     return Transformer(**config)

@@ -137,7 +137,12 @@ class BaseModel:
                     f"Shape mismatch for {ln}/{pn}: {w.shape} vs {current.shape}")
             new_params[ln][pn] = w
         self.params = new_params
-        self._invalidate_jit()
+        # deliberately NOT invalidating the jit cache: the jitted steps
+        # take params as traced arguments, and set_weights preserves every
+        # shape/dtype, so the cached executables stay valid. Invalidating
+        # here forced a full retrace per pull in the async batch loop and
+        # per predict/evaluate call after a weight sync — recompiles that
+        # dwarf the actual compute on a real TPU.
 
     # -------------------------------------------------- checkpoint state api
     def training_state(self) -> Dict:
@@ -795,4 +800,7 @@ def model_from_json(json_string: str,
         return Sequential.from_config(config, custom_objects)
     if class_name in ("Model", "Functional"):
         return Model.from_config(config, custom_objects)
+    if class_name == "TransformerModel":
+        from .transformer_model import TransformerModel
+        return TransformerModel.from_config(config, custom_objects)
     raise ValueError(f"Unknown model class: {class_name!r}")

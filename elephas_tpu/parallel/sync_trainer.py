@@ -24,7 +24,7 @@ reference's "skip training when partition <= batch_size" rule,
 ``elephas/worker.py:41``) are handled with static padding + per-sample
 masks so XLA sees fixed shapes.
 """
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -416,13 +416,25 @@ def build_sharded_predict(model: BaseModel, mesh=None):
         lambda params, xb: model.apply(params, xb, training=False),
         out_shardings=out_sharding)
 
+    # the replicated param buffers persist across predict() calls;
+    # set_weights swaps the model's params pytree object, so identity is
+    # the invalidation key (re-uploading every call made each chunked
+    # inference pay a full host->device weight transfer)
+    cache: Dict[str, Any] = {"key": None, "value": None}
+
+    def replicated_params():
+        if cache["key"] is not model.params:
+            cache["value"] = replicate(mesh, model.params)
+            cache["key"] = model.params
+        return cache["value"]
+
     def predict(x: np.ndarray, batch_size: int = 1024) -> np.ndarray:
         x = model._prepare_x(x)
         n = x.shape[0]
         if n == 0:
             return np.zeros((0,) + tuple(model.output_shape), dtype=np.float32)
         chunk = int(-(-min(batch_size, n) // ndev) * ndev)
-        params = replicate(mesh, model.params)
+        params = replicated_params()
         outs = []
         for start in range(0, n, chunk):
             xb = _pad_to(x[start:start + chunk], chunk)
@@ -458,12 +470,21 @@ def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
         NamedSharding(mesh, PartitionSpec())
         if spans_processes(mesh) else None))
 
+    # replicated-param cache, as in build_sharded_predict
+    cache: Dict[str, Any] = {"key": None, "value": None}
+
+    def replicated_params():
+        if cache["key"] is not model.params:
+            cache["value"] = replicate(mesh, model.params)
+            cache["key"] = model.params
+        return cache["value"]
+
     def evaluate(x: np.ndarray, y: np.ndarray, batch_size: int = 1024):
         x = model._prepare_x(x)
         y = model._prepare_y(y)
         n = x.shape[0]
         chunk = int(-(-min(batch_size, max(n, 1)) // ndev) * ndev)
-        params = replicate(mesh, model.params)
+        params = replicated_params()
         totals = None
         for start in range(0, n, chunk):
             real = min(chunk, n - start)
