@@ -13,7 +13,17 @@ driven by the single-controller :class:`~elephas_tpu.tpu_model.TPUModel`:
   for one epoch (or one batch), push the weight delta
   (``elephas/worker.py:76-131``). Workers run as coordinator-host threads,
   each driving jit-compiled local steps.
+- With ``overlap=True`` (or ``accum_batches > 1``) the batch-frequency
+  loop switches to a TPU-friendly schedule: parameters stay on device
+  between steps, the jitted step is compiled once, weight deltas
+  accumulate on device for ``accum_batches`` steps, and a background
+  :class:`_AsyncCommunicator` thread pushes deltas / prefetches fresh
+  global weights (double-buffered) so the chip never idles on an RPC —
+  the fix for the reference's 2-blocking-RPCs-per-batch throughput
+  killer (``elephas/worker.py:117-127``).
 """
+import queue
+import threading
 from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
@@ -58,15 +68,120 @@ class SyncWorker:
         return [deltas, history.history if history else None]
 
 
+class _AsyncCommunicator:
+    """Background RPC thread owning the parameter-server client.
+
+    Commands (``push`` a delta, ``pull`` fresh weights) execute FIFO off
+    the compute thread, so device steps overlap wire I/O. Pulled weights
+    land in a single-slot "latest" buffer the compute loop adopts at its
+    next accumulation boundary — classic double buffering. A transport
+    error parks the thread and re-raises on the compute thread's next
+    interaction, preserving the client's failure-detection semantics.
+    """
+
+    #: max queued commands — a slower-than-compute server back-pressures
+    #: the training loop instead of accumulating unbounded host copies of
+    #: the weights in the queue
+    MAX_QUEUED = 8
+
+    def __init__(self, client: BaseParameterClient):
+        self.client = client
+        self._cmds: "queue.Queue" = queue.Queue(maxsize=self.MAX_QUEUED)
+        self._latest: Optional[tuple] = None
+        self._pushes_done = 0
+        self._lock = threading.Lock()
+        self._fresh = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="elephas-tpu-async-comm")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            cmd = self._cmds.get()
+            if cmd is None:
+                return
+            kind, payload = cmd
+            try:
+                if kind == "push":
+                    self.client.update_parameters(payload)
+                    self._pushes_done += 1
+                else:
+                    weights = self.client.get_parameters()
+                    with self._lock:
+                        # tag the snapshot with how many of OUR pushes it
+                        # reflects (FIFO: every push queued before this
+                        # pull has executed) — the compute loop must not
+                        # adopt a snapshot missing its own latest push,
+                        # which would roll back local progress
+                        self._latest = (weights, self._pushes_done)
+                    self._fresh.set()
+            except BaseException as err:  # surfaced on the compute thread
+                self._error = err
+                self._fresh.set()  # unblock a waiting take_latest
+                return
+
+    def _check(self):
+        if self._error is not None:
+            raise self._error
+
+    def _put(self, cmd):
+        # bounded put that can't deadlock against a dead comm thread:
+        # re-check the error flag while waiting for queue space
+        while True:
+            self._check()
+            try:
+                self._cmds.put(cmd, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
+    def push(self, delta: List[np.ndarray]):
+        self._put(("push", delta))
+
+    def request_pull(self):
+        self._put(("pull", None))
+
+    def take_latest(self, block: bool = False,
+                    timeout: Optional[float] = None
+                    ) -> Optional[tuple]:
+        """Consume the freshest pulled weights as ``(weights,
+        pushes_reflected)``, or None if no pull completed since the last
+        take. ``pushes_reflected`` counts this worker's own pushes the
+        snapshot is guaranteed to include."""
+        if block:
+            self._fresh.wait(timeout)
+        self._check()
+        with self._lock:
+            snapshot, self._latest = self._latest, None
+            self._fresh.clear()
+        return snapshot
+
+    def close(self):
+        """Drain queued pushes, stop the thread, re-raise any error."""
+        if self._error is None:
+            self._put(None)
+        self._thread.join()
+        self._check()
+
+
 class AsyncWorker:
     """Asynchronous worker: exchanges weight deltas with a parameter server
-    at epoch or batch frequency (parity: ``elephas/worker.py:52-131``)."""
+    at epoch or batch frequency (parity: ``elephas/worker.py:52-131``).
+
+    :param overlap: run the batch-frequency loop with a background RPC
+        thread and device-resident parameters (throughput configuration)
+    :param accum_batches: accumulate the weight delta on device for this
+        many steps before pushing (1 = push every batch, as the
+        reference does)
+    """
 
     def __init__(self, json_config: str, parameters: List[np.ndarray],
                  client: Union[BaseParameterClient, str],
                  train_config: Dict[str, Any], frequency: str,
                  master_optimizer, master_loss, master_metrics,
-                 custom_objects: Optional[Dict] = None, port: int = 4000):
+                 custom_objects: Optional[Dict] = None, port: int = 4000,
+                 overlap: bool = False, accum_batches: int = 1):
         if isinstance(client, BaseParameterClient):
             self.client = client
         else:
@@ -79,6 +194,8 @@ class AsyncWorker:
         self.master_loss = master_loss
         self.master_metrics = master_metrics
         self.custom_objects = custom_objects or {}
+        self.overlap = overlap
+        self.accum_batches = max(1, int(accum_batches))
         self.model = None
 
     def train(self, x_train: np.ndarray, y_train: np.ndarray):
@@ -111,6 +228,11 @@ class AsyncWorker:
                 self.client.update_parameters(
                     subtract_params(weights_before, weights_after))
         elif self.frequency == "batch":
+            if self.overlap or self.accum_batches > 1:
+                if x_train.shape[0] > batch_size:
+                    self._train_batches_overlapped(x_train, y_train, epochs,
+                                                   batches)
+                return
             for _ in range(epochs):
                 if x_train.shape[0] > batch_size:
                     for batch_start, batch_end in batches:
@@ -126,3 +248,92 @@ class AsyncWorker:
             raise ValueError(
                 "frequency parameter can be `epoch` or `batch`, got {}".format(
                     self.frequency))
+
+    def _train_batches_overlapped(self, x_train, y_train, epochs, batches):
+        """Batch-frequency loop, TPU schedule: device-resident params, one
+        jit compile, delta accumulation over ``accum_batches`` steps, and
+        RPCs on a background thread (double-buffered weights).
+
+        Semantics vs the reference loop: the pulled global weights a
+        window trains from may be one push older than the server's very
+        latest (the price of not blocking compute on the pull) — a
+        staleness already inherent to asynchronous SGD.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        entries = model._weight_entries()
+
+        def as_params(weights):
+            new = {ln: dict(lp) for ln, lp in model.params.items()}
+            for (ln, pn), w in zip(entries, weights):
+                new[ln][pn] = jnp.asarray(w, dtype=new[ln][pn].dtype)
+            return new
+
+        def as_weights(params):
+            # the one device->host transfer per window
+            return [np.asarray(params[ln][pn]) for ln, pn in entries]
+
+        x_all = model._prepare_x(x_train)
+        y_all = model._prepare_y(y_train)
+
+        comm = _AsyncCommunicator(self.client)
+        try:
+            comm.request_pull()
+            base_weights, _ = comm.take_latest(block=True)
+            model.params = as_params(base_weights)
+            trainable, state = model._split_params(model.params)
+            opt_state = model._tx.init(trainable)
+            step = model._get_jitted("train")
+            base = model._merge_params(trainable, state)
+
+            window = 0
+            pushes_issued = 0
+            pending: Dict[int, List[np.ndarray]] = {}  # seq -> host delta
+            for _ in range(epochs):
+                for batch_start, batch_end in batches:
+                    trainable, state, opt_state, _, _ = step(
+                        trainable, state, opt_state, model._next_key(),
+                        x_all[batch_start:batch_end],
+                        y_all[batch_start:batch_end])
+                    window += 1
+                    if window < self.accum_batches:
+                        continue
+                    window = 0
+                    current = model._merge_params(trainable, state)
+                    delta = jax.tree_util.tree_map(lambda a, b: a - b,
+                                                   base, current)
+                    host_delta = as_weights(delta)
+                    comm.push(host_delta)
+                    pushes_issued += 1
+                    pending[pushes_issued] = host_delta
+                    comm.request_pull()  # FIFO: pull sees our push applied
+                    fresh = comm.take_latest(block=False)
+                    if fresh is not None:
+                        # adopt the snapshot (peer updates included),
+                        # corrected by our own pushes it can't reflect
+                        # yet — the server applies them regardless, so
+                        # subtracting locally keeps our trajectory intact
+                        # (1-worker case: adopted == current, exactly)
+                        snap_weights, reflected = fresh
+                        pending = {s: d for s, d in pending.items()
+                                   if s > reflected}
+                        adopted = [np.array(w) for w in snap_weights]
+                        for d in pending.values():
+                            adopted = [a - dd for a, dd in zip(adopted, d)]
+                        model.params = as_params(adopted)
+                        trainable, state = model._split_params(model.params)
+                        base = model._merge_params(trainable, state)
+                    else:
+                        # pull not back yet: keep training from local state
+                        base = current
+            # flush a partial window so no training is lost
+            if window:
+                current = model._merge_params(trainable, state)
+                delta = jax.tree_util.tree_map(lambda a, b: a - b,
+                                               base, current)
+                comm.push(as_weights(delta))
+        finally:
+            comm.close()
+        model.params = model._merge_params(trainable, state)
