@@ -15,3 +15,4 @@ from .optimizers import serialize as serialize_optimizer
 from .resnet import (build_resnet, build_resnet8, build_resnet50,
                      build_resnet_imagenet)
 from .saving import load_model, save_model
+from .transformer_model import TransformerModel

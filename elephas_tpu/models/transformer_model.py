@@ -1,0 +1,362 @@
+"""TransformerModel: the flagship transformer behind the TPUModel API.
+
+Round-1 left two worlds disjoint: the functional transformer stack
+(:mod:`~elephas_tpu.models.transformer` — ``init_params`` /
+``make_train_step`` pytrees over a mesh) and the framework's distributed
+driver (:class:`~elephas_tpu.tpu_model.TPUModel` with callbacks,
+checkpointing and histories, the capability mirror of the reference's
+``SparkModel``, ``elephas/spark_model.py:28-308``). This adapter unifies
+them: it exposes the BaseModel surface TPUModel and the callback suite
+expect (``compile``/``get_weights``/``training_state``/``to_json``/...)
+while training runs through the jitted, mesh-sharded
+``make_train_step`` — so the flagship LM trains via ``TPUModel.fit`` with
+``EarlyStopping``/``ModelCheckpoint`` and resumes bit-exact.
+"""
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .optimizers import Optimizer
+from .optimizers import get as get_optimizer
+from .transformer import (TransformerConfig, forward, init_params, lm_loss,
+                          make_train_step, select_moe_dispatch, shard_params)
+
+__all__ = ["TransformerModel"]
+
+#: dataclass fields that hold dtypes (serialized by numpy name)
+_DTYPE_FIELDS = ("dtype", "param_dtype")
+
+
+def _config_to_dict(config: TransformerConfig) -> Dict:
+    out = dataclasses.asdict(config)
+    for f in _DTYPE_FIELDS:
+        out[f] = np.dtype(out[f]).name
+    return out
+
+
+def _config_from_dict(d: Dict) -> TransformerConfig:
+    d = dict(d)
+    for f in _DTYPE_FIELDS:
+        if isinstance(d.get(f), str):
+            d[f] = getattr(jnp, d[f])
+    return TransformerConfig(**d)
+
+
+class TransformerModel:
+    """Decoder-only transformer LM with the framework's model surface.
+
+    Data convention: "x" is a ``(rows, seq_len)`` int array of token ids;
+    there is no separate label column (next-token targets are the shifted
+    input, ``transformer.next_token_loss``).
+
+    :param config: :class:`~elephas_tpu.models.transformer.TransformerConfig`
+    :param tensor_parallel: Megatron-style model-axis size the training
+        mesh uses (1 = pure data parallelism over all visible devices)
+    """
+
+    def __init__(self, config: TransformerConfig,
+                 tensor_parallel: int = 1, name: Optional[str] = None):
+        self.config = config
+        self.tensor_parallel = int(tensor_parallel)
+        self.name = name or "transformer_model"
+        self.params: Optional[Dict] = None
+        self.built = False
+        self.stop_training = False
+        self.optimizer: Optional[Optimizer] = None
+        self.loss: Optional[str] = None
+        self.metrics: List = []
+        self._tx = None
+        self._opt_state = None
+        self._seed = 0
+        # jitted forward/loss, built once per model (config is static; a
+        # fresh jax.jit(lambda) per call would retrace every invocation)
+        self._jit_forward = None
+        self._jit_loss = None
+
+    # ------------------------------------------------------------ lifecycle
+    def build(self, input_shape=None, seed: Optional[int] = None):
+        if seed is not None:
+            self._seed = seed
+        self.params = init_params(self.config,
+                                  jax.random.PRNGKey(self._seed))
+        self.built = True
+        self._opt_state = None
+        return self
+
+    def compile(self, optimizer="adam", loss: Optional[str] = None,
+                metrics: Optional[Sequence] = None,
+                seed: Optional[int] = None, **kwargs):
+        """``loss``/``metrics`` exist for API parity; the training loss is
+        always next-token cross-entropy (+ the MoE aux term)."""
+        self.optimizer = get_optimizer(optimizer)
+        self.loss = loss or "lm_cross_entropy"
+        self.metrics = list(metrics or [])
+        self._tx = self.optimizer.to_optax()
+        if self.config.num_experts > 1 and self.config.moe_dispatch == "auto":
+            # pin 'auto' to one concrete dispatch now, resolved against
+            # the TRAINING mesh: otherwise a tp-sharded fit would train
+            # dense (exact) while unsharded predict/evaluate routed
+            # (capacity drops) — silent train/serve numeric skew
+            mesh = self._training_mesh()
+            self.config = dataclasses.replace(
+                self.config,
+                moe_dispatch=select_moe_dispatch(
+                    self.config, mesh, "model" if mesh is not None else None))
+        self._jit_forward = None  # config may have changed: rebuild lazily
+        self._jit_loss = None
+        if not self.built:
+            self.build(seed=seed)
+        elif seed is not None and seed != self._seed:
+            self.build(seed=seed)
+        self._opt_state = None
+        return self
+
+    @property
+    def compiled(self) -> bool:
+        return self._tx is not None
+
+    # -------------------------------------------------------------- weights
+    def get_weights(self) -> List[np.ndarray]:
+        """Flat leaf list in jax pytree order (sorted dict keys — stable
+        across instances of the same config)."""
+        if self.params is None:
+            raise ValueError("Model must be built before get_weights()")
+        return [np.asarray(leaf)
+                for leaf in jax.tree_util.tree_leaves(self.params)]
+
+    def set_weights(self, weights: Sequence[np.ndarray]):
+        if self.params is None:
+            raise ValueError("Model must be built before set_weights()")
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        if len(leaves) != len(weights):
+            raise ValueError(
+                f"Expected {len(leaves)} weight arrays, got {len(weights)}")
+        new_leaves = []
+        for ref, w in zip(leaves, weights):
+            w = jnp.asarray(w, dtype=ref.dtype)
+            if w.shape != ref.shape:
+                raise ValueError(
+                    f"Shape mismatch: {w.shape} vs {ref.shape}")
+            new_leaves.append(w)
+        self.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    # ------------------------------------------------------- checkpoint api
+    def training_state(self) -> Dict:
+        """Same contract as ``BaseModel.training_state`` so
+        :class:`~elephas_tpu.models.callbacks.ModelCheckpoint` drives this
+        model unchanged."""
+        if self.params is None:
+            raise ValueError("Model must be built before training_state()")
+        leaves = (jax.tree_util.tree_leaves(self._opt_state)
+                  if self._opt_state is not None else [])
+        return {"params": self.params,
+                "opt_state_leaves": {f"leaf_{i}": leaf
+                                     for i, leaf in enumerate(leaves)}}
+
+    def restore_training_state(self, directory: str,
+                               step: Optional[int] = None) -> Optional[int]:
+        """Restore params + optimizer moments saved by ModelCheckpoint;
+        bit-exact resume (no layer renaming needed — the param pytree keys
+        are positional and stable)."""
+        from ..utils.checkpoint import CheckpointManager
+
+        if not self.built:
+            raise RuntimeError("build()/compile() before "
+                               "restore_training_state")
+        manager = CheckpointManager(directory)
+        state = manager.restore(step)
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        leaves_dict = state.get("opt_state_leaves") or {}
+        if leaves_dict:
+            if self._tx is None:
+                raise RuntimeError(
+                    "checkpoint contains optimizer state but the model is "
+                    "not compiled — compile() first")
+            ref = self._tx.init(self.params)
+            treedef = jax.tree_util.tree_structure(ref)
+            leaves = [jnp.asarray(leaves_dict[f"leaf_{i}"])
+                      for i in range(len(leaves_dict))]
+            self._opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        return step if step is not None else manager.latest_step()
+
+    # -------------------------------------------------------- serialization
+    def get_config(self) -> Dict:
+        return {"name": self.name,
+                "tensor_parallel": self.tensor_parallel,
+                "transformer_config": _config_to_dict(self.config)}
+
+    def to_json(self, **kwargs) -> str:
+        return json.dumps({"class_name": "TransformerModel",
+                           "config": self.get_config()}, **kwargs)
+
+    @classmethod
+    def from_config(cls, config: Dict,
+                    custom_objects: Optional[Dict] = None
+                    ) -> "TransformerModel":
+        return cls(_config_from_dict(config["transformer_config"]),
+                   tensor_parallel=config.get("tensor_parallel", 1),
+                   name=config.get("name"))
+
+    # ------------------------------------------------------------- training
+    def _training_mesh(self) -> Optional[Mesh]:
+        """dp×tp mesh over the visible devices (None on a single chip)."""
+        devices = jax.devices()
+        tp = self.tensor_parallel
+        if len(devices) == 1 and tp == 1:
+            return None
+        if len(devices) % tp:
+            raise ValueError(
+                f"tensor_parallel={tp} does not divide the "
+                f"{len(devices)}-device mesh")
+        dp = len(devices) // tp
+        return Mesh(np.array(devices).reshape(dp, tp), ("data", "model"))
+
+    def fit_tokens(self, tokens: np.ndarray, epochs: int = 1,
+                   batch_size: int = 32, validation_split: float = 0.0,
+                   seed: int = 0, verbose: int = 0,
+                   epoch_callback: Optional[Callable] = None) -> Dict:
+        """Mesh-sharded LM training; the engine behind ``TPUModel.fit``.
+
+        ``epoch_callback(epoch_idx, logs) -> stop?`` fires after each
+        epoch with ``{'loss': ..., 'val_loss': ...}`` logs (val only with
+        a validation split), mirroring ``SyncStepTrainer.fit`` so
+        TPUModel's callback plumbing drives both trainers identically.
+        Returns a Keras-style history dict.
+        """
+        if not self.compiled:
+            raise RuntimeError("compile() the model before fit")
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 2:
+            raise ValueError(f"tokens must be (rows, seq), got {tokens.shape}")
+
+        mesh = self._training_mesh()
+        dp = (dict(zip(mesh.axis_names, mesh.devices.shape))["data"]
+              if mesh is not None else 1)
+        if batch_size % dp:
+            raise ValueError(
+                f"batch_size={batch_size} must divide over the data-"
+                f"parallel axis ({dp} devices)")
+        n_val = int(round(tokens.shape[0] * validation_split))
+        # the val batch shards over the data axis too: trim to a dp
+        # multiple (a sub-dp remainder can't be laid out on the mesh)
+        n_val -= n_val % dp
+        if n_val:
+            tokens, val_tokens = tokens[:-n_val], tokens[-n_val:]
+
+        params = self.params
+        if mesh is not None:
+            params = shard_params(params, self.config, mesh)
+            token_sharding = NamedSharding(mesh, P("data", None))
+        step = make_train_step(self.config, self._tx, mesh=mesh)
+        opt_state = (self._opt_state if self._opt_state is not None
+                     else jax.jit(self._tx.init)(params))
+
+        eval_loss = jax.jit(
+            lambda p, t: lm_loss(p, t, self.config,
+                                 mesh=mesh,
+                                 batch_axis="data" if mesh else None,
+                                 model_axis="model" if mesh else None))
+
+        rng = np.random.default_rng(seed)
+        n = tokens.shape[0]
+        nb = n // batch_size
+        if nb == 0:
+            raise ValueError(
+                f"fewer token rows ({n}) than batch_size ({batch_size})")
+        history: Dict[str, List[float]] = {"loss": []}
+        if n_val:
+            history["val_loss"] = []
+
+        for epoch in range(epochs):
+            order = rng.permutation(n)
+            shuffled = tokens[order]
+            losses = []
+            for i in range(nb):
+                xb = jnp.asarray(shuffled[i * batch_size:(i + 1) * batch_size])
+                if mesh is not None:
+                    xb = jax.device_put(xb, token_sharding)
+                params, opt_state, loss = step(params, opt_state, xb)
+                losses.append(loss)
+            logs = {"loss": float(np.mean([float(l) for l in losses]))}
+            if n_val:
+                vb = jnp.asarray(val_tokens)
+                if mesh is not None:
+                    vb = jax.device_put(vb, token_sharding)
+                logs["val_loss"] = float(eval_loss(params, vb))
+            for k, v in logs.items():
+                history[k].append(v)
+            if verbose:
+                print(f"epoch {epoch + 1}/{epochs} - " +
+                      " - ".join(f"{k}: {v:.4f}" for k, v in logs.items()))
+            # sync resumable state so callbacks observe current weights
+            # and checkpoints carry the optimizer moments
+            self.params = params
+            self._opt_state = opt_state
+            if epoch_callback is not None and epoch_callback(epoch, logs):
+                break
+
+        self.params = params
+        self._opt_state = opt_state
+        return history
+
+    # fit() keeps the (x, y) surface of BaseModel: y is ignored (LM
+    # targets are the shifted input)
+    def fit(self, x, y=None, epochs: int = 1, batch_size: int = 32,
+            verbose: int = 0, validation_split: float = 0.0,
+            callbacks=None, seed: int = 0, **kwargs) -> Dict:
+        from .callbacks import CallbackList
+
+        cbs = CallbackList(callbacks, self)
+        self.stop_training = False
+        cbs.train_begin()
+
+        def epoch_cb(epoch, logs):
+            cbs.epoch_end(epoch, logs)
+            return bool(self.stop_training)
+
+        history = self.fit_tokens(
+            x, epochs=epochs, batch_size=batch_size,
+            validation_split=validation_split, seed=seed, verbose=verbose,
+            epoch_callback=epoch_cb if cbs else None)
+        cbs.train_end()
+        return history
+
+    def save(self, filepath: str, overwrite: bool = True,
+             include_optimizer: bool = True):
+        from .saving import save_model
+
+        save_model(self, filepath, overwrite, include_optimizer)
+
+    # ------------------------------------------------------ inference/eval
+    def predict(self, tokens: np.ndarray, batch_size: int = 8,
+                verbose: int = 0) -> np.ndarray:
+        """Logits ``(rows, seq, vocab)`` in input order."""
+        tokens = np.asarray(tokens)
+        if self._jit_forward is None:
+            config = self.config
+            self._jit_forward = jax.jit(
+                lambda p, t: forward(p, t, config))
+        outs = [np.asarray(self._jit_forward(
+                    self.params, jnp.asarray(tokens[i:i + batch_size])))
+                for i in range(0, tokens.shape[0], batch_size)]
+        return np.concatenate(outs, axis=0)
+
+    def evaluate(self, tokens: np.ndarray, y=None, batch_size: int = 8,
+                 verbose: int = 0) -> float:
+        """Mean next-token loss over the rows (batch-weighted)."""
+        tokens = np.asarray(tokens)
+        if self._jit_loss is None:
+            config = self.config
+            self._jit_loss = jax.jit(lambda p, t: lm_loss(p, t, config))
+        total, count = 0.0, 0
+        for i in range(0, tokens.shape[0], batch_size):
+            chunk = tokens[i:i + batch_size]
+            total += float(self._jit_loss(
+                self.params, jnp.asarray(chunk))) * len(chunk)
+            count += len(chunk)
+        return total / max(count, 1)

@@ -89,6 +89,10 @@ class TPUModel:
             raise ValueError(
                 "sync_mode must be 'average' or 'step', got "
                 f"{self.sync_mode!r}")
+        # async throughput knobs (batch frequency): background RPC overlap
+        # + on-device delta accumulation window (1 = reference semantics)
+        self.async_overlap = bool(kwargs.pop("async_overlap", False))
+        self.async_accum = max(1, int(kwargs.pop("async_accum", 1)))
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)
@@ -102,6 +106,7 @@ class TPUModel:
             self.client = transport.create_client(self.port)
 
         self._replica = None  # lazily-built worker replica for predict/eval
+        self._replica_src = None  # master params the replica last adopted
         self._predict_fn = None
         self._evaluate_fn = None
 
@@ -117,6 +122,10 @@ class TPUModel:
         config = base_config.copy()
         if self.sync_mode != "average":
             config["sync_mode"] = self.sync_mode
+        if self.async_overlap:
+            config["async_overlap"] = True
+        if self.async_accum != 1:
+            config["async_accum"] = self.async_accum
         config.update(self.kwargs)
         return config
 
@@ -199,9 +208,13 @@ class TPUModel:
         :param dataset: pair :class:`Dataset` or ``(features, labels)``
         :param epochs, batch_size, verbose, validation_split: as in Keras
         """
+        from .models.transformer_model import TransformerModel
         from .parallel.multihost import ensure_multihost
 
         ensure_multihost()
+        if isinstance(self._master_network, TransformerModel):
+            self._fit_transformer(dataset, **kwargs)
+            return
         ds = self._as_dataset(dataset)
         if self.num_workers:
             ds = ds.repartition(self.num_workers)
@@ -253,6 +266,42 @@ class TPUModel:
                         sums.setdefault(k, []).append(v[-1])
             cbs.epoch_end(0, {k: float(np.mean(v)) for k, v in sums.items()})
         cbs.train_end()
+
+    def _fit_transformer(self, data, epochs: int = 10,
+                         batch_size: Optional[int] = None,
+                         verbose: int = 0, validation_split: float = 0.1,
+                         **kwargs):
+        """Train the flagship :class:`TransformerModel` through the same
+        callback/history/checkpoint plumbing as the Keras-style models.
+
+        Transformer training is per-step synchronous SGD over the dp×tp
+        mesh (the ``sync_mode='step'`` semantics); parameter-server modes
+        target the delta-exchange Keras-style models."""
+        if self.mode != "synchronous":
+            raise ValueError(
+                "TransformerModel trains synchronously (per-step sync SGD "
+                "over the device mesh); asynchronous/hogwild parameter-"
+                "server modes apply to the Keras-style models")
+        # TransformerModel.fit owns the callback plumbing (CallbackList,
+        # stop_training, train_begin/end) — one implementation, not two
+        history = self._master_network.fit(
+            self._extract_tokens(data), epochs=epochs,
+            batch_size=batch_size or self.batch_size, verbose=verbose,
+            validation_split=validation_split,
+            callbacks=kwargs.pop("callbacks", None),
+            seed=kwargs.get("seed", 0))
+        self._training_histories.append(history)
+
+    @staticmethod
+    def _extract_tokens(data) -> np.ndarray:
+        """Token rows from a Dataset / (tokens, labels) pair / array — LM
+        targets are the shifted input, so any label column is ignored."""
+        if isinstance(data, Dataset):
+            return (data.columns[0] if data.is_columnar
+                    else np.asarray(data.rows()))
+        if isinstance(data, tuple) and len(data) == 2:
+            return np.asarray(data[0])
+        return np.asarray(data)
 
     def _worker_metric_fns(self):
         from .models import metrics as metrics_mod
@@ -369,7 +418,9 @@ class TPUModel:
                         model_json, init, self.client, train_config,
                         self.frequency, self.master_optimizer,
                         self.master_loss, self.master_metrics,
-                        self.custom_objects, port=self.port)
+                        self.custom_objects, port=self.port,
+                        overlap=self.async_overlap,
+                        accum_batches=self.async_accum)
                     worker.train(np.asarray(x_w), np.asarray(y_w))
 
                 if shards:
@@ -406,6 +457,7 @@ class TPUModel:
     # ------------------------------------------------------------ predict/eval
     def _invalidate_replica(self):
         self._replica = None
+        self._replica_src = None
         self._predict_fn = None
         self._evaluate_fn = None
 
@@ -417,14 +469,26 @@ class TPUModel:
         if self._replica is None:
             self._replica = model_from_json(self._master_network.to_json(),
                                             self.custom_objects)
-        self._replica.set_weights(self._master_network.get_weights())
+            self._replica_src = None
+        # sync only when the master's params pytree object changed
+        # (set_weights/trainers always swap it): an unconditional
+        # set_weights would rebuild the replica's pytree every call and
+        # defeat the replicated-param caches in the sharded predict/eval
+        if self._replica_src is not self._master_network.params:
+            self._replica.set_weights(self._master_network.get_weights())
+            self._replica_src = self._master_network.params
         return self._replica
 
     def predict(self, data: Union[Dataset, np.ndarray],
                 batch_size: Optional[int] = None) -> np.ndarray:
         """Distributed inference; returns predictions in input order."""
+        from .models.transformer_model import TransformerModel
         from .parallel.sync_trainer import build_sharded_predict
 
+        if isinstance(self._master_network, TransformerModel):
+            return self._master_network.predict(
+                self._extract_tokens(data),
+                batch_size=batch_size or self.batch_size)
         if isinstance(data, Dataset):
             if data.is_columnar:
                 x = data.columns[0]
@@ -442,8 +506,13 @@ class TPUModel:
                  **kwargs) -> Union[List[float], float]:
         """Distributed evaluation: sample-count-weighted loss/metric means
         (parity: ``elephas/spark_model.py:274-308``)."""
+        from .models.transformer_model import TransformerModel
         from .parallel.sync_trainer import build_sharded_evaluate
 
+        if isinstance(self._master_network, TransformerModel):
+            return self._master_network.evaluate(
+                np.asarray(x_test),
+                batch_size=kwargs.get("batch_size", self.batch_size))
         replica = self._get_replica()
         if self._evaluate_fn is None:
             self._evaluate_fn = build_sharded_evaluate(

@@ -1,0 +1,135 @@
+"""TransformerModel: the flagship LM driven through the TPUModel API —
+callbacks, histories, checkpoint/bit-exact resume (VERDICT round-1 #8)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.models import (Adam, EarlyStopping, LambdaCallback,
+                                ModelCheckpoint, TransformerModel,
+                                model_from_json)
+from elephas_tpu.models.transformer import TransformerConfig
+from elephas_tpu.tpu_model import TPUModel, load_tpu_model
+from elephas_tpu.utils.checkpoint import CheckpointManager
+
+
+def _config(**kw):
+    base = dict(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                d_ff=64, max_seq_len=16, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(rows=64, seq=16, seed=1):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (rows, seq), 0, 64))
+
+
+def _model(**kw):
+    model = TransformerModel(_config(), **kw)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    return model
+
+
+def test_json_roundtrip_and_weights():
+    model = _model()
+    clone = model_from_json(model.to_json())
+    assert isinstance(clone, TransformerModel)
+    assert clone.config == model.config
+    clone.build(seed=0)
+    assert len(clone.get_weights()) == len(model.get_weights())
+    for a, b in zip(clone.get_weights(), model.get_weights()):
+        np.testing.assert_array_equal(a, b)
+    # set_weights round-trips through the flat list
+    model.set_weights(clone.get_weights())
+
+
+def test_fit_through_tpu_model_records_history_and_trains():
+    model = _model()
+    tpu_model = TPUModel(model, mode="synchronous")
+    tokens = _tokens()
+    tpu_model.fit(tokens, epochs=3, batch_size=8, verbose=0,
+                  validation_split=0.25)
+    history = tpu_model.training_histories[-1]
+    assert len(history["loss"]) == 3 and len(history["val_loss"]) == 3
+    assert history["loss"][-1] < history["loss"][0]
+    # predict/evaluate delegate to the sharded LM paths
+    logits = tpu_model.predict(tokens[:4])
+    assert logits.shape == (4, 16, 64)
+    assert np.isfinite(tpu_model.evaluate(tokens[:8], None))
+
+
+def test_tensor_parallel_fit_runs():
+    model = _model(tensor_parallel=2)  # 8 CPU devices -> 4x2 dp/tp mesh
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=1, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    assert len(tpu_model.training_histories) == 1
+
+
+def test_async_mode_rejected():
+    model = _model()
+    tpu_model = TPUModel(model, mode="asynchronous", port=3901)
+    with pytest.raises(ValueError, match="synchronously"):
+        tpu_model.fit(_tokens(), epochs=1, batch_size=8)
+
+
+def test_early_stopping_stops_transformer_training():
+    model = _model()
+    tpu_model = TPUModel(model, mode="synchronous")
+    epochs_seen = []
+    cb = LambdaCallback(on_epoch_end=lambda e, logs: epochs_seen.append(e))
+    es = EarlyStopping(monitor="loss", patience=0, min_delta=1e9)
+    tpu_model.fit(_tokens(), epochs=10, batch_size=8, verbose=0,
+                  validation_split=0.0, callbacks=[cb, es])
+    # epoch 0 sets 'best'; epoch 1 can't beat the huge min_delta -> stop
+    assert epochs_seen == [0, 1]
+    assert es.stopped_epoch == 1
+
+
+def test_checkpoint_and_bitexact_resume(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpts")
+    tokens = _tokens()
+    model = _model()
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(tokens, epochs=3, batch_size=8, verbose=0,
+                  validation_split=0.0,
+                  callbacks=[ModelCheckpoint(ckpt_dir)])
+    assert CheckpointManager(ckpt_dir).latest_step() == 2
+
+    resumed = TransformerModel(_config())
+    resumed.compile(Adam(learning_rate=1e-2), seed=7)  # different init
+    step = resumed.restore_training_state(ckpt_dir)
+    assert step == 2
+    # bit-exact: params AND optimizer moments
+    for a, b in zip(jax.tree_util.tree_leaves(resumed.params),
+                    jax.tree_util.tree_leaves(model.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    got = jax.tree_util.tree_leaves(resumed._opt_state)
+    want = jax.tree_util.tree_leaves(model._opt_state)
+    assert len(got) == len(want) > 0
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # training continues; the checkpoint step sequence extends
+    TPUModel(resumed, mode="synchronous").fit(
+        tokens, epochs=1, batch_size=8, verbose=0, validation_split=0.0,
+        callbacks=[ModelCheckpoint(ckpt_dir)])
+    assert CheckpointManager(ckpt_dir).latest_step() == 3
+
+
+def test_save_and_load_through_tpu_model(tmp_path):
+    path = str(tmp_path / "transformer.h5")
+    model = _model()
+    tpu_model = TPUModel(model, mode="synchronous")
+    tokens = _tokens(16)
+    tpu_model.fit(tokens, epochs=1, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    expected = tpu_model.predict(tokens[:2])
+    tpu_model.save(path)
+
+    loaded = load_tpu_model(path)
+    assert isinstance(loaded.master_network, TransformerModel)
+    assert loaded.mode == "synchronous"
+    np.testing.assert_allclose(loaded.predict(tokens[:2]), expected,
+                               atol=1e-6)
