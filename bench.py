@@ -1,21 +1,44 @@
-"""Benchmark: distributed MNIST-MLP training throughput on real hardware.
+"""Benchmark: framework training throughput on real hardware.
 
-Measures samples/sec of the framework's synchronous data-parallel training
-(``TPUModel`` with ``sync_mode='step'`` — the benchmark configuration) on
-the reference's canonical workload (MNIST-shape 784-128-128-10 MLP, SGD
-lr=0.1, batch 64: ``examples/mnist_mlp_spark_synchronous.py`` in the
-reference), and compares against a hand-rolled pure-JAX training loop of
-the same model/batch on the same hardware — the ">=90% of single-process
-JAX throughput" bar from BASELINE.md.
+Two workloads:
+
+1. **MNIST-MLP sync-step** (the reference's canonical config,
+   ``examples/mnist_mlp_spark_synchronous.py``): samples/sec of
+   ``TPUModel(sync_mode='step')`` vs a hand-rolled pure-JAX loop of the
+   same model — the ">=90% of single-process JAX throughput" bar from
+   BASELINE.md. This is the headline metric/vs_baseline.
+2. **Transformer LM** (the flagship model): tokens/sec and **MFU**
+   (model FLOPs / chip peak FLOPs) of a jitted train step, measured for
+   the Pallas flash-attention path AND the XLA attention path so the
+   kernel's win is a number, not a claim.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": R}
+    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": R,
+     "transformer": {"tokens_per_sec": T, "mfu": M,
+                     "xla_tokens_per_sec": Tx, "flash_speedup": S, ...}}
 where vs_baseline = framework_throughput / pure_jax_throughput.
 """
 import json
 import time
 
 import numpy as np
+
+#: advertised peak dense-matmul TFLOP/s per JAX device (bf16), by device
+#: kind prefix — the MFU denominator. v2/v3 expose one device per CORE
+#: (half a chip); v4+ expose one megacore device per chip, so those
+#: entries are full-chip peaks (v4 275, v5p 459, v5e 197, v6e 918).
+_PEAK_TFLOPS = {
+    "TPU v2": 22.5, "TPU v3": 61.0, "TPU v4": 275.0, "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0, "TPU v5p": 459.0, "TPU v5": 459.0, "TPU v6": 918.0,
+}
+
+
+def _chip_peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for prefix in sorted(_PEAK_TFLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return _PEAK_TFLOPS[prefix]
+    return 197.0  # unknown TPU: assume v5e-class so MFU stays conservative
 
 
 def _data(n=8192, dim=784, classes=10, seed=0):
@@ -92,7 +115,8 @@ def bench_pure_jax(x, y, batch_size, epochs=3):
                 xb = xs[i * batch_size:(i + 1) * batch_size]
                 yb = ys[i * batch_size:(i + 1) * batch_size]
                 p = step(p, xb, yb)
-        jax.tree_util.tree_map(lambda a: a.block_until_ready(), p)
+        # hard completion barrier: fetch a scalar from the last step
+        float(p["b3"][0])
         return p
 
     params = run_epochs(params, 1)  # warmup/compile
@@ -102,17 +126,88 @@ def bench_pure_jax(x, y, batch_size, epochs=3):
     return (nb * batch_size * epochs) / elapsed
 
 
+def bench_transformer(attention_impl: str, steps: int = 20):
+    """Tokens/sec + MFU of a jitted transformer LM train step on the
+    current chip, for the given attention implementation."""
+    import jax
+    import optax
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params, make_train_step)
+
+    config = TransformerConfig(vocab_size=32000, num_layers=8, num_heads=16,
+                               d_model=1024, d_ff=4096, max_seq_len=1024,
+                               attention_impl=attention_impl)
+    batch, seq = 8, 1024
+    params = init_params(config, jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    step = make_train_step(config, tx)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                config.vocab_size)
+
+    # float() forces a host fetch of the scalar — a hard completion
+    # barrier even where a tunneled backend's block_until_ready is lax
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile
+    float(loss)
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)  # all steps chain through donated buffers
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+
+    # Model FLOPs per step (PaLM-appendix accounting): matmul fwd cost is
+    # 2*P FLOPs/token for the P non-embedding-lookup params the token
+    # touches, plus causal attention scores/values
+    # (2 matmuls * 2 FLOPs * seq/2 avg causal length * d_model); backward
+    # is 2x forward. Embedding gather and softmax are excluded (not MXU
+    # work) — standard MFU convention, slightly conservative.
+    c = config
+    p_matmul = (c.num_layers * (4 * c.d_model * c.d_model
+                                + 2 * c.d_model * c.d_ff)
+                + c.d_model * c.vocab_size)  # tied LM head projection
+    attn_flops = 2 * 2 * (seq / 2) * c.d_model  # per token per layer
+    flops_per_token = 3 * (2 * p_matmul + c.num_layers * attn_flops)
+    mfu = (flops_per_token * tokens_per_sec
+           / (_chip_peak_tflops(jax.devices()[0]) * 1e12))
+    return tokens_per_sec, mfu
+
+
 def main():
+    import jax
+
     batch_size = 64
     x, y = _data()
     framework = bench_framework(x, y, batch_size)
     pure = bench_pure_jax(x, y, batch_size)
-    print(json.dumps({
+
+    result = {
         "metric": "mnist_mlp_sync_samples_per_sec",
         "value": round(framework, 1),
         "unit": "samples/sec",
         "vs_baseline": round(framework / pure, 4),
-    }))
+    }
+
+    xla_tps, xla_mfu = bench_transformer("xla")
+    result["transformer"] = {
+        "tokens_per_sec": round(xla_tps, 1),
+        "mfu": round(xla_mfu, 4),
+        "xla_tokens_per_sec": round(xla_tps, 1),
+        "config": "L8 d1024 ff4096 h16 seq1024 batch8 bf16 adamw",
+    }
+    if jax.default_backend() == "tpu":
+        # the Pallas kernel only exists on TPU; elsewhere a "flash" run
+        # would silently re-measure XLA and report noise as a speedup
+        flash_tps, flash_mfu = bench_transformer("flash")
+        if flash_tps >= xla_tps:
+            result["transformer"]["tokens_per_sec"] = round(flash_tps, 1)
+            result["transformer"]["mfu"] = round(flash_mfu, 4)
+        result["transformer"]["flash_tokens_per_sec"] = round(flash_tps, 1)
+        result["transformer"]["flash_speedup"] = round(flash_tps / xla_tps, 4)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -57,7 +57,10 @@ PAGES = [
      ["ring_attention", "ring_attention_sharded"]),
     ("Transformer", "elephas_tpu.models.transformer",
      ["TransformerConfig", "init_params", "param_specs", "forward",
-      "forward_with_aux", "lm_loss", "make_train_step", "shard_params"]),
+      "forward_with_aux", "lm_loss", "make_train_step", "shard_params",
+      "select_moe_dispatch"]),
+    ("TransformerModel", "elephas_tpu.models.transformer_model",
+     ["TransformerModel"]),
     ("Pipeline parallelism", "elephas_tpu.parallel.pipeline",
      ["make_pipeline_fn", "stack_stage_params", "split_transformer_stages",
       "merge_transformer_stages", "shard_pipelined_params",

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Release: build the native library, run the full CPU suite, build a wheel,
+# and (with --publish) upload it. Capability mirror of the reference's
+# release.sh, with the test gate the reference lacked.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+bash native/build.sh
+
+echo "== test gate (8-device virtual CPU mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/ -q
+
+echo "== wheel =="
+rm -rf dist/
+python -m pip wheel --no-deps -w dist .
+
+if [[ "${1:-}" == "--publish" ]]; then
+    echo "== publish =="
+    python -m twine upload dist/*.whl
+fi
+echo "release artifacts in dist/"
