@@ -41,6 +41,48 @@ from .utils.serialization import model_to_dict
 from .worker import AsyncWorker
 
 
+class _EpochAggregator:
+    """Turns per-worker epoch completions into driver-level epoch_end.
+
+    Each async worker reports ``(epoch, mean_loss)`` after its local
+    epoch; when all participants have reported epoch k the aggregator
+    fires ``on_epoch(k, logs)`` (on the last reporter's thread — workers
+    train concurrently, so a worker can only reach epoch k+1 after
+    emitting its own k event, which keeps firings ordered). ``on_epoch``
+    returning True latches the stop flag every worker polls at its epoch
+    boundaries — EarlyStopping that actually stops asynchronous training
+    mid-run.
+    """
+
+    def __init__(self, participants: int, on_epoch):
+        import threading
+
+        self.participants = max(1, participants)
+        self.on_epoch = on_epoch
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self._losses: Dict[int, List[float]] = {}
+        self._stop = threading.Event()
+
+    def report(self, epoch: int, loss: Optional[float]):
+        with self._lock:
+            self._counts[epoch] = self._counts.get(epoch, 0) + 1
+            if loss is not None:
+                self._losses.setdefault(epoch, []).append(float(loss))
+            if self._counts[epoch] != self.participants:
+                return
+            losses = self._losses.pop(epoch, [])
+            # fire under the lock: callbacks mutate the master network,
+            # and serializing here keeps reports cheap (callbacks are
+            # epoch-granular)
+            logs = {"loss": float(np.mean(losses))} if losses else {}
+            if self.on_epoch(epoch, logs):
+                self._stop.set()
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+
 class TPUModel:
     """Distributed model: train/predict/evaluate over a TPU device mesh.
 
@@ -232,9 +274,10 @@ class TPUModel:
         self._invalidate_replica()
 
         # driver-level callbacks: per-epoch hooks for sync_mode='step'
-        # (whose epoch loop runs on the driver); round-level (one
-        # epoch_end per fit) for model-averaging and async modes, whose
-        # epochs run inside one compiled program / inside the workers
+        # (whose epoch loop runs on the driver) and for async/hogwild
+        # (worker epoch events aggregated by _EpochAggregator, with live
+        # PS pulls); round-level (one epoch_end per fit) only for model
+        # averaging, whose epochs run inside one compiled program
         from .models.callbacks import CallbackList
 
         callbacks = train_config.pop("callbacks", None)
@@ -249,15 +292,15 @@ class TPUModel:
             else:
                 self._fit_sync_average(ds, **train_config)
         elif self.mode in ("asynchronous", "hogwild"):
-            self._fit_async(ds, **train_config)
+            self._fit_async(ds, callbacks=cbs, **train_config)
         else:
             raise ValueError("Unsupported mode {}".format(self.mode))
 
-        if cbs and not (self.mode == "synchronous"
-                        and self.sync_mode == "step"):
-            # round logs: mean of each metric's final value across THIS
-            # fit's worker histories (async workers report none — the logs
-            # are then empty, never stale data from an earlier fit)
+        if cbs and self.mode == "synchronous" and self.sync_mode == "average":
+            # model averaging runs all epochs inside one compiled program,
+            # so callbacks get one round-level epoch_end: mean of each
+            # metric's final value across THIS fit's worker histories.
+            # (sync-step and async modes fire real per-epoch hooks.)
             new_histories = self._training_histories[histories_before:]
             sums: Dict[str, list] = {}
             for hist in new_histories:
@@ -365,7 +408,8 @@ class TPUModel:
         # and any callback mutation of them wins over the trainer result
 
     def _fit_async(self, ds: Dataset, epochs: int = 10, batch_size: int = 32,
-                   verbose: int = 0, validation_split: float = 0.1, **kwargs):
+                   verbose: int = 0, validation_split: float = 0.1,
+                   callbacks=None, **kwargs):
         import concurrent.futures
 
         import jax
@@ -412,6 +456,36 @@ class TPUModel:
                     # strided slice
                     shards = shards[jax.process_index()::jax.process_count()]
 
+                # real per-epoch callbacks for async modes: workers emit
+                # epoch events; when every participating (non-empty)
+                # worker finishes epoch k, the driver pulls the live
+                # global weights off the PS and fires epoch_end — so
+                # EarlyStopping/ModelCheckpoint observe current state and
+                # can stop async training mid-run. (Multi-host: each
+                # process aggregates its own workers; a stop triggered
+                # here halts this process's workers.)
+                aggregator = None
+                if callbacks:
+                    participants = sum(
+                        1 for shard in shards if np.asarray(shard[0]).size)
+
+                    def on_epoch(epoch_idx, logs):
+                        import warnings as _warnings
+
+                        try:
+                            self._master_network.set_weights(
+                                self.client.get_parameters())
+                        except Exception as err:
+                            _warnings.warn(
+                                f"per-epoch weight pull failed ({err}); "
+                                "callbacks see the previous weights")
+                        callbacks.epoch_end(epoch_idx, logs)
+                        return bool(getattr(self._master_network,
+                                            "stop_training", False))
+
+                    if participants:
+                        aggregator = _EpochAggregator(participants, on_epoch)
+
                 def run_worker(shard):
                     x_w, y_w = shard
                     worker = AsyncWorker(
@@ -420,7 +494,11 @@ class TPUModel:
                         self.master_loss, self.master_metrics,
                         self.custom_objects, port=self.port,
                         overlap=self.async_overlap,
-                        accum_batches=self.async_accum)
+                        accum_batches=self.async_accum,
+                        epoch_event=(aggregator.report if aggregator
+                                     else None),
+                        should_stop=(aggregator.should_stop if aggregator
+                                     else None))
                     worker.train(np.asarray(x_w), np.asarray(y_w))
 
                 if shards:

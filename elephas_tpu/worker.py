@@ -174,6 +174,12 @@ class AsyncWorker:
     :param accum_batches: accumulate the weight delta on device for this
         many steps before pushing (1 = push every batch, as the
         reference does)
+    :param epoch_event: optional ``(epoch_idx, mean_loss_or_None)`` hook
+        fired after each local epoch — the driver aggregates these into
+        real per-epoch callbacks across workers
+    :param should_stop: optional predicate polled at epoch boundaries;
+        True ends training early (EarlyStopping reaching into the
+        workers)
     """
 
     def __init__(self, json_config: str, parameters: List[np.ndarray],
@@ -181,7 +187,8 @@ class AsyncWorker:
                  train_config: Dict[str, Any], frequency: str,
                  master_optimizer, master_loss, master_metrics,
                  custom_objects: Optional[Dict] = None, port: int = 4000,
-                 overlap: bool = False, accum_batches: int = 1):
+                 overlap: bool = False, accum_batches: int = 1,
+                 epoch_event=None, should_stop=None):
         if isinstance(client, BaseParameterClient):
             self.client = client
         else:
@@ -196,7 +203,13 @@ class AsyncWorker:
         self.custom_objects = custom_objects or {}
         self.overlap = overlap
         self.accum_batches = max(1, int(accum_batches))
+        self.epoch_event = epoch_event
+        self.should_stop = should_stop or (lambda: False)
         self.model = None
+
+    def _emit(self, epoch: int, loss: Optional[float]):
+        if self.epoch_event is not None:
+            self.epoch_event(epoch, loss)
 
     def train(self, x_train: np.ndarray, y_train: np.ndarray):
         if x_train.size == 0:
@@ -217,33 +230,53 @@ class AsyncWorker:
                    for i in range(nb_batch)]
 
         if self.frequency == "epoch":
-            for _ in range(epochs):
+            for epoch in range(epochs):
+                if self.should_stop():
+                    break
                 weights_before = self.client.get_parameters()
                 self.model.set_weights(weights_before)
+                history = None
                 if x_train.shape[0] > batch_size:
                     per_epoch = dict(train_config)
                     per_epoch["epochs"] = 1
-                    self.model.fit(x_train, y_train, **per_epoch)
+                    history = self.model.fit(x_train, y_train, **per_epoch)
                 weights_after = self.model.get_weights()
                 self.client.update_parameters(
                     subtract_params(weights_before, weights_after))
+                loss = (history.history["loss"][-1]
+                        if history and history.history.get("loss") else None)
+                self._emit(epoch, loss)
         elif self.frequency == "batch":
             if self.overlap or self.accum_batches > 1:
                 if x_train.shape[0] > batch_size:
                     self._train_batches_overlapped(x_train, y_train, epochs,
                                                    batches)
+                else:
+                    # too small to train, but still a participant: keep
+                    # the driver's epoch aggregation complete
+                    for epoch in range(epochs):
+                        if self.should_stop():
+                            break
+                        self._emit(epoch, None)
                 return
-            for _ in range(epochs):
+            for epoch in range(epochs):
+                if self.should_stop():
+                    break
+                losses = []
                 if x_train.shape[0] > batch_size:
                     for batch_start, batch_end in batches:
                         weights_before = self.client.get_parameters()
                         self.model.set_weights(weights_before)
-                        self.model.train_on_batch(
+                        vals = self.model.train_on_batch(
                             x_train[batch_start:batch_end],
                             y_train[batch_start:batch_end])
+                        losses.append(vals[0] if isinstance(vals, list)
+                                      else float(vals))
                         weights_after = self.model.get_weights()
                         self.client.update_parameters(
                             subtract_params(weights_before, weights_after))
+                self._emit(epoch,
+                           float(np.mean(losses)) if losses else None)
         else:
             raise ValueError(
                 "frequency parameter can be `epoch` or `batch`, got {}".format(
@@ -291,12 +324,16 @@ class AsyncWorker:
             window = 0
             pushes_issued = 0
             pending: Dict[int, List[np.ndarray]] = {}  # seq -> host delta
-            for _ in range(epochs):
+            for epoch in range(epochs):
+                if self.should_stop():
+                    break
+                epoch_losses = []
                 for batch_start, batch_end in batches:
-                    trainable, state, opt_state, _, _ = step(
+                    trainable, state, opt_state, loss_val, _ = step(
                         trainable, state, opt_state, model._next_key(),
                         x_all[batch_start:batch_end],
                         y_all[batch_start:batch_end])
+                    epoch_losses.append(loss_val)  # device scalar, no sync
                     window += 1
                     if window < self.accum_batches:
                         continue
@@ -328,6 +365,10 @@ class AsyncWorker:
                     else:
                         # pull not back yet: keep training from local state
                         base = current
+                # one host sync per epoch: the mean loss for the driver's
+                # aggregated epoch_end logs
+                self._emit(epoch, float(np.mean([float(l)
+                                                 for l in epoch_losses])))
             # flush a partial window so no training is lost
             if window:
                 current = model._merge_params(trainable, state)

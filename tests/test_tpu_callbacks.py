@@ -1,5 +1,7 @@
 """Driver-level callbacks on TPUModel.fit: per-epoch hooks for per-step
-sync SGD, round-level hooks for model-averaging and async modes."""
+sync SGD and async/hogwild modes (aggregated across workers, with live
+PS weight pulls), round-level hooks for model averaging (whose epochs run
+inside one compiled program)."""
 import numpy as np
 
 from elephas_tpu.models import (SGD, Dense, EarlyStopping, LambdaCallback,
@@ -101,3 +103,67 @@ def test_async_round_level_hooks():
     tpu_model.fit(to_dataset(x, y), epochs=1, batch_size=32, verbose=0,
                   validation_split=0.0, callbacks=[cb])
     assert events == ["begin", "end"]
+
+
+def test_async_per_epoch_hooks_fire_with_loss():
+    """Async modes fire REAL per-epoch callbacks: workers emit epoch
+    events, and when all participants finish epoch k the driver pulls the
+    live PS weights and fires epoch_end with the mean worker loss."""
+    import random
+
+    x, y = _data()
+    tpu_model = TPUModel(_model(), mode="asynchronous", frequency="epoch",
+                         parameter_server_mode="socket",
+                         port=random.randint(4100, 8900), num_workers=2)
+    events = []
+    snapshots = []
+    cb = LambdaCallback(on_epoch_end=lambda e, logs: (
+        events.append((e, logs.get("loss"))),
+        snapshots.append(tpu_model.master_network.get_weights()[0].copy())))
+    tpu_model.fit(to_dataset(x, y), epochs=3, batch_size=32, verbose=0,
+                  validation_split=0.0, callbacks=[cb])
+    assert [e for e, _ in events] == [0, 1, 2]
+    assert all(isinstance(l, float) and np.isfinite(l) for _, l in events)
+    # the per-epoch pull gives callbacks live weights: training moves
+    # them between epochs
+    assert any(not np.array_equal(snapshots[0], s) for s in snapshots[1:])
+
+
+def test_async_early_stopping_stops_workers_mid_run():
+    """EarlyStopping must actually stop asynchronous training, not fire
+    after the fact: with an unbeatable min_delta, patience=0 stops after
+    epoch 1 of 10."""
+    import random
+
+    from elephas_tpu.models import EarlyStopping
+
+    x, y = _data()
+    tpu_model = TPUModel(_model(), mode="asynchronous", frequency="epoch",
+                         parameter_server_mode="http",
+                         port=random.randint(4100, 8900), num_workers=2)
+    events = []
+    cb = LambdaCallback(on_epoch_end=lambda e, logs: events.append(e))
+    es = EarlyStopping(monitor="loss", patience=0, min_delta=1e9)
+    tpu_model.fit(to_dataset(x, y), epochs=10, batch_size=32, verbose=0,
+                  validation_split=0.0, callbacks=[cb, es])
+    assert es.stopped_epoch == 1
+    assert events == [0, 1]  # workers stopped; epochs 2..9 never ran
+
+
+def test_async_batch_frequency_per_epoch_hooks():
+    import random
+
+    x, y = _data()
+    for overlap, accum in [(False, 1), (True, 2)]:
+        tpu_model = TPUModel(_model(), mode="asynchronous",
+                             frequency="batch",
+                             parameter_server_mode="socket",
+                             port=random.randint(4100, 8900), num_workers=2,
+                             async_overlap=overlap, async_accum=accum)
+        events = []
+        cb = LambdaCallback(on_epoch_end=lambda e, logs: events.append(
+            (e, logs.get("loss"))))
+        tpu_model.fit(to_dataset(x, y), epochs=2, batch_size=32, verbose=0,
+                      validation_split=0.0, callbacks=[cb])
+        assert [e for e, _ in events] == [0, 1], (overlap, accum, events)
+        assert all(isinstance(l, float) for _, l in events)
