@@ -299,18 +299,19 @@ def select_moe_dispatch(config: "TransformerConfig",
     """Resolve ``config.moe_dispatch`` to ``'dense'`` or ``'routed'``.
 
     ``auto`` picks routed dispatch (FLOPs ∝ top_k) once the expert count
-    is big enough for the savings to matter, but stays dense when the
-    experts are sharded over a mesh axis (expert parallelism keeps the
-    per-device einsum; routed's scatter indices would force GSPMD to
-    regather the expert-sharded capacity buffers)."""
+    is big enough for the savings to matter. Under an expert-sharded mesh
+    the routed path runs as an explicit shard_map program
+    (:func:`_moe_block_routed_ep` — each device dispatches to its local
+    expert slice, one psum combines), so routing stays available with
+    expert parallelism as long as the experts divide the axis."""
     if config.moe_dispatch != "auto":
         return config.moe_dispatch
-    expert_sharded = (mesh is not None and model_axis is not None
-                      and dict(zip(mesh.axis_names,
-                                   mesh.devices.shape)).get(model_axis, 1) > 1)
-    if config.num_experts > 4 and not expert_sharded:
-        return "routed"
-    return "dense"
+    if config.num_experts <= 4:
+        return "dense"
+    if mesh is not None and not _mesh_divides(mesh, model_axis,
+                                              config.num_experts):
+        return "dense"  # experts don't divide the axis: keep the einsum
+    return "routed"
 
 
 def _moe_gates(h, moe, config: "TransformerConfig"):
@@ -381,60 +382,127 @@ def _moe_block(h, moe, config: "TransformerConfig",
     return jnp.einsum("betd,bte->btd", out, gates), aux
 
 
-def _moe_block_routed(h, moe, config: "TransformerConfig"):
-    """Capacity-factor routed MoE dispatch (Switch Transformer §2.2).
+def _routed_capacity(config: "TransformerConfig", n_tokens: int) -> int:
+    c = int(np.ceil(config.moe_capacity_factor * config.expert_top_k
+                    * n_tokens / config.num_experts))
+    return min(max(c, 1), n_tokens)
 
-    Tokens scatter into per-expert buffers of capacity
-    ``C = ceil(capacity_factor * top_k * N / E)``; each expert runs its
-    MLP once over its ``(C, d_model)`` buffer, and outputs gather back to
-    token order weighted by the gate. Per-token expert FLOPs are
-    ``capacity_factor * top_k * 2 * d_model * d_ff`` — independent of
-    ``num_experts`` (dense dispatch pays ``num_experts``×). Assignments
-    beyond an expert's capacity are dropped (their gate contribution is
-    zero — the token passes through on the residual stream only), with
-    earlier tokens and higher-ranked choices winning: the static-shape
-    price of routing, bounded by the aux loss keeping the router
-    balanced. All shapes are static: XLA-friendly scatter-add/gather, no
-    host sync.
+
+def _routed_dispatch(hf, gate_vals, topi, w1, b1, w2, b2,
+                     config: "TransformerConfig", capacity: int,
+                     expert_offset: int = 0):
+    """Scatter → expert MLP → gather for the expert slice
+    ``[expert_offset, expert_offset + w1.shape[0])``.
+
+    Tokens scatter into per-expert capacity buffers; each expert runs its
+    MLP once over its ``(capacity, d_model)`` buffer, and outputs gather
+    back to token order weighted by the gate. Assignments beyond an
+    expert's capacity are dropped (their gate contribution is zero — the
+    token passes through on the residual stream only), with earlier
+    tokens and higher-ranked choices winning: the static-shape price of
+    routing, bounded by the aux loss keeping the router balanced. All
+    shapes are static: XLA-friendly scatter-add/gather, no host sync.
+    Assignments outside the expert slice also drop — under expert
+    parallelism every device runs this on its local slice and a psum
+    sums the slices' contributions.
     """
     c = config
-    B, T, D = h.shape
-    N = B * T
+    N, D = hf.shape
     k = c.expert_top_k
-    E = c.num_experts
-    capacity = int(np.ceil(c.moe_capacity_factor * k * N / E))
-    capacity = min(max(capacity, 1), N)
-
-    hf = h.reshape(N, D)
-    probs, gate_vals, topi, aux = _moe_gates(hf, moe, c)
+    e_local = w1.shape[0]
 
     # flatten assignments token-major so earlier tokens (and, within a
     # token, higher-ranked choices) win the capacity race
-    experts = topi.reshape(N * k)              # (N*k,)
-    assign = jax.nn.one_hot(experts, E, dtype=jnp.int32)  # (N*k, E)
-    # position of each assignment within its expert's buffer
+    experts = topi.reshape(N * k)                         # (N*k,)
+    assign = jax.nn.one_hot(experts, c.num_experts, dtype=jnp.int32)
+    # position of each assignment within its expert's buffer — computed
+    # over the FULL expert range so every slice agrees on positions
     pos_in_expert = jnp.cumsum(assign, axis=0) - assign
     pos = jnp.sum(pos_in_expert * assign, axis=-1)        # (N*k,)
     keep = pos < capacity
+    local = experts - expert_offset
+    in_slice = (local >= 0) & (local < e_local)
 
     token_idx = jnp.arange(N * k) // k
-    xs = hf[token_idx]                                    # (N*k, D)
-    # out-of-capacity scatters land on mode='drop'; their gathers below
-    # are masked through the zeroed gate
-    buf = jnp.zeros((E, capacity, D), c.dtype)
-    buf = buf.at[experts, pos].add(xs.astype(c.dtype), mode="drop")
+    xs = hf[token_idx].astype(c.dtype)                    # (N*k, D)
+    # out-of-capacity / out-of-slice scatters are pushed out of bounds
+    # and land on mode='drop'; their gathers below are masked through the
+    # zeroed gate
+    safe_e = jnp.where(in_slice, local, 0)
+    pos_eff = jnp.where(in_slice & keep, pos, capacity)
+    buf = jnp.zeros((e_local, capacity, D), c.dtype)
+    buf = buf.at[safe_e, pos_eff].add(xs, mode="drop")
 
     he = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", buf, moe["w1"].astype(c.dtype))
-        + moe["b1"].astype(c.dtype)[:, None, :])
-    out_buf = (jnp.einsum("ecf,efd->ecd", he, moe["w2"].astype(c.dtype))
-               + moe["b2"].astype(c.dtype)[:, None, :])
+        jnp.einsum("ecd,edf->ecf", buf, w1.astype(c.dtype))
+        + b1.astype(c.dtype)[:, None, :])
+    out_buf = (jnp.einsum("ecf,efd->ecd", he, w2.astype(c.dtype))
+               + b2.astype(c.dtype)[:, None, :])
 
     gate_flat = (gate_vals.reshape(N * k)
-                 * keep.astype(gate_vals.dtype)).astype(c.dtype)
-    picked = out_buf[experts, jnp.minimum(pos, capacity - 1)]  # (N*k, D)
-    out = jnp.sum((picked * gate_flat[:, None]).reshape(N, k, D), axis=1)
+                 * (keep & in_slice).astype(gate_vals.dtype)).astype(c.dtype)
+    picked = out_buf[safe_e, jnp.minimum(pos, capacity - 1)]  # (N*k, D)
+    return jnp.sum((picked * gate_flat[:, None]).reshape(N, k, D), axis=1)
+
+
+def _moe_block_routed(h, moe, config: "TransformerConfig"):
+    """Capacity-factor routed MoE dispatch (Switch Transformer §2.2).
+
+    Per-token expert FLOPs are ``capacity_factor * top_k * 2 * d_model *
+    d_ff`` — independent of ``num_experts`` (dense dispatch pays
+    ``num_experts``×). See :func:`_routed_dispatch` for the scatter/
+    gather mechanics and drop semantics.
+    """
+    c = config
+    B, T, D = h.shape
+    hf = h.reshape(B * T, D)
+    _, gate_vals, topi, aux = _moe_gates(hf, moe, c)
+    out = _routed_dispatch(hf, gate_vals, topi, moe["w1"], moe["b1"],
+                           moe["w2"], moe["b2"], c,
+                           _routed_capacity(c, B * T))
     return out.reshape(B, T, D), aux
+
+
+def _moe_block_routed_ep(h, moe, config: "TransformerConfig", mesh: Mesh,
+                         data_axis: Optional[str], model_axis: str):
+    """Routed dispatch under expert parallelism, as an explicit shard_map
+    program: every device routes its local token shard to its local
+    expert slice (out-of-slice assignments drop at the scatter), and one
+    psum over the ``model`` axis sums the slices' contributions back into
+    the replicated residual stream — the same single-collective shape as
+    the dense einsum path, with routed FLOP economics per device.
+
+    Capacity is per data shard (``ceil(cf * k * local_tokens / E)``), the
+    standard per-group capacity of sharded MoE — identical to the global
+    computation when nothing drops.
+    """
+    c = config
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ep = axis_sizes[model_axis]
+
+    def local_fn(h_l, gate, w1_l, b1_l, w2_l, b2_l):
+        bl, tl, dl = h_l.shape
+        hf = h_l.reshape(bl * tl, dl)
+        _, gate_vals, topi, aux = _moe_gates(hf, {"gate": gate}, c)
+        offset = jax.lax.axis_index(model_axis) * (c.num_experts // ep)
+        out = _routed_dispatch(hf, gate_vals, topi, w1_l, b1_l, w2_l,
+                               b2_l, c, _routed_capacity(c, bl * tl),
+                               expert_offset=offset)
+        out = jax.lax.psum(out.reshape(bl, tl, dl), model_axis)
+        if data_axis is not None:
+            aux = jax.lax.pmean(aux, data_axis)
+        return out, aux
+
+    batch_spec = P(data_axis, None, None)
+    out, aux = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(batch_spec, P(None, None), P(model_axis, None, None),
+                  P(model_axis, None), P(model_axis, None, None),
+                  P(model_axis, None)),
+        out_specs=(batch_spec, P()),
+        check_vma=False)(h, moe["gate"], moe["w1"], moe["b1"], moe["w2"],
+                         moe["b2"])
+    return out, aux
 
 
 def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
@@ -483,13 +551,27 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
 
     moe_dispatch = (select_moe_dispatch(c, mesh, model_axis)
                     if c.num_experts > 1 else None)
+    # routed + expert-sharded mesh -> the explicit shard_map EP program,
+    # when the experts divide the axis (shard_map precondition) and no
+    # sequence axis is in play (the shard_map would force a seq
+    # re-gather). Every other routed case keeps the GSPMD routed path —
+    # an explicit moe_dispatch='routed' is always honored as routed.
+    ep = (dict(zip(mesh.axis_names, mesh.devices.shape)).get(model_axis, 1)
+          if mesh is not None and model_axis is not None else 1)
+    moe_ep = (moe_dispatch == "routed" and ep > 1 and seq_axis is None
+              and _mesh_divides(mesh, model_axis, c.num_experts))
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
         x = _attn_apply(layer, x, c, attn_fn)
         if c.num_experts > 1:
             h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
             h = h.astype(c.dtype)
-            h, aux = _moe_block(h, layer["moe"], c, dispatch=moe_dispatch)
+            if moe_ep:
+                h, aux = _moe_block_routed_ep(h, layer["moe"], c, mesh,
+                                              batch_axis, model_axis)
+            else:
+                h, aux = _moe_block(h, layer["moe"], c,
+                                    dispatch=moe_dispatch)
             aux_total = aux_total + aux
             x = x + h
         else:
