@@ -58,7 +58,7 @@ PAGES = [
     ("Transformer", "elephas_tpu.models.transformer",
      ["TransformerConfig", "init_params", "param_specs", "forward",
       "forward_with_aux", "lm_loss", "make_train_step", "shard_params",
-      "select_moe_dispatch"]),
+      "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
      ["TransformerModel"]),
     ("Pipeline parallelism", "elephas_tpu.parallel.pipeline",

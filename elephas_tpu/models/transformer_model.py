@@ -25,6 +25,7 @@ from .optimizers import Optimizer
 from .optimizers import get as get_optimizer
 from .transformer import (TransformerConfig, forward, init_params, lm_loss,
                           make_train_step, select_moe_dispatch, shard_params)
+from .transformer import generate as _generate
 
 __all__ = ["TransformerModel"]
 
@@ -345,6 +346,16 @@ class TransformerModel:
                     self.params, jnp.asarray(tokens[i:i + batch_size])))
                 for i in range(0, tokens.shape[0], batch_size)]
         return np.concatenate(outs, axis=0)
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Autoregressive continuation of ``(batch, prompt_len)`` token
+        ids via the KV-cache decode loop (one lax.scan, compiled once per
+        shape): ``temperature=0`` greedy, otherwise categorical sampling."""
+        key = jax.random.PRNGKey(seed)
+        return np.asarray(_generate(self.params, np.asarray(prompt),
+                                    int(max_new_tokens), self.config,
+                                    temperature=temperature, key=key))
 
     def evaluate(self, tokens: np.ndarray, y=None, batch_size: int = 8,
                  verbose: int = 0) -> float:
