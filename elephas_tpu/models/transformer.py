@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import attention
+from ..ops.attention import NEG_INF, attention
 from ..ops.pallas_attention import flash_attention, flash_attention_sharded
 from ..ops.ring_attention import ring_attention_sharded
 
@@ -607,15 +607,60 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     return loss
 
 
+def zero_opt_specs(tx, params: Dict, config: TransformerConfig, mesh: Mesh,
+                   data_axis: str = "data", model_axis: str = "model"):
+    """ZeRO-1 style PartitionSpecs for the optimizer state: param-shaped
+    state leaves (Adam moments etc.) keep their tensor-parallel sharding
+    and additionally shard their first still-unsharded, divisible
+    dimension over the ``data`` axis — optimizer memory scales down with
+    the data-parallel degree instead of being replicated across it (the
+    gradients are already replicated post-psum, so XLA turns the update
+    into a per-shard computation plus the collectives it needs). Scalar
+    leaves (step counts) replicate.
+
+    Works structurally: optax states are (nested) tuples/NamedTuples
+    whose fields are either pytrees with the params' treedef or scalars.
+    """
+    dsize = dict(zip(mesh.axis_names, mesh.devices.shape)).get(data_axis, 1)
+    specs = param_specs(config, model_axis=model_axis)
+    params_treedef = jax.tree_util.tree_structure(params)
+
+    def extend(spec, leaf):
+        if dsize <= 1:
+            return spec
+        entries = list(spec) + [None] * (len(leaf.shape) - len(spec))
+        for i, (s, dim) in enumerate(zip(entries, leaf.shape)):
+            if s is None and dim % dsize == 0 and dim >= dsize:
+                entries[i] = data_axis
+                return P(*entries)
+        return spec  # nothing divisible: keep the tensor-parallel spec
+
+    state_shapes = jax.eval_shape(tx.init, params)
+
+    def walk(node):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[walk(getattr(node, f))
+                                for f in node._fields])
+        if isinstance(node, (tuple, list)):
+            return type(node)(walk(s) for s in node)
+        if jax.tree_util.tree_structure(node) == params_treedef:
+            return jax.tree_util.tree_map(extend, specs, node)
+        return P()  # scalar / non-param-shaped leaf: replicate
+
+    return walk(state_shapes)
+
+
 def make_train_step(config: TransformerConfig, tx,
                     mesh: Optional[Mesh] = None,
                     data_axis: Optional[str] = "data",
                     model_axis: Optional[str] = "model",
-                    seq_axis: Optional[str] = None):
+                    seq_axis: Optional[str] = None,
+                    zero_optimizer: bool = False):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
     step with dp/tp(/sp) shardings. With ``mesh=None`` it is the plain
-    single-device step."""
-
+    single-device step. ``zero_optimizer=True`` pins the optimizer state
+    to :func:`zero_opt_specs` shardings (ZeRO-1: moments sharded over the
+    data axis instead of replicated)."""
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(
             params, tokens, config, mesh=mesh, seq_axis=seq_axis,
@@ -625,7 +670,30 @@ def make_train_step(config: TransformerConfig, tx,
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    if not (zero_optimizer and mesh is not None):
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    jitted = {}
+
+    def stepper(params, opt_state, tokens):
+        # the opt-state shardings depend on the params treedef, so the
+        # jit wrapper is built on first call and cached
+        if "fn" not in jitted:
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                zero_opt_specs(tx, params, config, mesh, data_axis,
+                               model_axis),
+                is_leaf=lambda x: isinstance(x, P))
+            # in_shardings too: a replicated opt state passed on the
+            # first call is resharded on entry, so the donated input and
+            # the sharded output alias cleanly
+            jitted["fn"] = jax.jit(
+                step, donate_argnums=(0, 1),
+                in_shardings=(None, shardings, None),
+                out_shardings=(None, shardings, None))
+        return jitted["fn"](params, opt_state, tokens)
+
+    return stepper
 
 
 def shard_params(params: Dict, config: TransformerConfig, mesh: Mesh,
@@ -681,7 +749,7 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
         cv = cache[f"layer_{i}"]["v"].at[:, :, pos].set(v_new)
         new_cache[f"layer_{i}"] = {"k": ck, "v": cv}
         scores = jnp.einsum("bhk,bhtk->bht", q, ck) * scale
-        scores = jnp.where(mask, scores, -1e30)
+        scores = jnp.where(mask, scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bht,bhtk->bhk", weights, cv)
         x = x + jnp.einsum("bhk,hkd->bd", o,

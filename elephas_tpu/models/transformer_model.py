@@ -58,12 +58,17 @@ class TransformerModel:
     :param config: :class:`~elephas_tpu.models.transformer.TransformerConfig`
     :param tensor_parallel: Megatron-style model-axis size the training
         mesh uses (1 = pure data parallelism over all visible devices)
+    :param zero_optimizer: shard the optimizer state over the data axis
+        (ZeRO-1: optimizer memory scales down with the data-parallel
+        degree instead of being replicated)
     """
 
     def __init__(self, config: TransformerConfig,
-                 tensor_parallel: int = 1, name: Optional[str] = None):
+                 tensor_parallel: int = 1, name: Optional[str] = None,
+                 zero_optimizer: bool = False):
         self.config = config
         self.tensor_parallel = int(tensor_parallel)
+        self.zero_optimizer = bool(zero_optimizer)
         self.name = name or "transformer_model"
         self.params: Optional[Dict] = None
         self.built = False
@@ -189,6 +194,7 @@ class TransformerModel:
     def get_config(self) -> Dict:
         return {"name": self.name,
                 "tensor_parallel": self.tensor_parallel,
+                "zero_optimizer": self.zero_optimizer,
                 "transformer_config": _config_to_dict(self.config)}
 
     def to_json(self, **kwargs) -> str:
@@ -201,7 +207,8 @@ class TransformerModel:
                     ) -> "TransformerModel":
         return cls(_config_from_dict(config["transformer_config"]),
                    tensor_parallel=config.get("tensor_parallel", 1),
-                   name=config.get("name"))
+                   name=config.get("name"),
+                   zero_optimizer=config.get("zero_optimizer", False))
 
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
@@ -253,7 +260,8 @@ class TransformerModel:
         if mesh is not None:
             params = shard_params(params, self.config, mesh)
             token_sharding = NamedSharding(mesh, P("data", None))
-        step = make_train_step(self.config, self._tx, mesh=mesh)
+        step = make_train_step(self.config, self._tx, mesh=mesh,
+                               zero_optimizer=self.zero_optimizer)
         opt_state = (self._opt_state if self._opt_state is not None
                      else jax.jit(self._tx.init)(params))
 
