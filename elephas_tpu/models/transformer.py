@@ -72,6 +72,14 @@ class TransformerConfig:
     #: activation memory that stays O(1) in depth — the standard TPU
     #: HBM trade for long sequences / deep stacks
     remat: bool = False
+    #: position encoding: ``learned`` adds a trained (max_seq_len, d)
+    #: table at the embedding; ``rope`` rotates q/k per layer (RoFormer)
+    #: — relative positions, no length-bound table, the standard choice
+    #: for long-context models
+    positional: str = "learned"
+    #: RoPE base frequency (10000 is the RoFormer default; larger bases
+    #: extend usable context)
+    rope_theta: float = 10000.0
 
     def __post_init__(self):
         if self.attention_impl not in ("auto", "flash", "xla"):
@@ -85,6 +93,11 @@ class TransformerConfig:
                              f"'routed', got {self.moe_dispatch!r}")
         if self.moe_capacity_factor <= 0:
             raise ValueError("moe_capacity_factor must be positive")
+        if self.positional not in ("learned", "rope"):
+            raise ValueError("positional must be 'learned' or 'rope', "
+                             f"got {self.positional!r}")
+        if self.positional == "rope" and self.head_dim % 2:
+            raise ValueError("rope requires an even head_dim")
 
     @property
     def head_dim(self) -> int:
@@ -100,13 +113,15 @@ def init_params(config: TransformerConfig, key) -> Dict:
         return (jax.random.normal(k, shape, c.param_dtype)
                 / math.sqrt(fan_in))
 
+    embed: Dict[str, Any] = {
+        "tokens": 0.02 * jax.random.normal(
+            keys[0], (c.vocab_size, c.d_model), c.param_dtype),
+    }
+    if c.positional == "learned":
+        embed["pos"] = 0.02 * jax.random.normal(
+            keys[1], (c.max_seq_len, c.d_model), c.param_dtype)
     params: Dict[str, Any] = {
-        "embed": {
-            "tokens": 0.02 * jax.random.normal(
-                keys[0], (c.vocab_size, c.d_model), c.param_dtype),
-            "pos": 0.02 * jax.random.normal(
-                keys[1], (c.max_seq_len, c.d_model), c.param_dtype),
-        },
+        "embed": embed,
         "final_ln": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
                      "beta": jnp.zeros((c.d_model,), c.param_dtype)},
     }
@@ -152,8 +167,11 @@ def param_specs(config: TransformerConfig, model_axis: str = "model") -> Dict:
     exactly one all-reduce (inserted by XLA) where it re-enters the
     residual stream.
     """
+    embed_specs: Dict[str, Any] = {"tokens": P(model_axis, None)}
+    if config.positional == "learned":
+        embed_specs["pos"] = P(None, None)
     specs: Dict[str, Any] = {
-        "embed": {"tokens": P(model_axis, None), "pos": P(None, None)},
+        "embed": embed_specs,
         "final_ln": {"gamma": P(None), "beta": P(None)},
     }
     for i in range(config.num_layers):
@@ -231,6 +249,22 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
     return "xla"
 
 
+def _apply_rope(x, positions, config: "TransformerConfig"):
+    """Rotate the head dimension of ``x`` (..., seq, head_dim) by the
+    position-dependent RoPE angles (RoFormer, half-split convention).
+    Angles are computed in f32; the rotation runs in x's dtype."""
+    c = config
+    half = c.head_dim // 2
+    freqs = c.rope_theta ** (-jnp.arange(half, dtype=jnp.float32) * 2.0
+                             / c.head_dim)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (seq, half)
+    cos = jnp.cos(angles).astype(x.dtype)
+    sin = jnp.sin(angles).astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
 def _layer_norm(x, gamma, beta, eps=1e-5):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
@@ -246,6 +280,12 @@ def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
     q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
     k = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wk"].astype(c.dtype))
     v = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wv"].astype(c.dtype))
+    if c.positional == "rope":
+        # rotation happens on the logically-global sequence (GSPMD keeps
+        # the iota global under sharding), before any ring/flash shard_map
+        pos = jnp.arange(x.shape[1])
+        q = _apply_rope(q, pos, c)
+        k = _apply_rope(k, pos, c)
     o = attn_fn(q, k, v)
     return x + jnp.einsum("bhtk,hkd->btd", o,
                           layer["attn"]["wo"].astype(c.dtype))
@@ -276,9 +316,13 @@ def block_apply(layer: Dict, x: jnp.ndarray, config: TransformerConfig,
 
 def embed_apply(embed: Dict, tokens: jnp.ndarray,
                 config: TransformerConfig) -> jnp.ndarray:
-    """Token + positional embedding -> activations in the compute dtype.
-    Shared by the monolithic forward and the pipelined LM entry."""
-    x = embed["tokens"][tokens] + embed["pos"][:tokens.shape[1]]
+    """Token (+ learned positional) embedding -> activations in the
+    compute dtype. Shared by the monolithic forward and the pipelined LM
+    entry. RoPE configs carry position in the per-layer q/k rotation
+    instead of an additive table."""
+    x = embed["tokens"][tokens]
+    if config.positional == "learned":
+        x = x + embed["pos"][:tokens.shape[1]]
     return x.astype(config.dtype)
 
 
@@ -731,8 +775,10 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
     """
     c = config
     scale = 1.0 / math.sqrt(c.head_dim)
-    x = (params["embed"]["tokens"][tokens]
-         + params["embed"]["pos"][pos]).astype(c.dtype)      # (B, D)
+    x = params["embed"]["tokens"][tokens]
+    if c.positional == "learned":
+        x = x + params["embed"]["pos"][pos]
+    x = x.astype(c.dtype)                                    # (B, D)
     length = next(iter(cache.values()))["k"].shape[2]
     mask = (jnp.arange(length) <= pos)[None, None, :]        # (1, 1, L)
     new_cache: Dict = {}
@@ -745,6 +791,12 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
                            layer["attn"]["wk"].astype(c.dtype))
         v_new = jnp.einsum("bd,dhk->bhk", h,
                            layer["attn"]["wv"].astype(c.dtype))
+        if c.positional == "rope":
+            # the cache stores rotated keys (standard practice): the new
+            # k/q rotate at this position, cached keys are already rotated
+            # (_apply_rope broadcasts a scalar position over (B, H, half))
+            q = _apply_rope(q, jnp.asarray(pos), c)
+            k_new = _apply_rope(k_new, jnp.asarray(pos), c)
         ck = cache[f"layer_{i}"]["k"].at[:, :, pos].set(k_new)
         cv = cache[f"layer_{i}"]["v"].at[:, :, pos].set(v_new)
         new_cache[f"layer_{i}"] = {"k": ck, "v": cv}
