@@ -38,6 +38,15 @@ from .layers import (InputLayer, KTensor, Layer, deserialize_layer,
 _MODEL_UID = [0]
 
 
+def _cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree (ints/bools untouched);
+    non-array leaves (Python floats) become arrays of the target dtype."""
+    def cast(a):
+        a = jnp.asarray(a)
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+    return jax.tree_util.tree_map(cast, tree)
+
+
 def _auto_name(prefix: str) -> str:
     _MODEL_UID[0] += 1
     return f"{prefix}_{_MODEL_UID[0]}"
@@ -72,6 +81,9 @@ class BaseModel:
         self._rng_seed: Optional[int] = None
         self._step_counter = 0
         self._jit_cache: Dict[str, Any] = {}
+        #: mixed precision: compute dtype for forward/backward (params and
+        #: optimizer state stay f32); set via compile(compute_dtype=...)
+        self._compute_dtype = None
         #: callbacks set this mid-fit to end training after the epoch
         self.stop_training = False
 
@@ -237,20 +249,69 @@ class BaseModel:
 
     # ------------------------------------------------------------------ apply
     def apply(self, params: Dict, inputs, training: bool = False, rng=None):
-        """Pure forward pass. Safe to jit/vmap/shard_map."""
+        """Pure forward pass. Safe to jit/vmap/shard_map. Under mixed
+        precision (``compile(compute_dtype='bfloat16')``) params/inputs
+        cast down for the compute and predictions cast back to f32."""
+        if self._compute_dtype is not None:
+            params = _cast_floats(params, self._compute_dtype)
+            inputs = _cast_floats(inputs, self._compute_dtype)
         y, _ = self._apply_internal(params, inputs, training, rng,
                                     collect_updates=False)
+        if self._compute_dtype is not None:
+            y = _cast_floats(y, jnp.float32)
         return y
 
     def _apply_internal(self, params, inputs, training, rng, collect_updates):
         raise NotImplementedError
 
+    def _apply_for_training(self, params, inputs, rng):
+        """Training forward with the compile-level mixed-precision casts
+        applied: compute runs in ``_compute_dtype`` (when set), while the
+        returned predictions and state updates are f32 for the loss,
+        metrics and state merge. The single entry point for every
+        training objective (the model's own jitted step and the sharded
+        trainers), so mixed precision holds on all paths."""
+        if self._compute_dtype is not None:
+            params = _cast_floats(params, self._compute_dtype)
+            inputs = _cast_floats(inputs, self._compute_dtype)
+        preds, updates = self._apply_internal(params, inputs, True, rng,
+                                              collect_updates=True)
+        if self._compute_dtype is not None:
+            preds = _cast_floats(preds, jnp.float32)
+            updates = _cast_floats(updates, jnp.float32)
+        return preds, updates
+
     # ---------------------------------------------------------------- compile
     def compile(self, optimizer="rmsprop", loss=None, metrics=None,
-                custom_objects: Optional[Dict] = None, seed: Optional[int] = None):
-        """Attach optimizer, loss and metrics; builds params if shapes known."""
+                custom_objects: Optional[Dict] = None, seed: Optional[int] = None,
+                compute_dtype: Optional[str] = None):
+        """Attach optimizer, loss and metrics; builds params if shapes known.
+
+        :param compute_dtype: ``'bfloat16'`` enables mixed precision —
+            forward/backward run in bf16 (MXU-native, half the HBM
+            traffic) while parameters, optimizer state, loss and metrics
+            stay f32. bf16's f32-sized exponent needs no loss scaling.
+        """
         custom_objects = {**self.custom_objects, **(custom_objects or {})}
         self.custom_objects = custom_objects
+        if compute_dtype is not None:
+            canonical = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+                         "float32": None, "fp32": None}
+            if compute_dtype in ("float16", "fp16"):
+                # fp16's 5-bit exponent underflows small gradients without
+                # loss scaling, which this stack does not implement —
+                # reject rather than silently fail to converge
+                raise ValueError(
+                    "compute_dtype='float16' needs loss scaling, which is "
+                    "not implemented; use 'bfloat16' (f32-sized exponent, "
+                    "no scaling needed)")
+            if compute_dtype not in canonical:
+                raise ValueError(
+                    f"unsupported compute_dtype {compute_dtype!r}")
+            name = canonical[compute_dtype]
+            self._compute_dtype = jnp.dtype(name) if name else None
+        else:
+            self._compute_dtype = None
         self.optimizer = optimizers_mod.get(optimizer)
         if loss is None:
             raise ValueError("compile() requires a loss")
@@ -316,8 +377,10 @@ class BaseModel:
         def step(trainable, state, opt_state, key, xb, yb):
             def objective(tr):
                 params = self._merge_params(tr, state)
-                preds, updates = self._apply_internal(params, xb, True, key,
-                                                      collect_updates=True)
+                # mixed precision (when compiled so): compute in bf16,
+                # master params and the loss/metric reductions stay f32
+                # (grad of the cast casts back, so gradients land f32)
+                preds, updates = self._apply_for_training(params, xb, key)
                 per_sample = loss_fn(yb, preds)
                 return jnp.mean(per_sample), (preds, updates)
 

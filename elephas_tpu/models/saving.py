@@ -41,6 +41,9 @@ def save_model(model: BaseModel, filepath: str, overwrite: bool = True,
                 "loss": losses_mod.serialize(model.loss),
                 "metrics": [metrics_mod.serialize(m) for m in model.metrics],
             }
+            compute_dtype = getattr(model, "_compute_dtype", None)
+            if compute_dtype is not None:
+                training_config["compute_dtype"] = str(compute_dtype)
             f.attrs["training_config"] = json.dumps(training_config).encode("utf8")
 
 
@@ -61,7 +64,10 @@ def load_model(filepath: str, custom_objects: Optional[Dict] = None) -> BaseMode
             if isinstance(training_config, bytes):
                 training_config = training_config.decode("utf8")
             cfg = json.loads(training_config)
+            compile_kwargs = {}
+            if cfg.get("compute_dtype"):
+                compile_kwargs["compute_dtype"] = cfg["compute_dtype"]
             model.compile(optimizer=optimizers_mod.deserialize(cfg["optimizer"]),
                           loss=cfg["loss"], metrics=cfg.get("metrics", []),
-                          custom_objects=custom_objects)
+                          custom_objects=custom_objects, **compile_kwargs)
     return model

@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from .optimizers import Optimizer
 from .optimizers import get as get_optimizer
@@ -256,10 +256,11 @@ class TransformerModel:
         if n_val:
             tokens, val_tokens = tokens[:-n_val], tokens[-n_val:]
 
+        from ..parallel.mesh import shard_leading
+
         params = self.params
         if mesh is not None:
             params = shard_params(params, self.config, mesh)
-            token_sharding = NamedSharding(mesh, P("data", None))
         step = make_train_step(self.config, self._tx, mesh=mesh,
                                zero_optimizer=self.zero_optimizer)
         opt_state = (self._opt_state if self._opt_state is not None
@@ -271,6 +272,8 @@ class TransformerModel:
                                  batch_axis="data" if mesh else None,
                                  model_axis="model" if mesh else None))
 
+        from ..utils.tracing import StepTimer
+
         rng = np.random.default_rng(seed)
         n = tokens.shape[0]
         nb = n // batch_size
@@ -280,22 +283,33 @@ class TransformerModel:
         history: Dict[str, List[float]] = {"loss": []}
         if n_val:
             history["val_loss"] = []
+        history["epoch_time"] = []
+        self.timer = timer = StepTimer()
 
         for epoch in range(epochs):
+            timer.start()
             order = rng.permutation(n)
             shuffled = tokens[order]
             losses = []
             for i in range(nb):
-                xb = jnp.asarray(shuffled[i * batch_size:(i + 1) * batch_size])
+                xb = shuffled[i * batch_size:(i + 1) * batch_size]
                 if mesh is not None:
-                    xb = jax.device_put(xb, token_sharding)
+                    # shard_leading routes through global-array assembly
+                    # on process-spanning meshes (multi-host DCN), plain
+                    # device_put otherwise
+                    xb = shard_leading(mesh, "data", xb)
+                else:
+                    xb = jnp.asarray(xb)
                 params, opt_state, loss = step(params, opt_state, xb)
                 losses.append(loss)
+            # the float() fetches block on the epoch's dispatched steps,
+            # so the recorded wall time is real (tracing requirement)
             logs = {"loss": float(np.mean([float(l) for l in losses]))}
+            timer.stop()
+            history["epoch_time"].append(timer.durations[-1])
             if n_val:
-                vb = jnp.asarray(val_tokens)
-                if mesh is not None:
-                    vb = jax.device_put(vb, token_sharding)
+                vb = (shard_leading(mesh, "data", val_tokens)
+                      if mesh is not None else jnp.asarray(val_tokens))
                 logs["val_loss"] = float(eval_loss(params, vb))
             for k, v in logs.items():
                 history[k].append(v)

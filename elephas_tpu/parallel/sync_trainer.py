@@ -129,8 +129,8 @@ class SyncAverageTrainer:
 
                     def objective(tr):
                         params = model._merge_params(tr, state)
-                        preds, updates = model._apply_internal(
-                            params, xb, True, key_b, collect_updates=True)
+                        preds, updates = model._apply_for_training(
+                            params, xb, key_b)
                         per = loss_fn(yb, preds)
                         count = jnp.sum(swb)
                         mean_loss = jnp.sum(per * swb) / jnp.maximum(count, 1.0)
@@ -262,8 +262,7 @@ class SyncStepTrainer:
 
             def objective(tr):
                 params = model._merge_params(tr, state)
-                preds, updates = model._apply_internal(params, xb, True, sub,
-                                                       collect_updates=True)
+                preds, updates = model._apply_for_training(params, xb, sub)
                 per = loss_fn(yb, preds)
                 count = jnp.maximum(jnp.sum(swb), 1.0)
                 return jnp.sum(per * swb) / count, (preds, updates, count)

@@ -122,6 +122,10 @@ class TPUModel:
         self.master_optimizer = serialize_optimizer(model.optimizer)
         self.master_loss = model.loss
         self.master_metrics = list(model.metrics or [])
+        # compile-level mixed precision rides to every worker/replica
+        compute_dtype = getattr(model, "_compute_dtype", None)
+        self.master_compute_dtype = (str(compute_dtype)
+                                     if compute_dtype is not None else None)
         self.custom_objects = custom_objects or {}
         self.parameter_server_mode = parameter_server_mode
         self.batch_size = batch_size
@@ -493,6 +497,7 @@ class TPUModel:
                         self.frequency, self.master_optimizer,
                         self.master_loss, self.master_metrics,
                         self.custom_objects, port=self.port,
+                        compute_dtype=self.master_compute_dtype,
                         overlap=self.async_overlap,
                         accum_batches=self.async_accum,
                         epoch_event=(aggregator.report if aggregator
@@ -548,6 +553,11 @@ class TPUModel:
             self._replica = model_from_json(self._master_network.to_json(),
                                             self.custom_objects)
             self._replica_src = None
+        # mixed precision is compile-level config, not architecture: carry
+        # it onto the replica (every call: a master recompile with a
+        # different dtype must not leave a stale replica dtype behind)
+        self._replica._compute_dtype = getattr(
+            self._master_network, "_compute_dtype", None)
         # sync only when the master's params pytree object changed
         # (set_weights/trainers always swap it): an unconditional
         # set_weights would rebuild the replica's pytree every call and

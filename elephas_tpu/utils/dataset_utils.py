@@ -83,3 +83,30 @@ def lp_to_dataset(lp_dataset: Dataset, categorical: bool = False,
     else:
         labels = np.array([lp.label for lp in rows])
     return Dataset((features, labels), num_partitions=lp_dataset._num_partitions)
+
+
+def tokens_to_sequences(token_ids, seq_len: int,
+                        drop_remainder: bool = True) -> np.ndarray:
+    """Chunk a flat token-id stream into ``(rows, seq_len)`` training
+    sequences for the transformer LM (the LM analog of ``to_dataset``:
+    next-token targets are the shifted input, so no label column).
+
+    :param token_ids: 1-D array/list of token ids (a tokenized corpus)
+    :param seq_len: sequence length of each row
+    :param drop_remainder: drop the trailing partial chunk (default);
+        ``False`` right-pads the last row with the final token id
+    """
+    ids = np.asarray(token_ids).reshape(-1)
+    if seq_len < 2:
+        raise ValueError("seq_len must be >= 2 (next-token loss needs at "
+                         "least one target position)")
+    n_full = len(ids) // seq_len
+    if drop_remainder or len(ids) % seq_len == 0:
+        if n_full == 0:
+            raise ValueError(
+                f"token stream of {len(ids)} ids is shorter than "
+                f"seq_len={seq_len}")
+        return ids[:n_full * seq_len].reshape(n_full, seq_len)
+    pad = (n_full + 1) * seq_len - len(ids)
+    padded = np.concatenate([ids, np.full(pad, ids[-1], dtype=ids.dtype)])
+    return padded.reshape(n_full + 1, seq_len)

@@ -40,7 +40,8 @@ class SyncWorker:
     def __init__(self, json_config: str, parameters: List[np.ndarray],
                  train_config: Dict[str, Any], master_optimizer,
                  master_loss, master_metrics,
-                 custom_objects: Optional[Dict] = None):
+                 custom_objects: Optional[Dict] = None,
+                 compute_dtype: Optional[str] = None):
         self.json = json_config
         self.parameters = parameters
         self.train_config = dict(train_config)
@@ -48,6 +49,7 @@ class SyncWorker:
         self.master_loss = master_loss
         self.master_metrics = master_metrics
         self.custom_objects = custom_objects or {}
+        self.compute_dtype = compute_dtype
         self.model = None
 
     def train(self, x_train: np.ndarray, y_train: np.ndarray):
@@ -56,7 +58,8 @@ class SyncWorker:
         self.model = model_from_json(self.json, self.custom_objects)
         self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
                            loss=self.master_loss, metrics=self.master_metrics,
-                           custom_objects=self.custom_objects)
+                           custom_objects=self.custom_objects,
+                           compute_dtype=self.compute_dtype)
         self.model.set_weights(self.parameters)
 
         weights_before = self.model.get_weights()
@@ -188,7 +191,8 @@ class AsyncWorker:
                  master_optimizer, master_loss, master_metrics,
                  custom_objects: Optional[Dict] = None, port: int = 4000,
                  overlap: bool = False, accum_batches: int = 1,
-                 epoch_event=None, should_stop=None):
+                 epoch_event=None, should_stop=None,
+                 compute_dtype: Optional[str] = None):
         if isinstance(client, BaseParameterClient):
             self.client = client
         else:
@@ -201,6 +205,7 @@ class AsyncWorker:
         self.master_loss = master_loss
         self.master_metrics = master_metrics
         self.custom_objects = custom_objects or {}
+        self.compute_dtype = compute_dtype
         self.overlap = overlap
         self.accum_batches = max(1, int(accum_batches))
         self.epoch_event = epoch_event
@@ -218,7 +223,8 @@ class AsyncWorker:
         self.model = model_from_json(self.json, self.custom_objects)
         self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
                            loss=self.master_loss, metrics=self.master_metrics,
-                           custom_objects=self.custom_objects)
+                           custom_objects=self.custom_objects,
+                           compute_dtype=self.compute_dtype)
         self.model.set_weights(self.parameters)
 
         train_config = dict(self.train_config)

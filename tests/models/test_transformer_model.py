@@ -168,3 +168,17 @@ def test_generate_through_model_surface():
     sampled = model.generate(prompt, 7, temperature=0.8, seed=11)
     assert sampled.shape == (3, 7)
     assert (sampled >= 0).all() and (sampled < model.config.vocab_size).all()
+
+
+def test_fit_with_forced_global_assembly(monkeypatch):
+    """The multi-host token placement path (make_array_from_callback
+    global assembly) must work for the flagship fit — forced via the env
+    flag the dryrun/CI use, since real multi-process launches are not
+    available in-suite."""
+    monkeypatch.setenv("ELEPHAS_TPU_FORCE_GLOBAL_ASSEMBLY", "1")
+    model = _model(tensor_parallel=2)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(40), epochs=1, batch_size=8, verbose=0,
+                  validation_split=0.2)
+    history = tpu_model.training_histories[-1]
+    assert len(history["loss"]) == 1 and "val_loss" in history

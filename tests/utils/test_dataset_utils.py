@@ -98,3 +98,22 @@ def test_dataset_partitioning():
     assert np.array_equal(parts[0][1], np.array([0, 1, 2, 3.0]))
     re = ds.repartition(2)
     assert re.partition_sizes() == [5, 5]
+
+
+def test_tokens_to_sequences_chunks_and_pads():
+    import numpy as np
+    import pytest
+
+    from elephas_tpu.utils.dataset_utils import tokens_to_sequences
+
+    ids = np.arange(10)
+    out = tokens_to_sequences(ids, 4)
+    assert out.shape == (2, 4)
+    np.testing.assert_array_equal(out[0], [0, 1, 2, 3])
+    padded = tokens_to_sequences(ids, 4, drop_remainder=False)
+    assert padded.shape == (3, 4)
+    np.testing.assert_array_equal(padded[2], [8, 9, 9, 9])
+    with pytest.raises(ValueError, match="shorter"):
+        tokens_to_sequences(np.arange(3), 4)
+    with pytest.raises(ValueError, match="seq_len"):
+        tokens_to_sequences(ids, 1)
