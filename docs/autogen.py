@@ -26,6 +26,9 @@ PAGES = [
       "Multiply", "Concatenate", "Input"]),
     ("Optimizers", "elephas_tpu.models.optimizers",
      ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "Adadelta", "Nadam"]),
+    ("LR schedules", "elephas_tpu.models.schedules",
+     ["ExponentialDecay", "CosineDecay", "PiecewiseConstantDecay",
+      "WarmupCosine"]),
     ("Workers", "elephas_tpu.worker", ["SyncWorker", "AsyncWorker"]),
     ("Parameter servers", "elephas_tpu.parameter.server",
      ["BaseParameterServer", "HttpServer", "SocketServer"]),

@@ -1,4 +1,6 @@
-from . import activations, initializers, losses, metrics, optimizers
+from . import activations, initializers, losses, metrics, optimizers, schedules
+from .schedules import (CosineDecay, ExponentialDecay,
+                        PiecewiseConstantDecay, WarmupCosine)
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,
                         ModelCheckpoint)
 from .core import BaseModel, History, Model, Sequential, model_from_json

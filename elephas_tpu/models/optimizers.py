@@ -11,19 +11,47 @@ from typing import Dict, Union
 
 import optax
 
+from . import schedules
+
+
+def _coerce_lr(learning_rate):
+    """float stays float; schedule objects/serialized dicts resolve."""
+    if isinstance(learning_rate, schedules.LearningRateSchedule):
+        return learning_rate
+    if isinstance(learning_rate, dict):
+        return schedules.deserialize(learning_rate)
+    return float(learning_rate)
+
 
 class Optimizer:
-    """Base class: named hyperparameter bundle lowering to optax."""
+    """Base class: named hyperparameter bundle lowering to optax.
 
-    def __init__(self, learning_rate: float = 0.01, **kwargs):
-        self.learning_rate = float(learning_rate)
+    ``learning_rate`` is a float or a
+    :class:`~elephas_tpu.models.schedules.LearningRateSchedule` (or its
+    serialized dict) — schedules lower to optax schedule callables, so
+    the per-step rate is computed on-device inside the jitted step.
+    """
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        self.learning_rate = _coerce_lr(learning_rate)
         self.kwargs = kwargs
+
+    def _lr(self):
+        """optax-ready learning rate: float, or the schedule callable."""
+        if isinstance(self.learning_rate, schedules.LearningRateSchedule):
+            return self.learning_rate.to_optax()
+        return self.learning_rate
+
+    def _lr_config(self):
+        if isinstance(self.learning_rate, schedules.LearningRateSchedule):
+            return schedules.serialize(self.learning_rate)
+        return self.learning_rate
 
     def to_optax(self) -> optax.GradientTransformation:
         raise NotImplementedError
 
     def get_config(self) -> Dict:
-        return {"learning_rate": self.learning_rate, **self.kwargs}
+        return {"learning_rate": self._lr_config(), **self.kwargs}
 
     @classmethod
     def from_config(cls, config: Dict) -> "Optimizer":
@@ -43,12 +71,12 @@ class SGD(Optimizer):
         self.nesterov = bool(nesterov)
 
     def to_optax(self):
-        return optax.sgd(self.learning_rate,
+        return optax.sgd(self._lr(),
                          momentum=self.momentum if self.momentum else None,
                          nesterov=self.nesterov)
 
     def get_config(self):
-        return {"learning_rate": self.learning_rate, "momentum": self.momentum,
+        return {"learning_rate": self._lr_config(), "momentum": self.momentum,
                 "nesterov": self.nesterov}
 
 
@@ -61,11 +89,11 @@ class Adam(Optimizer):
         self.beta_1, self.beta_2, self.epsilon = float(beta_1), float(beta_2), float(epsilon)
 
     def to_optax(self):
-        return optax.adam(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+        return optax.adam(self._lr(), b1=self.beta_1, b2=self.beta_2,
                           eps=self.epsilon)
 
     def get_config(self):
-        return {"learning_rate": self.learning_rate, "beta_1": self.beta_1,
+        return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
                 "beta_2": self.beta_2, "epsilon": self.epsilon}
 
 
@@ -76,7 +104,7 @@ class AdamW(Adam):
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return optax.adamw(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+        return optax.adamw(self._lr(), b1=self.beta_1, b2=self.beta_2,
                            eps=self.epsilon, weight_decay=self.weight_decay)
 
     def get_config(self):
@@ -94,11 +122,11 @@ class RMSprop(Optimizer):
         self.rho, self.momentum, self.epsilon = float(rho), float(momentum), float(epsilon)
 
     def to_optax(self):
-        return optax.rmsprop(self.learning_rate, decay=self.rho, eps=self.epsilon,
+        return optax.rmsprop(self._lr(), decay=self.rho, eps=self.epsilon,
                              momentum=self.momentum if self.momentum else None)
 
     def get_config(self):
-        return {"learning_rate": self.learning_rate, "rho": self.rho,
+        return {"learning_rate": self._lr_config(), "rho": self.rho,
                 "momentum": self.momentum, "epsilon": self.epsilon}
 
 
@@ -110,10 +138,10 @@ class Adagrad(Optimizer):
         self.epsilon = float(epsilon)
 
     def to_optax(self):
-        return optax.adagrad(self.learning_rate, eps=self.epsilon)
+        return optax.adagrad(self._lr(), eps=self.epsilon)
 
     def get_config(self):
-        return {"learning_rate": self.learning_rate, "epsilon": self.epsilon}
+        return {"learning_rate": self._lr_config(), "epsilon": self.epsilon}
 
 
 class Adadelta(Optimizer):
@@ -125,16 +153,16 @@ class Adadelta(Optimizer):
         self.rho, self.epsilon = float(rho), float(epsilon)
 
     def to_optax(self):
-        return optax.adadelta(self.learning_rate, rho=self.rho, eps=self.epsilon)
+        return optax.adadelta(self._lr(), rho=self.rho, eps=self.epsilon)
 
     def get_config(self):
-        return {"learning_rate": self.learning_rate, "rho": self.rho,
+        return {"learning_rate": self._lr_config(), "rho": self.rho,
                 "epsilon": self.epsilon}
 
 
 class Nadam(Adam):
     def to_optax(self):
-        return optax.nadam(self.learning_rate, b1=self.beta_1, b2=self.beta_2,
+        return optax.nadam(self._lr(), b1=self.beta_1, b2=self.beta_2,
                            eps=self.epsilon)
 
 

@@ -1,0 +1,160 @@
+"""Learning-rate schedules: serializable wrappers over optax schedules.
+
+Keras-parity surface (the reference inherits `LearningRateSchedule`
+support from Keras optimizers implicitly): schedule objects pass as the
+``learning_rate`` of any :mod:`~elephas_tpu.models.optimizers` optimizer,
+lower to optax schedule callables inside the jitted train step (the step
+count drives them on-device — no host involvement per step), and
+round-trip through the same ``{'class_name', 'config'}`` serialization as
+optimizers, so scheduled configs travel inside model JSON, h5 files and
+checkpoint manifests.
+"""
+from typing import Dict, List, Union
+
+import optax
+
+__all__ = ["LearningRateSchedule", "ExponentialDecay", "CosineDecay",
+           "PiecewiseConstantDecay", "WarmupCosine", "serialize",
+           "deserialize", "get"]
+
+
+class LearningRateSchedule:
+    """Base class: named hyperparameter bundle lowering to an optax
+    schedule ``step -> learning_rate``."""
+
+    def to_optax(self):
+        raise NotImplementedError
+
+    def __call__(self, step: int) -> float:
+        return float(self.to_optax()(step))
+
+    def get_config(self) -> Dict:
+        raise NotImplementedError
+
+    @classmethod
+    def from_config(cls, config: Dict) -> "LearningRateSchedule":
+        return cls(**config)
+
+
+class ExponentialDecay(LearningRateSchedule):
+    """``lr = initial * decay_rate ** (step / decay_steps)`` (Keras
+    semantics; ``staircase`` floors the exponent)."""
+
+    def __init__(self, initial_learning_rate: float, decay_steps: int,
+                 decay_rate: float, staircase: bool = False):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = bool(staircase)
+
+    def to_optax(self):
+        return optax.exponential_decay(
+            init_value=self.initial_learning_rate,
+            transition_steps=self.decay_steps,
+            decay_rate=self.decay_rate, staircase=self.staircase)
+
+    def get_config(self):
+        return {"initial_learning_rate": self.initial_learning_rate,
+                "decay_steps": self.decay_steps,
+                "decay_rate": self.decay_rate,
+                "staircase": self.staircase}
+
+
+class CosineDecay(LearningRateSchedule):
+    """Cosine anneal from the initial rate to ``alpha * initial`` over
+    ``decay_steps``."""
+
+    def __init__(self, initial_learning_rate: float, decay_steps: int,
+                 alpha: float = 0.0):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.alpha = float(alpha)
+
+    def to_optax(self):
+        return optax.cosine_decay_schedule(
+            init_value=self.initial_learning_rate,
+            decay_steps=self.decay_steps, alpha=self.alpha)
+
+    def get_config(self):
+        return {"initial_learning_rate": self.initial_learning_rate,
+                "decay_steps": self.decay_steps, "alpha": self.alpha}
+
+
+class PiecewiseConstantDecay(LearningRateSchedule):
+    """``values[i]`` between ``boundaries[i-1]`` and ``boundaries[i]``
+    (len(values) == len(boundaries) + 1, Keras semantics)."""
+
+    def __init__(self, boundaries: List[int], values: List[float]):
+        if len(values) != len(boundaries) + 1:
+            raise ValueError("need len(values) == len(boundaries) + 1")
+        self.boundaries = [int(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+
+    def to_optax(self):
+        # hand-rolled rather than optax.piecewise_constant_schedule: the
+        # optax version is multiplicative (breaks on zero values, a legal
+        # input) and switches one step early relative to Keras's
+        # "values[i] while step <= boundaries[i]" contract
+        import jax.numpy as jnp
+
+        boundaries = jnp.asarray(self.boundaries)
+        values = jnp.asarray(self.values, jnp.float32)
+
+        def schedule(count):
+            return values[jnp.sum(count > boundaries)]
+
+        return schedule
+
+    def get_config(self):
+        return {"boundaries": self.boundaries, "values": self.values}
+
+
+class WarmupCosine(LearningRateSchedule):
+    """Linear warmup to ``peak_learning_rate`` over ``warmup_steps``, then
+    cosine decay to ``end_learning_rate`` by ``decay_steps`` — the
+    standard LM training schedule."""
+
+    def __init__(self, peak_learning_rate: float, warmup_steps: int,
+                 decay_steps: int, end_learning_rate: float = 0.0):
+        self.peak_learning_rate = float(peak_learning_rate)
+        self.warmup_steps = int(warmup_steps)
+        self.decay_steps = int(decay_steps)
+        self.end_learning_rate = float(end_learning_rate)
+
+    def to_optax(self):
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=self.peak_learning_rate,
+            warmup_steps=self.warmup_steps, decay_steps=self.decay_steps,
+            end_value=self.end_learning_rate)
+
+    def get_config(self):
+        return {"peak_learning_rate": self.peak_learning_rate,
+                "warmup_steps": self.warmup_steps,
+                "decay_steps": self.decay_steps,
+                "end_learning_rate": self.end_learning_rate}
+
+
+_SCHEDULES = {cls.__name__: cls for cls in
+              (ExponentialDecay, CosineDecay, PiecewiseConstantDecay,
+               WarmupCosine)}
+
+
+def serialize(schedule: LearningRateSchedule) -> Dict:
+    return {"class_name": type(schedule).__name__,
+            "config": schedule.get_config()}
+
+
+def deserialize(config: Dict) -> LearningRateSchedule:
+    cls = _SCHEDULES.get(config.get("class_name"))
+    if cls is None:
+        raise ValueError(f"Unknown schedule: {config.get('class_name')!r}")
+    return cls.from_config(config.get("config", {}))
+
+
+def get(identifier: Union[Dict, LearningRateSchedule]
+        ) -> LearningRateSchedule:
+    if isinstance(identifier, LearningRateSchedule):
+        return identifier
+    if isinstance(identifier, dict):
+        return deserialize(identifier)
+    raise ValueError(f"Cannot interpret schedule: {identifier!r}")

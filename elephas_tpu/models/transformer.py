@@ -77,6 +77,10 @@ class TransformerConfig:
     #: — relative positions, no length-bound table, the standard choice
     #: for long-context models
     positional: str = "learned"
+    #: weight of the z-loss term ``mean(logsumexp(logits)^2)`` (PaLM §5):
+    #: keeps logits from drifting large, which stabilizes bf16 training
+    #: at scale — 0 disables it (1e-4 is the usual setting)
+    z_loss_weight: float = 0.0
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
@@ -648,6 +652,11 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     loss = next_token_loss(logits, tokens)
     if config.num_experts > 1 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
+    if config.z_loss_weight:
+        # PaLM-style z-loss: penalize the log-partition so logits don't
+        # drift large (bf16 stability); only predicting positions count
+        z = jax.scipy.special.logsumexp(logits[:, :-1], axis=-1)
+        loss = loss + config.z_loss_weight * jnp.mean(z * z)
     return loss
 
 
@@ -699,17 +708,54 @@ def make_train_step(config: TransformerConfig, tx,
                     data_axis: Optional[str] = "data",
                     model_axis: Optional[str] = "model",
                     seq_axis: Optional[str] = None,
-                    zero_optimizer: bool = False):
+                    zero_optimizer: bool = False,
+                    accum_steps: int = 1):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
     step with dp/tp(/sp) shardings. With ``mesh=None`` it is the plain
     single-device step. ``zero_optimizer=True`` pins the optimizer state
     to :func:`zero_opt_specs` shardings (ZeRO-1: moments sharded over the
-    data axis instead of replicated)."""
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(lm_loss)(
+    data axis instead of replicated). ``accum_steps > 1`` splits the
+    token batch into that many microbatches and accumulates gradients in
+    one ``lax.scan`` before the single optimizer update — the effective
+    batch no longer has to fit in memory at once (equal-size microbatches
+    make the result identical to the unaccumulated step)."""
+    accum_steps = max(1, int(accum_steps))
+
+    def loss_and_grads(params, tokens):
+        return jax.value_and_grad(lm_loss)(
             params, tokens, config, mesh=mesh, seq_axis=seq_axis,
             batch_axis=data_axis if mesh is not None else None,
             model_axis=model_axis if mesh is not None else None)
+
+    def step(params, opt_state, tokens):
+        if accum_steps > 1:
+            if tokens.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch {tokens.shape[0]} does not split into "
+                    f"{accum_steps} microbatches")
+            micro = tokens.reshape((accum_steps,
+                                    tokens.shape[0] // accum_steps)
+                                   + tokens.shape[1:])
+            if mesh is not None and data_axis is not None:
+                # keep each microbatch sharded over the data axis (the
+                # reshape otherwise leaves XLA free to pick a layout it
+                # then repartitions with a full rematerialization)
+                micro = jax.lax.with_sharding_constraint(
+                    micro, NamedSharding(mesh, P(None, data_axis,
+                                                 *([None] * (micro.ndim - 2)))))
+
+            def body(carry, tk):
+                gsum, lsum = carry
+                loss, grads = loss_and_grads(params, tk)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, lsum + loss), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (gsum, lsum), _ = jax.lax.scan(body, (zeros, 0.0), micro)
+            grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+            loss = lsum / accum_steps
+        else:
+            loss, grads = loss_and_grads(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss

@@ -61,14 +61,19 @@ class TransformerModel:
     :param zero_optimizer: shard the optimizer state over the data axis
         (ZeRO-1: optimizer memory scales down with the data-parallel
         degree instead of being replicated)
+    :param grad_accum: accumulate gradients over this many microbatches
+        per optimizer step (each fit batch splits into ``grad_accum``
+        microbatches; identical numerics, 1/``grad_accum`` the activation
+        memory)
     """
 
     def __init__(self, config: TransformerConfig,
                  tensor_parallel: int = 1, name: Optional[str] = None,
-                 zero_optimizer: bool = False):
+                 zero_optimizer: bool = False, grad_accum: int = 1):
         self.config = config
         self.tensor_parallel = int(tensor_parallel)
         self.zero_optimizer = bool(zero_optimizer)
+        self.grad_accum = max(1, int(grad_accum))
         self.name = name or "transformer_model"
         self.params: Optional[Dict] = None
         self.built = False
@@ -195,6 +200,7 @@ class TransformerModel:
         return {"name": self.name,
                 "tensor_parallel": self.tensor_parallel,
                 "zero_optimizer": self.zero_optimizer,
+                "grad_accum": self.grad_accum,
                 "transformer_config": _config_to_dict(self.config)}
 
     def to_json(self, **kwargs) -> str:
@@ -208,7 +214,8 @@ class TransformerModel:
         return cls(_config_from_dict(config["transformer_config"]),
                    tensor_parallel=config.get("tensor_parallel", 1),
                    name=config.get("name"),
-                   zero_optimizer=config.get("zero_optimizer", False))
+                   zero_optimizer=config.get("zero_optimizer", False),
+                   grad_accum=config.get("grad_accum", 1))
 
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
@@ -261,8 +268,13 @@ class TransformerModel:
         params = self.params
         if mesh is not None:
             params = shard_params(params, self.config, mesh)
+        if batch_size % self.grad_accum:
+            raise ValueError(
+                f"batch_size={batch_size} does not split into "
+                f"{self.grad_accum} gradient-accumulation microbatches")
         step = make_train_step(self.config, self._tx, mesh=mesh,
-                               zero_optimizer=self.zero_optimizer)
+                               zero_optimizer=self.zero_optimizer,
+                               accum_steps=self.grad_accum)
         opt_state = (self._opt_state if self._opt_state is not None
                      else jax.jit(self._tx.init)(params))
 

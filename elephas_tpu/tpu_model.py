@@ -122,10 +122,6 @@ class TPUModel:
         self.master_optimizer = serialize_optimizer(model.optimizer)
         self.master_loss = model.loss
         self.master_metrics = list(model.metrics or [])
-        # compile-level mixed precision rides to every worker/replica
-        compute_dtype = getattr(model, "_compute_dtype", None)
-        self.master_compute_dtype = (str(compute_dtype)
-                                     if compute_dtype is not None else None)
         self.custom_objects = custom_objects or {}
         self.parameter_server_mode = parameter_server_mode
         self.batch_size = batch_size
@@ -178,6 +174,13 @@ class TPUModel:
     @property
     def training_histories(self):
         return self._training_histories
+
+    @property
+    def master_compute_dtype(self) -> Optional[str]:
+        """The master's compile-level mixed-precision dtype, read live so
+        a recompile is seen by workers and replicas alike."""
+        dt = getattr(self._master_network, "_compute_dtype", None)
+        return str(dt) if dt is not None else None
 
     @property
     def master_network(self) -> BaseModel:
@@ -554,10 +557,16 @@ class TPUModel:
                                             self.custom_objects)
             self._replica_src = None
         # mixed precision is compile-level config, not architecture: carry
-        # it onto the replica (every call: a master recompile with a
-        # different dtype must not leave a stale replica dtype behind)
-        self._replica._compute_dtype = getattr(
-            self._master_network, "_compute_dtype", None)
+        # it onto the replica (checked every call: a master recompile with
+        # a different dtype must not leave a stale replica dtype behind —
+        # and the already-traced predict/eval functions must be dropped,
+        # or they would keep serving the old dtype's compilation)
+        master_dtype = getattr(self._master_network, "_compute_dtype", None)
+        if self._replica._compute_dtype != master_dtype:
+            self._replica._compute_dtype = master_dtype
+            self._replica._invalidate_jit()
+            self._predict_fn = None
+            self._evaluate_fn = None
         # sync only when the master's params pytree object changed
         # (set_weights/trainers always swap it): an unconditional
         # set_weights would rebuild the replica's pytree every call and

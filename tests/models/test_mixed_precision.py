@@ -116,3 +116,19 @@ def test_bf16_propagates_to_async_workers():
     m.compile(deserialize_optimizer(worker.master_optimizer), worker.master_loss,
               compute_dtype=worker.compute_dtype)
     assert m._compute_dtype == jnp.dtype("bfloat16")
+
+
+def test_recompile_dtype_invalidates_replica_jit():
+    """Switching the master's compute dtype after a predict must not keep
+    serving the old dtype's compiled functions."""
+    x, y = _data(64)
+    model = _model()  # f32
+    tpu_model = TPUModel(model, mode="synchronous", sync_mode="step")
+    p32 = tpu_model.predict(x[:16])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  ["acc"], seed=0, compute_dtype="bfloat16")
+    p16 = tpu_model.predict(x[:16])
+    # bf16 rounding must be visible (same weights, different compute)
+    assert not np.array_equal(p32, p16)
+    np.testing.assert_allclose(p16, p32, atol=2e-2)
+    assert tpu_model.master_compute_dtype == "bfloat16"

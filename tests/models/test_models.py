@@ -196,3 +196,33 @@ def test_save_load_h5(tmp_path):
     np.testing.assert_allclose(loaded.predict(x), model.predict(x), atol=1e-6)
     assert isinstance(loaded.optimizer, M.SGD)
     assert loaded.optimizer.learning_rate == pytest.approx(0.1)
+
+
+def test_scheduled_lr_on_sequential_and_h5_roundtrip(tmp_path):
+    """LR schedules ride through the Keras-style optimizer machinery:
+    compile, fit, save, load — the schedule config survives the h5
+    round-trip inside training_config."""
+    import numpy as np
+
+    from elephas_tpu.models import (Activation, Adam, Dense,
+                                    ExponentialDecay, Sequential,
+                                    load_model)
+
+    rng = np.random.default_rng(0)
+    x = rng.random((128, 8), dtype=np.float32)
+    y = (x @ rng.random((8, 1), dtype=np.float32)).astype(np.float32)
+    schedule = ExponentialDecay(0.05, decay_steps=16, decay_rate=0.9)
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(1)])
+    model.compile(Adam(schedule), "mse", seed=0)
+    history = model.fit(x, y, epochs=5, batch_size=32, verbose=0,
+                        validation_split=0.0)
+    hist = history.history if hasattr(history, "history") else history
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    path = str(tmp_path / "sched.h5")
+    model.save(path)
+    loaded = load_model(path)
+    assert isinstance(loaded.optimizer.learning_rate, ExponentialDecay)
+    assert (loaded.optimizer.learning_rate.get_config()
+            == schedule.get_config())

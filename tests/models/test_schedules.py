@@ -1,0 +1,57 @@
+"""LR schedules: Keras-semantics values, optax lowering, serialization."""
+import numpy as np
+import pytest
+
+from elephas_tpu.models.schedules import (CosineDecay, ExponentialDecay,
+                                          PiecewiseConstantDecay,
+                                          WarmupCosine, deserialize,
+                                          serialize)
+
+
+def test_exponential_decay_values():
+    s = ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+    assert np.isclose(s(0), 0.1)
+    assert np.isclose(s(10), 0.05)
+    assert np.isclose(s(20), 0.025)
+    stair = ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5,
+                             staircase=True)
+    assert np.isclose(stair(9), 0.1)  # floored exponent
+    assert np.isclose(stair(10), 0.05)
+
+
+def test_cosine_decay_endpoints():
+    s = CosineDecay(0.1, decay_steps=100, alpha=0.1)
+    assert np.isclose(s(0), 0.1)
+    assert np.isclose(s(100), 0.01, rtol=1e-5)  # alpha * initial
+    assert s(50) < s(0)
+
+
+def test_piecewise_keras_boundary_semantics_and_zero_values():
+    s = PiecewiseConstantDecay([100], [0.1, 0.01])
+    # Keras contract: values[i] while step <= boundaries[i]
+    assert np.isclose(s(100), 0.1)
+    assert np.isclose(s(101), 0.01)
+    # zero values are legal (the optax multiplicative lowering would
+    # divide by zero)
+    z = PiecewiseConstantDecay([10, 20], [0.1, 0.0, 0.01])
+    assert z(15) == 0.0 and np.isclose(z(25), 0.01)
+    with pytest.raises(ValueError, match="len"):
+        PiecewiseConstantDecay([10], [0.1])
+
+
+def test_warmup_cosine_shape():
+    s = WarmupCosine(1e-2, warmup_steps=10, decay_steps=100,
+                     end_learning_rate=1e-4)
+    assert s(0) < s(5) < s(10)          # linear warmup
+    assert np.isclose(s(10), 1e-2)      # peak
+    assert np.isclose(s(100), 1e-4, rtol=1e-4)  # end value
+
+
+def test_serialization_roundtrip_all():
+    for s in (ExponentialDecay(0.1, 10, 0.5, True),
+              CosineDecay(0.1, 100, 0.1),
+              PiecewiseConstantDecay([5, 10], [0.3, 0.2, 0.1]),
+              WarmupCosine(1e-2, 10, 100, 1e-4)):
+        rt = deserialize(serialize(s))
+        assert type(rt) is type(s)
+        assert rt.get_config() == s.get_config()

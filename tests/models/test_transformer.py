@@ -744,3 +744,75 @@ def test_rope_requires_even_head_dim():
     with pytest.raises(ValueError, match="even head_dim"):
         dataclasses.replace(_config(), positional="rope", num_heads=32,
                             d_model=32)  # head_dim 1
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 over a batch of 8 must produce the same parameters
+    as the single full-batch step (equal-size microbatches: mean of
+    microbatch grads == full-batch grad)."""
+    config = _config()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                config.vocab_size)
+    tx = optax.adam(1e-2)
+
+    p_full = init_params(config, jax.random.PRNGKey(0))
+    o_full = tx.init(p_full)
+    p_full, o_full, l_full = make_train_step(config, tx)(p_full, o_full,
+                                                         tokens)
+
+    p_acc = init_params(config, jax.random.PRNGKey(0))
+    o_acc = tx.init(p_acc)
+    p_acc, o_acc, l_acc = make_train_step(config, tx, accum_steps=4)(
+        p_acc, o_acc, tokens)
+
+    np.testing.assert_allclose(float(l_acc), float(l_full), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_acc),
+                    jax.tree_util.tree_leaves(p_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5,
+                                   rtol=1e-5)
+
+
+def test_z_loss_added_and_finite():
+    import dataclasses
+
+    config = _config()
+    z_config = dataclasses.replace(config, z_loss_weight=1e-2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                config.vocab_size)
+    plain = float(lm_loss(params, tokens, config))
+    with_z = float(lm_loss(params, tokens, z_config))
+    assert with_z > plain  # the z penalty is strictly positive
+    g = jax.grad(lm_loss)(params, tokens, z_config)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_scheduled_lr_transformer_training():
+    """A WarmupCosine schedule drives the jitted step on-device: the
+    schedule value changes with the step count and training proceeds."""
+    from elephas_tpu.models import Adam, WarmupCosine
+
+    schedule = WarmupCosine(1e-2, warmup_steps=4, decay_steps=64)
+    assert schedule(0) < schedule(4)  # warming up
+    assert schedule(4) > schedule(64)  # decaying
+    opt = Adam(schedule)
+    tx = opt.to_optax()
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                config.vocab_size)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
+
+    # the schedule serializes inside the optimizer config
+    from elephas_tpu.models import optimizers as optimizers_mod
+    rt = optimizers_mod.deserialize(optimizers_mod.serialize(opt))
+    assert isinstance(rt.learning_rate, WarmupCosine)
+    assert rt.learning_rate.get_config() == schedule.get_config()

@@ -182,3 +182,15 @@ def test_fit_with_forced_global_assembly(monkeypatch):
                   validation_split=0.2)
     history = tpu_model.training_histories[-1]
     assert len(history["loss"]) == 1 and "val_loss" in history
+
+
+def test_grad_accum_through_model_surface():
+    model = TransformerModel(_config(), grad_accum=2)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][1] < history["loss"][0]
+    clone = model_from_json(model.to_json())
+    assert clone.grad_accum == 2
