@@ -59,7 +59,8 @@ PAGES = [
     ("Ring attention", "elephas_tpu.ops.ring_attention",
      ["ring_attention", "ring_attention_sharded"]),
     ("Transformer", "elephas_tpu.models.transformer",
-     ["TransformerConfig", "init_params", "param_specs", "forward",
+     ["TransformerConfig", "init_params", "param_specs",
+      "fsdp_param_specs", "zero_opt_specs", "forward",
       "forward_with_aux", "lm_loss", "make_train_step", "shard_params",
       "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
