@@ -61,6 +61,10 @@ class TransformerModel:
     :param zero_optimizer: shard the optimizer state over the data axis
         (ZeRO-1: optimizer memory scales down with the data-parallel
         degree instead of being replicated)
+    :param fsdp: fully shard parameters, gradients, AND optimizer state
+        over the data axis (ZeRO-3 via
+        :func:`~elephas_tpu.models.transformer.fsdp_param_specs`);
+        composes with ``tensor_parallel``, supersedes ``zero_optimizer``
     :param grad_accum: accumulate gradients over this many microbatches
         per optimizer step (each fit batch splits into ``grad_accum``
         microbatches; identical numerics, 1/``grad_accum`` the activation
@@ -69,9 +73,13 @@ class TransformerModel:
 
     def __init__(self, config: TransformerConfig,
                  tensor_parallel: int = 1, name: Optional[str] = None,
-                 zero_optimizer: bool = False, grad_accum: int = 1):
+                 zero_optimizer: bool = False, grad_accum: int = 1,
+                 fsdp: bool = False):
+        if fsdp and zero_optimizer:
+            raise ValueError("fsdp supersedes zero_optimizer — pick one")
         self.config = config
         self.tensor_parallel = int(tensor_parallel)
+        self.fsdp = bool(fsdp)
         self.zero_optimizer = bool(zero_optimizer)
         self.grad_accum = max(1, int(grad_accum))
         self.name = name or "transformer_model"
@@ -201,6 +209,7 @@ class TransformerModel:
                 "tensor_parallel": self.tensor_parallel,
                 "zero_optimizer": self.zero_optimizer,
                 "grad_accum": self.grad_accum,
+                "fsdp": self.fsdp,
                 "transformer_config": _config_to_dict(self.config)}
 
     def to_json(self, **kwargs) -> str:
@@ -215,7 +224,8 @@ class TransformerModel:
                    tensor_parallel=config.get("tensor_parallel", 1),
                    name=config.get("name"),
                    zero_optimizer=config.get("zero_optimizer", False),
-                   grad_accum=config.get("grad_accum", 1))
+                   grad_accum=config.get("grad_accum", 1),
+                   fsdp=config.get("fsdp", False))
 
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
@@ -267,14 +277,17 @@ class TransformerModel:
 
         params = self.params
         if mesh is not None:
-            params = shard_params(params, self.config, mesh)
+            params = shard_params(
+                params, self.config, mesh,
+                fsdp_axis="data" if self.fsdp else None)
         if batch_size % self.grad_accum:
             raise ValueError(
                 f"batch_size={batch_size} does not split into "
                 f"{self.grad_accum} gradient-accumulation microbatches")
         step = make_train_step(self.config, self._tx, mesh=mesh,
                                zero_optimizer=self.zero_optimizer,
-                               accum_steps=self.grad_accum)
+                               accum_steps=self.grad_accum,
+                               fsdp=self.fsdp and mesh is not None)
         opt_state = (self._opt_state if self._opt_state is not None
                      else jax.jit(self._tx.init)(params))
 
