@@ -1,0 +1,111 @@
+"""Measure the BASELINE.md rows beyond bench.py's two headline configs.
+
+Row: Otto-style tabular pipeline (parity with the reference's
+``examples/ml_pipeline_otto.py`` Spark pipeline) — Estimator.fit
+throughput through the full ML-pipeline stack (DataFrame adapter ->
+TPUModel -> sync trainer) plus transform accuracy.
+
+Row: ResNet-50 on CIFAR-10 shapes, synchronous per-step SGD — the conv
+workload BASELINE.md names twice. Uses the full TPUModel sync-step path
+(whole epoch jitted, donated buffers).
+
+Prints one JSON line per row. Run on the real chip:
+    python benchmarks/baseline_rows.py [otto|resnet50]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+
+def measure_otto(epochs=8):
+    from common import otto_like
+
+    from elephas_tpu.ml import Estimator, to_data_frame
+    from elephas_tpu.models import (Activation, Adam, Dense, Dropout,
+                                    Sequential, serialize_optimizer)
+
+    x, labels = otto_like(n=8192)
+    classes, indexed = np.unique(labels, return_inverse=True)
+    nb_classes = len(classes)
+    mean, std = x.mean(axis=0), x.std(axis=0) + 1e-8
+    x = (x - mean) / std
+    split = int(0.8 * len(x))
+    train_df = to_data_frame(x[:split], indexed[:split].astype(float),
+                             categorical=False)
+    test_df = to_data_frame(x[split:], indexed[split:].astype(float),
+                            categorical=False)
+
+    def make_estimator(n_epochs):
+        model = Sequential([Dense(256, input_dim=x.shape[1]),
+                            Activation("relu"), Dropout(0.3),
+                            Dense(256), Activation("relu"), Dropout(0.3),
+                            Dense(nb_classes), Activation("softmax")])
+        model.build()
+        return Estimator(
+            model_config=model.to_json(),
+            optimizer_config=serialize_optimizer(Adam(learning_rate=1e-3)),
+            loss="categorical_crossentropy", metrics=["acc"],
+            mode="synchronous", categorical=True, nb_classes=nb_classes,
+            epochs=n_epochs, batch_size=128, validation_split=0.1,
+            num_workers=4, verbose=0, seed=0)
+
+    make_estimator(1).fit(train_df)  # warmup: compile
+    est = make_estimator(epochs)
+    start = time.perf_counter()
+    fitted = est.fit(train_df)
+    elapsed = time.perf_counter() - start
+    result = fitted.transform(test_df)
+    acc = float(np.mean([int(np.argmax(p)) == int(label) for p, label
+                         in zip(result["prediction"], result["label"])]))
+    return {"metric": "otto_pipeline_sync_samples_per_sec",
+            "value": round(split * epochs / elapsed, 1),
+            "unit": "samples/sec", "epochs": epochs, "n_train": split,
+            "test_accuracy": round(acc, 4),
+            "config": "93->256->256->9 MLP, adam, batch 128, sync average, "
+                      "4 workers, full ML-pipeline stack"}
+
+
+def measure_resnet50(epochs=2, n=4096, batch_size=128):
+    from elephas_tpu.models import SGD
+    from elephas_tpu.models.resnet import build_resnet50
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.0, 1.0, (n, 32, 32, 3)).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.integers(0, 10, n)]
+
+    model = build_resnet50(input_shape=(32, 32, 3), num_classes=10)
+    model.compile(SGD(learning_rate=0.05, momentum=0.9),
+                  "categorical_crossentropy", seed=0)
+    tpu_model = TPUModel(model, mode="synchronous", sync_mode="step",
+                         batch_size=batch_size)
+    dataset = to_dataset(x, y)
+    tpu_model.fit(dataset, epochs=1, batch_size=batch_size, verbose=0,
+                  validation_split=0.0)  # warmup: compile
+    start = time.perf_counter()
+    tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=0,
+                  validation_split=0.0)
+    elapsed = time.perf_counter() - start
+    return {"metric": "resnet50_cifar_sync_step_samples_per_sec",
+            "value": round(n * epochs / elapsed, 1),
+            "unit": "samples/sec", "epochs": epochs, "n": n,
+            "batch_size": batch_size,
+            "config": "ResNet-50 bottleneck (He et al.), 32x32x3 inputs, "
+                      "10 classes, SGD+momentum, sync-step (whole epoch "
+                      "jitted)"}
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("otto", "all"):
+        print(json.dumps(measure_otto()))
+    if which in ("resnet50", "all"):
+        print(json.dumps(measure_resnet50()))

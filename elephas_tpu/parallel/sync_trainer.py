@@ -76,6 +76,9 @@ class SyncAverageTrainer:
         self.tx = optimizer.to_optax()
         self.loss_fn = losses_mod.get(loss, custom_objects)
         self.metric_fns = list(metrics or [])
+        # jitted all-workers programs keyed by the run geometry — repeat
+        # fits with the same shapes reuse the compiled program
+        self._run_fns: Dict = {}
 
     def run(self, weights: List[np.ndarray],
             shards: Sequence[Tuple[np.ndarray, np.ndarray]],
@@ -198,8 +201,14 @@ class SyncAverageTrainer:
             keys = jax.random.split(jax.random.PRNGKey(seed), num_workers)
             keys_d = shard_leading(mesh, "workers", keys)
             params_d = replicate(mesh, params0)
+            run_key = (num_workers, X.shape, Y.shape, batch_size, epochs,
+                       bool(shuffle), float(validation_split), multihost)
+            run_fn = self._run_fns.get(run_key)
+            if run_fn is None:
+                run_fn = jax.jit(all_workers)
+                self._run_fns[run_key] = run_fn
             timer.start()
-            new_params, histories = jax.jit(all_workers)(
+            new_params, histories = run_fn(
                 params_d, X_d, Y_d, SW_d, active_d, keys_d)
 
         model.params = jax.device_get(new_params)  # forces completion
@@ -247,7 +256,10 @@ class SyncStepTrainer:
         from .mesh import data_mesh
 
         self.mesh = mesh if mesh is not None else data_mesh()
-        self._epoch_fn = None
+        # jitted epoch programs keyed by (nb, batch, shuffle): refitting
+        # with the same geometry must NOT recompile (on conv nets the
+        # XLA compile dwarfs the training itself)
+        self._epoch_fns: Dict = {}
         self._donate = donate
 
     def _build_epoch_fn(self, nb: int, batch_size: int, shuffle: bool):
@@ -346,7 +358,11 @@ class SyncStepTrainer:
         state = replicate(mesh, state)
         opt_state = jax.jit(self.tx.init)(trainable)
 
-        epoch_fn = self._build_epoch_fn(nb, global_batch, shuffle)
+        cache_key = (nb, global_batch, bool(shuffle))
+        epoch_fn = self._epoch_fns.get(cache_key)
+        if epoch_fn is None:
+            epoch_fn = self._build_epoch_fn(nb, global_batch, shuffle)
+            self._epoch_fns[cache_key] = epoch_fn
         base_key = jax.random.PRNGKey(seed)
         metric_names = ["loss"] + [metrics_mod.serialize(fn)
                                    for fn in self.metric_fns]

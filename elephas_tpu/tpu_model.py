@@ -148,6 +148,9 @@ class TPUModel:
             self.client = transport.create_client(self.port)
 
         self._replica = None  # lazily-built worker replica for predict/eval
+        # trainers cached across fit() calls so their jitted epoch
+        # programs survive; keyed by the compile-level config
+        self._trainer_cache = {}
         self._replica_src = None  # master params the replica last adopted
         self._predict_fn = None
         self._evaluate_fn = None
@@ -366,9 +369,11 @@ class TPUModel:
         from .parallel.sync_trainer import SyncAverageTrainer
 
         replica = self._get_replica()
-        trainer = SyncAverageTrainer(
-            replica, deserialize_optimizer(self.master_optimizer),
-            self.master_loss, self._worker_metric_fns(), self.custom_objects)
+        trainer = self._cached_trainer(
+            "sync_average", lambda: SyncAverageTrainer(
+                replica, deserialize_optimizer(self.master_optimizer),
+                self.master_loss, self._worker_metric_fns(),
+                self.custom_objects))
         shards = ds.partitions()
         new_weights, histories = trainer.run(
             self._master_network.get_weights(), shards, epochs=epochs,
@@ -386,9 +391,11 @@ class TPUModel:
         from .parallel.sync_trainer import SyncStepTrainer
 
         replica = self._get_replica()
-        trainer = SyncStepTrainer(
-            replica, deserialize_optimizer(self.master_optimizer),
-            self.master_loss, self._worker_metric_fns(), self.custom_objects)
+        trainer = self._cached_trainer(
+            "sync_step", lambda: SyncStepTrainer(
+                replica, deserialize_optimizer(self.master_optimizer),
+                self.master_loss, self._worker_metric_fns(),
+                self.custom_objects))
         x, y = ds.to_arrays()
 
         epoch_callback = None
@@ -541,8 +548,25 @@ class TPUModel:
             raise failure
 
     # ------------------------------------------------------------ predict/eval
+    def _cached_trainer(self, kind: str, build):
+        """Reuse a trainer (and its compiled epoch programs) across fit()
+        calls. Keyed by everything that changes the traced computation:
+        optimizer config, loss, metric set, and the replica's compute
+        dtype. A replica invalidation (architecture change) clears the
+        cache wholesale."""
+        key = (kind, str(self.master_optimizer), str(self.master_loss),
+               tuple(str(m) for m in self.master_metrics),
+               self.master_compute_dtype,
+               id(self._replica))
+        trainer = self._trainer_cache.get(key)
+        if trainer is None:
+            trainer = build()
+            self._trainer_cache = {key: trainer}
+        return trainer
+
     def _invalidate_replica(self):
         self._replica = None
+        self._trainer_cache = {}
         self._replica_src = None
         self._predict_fn = None
         self._evaluate_fn = None
