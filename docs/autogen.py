@@ -60,7 +60,7 @@ PAGES = [
      ["ring_attention", "ring_attention_sharded"]),
     ("Transformer", "elephas_tpu.models.transformer",
      ["TransformerConfig", "init_params", "param_specs",
-      "fsdp_param_specs", "zero_opt_specs", "forward",
+      "fsdp_param_specs", "zero_opt_specs", "abstract_params", "forward",
       "forward_with_aux", "lm_loss", "make_train_step", "shard_params",
       "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",

@@ -909,6 +909,34 @@ def make_train_step(config: TransformerConfig, tx,
     return stepper
 
 
+def abstract_params(config: TransformerConfig, mesh: Optional[Mesh] = None,
+                    model_axis: str = "model",
+                    fsdp_axis: Optional[str] = None) -> Dict:
+    """The parameter pytree as ``jax.ShapeDtypeStruct`` leaves — with the
+    mesh's NamedShardings attached when ``mesh`` is given (tensor-parallel
+    specs; fully-sharded when ``fsdp_axis`` is set).
+
+    This is the restore template for sharded checkpointing: passing it as
+    ``CheckpointManager.restore(..., template=...)`` makes orbax read each
+    parameter directly into its device shards (no host-side full-tensor
+    materialization), including restoring onto a *different* mesh topology
+    than the one that saved — the TPU-native upgrade over the reference's
+    whole-model h5 reload (``/root/reference/elephas/spark_model.py:355``).
+    """
+    shapes = jax.eval_shape(lambda k: init_params(config, k),
+                            jax.random.PRNGKey(0))
+    if mesh is None:
+        return shapes
+    specs = (fsdp_param_specs(config, mesh, data_axis=fsdp_axis,
+                              model_axis=model_axis, param_shapes=shapes)
+             if fsdp_axis is not None
+             else param_specs(config, model_axis=model_axis, mesh=mesh))
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, s)),
+        shapes, specs, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+
 def shard_params(params: Dict, config: TransformerConfig, mesh: Mesh,
                  model_axis: str = "model",
                  fsdp_axis: Optional[str] = None) -> Dict:
