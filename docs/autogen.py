@@ -22,8 +22,8 @@ PAGES = [
     ("Layers", "elephas_tpu.models.layers",
      ["Dense", "Activation", "Dropout", "Flatten", "Reshape", "Conv2D",
       "MaxPooling2D", "AveragePooling2D", "GlobalAveragePooling2D",
-      "Embedding", "LayerNormalization", "BatchNormalization", "Add",
-      "Multiply", "Concatenate", "Input"]),
+      "Embedding", "LSTM", "GRU", "LayerNormalization",
+      "BatchNormalization", "Add", "Multiply", "Concatenate", "Input"]),
     ("Optimizers", "elephas_tpu.models.optimizers",
      ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "Adadelta", "Nadam"]),
     ("LR schedules", "elephas_tpu.models.schedules",

@@ -4,11 +4,12 @@ from .schedules import (CosineDecay, ExponentialDecay,
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,
                         ModelCheckpoint)
 from .core import BaseModel, History, Model, Sequential, model_from_json
-from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
-                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
-                     GlobalAveragePooling2D, Input, InputLayer, KTensor,
-                     Layer, LayerNormalization, MaxPooling2D, Multiply,
-                     Reshape, register_layer, reset_layer_uids)
+from .layers import (GRU, LSTM, Activation, Add, AveragePooling2D,
+                     BatchNormalization, Concatenate, Conv2D, Dense, Dropout,
+                     Embedding, Flatten, GlobalAveragePooling2D, Input,
+                     InputLayer, KTensor, Layer, LayerNormalization,
+                     MaxPooling2D, Multiply, Reshape, register_layer,
+                     reset_layer_uids)
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, AdamW, Nadam,
                          Optimizer, RMSprop)
 from .optimizers import deserialize as deserialize_optimizer

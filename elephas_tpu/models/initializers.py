@@ -69,6 +69,23 @@ def truncated_normal(key, shape, dtype=jnp.float32):
     return 0.05 * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
 
 
+def orthogonal(key, shape, dtype=jnp.float32):
+    """Orthogonal matrix via QR (recurrent-kernel standard: preserves
+    activation norms through the recurrence)."""
+    if len(shape) < 2:
+        return random_normal(key, shape, dtype)
+    rows = shape[0]
+    cols = 1
+    for d in shape[1:]:
+        cols *= int(d)
+    n = max(rows, cols)
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    q, r = jnp.linalg.qr(a)
+    # sign correction makes the distribution uniform over O(n)
+    q = q * jnp.sign(jnp.diagonal(r))
+    return q[:rows, :cols].reshape(shape).astype(dtype)
+
+
 _INITIALIZERS: Dict[str, Callable] = {
     "zeros": zeros,
     "ones": ones,
@@ -80,6 +97,7 @@ _INITIALIZERS: Dict[str, Callable] = {
     "random_uniform": random_uniform,
     "random_normal": random_normal,
     "truncated_normal": truncated_normal,
+    "orthogonal": orthogonal,
 }
 
 

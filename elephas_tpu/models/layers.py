@@ -410,6 +410,176 @@ class Embedding(Layer):
         return config
 
 
+class LSTM(Layer):
+    """Long short-term memory over ``(batch, time, features)`` inputs.
+
+    TPU-shaped recurrence: the input projection for ALL timesteps and all
+    four gates is ONE ``(B*T, D) @ (D, 4U)`` matmul (MXU-sized, outside
+    the loop); only the ``(B, U) @ (U, 4U)`` recurrent half runs inside
+    the ``lax.scan``, whose carry is the ``(h, c)`` pair. Forget-gate bias
+    initializes to 1 (Jozefowicz et al. 2015).
+
+    Capability addition over the reference era's Keras LSTM
+    (sequence models trained data-parallel through the same
+    SparkModel/TPUModel surface).
+    """
+
+    weight_order = ("kernel", "recurrent_kernel", "bias")
+
+    def __init__(self, units: int, activation="tanh",
+                 recurrent_activation="sigmoid",
+                 return_sequences: bool = False, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.units = int(units)
+        self.activation = activation
+        self.recurrent_activation = recurrent_activation
+        self.return_sequences = bool(return_sequences)
+        self.use_bias = bool(use_bias)
+        self.kernel_initializer = kernel_initializer
+        self.recurrent_initializer = recurrent_initializer
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        in_dim = int(input_shape[-1])
+        k_in, k_rec = jax.random.split(key)
+        params = {
+            "kernel": initializers.get(self.kernel_initializer)(
+                k_in, (in_dim, 4 * self.units)),
+            "recurrent_kernel": initializers.get(self.recurrent_initializer)(
+                k_rec, (self.units, 4 * self.units)),
+        }
+        if self.use_bias:
+            bias = jnp.zeros((4 * self.units,))
+            # unit forget-gate bias (gate order: i, f, g, o)
+            bias = bias.at[self.units:2 * self.units].set(1.0)
+            params["bias"] = bias
+        return params
+
+    def compute_output_shape(self, input_shape):
+        t = input_shape[0]
+        return ((t, self.units) if self.return_sequences
+                else (self.units,))
+
+    def call(self, params, inputs, training, rng):
+        act = activations_mod.get(self.activation, self._custom_objects)
+        rec_act = activations_mod.get(self.recurrent_activation,
+                                      self._custom_objects)
+        u = self.units
+        xz = jnp.einsum("btd,dz->btz", inputs, params["kernel"])
+        if self.use_bias:
+            xz = xz + params["bias"]
+        batch = inputs.shape[0]
+        h0 = jnp.zeros((batch, u), inputs.dtype)
+        c0 = jnp.zeros((batch, u), inputs.dtype)
+        w_rec = params["recurrent_kernel"]
+
+        def step(carry, xz_t):
+            h, c = carry
+            z = xz_t + h @ w_rec
+            i = rec_act(z[:, :u])
+            f = rec_act(z[:, u:2 * u])
+            g = act(z[:, 2 * u:3 * u])
+            o = rec_act(z[:, 3 * u:])
+            c = f * c + i * g
+            h = o * act(c)
+            return (h, c), h
+
+        (h, _), hs = lax.scan(step, (h0, c0), xz.swapaxes(0, 1))
+        return hs.swapaxes(0, 1) if self.return_sequences else h
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({
+            "units": self.units,
+            "activation": activations_mod.serialize(self.activation),
+            "recurrent_activation": activations_mod.serialize(
+                self.recurrent_activation),
+            "return_sequences": self.return_sequences,
+            "use_bias": self.use_bias,
+        })
+        return config
+
+
+class GRU(Layer):
+    """Gated recurrent unit over ``(batch, time, features)`` inputs; same
+    hoisted-input-matmul structure as :class:`LSTM` (gate order: z, r, n;
+    v1 formulation — reset gate applied before the candidate matmul)."""
+
+    weight_order = ("kernel", "recurrent_kernel", "bias")
+
+    def __init__(self, units: int, activation="tanh",
+                 recurrent_activation="sigmoid",
+                 return_sequences: bool = False, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.units = int(units)
+        self.activation = activation
+        self.recurrent_activation = recurrent_activation
+        self.return_sequences = bool(return_sequences)
+        self.use_bias = bool(use_bias)
+        self.kernel_initializer = kernel_initializer
+        self.recurrent_initializer = recurrent_initializer
+
+    def build(self, key, input_shape):
+        super().build(key, input_shape)
+        in_dim = int(input_shape[-1])
+        k_in, k_rec = jax.random.split(key)
+        params = {
+            "kernel": initializers.get(self.kernel_initializer)(
+                k_in, (in_dim, 3 * self.units)),
+            "recurrent_kernel": initializers.get(self.recurrent_initializer)(
+                k_rec, (self.units, 3 * self.units)),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((3 * self.units,))
+        return params
+
+    def compute_output_shape(self, input_shape):
+        t = input_shape[0]
+        return ((t, self.units) if self.return_sequences
+                else (self.units,))
+
+    def call(self, params, inputs, training, rng):
+        act = activations_mod.get(self.activation, self._custom_objects)
+        rec_act = activations_mod.get(self.recurrent_activation,
+                                      self._custom_objects)
+        u = self.units
+        xz = jnp.einsum("btd,dz->btz", inputs, params["kernel"])
+        if self.use_bias:
+            xz = xz + params["bias"]
+        batch = inputs.shape[0]
+        h0 = jnp.zeros((batch, u), inputs.dtype)
+        w_rec = params["recurrent_kernel"]
+
+        def step(h, xz_t):
+            rz = xz_t[:, :2 * u] + h @ w_rec[:, :2 * u]
+            z = rec_act(rz[:, :u])
+            r = rec_act(rz[:, u:])
+            n = act(xz_t[:, 2 * u:] + (r * h) @ w_rec[:, 2 * u:])
+            h = (1.0 - z) * n + z * h
+            return h, h
+
+        h, hs = lax.scan(step, h0, xz.swapaxes(0, 1))
+        return hs.swapaxes(0, 1) if self.return_sequences else h
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({
+            "units": self.units,
+            "activation": activations_mod.serialize(self.activation),
+            "recurrent_activation": activations_mod.serialize(
+                self.recurrent_activation),
+            "return_sequences": self.return_sequences,
+            "use_bias": self.use_bias,
+        })
+        return config
+
+
 class LayerNormalization(Layer):
     weight_order = ("gamma", "beta")
 
@@ -540,6 +710,8 @@ _LAYERS = {
     "AveragePooling2D": AveragePooling2D,
     "GlobalAveragePooling2D": GlobalAveragePooling2D,
     "Embedding": Embedding,
+    "LSTM": LSTM,
+    "GRU": GRU,
     "LayerNormalization": LayerNormalization,
     "BatchNormalization": BatchNormalization,
     "Add": Add,

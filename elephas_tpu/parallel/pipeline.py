@@ -198,10 +198,18 @@ def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
         raise ValueError(f"{config.num_layers} layers do not split into "
                          f"{num_stages} equal pipeline stages")
 
+    block = block_apply
+    if config.remat:
+        # recompute each block in the pipeline's backward sweep: with M
+        # microbatches in flight GPipe keeps O(M) activations live per
+        # stage, so per-block remat is the difference between activation
+        # memory scaling with the *microbatch count* vs the *stage depth*
+        block = jax.checkpoint(block_apply, static_argnums=(2,))
+
     def stage_fn(stage_params, x):
         for j in range(per_stage):
             layer = jax.tree_util.tree_map(lambda p: p[j], stage_params)
-            x = block_apply(layer, x, config)
+            x = block(layer, x, config)
         return x
 
     pipe_fn = make_pipeline_fn(stage_fn, mesh, axis=axis,
