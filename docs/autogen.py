@@ -65,6 +65,9 @@ PAGES = [
       "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
      ["TransformerModel"]),
+    ("Vision Transformer", "elephas_tpu.models.vit",
+     ["ViTConfig", "init_params", "param_specs", "forward", "vit_loss",
+      "make_train_step", "shard_params"]),
     ("Pipeline parallelism", "elephas_tpu.parallel.pipeline",
      ["make_pipeline_fn", "stack_stage_params", "split_transformer_stages",
       "merge_transformer_stages", "shard_pipelined_params",

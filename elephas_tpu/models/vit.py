@@ -1,0 +1,247 @@
+"""Vision Transformer (ViT) — image-classification model family.
+
+Reuses the flagship transformer's block machinery (pre-LN attention +
+MLP sublayers, Megatron tensor-parallel specs) with non-causal attention
+over patch tokens: images ``(B, H, W, C)`` -> non-overlapping patches ->
+one ``(B*N, P*P*C) @ (P*P*C, D)`` embedding matmul (MXU-shaped: the
+conv-free formulation of the ViT stem) -> [CLS] + learned positions ->
+encoder blocks -> classification head.
+
+The reference framework's vision story is Keras CNNs trained
+data-parallel (``/root/reference/elephas/spark_model.py:169``); this adds
+the transformer-era equivalent with the same sharding machinery as the
+LM: replicated single-chip, dp over ``data``, Megatron tp over ``model``.
+"""
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import attention
+from .transformer import _attn_apply, _layer_norm, _mlp_apply
+
+__all__ = ["ViTConfig", "init_params", "param_specs", "forward", "vit_loss",
+           "make_train_step", "shard_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    num_classes: int = 10
+    num_layers: int = 6
+    num_heads: int = 4
+    d_model: int = 128
+    d_ff: int = 512
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    #: classification readout: ``cls`` token (ViT paper) or ``mean`` pool
+    pool: str = "cls"
+    #: per-block rematerialization (same HBM trade as the LM config)
+    remat: bool = False
+    #: grouped-query attention (see TransformerConfig.num_kv_heads)
+    num_kv_heads: Optional[int] = None
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"patch_size {self.patch_size} must divide image_size "
+                f"{self.image_size}")
+        if self.pool not in ("cls", "mean"):
+            raise ValueError(f"pool must be 'cls' or 'mean', got {self.pool!r}")
+        if self.d_model % self.num_heads:
+            raise ValueError("num_heads must divide d_model")
+        if self.num_kv_heads is not None and (
+                self.num_kv_heads < 1
+                or self.num_heads % self.num_kv_heads):
+            raise ValueError("num_kv_heads must divide num_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.pool == "cls" else 0)
+
+    # fields _attn_apply/_mlp_apply read off the config (shared with the
+    # LM blocks): ViT attention carries position in the additive table,
+    # never rope
+    @property
+    def positional(self) -> str:
+        return "learned"
+
+
+def init_params(config: ViTConfig, key) -> Dict:
+    """Initialize the ViT parameter pytree."""
+    c = config
+    keys = jax.random.split(key, 4 + c.num_layers)
+    patch_dim = c.patch_size * c.patch_size * c.channels
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, c.param_dtype)
+                / math.sqrt(fan_in))
+
+    embed: Dict[str, Any] = {
+        "patch_kernel": dense(keys[0], (patch_dim, c.d_model), patch_dim),
+        "patch_bias": jnp.zeros((c.d_model,), c.param_dtype),
+        "pos": 0.02 * jax.random.normal(keys[1], (c.seq_len, c.d_model),
+                                        c.param_dtype),
+    }
+    if c.pool == "cls":
+        embed["cls"] = jnp.zeros((c.d_model,), c.param_dtype)
+    params: Dict[str, Any] = {
+        "embed": embed,
+        "final_ln": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                     "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+        "head": {"kernel": dense(keys[2], (c.d_model, c.num_classes),
+                                 c.d_model),
+                 "bias": jnp.zeros((c.num_classes,), c.param_dtype)},
+    }
+    for i in range(c.num_layers):
+        lk = jax.random.split(keys[4 + i], 6)
+        params[f"layer_{i}"] = {
+            "ln1": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                    "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+            "attn": {
+                "wq": dense(lk[0], (c.d_model, c.num_heads, c.head_dim),
+                            c.d_model),
+                "wk": dense(lk[1], (c.d_model, c.kv_heads, c.head_dim),
+                            c.d_model),
+                "wv": dense(lk[2], (c.d_model, c.kv_heads, c.head_dim),
+                            c.d_model),
+                "wo": dense(lk[3], (c.num_heads, c.head_dim, c.d_model),
+                            c.d_model),
+            },
+            "ln2": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                    "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+            "mlp": {"w1": dense(lk[4], (c.d_model, c.d_ff), c.d_model),
+                    "b1": jnp.zeros((c.d_ff,), c.param_dtype),
+                    "w2": dense(lk[5], (c.d_ff, c.d_model), c.d_ff),
+                    "b2": jnp.zeros((c.d_model,), c.param_dtype)},
+        }
+    return params
+
+
+def param_specs(config: ViTConfig, model_axis: str = "model",
+                mesh: Optional[Mesh] = None) -> Dict:
+    """Tensor-parallel PartitionSpecs mirroring :func:`init_params` —
+    same Megatron sharding as the LM blocks; stem and head replicate
+    except the head's class dimension (usually tiny) stays whole."""
+    from .transformer import _mesh_divides
+
+    kv_shardable = (mesh is None
+                    or _mesh_divides(mesh, model_axis, config.kv_heads))
+    kv_spec = (P(None, model_axis, None) if kv_shardable
+               else P(None, None, None))
+    embed_specs: Dict[str, Any] = {
+        "patch_kernel": P(None, None), "patch_bias": P(None),
+        "pos": P(None, None),
+    }
+    if config.pool == "cls":
+        embed_specs["cls"] = P(None)
+    specs: Dict[str, Any] = {
+        "embed": embed_specs,
+        "final_ln": {"gamma": P(None), "beta": P(None)},
+        "head": {"kernel": P(None, None), "bias": P(None)},
+    }
+    for i in range(config.num_layers):
+        specs[f"layer_{i}"] = {
+            "ln1": {"gamma": P(None), "beta": P(None)},
+            "attn": {"wq": P(None, model_axis, None),
+                     "wk": kv_spec, "wv": kv_spec,
+                     "wo": P(model_axis, None, None)},
+            "ln2": {"gamma": P(None), "beta": P(None)},
+            "mlp": {"w1": P(None, model_axis), "b1": P(model_axis),
+                    "w2": P(model_axis, None), "b2": P(None)},
+        }
+    return specs
+
+
+def patchify(images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray:
+    """``(B, H, W, C)`` -> ``(B, N, P*P*C)`` non-overlapping patches."""
+    c = config
+    b, h, w, ch = images.shape
+    p = c.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, H/p, W/p, p, p, C)
+    return x.reshape(b, (h // p) * (w // p), p * p * ch)
+
+
+def forward(params: Dict, images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray:
+    """Images ``(B, H, W, C)`` -> class logits ``(B, num_classes)`` (f32).
+
+    Under a mesh, shard images over the data axis and params per
+    :func:`param_specs`; GSPMD partitions the same program (non-causal
+    attention has no kernel-side specialization to select)."""
+    c = config
+    e = params["embed"]
+    x = patchify(images.astype(c.dtype), c)
+    x = x @ e["patch_kernel"].astype(c.dtype) + e["patch_bias"].astype(c.dtype)
+    if c.pool == "cls":
+        cls = jnp.broadcast_to(e["cls"].astype(c.dtype),
+                               (x.shape[0], 1, c.d_model))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + e["pos"].astype(c.dtype)
+
+    def layer_apply(layer, x):
+        x = _attn_apply(layer, x, c, lambda q, k, v: attention(
+            q, k, v, causal=False))
+        return _mlp_apply(layer, x, c)
+
+    if c.remat:
+        layer_apply = jax.checkpoint(layer_apply)
+    for i in range(c.num_layers):
+        x = layer_apply(params[f"layer_{i}"], x)
+
+    pooled = x[:, 0] if c.pool == "cls" else jnp.mean(x, axis=1)
+    pooled = _layer_norm(pooled.astype(jnp.float32),
+                         params["final_ln"]["gamma"],
+                         params["final_ln"]["beta"])
+    return (pooled @ params["head"]["kernel"].astype(jnp.float32)
+            + params["head"]["bias"].astype(jnp.float32))
+
+
+def vit_loss(params: Dict, images: jnp.ndarray, labels: jnp.ndarray,
+             config: ViTConfig) -> jnp.ndarray:
+    """Softmax cross-entropy; ``labels`` are int class ids ``(B,)``."""
+    logits = forward(params, images, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def shard_params(params: Dict, config: ViTConfig, mesh: Mesh,
+                 model_axis: str = "model") -> Dict:
+    specs = param_specs(config, model_axis=model_axis, mesh=mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+def make_train_step(config: ViTConfig, tx, mesh: Optional[Mesh] = None,
+                    data_axis: str = "data"):
+    """Jitted ``(params, opt_state, images, labels) -> (params, opt_state,
+    loss)``; with a mesh, keep images/labels sharded over ``data_axis``
+    and params per :func:`param_specs` (dp gradient all-reduce inserted
+    by GSPMD)."""
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(vit_loss)(params, images, labels,
+                                                   config)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,110 @@
+"""Vision Transformer tests: shapes, training, sharded parity."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elephas_tpu.models.vit import (ViTConfig, forward, init_params,
+                                    make_train_step, param_specs,
+                                    shard_params, vit_loss)
+
+
+def _config(**kw):
+    base = dict(image_size=16, patch_size=4, channels=3, num_classes=10,
+                num_layers=2, num_heads=4, d_model=32, d_ff=64,
+                dtype=jnp.float32)
+    base.update(kw)
+    return ViTConfig(**base)
+
+
+def _images(n=32, config=None, seed=0):
+    """Separable task: class k images have a bright k-th 4x4 cell."""
+    c = config or _config()
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, c.num_classes, n)
+    x = rng.normal(0.0, 0.3, (n, c.image_size, c.image_size, c.channels))
+    for i, k in enumerate(labels):
+        r, col = divmod(int(k), c.image_size // c.patch_size)
+        x[i, r * 4:(r + 1) * 4, col * 4:(col + 1) * 4, :] += 2.0
+    return x.astype("float32"), labels.astype("int32")
+
+
+def test_vit_forward_shapes_and_loss():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    x, y = _images(8, config)
+    logits = forward(params, jnp.asarray(x), config)
+    assert logits.shape == (8, 10)
+    loss = float(vit_loss(params, jnp.asarray(x), jnp.asarray(y), config))
+    assert np.isfinite(loss)
+    assert abs(loss - np.log(10)) < 0.5  # untrained ~ uniform
+
+
+@pytest.mark.parametrize("pool", ["cls", "mean"])
+def test_vit_trains(pool):
+    config = _config(pool=pool)
+    params = init_params(config, jax.random.PRNGKey(0))
+    x, y = _images(64, config)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(20):
+        params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y))
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+    preds = np.asarray(forward(params, jnp.asarray(x), config)).argmax(1)
+    assert (preds == y).mean() > 0.5
+
+
+def test_vit_sharded_matches_unsharded():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    x, y = _images(8, config)
+    expected = np.asarray(forward(params, jnp.asarray(x), config))
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    sharded_params = shard_params(params, config, mesh)
+    xs = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P("data", None, None, None)))
+    got = np.asarray(jax.jit(
+        lambda p, im: forward(p, im, config))(sharded_params, xs))
+    np.testing.assert_allclose(expected, got, atol=2e-3)
+
+
+def test_vit_sharded_train_step_decreases_loss():
+    config = _config()
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    params = shard_params(init_params(config, jax.random.PRNGKey(0)),
+                          config, mesh)
+    x, y = _images(32, config)
+    tx = optax.adam(1e-3)
+    opt = jax.jit(tx.init)(params)
+    xs = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P("data", None, None, None)))
+    ys = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P("data")))
+    step = make_train_step(config, tx, mesh=mesh)
+    params, opt, l1 = step(params, opt, xs, ys)
+    params, opt, l2 = step(params, opt, xs, ys)
+    assert np.isfinite(float(l2)) and float(l2) < float(l1)
+
+
+def test_vit_config_validation_and_gqa():
+    with pytest.raises(ValueError):
+        _config(patch_size=5)
+    with pytest.raises(ValueError):
+        _config(pool="max")
+    with pytest.raises(ValueError):
+        _config(num_kv_heads=3)
+    config = _config(num_kv_heads=2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    assert params["layer_0"]["attn"]["wk"].shape == (32, 2, 8)
+    x, _ = _images(4, config)
+    assert forward(params, jnp.asarray(x), config).shape == (4, 10)
+    # specs structure matches params
+    jax.tree_util.tree_map(lambda p, s: None, params, param_specs(config))
