@@ -25,7 +25,8 @@ PAGES = [
       "Embedding", "LSTM", "GRU", "LayerNormalization",
       "BatchNormalization", "Add", "Multiply", "Concatenate", "Input"]),
     ("Optimizers", "elephas_tpu.models.optimizers",
-     ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "Adadelta", "Nadam"]),
+     ["SGD", "Adam", "AdamW", "RMSprop", "Adagrad", "Adadelta", "Nadam",
+      "Adafactor", "Lion", "LAMB"]),
     ("LR schedules", "elephas_tpu.models.schedules",
      ["ExponentialDecay", "CosineDecay", "PiecewiseConstantDecay",
       "WarmupCosine"]),

@@ -10,8 +10,8 @@ from .layers import (GRU, LSTM, Activation, Add, AveragePooling2D,
                      InputLayer, KTensor, Layer, LayerNormalization,
                      MaxPooling2D, Multiply, Reshape, register_layer,
                      reset_layer_uids)
-from .optimizers import (SGD, Adadelta, Adagrad, Adam, AdamW, Nadam,
-                         Optimizer, RMSprop)
+from .optimizers import (LAMB, SGD, Adadelta, Adafactor, Adagrad, Adam,
+                         AdamW, Lion, Nadam, Optimizer, RMSprop)
 from .optimizers import deserialize as deserialize_optimizer
 from .optimizers import get as get_optimizer
 from .optimizers import serialize as serialize_optimizer

@@ -166,6 +166,83 @@ class Nadam(Adam):
                            eps=self.epsilon)
 
 
+class Adafactor(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — the TPU-era memory-efficient
+    optimizer: second moments stored as factored row/column statistics,
+    so optimizer memory is O(rows + cols) per matrix instead of O(rows *
+    cols). The standard choice for training large transformers when Adam
+    moments don't fit HBM (T5, PaLM lineage)."""
+
+    def __init__(self, learning_rate=None, min_dim_size_to_factor: int = 128,
+                 weight_decay_rate: float = 0.0, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        # None keeps optax's relative step-size schedule (the paper's)
+        super().__init__(learning_rate if learning_rate is not None else 0.0)
+        self._use_default_lr = learning_rate is None
+        self.min_dim_size_to_factor = int(min_dim_size_to_factor)
+        self.weight_decay_rate = float(weight_decay_rate)
+
+    def to_optax(self):
+        return optax.adafactor(
+            learning_rate=None if self._use_default_lr else self._lr(),
+            min_dim_size_to_factor=self.min_dim_size_to_factor,
+            weight_decay_rate=self.weight_decay_rate or None)
+
+    def get_config(self):
+        return {"learning_rate": (None if self._use_default_lr
+                                  else self._lr_config()),
+                "min_dim_size_to_factor": self.min_dim_size_to_factor,
+                "weight_decay_rate": self.weight_decay_rate}
+
+
+class Lion(Optimizer):
+    """Lion (Chen et al. 2023): sign-of-momentum updates — one moment
+    buffer (half Adam's optimizer memory) and bf16-friendly updates."""
+
+    def __init__(self, learning_rate: float = 1e-4, beta_1: float = 0.9,
+                 beta_2: float = 0.99, weight_decay: float = 0.0, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.beta_1, self.beta_2 = float(beta_1), float(beta_2)
+        self.weight_decay = float(weight_decay)
+
+    def to_optax(self):
+        return optax.lion(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          weight_decay=self.weight_decay)
+
+    def get_config(self):
+        return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
+                "beta_2": self.beta_2, "weight_decay": self.weight_decay}
+
+
+class LAMB(Optimizer):
+    """LAMB (You et al. 2020): layer-wise adaptive rates for very large
+    batch training — the optimizer behind 76-minute BERT on TPU pods;
+    pairs with the data-parallel scaling path (large global batch over
+    the ``data`` axis)."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.0, **kwargs):
+        if "lr" in kwargs:
+            learning_rate = kwargs.pop("lr")
+        super().__init__(learning_rate)
+        self.beta_1, self.beta_2 = float(beta_1), float(beta_2)
+        self.epsilon = float(epsilon)
+        self.weight_decay = float(weight_decay)
+
+    def to_optax(self):
+        return optax.lamb(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          eps=self.epsilon, weight_decay=self.weight_decay)
+
+    def get_config(self):
+        return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
+                "beta_2": self.beta_2, "epsilon": self.epsilon,
+                "weight_decay": self.weight_decay}
+
+
 _OPTIMIZERS = {
     "SGD": SGD, "sgd": SGD,
     "Adam": Adam, "adam": Adam,
@@ -174,6 +251,9 @@ _OPTIMIZERS = {
     "Adagrad": Adagrad, "adagrad": Adagrad,
     "Adadelta": Adadelta, "adadelta": Adadelta,
     "Nadam": Nadam, "nadam": Nadam,
+    "Adafactor": Adafactor, "adafactor": Adafactor,
+    "Lion": Lion, "lion": Lion,
+    "LAMB": LAMB, "lamb": LAMB,
 }
 
 
