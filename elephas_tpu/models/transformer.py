@@ -84,6 +84,15 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: chunked-vocab LM loss: when set, the training loss streams the
+    #: logsumexp over vocab chunks of this size inside a rematerialized
+    #: ``lax.scan`` instead of materializing the full ``(batch, seq,
+    #: vocab)`` f32 logits (1 GB at vocab 32k, batch 8, seq 1024) — the
+    #: standard large-vocab HBM trade. Applies when the embedding is not
+    #: vocab-sharded (single device / pure dp); tensor-parallel meshes
+    #: already spread the logits over the model axis and keep the dense
+    #: path. Inference/generate paths are unaffected.
+    loss_vocab_chunk: Optional[int] = None
     #: grouped-query attention: number of key/value heads. ``None`` means
     #: ``num_heads`` (standard multi-head); ``1`` is multi-query (MQA).
     #: Each group of ``num_heads / num_kv_heads`` query heads shares one
@@ -383,6 +392,48 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(picked)
 
 
+def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
+                              tokens: jnp.ndarray, chunk: int
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Streamed LM loss pieces from the final hidden states: returns
+    ``(cross_entropy, lse)`` where ``lse[b, t] = logsumexp_v(logits)``
+    (so the z-loss comes free), WITHOUT materializing ``(B, T, V)``
+    logits. The vocab axis is processed in ``chunk``-sized slices inside
+    a rematerialized scan — each chunk's logits live only transiently in
+    both passes, bounding peak HBM at ``(B, T, chunk)``.
+    """
+    h = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
+                    final_ln["beta"])[:, :-1]                # (B, T', D)
+    targets = tokens[:, 1:]                                  # (B, T')
+    emb = embed["tokens"].astype(jnp.float32)                # (V, D)
+    v, d = emb.shape
+    nc = -(-v // chunk)
+    pad = nc * chunk - v
+    emb_p = jnp.pad(emb, ((0, pad), (0, 0)))
+    # padded rows must not contribute to the logsumexp
+    valid = (jnp.arange(nc * chunk) < v).reshape(nc, chunk)
+    emb_c = emb_p.reshape(nc, chunk, d)
+
+    @jax.checkpoint
+    def body(carry, ec):
+        m, s = carry
+        e_chunk, mask = ec
+        logits_c = jnp.einsum("btd,cd->btc", h, e_chunk)
+        logits_c = jnp.where(mask, logits_c, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits_c, axis=-1))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits_c - m_new[..., None]), axis=-1))
+        return (m_new, s), None
+
+    m0 = jnp.full(h.shape[:2], NEG_INF, jnp.float32)
+    s0 = jnp.zeros(h.shape[:2], jnp.float32)
+    (m, s), _ = jax.lax.scan(body, (m0, s0), (emb_c, valid))
+    lse = m + jnp.log(s)                                     # (B, T')
+    # target logit via a row gather — (B, T', D) transient, not (B,T',V)
+    picked = jnp.sum(h * emb[targets], axis=-1)
+    return jnp.mean(lse - picked), lse
+
+
 def select_moe_dispatch(config: "TransformerConfig",
                         mesh: Optional[Mesh] = None,
                         model_axis: Optional[str] = None) -> str:
@@ -619,6 +670,21 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                                                                 jnp.ndarray]:
     """Like :func:`forward` but also returns the summed MoE auxiliary
     (load-balancing) loss — 0.0 for dense configs."""
+    x, aux_total = _hidden_with_aux(params, tokens, config, mesh=mesh,
+                                    seq_axis=seq_axis, batch_axis=batch_axis,
+                                    model_axis=model_axis)
+    return head_logits(params["embed"], params["final_ln"], x), aux_total
+
+
+def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
+                     config: TransformerConfig,
+                     mesh: Optional[Mesh] = None,
+                     seq_axis: Optional[str] = None,
+                     batch_axis: Optional[str] = None,
+                     model_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
+                                                                jnp.ndarray]:
+    """The block stack up to (but excluding) the LM head: final hidden
+    states ``(B, T, D)`` + summed MoE aux loss."""
     c = config
     x = embed_apply(params["embed"], tokens, c)
     aux_total = jnp.zeros((), jnp.float32)
@@ -674,7 +740,7 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
         x, aux = layer_apply(params[f"layer_{i}"], x)
         aux_total = aux_total + aux
 
-    return head_logits(params["embed"], params["final_ln"], x), aux_total
+    return x, aux_total
 
 
 def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
@@ -683,6 +749,21 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             model_axis: Optional[str] = None) -> jnp.ndarray:
     """Next-token cross-entropy (mean over all positions), plus the
     weighted MoE load-balancing auxiliary loss for MoE configs."""
+    # the chunked (streamed-logsumexp) loss applies when the embedding is
+    # not vocab-sharded: a tp mesh already spreads the logits over the
+    # model axis, and chunk-slicing a sharded vocab would fight GSPMD
+    chunk = config.loss_vocab_chunk
+    if chunk and (mesh is None or model_axis is None):
+        x, aux = _hidden_with_aux(params, tokens, config, mesh=mesh,
+                                  seq_axis=seq_axis, batch_axis=batch_axis,
+                                  model_axis=model_axis)
+        loss, lse = chunked_next_token_losses(
+            x, params["embed"], params["final_ln"], tokens, int(chunk))
+        if config.num_experts > 1 and config.moe_aux_weight:
+            loss = loss + config.moe_aux_weight * aux
+        if config.z_loss_weight:
+            loss = loss + config.z_loss_weight * jnp.mean(lse * lse)
+        return loss
     logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
                                    seq_axis=seq_axis, batch_axis=batch_axis,
                                    model_axis=model_axis)
