@@ -1,0 +1,40 @@
+"""FSDP (ZeRO-3) transformer LM training via the TransformerModel API.
+
+Every large parameter, gradient, and Adam moment lives 1/dp-sharded over
+the data axis; GSPMD inserts the all-gathers and reduce-scatters. With
+GQA (2 kv-head groups) and the chunked-vocab loss, this is the
+memory-lean large-model configuration.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from elephas_tpu.models import Adam, TransformerModel
+from elephas_tpu.models.transformer import TransformerConfig
+from elephas_tpu.tpu_model import TPUModel
+
+config = TransformerConfig(vocab_size=512, num_layers=4, num_heads=8,
+                           num_kv_heads=2, d_model=256, d_ff=512,
+                           max_seq_len=128, positional="rope",
+                           loss_vocab_chunk=128)
+
+model = TransformerModel(config, tensor_parallel=1, fsdp=True)
+model.compile(Adam(learning_rate=1e-3), seed=0)
+
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, config.vocab_size, size=(2048, 64)).astype("int32")
+
+tpu_model = TPUModel(model, mode="synchronous")
+tpu_model.fit(tokens, epochs=3, batch_size=64, verbose=1,
+              validation_split=0.0)
+
+emb = model.params["embed"]["tokens"]
+print("devices:", len(jax.devices()),
+      "| embedding shard:", emb.addressable_shards[0].data.shape,
+      "of", emb.shape)
+print("loss history:", [round(v, 4)
+                        for v in tpu_model.training_histories[-1]["loss"]])
