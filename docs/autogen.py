@@ -39,7 +39,8 @@ PAGES = [
      ["SyncAverageTrainer", "SyncStepTrainer", "build_sharded_predict",
       "build_sharded_evaluate"]),
     ("Mesh utilities", "elephas_tpu.parallel.mesh",
-     ["worker_mesh", "data_mesh", "make_mesh", "shard_leading", "replicate"]),
+     ["worker_mesh", "data_mesh", "make_mesh", "hybrid_mesh",
+      "shard_leading", "replicate"]),
     ("Multi-host", "elephas_tpu.parallel.multihost",
      ["initialize_multihost", "is_coordinator", "host_local_slice",
       "global_batch_from_host_data"]),

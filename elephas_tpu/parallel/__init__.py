@@ -1,5 +1,5 @@
-from .mesh import (data_mesh, make_mesh, replicate, shard_leading,
-                   spans_processes, worker_mesh)
+from .mesh import (data_mesh, hybrid_mesh, make_mesh, replicate,
+                   shard_leading, spans_processes, worker_mesh)
 from .multihost import (barrier, coordinator_bind_env, ensure_multihost,
                         global_batch_from_host_data, global_data_mesh,
                         host_local_slice, initialize_multihost,

@@ -61,6 +61,49 @@ def make_mesh(axis_sizes: Tuple[Tuple[str, int], ...],
     return Mesh(grid, tuple(names))
 
 
+def hybrid_mesh(axis_sizes: Tuple[Tuple[str, int], ...],
+                dcn_axis: str = "data",
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Multi-host-aware mesh: the ``dcn_axis`` spans processes (hosts /
+    pod slices, traffic over DCN), every other axis stays inside a
+    process (traffic over ICI) — the standard pod-scale layout where
+    gradient all-reduce crosses hosts but tensor/sequence/expert
+    collectives ride the fast intra-slice interconnect.
+
+    ``axis_sizes`` gives TOTAL sizes, e.g. ``(("data", 8), ("model", 4))``
+    on 4 hosts x 8 chips puts dp=2 per host x 4 hosts over DCN and tp=4
+    over ICI. Falls back to a plain :func:`make_mesh` in single-process
+    runs (tests, single host), so code can use it unconditionally.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_proc = len({d.process_index for d in devices})
+    names = [name for name, _ in axis_sizes]
+    sizes = {name: int(size) for name, size in axis_sizes}
+    if dcn_axis not in sizes:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in {names}")
+    if n_proc == 1:
+        return make_mesh(axis_sizes, devices)
+    if sizes[dcn_axis] % n_proc:
+        raise ValueError(
+            f"{dcn_axis}={sizes[dcn_axis]} must divide by the "
+            f"{n_proc} processes it spans over DCN")
+    from jax.experimental import mesh_utils
+
+    ici_shape = [sizes[n] // n_proc if n == dcn_axis else sizes[n]
+                 for n in names]
+    dcn_shape = [n_proc if n == dcn_axis else 1 for n in names]
+    try:
+        # TPU pods: granule = slice (slice_index attr), DCN between slices
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    except ValueError:
+        # no slice_index info (CPU multi-process, single-slice pods):
+        # granule by process instead
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices, process_is_granule=True)
+    return Mesh(grid, tuple(names))
+
+
 def spans_processes(mesh: Mesh) -> bool:
     """True when the mesh includes devices of other processes (multi-host
     DCN execution) — placement must then go through global-array assembly

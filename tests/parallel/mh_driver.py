@@ -30,6 +30,32 @@ def main():
 
     import numpy as np
 
+    if mode == "hybrid_mesh":
+        # hybrid DCN x ICI mesh: the data axis spans the two processes
+        # (gradient-style psum over DCN), the model axis stays local
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.parallel.mesh import hybrid_mesh, shard_leading
+
+        mesh = hybrid_mesh((("data", 2 * nprocs), ("model", 1)))
+        assert mesh.shape == {"data": 2 * nprocs, "model": 1}, mesh.shape
+        # each data-axis row is one device; consecutive pairs must belong
+        # to one process (ici inside, dcn across)
+        procs = [d.process_index for d in mesh.devices[:, 0]]
+        assert procs == sorted(procs), procs
+        assert len(set(procs)) == nprocs, procs
+        x = np.arange(4 * nprocs, dtype=np.float32).reshape(2 * nprocs, 2)
+        xd = shard_leading(mesh, "data", x)
+        total = jax.jit(
+            lambda a: jnp.sum(a),
+            out_shardings=NamedSharding(mesh, P()))(xd)
+        np.testing.assert_allclose(np.asarray(total), x.sum())
+        np.savez(os.path.join(outdir, f"weights_{pid}.npz"),
+                 ok=np.asarray([1.0]))
+        print(f"proc {pid}: OK", flush=True)
+        return
+
     from elephas_tpu.models import SGD, Dense, Sequential
     from elephas_tpu.tpu_model import TPUModel
 

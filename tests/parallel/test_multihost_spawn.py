@@ -88,3 +88,23 @@ def test_two_process_async_parameter_server(tmp_path):
         assert np.all(np.isfinite(a))
     # training must actually have moved the weights off their init
     assert any(np.abs(a).sum() > 0 for a in w0)
+
+
+def test_two_process_hybrid_mesh(tmp_path):
+    """hybrid_mesh lays the data axis across processes (DCN) with local
+    devices contiguous (ICI), and a cross-process reduction executes."""
+    jax_port, ps_port = _ports()
+    _run_procs("hybrid_mesh", "step", 2, tmp_path, jax_port, ps_port)
+    for pid in (0, 1):
+        with np.load(os.path.join(str(tmp_path),
+                                  f"weights_{pid}.npz")) as z:
+            assert z["ok"][0] == 1.0
+
+
+def test_hybrid_mesh_single_process_fallback():
+    from elephas_tpu.parallel.mesh import hybrid_mesh
+
+    mesh = hybrid_mesh((("data", 4), ("model", 2)))
+    assert mesh.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        hybrid_mesh((("model", 2),), dcn_axis="data")
