@@ -67,6 +67,9 @@ PAGES = [
       "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
      ["TransformerModel"]),
+    ("BERT encoder (MLM)", "elephas_tpu.models.bert",
+     ["BertConfig", "init_params", "param_specs", "encode", "pool",
+      "mask_tokens", "mlm_loss", "make_mlm_train_step", "shard_params"]),
     ("Vision Transformer", "elephas_tpu.models.vit",
      ["ViTConfig", "init_params", "param_specs", "forward", "vit_loss",
       "make_train_step", "shard_params"]),

@@ -1,0 +1,286 @@
+"""BERT-style bidirectional encoder with masked-language-model training.
+
+Third transformer family next to the causal LM (``transformer.py``) and
+ViT (``vit.py``), reusing the same block sublayers and Megatron
+tensor-parallel specs. Bidirectional attention with a padding mask,
+token+position+segment embeddings, an MLM head tied to the embedding
+matrix, and a [CLS] pooler for fine-tuning — trained with the standard
+80/10/10 dynamic masking recipe (:func:`mask_tokens`).
+
+TPU notes: the MLM loss only gathers the masked positions' hidden states
+before the vocab projection (a ``(num_masked, D) @ (D, V)`` matmul
+instead of ``(B*T, D) @ (D, V)`` — ~6x fewer head FLOPs at the usual 15%
+mask rate), with a static masked-position budget so shapes stay
+compile-friendly.
+"""
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import attention
+from .transformer import _attn_apply, _layer_norm, _mesh_divides, _mlp_apply
+
+__all__ = ["BertConfig", "init_params", "param_specs", "encode", "pool",
+           "mlm_loss", "mask_tokens", "make_mlm_train_step", "shard_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    #: id of the [MASK] token used by :func:`mask_tokens`
+    mask_token_id: int = 103
+    #: id of the padding token (excluded from attention and masking)
+    pad_token_id: int = 0
+    #: static budget of masked positions per row in the MLM loss: the
+    #: gather keeps shapes fixed for XLA (ceil(mask_rate * seq) rounded
+    #: up; rows with fewer masks pad with weight-0 entries)
+    max_predictions: int = 80
+    remat: bool = False
+    num_kv_heads: Optional[int] = None
+
+    def __post_init__(self):
+        if self.d_model % self.num_heads:
+            raise ValueError("num_heads must divide d_model")
+        if self.num_kv_heads is not None and (
+                self.num_kv_heads < 1
+                or self.num_heads % self.num_kv_heads):
+            raise ValueError("num_kv_heads must divide num_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
+
+    # read by the shared _attn_apply: BERT position is an additive table
+    @property
+    def positional(self) -> str:
+        return "learned"
+
+
+def init_params(config: BertConfig, key) -> Dict:
+    c = config
+    keys = jax.random.split(key, 6 + c.num_layers)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, c.param_dtype)
+                / math.sqrt(fan_in))
+
+    params: Dict[str, Any] = {
+        "embed": {
+            "tokens": 0.02 * jax.random.normal(
+                keys[0], (c.vocab_size, c.d_model), c.param_dtype),
+            "pos": 0.02 * jax.random.normal(
+                keys[1], (c.max_seq_len, c.d_model), c.param_dtype),
+            "seg": 0.02 * jax.random.normal(
+                keys[2], (c.type_vocab_size, c.d_model), c.param_dtype),
+            "ln": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                   "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+        },
+        "pooler": {"kernel": dense(keys[3], (c.d_model, c.d_model),
+                                   c.d_model),
+                   "bias": jnp.zeros((c.d_model,), c.param_dtype)},
+        "mlm": {  # transform + tied-embedding output bias (BERT head)
+            "kernel": dense(keys[4], (c.d_model, c.d_model), c.d_model),
+            "bias": jnp.zeros((c.d_model,), c.param_dtype),
+            "ln": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                   "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+            "out_bias": jnp.zeros((c.vocab_size,), c.param_dtype),
+        },
+    }
+    for i in range(c.num_layers):
+        lk = jax.random.split(keys[6 + i], 6)
+        params[f"layer_{i}"] = {
+            "ln1": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                    "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+            "attn": {
+                "wq": dense(lk[0], (c.d_model, c.num_heads, c.head_dim),
+                            c.d_model),
+                "wk": dense(lk[1], (c.d_model, c.kv_heads, c.head_dim),
+                            c.d_model),
+                "wv": dense(lk[2], (c.d_model, c.kv_heads, c.head_dim),
+                            c.d_model),
+                "wo": dense(lk[3], (c.num_heads, c.head_dim, c.d_model),
+                            c.d_model),
+            },
+            "ln2": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+                    "beta": jnp.zeros((c.d_model,), c.param_dtype)},
+            "mlp": {"w1": dense(lk[4], (c.d_model, c.d_ff), c.d_model),
+                    "b1": jnp.zeros((c.d_ff,), c.param_dtype),
+                    "w2": dense(lk[5], (c.d_ff, c.d_model), c.d_ff),
+                    "b2": jnp.zeros((c.d_model,), c.param_dtype)},
+        }
+    return params
+
+
+def param_specs(config: BertConfig, model_axis: str = "model",
+                mesh: Optional[Mesh] = None) -> Dict:
+    """Megatron tensor-parallel specs mirroring :func:`init_params`."""
+    kv_shardable = (mesh is None
+                    or _mesh_divides(mesh, model_axis, config.kv_heads))
+    kv_spec = (P(None, model_axis, None) if kv_shardable
+               else P(None, None, None))
+    specs: Dict[str, Any] = {
+        "embed": {"tokens": P(model_axis, None), "pos": P(None, None),
+                  "seg": P(None, None),
+                  "ln": {"gamma": P(None), "beta": P(None)}},
+        "pooler": {"kernel": P(None, None), "bias": P(None)},
+        "mlm": {"kernel": P(None, None), "bias": P(None),
+                "ln": {"gamma": P(None), "beta": P(None)},
+                "out_bias": P(model_axis)},
+    }
+    for i in range(config.num_layers):
+        specs[f"layer_{i}"] = {
+            "ln1": {"gamma": P(None), "beta": P(None)},
+            "attn": {"wq": P(None, model_axis, None),
+                     "wk": kv_spec, "wv": kv_spec,
+                     "wo": P(model_axis, None, None)},
+            "ln2": {"gamma": P(None), "beta": P(None)},
+            "mlp": {"w1": P(None, model_axis), "b1": P(model_axis),
+                    "w2": P(model_axis, None), "b2": P(None)},
+        }
+    return specs
+
+
+def encode(params: Dict, tokens: jnp.ndarray,
+           segment_ids: Optional[jnp.ndarray] = None,
+           config: BertConfig = None) -> jnp.ndarray:
+    """Token ids ``(B, T)`` -> contextual hidden states ``(B, T, D)``.
+    Padding positions (``pad_token_id``) are excluded from every
+    attention's key set."""
+    c = config
+    e = params["embed"]
+    x = e["tokens"][tokens] + e["pos"][:tokens.shape[1]]
+    if segment_ids is None:
+        segment_ids = jnp.zeros_like(tokens)
+    x = x + e["seg"][segment_ids]
+    x = _layer_norm(x, e["ln"]["gamma"], e["ln"]["beta"]).astype(c.dtype)
+
+    pad_mask = (tokens != c.pad_token_id)[:, None, None, :]  # (B,1,1,T)
+
+    def attn_fn(q, k, v):
+        return attention(q, k, v, causal=False, mask=pad_mask)
+
+    def layer_apply(layer, x):
+        x = _attn_apply(layer, x, c, attn_fn)
+        return _mlp_apply(layer, x, c)
+
+    if c.remat:
+        layer_apply = jax.checkpoint(layer_apply)
+    for i in range(c.num_layers):
+        x = layer_apply(params[f"layer_{i}"], x)
+    return x
+
+
+def pool(params: Dict, hidden: jnp.ndarray,
+         config: BertConfig) -> jnp.ndarray:
+    """[CLS] pooler: tanh projection of position 0 — the fine-tuning
+    feature vector."""
+    h = hidden[:, 0].astype(jnp.float32)
+    return jnp.tanh(h @ params["pooler"]["kernel"].astype(jnp.float32)
+                    + params["pooler"]["bias"].astype(jnp.float32))
+
+
+def mask_tokens(tokens: jnp.ndarray, key, config: BertConfig,
+                mask_rate: float = 0.15
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """BERT dynamic masking: returns ``(masked_tokens, positions,
+    weights)`` with the 80/10/10 [MASK]/random/keep recipe over a static
+    ``max_predictions`` budget per row (weight 0 pads the budget)."""
+    c = config
+    b, t = tokens.shape
+    k_sel, k_op, k_rand = jax.random.split(key, 3)
+    scores = jax.random.uniform(k_sel, (b, t))
+    scores = jnp.where(tokens != c.pad_token_id, scores, 2.0)
+    # lowest-scoring ~mask_rate fraction of real tokens get masked
+    threshold = mask_rate
+    # static budget: take the max_predictions smallest scores per row
+    n_pred = min(c.max_predictions, t)
+    neg = -scores
+    _, positions = jax.lax.top_k(neg, n_pred)                 # (B, n_pred)
+    picked_score = jnp.take_along_axis(scores, positions, axis=1)
+    weights = (picked_score < threshold).astype(jnp.float32)  # budget pad
+    op = jax.random.uniform(k_op, (b, n_pred))
+    rand_tok = jax.random.randint(k_rand, (b, n_pred), 0, c.vocab_size)
+    orig = jnp.take_along_axis(tokens, positions, axis=1)
+    replacement = jnp.where(op < 0.8, c.mask_token_id,
+                            jnp.where(op < 0.9, rand_tok, orig))
+    masked = tokens
+    # scatter replacements at the chosen positions (weight-0 entries
+    # scatter their original token back: a no-op)
+    replacement = jnp.where(weights > 0, replacement, orig)
+    masked = jax.vmap(lambda row, pos, rep: row.at[pos].set(rep))(
+        masked, positions, replacement)
+    return masked, positions, weights
+
+
+def mlm_loss(params: Dict, masked_tokens: jnp.ndarray,
+             positions: jnp.ndarray, labels: jnp.ndarray,
+             weights: jnp.ndarray, config: BertConfig,
+             segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Masked-LM cross-entropy over the selected ``positions`` (labels =
+    original tokens at those positions; ``weights`` zero out budget
+    padding). Only the masked positions' hidden states reach the vocab
+    projection."""
+    c = config
+    hidden = encode(params, masked_tokens, segment_ids, c)    # (B, T, D)
+    picked = jnp.take_along_axis(
+        hidden, positions[..., None].astype(jnp.int32), axis=1)  # (B,P,D)
+    h = picked.astype(jnp.float32)
+    h = h @ params["mlm"]["kernel"].astype(jnp.float32) \
+        + params["mlm"]["bias"].astype(jnp.float32)
+    h = jax.nn.gelu(h)
+    h = _layer_norm(h, params["mlm"]["ln"]["gamma"],
+                    params["mlm"]["ln"]["beta"])
+    logits = (h @ params["embed"]["tokens"].T.astype(jnp.float32)
+              + params["mlm"]["out_bias"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    total = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(ce * weights) / total
+
+
+def shard_params(params: Dict, config: BertConfig, mesh: Mesh,
+                 model_axis: str = "model") -> Dict:
+    specs = param_specs(config, model_axis=model_axis, mesh=mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+def make_mlm_train_step(config: BertConfig, tx,
+                        mesh: Optional[Mesh] = None,
+                        mask_rate: float = 0.15):
+    """Jitted ``(params, opt_state, tokens, key) -> (params, opt_state,
+    loss)``: dynamic masking + encoder + MLM loss + optax update in one
+    compiled program (fresh masks each step, per the RoBERTa finding)."""
+
+    def step(params, opt_state, tokens, key):
+        masked, positions, weights = mask_tokens(tokens, key, config,
+                                                 mask_rate)
+        labels = jax.vmap(jnp.take)(tokens, positions)
+
+        def loss_fn(p):
+            return mlm_loss(p, masked, positions, labels, weights, config)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
