@@ -40,7 +40,8 @@ def stack_stage_params(per_stage_params):
 
 
 def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, axis: str = "pipe",
-                     num_microbatches: int = None):
+                     num_microbatches: int = None,
+                     batch_axis: Optional[str] = None):
     """Build ``fn(stacked_params, x) -> y`` running ``stage_fn`` as a
     microbatched pipeline over ``mesh[axis]``.
 
@@ -49,6 +50,12 @@ def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, axis: str = "pipe",
         shape, as in a stack of transformer blocks).
     :param num_microbatches: number of microbatches M (default: pipeline
         depth). The batch dimension must divide by M.
+    :param batch_axis: optional data-parallel mesh axis: each dp row of
+        the mesh pipelines its own batch shard through the same stage
+        stack (dp x pp composition — stage params are sharded over
+        ``axis`` and replicated over ``batch_axis``; the gradient
+        all-reduce over ``batch_axis`` is inserted by GSPMD where the
+        loss averages over the global batch).
     """
     num_stages = mesh.shape[axis]
     M = num_microbatches or num_stages
@@ -96,8 +103,11 @@ def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, axis: str = "pipe",
             return jax.lax.psum(outs, axis)
 
         in_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+        # micro is (M, B, ...): with a dp axis the per-microbatch batch
+        # dim shards over it, so each dp row pipelines its own shard
+        x_spec = P(None, batch_axis) if batch_axis is not None else P()
         y = jax.shard_map(per_device, mesh=mesh,
-                          in_specs=(in_spec, P()), out_specs=P(),
+                          in_specs=(in_spec, x_spec), out_specs=x_spec,
                           check_vma=False)(stacked_params, micro)
         return y.reshape(x.shape[0:1] + y.shape[2:])
 
@@ -175,7 +185,8 @@ def shard_pipelined_params(pipe_params: Dict, mesh: Mesh,
 
 
 def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
-                           num_microbatches: Optional[int] = None):
+                           num_microbatches: Optional[int] = None,
+                           batch_axis: Optional[str] = None):
     """Build ``loss(pipe_params, tokens)`` — next-token cross-entropy of
     the transformer LM with its blocks running as a GPipe pipeline.
 
@@ -213,7 +224,8 @@ def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
         return x
 
     pipe_fn = make_pipeline_fn(stage_fn, mesh, axis=axis,
-                               num_microbatches=num_microbatches)
+                               num_microbatches=num_microbatches,
+                               batch_axis=batch_axis)
 
     def loss(pipe_params, tokens):
         x = embed_apply(pipe_params["embed"], tokens, config)
@@ -225,13 +237,18 @@ def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
 
 
 def make_pipelined_train_step(config, tx, mesh: Mesh, axis: str = "pipe",
-                              num_microbatches: Optional[int] = None):
+                              num_microbatches: Optional[int] = None,
+                              batch_axis: Optional[str] = None):
     """Jitted ``(pipe_params, opt_state, tokens) -> (pipe_params,
     opt_state, loss)``: forward + backward through the pipeline (gradient
     accumulation over microbatches via the scan transpose) and an optax
-    update over the stage-stacked pytree, all in one compiled program."""
+    update over the stage-stacked pytree, all in one compiled program.
+    With ``batch_axis`` the step runs dp x pp: tokens shard over the
+    data axis, each dp row pipelines its shard, and the loss mean makes
+    GSPMD all-reduce the gradients across rows."""
     loss_fn = make_pipelined_lm_loss(config, mesh, axis=axis,
-                                     num_microbatches=num_microbatches)
+                                     num_microbatches=num_microbatches,
+                                     batch_axis=batch_axis)
 
     def step(pipe_params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(pipe_params, tokens)
