@@ -82,6 +82,7 @@ PAGES = [
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Native acceleration", "elephas_tpu.utils.native",
      ["build", "available", "NativeBatchLoader", "batch_iterator"]),
+    ("Text utilities", "elephas_tpu.utils.text", ["ByteTokenizer"]),
     ("Tracing", "elephas_tpu.utils.tracing",
      ["StepTimer", "profiler_trace", "annotate"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
