@@ -71,3 +71,49 @@ def load_model(filepath: str, custom_objects: Optional[Dict] = None) -> BaseMode
                           loss=cfg["loss"], metrics=cfg.get("metrics", []),
                           custom_objects=custom_objects, **compile_kwargs)
     return model
+
+
+# ------------------------------------------------ functional-family configs
+#: registry of the functional model families' config dataclasses, so a
+#: checkpoint manifest can name its config class and round-trip it
+_CONFIG_CLASSES = {}
+
+
+def _config_registry():
+    if not _CONFIG_CLASSES:
+        from .bert import BertConfig
+        from .transformer import TransformerConfig
+        from .vit import ViTConfig
+
+        _CONFIG_CLASSES.update({"TransformerConfig": TransformerConfig,
+                                "ViTConfig": ViTConfig,
+                                "BertConfig": BertConfig})
+    return _CONFIG_CLASSES
+
+
+def config_to_dict(config) -> Dict:
+    """Serialize a TransformerConfig / ViTConfig / BertConfig to a plain
+    JSON-able dict (dtypes by numpy name, class recorded) — the manifest
+    format for functional-family checkpoints."""
+    import dataclasses
+
+    import numpy as np
+
+    out = dataclasses.asdict(config)
+    for f in ("dtype", "param_dtype"):
+        if f in out:
+            out[f] = np.dtype(out[f]).name
+    out["__class__"] = type(config).__name__
+    return out
+
+
+def config_from_dict(d: Dict):
+    """Inverse of :func:`config_to_dict`."""
+    import jax.numpy as jnp
+
+    d = dict(d)
+    cls = _config_registry()[d.pop("__class__")]
+    for f in ("dtype", "param_dtype"):
+        if isinstance(d.get(f), str):
+            d[f] = getattr(jnp, d[f])
+    return cls(**d)

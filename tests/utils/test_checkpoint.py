@@ -1,3 +1,4 @@
+import json
 import numpy as np
 
 from elephas_tpu.utils.checkpoint import CheckpointManager
@@ -121,3 +122,47 @@ def test_abstract_params_matches_init_shapes():
     jax.tree_util.tree_map(
         lambda s, p: (s.shape, s.dtype) == (p.shape, p.dtype) or
         (_ for _ in ()).throw(AssertionError((s, p.shape))), shapes, real)
+
+
+def test_functional_config_manifest_roundtrip(tmp_path):
+    """ViT / BERT / Transformer configs round-trip through the checkpoint
+    manifest, so a functional-family training run resumes from directory
+    + manifest alone."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.bert import BertConfig
+    from elephas_tpu.models.saving import config_from_dict, config_to_dict
+    from elephas_tpu.models.transformer import TransformerConfig
+    from elephas_tpu.models.vit import ViTConfig, init_params
+
+    configs = [
+        TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=32,
+                          num_kv_heads=2, positional="rope",
+                          loss_vocab_chunk=16),
+        ViTConfig(image_size=16, patch_size=4, num_layers=1, num_heads=2,
+                  d_model=16, d_ff=32, pool="mean"),
+        BertConfig(vocab_size=64, num_layers=1, num_heads=2, d_model=16,
+                   d_ff=32, max_seq_len=16, max_predictions=4),
+    ]
+    for config in configs:
+        rt = config_from_dict(json.loads(json.dumps(
+            config_to_dict(config))))
+        assert rt == config, type(config).__name__
+
+    # end to end: save a ViT with its config in the manifest, restore
+    config = configs[1]
+    params = init_params(config, jax.random.PRNGKey(0))
+    manager = CheckpointManager(str(tmp_path / "vit"))
+    manager.save(1, {"params": params},
+                 distributed_config={"model_config": config_to_dict(config)})
+    fresh = CheckpointManager(str(tmp_path / "vit"))
+    manifest = fresh.manifest()
+    restored_config = config_from_dict(
+        manifest["distributed_config"]["model_config"])
+    assert restored_config == config
+    restored = fresh.restore()["params"]
+    for a, b in zip(jax.tree_util.tree_leaves(restored),
+                    jax.tree_util.tree_leaves(jax.device_get(params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
