@@ -32,8 +32,15 @@ class Optimizer:
     the per-step rate is computed on-device inside the jitted step.
     """
 
-    def __init__(self, learning_rate=0.01, **kwargs):
+    def __init__(self, learning_rate=0.01, clipnorm=None, clipvalue=None,
+                 **kwargs):
         self.learning_rate = _coerce_lr(learning_rate)
+        #: Keras-style gradient clipping, applied before the update rule:
+        #: ``clipnorm`` rescales by global norm, ``clipvalue`` clamps
+        #: elementwise. Available on every optimizer.
+        self.clipnorm = float(clipnorm) if clipnorm is not None else None
+        self.clipvalue = (float(clipvalue) if clipvalue is not None
+                          else None)
         self.kwargs = kwargs
 
     def _lr(self):
@@ -50,8 +57,27 @@ class Optimizer:
     def to_optax(self) -> optax.GradientTransformation:
         raise NotImplementedError
 
+    def _clipped(self, tx: optax.GradientTransformation):
+        """Chain the configured gradient clipping in front of ``tx`` —
+        every subclass wraps its transform with this."""
+        pre = []
+        if self.clipvalue is not None:
+            pre.append(optax.clip(self.clipvalue))
+        if self.clipnorm is not None:
+            pre.append(optax.clip_by_global_norm(self.clipnorm))
+        return optax.chain(*pre, tx) if pre else tx
+
+    def _clip_config(self) -> Dict:
+        config = {}
+        if self.clipnorm is not None:
+            config["clipnorm"] = self.clipnorm
+        if self.clipvalue is not None:
+            config["clipvalue"] = self.clipvalue
+        return config
+
     def get_config(self) -> Dict:
-        return {"learning_rate": self._lr_config(), **self.kwargs}
+        return {"learning_rate": self._lr_config(), **self._clip_config(),
+                **self.kwargs}
 
     @classmethod
     def from_config(cls, config: Dict) -> "Optimizer":
@@ -66,18 +92,18 @@ class SGD(Optimizer):
                  nesterov: bool = False, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
 
     def to_optax(self):
-        return optax.sgd(self._lr(),
+        return self._clipped(optax.sgd(self._lr(),
                          momentum=self.momentum if self.momentum else None,
-                         nesterov=self.nesterov)
+                         nesterov=self.nesterov))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "momentum": self.momentum,
-                "nesterov": self.nesterov}
+                "nesterov": self.nesterov, **self._clip_config()}
 
 
 class Adam(Optimizer):
@@ -85,16 +111,17 @@ class Adam(Optimizer):
                  beta_2: float = 0.999, epsilon: float = 1e-7, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.beta_1, self.beta_2, self.epsilon = float(beta_1), float(beta_2), float(epsilon)
 
     def to_optax(self):
-        return optax.adam(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          eps=self.epsilon)
+        return self._clipped(optax.adam(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          eps=self.epsilon))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
-                "beta_2": self.beta_2, "epsilon": self.epsilon}
+                "beta_2": self.beta_2, "epsilon": self.epsilon,
+                **self._clip_config()}
 
 
 class AdamW(Adam):
@@ -104,8 +131,8 @@ class AdamW(Adam):
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return optax.adamw(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                           eps=self.epsilon, weight_decay=self.weight_decay)
+        return self._clipped(optax.adamw(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                           eps=self.epsilon, weight_decay=self.weight_decay))
 
     def get_config(self):
         config = super().get_config()
@@ -118,30 +145,32 @@ class RMSprop(Optimizer):
                  momentum: float = 0.0, epsilon: float = 1e-7, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.rho, self.momentum, self.epsilon = float(rho), float(momentum), float(epsilon)
 
     def to_optax(self):
-        return optax.rmsprop(self._lr(), decay=self.rho, eps=self.epsilon,
-                             momentum=self.momentum if self.momentum else None)
+        return self._clipped(optax.rmsprop(self._lr(), decay=self.rho, eps=self.epsilon,
+                             momentum=self.momentum if self.momentum else None))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "rho": self.rho,
-                "momentum": self.momentum, "epsilon": self.epsilon}
+                "momentum": self.momentum, "epsilon": self.epsilon,
+                **self._clip_config()}
 
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate: float = 0.001, epsilon: float = 1e-7, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.epsilon = float(epsilon)
 
     def to_optax(self):
-        return optax.adagrad(self._lr(), eps=self.epsilon)
+        return self._clipped(optax.adagrad(self._lr(), eps=self.epsilon))
 
     def get_config(self):
-        return {"learning_rate": self._lr_config(), "epsilon": self.epsilon}
+        return {"learning_rate": self._lr_config(), "epsilon": self.epsilon,
+                **self._clip_config()}
 
 
 class Adadelta(Optimizer):
@@ -149,21 +178,21 @@ class Adadelta(Optimizer):
                  epsilon: float = 1e-7, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.rho, self.epsilon = float(rho), float(epsilon)
 
     def to_optax(self):
-        return optax.adadelta(self._lr(), rho=self.rho, eps=self.epsilon)
+        return self._clipped(optax.adadelta(self._lr(), rho=self.rho, eps=self.epsilon))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "rho": self.rho,
-                "epsilon": self.epsilon}
+                "epsilon": self.epsilon, **self._clip_config()}
 
 
 class Nadam(Adam):
     def to_optax(self):
-        return optax.nadam(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                           eps=self.epsilon)
+        return self._clipped(optax.nadam(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                           eps=self.epsilon))
 
 
 class Adafactor(Optimizer):
@@ -178,22 +207,24 @@ class Adafactor(Optimizer):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
         # None keeps optax's relative step-size schedule (the paper's)
-        super().__init__(learning_rate if learning_rate is not None else 0.0)
+        super().__init__(
+            learning_rate if learning_rate is not None else 0.0, **kwargs)
         self._use_default_lr = learning_rate is None
         self.min_dim_size_to_factor = int(min_dim_size_to_factor)
         self.weight_decay_rate = float(weight_decay_rate)
 
     def to_optax(self):
-        return optax.adafactor(
+        return self._clipped(optax.adafactor(
             learning_rate=None if self._use_default_lr else self._lr(),
             min_dim_size_to_factor=self.min_dim_size_to_factor,
-            weight_decay_rate=self.weight_decay_rate or None)
+            weight_decay_rate=self.weight_decay_rate or None))
 
     def get_config(self):
         return {"learning_rate": (None if self._use_default_lr
                                   else self._lr_config()),
                 "min_dim_size_to_factor": self.min_dim_size_to_factor,
-                "weight_decay_rate": self.weight_decay_rate}
+                "weight_decay_rate": self.weight_decay_rate,
+                **self._clip_config()}
 
 
 class Lion(Optimizer):
@@ -204,17 +235,18 @@ class Lion(Optimizer):
                  beta_2: float = 0.99, weight_decay: float = 0.0, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.beta_1, self.beta_2 = float(beta_1), float(beta_2)
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return optax.lion(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          weight_decay=self.weight_decay)
+        return self._clipped(optax.lion(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          weight_decay=self.weight_decay))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
-                "beta_2": self.beta_2, "weight_decay": self.weight_decay}
+                "beta_2": self.beta_2, "weight_decay": self.weight_decay,
+                **self._clip_config()}
 
 
 class LAMB(Optimizer):
@@ -228,19 +260,19 @@ class LAMB(Optimizer):
                  weight_decay: float = 0.0, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
-        super().__init__(learning_rate)
+        super().__init__(learning_rate, **kwargs)
         self.beta_1, self.beta_2 = float(beta_1), float(beta_2)
         self.epsilon = float(epsilon)
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return optax.lamb(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          eps=self.epsilon, weight_decay=self.weight_decay)
+        return self._clipped(optax.lamb(self._lr(), b1=self.beta_1, b2=self.beta_2,
+                          eps=self.epsilon, weight_decay=self.weight_decay))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
                 "beta_2": self.beta_2, "epsilon": self.epsilon,
-                "weight_decay": self.weight_decay}
+                "weight_decay": self.weight_decay, **self._clip_config()}
 
 
 _OPTIMIZERS = {
