@@ -67,6 +67,9 @@ PAGES = [
       "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
      ["TransformerModel"]),
+    ("LoRA fine-tuning", "elephas_tpu.models.lora",
+     ["init_lora_params", "merge_lora", "make_lora_train_step",
+      "lora_param_count"]),
     ("BERT encoder (MLM)", "elephas_tpu.models.bert",
      ["BertConfig", "init_params", "param_specs", "encode", "pool",
       "mask_tokens", "mlm_loss", "make_mlm_train_step", "shard_params"]),

@@ -1,0 +1,122 @@
+"""LoRA — low-rank adaptation fine-tuning for the transformer family.
+
+Fine-tunes a frozen base model by learning rank-``r`` factors ``A @ B``
+per target projection (Hu et al. 2021): trainable state shrinks from the
+full parameter count to ``O(r * (d_in + d_out))`` per target, which is
+what makes many-adapter serving and cheap task fine-tuning work.
+
+TPU-shaped choice: the train step *merges* ``W + scale * A @ B`` on the
+fly inside the jitted program (one small ``(d_in, r) @ (r, d_out)``
+matmul per target per step) and runs the stock :func:`~elephas_tpu.
+models.transformer.forward` — no forked model code, every attention
+path (flash/ring/GQA) and sharding spec keeps working. Gradients flow
+only into the factors (the base is a non-differentiated argument); XLA
+dead-code-eliminates the unused base-gradient computation.
+"""
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import TransformerConfig, lm_loss
+
+__all__ = ["init_lora_params", "merge_lora", "make_lora_train_step",
+           "lora_param_count"]
+
+#: supported targets -> (parent key, (d_in, d_out) extractor)
+_TARGETS = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+
+def _target_dims(leaf: jnp.ndarray, name: str) -> Tuple[int, int]:
+    """2-D (d_in, d_out) view dims of a target projection's weight."""
+    if name in ("wq", "wk", "wv"):        # (d_model, heads, head_dim)
+        return leaf.shape[0], leaf.shape[1] * leaf.shape[2]
+    if name == "wo":                       # (heads, head_dim, d_model)
+        return leaf.shape[0] * leaf.shape[1], leaf.shape[2]
+    return leaf.shape[0], leaf.shape[1]    # mlp w1 / w2
+
+
+def _parent(name: str) -> str:
+    return "attn" if name in ("wq", "wk", "wv", "wo") else "mlp"
+
+
+def init_lora_params(params: Dict, config: TransformerConfig, key,
+                     rank: int = 8,
+                     targets: Sequence[str] = ("wq", "wv")) -> Dict:
+    """Rank-``rank`` adapter pytree for ``targets`` of every layer.
+
+    ``A`` is Kaiming-init, ``B`` zeros — so the adapted model starts
+    exactly equal to the base (the LoRA identity-at-init property).
+    """
+    for t in targets:
+        if t not in _TARGETS:
+            raise ValueError(f"unknown LoRA target {t!r}; pick from "
+                             f"{_TARGETS}")
+        if t in ("w1", "w2") and config.num_experts > 1:
+            raise ValueError("MoE configs support attention targets only")
+    lora: Dict = {}
+    keys = jax.random.split(key, config.num_layers)
+    for i in range(config.num_layers):
+        layer = params[f"layer_{i}"]
+        tk = jax.random.split(keys[i], len(targets))
+        entry = {}
+        for t, k in zip(targets, tk):
+            leaf = layer[_parent(t)][t]
+            d_in, d_out = _target_dims(leaf, t)
+            entry[t] = {
+                "a": (jax.random.normal(k, (d_in, rank), leaf.dtype)
+                      / math.sqrt(d_in)),
+                "b": jnp.zeros((rank, d_out), leaf.dtype),
+            }
+        lora[f"layer_{i}"] = entry
+    return lora
+
+
+def merge_lora(params: Dict, lora: Dict, config: TransformerConfig,
+               alpha: Optional[float] = None) -> Dict:
+    """Base params with ``scale * A @ B`` folded into each target weight
+    (``scale = alpha / rank``, alpha defaulting to the rank — scale 1).
+    Used inside the train step each iteration AND for exporting a merged
+    model for serving."""
+    merged = {k: v for k, v in params.items()}
+    for lname, entry in lora.items():
+        layer = dict(params[lname])
+        parents: Dict = {}
+        for t, ab in entry.items():
+            rank = ab["a"].shape[1]
+            scale = (alpha / rank) if alpha is not None else 1.0
+            leaf = params[lname][_parent(t)][t]
+            delta = (ab["a"] @ ab["b"]).reshape(leaf.shape) * scale
+            parent = parents.setdefault(_parent(t),
+                                        dict(params[lname][_parent(t)]))
+            parent[t] = leaf + delta.astype(leaf.dtype)
+        for pname, pdict in parents.items():
+            layer[pname] = pdict
+        merged[lname] = layer
+    return merged
+
+
+def lora_param_count(lora: Dict) -> int:
+    return sum(int(np.prod(l.shape)) if hasattr(l, "shape") else 0
+               for l in jax.tree_util.tree_leaves(lora))
+
+
+def make_lora_train_step(config: TransformerConfig, tx,
+                         alpha: Optional[float] = None):
+    """Jitted ``(lora, opt_state, base_params, tokens) -> (lora,
+    opt_state, loss)``: only the adapter factors receive gradients and
+    optimizer state; the base rides along frozen (donate nothing of it)."""
+
+    def step(lora, opt_state, base_params, tokens):
+        def loss_fn(lo):
+            merged = merge_lora(base_params, lo, config, alpha)
+            return lm_loss(merged, tokens, config)
+
+        loss, grads = jax.value_and_grad(loss_fn)(lora)
+        updates, opt_state = tx.update(grads, opt_state, lora)
+        lora = jax.tree_util.tree_map(lambda p, u: p + u, lora, updates)
+        return lora, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))

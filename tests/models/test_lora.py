@@ -1,0 +1,101 @@
+"""LoRA fine-tuning tests: identity at init, frozen base, merged-serving
+equivalence, parameter-count economics."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elephas_tpu.models.lora import (init_lora_params, lora_param_count,
+                                     make_lora_train_step, merge_lora)
+from elephas_tpu.models.transformer import (TransformerConfig, forward,
+                                            init_params)
+
+
+def _config(**kw):
+    base = dict(vocab_size=64, num_layers=2, num_heads=4, d_model=32,
+                d_ff=64, max_seq_len=32, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_identity_at_init_and_param_economics():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    lora = init_lora_params(params, config, jax.random.PRNGKey(1), rank=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+    base_out = np.asarray(forward(params, tokens, config))
+    merged_out = np.asarray(forward(merge_lora(params, lora, config),
+                                    tokens, config))
+    np.testing.assert_allclose(base_out, merged_out, atol=1e-6)
+
+    full = sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(params))
+    assert lora_param_count(lora) < full / 10
+
+
+def test_lora_trains_and_base_stays_frozen():
+    config = _config(positional="rope", num_kv_heads=2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    frozen = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), params)
+    lora = init_lora_params(params, config, jax.random.PRNGKey(1), rank=4,
+                            targets=("wq", "wv", "w1"))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+    tx = optax.adam(1e-2)
+    opt = tx.init(lora)
+    step = make_lora_train_step(config, tx, alpha=8.0)
+    first = None
+    for _ in range(10):
+        lora, opt, loss = step(lora, opt, params, tokens)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(frozen)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # B factors actually moved
+    assert any(np.abs(np.asarray(l)).sum() > 0
+               for name, l in jax.tree_util.tree_leaves_with_path(lora)
+               if "'b'" in str(name))
+
+
+def test_merged_model_serves_equal_to_adapter_forward():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    lora = init_lora_params(params, config, jax.random.PRNGKey(1), rank=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+    tx = optax.adam(5e-3)
+    opt = tx.init(lora)
+    step = make_lora_train_step(config, tx)
+    for _ in range(3):
+        lora, opt, _ = step(lora, opt, params, tokens)
+    merged = merge_lora(params, lora, config)
+    out_merged = np.asarray(forward(merged, tokens, config))
+    # oracle: explicit x@A@B addition on wq/wv is what merge encodes;
+    # spot-check wq delta algebra directly
+    lw = lora["layer_0"]["wq"]
+    delta = np.asarray(lw["a"] @ lw["b"]).reshape(
+        np.asarray(params["layer_0"]["attn"]["wq"]).shape)
+    np.testing.assert_allclose(
+        np.asarray(merged["layer_0"]["attn"]["wq"]),
+        np.asarray(params["layer_0"]["attn"]["wq"]) + delta, atol=1e-6)
+    assert np.all(np.isfinite(out_merged))
+
+
+def test_lora_validation():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        init_lora_params(params, config, jax.random.PRNGKey(1),
+                         targets=("nope",))
+    moe = _config(num_experts=2)
+    moe_params = init_params(moe, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        init_lora_params(moe_params, moe, jax.random.PRNGKey(1),
+                        targets=("w1",))
+    # attention targets fine for MoE
+    lora = init_lora_params(moe_params, moe, jax.random.PRNGKey(1),
+                            targets=("wq",))
+    assert "wq" in lora["layer_0"]
