@@ -84,6 +84,11 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: residual dropout (GPT-2 scheme): applied to each attention and
+    #: MLP sublayer output before it re-enters the residual stream —
+    #: active only when a ``dropout_key`` reaches the forward pass
+    #: (training); inference/generate paths never drop
+    dropout_rate: float = 0.0
     #: chunked-vocab LM loss: when set, the training loss streams the
     #: logsumexp over vocab chunks of this size inside a rematerialized
     #: ``lax.scan`` instead of materializing the full ``(batch, seq,
@@ -113,6 +118,8 @@ class TransformerConfig:
                              f"'routed', got {self.moe_dispatch!r}")
         if self.moe_capacity_factor <= 0:
             raise ValueError("moe_capacity_factor must be positive")
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError("dropout_rate must be in [0, 1)")
         if self.positional not in ("learned", "rope"):
             raise ValueError("positional must be 'learned' or 'rope', "
                              f"got {self.positional!r}")
@@ -307,6 +314,15 @@ def _apply_rope(x, positions, config: "TransformerConfig"):
                             x1 * sin + x2 * cos], axis=-1)
 
 
+def _dropout(x, rate: float, key):
+    """Inverted dropout; identity when key is None (inference)."""
+    if key is None or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
 def _layer_norm(x, gamma, beta, eps=1e-5):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
@@ -314,9 +330,10 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
 
 
 def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
-                attn_fn) -> jnp.ndarray:
+                attn_fn, dropout_key=None) -> jnp.ndarray:
     """Pre-LN attention sublayer with residual; ``attn_fn(q, k, v) -> o``
-    supplies the attention implementation."""
+    supplies the attention implementation. ``dropout_key`` enables
+    residual dropout on the sublayer output (training only)."""
     h = _layer_norm(x, layer["ln1"]["gamma"], layer["ln1"]["beta"])
     h = h.astype(c.dtype)
     q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
@@ -337,11 +354,13 @@ def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
         k = jnp.repeat(k, groups, axis=1)
         v = jnp.repeat(v, groups, axis=1)
     o = attn_fn(q, k, v)
-    return x + jnp.einsum("bhtk,hkd->btd", o,
-                          layer["attn"]["wo"].astype(c.dtype))
+    out = jnp.einsum("bhtk,hkd->btd", o,
+                     layer["attn"]["wo"].astype(c.dtype))
+    return x + _dropout(out, c.dropout_rate, dropout_key)
 
 
-def _mlp_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig) -> jnp.ndarray:
+def _mlp_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
+               dropout_key=None) -> jnp.ndarray:
     """Pre-LN dense MLP sublayer with residual."""
     h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
     h = h.astype(c.dtype)
@@ -349,7 +368,7 @@ def _mlp_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig) -> jnp.ndarray
                     + layer["mlp"]["b1"].astype(c.dtype))
     h = (h @ layer["mlp"]["w2"].astype(c.dtype)
          + layer["mlp"]["b2"].astype(c.dtype))
-    return x + h
+    return x + _dropout(h, c.dropout_rate, dropout_key)
 
 
 def block_apply(layer: Dict, x: jnp.ndarray, config: TransformerConfig,
@@ -649,15 +668,19 @@ def _moe_block_routed_ep(h, moe, config: "TransformerConfig", mesh: Mesh,
 def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
             batch_axis: Optional[str] = None,
-            model_axis: Optional[str] = None) -> jnp.ndarray:
+            model_axis: Optional[str] = None,
+            dropout_key=None) -> jnp.ndarray:
     """Token ids ``(batch, seq)`` -> logits ``(batch, seq, vocab)``.
 
     When ``mesh`` and ``seq_axis`` are given, attention runs as ring
     attention with k/v shards streaming over the ``seq_axis`` ring.
+    ``dropout_key`` activates residual dropout (training); omit it for
+    deterministic inference.
     """
     logits, _ = forward_with_aux(params, tokens, config, mesh=mesh,
                                  seq_axis=seq_axis, batch_axis=batch_axis,
-                                 model_axis=model_axis)
+                                 model_axis=model_axis,
+                                 dropout_key=dropout_key)
     return logits
 
 
@@ -666,13 +689,15 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                      mesh: Optional[Mesh] = None,
                      seq_axis: Optional[str] = None,
                      batch_axis: Optional[str] = None,
-                     model_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
-                                                                jnp.ndarray]:
+                     model_axis: Optional[str] = None,
+                     dropout_key=None) -> Tuple[jnp.ndarray,
+                                                jnp.ndarray]:
     """Like :func:`forward` but also returns the summed MoE auxiliary
     (load-balancing) loss — 0.0 for dense configs."""
     x, aux_total = _hidden_with_aux(params, tokens, config, mesh=mesh,
                                     seq_axis=seq_axis, batch_axis=batch_axis,
-                                    model_axis=model_axis)
+                                    model_axis=model_axis,
+                                    dropout_key=dropout_key)
     return head_logits(params["embed"], params["final_ln"], x), aux_total
 
 
@@ -681,8 +706,9 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
                      mesh: Optional[Mesh] = None,
                      seq_axis: Optional[str] = None,
                      batch_axis: Optional[str] = None,
-                     model_axis: Optional[str] = None) -> Tuple[jnp.ndarray,
-                                                                jnp.ndarray]:
+                     model_axis: Optional[str] = None,
+                     dropout_key=None) -> Tuple[jnp.ndarray,
+                                                jnp.ndarray]:
     """The block stack up to (but excluding) the LM head: final hidden
     states ``(B, T, D)`` + summed MoE aux loss."""
     c = config
@@ -717,8 +743,12 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
     moe_ep = (moe_dispatch == "routed" and ep > 1 and seq_axis is None
               and _mesh_divides(mesh, model_axis, c.num_experts))
 
-    def layer_apply(layer, x):
-        x = _attn_apply(layer, x, c, attn_fn)
+    def layer_apply(layer, x, layer_key):
+        if layer_key is not None:
+            attn_key, mlp_key = jax.random.split(layer_key)
+        else:
+            attn_key = mlp_key = None
+        x = _attn_apply(layer, x, c, attn_fn, dropout_key=attn_key)
         if c.num_experts > 1:
             h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
             h = h.astype(c.dtype)
@@ -728,8 +758,9 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
             else:
                 h, aux = _moe_block(h, layer["moe"], c,
                                     dispatch=moe_dispatch)
-            return x + h, aux
-        return _mlp_apply(layer, x, c), jnp.zeros((), jnp.float32)
+            return x + _dropout(h, c.dropout_rate, mlp_key), aux
+        return (_mlp_apply(layer, x, c, dropout_key=mlp_key),
+                jnp.zeros((), jnp.float32))
 
     if c.remat:
         # recompute each block's activations in the backward pass instead
@@ -737,7 +768,9 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         layer_apply = jax.checkpoint(layer_apply)
 
     for i in range(c.num_layers):
-        x, aux = layer_apply(params[f"layer_{i}"], x)
+        layer_key = (jax.random.fold_in(dropout_key, i)
+                     if dropout_key is not None else None)
+        x, aux = layer_apply(params[f"layer_{i}"], x, layer_key)
         aux_total = aux_total + aux
 
     return x, aux_total
@@ -746,7 +779,8 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
 def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
             batch_axis: Optional[str] = None,
-            model_axis: Optional[str] = None) -> jnp.ndarray:
+            model_axis: Optional[str] = None,
+            dropout_key=None) -> jnp.ndarray:
     """Next-token cross-entropy (mean over all positions), plus the
     weighted MoE load-balancing auxiliary loss for MoE configs."""
     # the chunked (streamed-logsumexp) loss applies when the embedding is
@@ -756,7 +790,8 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     if chunk and (mesh is None or model_axis is None):
         x, aux = _hidden_with_aux(params, tokens, config, mesh=mesh,
                                   seq_axis=seq_axis, batch_axis=batch_axis,
-                                  model_axis=model_axis)
+                                  model_axis=model_axis,
+                                  dropout_key=dropout_key)
         loss, lse = chunked_next_token_losses(
             x, params["embed"], params["final_ln"], tokens, int(chunk))
         if config.num_experts > 1 and config.moe_aux_weight:
@@ -766,7 +801,8 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
         return loss
     logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
                                    seq_axis=seq_axis, batch_axis=batch_axis,
-                                   model_axis=model_axis)
+                                   model_axis=model_axis,
+                                   dropout_key=dropout_key)
     loss = next_token_loss(logits, tokens)
     if config.num_experts > 1 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
@@ -914,13 +950,16 @@ def make_train_step(config: TransformerConfig, tx,
         fsdp_opt_shardings = as_sharding(
             _opt_state_specs(tx, param_shapes, specs))
 
-    def loss_and_grads(params, tokens):
+    use_dropout = config.dropout_rate > 0
+
+    def loss_and_grads(params, tokens, dropout_key):
         return jax.value_and_grad(lm_loss)(
             params, tokens, config, mesh=mesh, seq_axis=seq_axis,
             batch_axis=data_axis if mesh is not None else None,
-            model_axis=model_axis if mesh is not None else None)
+            model_axis=model_axis if mesh is not None else None,
+            dropout_key=dropout_key)
 
-    def step(params, opt_state, tokens):
+    def step(params, opt_state, tokens, dropout_key=None):
         if accum_steps > 1:
             if tokens.shape[0] % accum_steps:
                 raise ValueError(
@@ -936,19 +975,26 @@ def make_train_step(config: TransformerConfig, tx,
                 micro = jax.lax.with_sharding_constraint(
                     micro, NamedSharding(mesh, P(None, data_axis,
                                                  *([None] * (micro.ndim - 2)))))
+            mkeys = (jax.random.split(dropout_key, accum_steps)
+                     if use_dropout else jnp.zeros((accum_steps, 2),
+                                                   jnp.uint32))
 
-            def body(carry, tk):
+            def body(carry, xs):
+                tk, mk = xs
                 gsum, lsum = carry
-                loss, grads = loss_and_grads(params, tk)
+                loss, grads = loss_and_grads(params, tk,
+                                             mk if use_dropout else None)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
                 return (gsum, lsum + loss), None
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (gsum, lsum), _ = jax.lax.scan(body, (zeros, 0.0), micro)
+            (gsum, lsum), _ = jax.lax.scan(body, (zeros, 0.0),
+                                           (micro, mkeys))
             grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
             loss = lsum / accum_steps
         else:
-            loss, grads = loss_and_grads(params, tokens)
+            loss, grads = loss_and_grads(
+                params, tokens, dropout_key if use_dropout else None)
         if fsdp_shardings is not None:
             # keep the gradient fully sharded before the optimizer math:
             # GSPMD then reduce-scatters it and runs the update per-shard
@@ -960,16 +1006,28 @@ def make_train_step(config: TransformerConfig, tx,
         return params, opt_state, loss
 
     if not (zero_optimizer and mesh is not None):
+        if not use_dropout:
+            # keep the historical 3-arg signature when dropout is off
+            def step3(params, opt_state, tokens):
+                return step(params, opt_state, tokens, None)
+            if fsdp_shardings is not None:
+                return jax.jit(
+                    step3, donate_argnums=(0, 1),
+                    in_shardings=(fsdp_shardings, fsdp_opt_shardings, None),
+                    out_shardings=(fsdp_shardings, fsdp_opt_shardings,
+                                   None))
+            return jax.jit(step3, donate_argnums=(0, 1))
         if fsdp_shardings is not None:
             return jax.jit(
                 step, donate_argnums=(0, 1),
-                in_shardings=(fsdp_shardings, fsdp_opt_shardings, None),
+                in_shardings=(fsdp_shardings, fsdp_opt_shardings, None,
+                              None),
                 out_shardings=(fsdp_shardings, fsdp_opt_shardings, None))
         return jax.jit(step, donate_argnums=(0, 1))
 
     jitted = {}
 
-    def stepper(params, opt_state, tokens):
+    def stepper(params, opt_state, tokens, *dropout_key):
         # the opt-state shardings depend on the params treedef, so the
         # jit wrapper is built on first call and cached
         if "fn" not in jitted:
@@ -981,11 +1039,14 @@ def make_train_step(config: TransformerConfig, tx,
             # in_shardings too: a replicated opt state passed on the
             # first call is resharded on entry, so the donated input and
             # the sharded output alias cleanly
+            n_extra = 1 if use_dropout else 0
+            fn = step if use_dropout else (
+                lambda p, o, t: step(p, o, t, None))
             jitted["fn"] = jax.jit(
-                step, donate_argnums=(0, 1),
-                in_shardings=(None, shardings, None),
+                fn, donate_argnums=(0, 1),
+                in_shardings=(None, shardings, None) + (None,) * n_extra,
                 out_shardings=(None, shardings, None))
-        return jitted["fn"](params, opt_state, tokens)
+        return jitted["fn"](params, opt_state, tokens, *dropout_key)
 
     return stepper
 

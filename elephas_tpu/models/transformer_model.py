@@ -300,6 +300,8 @@ class TransformerModel:
         from ..utils.tracing import StepTimer
 
         rng = np.random.default_rng(seed)
+        use_dropout = self.config.dropout_rate > 0
+        dropout_base = jax.random.PRNGKey(seed)
         n = tokens.shape[0]
         nb = n // batch_size
         if nb == 0:
@@ -325,7 +327,12 @@ class TransformerModel:
                     xb = shard_leading(mesh, "data", xb)
                 else:
                     xb = jnp.asarray(xb)
-                params, opt_state, loss = step(params, opt_state, xb)
+                if use_dropout:
+                    params, opt_state, loss = step(
+                        params, opt_state, xb,
+                        jax.random.fold_in(dropout_base, epoch * nb + i))
+                else:
+                    params, opt_state, loss = step(params, opt_state, xb)
                 losses.append(loss)
             # the float() fetches block on the epoch's dispatched steps,
             # so the recorded wall time is real (tracing requirement)

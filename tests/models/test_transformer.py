@@ -1091,3 +1091,53 @@ def test_chunked_vocab_loss_trains_and_tp_mesh_falls_back():
     unsharded = float(lm_loss(init_params(config, jax.random.PRNGKey(0)),
                               tokens, config))
     np.testing.assert_allclose(sharded, unsharded, atol=2e-3)
+
+
+# -------------------------------------------------------------- dropout
+def test_dropout_zero_matches_baseline_and_inference_deterministic():
+    import dataclasses
+
+    config = _config()
+    drop_cfg = dataclasses.replace(config, dropout_rate=0.2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    # no key -> no dropout, regardless of rate
+    a = np.asarray(forward(params, tokens, drop_cfg))
+    b = np.asarray(forward(params, tokens, config))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    # same key deterministic, different keys differ
+    k = jax.random.PRNGKey(7)
+    d1 = np.asarray(forward(params, tokens, drop_cfg, dropout_key=k))
+    d2 = np.asarray(forward(params, tokens, drop_cfg, dropout_key=k))
+    d3 = np.asarray(forward(params, tokens, drop_cfg,
+                            dropout_key=jax.random.PRNGKey(8)))
+    np.testing.assert_array_equal(d1, d2)
+    assert np.abs(d1 - d3).max() > 1e-6
+    assert np.abs(d1 - a).max() > 1e-6  # dropout actually active
+
+
+def test_dropout_train_step_signature_and_training():
+    import dataclasses
+
+    config = dataclasses.replace(_config(), dropout_rate=0.1)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for i in range(10):
+        params, opt, loss = step(params, opt, tokens,
+                                 jax.random.PRNGKey(100 + i))
+        if first is None:
+            first = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
+
+    # grad accumulation splits the key per microbatch and still trains
+    config2 = dataclasses.replace(config, dropout_rate=0.1)
+    params2 = init_params(config2, jax.random.PRNGKey(0))
+    opt2 = tx.init(params2)
+    step2 = make_train_step(config2, tx, accum_steps=2)
+    params2, opt2, loss2 = step2(params2, opt2, tokens,
+                                 jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss2))

@@ -221,3 +221,21 @@ def test_fsdp_through_model_surface():
     assert clone.fsdp is True
     with pytest.raises(ValueError):
         TransformerModel(_config(), fsdp=True, zero_optimizer=True)
+
+
+def test_dropout_config_through_model_surface():
+    import dataclasses
+
+    config = dataclasses.replace(_config(), dropout_rate=0.1)
+    model = TransformerModel(config)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.25)
+    history = tpu_model.training_histories[-1]
+    assert np.isfinite(history["loss"][-1])
+    assert "val_loss" in history  # eval path runs without dropout
+    # predict is deterministic (no dropout at inference)
+    p1 = model.predict(np.asarray(_tokens(4)))
+    p2 = model.predict(np.asarray(_tokens(4)))
+    np.testing.assert_array_equal(p1, p2)
