@@ -1177,11 +1177,37 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
     return head_logits(params["embed"], params["final_ln"], x), new_cache
 
 
+def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],
+                   top_p: Optional[float]) -> jnp.ndarray:
+    """Sampling filters: keep the top-k logits and/or the nucleus (the
+    smallest set of tokens whose probability mass reaches top_p); the
+    rest drop to -inf. Static-shape formulations (sort + threshold), so
+    the whole thing stays inside the decode scan."""
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, NEG_INF)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until the cumulative mass passes top_p (always
+        # keeping the most probable one)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool),
+             cum[..., :-1] < top_p], axis=-1)
+        # threshold = smallest kept logit
+        threshold = jnp.min(jnp.where(keep_sorted, sorted_logits,
+                                      jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits >= threshold, logits, NEG_INF)
+    return logits
+
+
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",
-                                   "config", "sample"))
+                                   "config", "sample", "top_k", "top_p"))
 def _generate_scan(params, prompt, temperature, key, prompt_len: int,
                    max_new_tokens: int, config: TransformerConfig,
-                   sample: bool):
+                   sample: bool, top_k: Optional[int] = None,
+                   top_p: Optional[float] = None):
     c = config
     total = prompt_len + max_new_tokens
     cache = init_kv_cache(c, prompt.shape[0], total)
@@ -1193,7 +1219,9 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
         logits, cache = decode_step(params, cache, tok, t, c)
         if sample:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            filtered = _filter_logits(logits, top_k, top_p)
+            nxt = jax.random.categorical(sub, filtered / temperature,
+                                         axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return (cache, nxt, key), nxt
@@ -1207,17 +1235,19 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
 
 def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
              config: TransformerConfig, temperature: float = 0.0,
-             key=None) -> jnp.ndarray:
+             key=None, top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jnp.ndarray:
     """Autoregressive generation: ``(batch, prompt_len)`` prompt ids ->
     ``(batch, max_new_tokens)`` sampled continuations.
 
     One jitted ``lax.scan`` over positions, compiled once per
-    (config, shape, greedy/sampled) combination — the config and lengths
-    are static jit arguments, so repeated calls reuse the executable.
-    Prompt positions teacher-force the cache, generation positions feed
-    the previous sample back. ``temperature=0`` is greedy argmax;
-    otherwise categorical sampling at the given temperature (``key``
-    required).
+    (config, shape, greedy/sampled, filters) combination — the config
+    and lengths are static jit arguments, so repeated calls reuse the
+    executable. Prompt positions teacher-force the cache, generation
+    positions feed the previous sample back. ``temperature=0`` is greedy
+    argmax; otherwise categorical sampling at the given temperature
+    (``key`` required), optionally filtered to the ``top_k`` most
+    probable tokens and/or the ``top_p`` nucleus.
     """
     c = config
     prompt = jnp.asarray(prompt)
@@ -1228,8 +1258,14 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
                          f"max_seq_len = {c.max_seq_len}")
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if top_k is not None and top_k < 1:
+        raise ValueError("top_k must be >= 1")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError("top_p must be in (0, 1]")
     if key is None:
         key = jax.random.PRNGKey(0)
     return _generate_scan(params, prompt, jnp.float32(temperature), key,
                           prompt_len, int(max_new_tokens), c,
-                          temperature > 0)
+                          temperature > 0,
+                          int(top_k) if top_k is not None else None,
+                          float(top_p) if top_p is not None else None)

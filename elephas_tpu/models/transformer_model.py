@@ -402,14 +402,18 @@ class TransformerModel:
         return np.concatenate(outs, axis=0)
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, seed: int = 0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> np.ndarray:
         """Autoregressive continuation of ``(batch, prompt_len)`` token
         ids via the KV-cache decode loop (one lax.scan, compiled once per
-        shape): ``temperature=0`` greedy, otherwise categorical sampling."""
+        shape): ``temperature=0`` greedy, otherwise categorical sampling,
+        optionally top-k and/or nucleus (top-p) filtered."""
         key = jax.random.PRNGKey(seed)
         return np.asarray(_generate(self.params, np.asarray(prompt),
                                     int(max_new_tokens), self.config,
-                                    temperature=temperature, key=key))
+                                    temperature=temperature, key=key,
+                                    top_k=top_k, top_p=top_p))
 
     def evaluate(self, tokens: np.ndarray, y=None, batch_size: int = 8,
                  verbose: int = 0) -> float:

@@ -1141,3 +1141,45 @@ def test_dropout_train_step_signature_and_training():
     params2, opt2, loss2 = step2(params2, opt2, tokens,
                                  jax.random.PRNGKey(0))
     assert np.isfinite(float(loss2))
+
+
+def test_generate_top_k_and_top_p_sampling():
+    from elephas_tpu.models.transformer import _filter_logits, generate
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0,
+                                config.vocab_size)
+    key = jax.random.PRNGKey(3)
+
+    # top_k=1 sampling degenerates to greedy
+    greedy = np.asarray(generate(params, prompt, 6, config))
+    tk1 = np.asarray(generate(params, prompt, 6, config, temperature=1.0,
+                              key=key, top_k=1))
+    np.testing.assert_array_equal(greedy, tk1)
+
+    # permissive filters change nothing vs plain sampling (same key)
+    plain = np.asarray(generate(params, prompt, 6, config, temperature=1.0,
+                                key=key))
+    loose = np.asarray(generate(params, prompt, 6, config, temperature=1.0,
+                                key=key, top_k=config.vocab_size,
+                                top_p=1.0))
+    np.testing.assert_array_equal(plain, loose)
+
+    # filter semantics on a known distribution
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    f = np.asarray(_filter_logits(logits, top_k=2, top_p=None))
+    assert np.isfinite(f[0, :2]).all() and (f[0, 2:] < -1e29).all()
+    f = np.asarray(_filter_logits(logits, top_k=None, top_p=0.6))
+    # nucleus at 0.6: keep 0.5 then 0.25 (cum 0.5 < 0.6 keeps the 2nd)
+    assert np.isfinite(f[0, :2]).all() and (f[0, 2:] < -1e29).all()
+    f = np.asarray(_filter_logits(logits, top_k=None, top_p=0.4))
+    assert np.isfinite(f[0, 0]) and (f[0, 1:] < -1e29).all()
+
+    import pytest
+    with pytest.raises(ValueError):
+        generate(params, prompt, 4, config, temperature=1.0, key=key,
+                 top_k=0)
+    with pytest.raises(ValueError):
+        generate(params, prompt, 4, config, temperature=1.0, key=key,
+                 top_p=0.0)
