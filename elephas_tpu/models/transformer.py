@@ -84,6 +84,10 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: label smoothing for the LM cross-entropy: eps mass spreads
+    #: uniformly over the vocab (Szegedy et al.; standard for seq2seq /
+    #: large-LM training) — 0 disables
+    label_smoothing: float = 0.0
     #: residual dropout (GPT-2 scheme): applied to each attention and
     #: MLP sublayer output before it re-enters the residual stream —
     #: active only when a ``dropout_key`` reaches the forward pass
@@ -120,6 +124,8 @@ class TransformerConfig:
             raise ValueError("moe_capacity_factor must be positive")
         if not 0.0 <= self.dropout_rate < 1.0:
             raise ValueError("dropout_rate must be in [0, 1)")
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError("label_smoothing must be in [0, 1)")
         if self.positional not in ("learned", "rope"):
             raise ValueError("positional must be 'learned' or 'rope', "
                              f"got {self.positional!r}")
@@ -403,23 +409,32 @@ def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray) -> jnp.ndarray:
     return x @ embed["tokens"].T.astype(jnp.float32)
 
 
-def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Next-token cross-entropy, mean over all positions."""
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+                    label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over all positions; with label
+    smoothing, eps probability mass spreads uniformly over the vocab."""
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    ce = -jnp.mean(picked)
+    if label_smoothing:
+        eps = label_smoothing
+        ce = (1.0 - eps) * ce - eps * jnp.mean(jnp.mean(logp, axis=-1))
+    return ce
 
 
 def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
                               tokens: jnp.ndarray, chunk: int
-                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                         jnp.ndarray]:
     """Streamed LM loss pieces from the final hidden states: returns
-    ``(cross_entropy, lse)`` where ``lse[b, t] = logsumexp_v(logits)``
-    (so the z-loss comes free), WITHOUT materializing ``(B, T, V)``
-    logits. The vocab axis is processed in ``chunk``-sized slices inside
-    a rematerialized scan — each chunk's logits live only transiently in
-    both passes, bounding peak HBM at ``(B, T, chunk)``.
+    ``(cross_entropy, lse, mean_logits)`` where ``lse[b, t] =
+    logsumexp_v(logits)`` (so the z-loss comes free) and ``mean_logits``
+    is the per-position vocab mean (the label-smoothing term), WITHOUT
+    materializing ``(B, T, V)`` logits. The vocab axis is processed in
+    ``chunk``-sized slices inside a rematerialized scan — each chunk's
+    logits live only transiently in both passes, bounding peak HBM at
+    ``(B, T, chunk)``.
     """
     h = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
                     final_ln["beta"])[:, :-1]                # (B, T', D)
@@ -435,22 +450,23 @@ def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
 
     @jax.checkpoint
     def body(carry, ec):
-        m, s = carry
+        m, s, tot = carry
         e_chunk, mask = ec
         logits_c = jnp.einsum("btd,cd->btc", h, e_chunk)
         logits_c = jnp.where(mask, logits_c, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(logits_c, axis=-1))
         s = (s * jnp.exp(m - m_new)
              + jnp.sum(jnp.exp(logits_c - m_new[..., None]), axis=-1))
-        return (m_new, s), None
+        tot = tot + jnp.sum(jnp.where(mask, logits_c, 0.0), axis=-1)
+        return (m_new, s, tot), None
 
     m0 = jnp.full(h.shape[:2], NEG_INF, jnp.float32)
     s0 = jnp.zeros(h.shape[:2], jnp.float32)
-    (m, s), _ = jax.lax.scan(body, (m0, s0), (emb_c, valid))
+    (m, s, tot), _ = jax.lax.scan(body, (m0, s0, s0), (emb_c, valid))
     lse = m + jnp.log(s)                                     # (B, T')
     # target logit via a row gather — (B, T', D) transient, not (B,T',V)
     picked = jnp.sum(h * emb[targets], axis=-1)
-    return jnp.mean(lse - picked), lse
+    return jnp.mean(lse - picked), lse, tot / v
 
 
 def select_moe_dispatch(config: "TransformerConfig",
@@ -792,8 +808,13 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
                                   seq_axis=seq_axis, batch_axis=batch_axis,
                                   model_axis=model_axis,
                                   dropout_key=dropout_key)
-        loss, lse = chunked_next_token_losses(
+        loss, lse, mean_logits = chunked_next_token_losses(
             x, params["embed"], params["final_ln"], tokens, int(chunk))
+        if config.label_smoothing:
+            # mean_v logp_v = mean_v logits_v - lse
+            eps = config.label_smoothing
+            loss = ((1.0 - eps) * loss
+                    + eps * jnp.mean(lse - mean_logits))
         if config.num_experts > 1 and config.moe_aux_weight:
             loss = loss + config.moe_aux_weight * aux
         if config.z_loss_weight:
@@ -803,7 +824,8 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
                                    seq_axis=seq_axis, batch_axis=batch_axis,
                                    model_axis=model_axis,
                                    dropout_key=dropout_key)
-    loss = next_token_loss(logits, tokens)
+    loss = next_token_loss(logits, tokens,
+                           label_smoothing=config.label_smoothing)
     if config.num_experts > 1 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
     if config.z_loss_weight:

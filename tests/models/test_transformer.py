@@ -1183,3 +1183,32 @@ def test_generate_top_k_and_top_p_sampling():
     with pytest.raises(ValueError):
         generate(params, prompt, 4, config, temperature=1.0, key=key,
                  top_p=0.0)
+
+
+def test_label_smoothing_dense_and_chunked_agree():
+    import dataclasses
+
+    base = dataclasses.replace(_config(), label_smoothing=0.1)
+    chunked = dataclasses.replace(base, loss_vocab_chunk=24)
+    params = init_params(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    dense_val = float(lm_loss(params, tokens, base))
+    chunk_val = float(lm_loss(params, tokens, chunked))
+    np.testing.assert_allclose(chunk_val, dense_val, atol=1e-5, rtol=1e-5)
+    # smoothing raises the loss on a confident model and grads match
+    plain = float(lm_loss(params, tokens, _config()))
+    assert dense_val != plain
+    g_dense = jax.grad(lm_loss)(params, tokens, base)
+    g_chunk = jax.grad(lm_loss)(params, tokens, chunked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_chunk),
+                    jax.tree_util.tree_leaves(g_dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+    # exact semantics: smoothed ce == (1-eps)*ce + eps*uniform_ce
+    logits = forward(params, tokens, base)
+    from elephas_tpu.models.transformer import next_token_loss
+    ce = float(next_token_loss(logits, tokens))
+    logp = jax.nn.log_softmax(np.asarray(logits[:, :-1], np.float64), -1)
+    uniform = -float(np.mean(logp.mean(-1)))
+    np.testing.assert_allclose(dense_val, 0.9 * ce + 0.1 * uniform,
+                               rtol=1e-5)
