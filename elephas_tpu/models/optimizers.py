@@ -124,19 +124,37 @@ class Adam(Optimizer):
                 **self._clip_config()}
 
 
+def _decay_mask_fn(params):
+    """True for leaves that should receive weight decay: rank >= 2
+    (matrices/embeddings), i.e. biases, LayerNorm scales and other 1-D
+    vectors are excluded — the standard transformer decay mask."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
 class AdamW(Adam):
+    """``decay_1d=False`` (default) applies the standard mask: only
+    rank>=2 parameters are decayed (biases/LayerNorm excluded); set
+    ``decay_1d=True`` for unmasked Keras-style decay of everything."""
+
     def __init__(self, learning_rate: float = 0.001, weight_decay: float = 0.004,
-                 **kwargs):
+                 decay_1d: bool = False, **kwargs):
         super().__init__(learning_rate, **kwargs)
         self.weight_decay = float(weight_decay)
+        self.decay_1d = bool(decay_1d)
 
     def to_optax(self):
-        return self._clipped(optax.adamw(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                           eps=self.epsilon, weight_decay=self.weight_decay))
+        return self._clipped(optax.adamw(
+            self._lr(), b1=self.beta_1, b2=self.beta_2,
+            eps=self.epsilon, weight_decay=self.weight_decay,
+            mask=None if self.decay_1d else _decay_mask_fn))
 
     def get_config(self):
         config = super().get_config()
         config["weight_decay"] = self.weight_decay
+        config["decay_1d"] = self.decay_1d
         return config
 
 
@@ -240,8 +258,10 @@ class Lion(Optimizer):
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return self._clipped(optax.lion(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          weight_decay=self.weight_decay))
+        return self._clipped(optax.lion(
+            self._lr(), b1=self.beta_1, b2=self.beta_2,
+            weight_decay=self.weight_decay,
+            mask=None if self.weight_decay == 0.0 else _decay_mask_fn))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
@@ -266,8 +286,10 @@ class LAMB(Optimizer):
         self.weight_decay = float(weight_decay)
 
     def to_optax(self):
-        return self._clipped(optax.lamb(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          eps=self.epsilon, weight_decay=self.weight_decay))
+        return self._clipped(optax.lamb(
+            self._lr(), b1=self.beta_1, b2=self.beta_2,
+            eps=self.epsilon, weight_decay=self.weight_decay,
+            mask=None if self.weight_decay == 0.0 else _decay_mask_fn))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,

@@ -301,3 +301,33 @@ def test_gradient_clipping_semantics_and_training():
     history = model.fit(x, y, epochs=5, batch_size=32, verbose=0)
     assert np.isfinite(history.history["loss"][-1])
     assert history.history["loss"][-1] < history.history["loss"][0]
+
+
+def test_adamw_decay_mask_excludes_1d_params():
+    """Default AdamW decays matrices but not biases/LN vectors; the
+    legacy unmasked behavior stays available via decay_1d=True."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elephas_tpu.models import AdamW
+    import elephas_tpu.models.optimizers as om
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    tx = AdamW(learning_rate=0.1, weight_decay=0.1).to_optax()
+    state = tx.init(params)
+    updates, _ = tx.update(zero_grads, state, params)
+    assert float(jnp.abs(updates["w"]).sum()) > 0   # matrix decayed
+    np.testing.assert_allclose(np.asarray(updates["b"]), 0.0)  # bias not
+
+    tx = AdamW(learning_rate=0.1, weight_decay=0.1,
+               decay_1d=True).to_optax()
+    state = tx.init(params)
+    updates, _ = tx.update(zero_grads, state, params)
+    assert float(jnp.abs(updates["b"]).sum()) > 0   # legacy: decayed
+
+    o = AdamW(weight_decay=0.05)
+    rt = om.deserialize(om.serialize(o))
+    assert rt.decay_1d is False and rt.get_config() == o.get_config()
