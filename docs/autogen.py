@@ -64,7 +64,8 @@ PAGES = [
      ["TransformerConfig", "init_params", "param_specs",
       "fsdp_param_specs", "zero_opt_specs", "abstract_params", "forward",
       "forward_with_aux", "lm_loss", "make_train_step", "shard_params",
-      "select_moe_dispatch", "init_kv_cache", "decode_step", "generate"]),
+      "select_moe_dispatch", "init_kv_cache", "decode_step", "generate",
+      "beam_search"]),
     ("TransformerModel", "elephas_tpu.models.transformer_model",
      ["TransformerModel"]),
     ("LoRA fine-tuning", "elephas_tpu.models.lora",

@@ -1291,3 +1291,106 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
                           temperature > 0,
                           int(top_k) if top_k is not None else None,
                           float(top_p) if top_p is not None else None)
+
+
+@partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",
+                                   "config", "num_beams", "eos_id"))
+def _beam_search_scan(params, prompt, prompt_len: int, max_new_tokens: int,
+                      config: TransformerConfig, num_beams: int,
+                      length_penalty, eos_id: Optional[int]):
+    c = config
+    batch = prompt.shape[0]
+    total = prompt_len + max_new_tokens
+    bb = batch * num_beams
+
+    # beams ride the batch axis of one shared decode program
+    cache = init_kv_cache(c, bb, total)
+    flat_prompt = jnp.repeat(prompt, num_beams, axis=0)       # (B*K, P)
+
+    # teacher-force the prompt through all beams (identical prefixes)
+    def prefill(carry, t):
+        cache, _ = carry
+        logits, cache = decode_step(params, cache, flat_prompt[:, t], t, c)
+        return (cache, logits), None
+
+    zero_logits = jnp.zeros((bb, c.vocab_size), jnp.float32)
+    (cache, logits), _ = jax.lax.scan(prefill, (cache, zero_logits),
+                                      jnp.arange(prompt_len))
+
+    # only beam 0 is live initially (identical beams would tie)
+    scores0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (num_beams - 1),
+                                   jnp.float32), (batch, 1))   # (B, K)
+    tokens0 = jnp.zeros((batch, num_beams, max_new_tokens), jnp.int32)
+    finished0 = jnp.zeros((batch, num_beams), bool)
+
+    def step(carry, t):
+        cache, logits, scores, tokens, finished = carry
+        logp = jax.nn.log_softmax(logits, axis=-1)            # (B*K, V)
+        logp = logp.reshape(batch, num_beams, c.vocab_size)
+        if eos_id is not None:
+            # finished beams may only emit eos, at no additional cost
+            frozen = jnp.full_like(logp[0, 0], NEG_INF).at[eos_id].set(0.0)
+            logp = jnp.where(finished[..., None], frozen, logp)
+        flat = (scores[..., None] + logp).reshape(batch, -1)  # (B, K*V)
+        top_scores, top_flat = jax.lax.top_k(flat, num_beams)  # (B, K)
+        beam_idx = top_flat // c.vocab_size
+        token = top_flat % c.vocab_size
+
+        # reorder everything along the beam axis
+        tokens = jnp.take_along_axis(tokens, beam_idx[..., None], axis=1)
+        tokens = tokens.at[:, :, t].set(token)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        if eos_id is not None:
+            finished = finished | (token == eos_id)
+        gather = (beam_idx
+                  + jnp.arange(batch)[:, None] * num_beams).reshape(-1)
+        cache = jax.tree_util.tree_map(lambda a: a[gather], cache)
+
+        logits, cache = decode_step(params, cache, token.reshape(-1),
+                                    prompt_len + t, c)
+        return (cache, logits, top_scores, tokens, finished), None
+
+    (cache, _, scores, tokens, finished), _ = jax.lax.scan(
+        step, (cache, logits, scores0, tokens0, finished0),
+        jnp.arange(max_new_tokens))
+
+    # Google-NMT length penalty ((5 + L) / 6) ** alpha
+    if eos_id is not None:
+        lengths = jnp.where(
+            finished,
+            1.0 + jnp.argmax(tokens == eos_id, axis=-1).astype(jnp.float32),
+            float(max_new_tokens))
+    else:
+        lengths = jnp.full(scores.shape, float(max_new_tokens))
+    norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    ranked = scores / norm
+    order = jnp.argsort(-ranked, axis=1)
+    return (jnp.take_along_axis(tokens, order[..., None], axis=1),
+            jnp.take_along_axis(ranked, order, axis=1))
+
+
+def beam_search(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
+                config: TransformerConfig, num_beams: int = 4,
+                length_penalty: float = 0.0,
+                eos_id: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding: ``(batch, prompt_len)`` prompts ->
+    ``(sequences, scores)`` with sequences ``(batch, num_beams,
+    max_new_tokens)`` sorted best-first.
+
+    Beams ride the batch axis of the same jitted KV-cache decode program
+    ``generate`` uses (one compiled scan; cache reordered by a beam
+    gather each step — static shapes throughout). ``eos_id`` freezes
+    finished beams; ``length_penalty`` applies the GNMT normalization
+    ``((5+L)/6)**alpha`` at ranking time.
+    """
+    c = config
+    prompt = jnp.asarray(prompt)
+    _, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > c.max_seq_len:
+        raise ValueError("prompt_len + max_new_tokens exceeds max_seq_len")
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    return _beam_search_scan(params, prompt, prompt_len,
+                             int(max_new_tokens), c, int(num_beams),
+                             jnp.float32(length_penalty), eos_id)

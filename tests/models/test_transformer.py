@@ -1212,3 +1212,58 @@ def test_label_smoothing_dense_and_chunked_agree():
     uniform = -float(np.mean(logp.mean(-1)))
     np.testing.assert_allclose(dense_val, 0.9 * ce + 0.1 * uniform,
                                rtol=1e-5)
+
+
+def test_beam_search_beats_greedy_and_beam1_equals_greedy():
+    from elephas_tpu.models.transformer import beam_search, generate
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 4), 0,
+                                config.vocab_size)
+
+    greedy = np.asarray(generate(params, prompt, 6, config))
+    seqs, scores = beam_search(params, prompt, 6, config, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(seqs)[:, 0], greedy)
+
+    seqs4, scores4 = beam_search(params, prompt, 6, config, num_beams=4)
+    assert seqs4.shape == (3, 4, 6) and scores4.shape == (3, 4)
+    # scores sorted best-first and the best beam >= greedy's joint logp
+    s4 = np.asarray(scores4)
+    assert (np.diff(s4, axis=1) <= 1e-5).all()
+
+    def joint_logp(seq_tokens):
+        full = np.concatenate([np.asarray(prompt), seq_tokens], axis=1)
+        logits = np.asarray(forward(params, jnp.asarray(full), config))
+        logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        total = np.zeros(full.shape[0])
+        for t in range(6):
+            pos = prompt.shape[1] - 1 + t
+            total += np.asarray(logp)[np.arange(full.shape[0]), pos,
+                                      full[:, pos + 1]]
+        return total
+
+    g = joint_logp(greedy)
+    b = joint_logp(np.asarray(seqs4)[:, 0])
+    assert (b >= g - 1e-4).all(), (b, g)
+
+
+def test_beam_search_eos_freezes_finished_beams():
+    from elephas_tpu.models.transformer import beam_search
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 3), 0,
+                                config.vocab_size)
+    eos = 5
+    seqs, scores = beam_search(params, prompt, 8, config, num_beams=3,
+                               eos_id=eos, length_penalty=1.0)
+    s = np.asarray(seqs)
+    # after the first eos in a beam, every subsequent token is eos
+    for b in range(2):
+        for k in range(3):
+            row = s[b, k]
+            hits = np.flatnonzero(row == eos)
+            if hits.size:
+                assert (row[hits[0]:] == eos).all()
+    assert np.isfinite(np.asarray(scores)).all()
