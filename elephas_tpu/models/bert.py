@@ -25,7 +25,8 @@ from ..ops.attention import attention
 from .transformer import _attn_apply, _layer_norm, _mesh_divides, _mlp_apply
 
 __all__ = ["BertConfig", "init_params", "param_specs", "encode", "pool",
-           "mlm_loss", "mask_tokens", "make_mlm_train_step", "shard_params"]
+           "mlm_loss", "mask_tokens", "make_mlm_train_step", "shard_params",
+           "init_classifier_head", "classify", "make_classifier_train_step"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,12 +48,17 @@ class BertConfig:
     #: gather keeps shapes fixed for XLA (ceil(mask_rate * seq) rounded
     #: up; rows with fewer masks pad with weight-0 entries)
     max_predictions: int = 80
+    #: residual dropout on each sublayer output (active only when a
+    #: dropout key reaches the forward pass)
+    dropout_rate: float = 0.0
     remat: bool = False
     num_kv_heads: Optional[int] = None
 
     def __post_init__(self):
         if self.d_model % self.num_heads:
             raise ValueError("num_heads must divide d_model")
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError("dropout_rate must be in [0, 1)")
         if self.num_kv_heads is not None and (
                 self.num_kv_heads < 1
                 or self.num_heads % self.num_kv_heads):
@@ -282,5 +288,61 @@ def make_mlm_train_step(config: BertConfig, tx,
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ------------------------------------------------------------- fine-tuning
+def init_classifier_head(config: BertConfig, num_classes: int, key) -> Dict:
+    """Classification head over the [CLS] pooler (the BERT fine-tuning
+    recipe): one dense layer to ``num_classes`` logits."""
+    return {"kernel": (jax.random.normal(
+                key, (config.d_model, num_classes), config.param_dtype)
+                / math.sqrt(config.d_model)),
+            "bias": jnp.zeros((num_classes,), config.param_dtype)}
+
+
+def classify(params: Dict, head: Dict, tokens: jnp.ndarray,
+             config: BertConfig,
+             segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Sequence-classification logits ``(B, num_classes)``."""
+    hidden = encode(params, tokens, segment_ids, config)
+    pooled = pool(params, hidden, config)
+    return (pooled @ head["kernel"].astype(jnp.float32)
+            + head["bias"].astype(jnp.float32))
+
+
+def make_classifier_train_step(config: BertConfig, tx,
+                               freeze_encoder: bool = False):
+    """Jitted fine-tuning step ``(state, opt_state, tokens, labels) ->
+    (state, opt_state, loss)`` where ``state = {"params", "head"}``.
+    ``freeze_encoder=True`` trains the head only (linear probing) —
+    gradients never flow into the encoder and its optimizer state is a
+    single frozen subtree."""
+
+    def loss_fn(trainable, frozen, tokens, labels):
+        params = frozen if freeze_encoder else trainable["params"]
+        logits = classify(params, trainable["head"], tokens, config)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, labels[:, None].astype(jnp.int32), axis=1))
+
+    def step(state, opt_state, tokens, labels):
+        if freeze_encoder:
+            trainable = {"head": state["head"]}
+            frozen = state["params"]
+        else:
+            trainable = state
+            frozen = None
+        loss, grads = jax.value_and_grad(loss_fn)(trainable, frozen,
+                                                  tokens, labels)
+        updates, opt_state = tx.update(grads, opt_state, trainable)
+        trainable = jax.tree_util.tree_map(lambda p, u: p + u, trainable,
+                                           updates)
+        if freeze_encoder:
+            state = {"params": state["params"], "head": trainable["head"]}
+        else:
+            state = trainable
+        return state, opt_state, loss
 
     return jax.jit(step, donate_argnums=(0, 1))

@@ -43,6 +43,9 @@ class ViTConfig:
     pool: str = "cls"
     #: per-block rematerialization (same HBM trade as the LM config)
     remat: bool = False
+    #: residual dropout on each sublayer output (active only when a
+    #: dropout key reaches the forward pass)
+    dropout_rate: float = 0.0
     #: grouped-query attention (see TransformerConfig.num_kv_heads)
     num_kv_heads: Optional[int] = None
 
@@ -55,6 +58,8 @@ class ViTConfig:
             raise ValueError(f"pool must be 'cls' or 'mean', got {self.pool!r}")
         if self.d_model % self.num_heads:
             raise ValueError("num_heads must divide d_model")
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError("dropout_rate must be in [0, 1)")
         if self.num_kv_heads is not None and (
                 self.num_kv_heads < 1
                 or self.num_heads % self.num_kv_heads):
