@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import attention
-from .transformer import _attn_apply, _layer_norm, _mesh_divides, _mlp_apply
+from .transformer import (_attn_apply, _dropout, _layer_norm,
+                          _mesh_divides, _mlp_apply)
 
 __all__ = ["BertConfig", "init_params", "param_specs", "encode", "pool",
            "mlm_loss", "mask_tokens", "make_mlm_train_step", "shard_params",
@@ -165,10 +166,10 @@ def param_specs(config: BertConfig, model_axis: str = "model",
 
 def encode(params: Dict, tokens: jnp.ndarray,
            segment_ids: Optional[jnp.ndarray] = None,
-           config: BertConfig = None) -> jnp.ndarray:
+           config: BertConfig = None, dropout_key=None) -> jnp.ndarray:
     """Token ids ``(B, T)`` -> contextual hidden states ``(B, T, D)``.
     Padding positions (``pad_token_id``) are excluded from every
-    attention's key set."""
+    attention's key set. ``dropout_key`` activates residual dropout."""
     c = config
     e = params["embed"]
     x = e["tokens"][tokens] + e["pos"][:tokens.shape[1]]
@@ -182,14 +183,20 @@ def encode(params: Dict, tokens: jnp.ndarray,
     def attn_fn(q, k, v):
         return attention(q, k, v, causal=False, mask=pad_mask)
 
-    def layer_apply(layer, x):
-        x = _attn_apply(layer, x, c, attn_fn)
-        return _mlp_apply(layer, x, c)
+    def layer_apply(layer, x, layer_key):
+        if layer_key is not None:
+            ak, mk = jax.random.split(layer_key)
+        else:
+            ak = mk = None
+        x = _attn_apply(layer, x, c, attn_fn, dropout_key=ak)
+        return _mlp_apply(layer, x, c, dropout_key=mk)
 
     if c.remat:
         layer_apply = jax.checkpoint(layer_apply)
     for i in range(c.num_layers):
-        x = layer_apply(params[f"layer_{i}"], x)
+        layer_key = (jax.random.fold_in(dropout_key, i)
+                     if dropout_key is not None else None)
+        x = layer_apply(params[f"layer_{i}"], x, layer_key)
     return x
 
 
@@ -238,13 +245,15 @@ def mask_tokens(tokens: jnp.ndarray, key, config: BertConfig,
 def mlm_loss(params: Dict, masked_tokens: jnp.ndarray,
              positions: jnp.ndarray, labels: jnp.ndarray,
              weights: jnp.ndarray, config: BertConfig,
-             segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+             segment_ids: Optional[jnp.ndarray] = None,
+             dropout_key=None) -> jnp.ndarray:
     """Masked-LM cross-entropy over the selected ``positions`` (labels =
     original tokens at those positions; ``weights`` zero out budget
     padding). Only the masked positions' hidden states reach the vocab
     projection."""
     c = config
-    hidden = encode(params, masked_tokens, segment_ids, c)    # (B, T, D)
+    hidden = encode(params, masked_tokens, segment_ids, c,
+                    dropout_key=dropout_key)                  # (B, T, D)
     picked = jnp.take_along_axis(
         hidden, positions[..., None].astype(jnp.int32), axis=1)  # (B,P,D)
     h = picked.astype(jnp.float32)
@@ -277,12 +286,15 @@ def make_mlm_train_step(config: BertConfig, tx,
     compiled program (fresh masks each step, per the RoBERTa finding)."""
 
     def step(params, opt_state, tokens, key):
-        masked, positions, weights = mask_tokens(tokens, key, config,
+        mask_key, drop_key = jax.random.split(key)
+        masked, positions, weights = mask_tokens(tokens, mask_key, config,
                                                  mask_rate)
         labels = jax.vmap(jnp.take)(tokens, positions)
+        drop_key = drop_key if config.dropout_rate > 0 else None
 
         def loss_fn(p):
-            return mlm_loss(p, masked, positions, labels, weights, config)
+            return mlm_loss(p, masked, positions, labels, weights, config,
+                            dropout_key=drop_key)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import attention
-from .transformer import _attn_apply, _layer_norm, _mlp_apply
+from .transformer import (_attn_apply, _dropout, _layer_norm,
+                          _mlp_apply)
 
 __all__ = ["ViTConfig", "init_params", "param_specs", "forward", "vit_loss",
            "make_train_step", "shard_params"]
@@ -186,12 +187,14 @@ def patchify(images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray:
     return x.reshape(b, (h // p) * (w // p), p * p * ch)
 
 
-def forward(params: Dict, images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray:
+def forward(params: Dict, images: jnp.ndarray, config: ViTConfig,
+            dropout_key=None) -> jnp.ndarray:
     """Images ``(B, H, W, C)`` -> class logits ``(B, num_classes)`` (f32).
 
     Under a mesh, shard images over the data axis and params per
     :func:`param_specs`; GSPMD partitions the same program (non-causal
-    attention has no kernel-side specialization to select)."""
+    attention has no kernel-side specialization to select).
+    ``dropout_key`` activates residual dropout (training only)."""
     c = config
     e = params["embed"]
     x = patchify(images.astype(c.dtype), c)
@@ -202,15 +205,21 @@ def forward(params: Dict, images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray
         x = jnp.concatenate([cls, x], axis=1)
     x = x + e["pos"].astype(c.dtype)
 
-    def layer_apply(layer, x):
+    def layer_apply(layer, x, layer_key):
+        if layer_key is not None:
+            ak, mk = jax.random.split(layer_key)
+        else:
+            ak = mk = None
         x = _attn_apply(layer, x, c, lambda q, k, v: attention(
-            q, k, v, causal=False))
-        return _mlp_apply(layer, x, c)
+            q, k, v, causal=False), dropout_key=ak)
+        return _mlp_apply(layer, x, c, dropout_key=mk)
 
     if c.remat:
         layer_apply = jax.checkpoint(layer_apply)
     for i in range(c.num_layers):
-        x = layer_apply(params[f"layer_{i}"], x)
+        layer_key = (jax.random.fold_in(dropout_key, i)
+                     if dropout_key is not None else None)
+        x = layer_apply(params[f"layer_{i}"], x, layer_key)
 
     pooled = x[:, 0] if c.pool == "cls" else jnp.mean(x, axis=1)
     pooled = _layer_norm(pooled.astype(jnp.float32),
@@ -221,9 +230,9 @@ def forward(params: Dict, images: jnp.ndarray, config: ViTConfig) -> jnp.ndarray
 
 
 def vit_loss(params: Dict, images: jnp.ndarray, labels: jnp.ndarray,
-             config: ViTConfig) -> jnp.ndarray:
+             config: ViTConfig, dropout_key=None) -> jnp.ndarray:
     """Softmax cross-entropy; ``labels`` are int class ids ``(B,)``."""
-    logits = forward(params, images, config)
+    logits = forward(params, images, config, dropout_key=dropout_key)
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
@@ -242,11 +251,17 @@ def make_train_step(config: ViTConfig, tx, mesh: Optional[Mesh] = None,
     and params per :func:`param_specs` (dp gradient all-reduce inserted
     by GSPMD)."""
 
-    def step(params, opt_state, images, labels):
-        loss, grads = jax.value_and_grad(vit_loss)(params, images, labels,
-                                                   config)
+    use_dropout = config.dropout_rate > 0
+
+    def step(params, opt_state, images, labels, dropout_key=None):
+        loss, grads = jax.value_and_grad(vit_loss)(
+            params, images, labels, config,
+            dropout_key=dropout_key if use_dropout else None)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
+    if not use_dropout:
+        return jax.jit(lambda p, o, im, lb: step(p, o, im, lb, None),
+                       donate_argnums=(0, 1))
     return jax.jit(step, donate_argnums=(0, 1))

@@ -165,3 +165,22 @@ def test_bert_classification_finetune(freeze):
             np.testing.assert_array_equal(np.asarray(a), b)
     logits = classify(state["params"], state["head"], tokens, config)
     assert logits.shape == (32, 3)
+
+
+def test_bert_dropout_training_and_deterministic_inference():
+    config = _config(dropout_rate=0.1)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = _tokens(8, 12)
+    a = np.asarray(encode(params, tokens, config=config))
+    b = np.asarray(encode(params, tokens, config=config))
+    np.testing.assert_array_equal(a, b)
+    d = np.asarray(encode(params, tokens, config=config,
+                          dropout_key=jax.random.PRNGKey(1)))
+    assert np.abs(d - a).max() > 1e-6
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    from elephas_tpu.models.bert import make_mlm_train_step
+    step = make_mlm_train_step(config, tx)
+    params, opt, loss = step(params, opt, _tokens(8, 12),
+                             jax.random.PRNGKey(5))
+    assert np.isfinite(float(loss))

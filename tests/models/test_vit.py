@@ -108,3 +108,21 @@ def test_vit_config_validation_and_gqa():
     assert forward(params, jnp.asarray(x), config).shape == (4, 10)
     # specs structure matches params
     jax.tree_util.tree_map(lambda p, s: None, params, param_specs(config))
+
+
+def test_vit_dropout_active_in_training_only():
+    config = _config(dropout_rate=0.2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    x, y = _images(8, config)
+    a = np.asarray(forward(params, jnp.asarray(x), config))
+    b = np.asarray(forward(params, jnp.asarray(x), config))
+    np.testing.assert_array_equal(a, b)  # inference deterministic
+    d = np.asarray(forward(params, jnp.asarray(x), config,
+                           dropout_key=jax.random.PRNGKey(1)))
+    assert np.abs(d - a).max() > 1e-6
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y),
+                             jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
