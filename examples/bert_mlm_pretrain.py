@@ -1,0 +1,62 @@
+"""BERT masked-language-model pretraining + classification fine-tune.
+
+Pretrains a small bidirectional encoder with dynamic 80/10/10 masking on
+byte-tokenized synthetic text, then fine-tunes a classifier head (linear
+probe) — the full BERT recipe end to end on the framework's own
+tokenizer and encoder.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elephas_tpu.models.bert import (BertConfig, init_classifier_head,
+                                     init_params, make_classifier_train_step,
+                                     make_mlm_train_step)
+from elephas_tpu.utils.text import ByteTokenizer
+
+tok = ByteTokenizer()
+config = BertConfig(vocab_size=tok.vocab_size, num_layers=4, num_heads=4,
+                    d_model=128, d_ff=256, max_seq_len=64,
+                    mask_token_id=tok.bos_id,  # reuse a spare special id
+                    pad_token_id=tok.pad_id, max_predictions=12,
+                    dtype=jnp.float32)
+
+sentences = ["the quick brown fox jumps over the lazy dog",
+             "pack my box with five dozen liquor jugs",
+             "how vexingly quick daft zebras jump"]
+rows = tok.encode_batch([s for s in sentences for _ in range(64)],
+                        seq_len=48)
+
+params = init_params(config, jax.random.PRNGKey(0))
+tx = optax.adam(3e-4)
+opt = tx.init(params)
+step = make_mlm_train_step(config, tx)
+
+tokens = jnp.asarray(rows)
+for i in range(30):
+    params, opt, loss = step(params, opt, tokens, jax.random.PRNGKey(i))
+    if (i + 1) % 10 == 0:
+        print(f"mlm step {i + 1}: loss {float(loss):.4f}")
+
+# fine-tune: classify which pangram a (unmasked) row is
+labels = jnp.asarray(np.arange(len(rows)) // 64, dtype=jnp.int32)
+head = init_classifier_head(config, len(sentences), jax.random.PRNGKey(1))
+state = {"params": params, "head": head}
+ft_tx = optax.adam(1e-3)
+ft_opt = ft_tx.init({"head": head})
+ft_step = make_classifier_train_step(config, ft_tx, freeze_encoder=True)
+for i in range(20):
+    state, ft_opt, ft_loss = ft_step(state, ft_opt, tokens, labels)
+print(f"fine-tune loss: {float(ft_loss):.4f}")
+
+from elephas_tpu.models.bert import classify
+
+preds = np.asarray(classify(state["params"], state["head"], tokens,
+                            config)).argmax(1)
+print("probe accuracy:", float((preds == np.asarray(labels)).mean()))

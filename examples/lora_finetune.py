@@ -1,0 +1,57 @@
+"""LoRA fine-tuning of a pretrained transformer LM.
+
+"Pretrains" a base LM on one distribution, then adapts it to a shifted
+distribution touching only rank-4 factors on wq/wv — ~1% of the
+parameters — and exports the merged model for serving.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elephas_tpu.models.lora import (init_lora_params, lora_param_count,
+                                     make_lora_train_step, merge_lora)
+from elephas_tpu.models.transformer import (TransformerConfig, forward,
+                                            init_params, lm_loss,
+                                            make_train_step)
+
+config = TransformerConfig(vocab_size=256, num_layers=2, num_heads=4,
+                           d_model=64, d_ff=128, max_seq_len=32,
+                           positional="rope", dtype=jnp.float32)
+rng = np.random.default_rng(0)
+
+# base task: ascending mod-256 sequences
+base_data = (np.arange(32)[None, :] + rng.integers(0, 256, (128, 1))) % 256
+params = init_params(config, jax.random.PRNGKey(0))
+tx = optax.adam(1e-3)
+opt = tx.init(params)
+step = make_train_step(config, tx)
+for i in range(20):
+    params, opt, loss = step(params, opt, jnp.asarray(base_data))
+print(f"base model loss: {float(loss):.4f}")
+
+# adaptation task: DESCENDING sequences — fine-tune only LoRA factors
+adapt_data = (rng.integers(0, 256, (128, 1)) - np.arange(32)[None, :]) % 256
+lora = init_lora_params(params, config, jax.random.PRNGKey(1), rank=4)
+full = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+print(f"trainable: {lora_param_count(lora)} of {full} params "
+      f"({100 * lora_param_count(lora) / full:.2f}%)")
+
+ltx = optax.adam(5e-3)
+lopt = ltx.init(lora)
+lstep = make_lora_train_step(config, ltx, alpha=8.0)
+before = float(lm_loss(params, jnp.asarray(adapt_data), config))
+for i in range(25):
+    lora, lopt, lloss = lstep(lora, lopt, params, jnp.asarray(adapt_data))
+print(f"adaptation loss: {before:.4f} -> {float(lloss):.4f}")
+
+merged = merge_lora(params, lora, config, alpha=8.0)
+print("merged-model adaptation loss:",
+      round(float(lm_loss(merged, jnp.asarray(adapt_data), config)), 4))
+print("base model unchanged:",
+      round(float(lm_loss(params, jnp.asarray(base_data), config)), 4))
