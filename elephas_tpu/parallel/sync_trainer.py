@@ -247,7 +247,7 @@ class SyncStepTrainer:
 
     def __init__(self, model: BaseModel, optimizer, loss, metrics=None,
                  custom_objects: Optional[Dict] = None, mesh=None,
-                 donate: bool = True):
+                 donate: bool = True, epoch_mode: str = "auto"):
         self.model = model
         self.optimizer = optimizer
         self.tx = optimizer.to_optax()
@@ -261,6 +261,28 @@ class SyncStepTrainer:
         # XLA compile dwarfs the training itself)
         self._epoch_fns: Dict = {}
         self._donate = donate
+        if epoch_mode not in ("auto", "scan", "per_batch"):
+            raise ValueError("epoch_mode must be 'auto', 'scan' or "
+                             f"'per_batch', got {epoch_mode!r}")
+        # XLA pessimizes CONV GRADIENTS inside while-loop (scan) bodies —
+        # forced layouts mean per-iteration transposes, measured ~20-50x
+        # slower than the same step dispatched per batch. 'auto' keeps the
+        # whole-epoch scan (one host round-trip per epoch) for dense
+        # models and switches conv models to a per-batch jitted step.
+        self._epoch_mode = epoch_mode
+        self._step_fns: Dict = {}
+
+    def _resolve_mode(self) -> str:
+        if self._epoch_mode != "auto":
+            return self._epoch_mode
+        from ..models.layers import Conv2D
+
+        try:
+            has_conv = any(isinstance(l, Conv2D)
+                           for l in self.model.layers)
+        except Exception:
+            has_conv = False
+        return "per_batch" if has_conv else "scan"
 
     def _build_epoch_fn(self, nb: int, batch_size: int, shuffle: bool):
         model, tx, loss_fn = self.model, self.tx, self.loss_fn
@@ -310,6 +332,40 @@ class SyncStepTrainer:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(epoch, donate_argnums=donate)
 
+    def _build_step_fn(self):
+        """Single-batch jitted step for ``per_batch`` mode — same math as
+        one scan tick, dispatched per batch (conv-friendly layouts)."""
+        model, tx, loss_fn = self.model, self.tx, self.loss_fn
+        metric_fns = self.metric_fns
+
+        def step(trainable, state, opt_state, key, xb, yb, swb):
+            key, sub = jax.random.split(key)
+
+            def objective(tr):
+                params = model._merge_params(tr, state)
+                preds, updates = model._apply_for_training(params, xb, sub)
+                per = loss_fn(yb, preds)
+                count = jnp.maximum(jnp.sum(swb), 1.0)
+                return jnp.sum(per * swb) / count, (preds, updates, count)
+
+            (lval, (preds, updates, count)), grads = jax.value_and_grad(
+                objective, has_aux=True)(trainable)
+            opt_up, opt_state = tx.update(grads, opt_state, trainable)
+            trainable = optax.apply_updates(trainable, opt_up)
+            new_state = {ln: {**state.get(ln, {}), **lu}
+                         for ln, lu in updates.items()}
+            for ln in state:
+                new_state.setdefault(ln, state[ln])
+            stats = [lval * count, count]
+            stats += [jnp.sum(fn(yb, preds) * swb) for fn in metric_fns]
+            return trainable, new_state, opt_state, key, jnp.stack(stats)
+
+        # NO donation here, deliberately: aliasing outputs into donated
+        # input buffers pins the conv layouts to the inputs' and costs
+        # ~3x per step (measured on resnet8) — the whole reason this
+        # path exists is layout freedom for conv gradients
+        return jax.jit(step)
+
     def fit(self, weights: List[np.ndarray], x: np.ndarray, y: np.ndarray,
             epochs: int, batch_size: int, validation_split: float = 0.0,
             shuffle: bool = True, seed: int = 0, verbose: int = 0,
@@ -348,21 +404,30 @@ class SyncStepTrainer:
 
         sw = np.zeros(n_pad, dtype=np.float32)
         sw[:n] = 1.0
-        # transfer the (padded) epoch data and parameters once
-        x_d = shard_leading(mesh, "data", _pad_to(x, n_pad))
-        y_d = shard_leading(mesh, "data", _pad_to(y, n_pad))
-        sw_d = shard_leading(mesh, "data", sw)
+        mode = self._resolve_mode()
+        x_pad, y_pad = _pad_to(x, n_pad), _pad_to(y, n_pad)
+        if mode == "scan":
+            # transfer the (padded) epoch data and parameters once
+            x_d = shard_leading(mesh, "data", x_pad)
+            y_d = shard_leading(mesh, "data", y_pad)
+            sw_d = shard_leading(mesh, "data", sw)
 
         trainable, state = model._split_params(model.params)
         trainable = replicate(mesh, trainable)
         state = replicate(mesh, state)
         opt_state = jax.jit(self.tx.init)(trainable)
 
-        cache_key = (nb, global_batch, bool(shuffle))
-        epoch_fn = self._epoch_fns.get(cache_key)
-        if epoch_fn is None:
-            epoch_fn = self._build_epoch_fn(nb, global_batch, shuffle)
-            self._epoch_fns[cache_key] = epoch_fn
+        if mode == "scan":
+            cache_key = (nb, global_batch, bool(shuffle))
+            epoch_fn = self._epoch_fns.get(cache_key)
+            if epoch_fn is None:
+                epoch_fn = self._build_epoch_fn(nb, global_batch, shuffle)
+                self._epoch_fns[cache_key] = epoch_fn
+        else:
+            step_fn = self._step_fns.get("step")
+            if step_fn is None:
+                step_fn = self._build_step_fn()
+                self._step_fns["step"] = step_fn
         base_key = jax.random.PRNGKey(seed)
         metric_names = ["loss"] + [metrics_mod.serialize(fn)
                                    for fn in self.metric_fns]
@@ -373,8 +438,29 @@ class SyncStepTrainer:
         for epoch_idx in range(int(epochs)):
             key = jax.random.fold_in(base_key, epoch_idx)
             timer.start()
-            trainable, state, opt_state, stats = epoch_fn(
-                trainable, state, opt_state, key, x_d, y_d, sw_d)
+            if mode == "scan":
+                trainable, state, opt_state, stats = epoch_fn(
+                    trainable, state, opt_state, key, x_d, y_d, sw_d)
+            else:
+                # per-batch dispatch: conv-model path (conv grads inside
+                # a scan get pessimized layouts); shuffle on host, one
+                # sharded transfer + one jitted step per batch
+                perm = (np.random.default_rng(
+                    np.asarray(jax.random.key_data(key))[-1]).permutation(
+                        n_pad) if shuffle else np.arange(n_pad))
+                batch_stats = []
+                for b in range(nb):
+                    sl = perm[b * global_batch:(b + 1) * global_batch]
+                    xb = shard_leading(mesh, "data", x_pad[sl])
+                    yb = shard_leading(mesh, "data", y_pad[sl])
+                    swb = shard_leading(mesh, "data", sw[sl])
+                    trainable, state, opt_state, key, st = step_fn(
+                        trainable, state, opt_state, key, xb, yb, swb)
+                    batch_stats.append(st)
+                totals = jnp.sum(jnp.stack(batch_stats), axis=0)
+                count = jnp.maximum(totals[1], 1.0)
+                stats = jnp.concatenate([totals[0:1] / count,
+                                         totals[2:] / count])
             epoch_stats.append(stats)  # stays on device; fetched at the end
             if timing or verbose or epoch_callback is not None:
                 # one host fetch serves timing, verbose and callbacks — and
