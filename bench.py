@@ -218,10 +218,12 @@ def main():
                                                  loss_vocab_chunk=8192)
         result["transformer"]["chunked_loss_tokens_per_sec"] = round(
             chunk_tps, 1)
+        result["transformer"]["chunked_loss_attention"] = best_attn
         if chunk_tps > result["transformer"]["tokens_per_sec"]:
             result["transformer"]["tokens_per_sec"] = round(chunk_tps, 1)
             result["transformer"]["mfu"] = round(chunk_mfu, 4)
-            result["transformer"]["config"] += " chunked-vocab-loss"
+            result["transformer"]["config"] += (
+                f" {best_attn}-attention chunked-vocab-loss")
     print(json.dumps(result))
 
 
