@@ -112,6 +112,17 @@ class SyncAverageTrainer:
         mesh = worker_mesh(num_workers)
         tx, loss_fn, metric_fns = self.tx, self.loss_fn, self.metric_fns
         epochs = int(epochs)
+        # conv gradients inside scan bodies get pessimized layouts (see
+        # SyncStepTrainer); this path is vmapped over workers so it
+        # cannot dispatch per batch — unroll the batch scan instead when
+        # the model has convs and the unrolled graph stays bounded
+        from ..models.layers import Conv2D
+
+        try:
+            has_conv = any(isinstance(l, Conv2D) for l in model.layers)
+        except Exception:
+            has_conv = False
+        batch_unroll = nb if (has_conv and nb <= 16) else 1
 
         def local_train(params0, x, y, sw, active_w, key):
             trainable0, state0 = model._split_params(params0)
@@ -154,7 +165,8 @@ class SyncAverageTrainer:
                     return (trainable, new_state, opt_state, i + 1), jnp.stack(stats)
 
                 (trainable, state, opt_state, _), stats = jax.lax.scan(
-                    batch_body, (trainable, state, opt_state, 0), (xs, ys, sws))
+                    batch_body, (trainable, state, opt_state, 0),
+                    (xs, ys, sws), unroll=batch_unroll)
                 totals = jnp.sum(stats, axis=0)
                 count = jnp.maximum(totals[1], 1.0)
                 epoch_stats = jnp.concatenate(

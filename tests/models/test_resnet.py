@@ -81,3 +81,26 @@ def test_resnet8_distributed_sync():
     tpu_model.fit(to_dataset(x, y), epochs=1, batch_size=16)
     preds = tpu_model.predict(x[:4])
     np.testing.assert_allclose(preds, model.predict(x[:4]), atol=1e-5)
+
+
+def test_conv_model_through_sync_average_mode():
+    """Conv models train through the sync-average (model averaging)
+    path too — the batch scan unrolls for layout-friendly conv grads."""
+    import numpy as np
+
+    from elephas_tpu.models import SGD
+    from elephas_tpu.models.resnet import build_resnet8
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.0, 1.0, (128, 32, 32, 3)).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.integers(0, 10, 128)]
+    model = build_resnet8()
+    model.compile(SGD(learning_rate=0.05), "categorical_crossentropy",
+                  seed=0)
+    tpu_model = TPUModel(model, mode="synchronous", num_workers=2)
+    tpu_model.fit(to_dataset(x, y), epochs=1, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    histories = [h for h in tpu_model.training_histories if h]
+    assert histories and all(np.isfinite(h["loss"][-1]) for h in histories)
