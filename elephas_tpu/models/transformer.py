@@ -84,6 +84,10 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: tie the LM head to the token embedding (GPT-2 style, the
+    #: default); False gives the head its own (d_model, vocab) matrix —
+    #: common at larger scales where input/output roles diverge
+    tied_embedding: bool = True
     #: label smoothing for the LM cross-entropy: eps mass spreads
     #: uniformly over the vocab (Szegedy et al.; standard for seq2seq /
     #: large-LM training) — 0 disables
@@ -170,6 +174,9 @@ def init_params(config: TransformerConfig, key) -> Dict:
         "final_ln": {"gamma": jnp.ones((c.d_model,), c.param_dtype),
                      "beta": jnp.zeros((c.d_model,), c.param_dtype)},
     }
+    if not c.tied_embedding:
+        params["head"] = dense(jax.random.fold_in(keys[0], 1),
+                               (c.d_model, c.vocab_size), c.d_model)
     for i in range(c.num_layers):
         lk = jax.random.split(keys[2 + i], 7)
         layer = {
@@ -229,6 +236,8 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
         "embed": embed_specs,
         "final_ln": {"gamma": P(None), "beta": P(None)},
     }
+    if not config.tied_embedding:
+        specs["head"] = P(None, model_axis)
     for i in range(config.num_layers):
         layer_specs = {
             "ln1": {"gamma": P(None), "beta": P(None)},
@@ -401,11 +410,15 @@ def embed_apply(embed: Dict, tokens: jnp.ndarray,
     return x.astype(config.dtype)
 
 
-def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray) -> jnp.ndarray:
-    """Final layer norm + tied-embedding head; f32 logits for a stable
-    softmax. Shared by the monolithic forward and the pipelined LM exit."""
+def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray,
+                head: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Final layer norm + LM head (tied to the embedding unless an
+    untied ``head`` matrix is given); f32 logits for a stable softmax.
+    Shared by the monolithic forward and the pipelined LM exit."""
     x = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
                     final_ln["beta"])
+    if head is not None:
+        return x @ head.astype(jnp.float32)
     return x @ embed["tokens"].T.astype(jnp.float32)
 
 
@@ -424,7 +437,8 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
 
 
 def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
-                              tokens: jnp.ndarray, chunk: int
+                              tokens: jnp.ndarray, chunk: int,
+                              head: Optional[jnp.ndarray] = None
                               ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                          jnp.ndarray]:
     """Streamed LM loss pieces from the final hidden states: returns
@@ -439,7 +453,8 @@ def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
     h = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
                     final_ln["beta"])[:, :-1]                # (B, T', D)
     targets = tokens[:, 1:]                                  # (B, T')
-    emb = embed["tokens"].astype(jnp.float32)                # (V, D)
+    emb = (head.T if head is not None
+           else embed["tokens"]).astype(jnp.float32)         # (V, D)
     v, d = emb.shape
     nc = -(-v // chunk)
     pad = nc * chunk - v
@@ -714,7 +729,8 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                                     seq_axis=seq_axis, batch_axis=batch_axis,
                                     model_axis=model_axis,
                                     dropout_key=dropout_key)
-    return head_logits(params["embed"], params["final_ln"], x), aux_total
+    return head_logits(params["embed"], params["final_ln"], x,
+                       head=params.get("head")), aux_total
 
 
 def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
@@ -809,7 +825,8 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
                                   model_axis=model_axis,
                                   dropout_key=dropout_key)
         loss, lse, mean_logits = chunked_next_token_losses(
-            x, params["embed"], params["final_ln"], tokens, int(chunk))
+            x, params["embed"], params["final_ln"], tokens, int(chunk),
+            head=params.get("head"))
         if config.label_smoothing:
             # mean_v logp_v = mean_v logits_v - lse
             eps = config.label_smoothing
@@ -1196,7 +1213,8 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
             x = x + h2[:, 0]
         else:
             x = _mlp_apply(layer, x, c)
-    return head_logits(params["embed"], params["final_ln"], x), new_cache
+    return (head_logits(params["embed"], params["final_ln"], x,
+                        head=params.get("head")), new_cache)
 
 
 def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],

@@ -144,8 +144,11 @@ def split_transformer_stages(params: Dict, config, num_stages: int) -> Dict:
             lambda *xs: jnp.stack(xs),
             *[params[f"layer_{s * per_stage + j}"] for j in range(per_stage)])
         for s in range(num_stages)]
-    return {"embed": params["embed"], "final_ln": params["final_ln"],
-            "stages": stack_stage_params(stages)}
+    out = {"embed": params["embed"], "final_ln": params["final_ln"],
+           "stages": stack_stage_params(stages)}
+    if "head" in params:  # untied LM head rides outside the stage stack
+        out["head"] = params["head"]
+    return out
 
 
 def merge_transformer_stages(pipe_params: Dict, config) -> Dict:
@@ -156,6 +159,8 @@ def merge_transformer_stages(pipe_params: Dict, config) -> Dict:
     per_stage = config.num_layers // num_stages
     params = {"embed": pipe_params["embed"],
               "final_ln": pipe_params["final_ln"]}
+    if "head" in pipe_params:
+        params["head"] = pipe_params["head"]
     for s in range(num_stages):
         for j in range(per_stage):
             params[f"layer_{s * per_stage + j}"] = jax.tree_util.tree_map(
@@ -174,7 +179,7 @@ def shard_pipelined_params(pipe_params: Dict, mesh: Mesh,
             spec = P()
         return jax.device_put(p, NamedSharding(mesh, spec))
 
-    return {
+    out = {
         "embed": jax.tree_util.tree_map(lambda p: put(False, p),
                                         pipe_params["embed"]),
         "final_ln": jax.tree_util.tree_map(lambda p: put(False, p),
@@ -182,6 +187,10 @@ def shard_pipelined_params(pipe_params: Dict, mesh: Mesh,
         "stages": jax.tree_util.tree_map(lambda p: put(True, p),
                                          pipe_params["stages"]),
     }
+    if "head" in pipe_params:
+        out["head"] = jax.tree_util.tree_map(lambda p: put(False, p),
+                                             pipe_params["head"])
+    return out
 
 
 def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
@@ -230,7 +239,8 @@ def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
     def loss(pipe_params, tokens):
         x = embed_apply(pipe_params["embed"], tokens, config)
         x = pipe_fn(pipe_params["stages"], x)
-        logits = head_logits(pipe_params["embed"], pipe_params["final_ln"], x)
+        logits = head_logits(pipe_params["embed"], pipe_params["final_ln"],
+                             x, head=pipe_params.get("head"))
         return next_token_loss(logits, tokens)
 
     return loss
