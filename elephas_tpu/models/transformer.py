@@ -84,6 +84,15 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: MLP variant: ``gelu`` (GPT-2 style, w1/w2) or ``swiglu`` (Llama
+    #: style: SiLU(x@w1) * (x@w3) @ w2 — the gated unit that wins at
+    #: equal parameter count, Shazeer 2020). Dense blocks only; MoE
+    #: experts keep gelu
+    mlp_variant: str = "gelu"
+    #: normalization: ``layernorm`` (mean+variance, learned beta) or
+    #: ``rmsnorm`` (scale-only, no centering — cheaper and the modern
+    #: default, Zhang & Sennrich 2019)
+    norm: str = "layernorm"
     #: tie the LM head to the token embedding (GPT-2 style, the
     #: default); False gives the head its own (d_model, vocab) matrix —
     #: common at larger scales where input/output roles diverge
@@ -130,6 +139,12 @@ class TransformerConfig:
             raise ValueError("dropout_rate must be in [0, 1)")
         if not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError("label_smoothing must be in [0, 1)")
+        if self.mlp_variant not in ("gelu", "swiglu"):
+            raise ValueError("mlp_variant must be 'gelu' or 'swiglu', "
+                             f"got {self.mlp_variant!r}")
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError("norm must be 'layernorm' or 'rmsnorm', "
+                             f"got {self.norm!r}")
         if self.positional not in ("learned", "rope"):
             raise ValueError("positional must be 'learned' or 'rope', "
                              f"got {self.positional!r}")
@@ -207,6 +222,9 @@ def init_params(config: TransformerConfig, key) -> Dict:
                 "w2": dense(lk[5], (c.d_ff, c.d_model), c.d_ff),
                 "b2": jnp.zeros((c.d_model,), c.param_dtype),
             }
+            if c.mlp_variant == "swiglu":
+                layer["mlp"]["w3"] = dense(jax.random.fold_in(lk[4], 1),
+                                           (c.d_model, c.d_ff), c.d_model)
         params[f"layer_{i}"] = layer
     return params
 
@@ -265,6 +283,10 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
             layer_specs["mlp"] = {"w1": P(None, model_axis),
                                   "b1": P(model_axis),
                                   "w2": P(model_axis, None), "b2": P(None)}
+            if config.mlp_variant == "swiglu":
+                # the gate shards its output dim like w1 (elementwise
+                # product stays local to the model shard)
+                layer_specs["mlp"]["w3"] = P(None, model_axis)
         specs[f"layer_{i}"] = layer_specs
     return specs
 
@@ -344,12 +366,24 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return ((x - mean) * jax.lax.rsqrt(var + eps)) * gamma + beta
 
 
+def _rms_norm(x, gamma, eps=1e-5):
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(ms + eps) * gamma
+
+
+def _norm(x, sub: Dict, c) -> jnp.ndarray:
+    """Config-selected normalization (rmsnorm ignores beta)."""
+    if getattr(c, "norm", "layernorm") == "rmsnorm":
+        return _rms_norm(x, sub["gamma"])
+    return _layer_norm(x, sub["gamma"], sub["beta"])
+
+
 def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
                 attn_fn, dropout_key=None) -> jnp.ndarray:
     """Pre-LN attention sublayer with residual; ``attn_fn(q, k, v) -> o``
     supplies the attention implementation. ``dropout_key`` enables
     residual dropout on the sublayer output (training only)."""
-    h = _layer_norm(x, layer["ln1"]["gamma"], layer["ln1"]["beta"])
+    h = _norm(x, layer["ln1"], c)
     h = h.astype(c.dtype)
     q = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wq"].astype(c.dtype))
     k = jnp.einsum("btd,dhk->bhtk", h, layer["attn"]["wk"].astype(c.dtype))
@@ -376,11 +410,16 @@ def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
 
 def _mlp_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
                dropout_key=None) -> jnp.ndarray:
-    """Pre-LN dense MLP sublayer with residual."""
-    h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
+    """Pre-LN dense MLP sublayer with residual (gelu or SwiGLU)."""
+    h = _norm(x, layer["ln2"], c)
     h = h.astype(c.dtype)
-    h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
-                    + layer["mlp"]["b1"].astype(c.dtype))
+    if getattr(c, "mlp_variant", "gelu") == "swiglu":
+        gate = jax.nn.silu(h @ layer["mlp"]["w1"].astype(c.dtype)
+                           + layer["mlp"]["b1"].astype(c.dtype))
+        h = gate * (h @ layer["mlp"]["w3"].astype(c.dtype))
+    else:
+        h = jax.nn.gelu(h @ layer["mlp"]["w1"].astype(c.dtype)
+                        + layer["mlp"]["b1"].astype(c.dtype))
     h = (h @ layer["mlp"]["w2"].astype(c.dtype)
          + layer["mlp"]["b2"].astype(c.dtype))
     return x + _dropout(h, c.dropout_rate, dropout_key)
@@ -411,12 +450,14 @@ def embed_apply(embed: Dict, tokens: jnp.ndarray,
 
 
 def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray,
-                head: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Final layer norm + LM head (tied to the embedding unless an
-    untied ``head`` matrix is given); f32 logits for a stable softmax.
-    Shared by the monolithic forward and the pipelined LM exit."""
-    x = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
-                    final_ln["beta"])
+                head: Optional[jnp.ndarray] = None,
+                norm: str = "layernorm") -> jnp.ndarray:
+    """Final norm + LM head (tied to the embedding unless an untied
+    ``head`` matrix is given); f32 logits for a stable softmax. Shared
+    by the monolithic forward and the pipelined LM exit."""
+    x = x.astype(jnp.float32)
+    x = (_rms_norm(x, final_ln["gamma"]) if norm == "rmsnorm"
+         else _layer_norm(x, final_ln["gamma"], final_ln["beta"]))
     if head is not None:
         return x @ head.astype(jnp.float32)
     return x @ embed["tokens"].T.astype(jnp.float32)
@@ -438,7 +479,8 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
 
 def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
                               tokens: jnp.ndarray, chunk: int,
-                              head: Optional[jnp.ndarray] = None
+                              head: Optional[jnp.ndarray] = None,
+                              norm: str = "layernorm"
                               ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                          jnp.ndarray]:
     """Streamed LM loss pieces from the final hidden states: returns
@@ -450,8 +492,9 @@ def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
     logits live only transiently in both passes, bounding peak HBM at
     ``(B, T, chunk)``.
     """
-    h = _layer_norm(x.astype(jnp.float32), final_ln["gamma"],
-                    final_ln["beta"])[:, :-1]                # (B, T', D)
+    h = x.astype(jnp.float32)
+    h = (_rms_norm(h, final_ln["gamma"]) if norm == "rmsnorm"
+         else _layer_norm(h, final_ln["gamma"], final_ln["beta"]))[:, :-1]
     targets = tokens[:, 1:]                                  # (B, T')
     emb = (head.T if head is not None
            else embed["tokens"]).astype(jnp.float32)         # (V, D)
@@ -730,7 +773,7 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                                     model_axis=model_axis,
                                     dropout_key=dropout_key)
     return head_logits(params["embed"], params["final_ln"], x,
-                       head=params.get("head")), aux_total
+                       head=params.get("head"), norm=config.norm), aux_total
 
 
 def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
@@ -782,7 +825,7 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
             attn_key = mlp_key = None
         x = _attn_apply(layer, x, c, attn_fn, dropout_key=attn_key)
         if c.num_experts > 1:
-            h = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
+            h = _norm(x, layer["ln2"], c)
             h = h.astype(c.dtype)
             if moe_ep:
                 h, aux = _moe_block_routed_ep(h, layer["moe"], c, mesh,
@@ -826,7 +869,7 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
                                   dropout_key=dropout_key)
         loss, lse, mean_logits = chunked_next_token_losses(
             x, params["embed"], params["final_ln"], tokens, int(chunk),
-            head=params.get("head"))
+            head=params.get("head"), norm=config.norm)
         if config.label_smoothing:
             # mean_v logp_v = mean_v logits_v - lse
             eps = config.label_smoothing
@@ -1171,7 +1214,7 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
     new_cache: Dict = {}
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
-        h = _layer_norm(x, layer["ln1"]["gamma"], layer["ln1"]["beta"])
+        h = _norm(x, layer["ln1"], c)
         h = h.astype(c.dtype)
         q = jnp.einsum("bd,dhk->bhk", h, layer["attn"]["wq"].astype(c.dtype))
         k_new = jnp.einsum("bd,dhk->bhk", h,
@@ -1200,7 +1243,7 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
         x = x + jnp.einsum("bhk,hkd->bd", o,
                            layer["attn"]["wo"].astype(c.dtype))
         if c.num_experts > 1:
-            h2 = _layer_norm(x, layer["ln2"]["gamma"], layer["ln2"]["beta"])
+            h2 = _norm(x, layer["ln2"], c)
             h2 = h2.astype(c.dtype)[:, None, :]              # (B, 1, D)
             # always dense top-k gating at decode time: capacity-based
             # dropping is a training-time load-balancing artifact — a
@@ -1214,7 +1257,7 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
         else:
             x = _mlp_apply(layer, x, c)
     return (head_logits(params["embed"], params["final_ln"], x,
-                        head=params.get("head")), new_cache)
+                        head=params.get("head"), norm=c.norm), new_cache)
 
 
 def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],

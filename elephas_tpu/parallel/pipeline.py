@@ -240,7 +240,8 @@ def make_pipelined_lm_loss(config, mesh: Mesh, axis: str = "pipe",
         x = embed_apply(pipe_params["embed"], tokens, config)
         x = pipe_fn(pipe_params["stages"], x)
         logits = head_logits(pipe_params["embed"], pipe_params["final_ln"],
-                             x, head=pipe_params.get("head"))
+                             x, head=pipe_params.get("head"),
+                             norm=config.norm)
         return next_token_loss(logits, tokens)
 
     return loss
