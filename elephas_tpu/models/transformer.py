@@ -84,6 +84,12 @@ class TransformerConfig:
     #: RoPE base frequency (10000 is the RoFormer default; larger bases
     #: extend usable context)
     rope_theta: float = 10000.0
+    #: sliding-window attention (Mistral style): each position attends
+    #: to at most the last ``attention_window`` keys (itself included).
+    #: None = full causal context. Decode keeps an O(window) effective
+    #: read set; the xla attention path applies the band mask (flash /
+    #: ring fall back to xla when a window is set)
+    attention_window: Optional[int] = None
     #: MLP variant: ``gelu`` (GPT-2 style, w1/w2) or ``swiglu`` (Llama
     #: style: SiLU(x@w1) * (x@w3) @ w2 — the gated unit that wins at
     #: equal parameter count, Shazeer 2020). Dense blocks only; MoE
@@ -139,6 +145,8 @@ class TransformerConfig:
             raise ValueError("dropout_rate must be in [0, 1)")
         if not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError("label_smoothing must be in [0, 1)")
+        if self.attention_window is not None and self.attention_window < 1:
+            raise ValueError("attention_window must be >= 1")
         if self.mlp_variant not in ("gelu", "swiglu"):
             raise ValueError("mlp_variant must be 'gelu' or 'swiglu', "
                              f"got {self.mlp_variant!r}")
@@ -316,6 +324,10 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
     reached exclusively through shard_map with divisible batch/head dims.
     """
     c = config
+    if c.attention_window is not None:
+        # band mask lives in the xla path only; a windowed ring/flash
+        # kernel is a future optimization, correctness first
+        return "xla"
     if mesh is not None and seq_axis is not None:
         return "ring"
     backend = backend if backend is not None else jax.default_backend()
@@ -803,6 +815,14 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
                           batch_axis=batch_axis, head_axis=model_axis)
     elif attn_impl == "flash":
         attn_fn = partial(flash_attention, causal=True)
+    elif c.attention_window is not None:
+        w = c.attention_window
+        t = tokens.shape[1]
+        q_pos = jnp.arange(t)[:, None]
+        k_pos = jnp.arange(t)[None, :]
+        band = (k_pos <= q_pos) & (k_pos > q_pos - w)  # (T, T)
+        attn_fn = partial(attention, causal=False,
+                          mask=band[None, None, :, :])
     else:
         attn_fn = partial(attention, causal=True)
 
@@ -1210,7 +1230,11 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
         x = x + params["embed"]["pos"][pos]
     x = x.astype(c.dtype)                                    # (B, D)
     length = next(iter(cache.values()))["k"].shape[2]
-    mask = (jnp.arange(length) <= pos)[None, None, :]        # (1, 1, L)
+    positions = jnp.arange(length)
+    mask = positions <= pos
+    if c.attention_window is not None:
+        mask = mask & (positions > pos - c.attention_window)
+    mask = mask[None, None, :]                               # (1, 1, L)
     new_cache: Dict = {}
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]

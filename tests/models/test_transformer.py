@@ -1429,3 +1429,69 @@ def test_mlp_variant_and_norm_validation():
     # gelu default unchanged: no w3 in params
     params = init_params(_config(), jax.random.PRNGKey(0))
     assert "w3" not in params["layer_0"]["mlp"]
+
+
+def test_sliding_window_attention_semantics_and_decode_parity():
+    import dataclasses
+
+    from elephas_tpu.models.transformer import decode_step, init_kv_cache
+
+    base = _config()
+    params = init_params(base, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 12),
+                                           0, 64))
+
+    # a window covering the whole sequence equals full causal attention
+    wide = dataclasses.replace(base, attention_window=64)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, jnp.asarray(tokens), wide)),
+        np.asarray(forward(params, jnp.asarray(tokens), base)),
+        atol=1e-5, rtol=1e-5)
+
+    # a tight window changes late positions but NOT the first `w`
+    tight = dataclasses.replace(base, attention_window=3)
+    out_t = np.asarray(forward(params, jnp.asarray(tokens), tight))
+    out_f = np.asarray(forward(params, jnp.asarray(tokens), base))
+    np.testing.assert_allclose(out_t[:, :3], out_f[:, :3], atol=1e-5,
+                               rtol=1e-5)
+    assert np.abs(out_t[:, 6:] - out_f[:, 6:]).max() > 1e-5
+
+    # teacher-forced decode must match the windowed forward
+    cache = init_kv_cache(tight, 2, max_len=12)
+    for t in range(12):
+        logits, cache = decode_step(params, cache,
+                                    jnp.asarray(tokens[:, t]), t, tight)
+        np.testing.assert_allclose(np.asarray(logits), out_t[:, t],
+                                   atol=2e-4, rtol=2e-4)
+
+    import pytest
+    with pytest.raises(ValueError):
+        dataclasses.replace(base, attention_window=0)
+
+
+def test_sliding_window_trains_and_generates():
+    import dataclasses
+
+    from elephas_tpu.models.transformer import generate
+
+    config = dataclasses.replace(_config(), attention_window=4,
+                                 positional="rope")
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(8):
+        params, opt, loss = step(params, opt, tokens)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    out = np.asarray(generate(params, tokens[:2, :4], 6, config))
+    assert out.shape == (2, 6)
+    # greedy continuation equals argmax over the windowed forward
+    seq = np.asarray(tokens[:2, :4])
+    for _ in range(6):
+        logits = np.asarray(forward(params, jnp.asarray(seq), config))
+        seq = np.concatenate([seq, logits[:, -1].argmax(-1)[:, None]],
+                             axis=1)
+    np.testing.assert_array_equal(out, seq[:, 4:])
