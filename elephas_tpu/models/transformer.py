@@ -1310,20 +1310,32 @@ def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",
-                                   "config", "sample", "top_k", "top_p"))
+                                   "config", "sample", "top_k", "top_p",
+                                   "use_rep_penalty"))
 def _generate_scan(params, prompt, temperature, key, prompt_len: int,
                    max_new_tokens: int, config: TransformerConfig,
                    sample: bool, top_k: Optional[int] = None,
-                   top_p: Optional[float] = None):
+                   top_p: Optional[float] = None,
+                   repetition_penalty=1.0, use_rep_penalty: bool = False):
     c = config
+    batch = prompt.shape[0]
     total = prompt_len + max_new_tokens
-    cache = init_kv_cache(c, prompt.shape[0], total)
+    cache = init_kv_cache(c, batch, total)
+    seen0 = jnp.zeros((batch, c.vocab_size), bool)
+    if use_rep_penalty:
+        seen0 = seen0.at[jnp.arange(batch)[:, None], prompt].set(True)
 
     def step_fn(carry, t):
-        cache, prev, key = carry
+        cache, prev, key, seen = carry
         tok = jnp.where(t < prompt_len,
                         prompt[:, jnp.minimum(t, prompt_len - 1)], prev)
         logits, cache = decode_step(params, cache, tok, t, c)
+        if use_rep_penalty:
+            # CTRL-style: shrink already-emitted tokens' logits toward
+            # "less likely" on whichever side of zero they sit
+            p = repetition_penalty
+            penalized = jnp.where(logits > 0, logits / p, logits * p)
+            logits = jnp.where(seen, penalized, logits)
         if sample:
             key, sub = jax.random.split(key)
             filtered = _filter_logits(logits, top_k, top_p)
@@ -1331,10 +1343,12 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
                                          axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        return (cache, nxt, key), nxt
+        if use_rep_penalty:
+            seen = seen.at[jnp.arange(batch), nxt].set(True)
+        return (cache, nxt, key, seen), nxt
 
-    (_, _, _), sampled = jax.lax.scan(
-        step_fn, (cache, prompt[:, 0], key), jnp.arange(total - 1))
+    (_, _, _, _), sampled = jax.lax.scan(
+        step_fn, (cache, prompt[:, 0], key, seen0), jnp.arange(total - 1))
     # sampled[t] is the model's token for position t+1: generation starts
     # at position prompt_len, i.e. sampled[prompt_len - 1:]
     return sampled[prompt_len - 1:].T
@@ -1343,7 +1357,8 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
 def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
              config: TransformerConfig, temperature: float = 0.0,
              key=None, top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> jnp.ndarray:
+             top_p: Optional[float] = None,
+             repetition_penalty: float = 1.0) -> jnp.ndarray:
     """Autoregressive generation: ``(batch, prompt_len)`` prompt ids ->
     ``(batch, max_new_tokens)`` sampled continuations.
 
@@ -1355,6 +1370,8 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
     argmax; otherwise categorical sampling at the given temperature
     (``key`` required), optionally filtered to the ``top_k`` most
     probable tokens and/or the ``top_p`` nucleus.
+    ``repetition_penalty > 1`` (CTRL) down-weights tokens already in the
+    prompt or emitted so far.
     """
     c = config
     prompt = jnp.asarray(prompt)
@@ -1369,13 +1386,17 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
         raise ValueError("top_k must be >= 1")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError("top_p must be in (0, 1]")
+    if repetition_penalty < 1.0:
+        raise ValueError("repetition_penalty must be >= 1")
     if key is None:
         key = jax.random.PRNGKey(0)
     return _generate_scan(params, prompt, jnp.float32(temperature), key,
                           prompt_len, int(max_new_tokens), c,
                           temperature > 0,
                           int(top_k) if top_k is not None else None,
-                          float(top_p) if top_p is not None else None)
+                          float(top_p) if top_p is not None else None,
+                          jnp.float32(repetition_penalty),
+                          repetition_penalty != 1.0)
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",

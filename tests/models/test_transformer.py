@@ -1495,3 +1495,30 @@ def test_sliding_window_trains_and_generates():
         seq = np.concatenate([seq, logits[:, -1].argmax(-1)[:, None]],
                              axis=1)
     np.testing.assert_array_equal(out, seq[:, 4:])
+
+
+def test_repetition_penalty_suppresses_repeats():
+    from elephas_tpu.models.transformer import generate
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 4), 0,
+                                config.vocab_size)
+    # penalty=1 must be bit-identical to the plain path
+    plain = np.asarray(generate(params, prompt, 8, config))
+    p1 = np.asarray(generate(params, prompt, 8, config,
+                             repetition_penalty=1.0))
+    np.testing.assert_array_equal(plain, p1)
+
+    # a huge penalty makes greedy avoid anything seen: all continuations
+    # distinct and disjoint from the prompt
+    out = np.asarray(generate(params, prompt, 8, config,
+                              repetition_penalty=1e6))
+    for b in range(3):
+        emitted = list(np.asarray(prompt)[b]) + list(out[b])
+        assert len(set(out[b])) == 8, out[b]
+        assert not (set(out[b]) & set(np.asarray(prompt)[b])), emitted
+
+    import pytest
+    with pytest.raises(ValueError):
+        generate(params, prompt, 4, config, repetition_penalty=0.5)
