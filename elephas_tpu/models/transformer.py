@@ -72,6 +72,11 @@ class TransformerConfig:
     #: activation memory that stays O(1) in depth — the standard TPU
     #: HBM trade for long sequences / deep stacks
     remat: bool = False
+    #: remat granularity: ``full`` recomputes everything in the block;
+    #: ``dots`` saves matmul outputs and recomputes only the cheap
+    #: elementwise work (jax ``dots_saveable`` policy — much less
+    #: recompute for a fraction of full remat's memory win)
+    remat_policy: str = "full"
     #: position encoding: ``learned`` adds a trained (max_seq_len, d)
     #: table at the embedding; ``rope`` rotates q/k per layer (RoFormer)
     #: — relative positions, no length-bound table, the standard choice
@@ -147,6 +152,9 @@ class TransformerConfig:
             raise ValueError("label_smoothing must be in [0, 1)")
         if self.attention_window is not None and self.attention_window < 1:
             raise ValueError("attention_window must be >= 1")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError("remat_policy must be 'full' or 'dots', "
+                             f"got {self.remat_policy!r}")
         if self.mlp_variant not in ("gelu", "swiglu"):
             raise ValueError("mlp_variant must be 'gelu' or 'swiglu', "
                              f"got {self.mlp_variant!r}")
@@ -860,7 +868,9 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
     if c.remat:
         # recompute each block's activations in the backward pass instead
         # of keeping them live: activation memory stays O(1) in depth
-        layer_apply = jax.checkpoint(layer_apply)
+        policy = (jax.checkpoint_policies.dots_saveable
+                  if c.remat_policy == "dots" else None)
+        layer_apply = jax.checkpoint(layer_apply, policy=policy)
 
     for i in range(c.num_layers):
         layer_key = (jax.random.fold_in(dropout_key, i)

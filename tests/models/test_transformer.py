@@ -1522,3 +1522,25 @@ def test_repetition_penalty_suppresses_repeats():
     import pytest
     with pytest.raises(ValueError):
         generate(params, prompt, 4, config, repetition_penalty=0.5)
+
+
+def test_remat_dots_policy_matches_values_and_grads():
+    import dataclasses
+
+    base = _config()
+    params = init_params(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    ref = float(lm_loss(params, tokens, base))
+    g_ref = jax.grad(lm_loss)(params, tokens, base)
+    for policy in ("full", "dots"):
+        cfg = dataclasses.replace(base, remat=True, remat_policy=policy)
+        np.testing.assert_allclose(float(lm_loss(params, tokens, cfg)),
+                                   ref, atol=1e-5, rtol=1e-5)
+        g = jax.grad(lm_loss)(params, tokens, cfg)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+    import pytest
+    with pytest.raises(ValueError):
+        dataclasses.replace(base, remat_policy="everything")
