@@ -169,3 +169,36 @@ def test_model_type_from_loss():
     assert LossModelTypeMapper().get_model_type("mse") == ModelType.REGRESSION
     assert (LossModelTypeMapper().get_model_type("categorical_crossentropy")
             == ModelType.CLASSIFICATION)
+
+
+def test_sequence_model_through_estimator():
+    """An Embedding+LSTM classifier runs through the full Estimator ->
+    Transformer pipeline (model JSON round-trips the recurrent layers;
+    int token features survive the DataFrame adapter)."""
+    import numpy as np
+
+    from elephas_tpu.ml import Estimator, to_data_frame
+    from elephas_tpu.models import (LSTM, Adam, Dense, Embedding,
+                                    Sequential, serialize_optimizer)
+
+    rng = np.random.default_rng(0)
+    n, t, vocab = 512, 10, 16
+    x = rng.integers(0, vocab, size=(n, t)).astype("float64")
+    y_bit = ((x == 1).sum(axis=1) % 2 == 0).astype(float)
+
+    model = Sequential([Embedding(vocab, 8, input_shape=(t,)),
+                        LSTM(16), Dense(2, activation="softmax")])
+    model.build()
+    est = Estimator(
+        model_config=model.to_json(),
+        optimizer_config=serialize_optimizer(Adam(learning_rate=5e-3)),
+        loss="categorical_crossentropy", metrics=["acc"],
+        mode="synchronous", sync_mode="step", categorical=True,
+        nb_classes=2, epochs=6, batch_size=64, validation_split=0.1,
+        num_workers=4, verbose=0, seed=0)
+    fitted = est.fit(to_data_frame(x, y_bit, categorical=False))
+    result = fitted.transform(to_data_frame(x[:256], y_bit[:256],
+                                            categorical=False))
+    acc = float(np.mean([int(np.argmax(p)) == int(label) for p, label
+                         in zip(result["prediction"], result["label"])]))
+    assert acc > 0.7, acc
