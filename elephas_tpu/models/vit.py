@@ -47,6 +47,10 @@ class ViTConfig:
     #: residual dropout on each sublayer output (active only when a
     #: dropout key reaches the forward pass)
     dropout_rate: float = 0.0
+    #: stochastic depth (Huang et al.): drop whole residual blocks per
+    #: sample during training, with the rate scaled linearly from 0 at
+    #: the first block to this value at the last (the ViT/DeiT recipe)
+    drop_path_rate: float = 0.0
     #: grouped-query attention (see TransformerConfig.num_kv_heads)
     num_kv_heads: Optional[int] = None
 
@@ -61,6 +65,8 @@ class ViTConfig:
             raise ValueError("num_heads must divide d_model")
         if not 0.0 <= self.dropout_rate < 1.0:
             raise ValueError("dropout_rate must be in [0, 1)")
+        if not 0.0 <= self.drop_path_rate < 1.0:
+            raise ValueError("drop_path_rate must be in [0, 1)")
         if self.num_kv_heads is not None and (
                 self.num_kv_heads < 1
                 or self.num_heads % self.num_kv_heads):
@@ -205,21 +211,32 @@ def forward(params: Dict, images: jnp.ndarray, config: ViTConfig,
         x = jnp.concatenate([cls, x], axis=1)
     x = x + e["pos"].astype(c.dtype)
 
-    def layer_apply(layer, x, layer_key):
+    def layer_apply(layer, x, layer_key, drop_path):
         if layer_key is not None:
-            ak, mk = jax.random.split(layer_key)
+            ak, mk, pk = jax.random.split(layer_key, 3)
         else:
-            ak = mk = None
-        x = _attn_apply(layer, x, c, lambda q, k, v: attention(
+            ak = mk = pk = None
+        y = _attn_apply(layer, x, c, lambda q, k, v: attention(
             q, k, v, causal=False), dropout_key=ak)
-        return _mlp_apply(layer, x, c, dropout_key=mk)
+        y = _mlp_apply(layer, y, c, dropout_key=mk)
+        if pk is not None and drop_path > 0.0:
+            # stochastic depth: drop this block's whole residual
+            # contribution per sample (inverted scaling keeps the
+            # expected activation unchanged)
+            keep = 1.0 - drop_path
+            mask = jax.random.bernoulli(pk, keep, (x.shape[0], 1, 1))
+            y = x + jnp.where(mask, (y - x) / keep, 0.0)
+        return y
 
     if c.remat:
-        layer_apply = jax.checkpoint(layer_apply)
+        layer_apply = jax.checkpoint(layer_apply,
+                                     static_argnums=(3,))
+    denom = max(c.num_layers - 1, 1)
     for i in range(c.num_layers):
         layer_key = (jax.random.fold_in(dropout_key, i)
                      if dropout_key is not None else None)
-        x = layer_apply(params[f"layer_{i}"], x, layer_key)
+        x = layer_apply(params[f"layer_{i}"], x, layer_key,
+                        c.drop_path_rate * i / denom)
 
     pooled = x[:, 0] if c.pool == "cls" else jnp.mean(x, axis=1)
     pooled = _layer_norm(pooled.astype(jnp.float32),
@@ -251,7 +268,8 @@ def make_train_step(config: ViTConfig, tx, mesh: Optional[Mesh] = None,
     and params per :func:`param_specs` (dp gradient all-reduce inserted
     by GSPMD)."""
 
-    use_dropout = config.dropout_rate > 0
+    use_dropout = (config.dropout_rate > 0
+                   or config.drop_path_rate > 0)
 
     def step(params, opt_state, images, labels, dropout_key=None):
         loss, grads = jax.value_and_grad(vit_loss)(

@@ -126,3 +126,27 @@ def test_vit_dropout_active_in_training_only():
     params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y),
                              jax.random.PRNGKey(2))
     assert np.isfinite(float(loss))
+
+
+def test_vit_stochastic_depth():
+    # inference identical regardless of rate; training path differs and
+    # still trains; first block never drops (rate scales from 0)
+    config = _config(drop_path_rate=0.5, num_layers=3)
+    base = _config(num_layers=3)
+    params = init_params(base, jax.random.PRNGKey(0))
+    x, y = _images(16, base)
+    np.testing.assert_array_equal(
+        np.asarray(forward(params, jnp.asarray(x), config)),
+        np.asarray(forward(params, jnp.asarray(x), base)))
+    d = np.asarray(forward(params, jnp.asarray(x), config,
+                           dropout_key=jax.random.PRNGKey(1)))
+    assert np.abs(d - np.asarray(forward(params, jnp.asarray(x),
+                                         base))).max() > 1e-6
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y),
+                             jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
+    with pytest.raises(ValueError):
+        _config(drop_path_rate=1.0)
