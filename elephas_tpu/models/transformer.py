@@ -414,11 +414,12 @@ def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
         pos = jnp.arange(x.shape[1])
         q = _apply_rope(q, pos, c)
         k = _apply_rope(k, pos, c)
-    if c.kv_heads != c.num_heads:
-        # GQA: broadcast each k/v head over its query group so every
-        # attention path (xla/flash/ring) sees full-width heads. XLA
-        # fuses the repeat into the downstream matmul; the FLOP/memory
-        # savings live in the kv projections above and the decode cache.
+    if (c.kv_heads != c.num_heads
+            and not getattr(attn_fn, "handles_gqa", False)):
+        # GQA: broadcast each k/v head over its query group so the
+        # xla/flash paths see full-width heads (XLA fuses the repeat
+        # into the downstream matmul). GQA-aware paths (the ring, which
+        # circulates narrow k/v buffers over ICI) take kv-width inputs.
         groups = c.num_heads // c.kv_heads
         k = jnp.repeat(k, groups, axis=1)
         v = jnp.repeat(v, groups, axis=1)
@@ -815,6 +816,9 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         attn_fn = partial(ring_attention_sharded, mesh=mesh,
                           seq_axis=seq_axis, causal=True,
                           batch_axis=batch_axis)
+        # the ring folds GQA groups internally and keeps k/v narrow on
+        # the wire — don't pre-broadcast them
+        attn_fn.handles_gqa = True
     elif attn_impl == "flash_sharded":
         # dp/tp meshes hit the Pallas kernel through shard_map (batch
         # pinned to the data axis, heads to the Megatron model axis —

@@ -31,20 +31,29 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = False) -> jnp.ndarray:
     """Attention over a ring; call inside ``shard_map``.
 
-    :param q, k, v: local shards, shape ``(batch, heads, seq_local, head_dim)``
+    :param q: local query shard ``(batch, heads, seq_local, head_dim)``
+    :param k, v: local key/value shards ``(batch, kv_heads, seq_local,
+        head_dim)`` — GQA-aware: with ``kv_heads < heads`` the ring
+        circulates the NARROW k/v buffers (ICI traffic shrinks by the
+        group factor) and each query group attends to its shared head
     :param axis_name: mesh axis carrying the sequence shards
     :param causal: apply a causal mask over *global* positions
     """
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    if h % kvh:
+        raise ValueError(f"kv heads {kvh} must divide query heads {h}")
+    g = h // kvh
+    qg = q.reshape(b, kvh, g, sq, d)
     scale = 1.0 / math.sqrt(d)
     q_pos = my_idx * sq + jnp.arange(sq)[:, None]
 
     def step(i, carry):
         o, l, m, k_cur, v_cur = carry
         kv_idx = (my_idx - i) % axis_size
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        s = jnp.einsum("bngqd,bnkd->bngqk", qg, k_cur) * scale
         if causal:
             k_pos = kv_idx * k_cur.shape[2] + jnp.arange(k_cur.shape[2])[None, :]
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
@@ -52,18 +61,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         p = jnp.exp(s - m_new[..., None])
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1)
-        o_new = o * correction[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        o_new = (o * correction[..., None]
+                 + jnp.einsum("bngqk,bnkd->bngqd", p, v_cur))
         # rotate k/v shards one hop around the ring (ICI neighbor exchange)
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return o_new, l_new, m_new, k_next, v_next
 
-    o0 = jnp.zeros_like(q)
-    l0 = jnp.zeros((b, h, sq), dtype=q.dtype)
-    m0 = jnp.full((b, h, sq), NEG_INF, dtype=q.dtype)
+    o0 = jnp.zeros_like(qg)
+    l0 = jnp.zeros((b, kvh, g, sq), dtype=q.dtype)
+    m0 = jnp.full((b, kvh, g, sq), NEG_INF, dtype=q.dtype)
     o, l, m, _, _ = lax.fori_loop(0, axis_size, step, (o0, l0, m0, k, v))
-    return o / jnp.maximum(l, 1e-20)[..., None]
+    o = o / jnp.maximum(l, 1e-20)[..., None]
+    return o.reshape(b, h, sq, d)
 
 
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -1544,3 +1544,21 @@ def test_remat_dots_policy_matches_values_and_grads():
     import pytest
     with pytest.raises(ValueError):
         dataclasses.replace(base, remat_policy="everything")
+
+
+def test_gqa_ring_sharded_forward_matches_unsharded():
+    """GQA + sequence parallelism: the ring path takes kv-width buffers
+    and the sharded forward matches the single-device one."""
+    config = _gqa_config(2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                config.vocab_size)
+    expected = np.asarray(forward(params, tokens, config))
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "model", "seq"))
+    sp = shard_params(params, config, mesh)
+    td = jax.device_put(tokens, NamedSharding(mesh, P("data", "seq")))
+    got = np.asarray(jax.jit(
+        lambda p, t: forward(p, t, config, mesh=mesh, seq_axis="seq",
+                             batch_axis="data"))(sp, td))
+    np.testing.assert_allclose(expected, got, atol=2e-3)

@@ -50,3 +50,30 @@ def test_ring_with_batch_axis():
     ring = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis="seq",
                                   causal=True, batch_axis="data")
     np.testing.assert_allclose(np.asarray(full), np.asarray(ring), atol=1e-4)
+
+
+def test_ring_attention_gqa_matches_full_attention():
+    """GQA ring (kv-width buffers on the wire) matches grouped full
+    attention computed by head-broadcast."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from elephas_tpu.ops.attention import attention
+    from elephas_tpu.ops.ring_attention import ring_attention_sharded
+
+    b, h, kvh, t, d = 2, 4, 2, 16, 8
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, kvh, t, d))
+    v = jax.random.normal(kv_, (b, kvh, t, d))
+
+    k_full = jnp.repeat(k, h // kvh, axis=1)
+    v_full = jnp.repeat(v, h // kvh, axis=1)
+    expected = np.asarray(attention(q, k_full, v_full, causal=True))
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    got = np.asarray(ring_attention_sharded(q, k, v, mesh=mesh,
+                                            seq_axis="seq", causal=True))
+    np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
