@@ -150,3 +150,21 @@ def test_vit_stochastic_depth():
     assert np.isfinite(float(loss))
     with pytest.raises(ValueError):
         _config(drop_path_rate=1.0)
+
+
+def test_vit_remat_with_drop_path_and_dropout():
+    config = _config(remat=True, drop_path_rate=0.3, dropout_rate=0.1,
+                     num_layers=3)
+    params = init_params(config, jax.random.PRNGKey(0))
+    x, y = _images(16, config)
+    # remat must not change inference values
+    base = _config(num_layers=3)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, jnp.asarray(x), config)),
+        np.asarray(forward(params, jnp.asarray(x), base)), atol=1e-6)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    params, opt, loss = step(params, opt, jnp.asarray(x), jnp.asarray(y),
+                             jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
