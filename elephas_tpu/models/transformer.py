@@ -67,6 +67,10 @@ class TransformerConfig:
     #: routed-dispatch expert capacity = ``ceil(capacity_factor * top_k *
     #: tokens / num_experts)`` — 1.0 is exact-balance, >1 gives headroom
     moe_capacity_factor: float = 1.25
+    #: DeepSeek-MoE style shared expert: one always-on dense MLP whose
+    #: output adds to the routed combine — captures common knowledge so
+    #: the routed experts can specialize; replicated like a dense MLP
+    moe_shared_expert: bool = False
     #: rematerialize each block's activations in the backward pass
     #: (``jax.checkpoint`` per layer): trades ~1/3 more FLOPs for
     #: activation memory that stays O(1) in depth — the standard TPU
@@ -231,6 +235,14 @@ def init_params(config: TransformerConfig, key) -> Dict:
                 "w2": dense(lk[5], (c.num_experts, c.d_ff, c.d_model), c.d_ff),
                 "b2": jnp.zeros((c.num_experts, c.d_model), c.param_dtype),
             }
+            if c.moe_shared_expert:
+                sk = jax.random.split(lk[6], 3)
+                layer["moe"]["shared"] = {
+                    "w1": dense(sk[1], (c.d_model, c.d_ff), c.d_model),
+                    "b1": jnp.zeros((c.d_ff,), c.param_dtype),
+                    "w2": dense(sk[2], (c.d_ff, c.d_model), c.d_ff),
+                    "b2": jnp.zeros((c.d_model,), c.param_dtype),
+                }
         else:
             layer["mlp"] = {
                 "w1": dense(lk[4], (c.d_model, c.d_ff), c.d_model),
@@ -295,6 +307,11 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
                 "w2": P(model_axis, None, None),
                 "b2": P(model_axis, None),
             }
+            if config.moe_shared_expert:
+                # the shared expert shards like a dense Megatron MLP
+                layer_specs["moe"]["shared"] = {
+                    "w1": P(None, model_axis), "b1": P(model_axis),
+                    "w2": P(model_axis, None), "b2": P(None)}
         else:
             layer_specs["mlp"] = {"w1": P(None, model_axis),
                                   "b1": P(model_axis),
@@ -637,6 +654,16 @@ def _moe_block(h, moe, config: "TransformerConfig",
     return jnp.einsum("betd,bte->btd", out, gates), aux
 
 
+def _shared_expert(h: jnp.ndarray, shared: Dict,
+                   c: "TransformerConfig") -> jnp.ndarray:
+    """Always-on dense MLP added to the MoE combine (gelu, like the
+    experts)."""
+    g = jax.nn.gelu(h @ shared["w1"].astype(c.dtype)
+                    + shared["b1"].astype(c.dtype))
+    return (g @ shared["w2"].astype(c.dtype)
+            + shared["b2"].astype(c.dtype))
+
+
 def _routed_capacity(config: "TransformerConfig", n_tokens: int) -> int:
     c = int(np.ceil(config.moe_capacity_factor * config.expert_top_k
                     * n_tokens / config.num_experts))
@@ -860,12 +887,14 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
             h = _norm(x, layer["ln2"], c)
             h = h.astype(c.dtype)
             if moe_ep:
-                h, aux = _moe_block_routed_ep(h, layer["moe"], c, mesh,
-                                              batch_axis, model_axis)
+                out, aux = _moe_block_routed_ep(h, layer["moe"], c, mesh,
+                                                batch_axis, model_axis)
             else:
-                h, aux = _moe_block(h, layer["moe"], c,
-                                    dispatch=moe_dispatch)
-            return x + _dropout(h, c.dropout_rate, mlp_key), aux
+                out, aux = _moe_block(h, layer["moe"], c,
+                                      dispatch=moe_dispatch)
+            if c.moe_shared_expert:
+                out = out + _shared_expert(h, layer["moe"]["shared"], c)
+            return x + _dropout(out, c.dropout_rate, mlp_key), aux
         return (_mlp_apply(layer, x, c, dropout_key=mlp_key),
                 jnp.zeros((), jnp.float32))
 
@@ -1290,8 +1319,11 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
             # gating equals routed-without-drops, so teacher-forced
             # parity with `forward` is exact whenever forward dropped
             # nothing (and strictly better-behaved when it did).
-            h2, _ = _moe_block(h2, layer["moe"], c, dispatch="dense")
-            x = x + h2[:, 0]
+            h2_out, _ = _moe_block(h2, layer["moe"], c, dispatch="dense")
+            if c.moe_shared_expert:
+                h2_out = h2_out + _shared_expert(h2, layer["moe"]["shared"],
+                                                 c)
+            x = x + h2_out[:, 0]
         else:
             x = _mlp_apply(layer, x, c)
     return (head_logits(params["embed"], params["final_ln"], x,

@@ -1562,3 +1562,57 @@ def test_gqa_ring_sharded_forward_matches_unsharded():
         lambda p, t: forward(p, t, config, mesh=mesh, seq_axis="seq",
                              batch_axis="data"))(sp, td))
     np.testing.assert_allclose(expected, got, atol=2e-3)
+
+
+def test_moe_shared_expert():
+    """DeepSeek-style shared expert: adds an always-on dense path to the
+    MoE combine, consistent across dense/routed dispatch and decode."""
+    import dataclasses
+
+    from elephas_tpu.models.transformer import decode_step, init_kv_cache
+
+    config = _moe_config(num_experts=4, expert_top_k=2)
+    shared_cfg = dataclasses.replace(config, moe_shared_expert=True)
+    params = init_params(shared_cfg, jax.random.PRNGKey(0))
+    assert "shared" in params["layer_0"]["moe"]
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 8),
+                                           0, shared_cfg.vocab_size))
+
+    # the shared path participates: zeroing it changes the output
+    full = np.asarray(forward(params, jnp.asarray(tokens), shared_cfg))
+    import copy
+
+    zeroed = copy.deepcopy(jax.device_get(params))
+    for i in range(shared_cfg.num_layers):
+        sh = zeroed[f"layer_{i}"]["moe"]["shared"]
+        sh["w2"] = np.zeros_like(sh["w2"])
+    out_z = np.asarray(forward(jax.tree_util.tree_map(jnp.asarray, zeroed),
+                               jnp.asarray(tokens), shared_cfg))
+    assert np.abs(full - out_z).max() > 1e-6
+
+    # decode parity with forward
+    cache = init_kv_cache(shared_cfg, 2, max_len=8)
+    for t in range(8):
+        logits, cache = decode_step(params, cache,
+                                    jnp.asarray(tokens[:, t]), t,
+                                    shared_cfg)
+        np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                   atol=2e-4, rtol=2e-4)
+
+    # trains; shared expert receives gradient
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_train_step(shared_cfg, tx)
+    jt = jnp.asarray(np.tile(tokens, (2, 1)))
+    first = None
+    for _ in range(6):
+        params, opt, loss = step(params, opt, jt)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    g = jax.grad(lm_loss)(params, jt, shared_cfg)
+    assert np.abs(np.asarray(
+        g["layer_0"]["moe"]["shared"]["w1"])).sum() > 0
+
+    # specs structure matches params
+    jax.tree_util.tree_map(lambda p, s: None, params,
+                           param_specs(shared_cfg))
