@@ -360,7 +360,8 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
         if (c.attention_impl != "xla"
                 and (c.attention_impl == "flash" or backend == "tpu")
                 and _mesh_divides(mesh, batch_axis, batch)
-                and _mesh_divides(mesh, model_axis, c.num_heads)):
+                and _mesh_divides(mesh, model_axis, c.num_heads)
+                and _mesh_divides(mesh, model_axis, c.kv_heads)):
             return "flash_sharded"
         return "xla"
     n_devices = (n_devices if n_devices is not None
@@ -852,8 +853,12 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         # attention needs no cross-device communication)
         attn_fn = partial(flash_attention_sharded, mesh=mesh, causal=True,
                           batch_axis=batch_axis, head_axis=model_axis)
+        # the kernel resolves GQA via its kv-row index maps — narrow k/v
+        # all the way into VMEM, no head-broadcast materialization
+        attn_fn.handles_gqa = True
     elif attn_impl == "flash":
         attn_fn = partial(flash_attention, causal=True)
+        attn_fn.handles_gqa = True
     elif c.attention_window is not None:
         w = c.attention_window
         t = tokens.shape[1]

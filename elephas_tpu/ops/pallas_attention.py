@@ -105,6 +105,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    grp = h // kvh
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
@@ -112,9 +114,13 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     qr = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))).reshape(
         b * h, sq_p, d)
     kr = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))).reshape(
-        b * h, sk_p, d)
+        b * kvh, sk_p, d)
     vr = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))).reshape(
-        b * h, sk_p, d)
+        b * kvh, sk_p, d)
+
+    def kv_row(bh):
+        # GQA: query row bh = bi*h + hi reads kv row bi*kvh + hi//grp
+        return (bh // h) * kvh + (bh % h) // grp
 
     grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(_fwd_kernel, sq=sq, sk=sk, block_q=block_q,
@@ -124,8 +130,10 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
@@ -188,12 +196,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, sq: int, sk: int,
-                block_q: int, block_k: int, causal: bool, scale: float):
+                block_q: int, block_k: int, causal: bool, scale: float,
+                nq_blocks: int):
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)
+    # the trailing grid axis enumerates (group member, q block): every
+    # query head sharing this kv head accumulates into the same dk/dv
+    qi = t % nq_blocks
+    nq = nq_blocks
+    total = pl.num_programs(2)
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -224,7 +237,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == total - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -233,6 +246,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
     q, k, v, o, lse = residuals
     b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    grp = h // kvh
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
@@ -240,8 +255,9 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     def prep(x, s_pad):
+        rows = x.shape[0] * x.shape[1]
         return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - x.shape[2]),
-                           (0, 0))).reshape(b * h, s_pad, x.shape[3])
+                           (0, 0))).reshape(rows, s_pad, x.shape[3])
 
     qr, dor = prep(q, sq_p), prep(g, sq_p)
     kr, vr = prep(k, sk_p), prep(v, sk_p)
@@ -255,8 +271,12 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     common = dict(sq=sq, sk=sk, block_q=block_q, block_k=block_k,
                   causal=causal, scale=scale)
 
+    def kv_row(bh):
+        return (bh // h) * kvh + (bh % h) // grp
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0))
+    k_spec = pl.BlockSpec((1, block_k, d),
+                          lambda bh, qi, kj: (kv_row(bh), kj, 0))
     row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0))
 
     dq = pl.pallas_call(
@@ -271,19 +291,29 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
         interpret=interp,
     )(qr, kr, vr, dor, lser, deltar)[0]
 
-    # kv-major grid: swap the roles of the two trailing grid axes
-    q_spec_t = pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0))
-    k_spec_t = pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0))
-    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0))
+    # kv-major grid over the NARROW kv rows; the trailing axis walks
+    # (group member, q block) so all grp query heads sharing a kv head
+    # accumulate into its dk/dv block
+    nq = sq_p // block_q
+
+    def q_row(bkv, t):
+        return (bkv // kvh) * h + (bkv % kvh) * grp + t // nq
+
+    q_spec_t = pl.BlockSpec((1, block_q, d),
+                            lambda bkv, kj, t: (q_row(bkv, t), t % nq, 0))
+    k_spec_t = pl.BlockSpec((1, block_k, d),
+                            lambda bkv, kj, t: (bkv, kj, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1),
+                              lambda bkv, kj, t: (q_row(bkv, t), t % nq, 0))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common),
-        grid=(b * h, sk_p // block_k, sq_p // block_q),
+        functools.partial(_dkv_kernel, nq_blocks=nq, **common),
+        grid=(b * kvh, sk_p // block_k, grp * nq),
         in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[k_spec_t, k_spec_t],
-        out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b * kvh, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * kvh, sk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -292,8 +322,8 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
     )(qr, kr, vr, dor, lser, deltar)
 
     return (dq[:, :sq].reshape(b, h, sq, d),
-            dk[:, :sk].reshape(b, h, sk, d),
-            dv[:, :sk].reshape(b, h, sk, d))
+            dk[:, :sk].reshape(b, kvh, sk, d),
+            dv[:, :sk].reshape(b, kvh, sk, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -328,6 +358,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if q.ndim != 4:
         raise ValueError(f"expected (batch, heads, seq, head_dim), got "
                          f"{q.shape}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"kv heads {k.shape[1]} must divide query heads {q.shape[1]} "
+            "(GQA)")
     # clamp blocks for short sequences, rounding to 32 rows — a multiple of
     # every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
     block_q = min(block_q, _round_up(q.shape[2], 32))
