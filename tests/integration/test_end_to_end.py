@@ -155,3 +155,51 @@ def test_sync_average_scalar_labels_learn(housing_data, regression_model):
                   validation_split=0.0)
     after = regression_model.evaluate(x_train, y_train)
     assert after < before * 0.9
+
+
+def test_async_worker_crash_propagates_and_frees_the_port(
+        classification_model, mnist_data, monkeypatch):
+    """A worker dying mid-fit must surface its exception (not hang the
+    pool) and still tear the parameter server down, leaving the port
+    reusable — the failure-detection contract the reference lacks."""
+    import pytest
+
+    import elephas_tpu.tpu_model as tm
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    x_train, y_train, _, _ = mnist_data
+    classification_model.compile("sgd", "categorical_crossentropy",
+                                 seed=0)
+    port = _generate_port_number()
+
+    class Boom(RuntimeError):
+        pass
+
+    real_worker = tm.AsyncWorker
+    calls = {"n": 0}
+
+    def exploding_worker(*args, **kwargs):
+        worker = real_worker(*args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 2:  # second worker dies immediately
+            def bad_train(x, y):
+                raise Boom("worker died")
+            worker.train = bad_train
+        return worker
+
+    monkeypatch.setattr(tm, "AsyncWorker", exploding_worker)
+    model = tm.TPUModel(classification_model, mode="asynchronous",
+                        num_workers=3, batch_size=32, port=port,
+                        parameter_server_mode="http")
+    with pytest.raises(Boom):
+        model.fit(to_dataset(x_train[:256], y_train[:256]), epochs=1,
+                  batch_size=32, validation_split=0.0)
+
+    # the server must be down and the port free: a clean fit on the SAME
+    # port succeeds end to end
+    monkeypatch.setattr(tm, "AsyncWorker", real_worker)
+    model2 = tm.TPUModel(classification_model, mode="asynchronous",
+                         num_workers=2, batch_size=32, port=port,
+                         parameter_server_mode="http")
+    model2.fit(to_dataset(x_train[:256], y_train[:256]), epochs=1,
+               batch_size=32, validation_split=0.0)
