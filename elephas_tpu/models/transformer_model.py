@@ -415,6 +415,20 @@ class TransformerModel:
                                     temperature=temperature, key=key,
                                     top_k=top_k, top_p=top_p))
 
+    def beam_search(self, prompt: np.ndarray, max_new_tokens: int,
+                    num_beams: int = 4, length_penalty: float = 0.0,
+                    eos_id: Optional[int] = None):
+        """Beam-search continuations ``(batch, num_beams, max_new_tokens)``
+        with per-beam scores, best first."""
+        from .transformer import beam_search as _beam_search
+
+        seqs, scores = _beam_search(self.params, np.asarray(prompt),
+                                    int(max_new_tokens), self.config,
+                                    num_beams=num_beams,
+                                    length_penalty=length_penalty,
+                                    eos_id=eos_id)
+        return np.asarray(seqs), np.asarray(scores)
+
     def evaluate(self, tokens: np.ndarray, y=None, batch_size: int = 8,
                  verbose: int = 0) -> float:
         """Mean next-token loss over the rows (batch-weighted)."""

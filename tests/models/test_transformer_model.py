@@ -282,3 +282,14 @@ def test_llama_style_config_through_tpu_model_with_resume(tmp_path):
     assert np.isfinite(tpu_clone.training_histories[-1]["loss"][-1])
     restored = [np.asarray(w) for w in clone.get_weights()]
     assert len(restored) == len(w_after)
+
+
+def test_beam_search_through_model_surface():
+    model = _model()
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    prompt = np.asarray(_tokens(3))[:, :5]
+    seqs, scores = model.beam_search(prompt, 6, num_beams=3)
+    assert seqs.shape == (3, 3, 6) and scores.shape == (3, 3)
+    assert (np.diff(scores, axis=1) <= 1e-5).all()  # best first
+    one, _ = model.beam_search(prompt, 6, num_beams=1)
+    np.testing.assert_array_equal(one[:, 0], model.generate(prompt, 6))
