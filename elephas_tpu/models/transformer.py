@@ -503,23 +503,38 @@ def head_logits(embed: Dict, final_ln: Dict, x: jnp.ndarray,
 
 
 def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
-                    label_smoothing: float = 0.0) -> jnp.ndarray:
-    """Next-token cross-entropy, mean over all positions; with label
-    smoothing, eps probability mass spreads uniformly over the vocab."""
+                    label_smoothing: float = 0.0,
+                    weights: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over all positions (or a
+    ``weights``-weighted mean — packed training zeroes cross-document
+    and padding targets); with label smoothing, eps probability mass
+    spreads uniformly over the vocab."""
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    ce = -jnp.mean(picked)
+    ce_pos = -picked
     if label_smoothing:
         eps = label_smoothing
-        ce = (1.0 - eps) * ce - eps * jnp.mean(jnp.mean(logp, axis=-1))
-    return ce
+        ce_pos = (1.0 - eps) * ce_pos - eps * jnp.mean(logp, axis=-1)
+    if weights is None:
+        return jnp.mean(ce_pos)
+    w = weights.astype(ce_pos.dtype)
+    return jnp.sum(ce_pos * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def segment_target_weights(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-target weights for packed rows ``(B, T) -> (B, T-1)``: target
+    t+1 counts only when positions t and t+1 belong to the same non-pad
+    (id > 0) segment."""
+    a, b = segment_ids[:, :-1], segment_ids[:, 1:]
+    return ((a == b) & (b > 0)).astype(jnp.float32)
 
 
 def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
                               tokens: jnp.ndarray, chunk: int,
                               head: Optional[jnp.ndarray] = None,
-                              norm: str = "layernorm"
+                              norm: str = "layernorm",
+                              weights: Optional[jnp.ndarray] = None
                               ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                          jnp.ndarray]:
     """Streamed LM loss pieces from the final hidden states: returns
@@ -563,7 +578,13 @@ def chunked_next_token_losses(x: jnp.ndarray, embed: Dict, final_ln: Dict,
     lse = m + jnp.log(s)                                     # (B, T')
     # target logit via a row gather — (B, T', D) transient, not (B,T',V)
     picked = jnp.sum(h * emb[targets], axis=-1)
-    return jnp.mean(lse - picked), lse, tot / v
+    ce_pos = lse - picked
+    if weights is not None:
+        w = weights.astype(ce_pos.dtype)
+        ce = jnp.sum(ce_pos * w) / jnp.maximum(jnp.sum(w), 1.0)
+    else:
+        ce = jnp.mean(ce_pos)
+    return ce, lse, tot / v
 
 
 def select_moe_dispatch(config: "TransformerConfig",
@@ -792,7 +813,8 @@ def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
             batch_axis: Optional[str] = None,
             model_axis: Optional[str] = None,
-            dropout_key=None) -> jnp.ndarray:
+            dropout_key=None,
+            segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Token ids ``(batch, seq)`` -> logits ``(batch, seq, vocab)``.
 
     When ``mesh`` and ``seq_axis`` are given, attention runs as ring
@@ -803,7 +825,8 @@ def forward(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     logits, _ = forward_with_aux(params, tokens, config, mesh=mesh,
                                  seq_axis=seq_axis, batch_axis=batch_axis,
                                  model_axis=model_axis,
-                                 dropout_key=dropout_key)
+                                 dropout_key=dropout_key,
+                                 segment_ids=segment_ids)
     return logits
 
 
@@ -813,14 +836,16 @@ def forward_with_aux(params: Dict, tokens: jnp.ndarray,
                      seq_axis: Optional[str] = None,
                      batch_axis: Optional[str] = None,
                      model_axis: Optional[str] = None,
-                     dropout_key=None) -> Tuple[jnp.ndarray,
-                                                jnp.ndarray]:
+                     dropout_key=None,
+                     segment_ids: Optional[jnp.ndarray] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Like :func:`forward` but also returns the summed MoE auxiliary
     (load-balancing) loss — 0.0 for dense configs."""
     x, aux_total = _hidden_with_aux(params, tokens, config, mesh=mesh,
                                     seq_axis=seq_axis, batch_axis=batch_axis,
                                     model_axis=model_axis,
-                                    dropout_key=dropout_key)
+                                    dropout_key=dropout_key,
+                                    segment_ids=segment_ids)
     return head_logits(params["embed"], params["final_ln"], x,
                        head=params.get("head"), norm=config.norm), aux_total
 
@@ -831,15 +856,20 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
                      seq_axis: Optional[str] = None,
                      batch_axis: Optional[str] = None,
                      model_axis: Optional[str] = None,
-                     dropout_key=None) -> Tuple[jnp.ndarray,
-                                                jnp.ndarray]:
+                     dropout_key=None,
+                     segment_ids: Optional[jnp.ndarray] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The block stack up to (but excluding) the LM head: final hidden
-    states ``(B, T, D)`` + summed MoE aux loss."""
+    states ``(B, T, D)`` + summed MoE aux loss. ``segment_ids`` (packed
+    rows, ids > 0, 0 = padding) isolate documents: attention stays
+    within a segment (causal AND same-segment; forces the xla path)."""
     c = config
     x = embed_apply(params["embed"], tokens, c)
     aux_total = jnp.zeros((), jnp.float32)
     attn_impl = select_attention_impl(c, mesh, seq_axis, batch_axis,
                                       model_axis, tokens.shape[0])
+    if segment_ids is not None:
+        attn_impl = "xla"  # the segment mask lives in the xla path only
     if attn_impl == "ring":
         attn_fn = partial(ring_attention_sharded, mesh=mesh,
                           seq_axis=seq_axis, causal=True,
@@ -859,14 +889,18 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
     elif attn_impl == "flash":
         attn_fn = partial(flash_attention, causal=True)
         attn_fn.handles_gqa = True
-    elif c.attention_window is not None:
-        w = c.attention_window
+    elif segment_ids is not None or c.attention_window is not None:
         t = tokens.shape[1]
         q_pos = jnp.arange(t)[:, None]
         k_pos = jnp.arange(t)[None, :]
-        band = (k_pos <= q_pos) & (k_pos > q_pos - w)  # (T, T)
-        attn_fn = partial(attention, causal=False,
-                          mask=band[None, None, :, :])
+        mask = (k_pos <= q_pos)[None, None, :, :]      # (1, 1, T, T)
+        if c.attention_window is not None:
+            mask = mask & (k_pos > q_pos - c.attention_window)[None, None]
+        if segment_ids is not None:
+            same = (segment_ids[:, None, :, None]
+                    == segment_ids[:, None, None, :])  # (B, 1, T, T)
+            mask = mask & same & (segment_ids > 0)[:, None, None, :]
+        attn_fn = partial(attention, causal=False, mask=mask)
     else:
         attn_fn = partial(attention, causal=True)
 
@@ -923,44 +957,67 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
             mesh: Optional[Mesh] = None, seq_axis: Optional[str] = None,
             batch_axis: Optional[str] = None,
             model_axis: Optional[str] = None,
-            dropout_key=None) -> jnp.ndarray:
+            dropout_key=None,
+            segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Next-token cross-entropy (mean over all positions), plus the
     weighted MoE load-balancing auxiliary loss for MoE configs."""
     # the chunked (streamed-logsumexp) loss applies when the embedding is
     # not vocab-sharded: a tp mesh already spreads the logits over the
     # model axis, and chunk-slicing a sharded vocab would fight GSPMD
+    weights = (segment_target_weights(segment_ids)
+               if segment_ids is not None else None)
     chunk = config.loss_vocab_chunk
     if chunk and (mesh is None or model_axis is None):
         x, aux = _hidden_with_aux(params, tokens, config, mesh=mesh,
                                   seq_axis=seq_axis, batch_axis=batch_axis,
                                   model_axis=model_axis,
-                                  dropout_key=dropout_key)
+                                  dropout_key=dropout_key,
+                                  segment_ids=segment_ids)
         loss, lse, mean_logits = chunked_next_token_losses(
             x, params["embed"], params["final_ln"], tokens, int(chunk),
-            head=params.get("head"), norm=config.norm)
+            head=params.get("head"), norm=config.norm, weights=weights)
         if config.label_smoothing:
             # mean_v logp_v = mean_v logits_v - lse
             eps = config.label_smoothing
-            loss = ((1.0 - eps) * loss
-                    + eps * jnp.mean(lse - mean_logits))
+            smooth = lse - mean_logits
+            if weights is not None:
+                smooth_mean = (jnp.sum(smooth * weights)
+                               / jnp.maximum(jnp.sum(weights), 1.0))
+            else:
+                smooth_mean = jnp.mean(smooth)
+            loss = (1.0 - eps) * loss + eps * smooth_mean
         if config.num_experts > 1 and config.moe_aux_weight:
             loss = loss + config.moe_aux_weight * aux
         if config.z_loss_weight:
-            loss = loss + config.z_loss_weight * jnp.mean(lse * lse)
+            z2 = lse * lse
+            if weights is not None:
+                z_mean = (jnp.sum(z2 * weights)
+                          / jnp.maximum(jnp.sum(weights), 1.0))
+            else:
+                z_mean = jnp.mean(z2)
+            loss = loss + config.z_loss_weight * z_mean
         return loss
     logits, aux = forward_with_aux(params, tokens, config, mesh=mesh,
                                    seq_axis=seq_axis, batch_axis=batch_axis,
                                    model_axis=model_axis,
-                                   dropout_key=dropout_key)
+                                   dropout_key=dropout_key,
+                                   segment_ids=segment_ids)
     loss = next_token_loss(logits, tokens,
-                           label_smoothing=config.label_smoothing)
+                           label_smoothing=config.label_smoothing,
+                           weights=weights)
     if config.num_experts > 1 and config.moe_aux_weight:
         loss = loss + config.moe_aux_weight * aux
     if config.z_loss_weight:
         # PaLM-style z-loss: penalize the log-partition so logits don't
         # drift large (bf16 stability); only predicting positions count
         z = jax.scipy.special.logsumexp(logits[:, :-1], axis=-1)
-        loss = loss + config.z_loss_weight * jnp.mean(z * z)
+        z2 = z * z
+        if weights is not None:
+            z_mean = (jnp.sum(z2 * weights)
+                      / jnp.maximum(jnp.sum(weights), 1.0))
+        else:
+            z_mean = jnp.mean(z2)
+        loss = loss + config.z_loss_weight * z_mean
     return loss
 
 
