@@ -1119,7 +1119,8 @@ def make_train_step(config: TransformerConfig, tx,
                     seq_axis: Optional[str] = None,
                     zero_optimizer: bool = False,
                     accum_steps: int = 1,
-                    fsdp: bool = False):
+                    fsdp: bool = False,
+                    packed: bool = False):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
     step with dp/tp(/sp) shardings. With ``mesh=None`` it is the plain
     single-device step. ``zero_optimizer=True`` pins the optimizer state
@@ -1129,6 +1130,13 @@ def make_train_step(config: TransformerConfig, tx,
     one ``lax.scan`` before the single optimizer update — the effective
     batch no longer has to fit in memory at once (equal-size microbatches
     make the result identical to the unaccumulated step).
+
+    ``packed=True`` adds a trailing ``segment_ids`` argument to the
+    step (packed-row training: segment-isolated attention + boundary-
+    masked loss). Note: with ``accum_steps > 1`` the accumulated loss
+    averages per-microbatch weighted means — identical to the one-shot
+    step only when every microbatch carries the same valid-target count
+    (rows from the same packing run are statistically so).
 
     ``fsdp=True`` (mesh required) pins params — and, through
     ``jit(tx.init)`` on params already placed by
@@ -1159,14 +1167,15 @@ def make_train_step(config: TransformerConfig, tx,
 
     use_dropout = config.dropout_rate > 0
 
-    def loss_and_grads(params, tokens, dropout_key):
+    def loss_and_grads(params, tokens, dropout_key, segment_ids=None):
         return jax.value_and_grad(lm_loss)(
             params, tokens, config, mesh=mesh, seq_axis=seq_axis,
             batch_axis=data_axis if mesh is not None else None,
             model_axis=model_axis if mesh is not None else None,
-            dropout_key=dropout_key)
+            dropout_key=dropout_key, segment_ids=segment_ids)
 
-    def step(params, opt_state, tokens, dropout_key=None):
+    def step(params, opt_state, tokens, dropout_key=None,
+             segment_ids=None):
         if accum_steps > 1:
             if tokens.shape[0] % accum_steps:
                 raise ValueError(
@@ -1186,22 +1195,29 @@ def make_train_step(config: TransformerConfig, tx,
                      if use_dropout else jnp.zeros((accum_steps, 2),
                                                    jnp.uint32))
 
+            if segment_ids is not None:
+                seg_micro = segment_ids.reshape(micro.shape)
+            else:
+                seg_micro = jnp.zeros_like(micro)  # unused placeholder
+
             def body(carry, xs):
-                tk, mk = xs
+                tk, mk, sg = xs
                 gsum, lsum = carry
-                loss, grads = loss_and_grads(params, tk,
-                                             mk if use_dropout else None)
+                loss, grads = loss_and_grads(
+                    params, tk, mk if use_dropout else None,
+                    sg if segment_ids is not None else None)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
                 return (gsum, lsum + loss), None
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
             (gsum, lsum), _ = jax.lax.scan(body, (zeros, 0.0),
-                                           (micro, mkeys))
+                                           (micro, mkeys, seg_micro))
             grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
             loss = lsum / accum_steps
         else:
             loss, grads = loss_and_grads(
-                params, tokens, dropout_key if use_dropout else None)
+                params, tokens, dropout_key if use_dropout else None,
+                segment_ids)
         if fsdp_shardings is not None:
             # keep the gradient fully sharded before the optimizer math:
             # GSPMD then reduce-scatters it and runs the update per-shard
@@ -1213,28 +1229,37 @@ def make_train_step(config: TransformerConfig, tx,
         return params, opt_state, loss
 
     if not (zero_optimizer and mesh is not None):
-        if not use_dropout:
-            # keep the historical 3-arg signature when dropout is off
-            def step3(params, opt_state, tokens):
-                return step(params, opt_state, tokens, None)
-            if fsdp_shardings is not None:
-                return jax.jit(
-                    step3, donate_argnums=(0, 1),
-                    in_shardings=(fsdp_shardings, fsdp_opt_shardings, None),
-                    out_shardings=(fsdp_shardings, fsdp_opt_shardings,
-                                   None))
-            return jax.jit(step3, donate_argnums=(0, 1))
+        # positional signature: (params, opt, tokens[, key][, segments])
+        # — historical arities preserved when dropout/packing are off
+        if not use_dropout and not packed:
+            def wrapped(params, opt_state, tokens):
+                return step(params, opt_state, tokens, None, None)
+            n_extra = 0
+        elif use_dropout and not packed:
+            def wrapped(params, opt_state, tokens, dropout_key):
+                return step(params, opt_state, tokens, dropout_key, None)
+            n_extra = 1
+        elif packed and not use_dropout:
+            def wrapped(params, opt_state, tokens, segment_ids):
+                return step(params, opt_state, tokens, None, segment_ids)
+            n_extra = 1
+        else:
+            def wrapped(params, opt_state, tokens, dropout_key,
+                        segment_ids):
+                return step(params, opt_state, tokens, dropout_key,
+                            segment_ids)
+            n_extra = 2
         if fsdp_shardings is not None:
             return jax.jit(
-                step, donate_argnums=(0, 1),
-                in_shardings=(fsdp_shardings, fsdp_opt_shardings, None,
-                              None),
+                wrapped, donate_argnums=(0, 1),
+                in_shardings=(fsdp_shardings, fsdp_opt_shardings, None)
+                + (None,) * n_extra,
                 out_shardings=(fsdp_shardings, fsdp_opt_shardings, None))
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(wrapped, donate_argnums=(0, 1))
 
     jitted = {}
 
-    def stepper(params, opt_state, tokens, *dropout_key):
+    def stepper(params, opt_state, tokens, *extra):
         # the opt-state shardings depend on the params treedef, so the
         # jit wrapper is built on first call and cached
         if "fn" not in jitted:
@@ -1246,14 +1271,20 @@ def make_train_step(config: TransformerConfig, tx,
             # in_shardings too: a replicated opt state passed on the
             # first call is resharded on entry, so the donated input and
             # the sharded output alias cleanly
-            n_extra = 1 if use_dropout else 0
-            fn = step if use_dropout else (
-                lambda p, o, t: step(p, o, t, None))
+            n_extra = (1 if use_dropout else 0) + (1 if packed else 0)
+            if use_dropout and packed:
+                fn = step
+            elif use_dropout:
+                fn = lambda p, o, t, dk: step(p, o, t, dk, None)
+            elif packed:
+                fn = lambda p, o, t, sg: step(p, o, t, None, sg)
+            else:
+                fn = lambda p, o, t: step(p, o, t, None, None)
             jitted["fn"] = jax.jit(
                 fn, donate_argnums=(0, 1),
                 in_shardings=(None, shardings, None) + (None,) * n_extra,
                 out_shardings=(None, shardings, None))
-        return jitted["fn"](params, opt_state, tokens, *dropout_key)
+        return jitted["fn"](params, opt_state, tokens, *extra)
 
     return stepper
 

@@ -1745,3 +1745,45 @@ def test_pack_documents_and_packed_training():
         params, opt, loss = step(params, opt)
         first = first if first is not None else float(loss)
     assert np.isfinite(float(loss)) and float(loss) < first
+
+
+def test_packed_train_step_and_accumulation():
+    import dataclasses
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(4, 64, size=(4, 16)).astype("int32"))
+    segs = jnp.asarray(np.tile([1] * 8 + [2] * 8, (4, 1)).astype("int32"))
+    tx = optax.adam(1e-2)
+
+    opt = tx.init(params)
+    step = make_train_step(config, tx, packed=True)
+    first = None
+    for _ in range(6):
+        params, opt, loss = step(params, opt, tokens, segs)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+
+    # accumulation splits segments alongside tokens: equals one big batch
+    p0 = init_params(config, jax.random.PRNGKey(0))
+    o0 = tx.init(p0)
+    one = make_train_step(config, tx, packed=True)
+    p1, o1, l1 = one(p0, o0, tokens, segs)
+    p0b = init_params(config, jax.random.PRNGKey(0))
+    o0b = tx.init(p0b)
+    acc = make_train_step(config, tx, packed=True, accum_steps=2)
+    p2, o2, l2 = acc(p0b, o0b, tokens, segs)
+    np.testing.assert_allclose(float(l2), float(l1), atol=1e-5, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p2),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=2e-3)
+
+    # packed + dropout: 5-arg step
+    dcfg = dataclasses.replace(config, dropout_rate=0.1)
+    pd = init_params(dcfg, jax.random.PRNGKey(0))
+    od = tx.init(pd)
+    dstep = make_train_step(dcfg, tx, packed=True)
+    pd, od, dl = dstep(pd, od, tokens, jax.random.PRNGKey(1), segs)
+    assert np.isfinite(float(dl))
