@@ -349,9 +349,10 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
     reached exclusively through shard_map with divisible batch/head dims.
     """
     c = config
-    if c.attention_window is not None:
-        # band mask lives in the xla path only; a windowed ring/flash
-        # kernel is a future optimization, correctness first
+    if c.attention_window is not None and (mesh is not None
+                                           and seq_axis is not None):
+        # windowed ring attention is not implemented; under a seq axis
+        # the band mask runs through the (GSPMD-sharded) xla path
         return "xla"
     if mesh is not None and seq_axis is not None:
         return "ring"
@@ -882,12 +883,15 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         # pinned to the data axis, heads to the Megatron model axis —
         # attention needs no cross-device communication)
         attn_fn = partial(flash_attention_sharded, mesh=mesh, causal=True,
-                          batch_axis=batch_axis, head_axis=model_axis)
+                          batch_axis=batch_axis, head_axis=model_axis,
+                          window=c.attention_window)
         # the kernel resolves GQA via its kv-row index maps — narrow k/v
-        # all the way into VMEM, no head-broadcast materialization
+        # all the way into VMEM, no head-broadcast materialization; a
+        # sliding window skips out-of-band blocks in-kernel
         attn_fn.handles_gqa = True
     elif attn_impl == "flash":
-        attn_fn = partial(flash_attention, causal=True)
+        attn_fn = partial(flash_attention, causal=True,
+                          window=c.attention_window)
         attn_fn.handles_gqa = True
     elif segment_ids is not None or c.attention_window is not None:
         t = tokens.shape[1]

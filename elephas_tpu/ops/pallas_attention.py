@@ -50,7 +50,7 @@ def _round_up(n: int, m: int) -> int:
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, sq: int, sk: int, block_q: int, block_k: int,
-                causal: bool, scale: float):
+                causal: bool, scale: float, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -61,8 +61,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # skip blocks strictly above the causal diagonal
+    # skip blocks strictly above the causal diagonal — and, with a
+    # sliding window, blocks entirely below it
     diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    if window is not None:
+        in_band = (kj * block_k + block_k - 1
+                   > qi * block_q - window)
+        diag_reached = diag_reached & in_band
 
     @pl.when(diag_reached)
     def _():
@@ -79,6 +84,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         valid = k_pos < sk
         if causal:
             valid = valid & (k_pos <= q_pos)
+        if window is not None:
+            valid = valid & (k_pos > q_pos - window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, 0]
@@ -103,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grp = h // kvh
@@ -124,7 +131,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
     grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(_fwd_kernel, sq=sq, sk=sk, block_q=block_q,
-                               block_k=block_k, causal=causal, scale=scale)
+                               block_k=block_k, causal=causal, scale=scale,
+                               window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -159,7 +167,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 # --------------------------------------------------------------------- bwd
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc, *, sq: int, sk: int, block_q: int, block_k: int,
-               causal: bool, scale: float):
+               causal: bool, scale: float, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -169,6 +177,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    if window is not None:
+        diag_reached = diag_reached & (kj * block_k + block_k - 1
+                                       > qi * block_q - window)
 
     @pl.when(diag_reached)
     def _():
@@ -181,6 +192,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         valid = k_pos < sk
         if causal:
             valid = valid & (k_pos <= q_pos)
+        if window is not None:
+            valid = valid & (k_pos > q_pos - window)
         p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -197,7 +210,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, sq: int, sk: int,
                 block_q: int, block_k: int, causal: bool, scale: float,
-                nq_blocks: int):
+                nq_blocks: int, window=None):
     kj = pl.program_id(1)
     t = pl.program_id(2)
     # the trailing grid axis enumerates (group member, q block): every
@@ -212,6 +225,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    if window is not None:
+        diag_reached = diag_reached & (kj * block_k + block_k - 1
+                                       > qi * block_q - window)
 
     @pl.when(diag_reached)
     def _():
@@ -226,6 +242,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         valid = (k_pos < sk) & (q_pos < sq)
         if causal:
             valid = valid & (k_pos <= q_pos)
+        if window is not None:
+            valid = valid & (k_pos > q_pos - window)
         p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -243,7 +261,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, residuals, g):
+def _bwd(causal, block_q, block_k, interpret, window, residuals, g):
     q, k, v, o, lse = residuals
     b, h, sq, d = q.shape
     kvh = k.shape[1]
@@ -269,7 +287,7 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
 
     interp = _use_interpret(interpret)
     common = dict(sq=sq, sk=sk, block_q=block_q, block_k=block_k,
-                  causal=causal, scale=scale)
+                  causal=causal, scale=scale, window=window)
 
     def kv_row(bh):
         return (bh // h) * kvh + (bh % h) // grp
@@ -326,19 +344,19 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
             dv[:, :sk].reshape(b, kvh, sk, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window):
+    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, window)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    return _bwd(causal, block_q, block_k, interpret, residuals, g)
+def _flash_bwd(causal, block_q, block_k, interpret, window, residuals, g):
+    return _bwd(causal, block_q, block_k, interpret, window, residuals, g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -347,7 +365,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, block_q: int = 256,
                     block_k: int = 512,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None) -> jnp.ndarray:
     """Flash attention over ``(batch, heads, seq, head_dim)`` tensors.
 
     Differentiable (custom VJP with Pallas backward kernels). ``interpret``
@@ -364,9 +383,12 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             "(GQA)")
     # clamp blocks for short sequences, rounding to 32 rows — a multiple of
     # every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
+    if window is not None and window < 1:
+        raise ValueError("window must be >= 1")
     block_q = min(block_q, _round_up(q.shape[2], 32))
     block_k = min(block_k, _round_up(k.shape[2], 32))
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret,
+                  int(window) if window is not None else None)
 
 
 def flash_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -374,7 +396,8 @@ def flash_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             batch_axis: Optional[str] = None,
                             head_axis: Optional[str] = None,
                             block_q: int = 256, block_k: int = 512,
-                            interpret: Optional[bool] = None) -> jnp.ndarray:
+                            interpret: Optional[bool] = None,
+                            window: Optional[int] = None) -> jnp.ndarray:
     """Flash attention under a device mesh.
 
     The Mosaic kernel has no SPMD partitioning rule, so a bare
@@ -394,7 +417,7 @@ def flash_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = _P(batch_axis, head_axis, None, None)
     fn = jax.shard_map(
         _partial(flash_attention, causal=causal, block_q=block_q,
-                 block_k=block_k, interpret=interpret),
+                 block_k=block_k, interpret=interpret, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -1787,3 +1787,23 @@ def test_packed_train_step_and_accumulation():
     dstep = make_train_step(dcfg, tx, packed=True)
     pd, od, dl = dstep(pd, od, tokens, jax.random.PRNGKey(1), segs)
     assert np.isfinite(float(dl))
+
+
+def test_sliding_window_flash_matches_xla_model_level():
+    import dataclasses
+
+    xla_cfg = dataclasses.replace(_config(), attention_window=5,
+                                  attention_impl="xla")
+    flash_cfg = dataclasses.replace(xla_cfg, attention_impl="flash")
+    params = init_params(xla_cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, tokens, flash_cfg)),
+        np.asarray(forward(params, tokens, xla_cfg)),
+        atol=1e-4, rtol=1e-4)
+    g_ref = jax.grad(lm_loss)(params, tokens, xla_cfg)
+    g_fl = jax.grad(lm_loss)(params, tokens, flash_cfg)
+    for a, b in zip(jax.tree_util.tree_leaves(g_fl),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-3)
