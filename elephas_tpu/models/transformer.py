@@ -84,7 +84,8 @@ class TransformerConfig:
     #: position encoding: ``learned`` adds a trained (max_seq_len, d)
     #: table at the embedding; ``rope`` rotates q/k per layer (RoFormer)
     #: — relative positions, no length-bound table, the standard choice
-    #: for long-context models
+    #: for long-context models; ``sinusoidal`` is the original
+    #: parameter-free sin/cos table (Vaswani et al.)
     positional: str = "learned"
     #: weight of the z-loss term ``mean(logsumexp(logits)^2)`` (PaLM §5):
     #: keeps logits from drifting large, which stabilizes bf16 training
@@ -165,9 +166,9 @@ class TransformerConfig:
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError("norm must be 'layernorm' or 'rmsnorm', "
                              f"got {self.norm!r}")
-        if self.positional not in ("learned", "rope"):
-            raise ValueError("positional must be 'learned' or 'rope', "
-                             f"got {self.positional!r}")
+        if self.positional not in ("learned", "rope", "sinusoidal"):
+            raise ValueError("positional must be 'learned', 'rope' or "
+                             f"'sinusoidal', got {self.positional!r}")
         if self.positional == "rope" and self.head_dim % 2:
             raise ValueError("rope requires an even head_dim")
         if self.num_kv_heads is not None and (
@@ -477,15 +478,31 @@ def block_apply(layer: Dict, x: jnp.ndarray, config: TransformerConfig,
     return _mlp_apply(layer, x, config)
 
 
+def _sinusoidal_table(positions: jnp.ndarray, d_model: int) -> jnp.ndarray:
+    """Parameter-free sin/cos position encoding (Vaswani et al. §3.5):
+    ``(..., d_model)`` for integer ``positions``."""
+    half = d_model // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half, dtype=jnp.float32)
+                    / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    table = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+    if d_model % 2:
+        table = jnp.pad(table, [(0, 0)] * (table.ndim - 1) + [(0, 1)])
+    return table
+
+
 def embed_apply(embed: Dict, tokens: jnp.ndarray,
                 config: TransformerConfig) -> jnp.ndarray:
-    """Token (+ learned positional) embedding -> activations in the
-    compute dtype. Shared by the monolithic forward and the pipelined LM
-    entry. RoPE configs carry position in the per-layer q/k rotation
-    instead of an additive table."""
+    """Token (+ positional) embedding -> activations in the compute
+    dtype. Shared by the monolithic forward and the pipelined LM entry.
+    RoPE configs carry position in the per-layer q/k rotation instead of
+    an additive table; sinusoidal adds the parameter-free table."""
     x = embed["tokens"][tokens]
     if config.positional == "learned":
         x = x + embed["pos"][:tokens.shape[1]]
+    elif config.positional == "sinusoidal":
+        x = x + _sinusoidal_table(jnp.arange(tokens.shape[1]),
+                                  config.d_model)
     return x.astype(config.dtype)
 
 
@@ -1368,6 +1385,8 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
     x = params["embed"]["tokens"][tokens]
     if c.positional == "learned":
         x = x + params["embed"]["pos"][pos]
+    elif c.positional == "sinusoidal":
+        x = x + _sinusoidal_table(jnp.asarray(pos), c.d_model)
     x = x.astype(c.dtype)                                    # (B, D)
     length = next(iter(cache.values()))["k"].shape[2]
     positions = jnp.arange(length)

@@ -1807,3 +1807,34 @@ def test_sliding_window_flash_matches_xla_model_level():
                     jax.tree_util.tree_leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-3)
+
+
+def test_sinusoidal_positions_train_and_decode():
+    import dataclasses
+
+    from elephas_tpu.models.transformer import decode_step, init_kv_cache
+
+    config = dataclasses.replace(_config(), positional="sinusoidal")
+    params = init_params(config, jax.random.PRNGKey(0))
+    assert "pos" not in params["embed"]  # parameter-free
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 10),
+                                           0, 64))
+    full = np.asarray(forward(params, jnp.asarray(tokens), config))
+    # position-sensitive: permuting the sequence changes logits
+    perm = np.asarray(tokens)[:, ::-1].copy()
+    assert np.abs(np.asarray(forward(params, jnp.asarray(perm), config))
+                  [:, -1] - full[:, -1]).max() > 1e-6
+    cache = init_kv_cache(config, 2, max_len=10)
+    for t in range(10):
+        logits, cache = decode_step(params, cache,
+                                    jnp.asarray(tokens[:, t]), t, config)
+        np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                   atol=2e-4, rtol=2e-4)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(6):
+        params, opt, loss = step(params, opt, jnp.asarray(tokens))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
