@@ -1478,18 +1478,26 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
                    max_new_tokens: int, config: TransformerConfig,
                    sample: bool, top_k: Optional[int] = None,
                    top_p: Optional[float] = None,
-                   repetition_penalty=1.0, use_rep_penalty: bool = False):
+                   repetition_penalty=1.0, use_rep_penalty: bool = False,
+                   prompt_lengths: Optional[jnp.ndarray] = None):
     c = config
     batch = prompt.shape[0]
     total = prompt_len + max_new_tokens
     cache = init_kv_cache(c, batch, total)
+    lens = (prompt_lengths if prompt_lengths is not None
+            else jnp.full((batch,), prompt_len, jnp.int32))
     seen0 = jnp.zeros((batch, c.vocab_size), bool)
     if use_rep_penalty:
-        seen0 = seen0.at[jnp.arange(batch)[:, None], prompt].set(True)
+        # only real prompt positions mark the presence buffer (padded
+        # tails scatter out of range and drop)
+        valid = jnp.arange(prompt.shape[1])[None, :] < lens[:, None]
+        marked = jnp.where(valid, prompt, c.vocab_size)
+        seen0 = seen0.at[jnp.arange(batch)[:, None], marked].set(
+            True, mode="drop")
 
     def step_fn(carry, t):
         cache, prev, key, seen = carry
-        tok = jnp.where(t < prompt_len,
+        tok = jnp.where(t < lens,
                         prompt[:, jnp.minimum(t, prompt_len - 1)], prev)
         logits, cache = decode_step(params, cache, tok, t, c)
         if use_rep_penalty:
@@ -1511,16 +1519,22 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
 
     (_, _, _, _), sampled = jax.lax.scan(
         step_fn, (cache, prompt[:, 0], key, seen0), jnp.arange(total - 1))
-    # sampled[t] is the model's token for position t+1: generation starts
-    # at position prompt_len, i.e. sampled[prompt_len - 1:]
-    return sampled[prompt_len - 1:].T
+    # sampled[t] is the model's token for position t+1: row b's
+    # generation starts at its own prompt end, i.e. steps
+    # lens[b]-1 .. lens[b]+max_new-2 (a per-row gather for ragged
+    # batches; the uniform case reduces to sampled[prompt_len-1:])
+    if prompt_lengths is None:
+        return sampled[prompt_len - 1:].T
+    idx = (lens[:, None] - 1) + jnp.arange(max_new_tokens)[None, :]
+    return jnp.take_along_axis(sampled.T, idx, axis=1)
 
 
 def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
              config: TransformerConfig, temperature: float = 0.0,
              key=None, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
-             repetition_penalty: float = 1.0) -> jnp.ndarray:
+             repetition_penalty: float = 1.0,
+             prompt_lengths=None) -> jnp.ndarray:
     """Autoregressive generation: ``(batch, prompt_len)`` prompt ids ->
     ``(batch, max_new_tokens)`` sampled continuations.
 
@@ -1534,6 +1548,10 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
     probable tokens and/or the ``top_p`` nucleus.
     ``repetition_penalty > 1`` (CTRL) down-weights tokens already in the
     prompt or emitted so far.
+
+    Ragged batches: pass right-padded prompts plus ``prompt_lengths``
+    ``(batch,)`` — each row teacher-forces its own prefix and its
+    continuation aligns at index 0 of the output (per-row gather).
     """
     c = config
     prompt = jnp.asarray(prompt)
@@ -1552,13 +1570,18 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
         raise ValueError("repetition_penalty must be >= 1")
     if key is None:
         key = jax.random.PRNGKey(0)
+    if prompt_lengths is not None:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if prompt_lengths.shape != (prompt.shape[0],):
+            raise ValueError("prompt_lengths must be (batch,)")
     return _generate_scan(params, prompt, jnp.float32(temperature), key,
                           prompt_len, int(max_new_tokens), c,
                           temperature > 0,
                           int(top_k) if top_k is not None else None,
                           float(top_p) if top_p is not None else None,
                           jnp.float32(repetition_penalty),
-                          repetition_penalty != 1.0)
+                          repetition_penalty != 1.0,
+                          prompt_lengths)
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",

@@ -1838,3 +1838,40 @@ def test_sinusoidal_positions_train_and_decode():
         params, opt, loss = step(params, opt, jnp.asarray(tokens))
         first = first if first is not None else float(loss)
     assert float(loss) < first
+
+
+def test_ragged_prompt_generation_matches_per_row():
+    """Right-padded ragged prompts: each row's continuation equals an
+    individual generate() on its unpadded prompt (greedy oracle)."""
+    from elephas_tpu.models.transformer import generate
+
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    lens = [3, 6, 4]
+    lmax = max(lens)
+    prompt = np.zeros((3, lmax), dtype="int32")
+    rows = []
+    for b, L in enumerate(lens):
+        row = rng.integers(4, 64, size=L).astype("int32")
+        rows.append(row)
+        prompt[b, :L] = row
+
+    out = np.asarray(generate(params, jnp.asarray(prompt), 6, config,
+                              prompt_lengths=np.asarray(lens)))
+    assert out.shape == (3, 6)
+    for b, row in enumerate(rows):
+        solo = np.asarray(generate(params, jnp.asarray(row[None, :]), 6,
+                                   config))
+        np.testing.assert_array_equal(out[b], solo[0])
+
+    # uniform lengths equal the plain path exactly
+    uni = np.asarray(generate(params, jnp.asarray(prompt), 6, config,
+                              prompt_lengths=np.asarray([lmax] * 3)))
+    plain = np.asarray(generate(params, jnp.asarray(prompt), 6, config))
+    np.testing.assert_array_equal(uni, plain)
+
+    import pytest
+    with pytest.raises(ValueError):
+        generate(params, jnp.asarray(prompt), 4, config,
+                 prompt_lengths=np.asarray([3, 6]))
