@@ -1,4 +1,5 @@
-from . import activations, initializers, losses, metrics, optimizers, schedules
+from . import (activations, bert, initializers, lora, losses, metrics,
+               optimizers, schedules, transformer, vit)
 from .schedules import (CosineDecay, ExponentialDecay,
                         PiecewiseConstantDecay, WarmupCosine)
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,

@@ -404,7 +404,8 @@ class TransformerModel:
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> np.ndarray:
+                 top_p: Optional[float] = None,
+                 prompt_lengths=None) -> np.ndarray:
         """Autoregressive continuation of ``(batch, prompt_len)`` token
         ids via the KV-cache decode loop (one lax.scan, compiled once per
         shape): ``temperature=0`` greedy, otherwise categorical sampling,
@@ -413,7 +414,8 @@ class TransformerModel:
         return np.asarray(_generate(self.params, np.asarray(prompt),
                                     int(max_new_tokens), self.config,
                                     temperature=temperature, key=key,
-                                    top_k=top_k, top_p=top_p))
+                                    top_k=top_k, top_p=top_p,
+                                    prompt_lengths=prompt_lengths))
 
     def beam_search(self, prompt: np.ndarray, max_new_tokens: int,
                     num_beams: int = 4, length_penalty: float = 0.0,
