@@ -71,6 +71,10 @@ PAGES = [
     ("LoRA fine-tuning", "elephas_tpu.models.lora",
      ["init_lora_params", "merge_lora", "make_lora_train_step",
       "lora_param_count"]),
+    ("Encoder-decoder (seq2seq)", "elephas_tpu.models.encdec",
+     ["EncDecConfig", "init_params", "param_specs", "encode",
+      "decode_logits", "seq2seq_loss", "make_train_step", "greedy_decode",
+      "shard_params"]),
     ("BERT encoder (MLM)", "elephas_tpu.models.bert",
      ["BertConfig", "init_params", "param_specs", "encode", "pool",
       "mask_tokens", "mlm_loss", "make_mlm_train_step", "shard_params"]),

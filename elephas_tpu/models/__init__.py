@@ -1,5 +1,5 @@
-from . import (activations, bert, initializers, lora, losses, metrics,
-               optimizers, schedules, transformer, vit)
+from . import (activations, bert, encdec, initializers, lora, losses,
+               metrics, optimizers, schedules, transformer, vit)
 from .schedules import (CosineDecay, ExponentialDecay,
                         PiecewiseConstantDecay, WarmupCosine)
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,

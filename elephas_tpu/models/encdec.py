@@ -1,0 +1,356 @@
+"""Encoder-decoder (seq2seq) transformer — translation/summarization
+family (Vaswani et al. architecture).
+
+Fourth transformer family next to the causal LM, the BERT encoder, and
+ViT, completing the architecture matrix: a bidirectional encoder over
+the source (padding-masked), a causal decoder over the target, and
+cross-attention from every decoder block into the encoder outputs.
+Shares the framework's sublayer helpers and Megatron tensor-parallel
+spec shapes; the token embedding is shared between encoder, decoder,
+and the (tied) output head.
+
+Decoding runs with a self-attention KV cache plus per-layer
+cross-attention K/V computed once from the encoder output — the
+standard seq2seq serving split.
+"""
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import NEG_INF, attention
+from .transformer import _dropout, _layer_norm, _mesh_divides
+
+__all__ = ["EncDecConfig", "init_params", "param_specs", "encode",
+           "decode_logits", "seq2seq_loss", "make_train_step",
+           "greedy_decode", "shard_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EncDecConfig:
+    vocab_size: int = 32000
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    pad_token_id: int = 0
+    #: decoder-input start token (teacher forcing begins from it)
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+    dropout_rate: float = 0.0
+
+    def __post_init__(self):
+        if self.d_model % self.num_heads:
+            raise ValueError("num_heads must divide d_model")
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError("dropout_rate must be in [0, 1)")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def _attn_params(keys, c, prefix_dim):
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, c.param_dtype)
+                / math.sqrt(fan_in))
+
+    return {
+        "wq": dense(keys[0], (c.d_model, c.num_heads, c.head_dim),
+                    c.d_model),
+        "wk": dense(keys[1], (prefix_dim, c.num_heads, c.head_dim),
+                    prefix_dim),
+        "wv": dense(keys[2], (prefix_dim, c.num_heads, c.head_dim),
+                    prefix_dim),
+        "wo": dense(keys[3], (c.num_heads, c.head_dim, c.d_model),
+                    c.d_model),
+    }
+
+
+def _ln(c):
+    return {"gamma": jnp.ones((c.d_model,), c.param_dtype),
+            "beta": jnp.zeros((c.d_model,), c.param_dtype)}
+
+
+def _mlp_params(keys, c):
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, c.param_dtype)
+                / math.sqrt(fan_in))
+
+    return {"w1": dense(keys[0], (c.d_model, c.d_ff), c.d_model),
+            "b1": jnp.zeros((c.d_ff,), c.param_dtype),
+            "w2": dense(keys[1], (c.d_ff, c.d_model), c.d_ff),
+            "b2": jnp.zeros((c.d_model,), c.param_dtype)}
+
+
+def init_params(config: EncDecConfig, key) -> Dict:
+    c = config
+    n = 2 + c.num_encoder_layers + c.num_decoder_layers
+    keys = jax.random.split(key, n)
+    params: Dict[str, Any] = {
+        "embed": {
+            "tokens": 0.02 * jax.random.normal(
+                keys[0], (c.vocab_size, c.d_model), c.param_dtype),
+            "enc_pos": 0.02 * jax.random.normal(
+                keys[1], (c.max_seq_len, c.d_model), c.param_dtype),
+            "dec_pos": 0.02 * jax.random.normal(
+                jax.random.fold_in(keys[1], 1),
+                (c.max_seq_len, c.d_model), c.param_dtype),
+        },
+        "enc_final_ln": _ln(c),
+        "dec_final_ln": _ln(c),
+    }
+    for i in range(c.num_encoder_layers):
+        lk = jax.random.split(keys[2 + i], 6)
+        params[f"enc_{i}"] = {
+            "ln1": _ln(c), "attn": _attn_params(lk[:4], c, c.d_model),
+            "ln2": _ln(c), "mlp": _mlp_params(lk[4:6], c),
+        }
+    off = 2 + c.num_encoder_layers
+    for i in range(c.num_decoder_layers):
+        lk = jax.random.split(keys[off + i], 10)
+        params[f"dec_{i}"] = {
+            "ln1": _ln(c), "attn": _attn_params(lk[:4], c, c.d_model),
+            "ln_x": _ln(c), "cross": _attn_params(lk[4:8], c, c.d_model),
+            "ln2": _ln(c), "mlp": _mlp_params(lk[8:10], c),
+        }
+    return params
+
+
+def param_specs(config: EncDecConfig, model_axis: str = "model",
+                mesh: Optional[Mesh] = None) -> Dict:
+    c = config
+    attn = {"wq": P(None, model_axis, None), "wk": P(None, model_axis, None),
+            "wv": P(None, model_axis, None), "wo": P(model_axis, None, None)}
+    ln = {"gamma": P(None), "beta": P(None)}
+    mlp = {"w1": P(None, model_axis), "b1": P(model_axis),
+           "w2": P(model_axis, None), "b2": P(None)}
+    specs: Dict[str, Any] = {
+        "embed": {"tokens": P(model_axis, None), "enc_pos": P(None, None),
+                  "dec_pos": P(None, None)},
+        "enc_final_ln": dict(ln), "dec_final_ln": dict(ln),
+    }
+    for i in range(c.num_encoder_layers):
+        specs[f"enc_{i}"] = {"ln1": dict(ln), "attn": dict(attn),
+                             "ln2": dict(ln), "mlp": dict(mlp)}
+    for i in range(c.num_decoder_layers):
+        specs[f"dec_{i}"] = {"ln1": dict(ln), "attn": dict(attn),
+                             "ln_x": dict(ln), "cross": dict(attn),
+                             "ln2": dict(ln), "mlp": dict(mlp)}
+    return specs
+
+
+def _project(h, w, c):
+    return jnp.einsum("btd,dhk->bhtk", h, w.astype(c.dtype))
+
+
+def _attend(layer_attn, q_in, kv_in, mask, c):
+    """Pre-LN'd inputs -> attention output in model dim."""
+    q = _project(q_in, layer_attn["wq"], c)
+    k = _project(kv_in, layer_attn["wk"], c)
+    v = _project(kv_in, layer_attn["wv"], c)
+    o = attention(q, k, v, causal=False, mask=mask)
+    return jnp.einsum("bhtk,hkd->btd", o, layer_attn["wo"].astype(c.dtype))
+
+
+def _mlp(h, mlp, c):
+    g = jax.nn.gelu(h @ mlp["w1"].astype(c.dtype)
+                    + mlp["b1"].astype(c.dtype))
+    return g @ mlp["w2"].astype(c.dtype) + mlp["b2"].astype(c.dtype)
+
+
+def encode(params: Dict, src: jnp.ndarray, config: EncDecConfig,
+           dropout_key=None) -> jnp.ndarray:
+    """Source token ids ``(B, S)`` -> encoder states ``(B, S, D)``;
+    padding excluded from every attention's key set."""
+    c = config
+    e = params["embed"]
+    x = (e["tokens"][src] + e["enc_pos"][:src.shape[1]]).astype(c.dtype)
+    src_mask = (src != c.pad_token_id)[:, None, None, :]
+    for i in range(c.num_encoder_layers):
+        layer = params[f"enc_{i}"]
+        lkey = (jax.random.fold_in(dropout_key, i)
+                if dropout_key is not None else None)
+        ak, mk = (jax.random.split(lkey) if lkey is not None
+                  else (None, None))
+        h = _layer_norm(x, layer["ln1"]["gamma"],
+                        layer["ln1"]["beta"]).astype(c.dtype)
+        x = x + _dropout(_attend(layer["attn"], h, h, src_mask, c),
+                         c.dropout_rate, ak)
+        h = _layer_norm(x, layer["ln2"]["gamma"],
+                        layer["ln2"]["beta"]).astype(c.dtype)
+        x = x + _dropout(_mlp(h, layer["mlp"], c), c.dropout_rate, mk)
+    return _layer_norm(x.astype(jnp.float32),
+                       params["enc_final_ln"]["gamma"],
+                       params["enc_final_ln"]["beta"]).astype(c.dtype)
+
+
+def decode_logits(params: Dict, memory: jnp.ndarray, src: jnp.ndarray,
+                  tgt_in: jnp.ndarray, config: EncDecConfig,
+                  dropout_key=None) -> jnp.ndarray:
+    """Teacher-forced decoder: encoder ``memory`` + decoder input ids
+    ``(B, T)`` -> next-token logits ``(B, T, V)`` (f32)."""
+    c = config
+    e = params["embed"]
+    x = (e["tokens"][tgt_in] + e["dec_pos"][:tgt_in.shape[1]]).astype(c.dtype)
+    t = tgt_in.shape[1]
+    causal = (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None])[None, None]
+    cross_mask = (src != c.pad_token_id)[:, None, None, :]
+    for i in range(c.num_decoder_layers):
+        layer = params[f"dec_{i}"]
+        lkey = (jax.random.fold_in(dropout_key, 1000 + i)
+                if dropout_key is not None else None)
+        ak, xk, mk = (jax.random.split(lkey, 3) if lkey is not None
+                      else (None, None, None))
+        h = _layer_norm(x, layer["ln1"]["gamma"],
+                        layer["ln1"]["beta"]).astype(c.dtype)
+        x = x + _dropout(_attend(layer["attn"], h, h, causal, c),
+                         c.dropout_rate, ak)
+        h = _layer_norm(x, layer["ln_x"]["gamma"],
+                        layer["ln_x"]["beta"]).astype(c.dtype)
+        x = x + _dropout(_attend(layer["cross"], h, memory, cross_mask, c),
+                         c.dropout_rate, xk)
+        h = _layer_norm(x, layer["ln2"]["gamma"],
+                        layer["ln2"]["beta"]).astype(c.dtype)
+        x = x + _dropout(_mlp(h, layer["mlp"], c), c.dropout_rate, mk)
+    x = _layer_norm(x.astype(jnp.float32), params["dec_final_ln"]["gamma"],
+                    params["dec_final_ln"]["beta"])
+    return x @ params["embed"]["tokens"].T.astype(jnp.float32)
+
+
+def seq2seq_loss(params: Dict, src: jnp.ndarray, tgt: jnp.ndarray,
+                 config: EncDecConfig, dropout_key=None) -> jnp.ndarray:
+    """Teacher-forced cross-entropy: decoder input is ``[bos, tgt[:-1]]``,
+    targets are ``tgt`` with padding positions masked out."""
+    c = config
+    memory = encode(params, src, c, dropout_key=dropout_key)
+    bos = jnp.full((tgt.shape[0], 1), c.bos_token_id, tgt.dtype)
+    tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
+    logits = decode_logits(params, memory, src, tgt_in, c,
+                           dropout_key=dropout_key)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    w = (tgt != c.pad_token_id).astype(jnp.float32)
+    return -jnp.sum(picked * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def shard_params(params: Dict, config: EncDecConfig, mesh: Mesh,
+                 model_axis: str = "model") -> Dict:
+    specs = param_specs(config, model_axis=model_axis, mesh=mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs)
+
+
+def make_train_step(config: EncDecConfig, tx):
+    """Jitted ``(params, opt_state, src, tgt[, key]) -> (params,
+    opt_state, loss)`` (the key argument exists for dropout configs)."""
+    use_dropout = config.dropout_rate > 0
+
+    def step(params, opt_state, src, tgt, dropout_key=None):
+        loss, grads = jax.value_and_grad(seq2seq_loss)(
+            params, src, tgt, config,
+            dropout_key=dropout_key if use_dropout else None)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    if not use_dropout:
+        return jax.jit(lambda p, o, s, t: step(p, o, s, t, None),
+                       donate_argnums=(0, 1))
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ---------------------------------------------------------------- decoding
+def _dec_step(params: Dict, caches: Dict, cross_kv: Dict, src_mask,
+              tok: jnp.ndarray, pos, config: EncDecConfig
+              ) -> Tuple[jnp.ndarray, Dict]:
+    """One incremental decoder step with a self-attention KV cache and
+    precomputed cross-attention K/V."""
+    c = config
+    scale = 1.0 / math.sqrt(c.head_dim)
+    e = params["embed"]
+    x = (e["tokens"][tok] + e["dec_pos"][pos]).astype(c.dtype)   # (B, D)
+    length = next(iter(caches.values()))["k"].shape[2]
+    self_mask = (jnp.arange(length) <= pos)[None, None, :]
+    new_caches: Dict = {}
+    for i in range(c.num_decoder_layers):
+        layer = params[f"dec_{i}"]
+        h = _layer_norm(x, layer["ln1"]["gamma"],
+                        layer["ln1"]["beta"]).astype(c.dtype)
+        q = jnp.einsum("bd,dhk->bhk", h, layer["attn"]["wq"].astype(c.dtype))
+        k_new = jnp.einsum("bd,dhk->bhk", h,
+                           layer["attn"]["wk"].astype(c.dtype))
+        v_new = jnp.einsum("bd,dhk->bhk", h,
+                           layer["attn"]["wv"].astype(c.dtype))
+        ck = caches[f"dec_{i}"]["k"].at[:, :, pos].set(k_new)
+        cv = caches[f"dec_{i}"]["v"].at[:, :, pos].set(v_new)
+        new_caches[f"dec_{i}"] = {"k": ck, "v": cv}
+        s = jnp.einsum("bhk,bhtk->bht", q, ck) * scale
+        s = jnp.where(self_mask, s, NEG_INF)
+        o = jnp.einsum("bht,bhtk->bhk", jax.nn.softmax(s, axis=-1), cv)
+        x = x + jnp.einsum("bhk,hkd->bd", o,
+                           layer["attn"]["wo"].astype(c.dtype))
+
+        h = _layer_norm(x, layer["ln_x"]["gamma"],
+                        layer["ln_x"]["beta"]).astype(c.dtype)
+        q = jnp.einsum("bd,dhk->bhk", h, layer["cross"]["wq"].astype(c.dtype))
+        s = jnp.einsum("bhk,bhtk->bht", q, cross_kv[f"dec_{i}"]["k"]) * scale
+        s = jnp.where(src_mask, s, NEG_INF)
+        o = jnp.einsum("bht,bhtk->bhk", jax.nn.softmax(s, axis=-1),
+                       cross_kv[f"dec_{i}"]["v"])
+        x = x + jnp.einsum("bhk,hkd->bd", o,
+                           layer["cross"]["wo"].astype(c.dtype))
+
+        h = _layer_norm(x, layer["ln2"]["gamma"],
+                        layer["ln2"]["beta"]).astype(c.dtype)
+        x = x + _mlp(h, layer["mlp"], c)
+    x = _layer_norm(x.astype(jnp.float32), params["dec_final_ln"]["gamma"],
+                    params["dec_final_ln"]["beta"])
+    return x @ params["embed"]["tokens"].T.astype(jnp.float32), new_caches
+
+
+def _cross_kv(params, memory, config: EncDecConfig):
+    return {f"dec_{i}": {
+        "k": _project(memory, params[f"dec_{i}"]["cross"]["wk"], config),
+        "v": _project(memory, params[f"dec_{i}"]["cross"]["wv"], config)}
+        for i in range(config.num_decoder_layers)}
+
+
+def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
+                  config: EncDecConfig) -> jnp.ndarray:
+    """Greedy seq2seq decoding: ``(B, S)`` source ids -> ``(B, max_len)``
+    target ids, stopping per row at eos (subsequent positions emit eos).
+    One jitted scan; cross-attention K/V computed once."""
+    c = config
+    src = jnp.asarray(src)
+    memory = encode(params, src, c)
+    cross = jax.jit(lambda p, m: _cross_kv(p, m, c))(params, memory)
+    src_mask = (src != c.pad_token_id)[:, None, :]
+    batch = src.shape[0]
+    caches = {f"dec_{i}": {
+        "k": jnp.zeros((batch, c.num_heads, max_len, c.head_dim), c.dtype),
+        "v": jnp.zeros((batch, c.num_heads, max_len, c.head_dim), c.dtype)}
+        for i in range(c.num_decoder_layers)}
+
+    def step_fn(carry, pos):
+        caches, tok, done = carry
+        logits, caches = _dec_step(params, caches, cross, src_mask, tok,
+                                   pos, c)
+        nxt = jnp.argmax(logits, axis=-1).astype(src.dtype)
+        nxt = jnp.where(done, jnp.asarray(c.eos_token_id, src.dtype), nxt)
+        done = done | (nxt == c.eos_token_id)
+        return (caches, nxt, done), nxt
+
+    bos = jnp.full((batch,), c.bos_token_id, src.dtype)
+    (_, _, _), out = jax.lax.scan(
+        step_fn, (caches, bos, jnp.zeros((batch,), bool)),
+        jnp.arange(max_len))
+    return out.T
