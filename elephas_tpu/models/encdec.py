@@ -14,6 +14,7 @@ cross-attention K/V computed once from the encoder output — the
 standard seq2seq serving split.
 """
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -126,12 +127,21 @@ def init_params(config: EncDecConfig, key) -> Dict:
 
 def param_specs(config: EncDecConfig, model_axis: str = "model",
                 mesh: Optional[Mesh] = None) -> Dict:
+    """Megatron tensor-parallel specs; with ``mesh`` given, the head axis
+    replicates when ``num_heads`` does not divide the model axis (same
+    fallback rule as the other families)."""
     c = config
-    attn = {"wq": P(None, model_axis, None), "wk": P(None, model_axis, None),
-            "wv": P(None, model_axis, None), "wo": P(model_axis, None, None)}
+    shardable = (mesh is None
+                 or _mesh_divides(mesh, model_axis, c.num_heads))
+    ax = model_axis if shardable else None
+    attn = {"wq": P(None, ax, None), "wk": P(None, ax, None),
+            "wv": P(None, ax, None), "wo": P(ax, None, None)}
     ln = {"gamma": P(None), "beta": P(None)}
-    mlp = {"w1": P(None, model_axis), "b1": P(model_axis),
-           "w2": P(model_axis, None), "b2": P(None)}
+    mlp_shardable = (mesh is None
+                     or _mesh_divides(mesh, model_axis, c.d_ff))
+    mx = model_axis if mlp_shardable else None
+    mlp = {"w1": P(None, mx), "b1": P(mx),
+           "w2": P(mx, None), "b2": P(None)}
     specs: Dict[str, Any] = {
         "embed": {"tokens": P(model_axis, None), "enc_pos": P(None, None),
                   "dec_pos": P(None, None)},
@@ -250,14 +260,14 @@ def shard_params(params: Dict, config: EncDecConfig, mesh: Mesh,
 
 
 def make_train_step(config: EncDecConfig, tx):
-    """Jitted ``(params, opt_state, src, tgt[, key]) -> (params,
-    opt_state, loss)`` (the key argument exists for dropout configs)."""
+    """Jitted ``(params, opt_state, src, tgt) -> (params, opt_state,
+    loss)``; dropout configs take a REQUIRED trailing PRNG key (so a
+    forgotten key is a loud TypeError, not silently-disabled dropout)."""
     use_dropout = config.dropout_rate > 0
 
-    def step(params, opt_state, src, tgt, dropout_key=None):
+    def step(params, opt_state, src, tgt, dropout_key):
         loss, grads = jax.value_and_grad(seq2seq_loss)(
-            params, src, tgt, config,
-            dropout_key=dropout_key if use_dropout else None)
+            params, src, tgt, config, dropout_key=dropout_key)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
@@ -265,7 +275,11 @@ def make_train_step(config: EncDecConfig, tx):
     if not use_dropout:
         return jax.jit(lambda p, o, s, t: step(p, o, s, t, None),
                        donate_argnums=(0, 1))
-    return jax.jit(step, donate_argnums=(0, 1))
+
+    def with_key(params, opt_state, src, tgt, dropout_key):
+        return step(params, opt_state, src, tgt, dropout_key)
+
+    return jax.jit(with_key, donate_argnums=(0, 1))
 
 
 # ---------------------------------------------------------------- decoding
@@ -324,15 +338,11 @@ def _cross_kv(params, memory, config: EncDecConfig):
         for i in range(config.num_decoder_layers)}
 
 
-def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
-                  config: EncDecConfig) -> jnp.ndarray:
-    """Greedy seq2seq decoding: ``(B, S)`` source ids -> ``(B, max_len)``
-    target ids, stopping per row at eos (subsequent positions emit eos).
-    One jitted scan; cross-attention K/V computed once."""
+@functools.partial(jax.jit, static_argnames=("max_len", "config"))
+def _greedy_scan(params, src, max_len: int, config: EncDecConfig):
     c = config
-    src = jnp.asarray(src)
     memory = encode(params, src, c)
-    cross = jax.jit(lambda p, m: _cross_kv(p, m, c))(params, memory)
+    cross = _cross_kv(params, memory, c)
     src_mask = (src != c.pad_token_id)[:, None, :]
     batch = src.shape[0]
     caches = {f"dec_{i}": {
@@ -354,3 +364,20 @@ def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
         step_fn, (caches, bos, jnp.zeros((batch,), bool)),
         jnp.arange(max_len))
     return out.T
+
+
+def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
+                  config: EncDecConfig) -> jnp.ndarray:
+    """Greedy seq2seq decoding: ``(B, S)`` source ids -> ``(B, max_len)``
+    target ids, stopping per row at eos (subsequent positions emit eos).
+    One module-level jitted scan (compiled once per shape/config);
+    cross-attention K/V computed once inside it."""
+    c = config
+    src = jnp.asarray(src)
+    if max_len > c.max_seq_len:
+        raise ValueError(f"max_len {max_len} exceeds max_seq_len "
+                         f"{c.max_seq_len} (dec_pos table bound)")
+    if src.shape[1] > c.max_seq_len:
+        raise ValueError(f"source length {src.shape[1]} exceeds "
+                         f"max_seq_len {c.max_seq_len}")
+    return _greedy_scan(params, src, int(max_len), c)

@@ -140,3 +140,30 @@ def test_dropout_and_validation():
     assert np.isfinite(float(loss))
     with pytest.raises(ValueError):
         _config(num_heads=5)
+
+
+def test_review_fixes_bounds_specs_and_dropout_arity():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    src, tgt = _copy_data(2, 6)
+    # max_len bound validated (silent dec_pos clamping before)
+    with pytest.raises(ValueError):
+        greedy_decode(params, src, config.max_seq_len + 1, config)
+    # non-divisible heads replicate instead of crashing device_put
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("data", "model"))
+    specs = param_specs(config, mesh=mesh)  # 4 heads on an 8-way axis
+    assert specs["enc_0"]["attn"]["wq"] == P(None, None, None)
+    sp = shard_params(params, config, mesh)  # crashed before
+    got = float(jax.jit(lambda p, s, t: seq2seq_loss(p, s, t, config))(
+        sp, src, tgt))
+    np.testing.assert_allclose(got, float(seq2seq_loss(params, src, tgt,
+                                                       config)),
+                               atol=2e-4, rtol=2e-4)
+    # dropout configs REQUIRE the key
+    dcfg = _config(dropout_rate=0.1)
+    dp = init_params(dcfg, jax.random.PRNGKey(0))
+    import optax as _optax
+    tx = _optax.adam(1e-3)
+    step = make_train_step(dcfg, tx)
+    with pytest.raises(TypeError):
+        step(dp, tx.init(dp), src, tgt)
