@@ -272,11 +272,20 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
     head on tp=2) wk/wv replicate — pass ``mesh`` so the divisibility is
     known (the mesh-blind default assumes divisible).
     """
-    kv_shardable = (mesh is None
-                    or _mesh_divides(mesh, model_axis, config.kv_heads))
-    kv_spec = (P(None, model_axis, None) if kv_shardable
+    def div(dim):
+        return mesh is None or _mesh_divides(mesh, model_axis, dim)
+
+    kv_spec = (P(None, model_axis, None) if div(config.kv_heads)
                else P(None, None, None))
-    embed_specs: Dict[str, Any] = {"tokens": P(model_axis, None)}
+    # every sharded dim falls back to replicated when it does not divide
+    # the model axis (same rule across the model families)
+    h_ax = model_axis if div(config.num_heads) else None
+    ff_ax = model_axis if div(config.d_ff) else None
+    v_ax = model_axis if div(config.vocab_size) else None
+    e_ax = (model_axis
+            if div(config.num_experts if config.num_experts > 1 else 1)
+            else None)
+    embed_specs: Dict[str, Any] = {"tokens": P(v_ax, None)}
     if config.positional == "learned":
         embed_specs["pos"] = P(None, None)
     specs: Dict[str, Any] = {
@@ -284,15 +293,15 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
         "final_ln": {"gamma": P(None), "beta": P(None)},
     }
     if not config.tied_embedding:
-        specs["head"] = P(None, model_axis)
+        specs["head"] = P(None, v_ax)
     for i in range(config.num_layers):
         layer_specs = {
             "ln1": {"gamma": P(None), "beta": P(None)},
             "attn": {
-                "wq": P(None, model_axis, None),
+                "wq": P(None, h_ax, None),
                 "wk": kv_spec,
                 "wv": kv_spec,
-                "wo": P(model_axis, None, None),
+                "wo": P(h_ax, None, None),
             },
             "ln2": {"gamma": P(None), "beta": P(None)},
         }
@@ -303,24 +312,24 @@ def param_specs(config: TransformerConfig, model_axis: str = "model",
             # combine back into the (replicated) residual stream
             layer_specs["moe"] = {
                 "gate": P(None, None),
-                "w1": P(model_axis, None, None),
-                "b1": P(model_axis, None),
-                "w2": P(model_axis, None, None),
-                "b2": P(model_axis, None),
+                "w1": P(e_ax, None, None),
+                "b1": P(e_ax, None),
+                "w2": P(e_ax, None, None),
+                "b2": P(e_ax, None),
             }
             if config.moe_shared_expert:
                 # the shared expert shards like a dense Megatron MLP
                 layer_specs["moe"]["shared"] = {
-                    "w1": P(None, model_axis), "b1": P(model_axis),
-                    "w2": P(model_axis, None), "b2": P(None)}
+                    "w1": P(None, ff_ax), "b1": P(ff_ax),
+                    "w2": P(ff_ax, None), "b2": P(None)}
         else:
-            layer_specs["mlp"] = {"w1": P(None, model_axis),
-                                  "b1": P(model_axis),
-                                  "w2": P(model_axis, None), "b2": P(None)}
+            layer_specs["mlp"] = {"w1": P(None, ff_ax),
+                                  "b1": P(ff_ax),
+                                  "w2": P(ff_ax, None), "b2": P(None)}
             if config.mlp_variant == "swiglu":
                 # the gate shards its output dim like w1 (elementwise
                 # product stays local to the model shard)
-                layer_specs["mlp"]["w3"] = P(None, model_axis)
+                layer_specs["mlp"]["w3"] = P(None, ff_ax)
         specs[f"layer_{i}"] = layer_specs
     return specs
 

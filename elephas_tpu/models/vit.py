@@ -159,6 +159,12 @@ def param_specs(config: ViTConfig, model_axis: str = "model",
                     or _mesh_divides(mesh, model_axis, config.kv_heads))
     kv_spec = (P(None, model_axis, None) if kv_shardable
                else P(None, None, None))
+
+    def _div(dim):
+        return mesh is None or _mesh_divides(mesh, model_axis, dim)
+
+    h_ax = model_axis if _div(config.num_heads) else None
+    ff_ax = model_axis if _div(config.d_ff) else None
     embed_specs: Dict[str, Any] = {
         "patch_kernel": P(None, None), "patch_bias": P(None),
         "pos": P(None, None),
@@ -173,12 +179,12 @@ def param_specs(config: ViTConfig, model_axis: str = "model",
     for i in range(config.num_layers):
         specs[f"layer_{i}"] = {
             "ln1": {"gamma": P(None), "beta": P(None)},
-            "attn": {"wq": P(None, model_axis, None),
+            "attn": {"wq": P(None, h_ax, None),
                      "wk": kv_spec, "wv": kv_spec,
-                     "wo": P(model_axis, None, None)},
+                     "wo": P(h_ax, None, None)},
             "ln2": {"gamma": P(None), "beta": P(None)},
-            "mlp": {"w1": P(None, model_axis), "b1": P(model_axis),
-                    "w2": P(model_axis, None), "b2": P(None)},
+            "mlp": {"w1": P(None, ff_ax), "b1": P(ff_ax),
+                    "w2": P(ff_ax, None), "b2": P(None)},
         }
     return specs
 

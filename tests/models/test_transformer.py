@@ -1875,3 +1875,20 @@ def test_ragged_prompt_generation_matches_per_row():
     with pytest.raises(ValueError):
         generate(params, jnp.asarray(prompt), 4, config,
                  prompt_lengths=np.asarray([3, 6]))
+
+
+def test_param_specs_replicate_on_non_divisible_model_axis():
+    """4 heads on an 8-way model axis must replicate (not crash
+    device_put) — uniformly across the sharded dims."""
+    config = _config()  # 4 heads, d_ff 64, vocab 64
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 8), ("data", "model"))
+    specs = param_specs(config, mesh=mesh)
+    assert specs["layer_0"]["attn"]["wq"] == P(None, None, None)
+    assert specs["layer_0"]["mlp"]["w1"] == P(None, "model")  # 64 % 8 == 0
+    params = shard_params(init_params(config, jax.random.PRNGKey(0)),
+                          config, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    expected = float(lm_loss(init_params(config, jax.random.PRNGKey(0)),
+                             tokens, config))
+    got = float(jax.jit(lambda p, t: lm_loss(p, t, config))(params, tokens))
+    np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
