@@ -46,12 +46,21 @@ class EncDecConfig:
     bos_token_id: int = 1
     eos_token_id: int = 2
     dropout_rate: float = 0.0
+    #: T5-style relative position bias: > 0 adds a learned
+    #: (buckets, heads) bias table to the encoder (bidirectional
+    #: buckets) and decoder (causal buckets) self-attention — 0 disables.
+    #: Shared across layers like T5; cross-attention carries none
+    relative_position_buckets: int = 0
+    #: distances beyond this share the last log-spaced bucket
+    relative_position_max_distance: int = 128
 
     def __post_init__(self):
         if self.d_model % self.num_heads:
             raise ValueError("num_heads must divide d_model")
         if not 0.0 <= self.dropout_rate < 1.0:
             raise ValueError("dropout_rate must be in [0, 1)")
+        if self.relative_position_buckets < 0:
+            raise ValueError("relative_position_buckets must be >= 0")
 
     @property
     def head_dim(self) -> int:
@@ -108,6 +117,17 @@ def init_params(config: EncDecConfig, key) -> Dict:
         "enc_final_ln": _ln(c),
         "dec_final_ln": _ln(c),
     }
+    if c.relative_position_buckets:
+        rk = jax.random.fold_in(keys[0], 7)
+        params["rel_bias"] = {
+            "enc": 0.02 * jax.random.normal(
+                rk, (c.relative_position_buckets, c.num_heads),
+                c.param_dtype),
+            "dec": 0.02 * jax.random.normal(
+                jax.random.fold_in(rk, 1),
+                (c.relative_position_buckets, c.num_heads),
+                c.param_dtype),
+        }
     for i in range(c.num_encoder_layers):
         lk = jax.random.split(keys[2 + i], 6)
         params[f"enc_{i}"] = {
@@ -147,6 +167,13 @@ def param_specs(config: EncDecConfig, model_axis: str = "model",
                   "dec_pos": P(None, None)},
         "enc_final_ln": dict(ln), "dec_final_ln": dict(ln),
     }
+    if c.relative_position_buckets:
+        h_bias_ax = (model_axis
+                     if mesh is None
+                     or _mesh_divides(mesh, model_axis, c.num_heads)
+                     else None)
+        specs["rel_bias"] = {"enc": P(None, h_bias_ax),
+                             "dec": P(None, h_bias_ax)}
     for i in range(c.num_encoder_layers):
         specs[f"enc_{i}"] = {"ln1": dict(ln), "attn": dict(attn),
                              "ln2": dict(ln), "mlp": dict(mlp)}
@@ -157,16 +184,51 @@ def param_specs(config: EncDecConfig, model_axis: str = "model",
     return specs
 
 
+def _relative_buckets(rel_pos: jnp.ndarray, num_buckets: int,
+                      max_distance: int, bidirectional: bool) -> jnp.ndarray:
+    """T5's bucketing (Raffel et al., appendix): half the buckets for
+    exact small offsets, half log-spaced out to ``max_distance``; the
+    bidirectional variant splits buckets between signs."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _rel_bias(table: jnp.ndarray, q_len: int, k_len: int,
+              config: "EncDecConfig", bidirectional: bool) -> jnp.ndarray:
+    """(1, H, Tq, Tk) bias from a (buckets, heads) table."""
+    c = config
+    rel = (jnp.arange(k_len)[None, :] - jnp.arange(q_len)[:, None])
+    buckets = _relative_buckets(rel, c.relative_position_buckets,
+                                c.relative_position_max_distance,
+                                bidirectional)
+    bias = table.astype(jnp.float32)[buckets]        # (Tq, Tk, H)
+    return bias.transpose(2, 0, 1)[None]
+
+
 def _project(h, w, c):
     return jnp.einsum("btd,dhk->bhtk", h, w.astype(c.dtype))
 
 
-def _attend(layer_attn, q_in, kv_in, mask, c):
+def _attend(layer_attn, q_in, kv_in, mask, c, bias=None):
     """Pre-LN'd inputs -> attention output in model dim."""
     q = _project(q_in, layer_attn["wq"], c)
     k = _project(kv_in, layer_attn["wk"], c)
     v = _project(kv_in, layer_attn["wv"], c)
-    o = attention(q, k, v, causal=False, mask=mask)
+    o = attention(q, k, v, causal=False, mask=mask, bias=bias)
     return jnp.einsum("bhtk,hkd->btd", o, layer_attn["wo"].astype(c.dtype))
 
 
@@ -184,6 +246,9 @@ def encode(params: Dict, src: jnp.ndarray, config: EncDecConfig,
     e = params["embed"]
     x = (e["tokens"][src] + e["enc_pos"][:src.shape[1]]).astype(c.dtype)
     src_mask = (src != c.pad_token_id)[:, None, None, :]
+    enc_bias = (_rel_bias(params["rel_bias"]["enc"], src.shape[1],
+                          src.shape[1], c, bidirectional=True)
+                if c.relative_position_buckets else None)
     for i in range(c.num_encoder_layers):
         layer = params[f"enc_{i}"]
         lkey = (jax.random.fold_in(dropout_key, i)
@@ -192,7 +257,8 @@ def encode(params: Dict, src: jnp.ndarray, config: EncDecConfig,
                   else (None, None))
         h = _layer_norm(x, layer["ln1"]["gamma"],
                         layer["ln1"]["beta"]).astype(c.dtype)
-        x = x + _dropout(_attend(layer["attn"], h, h, src_mask, c),
+        x = x + _dropout(_attend(layer["attn"], h, h, src_mask, c,
+                                 bias=enc_bias),
                          c.dropout_rate, ak)
         h = _layer_norm(x, layer["ln2"]["gamma"],
                         layer["ln2"]["beta"]).astype(c.dtype)
@@ -213,6 +279,9 @@ def decode_logits(params: Dict, memory: jnp.ndarray, src: jnp.ndarray,
     t = tgt_in.shape[1]
     causal = (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None])[None, None]
     cross_mask = (src != c.pad_token_id)[:, None, None, :]
+    dec_bias = (_rel_bias(params["rel_bias"]["dec"], t, t, c,
+                          bidirectional=False)
+                if c.relative_position_buckets else None)
     for i in range(c.num_decoder_layers):
         layer = params[f"dec_{i}"]
         lkey = (jax.random.fold_in(dropout_key, 1000 + i)
@@ -221,7 +290,8 @@ def decode_logits(params: Dict, memory: jnp.ndarray, src: jnp.ndarray,
                       else (None, None, None))
         h = _layer_norm(x, layer["ln1"]["gamma"],
                         layer["ln1"]["beta"]).astype(c.dtype)
-        x = x + _dropout(_attend(layer["attn"], h, h, causal, c),
+        x = x + _dropout(_attend(layer["attn"], h, h, causal, c,
+                                 bias=dec_bias),
                          c.dropout_rate, ak)
         h = _layer_norm(x, layer["ln_x"]["gamma"],
                         layer["ln_x"]["beta"]).astype(c.dtype)
@@ -294,6 +364,15 @@ def _dec_step(params: Dict, caches: Dict, cross_kv: Dict, src_mask,
     x = (e["tokens"][tok] + e["dec_pos"][pos]).astype(c.dtype)   # (B, D)
     length = next(iter(caches.values()))["k"].shape[2]
     self_mask = (jnp.arange(length) <= pos)[None, None, :]
+    if c.relative_position_buckets:
+        rel = jnp.arange(length) - pos                     # (L,)
+        buckets = _relative_buckets(rel, c.relative_position_buckets,
+                                    c.relative_position_max_distance,
+                                    bidirectional=False)
+        dec_bias_row = params["rel_bias"]["dec"].astype(
+            jnp.float32)[buckets].T[None]                  # (1, H, L)
+    else:
+        dec_bias_row = None
     new_caches: Dict = {}
     for i in range(c.num_decoder_layers):
         layer = params[f"dec_{i}"]
@@ -308,6 +387,8 @@ def _dec_step(params: Dict, caches: Dict, cross_kv: Dict, src_mask,
         cv = caches[f"dec_{i}"]["v"].at[:, :, pos].set(v_new)
         new_caches[f"dec_{i}"] = {"k": ck, "v": cv}
         s = jnp.einsum("bhk,bhtk->bht", q, ck) * scale
+        if dec_bias_row is not None:
+            s = s + dec_bias_row
         s = jnp.where(self_mask, s, NEG_INF)
         o = jnp.einsum("bht,bhtk->bhk", jax.nn.softmax(s, axis=-1), cv)
         x = x + jnp.einsum("bhk,hkd->bd", o,

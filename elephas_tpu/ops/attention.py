@@ -19,10 +19,15 @@ NEG_INF = -1e30
 
 def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               causal: bool = False,
-              mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Plain softmax attention (reference implementation / XLA-fused path)."""
+              mask: Optional[jnp.ndarray] = None,
+              bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Plain softmax attention (reference implementation / XLA-fused
+    path). ``bias`` (broadcastable to ``(B, H, Tq, Tk)``, e.g. T5
+    relative-position bias) adds to the scaled scores before masking."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias
     if causal:
         q_pos = jnp.arange(q.shape[2])[:, None]
         k_pos = jnp.arange(k.shape[2])[None, :]
