@@ -85,7 +85,9 @@ class TransformerConfig:
     #: table at the embedding; ``rope`` rotates q/k per layer (RoFormer)
     #: — relative positions, no length-bound table, the standard choice
     #: for long-context models; ``sinusoidal`` is the original
-    #: parameter-free sin/cos table (Vaswani et al.)
+    #: parameter-free sin/cos table (Vaswani et al.); ``alibi`` adds the
+    #: per-head linear distance penalty (Press et al.) — parameter-free,
+    #: strong length extrapolation, forces the xla attention path
     positional: str = "learned"
     #: weight of the z-loss term ``mean(logsumexp(logits)^2)`` (PaLM §5):
     #: keeps logits from drifting large, which stabilizes bf16 training
@@ -166,9 +168,11 @@ class TransformerConfig:
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError("norm must be 'layernorm' or 'rmsnorm', "
                              f"got {self.norm!r}")
-        if self.positional not in ("learned", "rope", "sinusoidal"):
-            raise ValueError("positional must be 'learned', 'rope' or "
-                             f"'sinusoidal', got {self.positional!r}")
+        if self.positional not in ("learned", "rope", "sinusoidal",
+                                   "alibi"):
+            raise ValueError(
+                "positional must be 'learned', 'rope', 'sinusoidal' or "
+                f"'alibi', got {self.positional!r}")
         if self.positional == "rope" and self.head_dim % 2:
             raise ValueError("rope requires an even head_dim")
         if self.num_kv_heads is not None and (
@@ -382,6 +386,21 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
                                        and n_devices == 1):
         return "flash"
     return "xla"
+
+
+def _alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head geometric slopes (Press et al.): for 2^n heads,
+    2^(-8i/n); other counts interpolate the same way HF/ALiBi do."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-8.0 / n)
+        return [start ** (i + 1) for i in range(n)]
+
+    n = 2 ** math.floor(math.log2(num_heads))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)[0::2][:num_heads - n]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)
 
 
 def _apply_rope(x, positions, config: "TransformerConfig"):
@@ -895,8 +914,8 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
     aux_total = jnp.zeros((), jnp.float32)
     attn_impl = select_attention_impl(c, mesh, seq_axis, batch_axis,
                                       model_axis, tokens.shape[0])
-    if segment_ids is not None:
-        attn_impl = "xla"  # the segment mask lives in the xla path only
+    if segment_ids is not None or c.positional == "alibi":
+        attn_impl = "xla"  # segment masks / alibi bias live here only
     if attn_impl == "ring":
         attn_fn = partial(ring_attention_sharded, mesh=mesh,
                           seq_axis=seq_axis, causal=True,
@@ -919,7 +938,8 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         attn_fn = partial(flash_attention, causal=True,
                           window=c.attention_window)
         attn_fn.handles_gqa = True
-    elif segment_ids is not None or c.attention_window is not None:
+    elif (segment_ids is not None or c.attention_window is not None
+          or c.positional == "alibi"):
         t = tokens.shape[1]
         q_pos = jnp.arange(t)[:, None]
         k_pos = jnp.arange(t)[None, :]
@@ -930,7 +950,12 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
             same = (segment_ids[:, None, :, None]
                     == segment_ids[:, None, None, :])  # (B, 1, T, T)
             mask = mask & same & (segment_ids > 0)[:, None, None, :]
-        attn_fn = partial(attention, causal=False, mask=mask)
+        bias = None
+        if c.positional == "alibi":
+            slopes = _alibi_slopes(c.num_heads)        # (H,)
+            dist = (q_pos - k_pos).astype(jnp.float32)  # (T, T)
+            bias = (-slopes[:, None, None] * dist)[None]  # (1, H, T, T)
+        attn_fn = partial(attention, causal=False, mask=mask, bias=bias)
     else:
         attn_fn = partial(attention, causal=True)
 
@@ -1428,6 +1453,12 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
         groups = c.num_heads // c.kv_heads
         qg = q.reshape(q.shape[0], c.kv_heads, groups, c.head_dim)
         scores = jnp.einsum("bngk,bntk->bngt", qg, ck) * scale
+        if c.positional == "alibi":
+            dist = (pos - positions).astype(jnp.float32)     # (L,)
+            ab = (-_alibi_slopes(c.num_heads)[:, None]
+                  * dist[None, :]).reshape(
+                      c.kv_heads, groups, length)            # (n, g, L)
+            scores = scores + ab[None]
         scores = jnp.where(mask[:, :, None, :], scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bngt,bntk->bngk", weights, cv)

@@ -1892,3 +1892,41 @@ def test_param_specs_replicate_on_non_divisible_model_axis():
                              tokens, config))
     got = float(jax.jit(lambda p, t: lm_loss(p, t, config))(params, tokens))
     np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+
+def test_alibi_positions_decode_parity_and_extrapolation():
+    import dataclasses
+
+    from elephas_tpu.models.transformer import (_alibi_slopes, decode_step,
+                                                init_kv_cache)
+
+    slopes = np.asarray(_alibi_slopes(8))
+    np.testing.assert_allclose(slopes[0], 2 ** -1.0, rtol=1e-6)
+    np.testing.assert_allclose(slopes[-1], 2 ** -8.0, rtol=1e-6)
+
+    config = dataclasses.replace(_config(), positional="alibi")
+    params = init_params(config, jax.random.PRNGKey(0))
+    assert "pos" not in params["embed"]
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 10),
+                                           0, 64))
+    full = np.asarray(forward(params, jnp.asarray(tokens), config))
+    # position-sensitive
+    base = dataclasses.replace(_config(), positional="sinusoidal")
+    cache = init_kv_cache(config, 2, max_len=10)
+    for t in range(10):
+        logits, cache = decode_step(params, cache,
+                                    jnp.asarray(tokens[:, t]), t, config)
+        np.testing.assert_allclose(np.asarray(logits), full[:, t],
+                                   atol=2e-4, rtol=2e-4)
+    # trains, and runs BEYOND max_seq_len (no positional table bound)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = make_train_step(config, tx)
+    first = None
+    for _ in range(6):
+        params, opt, loss = step(params, opt, jnp.asarray(tokens))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    long_tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 48), 0, 64)
+    out = forward(params, long_tokens, config)  # 48 > max_seq_len=32
+    assert np.isfinite(np.asarray(out)).all()
