@@ -65,6 +65,9 @@ class TransformerModel:
         over the data axis (ZeRO-3 via
         :func:`~elephas_tpu.models.transformer.fsdp_param_specs`);
         composes with ``tensor_parallel``, supersedes ``zero_optimizer``
+    :param sequence_parallel: mesh size of the ``seq`` axis — long-
+        context training via ring attention (k/v shards stream around
+        the seq ring); sequence length must divide by it
     :param grad_accum: accumulate gradients over this many microbatches
         per optimizer step (each fit batch splits into ``grad_accum``
         microbatches; identical numerics, 1/``grad_accum`` the activation
@@ -74,11 +77,12 @@ class TransformerModel:
     def __init__(self, config: TransformerConfig,
                  tensor_parallel: int = 1, name: Optional[str] = None,
                  zero_optimizer: bool = False, grad_accum: int = 1,
-                 fsdp: bool = False):
+                 fsdp: bool = False, sequence_parallel: int = 1):
         if fsdp and zero_optimizer:
             raise ValueError("fsdp supersedes zero_optimizer — pick one")
         self.config = config
         self.tensor_parallel = int(tensor_parallel)
+        self.sequence_parallel = int(sequence_parallel)
         self.fsdp = bool(fsdp)
         self.zero_optimizer = bool(zero_optimizer)
         self.grad_accum = max(1, int(grad_accum))
@@ -207,6 +211,7 @@ class TransformerModel:
     def get_config(self) -> Dict:
         return {"name": self.name,
                 "tensor_parallel": self.tensor_parallel,
+                "sequence_parallel": self.sequence_parallel,
                 "zero_optimizer": self.zero_optimizer,
                 "grad_accum": self.grad_accum,
                 "fsdp": self.fsdp,
@@ -225,20 +230,24 @@ class TransformerModel:
                    name=config.get("name"),
                    zero_optimizer=config.get("zero_optimizer", False),
                    grad_accum=config.get("grad_accum", 1),
-                   fsdp=config.get("fsdp", False))
+                   fsdp=config.get("fsdp", False),
+                   sequence_parallel=config.get("sequence_parallel", 1))
 
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
-        """dp×tp mesh over the visible devices (None on a single chip)."""
+        """dp×tp(×sp) mesh over the visible devices (None on one chip)."""
         devices = jax.devices()
-        tp = self.tensor_parallel
-        if len(devices) == 1 and tp == 1:
+        tp, sp = self.tensor_parallel, self.sequence_parallel
+        if len(devices) == 1 and tp == 1 and sp == 1:
             return None
-        if len(devices) % tp:
+        if len(devices) % (tp * sp):
             raise ValueError(
-                f"tensor_parallel={tp} does not divide the "
-                f"{len(devices)}-device mesh")
-        dp = len(devices) // tp
+                f"tensor_parallel={tp} x sequence_parallel={sp} does not "
+                f"divide the {len(devices)}-device mesh")
+        dp = len(devices) // (tp * sp)
+        if sp > 1:
+            return Mesh(np.array(devices).reshape(dp, tp, sp),
+                        ("data", "model", "seq"))
         return Mesh(np.array(devices).reshape(dp, tp), ("data", "model"))
 
     def fit_tokens(self, tokens: np.ndarray, epochs: int = 1,
@@ -284,7 +293,9 @@ class TransformerModel:
             raise ValueError(
                 f"batch_size={batch_size} does not split into "
                 f"{self.grad_accum} gradient-accumulation microbatches")
+        sp = self.sequence_parallel
         step = make_train_step(self.config, self._tx, mesh=mesh,
+                               seq_axis="seq" if sp > 1 else None,
                                zero_optimizer=self.zero_optimizer,
                                accum_steps=self.grad_accum,
                                fsdp=self.fsdp and mesh is not None)
@@ -294,6 +305,8 @@ class TransformerModel:
         eval_loss = jax.jit(
             lambda p, t: lm_loss(p, t, self.config,
                                  mesh=mesh,
+                                 seq_axis=("seq" if mesh is not None
+                                           and sp > 1 else None),
                                  batch_axis="data" if mesh else None,
                                  model_axis="model" if mesh else None))
 
@@ -320,7 +333,14 @@ class TransformerModel:
             losses = []
             for i in range(nb):
                 xb = shuffled[i * batch_size:(i + 1) * batch_size]
-                if mesh is not None:
+                if mesh is not None and sp > 1:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as _P
+
+                    xb = jax.device_put(
+                        jnp.asarray(xb),
+                        NamedSharding(mesh, _P("data", "seq")))
+                elif mesh is not None:
                     # shard_leading routes through global-array assembly
                     # on process-spanning meshes (multi-host DCN), plain
                     # device_put otherwise
@@ -340,8 +360,17 @@ class TransformerModel:
             timer.stop()
             history["epoch_time"].append(timer.durations[-1])
             if n_val:
-                vb = (shard_leading(mesh, "data", val_tokens)
-                      if mesh is not None else jnp.asarray(val_tokens))
+                if mesh is not None and sp > 1:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as _P
+
+                    vb = jax.device_put(
+                        jnp.asarray(val_tokens),
+                        NamedSharding(mesh, _P("data", "seq")))
+                elif mesh is not None:
+                    vb = shard_leading(mesh, "data", val_tokens)
+                else:
+                    vb = jnp.asarray(val_tokens)
                 logs["val_loss"] = float(eval_loss(params, vb))
             for k, v in logs.items():
                 history[k].append(v)

@@ -293,3 +293,22 @@ def test_beam_search_through_model_surface():
     assert (np.diff(scores, axis=1) <= 1e-5).all()  # best first
     one, _ = model.beam_search(prompt, 6, num_beams=1)
     np.testing.assert_array_equal(one[:, 0], model.generate(prompt, 6))
+
+
+def test_sequence_parallel_through_model_surface():
+    """dp x tp x sp training via the adapter: ring attention over the
+    seq axis, histories sane, config round-trips."""
+    model = TransformerModel(_config(), tensor_parallel=2,
+                             sequence_parallel=2)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32, seq=16), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.25)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][1] < history["loss"][0]
+    assert np.isfinite(history["val_loss"][-1])
+    clone = model_from_json(model.to_json())
+    assert clone.sequence_parallel == 2
+    with pytest.raises(ValueError):
+        TransformerModel(_config(), tensor_parallel=3,
+                         sequence_parallel=3)._training_mesh()
