@@ -1,0 +1,46 @@
+"""Packed-sequence LM pretraining with the modern-config transformer.
+
+The realistic pretraining data path: greedy document packing (segment
+ids, no cross-document attention), RoPE + GQA + SwiGLU + RMSNorm
+architecture, chunked-vocab loss, and residual dropout — all through the
+standard ``make_train_step(packed=True)``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elephas_tpu.models.transformer import (TransformerConfig, init_params,
+                                            make_train_step)
+from elephas_tpu.utils.text import ByteTokenizer
+
+tok = ByteTokenizer()
+docs = ["the quick brown fox jumps over the lazy dog. ",
+        "pack my box with five dozen liquor jugs! ",
+        "sphinx of black quartz, judge my vow. "] * 40
+rows, segs = tok.pack_documents(docs, seq_len=64)
+print(f"packed {len(docs)} docs into {rows.shape[0]} rows of 64 "
+      f"({100 * (segs > 0).mean():.0f}% non-pad)")
+
+config = TransformerConfig(vocab_size=tok.vocab_size, num_layers=2,
+                           num_heads=4, num_kv_heads=2, d_model=64,
+                           d_ff=128, max_seq_len=64, positional="rope",
+                           mlp_variant="swiglu", norm="rmsnorm",
+                           loss_vocab_chunk=128, dropout_rate=0.1,
+                           dtype=jnp.float32)
+params = init_params(config, jax.random.PRNGKey(0))
+tx = optax.adamw(3e-3)
+opt = tx.init(params)
+step = make_train_step(config, tx, packed=True)
+
+tokens, segments = jnp.asarray(rows), jnp.asarray(segs)
+for i in range(40):
+    params, opt, loss = step(params, opt, tokens,
+                             jax.random.PRNGKey(i), segments)
+    if (i + 1) % 10 == 0:
+        print(f"step {i + 1}: loss {float(loss):.4f}")
