@@ -68,6 +68,9 @@ class TransformerModel:
     :param sequence_parallel: mesh size of the ``seq`` axis — long-
         context training via ring attention (k/v shards stream around
         the seq ring); sequence length must divide by it
+    :param ema_decay: keep an exponential moving average of the
+        parameters (updated on-device each optimizer step) — the
+        standard serving-quality trick; ``apply_ema()`` swaps it in
     :param grad_accum: accumulate gradients over this many microbatches
         per optimizer step (each fit batch splits into ``grad_accum``
         microbatches; identical numerics, 1/``grad_accum`` the activation
@@ -77,12 +80,17 @@ class TransformerModel:
     def __init__(self, config: TransformerConfig,
                  tensor_parallel: int = 1, name: Optional[str] = None,
                  zero_optimizer: bool = False, grad_accum: int = 1,
-                 fsdp: bool = False, sequence_parallel: int = 1):
+                 fsdp: bool = False, sequence_parallel: int = 1,
+                 ema_decay: Optional[float] = None):
         if fsdp and zero_optimizer:
             raise ValueError("fsdp supersedes zero_optimizer — pick one")
+        if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+            raise ValueError("ema_decay must be in (0, 1)")
         self.config = config
         self.tensor_parallel = int(tensor_parallel)
         self.sequence_parallel = int(sequence_parallel)
+        self.ema_decay = ema_decay
+        self.ema_params: Optional[Dict] = None
         self.fsdp = bool(fsdp)
         self.zero_optimizer = bool(zero_optimizer)
         self.grad_accum = max(1, int(grad_accum))
@@ -215,6 +223,7 @@ class TransformerModel:
                 "zero_optimizer": self.zero_optimizer,
                 "grad_accum": self.grad_accum,
                 "fsdp": self.fsdp,
+                "ema_decay": self.ema_decay,
                 "transformer_config": _config_to_dict(self.config)}
 
     def to_json(self, **kwargs) -> str:
@@ -231,7 +240,8 @@ class TransformerModel:
                    zero_optimizer=config.get("zero_optimizer", False),
                    grad_accum=config.get("grad_accum", 1),
                    fsdp=config.get("fsdp", False),
-                   sequence_parallel=config.get("sequence_parallel", 1))
+                   sequence_parallel=config.get("sequence_parallel", 1),
+                   ema_decay=config.get("ema_decay"))
 
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
@@ -312,6 +322,16 @@ class TransformerModel:
 
         from ..utils.tracing import StepTimer
 
+        ema_update = None
+        if self.ema_decay is not None:
+            decay = float(self.ema_decay)
+            ema_update = jax.jit(lambda e, p: jax.tree_util.tree_map(
+                lambda a, b: decay * a + (1.0 - decay) * b, e, p))
+            if self.ema_params is None:
+                # a REAL copy: the train step donates its param buffers,
+                # so aliasing them here would read deleted memory
+                self.ema_params = jax.tree_util.tree_map(jnp.copy, params)
+
         rng = np.random.default_rng(seed)
         use_dropout = self.config.dropout_rate > 0
         dropout_base = jax.random.PRNGKey(seed)
@@ -354,6 +374,8 @@ class TransformerModel:
                 else:
                     params, opt_state, loss = step(params, opt_state, xb)
                 losses.append(loss)
+                if ema_update is not None:
+                    self.ema_params = ema_update(self.ema_params, params)
             # the float() fetches block on the epoch's dispatched steps,
             # so the recorded wall time is real (tracing requirement)
             logs = {"loss": float(np.mean([float(l) for l in losses]))}
@@ -409,6 +431,15 @@ class TransformerModel:
             epoch_callback=epoch_cb if cbs else None)
         cbs.train_end()
         return history
+
+    def apply_ema(self):
+        """Swap the EMA average in as the live parameters (returns the
+        raw training params so callers can swap back)."""
+        if self.ema_params is None:
+            raise RuntimeError("no EMA state — set ema_decay and fit first")
+        raw = self.params
+        self.params = jax.tree_util.tree_map(jnp.asarray, self.ema_params)
+        return raw
 
     def save(self, filepath: str, overwrite: bool = True,
              include_optimizer: bool = True):

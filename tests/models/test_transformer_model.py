@@ -312,3 +312,31 @@ def test_sequence_parallel_through_model_surface():
     with pytest.raises(ValueError):
         TransformerModel(_config(), tensor_parallel=3,
                          sequence_parallel=3)._training_mesh()
+
+
+def test_ema_weights_track_and_apply():
+    model = TransformerModel(_config(), ema_decay=0.5)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    assert model.ema_params is not None
+    # EMA lags the live params but is not equal to the init
+    init = TransformerModel(_config())
+    init.compile(Adam(learning_rate=1e-2), seed=0)
+    diffs_live = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                  for a, b in zip(jax.tree_util.tree_leaves(model.ema_params),
+                                  jax.tree_util.tree_leaves(model.params))]
+    diffs_init = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                  for a, b in zip(jax.tree_util.tree_leaves(model.ema_params),
+                                  jax.tree_util.tree_leaves(init.params))]
+    assert max(diffs_live) > 0 and max(diffs_init) > 0
+    raw = model.apply_ema()
+    for a, b in zip(jax.tree_util.tree_leaves(model.params),
+                    jax.tree_util.tree_leaves(model.ema_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    model.params = raw  # swap back
+    clone = model_from_json(model.to_json())
+    assert clone.ema_decay == 0.5
+    with pytest.raises(ValueError):
+        TransformerModel(_config(), ema_decay=1.5)
