@@ -419,8 +419,10 @@ def _cross_kv(params, memory, config: EncDecConfig):
         for i in range(config.num_decoder_layers)}
 
 
-@functools.partial(jax.jit, static_argnames=("max_len", "config"))
-def _greedy_scan(params, src, max_len: int, config: EncDecConfig):
+@functools.partial(jax.jit, static_argnames=("max_len", "config",
+                                              "sample"))
+def _decode_scan(params, src, max_len: int, config: EncDecConfig,
+                 sample: bool = False, temperature=1.0, key=None):
     c = config
     memory = encode(params, src, c)
     cross = _cross_kv(params, memory, c)
@@ -430,29 +432,38 @@ def _greedy_scan(params, src, max_len: int, config: EncDecConfig):
         "k": jnp.zeros((batch, c.num_heads, max_len, c.head_dim), c.dtype),
         "v": jnp.zeros((batch, c.num_heads, max_len, c.head_dim), c.dtype)}
         for i in range(c.num_decoder_layers)}
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     def step_fn(carry, pos):
-        caches, tok, done = carry
+        caches, tok, done, key = carry
         logits, caches = _dec_step(params, caches, cross, src_mask, tok,
                                    pos, c)
-        nxt = jnp.argmax(logits, axis=-1).astype(src.dtype)
+        if sample:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, logits / temperature, axis=-1).astype(src.dtype)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(src.dtype)
         nxt = jnp.where(done, jnp.asarray(c.eos_token_id, src.dtype), nxt)
         done = done | (nxt == c.eos_token_id)
-        return (caches, nxt, done), nxt
+        return (caches, nxt, done, key), nxt
 
     bos = jnp.full((batch,), c.bos_token_id, src.dtype)
-    (_, _, _), out = jax.lax.scan(
-        step_fn, (caches, bos, jnp.zeros((batch,), bool)),
+    (_, _, _, _), out = jax.lax.scan(
+        step_fn, (caches, bos, jnp.zeros((batch,), bool), key),
         jnp.arange(max_len))
     return out.T
 
 
 def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
-                  config: EncDecConfig) -> jnp.ndarray:
-    """Greedy seq2seq decoding: ``(B, S)`` source ids -> ``(B, max_len)``
+                  config: EncDecConfig, temperature: float = 0.0,
+                  key=None) -> jnp.ndarray:
+    """Seq2seq decoding: ``(B, S)`` source ids -> ``(B, max_len)``
     target ids, stopping per row at eos (subsequent positions emit eos).
-    One module-level jitted scan (compiled once per shape/config);
-    cross-attention K/V computed once inside it."""
+    ``temperature=0`` is greedy argmax; otherwise categorical sampling
+    (``key`` required). One module-level jitted scan (compiled once per
+    shape/config); cross-attention K/V computed once inside it."""
     c = config
     src = jnp.asarray(src)
     if max_len > c.max_seq_len:
@@ -461,4 +472,9 @@ def greedy_decode(params: Dict, src: jnp.ndarray, max_len: int,
     if src.shape[1] > c.max_seq_len:
         raise ValueError(f"source length {src.shape[1]} exceeds "
                          f"max_seq_len {c.max_seq_len}")
-    return _greedy_scan(params, src, int(max_len), c)
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    return _decode_scan(params, src, int(max_len), c,
+                        sample=temperature > 0,
+                        temperature=jnp.float32(temperature or 1.0),
+                        key=key)

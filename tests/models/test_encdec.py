@@ -202,3 +202,20 @@ def test_relative_position_bias():
     g = jax.grad(seq2seq_loss)(params, src, tgt, config)
     assert np.abs(np.asarray(g["rel_bias"]["dec"])).sum() > 0
     jax.tree_util.tree_map(lambda p, s: None, params, param_specs(config))
+
+
+def test_sampled_decoding():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    src, _ = _copy_data(4, 6)
+    g = np.asarray(greedy_decode(params, src, 5, config))
+    s1 = np.asarray(greedy_decode(params, src, 5, config, temperature=1.0,
+                                  key=jax.random.PRNGKey(1)))
+    s2 = np.asarray(greedy_decode(params, src, 5, config, temperature=1.0,
+                                  key=jax.random.PRNGKey(1)))
+    s3 = np.asarray(greedy_decode(params, src, 5, config, temperature=1.0,
+                                  key=jax.random.PRNGKey(2)))
+    np.testing.assert_array_equal(s1, s2)  # same key deterministic
+    assert not np.array_equal(s1, s3) or not np.array_equal(s1, g)
+    with pytest.raises(ValueError):
+        greedy_decode(params, src, 5, config, temperature=1.0)
