@@ -71,6 +71,11 @@ class TransformerModel:
     :param ema_decay: keep an exponential moving average of the
         parameters (updated on-device each optimizer step) — the
         standard serving-quality trick; ``apply_ema()`` swaps it in
+    :param mesh: explicit training mesh (e.g. a
+        :func:`~elephas_tpu.parallel.hybrid_mesh` spanning hosts) —
+        must carry a ``data`` axis and, for tp/sp, ``model``/``seq``
+        axes; overrides the tensor_parallel/sequence_parallel-derived
+        mesh
     :param grad_accum: accumulate gradients over this many microbatches
         per optimizer step (each fit batch splits into ``grad_accum``
         microbatches; identical numerics, 1/``grad_accum`` the activation
@@ -81,7 +86,8 @@ class TransformerModel:
                  tensor_parallel: int = 1, name: Optional[str] = None,
                  zero_optimizer: bool = False, grad_accum: int = 1,
                  fsdp: bool = False, sequence_parallel: int = 1,
-                 ema_decay: Optional[float] = None):
+                 ema_decay: Optional[float] = None,
+                 mesh: Optional[Mesh] = None):
         if fsdp and zero_optimizer:
             raise ValueError("fsdp supersedes zero_optimizer — pick one")
         if ema_decay is not None and not 0.0 < ema_decay < 1.0:
@@ -91,6 +97,9 @@ class TransformerModel:
         self.sequence_parallel = int(sequence_parallel)
         self.ema_decay = ema_decay
         self.ema_params: Optional[Dict] = None
+        if mesh is not None and "data" not in mesh.axis_names:
+            raise ValueError("an explicit mesh must carry a 'data' axis")
+        self._explicit_mesh = mesh
         self.fsdp = bool(fsdp)
         self.zero_optimizer = bool(zero_optimizer)
         self.grad_accum = max(1, int(grad_accum))
@@ -246,6 +255,8 @@ class TransformerModel:
     # ------------------------------------------------------------- training
     def _training_mesh(self) -> Optional[Mesh]:
         """dp×tp(×sp) mesh over the visible devices (None on one chip)."""
+        if self._explicit_mesh is not None:
+            return self._explicit_mesh
         devices = jax.devices()
         tp, sp = self.tensor_parallel, self.sequence_parallel
         if len(devices) == 1 and tp == 1 and sp == 1:
@@ -304,6 +315,9 @@ class TransformerModel:
                 f"batch_size={batch_size} does not split into "
                 f"{self.grad_accum} gradient-accumulation microbatches")
         sp = self.sequence_parallel
+        if mesh is not None and "seq" in mesh.axis_names:
+            sp = max(sp, dict(zip(mesh.axis_names,
+                                  mesh.devices.shape))["seq"])
         step = make_train_step(self.config, self._tx, mesh=mesh,
                                seq_axis="seq" if sp > 1 else None,
                                zero_optimizer=self.zero_optimizer,

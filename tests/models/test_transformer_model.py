@@ -340,3 +340,20 @@ def test_ema_weights_track_and_apply():
     assert clone.ema_decay == 0.5
     with pytest.raises(ValueError):
         TransformerModel(_config(), ema_decay=1.5)
+
+
+def test_explicit_mesh_override():
+    from jax.sharding import Mesh as _Mesh
+
+    mesh = _Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    model = TransformerModel(_config(), mesh=mesh)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    assert model._training_mesh() is mesh
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][1] < history["loss"][0]
+    with pytest.raises(ValueError):
+        TransformerModel(_config(),
+                         mesh=_Mesh(np.array(jax.devices()), ("x",)))
