@@ -357,3 +357,17 @@ def test_explicit_mesh_override():
     with pytest.raises(ValueError):
         TransformerModel(_config(),
                          mesh=_Mesh(np.array(jax.devices()), ("x",)))
+
+
+def test_zero_optimizer_with_dropout_through_model_surface():
+    import dataclasses
+
+    config = dataclasses.replace(_config(), dropout_rate=0.1)
+    model = TransformerModel(config, tensor_parallel=2,
+                             zero_optimizer=True)
+    model.compile(Adam(learning_rate=1e-2), seed=0)
+    tpu_model = TPUModel(model, mode="synchronous")
+    tpu_model.fit(_tokens(32), epochs=2, batch_size=8, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert np.isfinite(history["loss"][-1])
