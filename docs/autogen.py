@@ -91,6 +91,7 @@ PAGES = [
     ("Native acceleration", "elephas_tpu.utils.native",
      ["build", "available", "NativeBatchLoader", "batch_iterator"]),
     ("Text utilities", "elephas_tpu.utils.text", ["ByteTokenizer"]),
+    ("Serving", "elephas_tpu.serving", ["TextGenerator"]),
     ("Tracing", "elephas_tpu.utils.tracing",
      ["StepTimer", "profiler_trace", "annotate"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",

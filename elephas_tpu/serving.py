@@ -1,0 +1,66 @@
+"""Serving conveniences: strings in -> strings out.
+
+``TextGenerator`` ties a tokenizer to the transformer LM's batched
+ragged-prompt decode loop: prompts of different lengths batch into one
+jitted scan (right-padded + per-row lengths), with the full sampling
+suite (temperature / top-k / nucleus / repetition penalty).
+"""
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .models.transformer import TransformerConfig, generate
+from .utils.text import ByteTokenizer
+
+__all__ = ["TextGenerator"]
+
+
+class TextGenerator:
+    """Batched text generation over a parameter pytree + config.
+
+    :param params: transformer parameter pytree (may be mesh-sharded —
+        the decode scan partitions through GSPMD)
+    :param config: the model's :class:`TransformerConfig`
+    :param tokenizer: object with ``encode(str) -> List[int]`` and
+        ``decode(ids) -> str`` (default: :class:`ByteTokenizer`)
+    """
+
+    def __init__(self, params, config: TransformerConfig, tokenizer=None):
+        self.params = params
+        self.config = config
+        self.tokenizer = tokenizer or ByteTokenizer()
+
+    def __call__(self, prompts: Sequence[str], max_new_tokens: int = 64,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 repetition_penalty: float = 1.0,
+                 seed: int = 0,
+                 stop_id: Optional[int] = None) -> List[str]:
+        tok = self.tokenizer
+        encoded = [tok.encode(p) for p in prompts]
+        lens = np.asarray([len(e) for e in encoded], np.int32)
+        if lens.min() < 1:
+            raise ValueError("prompts must encode to at least one token")
+        lmax = int(lens.max())
+        pad = getattr(tok, "pad_id", 0)
+        batch = np.full((len(encoded), lmax), pad, np.int32)
+        for i, e in enumerate(encoded):
+            batch[i, :len(e)] = e
+
+        out = np.asarray(generate(
+            self.params, batch, int(max_new_tokens), self.config,
+            temperature=temperature, key=jax.random.PRNGKey(seed),
+            top_k=top_k, top_p=top_p,
+            repetition_penalty=repetition_penalty,
+            prompt_lengths=lens))
+
+        stop = stop_id if stop_id is not None else getattr(tok, "eos_id",
+                                                           None)
+        texts = []
+        for row in out:
+            ids = list(row)
+            if stop is not None and stop in ids:
+                ids = ids[:ids.index(stop)]
+            texts.append(tok.decode(ids))
+        return texts
