@@ -22,4 +22,5 @@ if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
 
 from . import models, utils
 from .data import Dataset
+from .serving import TextGenerator
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model
