@@ -216,7 +216,6 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # the trailing grid axis enumerates (group member, q block): every
     # query head sharing this kv head accumulates into the same dk/dv
     qi = t % nq_blocks
-    nq = nq_blocks
     total = pl.num_programs(2)
 
     @pl.when(t == 0)
