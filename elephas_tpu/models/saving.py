@@ -82,12 +82,14 @@ _CONFIG_CLASSES = {}
 def _config_registry():
     if not _CONFIG_CLASSES:
         from .bert import BertConfig
+        from .encdec import EncDecConfig
         from .transformer import TransformerConfig
         from .vit import ViTConfig
 
         _CONFIG_CLASSES.update({"TransformerConfig": TransformerConfig,
                                 "ViTConfig": ViTConfig,
-                                "BertConfig": BertConfig})
+                                "BertConfig": BertConfig,
+                                "EncDecConfig": EncDecConfig})
     return _CONFIG_CLASSES
 
 

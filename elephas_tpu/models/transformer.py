@@ -1022,7 +1022,9 @@ def lm_loss(params: Dict, tokens: jnp.ndarray, config: TransformerConfig,
     weights = (segment_target_weights(segment_ids)
                if segment_ids is not None else None)
     chunk = config.loss_vocab_chunk
-    if chunk and (mesh is None or model_axis is None):
+    vocab_sharded = (mesh is not None and model_axis is not None
+                     and mesh.shape.get(model_axis, 1) > 1)
+    if chunk and not vocab_sharded:
         x, aux = _hidden_with_aux(params, tokens, config, mesh=mesh,
                                   seq_axis=seq_axis, batch_axis=batch_axis,
                                   model_axis=model_axis,
@@ -1548,13 +1550,18 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
             logits = jnp.where(seen, penalized, logits)
         if sample:
             key, sub = jax.random.split(key)
-            filtered = _filter_logits(logits, top_k, top_p)
-            nxt = jax.random.categorical(sub, filtered / temperature,
-                                         axis=-1)
+            # temperature first, then top-k/top-p: the nucleus is chosen
+            # on the tempered distribution (conventional HF/CTRL order)
+            filtered = _filter_logits(logits / temperature, top_k, top_p)
+            nxt = jax.random.categorical(sub, filtered, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         if use_rep_penalty:
-            seen = seen.at[jnp.arange(batch), nxt].set(True)
+            # only tokens actually fed back (emitted) mark the presence
+            # buffer; samples discarded for prompt positions scatter out
+            # of range and drop — 'prompt or emitted so far' semantics
+            mark = jnp.where(t + 1 >= lens, nxt, c.vocab_size)
+            seen = seen.at[jnp.arange(batch), mark].set(True, mode="drop")
         return (cache, nxt, key, seen), nxt
 
     (_, _, _, _), sampled = jax.lax.scan(
