@@ -17,11 +17,28 @@ Prints ONE JSON line:
      "transformer": {"tokens_per_sec": T, "mfu": M,
                      "xla_tokens_per_sec": Tx, "flash_speedup": S, ...}}
 where vs_baseline = framework_throughput / pure_jax_throughput.
+
+**Tunnel resilience** (this environment reaches its one TPU chip through
+a tunnel that can hang — not error — for hours): the default entry point
+is an orchestrator that runs the actual measurement in a *subprocess*
+with a hard timeout, retries with backoff across a bounded window
+(``ELEPHAS_BENCH_WINDOW_SEC``, default 1500s; per-attempt cap
+``ELEPHAS_BENCH_ATTEMPT_SEC``, default 600s), and — if no attempt
+succeeds — falls back to the last successful on-chip numbers
+(``benchmarks/last_good.json``) with ``"stale": true`` so one tunnel
+flap does not erase the round's perf record. ``python bench.py --child``
+runs the measurement directly.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "last_good.json")
 
 #: advertised peak dense-matmul TFLOP/s per JAX device (bf16), by device
 #: kind prefix — the MFU denominator. v2/v3 expose one device per CORE
@@ -179,7 +196,7 @@ def bench_transformer(attention_impl: str, steps: int = 20,
     return tokens_per_sec, mfu
 
 
-def main():
+def child_main():
     import jax
 
     batch_size = 64
@@ -192,6 +209,8 @@ def main():
         "value": round(framework, 1),
         "unit": "samples/sec",
         "vs_baseline": round(framework / pure, 4),
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
     }
 
     xla_tps, xla_mfu = bench_transformer("xla")
@@ -227,5 +246,87 @@ def main():
     print(json.dumps(result))
 
 
+def _parse_result(stdout: str):
+    """Last stdout line that parses as the result JSON, or None."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def main():
+    """Orchestrator: bounded attempts + backoff + last-good fallback."""
+    window = float(os.environ.get("ELEPHAS_BENCH_WINDOW_SEC", "1500"))
+    attempt_cap = float(os.environ.get("ELEPHAS_BENCH_ATTEMPT_SEC", "600"))
+    deadline = time.monotonic() + window
+    backoff = 30.0
+    attempt = 0
+    non_tpu_runs = 0
+    while True:
+        attempt += 1
+        budget = min(attempt_cap, max(60.0, deadline - time.monotonic()))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=budget)
+            result = _parse_result(proc.stdout)
+        except subprocess.TimeoutExpired:
+            result = None
+            proc = None
+        if result is not None and result.get("backend") != "tpu":
+            # a CPU-fallback run must never be recorded as a chip number;
+            # stale real-chip numbers beat fresh host numbers here
+            print(f"# bench attempt {attempt} ran on "
+                  f"{result.get('backend')}, not tpu — discarded",
+                  file=sys.stderr)
+            result = None
+            non_tpu_runs += 1
+            if non_tpu_runs >= 2:
+                # the child completes fine but no TPU is configured —
+                # retrying cannot change that; emit the fallback now
+                # instead of idling through the whole window
+                break
+        if result is not None:
+            result["stale"] = False
+            try:
+                os.makedirs(os.path.dirname(_LAST_GOOD), exist_ok=True)
+                with open(_LAST_GOOD, "w") as f:
+                    json.dump(result, f, indent=1)
+            except OSError:
+                pass  # read-only checkout: still report the fresh numbers
+            print(json.dumps(result))
+            return 0
+        detail = ("attempt timed out" if proc is None else
+                  (proc.stderr or "").strip().splitlines()[-1:] or ["?"])
+        print(f"# bench attempt {attempt} failed: {detail}", file=sys.stderr)
+        if time.monotonic() + backoff >= deadline:
+            break
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 300.0)
+    # window exhausted: emit the last on-chip numbers, marked stale, so
+    # the round keeps a perf record even when the tunnel is down
+    try:
+        with open(_LAST_GOOD) as f:
+            last = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print(json.dumps({"metric": "bench_unavailable", "value": 0,
+                          "unit": "none", "vs_baseline": 0,
+                          "error": "TPU unreachable and no last-good"}))
+        return 1
+    last["stale"] = True
+    print(json.dumps(last))
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        child_main()
+    else:
+        sys.exit(main())
