@@ -41,6 +41,12 @@ from .utils.serialization import model_to_dict
 from .worker import AsyncWorker
 
 
+def _temp_model_path(file_name: str) -> str:
+    """Unique local staging filename carrying ``file_name``'s suffix —
+    used for hadoop/object-store transfers in both directions."""
+    return str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
+
+
 class _EpochAggregator:
     """Turns per-worker epoch completions into driver-level epoch_end.
 
@@ -203,16 +209,31 @@ class TPUModel:
     def save(self, file_name: str, overwrite: bool = False,
              to_hadoop: bool = False):
         """Save model + distributed config to h5/keras, optionally pushing
-        the file to a Hadoop cluster (parity: ``elephas/spark_model.py:92-134``)."""
+        the file to a Hadoop cluster (parity: ``elephas/spark_model.py:92-134``).
+
+        ``file_name`` may also be an object-store URL (``gs://...``,
+        ``s3://...`` — the Cloud TPU analog of the hadoop path): the file
+        is written locally and uploaded through the scheme's registered
+        :mod:`~elephas_tpu.utils.storage` adapter."""
         assert (file_name[-3:] == ".h5" or file_name[-6:] == ".keras"), \
             "File name must end with either '.h5' or '.keras'"
+        from .utils.storage import get_store, is_remote
 
-        if overwrite and not to_hadoop and Path(file_name).exists():
+        remote_url = None
+        if is_remote(file_name):
+            if to_hadoop:
+                raise ValueError("to_hadoop and an object-store URL are "
+                                 "mutually exclusive")
+            remote_url = file_name
+            file_name = _temp_model_path(file_name)
+
+        if overwrite and not to_hadoop and remote_url is None \
+                and Path(file_name).exists():
             Path(file_name).unlink()
 
         if to_hadoop:
             cluster_file_path = deepcopy(file_name)
-            file_name = str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
+            file_name = _temp_model_path(file_name)
 
         model = self._master_network
         model.save(file_name, overwrite=True)
@@ -228,6 +249,16 @@ class TPUModel:
                 cli.append("-f")
             cli.extend([file_name, cluster_file_path])
             subprocess.run(cli)
+        elif remote_url is not None:
+            store = get_store(remote_url)
+            if not overwrite and store.exists(remote_url):
+                Path(file_name).unlink()
+                raise FileExistsError(
+                    f"{remote_url} exists (pass overwrite=True)")
+            try:
+                store.put_file(file_name, remote_url)
+            finally:
+                Path(file_name).unlink(missing_ok=True)
 
     # ------------------------------------------------------------------- data
     def _as_dataset(self, data, with_labels: bool = True) -> Dataset:
@@ -281,7 +312,7 @@ class TPUModel:
     def _fit(self, ds: Dataset, **kwargs):
         train_config = dict(kwargs)
         train_config.setdefault("batch_size", self.batch_size)
-        self._invalidate_replica()
+        self._refresh_replica()
 
         # driver-level callbacks: per-epoch hooks for sync_mode='step'
         # (whose epoch loop runs on the driver) and for async/hogwild
@@ -548,6 +579,12 @@ class TPUModel:
             raise failure
 
     # ------------------------------------------------------------ predict/eval
+    #: bound on live trainer entries: each holds compiled epoch programs,
+    #: so the cache is LRU rather than unbounded or single-entry —
+    #: alternating two fit configs (sync_mode, metric set, ...) must not
+    #: recompile on every call
+    _TRAINER_CACHE_MAX = 8
+
     def _cached_trainer(self, kind: str, build):
         """Reuse a trainer (and its compiled epoch programs) across fit()
         calls. Keyed by everything that changes the traced computation:
@@ -561,7 +598,11 @@ class TPUModel:
         trainer = self._trainer_cache.get(key)
         if trainer is None:
             trainer = build()
-            self._trainer_cache = {key: trainer}
+            self._trainer_cache[key] = trainer
+            while len(self._trainer_cache) > self._TRAINER_CACHE_MAX:
+                self._trainer_cache.pop(next(iter(self._trainer_cache)))
+        else:
+            self._trainer_cache[key] = self._trainer_cache.pop(key)
         return trainer
 
     def _invalidate_replica(self):
@@ -570,6 +611,19 @@ class TPUModel:
         self._replica_src = None
         self._predict_fn = None
         self._evaluate_fn = None
+
+    def _refresh_replica(self):
+        """Invalidate the replica (and with it every cached compiled
+        trainer/program) only when the master's *architecture* changed;
+        weight and compute-dtype drift are re-synced per call by
+        :meth:`_get_replica`, and compile-config changes are part of the
+        trainer cache key — so repeated/alternating fit() calls keep
+        their compiled programs."""
+        arch = self._master_network.to_json()
+        if self._replica is not None and arch != getattr(
+                self, "_replica_arch", None):
+            self._invalidate_replica()
+        self._replica_arch = arch
 
     def _get_replica(self) -> BaseModel:
         """A worker copy of the master network (master stays untouched
@@ -685,13 +739,26 @@ def load_tpu_model(file_name: str, from_hadoop: bool = False,
                    custom_objects: Optional[Dict] = None
                    ) -> Union[TPUModel, TPUMatrixModel]:
     """Load a distributed model saved by :meth:`TPUModel.save`
-    (parity: ``elephas/spark_model.py:355-389``)."""
+    (parity: ``elephas/spark_model.py:355-389``). Object-store URLs
+    (``gs://``, ``s3://``) download through the scheme's registered
+    :mod:`~elephas_tpu.utils.storage` adapter."""
+    from .utils.storage import get_store, is_remote
+
     assert (file_name[-3:] == ".h5" or file_name[-6:] == ".keras"), \
         "File name must end with either '.h5' or '.keras'"
 
+    remote = is_remote(file_name)
+    if from_hadoop and remote:
+        raise ValueError("from_hadoop and an object-store URL are "
+                         "mutually exclusive")
+    temp_download = from_hadoop or remote
     if from_hadoop:
-        temp_file = str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
+        temp_file = _temp_model_path(file_name)
         subprocess.run(["hadoop", "fs", "-copyToLocal", file_name, temp_file])
+        file_name = temp_file
+    elif remote:
+        temp_file = _temp_model_path(file_name)
+        get_store(file_name).get_file(file_name, temp_file)
         file_name = temp_file
 
     model = load_model(file_name, custom_objects)
@@ -703,7 +770,7 @@ def load_tpu_model(file_name: str, from_hadoop: bool = False,
     class_name = elephas_conf.get("class_name")
     config = elephas_conf.get("config")
 
-    if from_hadoop:
+    if temp_download:
         Path(file_name).unlink()
 
     if class_name == TPUModel.__name__:

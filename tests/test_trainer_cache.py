@@ -1,0 +1,68 @@
+"""Trainer/program cache hygiene: alternating fit configs must reuse the
+compiled trainers instead of evicting each other (the reference has no
+compile cost to cache; here each trainer holds jitted epoch programs)."""
+import numpy as np
+
+from elephas_tpu.models import SGD, Activation, Dense, Sequential
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils.dataset_utils import to_dataset
+
+
+def _model():
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy", seed=0)
+    return model
+
+
+def _dataset(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.random((n, 8), dtype=np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return to_dataset(x, y)
+
+
+def test_alternating_sync_modes_reuse_cached_trainers():
+    tpu_model = TPUModel(_model(), mode="synchronous", num_workers=2)
+    ds = _dataset()
+    fit_kwargs = dict(epochs=1, batch_size=16, verbose=0,
+                      validation_split=0.0)
+
+    tpu_model.sync_mode = "step"
+    tpu_model.fit(ds, **fit_kwargs)
+    step_trainer = next(iter(tpu_model._trainer_cache.values()))
+
+    tpu_model.sync_mode = "average"
+    tpu_model.fit(ds, **fit_kwargs)
+    assert len(tpu_model._trainer_cache) == 2
+
+    # flipping back must hit the cache, not rebuild/recompile
+    tpu_model.sync_mode = "step"
+    tpu_model.fit(ds, **fit_kwargs)
+    assert len(tpu_model._trainer_cache) == 2
+    assert any(t is step_trainer for t in tpu_model._trainer_cache.values())
+
+
+def test_cache_bounded_lru():
+    tpu_model = TPUModel(_model(), mode="synchronous", num_workers=2)
+    cap = tpu_model._TRAINER_CACHE_MAX
+    for i in range(cap + 3):
+        tpu_model._cached_trainer(f"kind_{i}", lambda: object())
+    assert len(tpu_model._trainer_cache) == cap
+    # the oldest entries were the ones evicted
+    kinds = [k[0] for k in tpu_model._trainer_cache]
+    assert kinds == [f"kind_{i}" for i in range(3, cap + 3)]
+
+
+def test_lru_refresh_on_hit():
+    tpu_model = TPUModel(_model(), mode="synchronous", num_workers=2)
+    cap = tpu_model._TRAINER_CACHE_MAX
+    sentinel = object()
+    tpu_model._cached_trainer("keep", lambda: sentinel)
+    for i in range(cap - 1):
+        tpu_model._cached_trainer(f"fill_{i}", lambda: object())
+    # touch 'keep', then overflow by one: 'fill_0' (now oldest) must go
+    assert tpu_model._cached_trainer("keep", lambda: object()) is sentinel
+    tpu_model._cached_trainer("new", lambda: object())
+    kinds = {k[0] for k in tpu_model._trainer_cache}
+    assert "keep" in kinds and "fill_0" not in kinds
