@@ -227,16 +227,24 @@ class Transformer(HasModelConfig, HasLabelCol, HasOutputCol, HasFeaturesCol,
         model = self.get_model()
         predict_fn = build_sharded_predict(model)
 
-        features = np.stack([_cell_to_array(cell)
-                             for cell in df[features_col]])
         inference_batch_size = self.get_inference_batch_size()
         if inference_batch_size is not None and inference_batch_size > 0:
-            # bounded-memory batched inference
-            preds = [predict_fn(features[i:i + inference_batch_size],
-                                batch_size=inference_batch_size)
-                     for i in range(0, len(features), inference_batch_size)]
+            # bounded-memory batched inference: stream the column in
+            # chunks end-to-end — host memory stays O(batch), never
+            # O(dataset) (the reference streams the partition iterator,
+            # ``elephas/ml_model.py:199-221``); order preserved by
+            # construction
+            column = df[features_col]
+            preds = []
+            for i in range(0, len(column), inference_batch_size):
+                chunk = np.stack([_cell_to_array(cell) for cell in
+                                  column.iloc[i:i + inference_batch_size]])
+                preds.append(np.asarray(predict_fn(
+                    chunk, batch_size=inference_batch_size)))
             predictions = np.vstack(preds) if preds else np.zeros((0,))
         else:
+            features = np.stack([_cell_to_array(cell)
+                                 for cell in df[features_col]])
             predictions = predict_fn(features)
 
         results_df = df.copy()

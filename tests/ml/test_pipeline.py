@@ -148,6 +148,31 @@ def test_batched_vs_unbatched_inference_equal(mnist_data,
         np.testing.assert_allclose(a, b, atol=1e-5)
 
 
+def test_batched_inference_streams_host_memory(monkeypatch, mnist_data,
+                                               classification_model):
+    """With inference_batch_size set, the feature column is converted in
+    chunks end-to-end: no np.stack call ever sees more rows than the
+    batch size (host memory O(batch), not O(dataset))."""
+    classification_model.build(seed=0)
+    train_df, test_df = _class_df(mnist_data, n=200)
+    estimator = _estimator(classification_model)
+    transformer = estimator.fit(train_df)
+    transformer.set_inference_batch_size(17)
+
+    stack_sizes = []
+    real_stack = np.stack
+
+    def recording_stack(arrays, *args, **kwargs):
+        arrays = list(arrays)
+        stack_sizes.append(len(arrays))
+        return real_stack(arrays, *args, **kwargs)
+
+    monkeypatch.setattr(np, "stack", recording_stack)
+    result = transformer.transform(test_df)
+    assert len(result) == len(test_df)
+    assert stack_sizes and max(stack_sizes) <= 17
+
+
 def test_transformer_save_load(tmp_path, mnist_data, classification_model):
     classification_model.build(seed=0)
     train_df, test_df = _class_df(mnist_data, n=200)
