@@ -99,8 +99,10 @@ class TransformerConfig:
     #: sliding-window attention (Mistral style): each position attends
     #: to at most the last ``attention_window`` keys (itself included).
     #: None = full causal context. Decode keeps an O(window) effective
-    #: read set; the xla attention path applies the band mask (flash /
-    #: ring fall back to xla when a window is set)
+    #: read set; the xla path applies the band mask, the flash kernel
+    #: skips out-of-band tiles in-kernel, and the ring path skips whole
+    #: out-of-band hops statically (windowed sequence parallelism
+    #: composes)
     attention_window: Optional[int] = None
     #: MLP variant: ``gelu`` (GPT-2 style, w1/w2) or ``swiglu`` (Llama
     #: style: SiLU(x@w1) * (x@w3) @ w2 — the gated unit that wins at
@@ -363,12 +365,9 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
     reached exclusively through shard_map with divisible batch/head dims.
     """
     c = config
-    if c.attention_window is not None and (mesh is not None
-                                           and seq_axis is not None):
-        # windowed ring attention is not implemented; under a seq axis
-        # the band mask runs through the (GSPMD-sharded) xla path
-        return "xla"
     if mesh is not None and seq_axis is not None:
+        # windowed configs compose: the ring applies the band over
+        # global positions and statically skips out-of-band hops
         return "ring"
     backend = backend if backend is not None else jax.default_backend()
     if mesh is not None:
@@ -919,7 +918,8 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
     if attn_impl == "ring":
         attn_fn = partial(ring_attention_sharded, mesh=mesh,
                           seq_axis=seq_axis, causal=True,
-                          batch_axis=batch_axis)
+                          batch_axis=batch_axis,
+                          window=c.attention_window)
         # the ring folds GQA groups internally and keeps k/v narrow on
         # the wire — don't pre-broadcast them
         attn_fn.handles_gqa = True

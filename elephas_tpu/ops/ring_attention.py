@@ -27,8 +27,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 NEG_INF = -1e30
 
 
+def ring_num_hops(axis_size: int, shard_len: int,
+                  window: Optional[int]) -> int:
+    """Ring hops a causal sliding-window band actually needs.
+
+    Hop ``i`` visits the kv block ``i`` shards behind the query shard;
+    the farthest-back block any query in a shard of length ``s`` can see
+    with a band ``k > q - window`` is ``floor((window - 2)/s) + 1`` hops
+    away — identical for every device, so the bound is static and the
+    out-of-band hops (and their ppermutes) are simply never executed.
+    """
+    if window is None:
+        return axis_size
+    if window <= 1:
+        return 1  # each query sees only itself: the diagonal block
+    return min(axis_size, 2 + (window - 2) // shard_len)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+                   axis_name: str, causal: bool = False,
+                   window: Optional[int] = None) -> jnp.ndarray:
     """Attention over a ring; call inside ``shard_map``.
 
     :param q: local query shard ``(batch, heads, seq_local, head_dim)``
@@ -38,7 +56,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         group factor) and each query group attends to its shared head
     :param axis_name: mesh axis carrying the sequence shards
     :param causal: apply a causal mask over *global* positions
+    :param window: sliding-window band over global positions — each
+        query attends to at most the last ``window`` keys (itself
+        included). Requires ``causal``; hops entirely outside the band
+        are skipped statically (see :func:`ring_num_hops`), so a narrow
+        window on a long ring pays O(window) compute and ICI traffic,
+        not O(seq).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal band)")
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
@@ -49,6 +76,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qg = q.reshape(b, kvh, g, sq, d)
     scale = 1.0 / math.sqrt(d)
     q_pos = my_idx * sq + jnp.arange(sq)[:, None]
+    n_hops = ring_num_hops(axis_size, sq, window)
 
     def step(i, carry):
         o, l, m, k_cur, v_cur = carry
@@ -56,7 +84,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         s = jnp.einsum("bngqd,bnkd->bngqk", qg, k_cur) * scale
         if causal:
             k_pos = kv_idx * k_cur.shape[2] + jnp.arange(k_cur.shape[2])[None, :]
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            keep = k_pos <= q_pos
+            if window is not None:
+                keep = keep & (k_pos > q_pos - window)
+            s = jnp.where(keep, s, NEG_INF)
+        # hop 0 is the diagonal block, so every query row sees at least
+        # its own position first: m is finite from the first hop on and
+        # fully-masked later blocks contribute exp(NEG_INF - m) = 0
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         correction = jnp.exp(m - m_new)
@@ -72,7 +106,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     o0 = jnp.zeros_like(qg)
     l0 = jnp.zeros((b, kvh, g, sq), dtype=q.dtype)
     m0 = jnp.full((b, kvh, g, sq), NEG_INF, dtype=q.dtype)
-    o, l, m, _, _ = lax.fori_loop(0, axis_size, step, (o0, l0, m0, k, v))
+    o, l, m, _, _ = lax.fori_loop(0, n_hops, step, (o0, l0, m0, k, v))
     o = o / jnp.maximum(l, 1e-20)[..., None]
     return o.reshape(b, h, sq, d)
 
@@ -80,7 +114,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            mesh: Mesh, seq_axis: str = "seq",
                            causal: bool = False,
-                           batch_axis: Optional[str] = None) -> jnp.ndarray:
+                           batch_axis: Optional[str] = None,
+                           window: Optional[int] = None) -> jnp.ndarray:
     """shard_map wrapper: global ``(batch, heads, seq, head_dim)`` arrays in,
     sequence sharded over ``seq_axis`` (and optionally batch over
     ``batch_axis``), global attention out."""
@@ -88,7 +123,8 @@ def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = PartitionSpec(batch_spec, None, seq_axis, None)
 
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        partial(ring_attention, axis_name=seq_axis, causal=causal,
+                window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -1932,11 +1932,11 @@ def test_alibi_positions_decode_parity_and_extrapolation():
     assert np.isfinite(np.asarray(out)).all()
 
 
-def test_window_under_seq_mesh_falls_back_to_xla_and_matches():
+def test_window_under_seq_mesh_runs_windowed_ring_and_matches():
     import dataclasses
 
     config = dataclasses.replace(_config(), attention_window=4)
-    assert select_attention_impl_for_test(config) == "xla"
+    assert select_attention_impl_for_test(config) == "ring"
     params = init_params(config, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
     expected = np.asarray(forward(params, tokens, config))

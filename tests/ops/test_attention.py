@@ -52,6 +52,76 @@ def test_ring_with_batch_axis():
     np.testing.assert_allclose(np.asarray(full), np.asarray(ring), atol=1e-4)
 
 
+def _band_mask(s, window):
+    q_pos = jnp.arange(s)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    return ((k_pos <= q_pos) & (k_pos > q_pos - window))[None, None]
+
+
+@pytest.mark.parametrize("window", [1, 3, 8, 9, 31, 32, 100])
+@pytest.mark.parametrize("ring_size", [4, 8])
+def test_windowed_ring_matches_band_reference(window, ring_size):
+    """Sliding-window x sequence-parallel composes: the ring applies the
+    band over global positions and matches the XLA band-mask path."""
+    q, k, v = _qkv()
+    mesh = Mesh(np.array(jax.devices()[:ring_size]), ("seq",))
+    expected = attention(q, k, v, mask=_band_mask(q.shape[2], window))
+    ring = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis="seq",
+                                  causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(expected), np.asarray(ring),
+                               atol=1e-4)
+
+
+def test_windowed_ring_skips_out_of_band_hops():
+    """The static hop count drops with the window: a narrow band on a
+    long ring pays O(window) hops, not O(seq)."""
+    from elephas_tpu.ops.ring_attention import ring_num_hops
+
+    # shard_len 8, 8 shards (seq 64)
+    assert ring_num_hops(8, 8, None) == 8      # full causal: every hop
+    assert ring_num_hops(8, 8, 1) == 1         # self only: diagonal hop
+    assert ring_num_hops(8, 8, 8) == 2         # band spills one shard back
+    assert ring_num_hops(8, 8, 9) == 2
+    assert ring_num_hops(8, 8, 10) == 3        # q=s_start needs k 9 back
+    assert ring_num_hops(8, 8, 64) == 8        # window >= seq: all hops
+    assert ring_num_hops(8, 8, 1000) == 8      # clamped at ring size
+    # exactness: hop bound must not under-count — brute-force check that
+    # every (q, k) pair inside the band lies within the visited hops
+    for s in (4, 8):
+        for p in (2, 4, 8):
+            for w in range(1, s * p + 2):
+                hops = ring_num_hops(p, s, w)
+                need = 0
+                for qpos in range(s * p):
+                    for kpos in range(max(0, qpos - w + 1), qpos + 1):
+                        need = max(need, qpos // s - kpos // s)
+                assert hops >= need + 1, (s, p, w)
+
+
+def test_windowed_ring_requires_causal():
+    q, k, v = _qkv(s=8)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    with pytest.raises(ValueError):
+        ring_attention_sharded(q, k, v, mesh=mesh, seq_axis="seq",
+                               causal=False, window=4)
+
+
+def test_windowed_ring_gqa():
+    b, h, kvh, t, d = 2, 4, 2, 32, 8
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, kvh, t, d))
+    v = jax.random.normal(kv_, (b, kvh, t, d))
+    k_full = jnp.repeat(k, h // kvh, axis=1)
+    v_full = jnp.repeat(v, h // kvh, axis=1)
+    expected = attention(q, k_full, v_full, mask=_band_mask(t, 5))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    got = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis="seq",
+                                 causal=True, window=5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attention_gqa_matches_full_attention():
     """GQA ring (kv-width buffers on the wire) matches grouped full
     attention computed by head-broadcast."""
