@@ -30,14 +30,37 @@ class CheckpointManager:
 
         <directory>/manifest.json           # model json + distributed config
         <directory>/step_<N>/               # orbax pytree (or state.npz)
+
+    ``directory`` may be an object-store URL (``gs://...`` — the Cloud
+    TPU checkpoint target, replacing the reference's ``hadoop fs``
+    pattern): checkpoints are staged in a local directory and mirrored
+    through the scheme's :mod:`~elephas_tpu.utils.storage` adapter; a
+    fresh process restores by downloading the manifest and the requested
+    step on demand.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        from .storage import get_store, is_remote
+
+        self._remote_url: str = ""
+        self._store = None
+        if is_remote(str(directory)):
+            import tempfile
+
+            self._remote_url = str(directory).rstrip("/")
+            self._store = get_store(self._remote_url)
+            directory = tempfile.mkdtemp(prefix="etpu_ckpt_staging_")
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
         self._checkpointer = (ocp.StandardCheckpointer() if _HAS_ORBAX
                               else None)
+        if self._store is not None:
+            # adopt an existing remote run's manifest (resume-from-URL)
+            manifest_url = f"{self._remote_url}/manifest.json"
+            if self._store.exists(manifest_url):
+                (self.directory / "manifest.json").write_text(
+                    self._store.read_text(manifest_url))
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Dict[str, Any],
@@ -68,6 +91,11 @@ class CheckpointManager:
             (step_dir / "treedef.json").write_text(json.dumps(treedef))
         manifest["steps"] = sorted(set(manifest["steps"]))
         (self.directory / "manifest.json").write_text(json.dumps(manifest))
+        if self._store is not None:
+            self._store.put_dir(str(step_dir),
+                                f"{self._remote_url}/step_{int(step)}")
+            self._store.write_text(f"{self._remote_url}/manifest.json",
+                                   json.dumps(manifest))
         self._gc()
 
     # --------------------------------------------------------------- restore
@@ -78,8 +106,12 @@ class CheckpointManager:
         if step is None:
             step = manifest.get("latest_step")
         if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+            raise FileNotFoundError(
+                f"no checkpoints in {self._remote_url or self.directory}")
         step_dir = self.directory / f"step_{int(step)}"
+        if self._store is not None and not step_dir.exists():
+            self._store.get_dir(f"{self._remote_url}/step_{int(step)}",
+                                str(step_dir))
         if self._checkpointer is not None:
             return self._checkpointer.restore(step_dir.absolute(),
                                               target=template)
@@ -110,9 +142,15 @@ class CheckpointManager:
             victim_dir = self.directory / f"step_{victim}"
             if victim_dir.exists():
                 shutil.rmtree(victim_dir)
+            if self._store is not None:
+                self._store.delete(f"{self._remote_url}/step_{victim}",
+                                   recursive=True)
         manifest = self._read_manifest()
         manifest["steps"] = steps
         (self.directory / "manifest.json").write_text(json.dumps(manifest))
+        if self._store is not None:
+            self._store.write_text(f"{self._remote_url}/manifest.json",
+                                   json.dumps(manifest))
 
 
 def _flatten(tree, prefix=""):

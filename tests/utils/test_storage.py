@@ -1,0 +1,114 @@
+"""Object-store adapter tests: the gs:// scheme (Cloud TPU's `hadoop fs`
+analog, VERDICT r2 item 5) through a local-filesystem fake, end to end:
+TPUModel.save/load round-trip and CheckpointManager remote mirroring."""
+import json
+
+import numpy as np
+import pytest
+
+from elephas_tpu.utils.storage import (CliObjectStore, LocalMirrorStore,
+                                       get_store, is_remote, register_store,
+                                       split_scheme)
+
+
+@pytest.fixture
+def gs_fake(tmp_path):
+    store = LocalMirrorStore(tmp_path / "fake_gcs")
+    register_store("gs", store)
+    yield store
+    register_store("gs", None)
+
+
+def test_split_scheme_and_is_remote():
+    assert split_scheme("gs://bucket/a/b.h5") == ("gs", "bucket/a/b.h5")
+    assert split_scheme("/local/path.h5") == (None, "/local/path.h5")
+    assert is_remote("gs://b/k") and is_remote("s3://b/k")
+    assert not is_remote("model.h5") and not is_remote("file:///x.h5")
+
+
+def test_registry_prefers_registered_store(gs_fake):
+    assert get_store("gs://bucket/x") is gs_fake
+    assert isinstance(get_store("s3://bucket/x"), CliObjectStore)
+    with pytest.raises(ValueError):
+        get_store("/plain/path")
+
+
+def test_store_file_and_dir_round_trip(gs_fake, tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    gs_fake.put_file(str(src), "gs://bucket/dir/src.txt")
+    assert gs_fake.exists("gs://bucket/dir/src.txt")
+    dest = tmp_path / "dest.txt"
+    gs_fake.get_file("gs://bucket/dir/src.txt", str(dest))
+    assert dest.read_text() == "payload"
+
+    d = tmp_path / "tree"
+    (d / "sub").mkdir(parents=True)
+    (d / "a.bin").write_bytes(b"\x00\x01")
+    (d / "sub" / "b.bin").write_bytes(b"\x02")
+    gs_fake.put_dir(str(d), "gs://bucket/ckpt/step_1")
+    out = tmp_path / "tree_out"
+    gs_fake.get_dir("gs://bucket/ckpt/step_1", str(out))
+    assert (out / "a.bin").read_bytes() == b"\x00\x01"
+    assert (out / "sub" / "b.bin").read_bytes() == b"\x02"
+    gs_fake.delete("gs://bucket/ckpt/step_1", recursive=True)
+    assert not gs_fake.exists("gs://bucket/ckpt/step_1")
+
+
+def test_tpu_model_save_load_through_gcs(gs_fake, classification_model):
+    from elephas_tpu.tpu_model import TPUModel, load_tpu_model
+
+    classification_model.compile("sgd", "categorical_crossentropy",
+                                 seed=0)
+    tpu_model = TPUModel(classification_model, mode="synchronous",
+                         num_workers=2)
+    url = "gs://models/run1/model.h5"
+    tpu_model.save(url)
+    assert gs_fake.exists(url)
+    # no overwrite without the flag
+    with pytest.raises(FileExistsError):
+        tpu_model.save(url)
+    tpu_model.save(url, overwrite=True)
+
+    loaded = load_tpu_model(url)
+    assert loaded.mode == "synchronous"
+    x = np.random.default_rng(0).random((8, 784), dtype=np.float32)
+    np.testing.assert_allclose(loaded.master_network.predict(x),
+                               classification_model.predict(x), atol=1e-5)
+
+
+def test_checkpoint_manager_remote_round_trip(gs_fake):
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    url = "gs://ckpts/run7"
+    mgr = CheckpointManager(url, max_to_keep=2)
+    state1 = {"params": {"w": np.arange(6, dtype=np.float32)},
+              "step": np.asarray(1)}
+    mgr.save(1, state1, model_json='{"arch": 1}',
+             distributed_config={"mode": "synchronous"})
+    mgr.save(2, {"params": {"w": np.arange(6, dtype=np.float32) * 2},
+                 "step": np.asarray(2)})
+    mgr.save(3, {"params": {"w": np.arange(6, dtype=np.float32) * 3},
+                 "step": np.asarray(3)})
+
+    # remote manifest lists the kept steps; gc pruned step 1 remotely
+    manifest = json.loads(gs_fake.read_text(f"{url}/manifest.json"))
+    assert manifest["latest_step"] == 3
+    assert manifest["steps"] == [2, 3]
+    assert manifest["distributed_config"] == {"mode": "synchronous"}
+    assert not gs_fake.exists(f"{url}/step_1")
+
+    # a FRESH manager (new process, empty staging dir) restores from the
+    # remote alone
+    mgr2 = CheckpointManager(url)
+    assert mgr2.latest_step() == 3
+    template = {"params": {"w": np.zeros(6, dtype=np.float32)},
+                "step": np.asarray(0)}
+    restored = mgr2.restore(template=template)
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.arange(6, dtype=np.float32) * 3)
+    restored2 = mgr2.restore(step=2, template=template)
+    np.testing.assert_array_equal(
+        np.asarray(restored2["params"]["w"]),
+        np.arange(6, dtype=np.float32) * 2)
