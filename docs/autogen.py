@@ -59,7 +59,7 @@ PAGES = [
     ("Flash attention (Pallas)", "elephas_tpu.ops.pallas_attention",
      ["flash_attention"]),
     ("Ring attention", "elephas_tpu.ops.ring_attention",
-     ["ring_attention", "ring_attention_sharded"]),
+     ["ring_attention", "ring_flash_attention", "ring_attention_sharded"]),
     ("Transformer", "elephas_tpu.models.transformer",
      ["TransformerConfig", "init_params", "param_specs",
       "fsdp_param_specs", "zero_opt_specs", "abstract_params", "forward",

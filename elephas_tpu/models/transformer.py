@@ -354,7 +354,8 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
                           model_axis: Optional[str], batch: int,
                           backend: Optional[str] = None,
                           n_devices: Optional[int] = None) -> str:
-    """Decide the attention execution path: ``'ring'`` (sequence-parallel),
+    """Decide the attention execution path: ``'ring_flash'`` /
+    ``'ring'`` (sequence-parallel, flash-kernel or einsum hops),
     ``'flash_sharded'`` (Pallas kernel per device under shard_map),
     ``'flash'`` (bare Pallas kernel, single device) or ``'xla'``.
 
@@ -365,11 +366,15 @@ def select_attention_impl(config: TransformerConfig, mesh: Optional[Mesh],
     reached exclusively through shard_map with divisible batch/head dims.
     """
     c = config
+    backend = backend if backend is not None else jax.default_backend()
     if mesh is not None and seq_axis is not None:
         # windowed configs compose: the ring applies the band over
-        # global positions and statically skips out-of-band hops
+        # global positions and statically skips out-of-band hops; each
+        # hop's local block runs the Pallas flash kernel on TPU
+        if (c.attention_impl == "flash"
+                or (c.attention_impl == "auto" and backend == "tpu")):
+            return "ring_flash"
         return "ring"
-    backend = backend if backend is not None else jax.default_backend()
     if mesh is not None:
         if (c.attention_impl != "xla"
                 and (c.attention_impl == "flash" or backend == "tpu")
@@ -915,11 +920,13 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
                                       model_axis, tokens.shape[0])
     if segment_ids is not None or c.positional == "alibi":
         attn_impl = "xla"  # segment masks / alibi bias live here only
-    if attn_impl == "ring":
+    if attn_impl in ("ring", "ring_flash"):
         attn_fn = partial(ring_attention_sharded, mesh=mesh,
                           seq_axis=seq_axis, causal=True,
                           batch_axis=batch_axis,
-                          window=c.attention_window)
+                          window=c.attention_window,
+                          impl=("flash" if attn_impl == "ring_flash"
+                                else "einsum"))
         # the ring folds GQA groups internally and keeps k/v narrow on
         # the wire — don't pre-broadcast them
         attn_fn.handles_gqa = True

@@ -48,12 +48,19 @@ def _round_up(n: int, m: int) -> int:
 
 
 # --------------------------------------------------------------------- fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
                 *, sq: int, sk: int, block_q: int, block_k: int,
                 causal: bool, scale: float, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
+    # global-position offsets (SMEM scalars): 0 for plain attention;
+    # under ring/sequence parallelism they place this device's q shard
+    # and the current hop's k/v shard on the global sequence axis, so
+    # causal/band masking and block skipping see global positions
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
 
     @pl.when(kj == 0)
     def _():
@@ -63,10 +70,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     # skip blocks strictly above the causal diagonal — and, with a
     # sliding window, blocks entirely below it
-    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    diag_reached = ((not causal)
+                    or (k_off + kj * block_k
+                        <= q_off + qi * block_q + block_q - 1))
     if window is not None:
-        in_band = (kj * block_k + block_k - 1
-                   > qi * block_q - window)
+        in_band = (k_off + kj * block_k + block_k - 1
+                   > q_off + qi * block_q - window)
         diag_reached = diag_reached & in_band
 
     @pl.when(diag_reached)
@@ -77,15 +86,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
 
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        q_loc = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        k_loc = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        valid = k_pos < sk
+        # padding bounds are local to the shard; causal/band are global
+        valid = k_loc < sk
         if causal:
-            valid = valid & (k_pos <= q_pos)
+            valid = valid & (k_off + k_loc <= q_off + q_loc)
         if window is not None:
-            valid = valid & (k_pos > q_pos - window)
+            valid = valid & (k_off + k_loc > q_off + q_loc - window)
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, 0]
@@ -107,10 +117,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             o_ref.dtype)
         # lse is (block_q, 1): trailing dims (block_q, 1) satisfy the TPU
         # (8, 128)-or-full-dim tile rule, which a (1, block_q) block doesn't
+        # fully-masked rows keep lse = NEG_INF-ish so a cross-hop merge
+        # weights them to zero
         lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
+def _as_offset(x):
+    """Scalar offset -> (1, 1) int32 array for the SMEM block spec."""
+    return jnp.asarray(x, jnp.int32).reshape(1, 1)
+
+
+#: whole-array SMEM placement for the (1, 1) int32 offset scalars
+_SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None,
+         q_offset=0, k_offset=0):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grp = h // kvh
@@ -137,6 +159,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
         kernel,
         grid=grid,
         in_specs=[
+            _SMEM_SPEC,
+            _SMEM_SPEC,
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda bh, qi, kj: (kv_row(bh), kj, 0)),
@@ -159,41 +183,46 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(interpret),
-    )(qr, kr, vr)
+    )(_as_offset(q_offset), _as_offset(k_offset), qr, kr, vr)
     return (o[:, :sq].reshape(b, h, sq, d),
             lse[:, :sq, 0].reshape(b, h, sq))
 
 
 # --------------------------------------------------------------------- bwd
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref,
                dq_acc, *, sq: int, sk: int, block_q: int, block_k: int,
                causal: bool, scale: float, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
 
     @pl.when(kj == 0)
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    diag_reached = ((not causal)
+                    or (k_off + kj * block_k
+                        <= q_off + qi * block_q + block_q - 1))
     if window is not None:
-        diag_reached = diag_reached & (kj * block_k + block_k - 1
-                                       > qi * block_q - window)
+        diag_reached = diag_reached & (k_off + kj * block_k + block_k - 1
+                                       > q_off + qi * block_q - window)
 
     @pl.when(diag_reached)
     def _():
         s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        q_loc = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        k_loc = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        valid = k_pos < sk
+        valid = k_loc < sk
         if causal:
-            valid = valid & (k_pos <= q_pos)
+            valid = valid & (k_off + k_loc <= q_off + q_loc)
         if window is not None:
-            valid = valid & (k_pos > q_pos - window)
+            valid = valid & (k_off + k_loc > q_off + q_loc - window)
         p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -207,7 +236,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, sq: int, sk: int,
                 block_q: int, block_k: int, causal: bool, scale: float,
                 nq_blocks: int, window=None):
@@ -217,32 +247,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # query head sharing this kv head accumulates into the same dk/dv
     qi = t % nq_blocks
     total = pl.num_programs(2)
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
 
     @pl.when(t == 0)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    diag_reached = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+    diag_reached = ((not causal)
+                    or (k_off + kj * block_k
+                        <= q_off + qi * block_q + block_q - 1))
     if window is not None:
-        diag_reached = diag_reached & (kj * block_k + block_k - 1
-                                       > qi * block_q - window)
+        diag_reached = diag_reached & (k_off + kj * block_k + block_k - 1
+                                       > q_off + qi * block_q - window)
 
     @pl.when(diag_reached)
     def _():
         s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        q_loc = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        k_loc = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        # mask BOTH query padding (q_pos >= sq would use garbage lse) and
+        # mask BOTH query padding (q_loc >= sq would use garbage lse) and
         # key validity/causality
-        valid = (k_pos < sk) & (q_pos < sq)
+        valid = (k_loc < sk) & (q_loc < sq)
         if causal:
-            valid = valid & (k_pos <= q_pos)
+            valid = valid & (k_off + k_loc <= q_off + q_loc)
         if window is not None:
-            valid = valid & (k_pos > q_pos - window)
+            valid = valid & (k_off + k_loc > q_off + q_loc - window)
         p = jnp.where(valid, jnp.exp(s - lse_ref[0]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -262,14 +296,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(causal, block_q, block_k, interpret, window, residuals, g):
     q, k, v, o, lse = residuals
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return _bwd_calls(q, k, v, g, lse, delta, causal, block_q, block_k,
+                      interpret, window)
+
+
+def _bwd_calls(q, k, v, g, lse, delta, causal, block_q, block_k, interpret,
+               window, q_offset=0, k_offset=0):
+    """dq/dk/dv kernel dispatch given precomputed lse and delta.
+
+    ``lse``/``delta`` may be GLOBAL row statistics (ring attention:
+    softmax over the whole sequence factorizes as exp(s - lse_global), so
+    a per-shard backward with global statistics yields exact gradients).
+    """
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grp = h // kvh
     sk = k.shape[2]
     scale = 1.0 / math.sqrt(d)
     sq_p, sk_p = _round_up(sq, block_q), _round_up(sk, block_k)
-
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     def prep(x, s_pad):
         rows = x.shape[0] * x.shape[1]
@@ -299,14 +344,16 @@ def _bwd(causal, block_q, block_k, interpret, window, residuals, g):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         grid=(b * h, sq_p // block_q, sk_p // block_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=[_SMEM_SPEC, _SMEM_SPEC,
+                  q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(qr, kr, vr, dor, lser, deltar)[0]
+    )(_as_offset(q_offset), _as_offset(k_offset),
+      qr, kr, vr, dor, lser, deltar)[0]
 
     # kv-major grid over the NARROW kv rows; the trailing axis walks
     # (group member, q block) so all grp query heads sharing a kv head
@@ -326,7 +373,8 @@ def _bwd(causal, block_q, block_k, interpret, window, residuals, g):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, nq_blocks=nq, **common),
         grid=(b * kvh, sk_p // block_k, grp * nq),
-        in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
+        in_specs=[_SMEM_SPEC, _SMEM_SPEC,
+                  q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[k_spec_t, k_spec_t],
         out_shape=[jax.ShapeDtypeStruct((b * kvh, sk_p, d), k.dtype),
@@ -336,11 +384,50 @@ def _bwd(causal, block_q, block_k, interpret, window, residuals, g):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(qr, kr, vr, dor, lser, deltar)
+    )(_as_offset(q_offset), _as_offset(k_offset),
+      qr, kr, vr, dor, lser, deltar)
 
     return (dq[:, :sq].reshape(b, h, sq, d),
             dk[:, :sk].reshape(b, kvh, sk, d),
             dv[:, :sk].reshape(b, kvh, sk, d))
+
+
+# ------------------------------------------------------------- ring hops
+def _clamp_blocks(block_q, block_k, sq, sk):
+    # round to 32 rows — a multiple of every dtype's min sublane tile
+    return (min(block_q, _round_up(sq, 32)), min(block_k, _round_up(sk, 32)))
+
+
+def flash_hop_forward(q, k, v, q_offset, k_offset, causal: bool = True,
+                      window: Optional[int] = None, block_q: int = 256,
+                      block_k: int = 512, interpret: Optional[bool] = None):
+    """One ring-attention hop through the flash kernel: block attention of
+    the local q shard against one circulating k/v shard, masked on GLOBAL
+    positions (``q_offset``/``k_offset`` are traced per-device scalars).
+
+    Returns ``(o, lse)`` — per-hop normalized output and logsumexp row
+    statistics, merged across hops by the caller. NOT differentiable;
+    ring attention's custom VJP calls :func:`flash_hop_backward`.
+    """
+    block_q, block_k = _clamp_blocks(block_q, block_k, q.shape[2],
+                                     k.shape[2])
+    return _fwd(q, k, v, causal, block_q, block_k, interpret, window,
+                q_offset=q_offset, k_offset=k_offset)
+
+
+def flash_hop_backward(q, k, v, g, lse, delta, q_offset, k_offset,
+                       causal: bool = True, window: Optional[int] = None,
+                       block_q: int = 256, block_k: int = 512,
+                       interpret: Optional[bool] = None):
+    """Per-hop backward with GLOBAL row statistics: softmax over the full
+    ring factorizes as ``exp(s - lse_global)``, so dq/dk/dv for this hop's
+    shard pair are exact given the global ``lse`` and
+    ``delta = rowsum(dO * O_global)``."""
+    block_q, block_k = _clamp_blocks(block_q, block_k, q.shape[2],
+                                     k.shape[2])
+    return _bwd_calls(q, k, v, g, lse, delta, causal, block_q, block_k,
+                      interpret, window, q_offset=q_offset,
+                      k_offset=k_offset)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -111,20 +111,150 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return o.reshape(b, h, sq, d)
 
 
+# ----------------------------------------------------------- flash ring
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, window, block_q, block_k,
+                interpret):
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, window, block_q,
+                           block_k, interpret)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, window, block_q, block_k,
+                    interpret):
+    from .pallas_attention import flash_hop_forward
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    n_hops = ring_num_hops(axis_size, sq, window) if causal else axis_size
+    q_off = my_idx * sq
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def hop(i, carry):
+        o, lse, k_cur, v_cur = carry
+        kv_idx = (my_idx - i) % axis_size
+        # each hop runs the flash kernel on the local block pair with
+        # global-position masking; per-hop (o, lse) merge by logsumexp
+        # weights — the hop-level analog of the kernel's kv-block online
+        # softmax
+        o_h, lse_h = flash_hop_forward(q, k_cur, v_cur, q_off,
+                                       kv_idx * sk, causal, window,
+                                       block_q, block_k, interpret)
+        lse_new = jnp.logaddexp(lse, lse_h)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_h.astype(jnp.float32) * jnp.exp(lse_h - lse_new)[..., None])
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse_new, k_next, v_next
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    o, lse, _, _ = lax.fori_loop(0, n_hops, hop, (o0, lse0, k, v))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_fwd_vjp(q, k, v, axis_name, causal, window, block_q,
+                        block_k, interpret):
+    o, lse = _ring_flash_fwd(q, k, v, axis_name, causal, window, block_q,
+                             block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, window, block_q, block_k, interpret,
+                    residuals, g):
+    from .pallas_attention import flash_hop_backward
+
+    q, k, v, o, lse = residuals
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    sq, sk = q.shape[2], k.shape[2]
+    n_hops = ring_num_hops(axis_size, sq, window) if causal else axis_size
+    q_off = my_idx * sq
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def hop(i, carry):
+        dq, k_cur, v_cur, dk, dv = carry
+        kv_idx = (my_idx - i) % axis_size
+        dq_h, dk_h, dv_h = flash_hop_backward(
+            q, k_cur, v_cur, g, lse, delta, q_off, kv_idx * sk, causal,
+            window, block_q, block_k, interpret)
+        dq = dq + dq_h.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their k/v shard around the ring
+        dk = dk + dk_h.astype(jnp.float32)
+        dv = dv + dv_h.astype(jnp.float32)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return dq, k_next, v_next, dk, dv
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(0, n_hops, hop,
+                                     (dq0, k, v, dk0, dv0))
+    if n_hops % axis_size:
+        # the travelling dk/dv accumulators are n_hops positions past
+        # their home shard — one permute sends every block home
+        home = [(j, (j - n_hops) % axis_size) for j in range(axis_size)]
+        dk = lax.ppermute(dk, axis_name, home)
+        dv = lax.ppermute(dv, axis_name, home)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
+
+
+def ring_flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         axis_name: str, causal: bool = False,
+                         window: Optional[int] = None, block_q: int = 256,
+                         block_k: int = 512,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Ring attention whose per-hop local block runs the Pallas flash
+    kernel (VMEM-tiled, never materializing the local ``(sq, sk)`` score
+    matrix) instead of the einsum path — the long-context composition of
+    sequence parallelism and flash attention. Same semantics and calling
+    convention as :func:`ring_attention`; differentiable via the
+    global-lse factorization (each hop's backward uses the full ring's
+    row statistics, which is exact)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"kv heads {k.shape[1]} must divide query heads "
+                         f"{q.shape[1]}")
+    return _ring_flash(q, k, v, axis_name, causal,
+                       int(window) if window is not None else None,
+                       block_q, block_k, interpret)
+
+
 def ring_attention_sharded(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                            mesh: Mesh, seq_axis: str = "seq",
                            causal: bool = False,
                            batch_axis: Optional[str] = None,
-                           window: Optional[int] = None) -> jnp.ndarray:
+                           window: Optional[int] = None,
+                           impl: str = "einsum",
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
     """shard_map wrapper: global ``(batch, heads, seq, head_dim)`` arrays in,
     sequence sharded over ``seq_axis`` (and optionally batch over
-    ``batch_axis``), global attention out."""
+    ``batch_axis``), global attention out.
+
+    ``impl='flash'`` runs each hop's local block through the Pallas flash
+    kernel (:func:`ring_flash_attention`) — the TPU path; ``'einsum'`` is
+    the XLA reference formulation."""
     batch_spec = batch_axis if batch_axis else None
     spec = PartitionSpec(batch_spec, None, seq_axis, None)
 
-    fn = jax.shard_map(
-        partial(ring_attention, axis_name=seq_axis, causal=causal,
-                window=window),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    if impl == "flash":
+        local = partial(ring_flash_attention, axis_name=seq_axis,
+                        causal=causal, window=window, interpret=interpret)
+    elif impl == "einsum":
+        local = partial(ring_attention, axis_name=seq_axis, causal=causal,
+                        window=window)
+    else:
+        raise ValueError(f"impl must be 'einsum' or 'flash', got {impl!r}")
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)

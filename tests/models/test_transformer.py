@@ -172,9 +172,13 @@ def test_attention_impl_selection_rules():
     flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
     assert select_attention_impl(flash_cfg, None, None, None, None, 4,
                                  backend="cpu", n_devices=8) == "flash"
-    # mesh + seq axis -> ring, regardless of impl
+    # mesh + seq axis -> ring; forced flash runs the kernel in the hops
     assert select_attention_impl(flash_cfg, mesh, "seq", "data", "model",
-                                 4) == "ring"
+                                 4) == "ring_flash"
+    assert select_attention_impl(cfg, mesh, "seq", "data", "model", 4,
+                                 backend="cpu") == "ring"
+    assert select_attention_impl(cfg, mesh, "seq", "data", "model", 4,
+                                 backend="tpu") == "ring_flash"
     # mesh + auto on TPU -> shard_map'd kernel when dims divide
     assert select_attention_impl(cfg, mesh, None, "data", "model", 4,
                                  backend="tpu") == "flash_sharded"
@@ -1936,7 +1940,9 @@ def test_window_under_seq_mesh_runs_windowed_ring_and_matches():
     import dataclasses
 
     config = dataclasses.replace(_config(), attention_window=4)
-    assert select_attention_impl_for_test(config) == "ring"
+    # the test helper injects backend="tpu": windowed seq-mesh configs
+    # run the flash ring there (einsum ring on other backends)
+    assert select_attention_impl_for_test(config) == "ring_flash"
     params = init_params(config, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
     expected = np.asarray(forward(params, tokens, config))
