@@ -1,0 +1,22 @@
+"""Every test file must belong to some CI shard — a new top-level test
+file that no matrix group covers would silently never run in CI."""
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_ci_shards_cover_all_test_files():
+    ci = (REPO / ".github" / "workflows" / "ci.yml").read_text()
+    # path tokens listed in the shard matrix (skip --ignore= exclusions:
+    # an ignored file must be picked up by another shard's token)
+    tokens = [t for t in re.findall(r"(?<!=)\btests/[\w/.-]*", ci)
+              if "--ignore" not in t]
+    assert tokens, "no shard paths found in ci.yml"
+
+    for test_file in REPO.glob("tests/**/test_*.py"):
+        rel = test_file.relative_to(REPO).as_posix()
+        assert any(rel == tok or rel.startswith(tok.rstrip("/") + "/")
+                   for tok in tokens), (
+            f"{rel} is not covered by any CI shard; add it to a matrix "
+            "group in .github/workflows/ci.yml")
