@@ -88,6 +88,9 @@ PAGES = [
     ("Callbacks", "elephas_tpu.models.callbacks",
      ["Callback", "EarlyStopping", "ModelCheckpoint", "LambdaCallback"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
+    ("Object storage", "elephas_tpu.utils.storage",
+     ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",
+      "get_store"]),
     ("Native acceleration", "elephas_tpu.utils.native",
      ["build", "available", "NativeBatchLoader", "batch_iterator"]),
     ("Text utilities", "elephas_tpu.utils.text", ["ByteTokenizer"]),
