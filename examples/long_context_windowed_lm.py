@@ -1,0 +1,58 @@
+"""Long-context LM: sliding-window attention x sequence parallelism.
+
+The Mistral-style configuration the reference could never express: the
+sequence axis is sharded over a device mesh (ring attention streams k/v
+shards over ICI), the attention window bounds each position's context,
+and the ring statically SKIPS hops whose shard is entirely outside the
+band — a narrow window on a long ring pays O(window) compute and
+communication, not O(seq). On TPU each hop's local block runs the Pallas
+flash kernel (``ring_flash_attention``); elsewhere the einsum ring.
+
+Run on the 8-device virtual mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_windowed_lm.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from elephas_tpu.models import Adam, TransformerModel
+from elephas_tpu.models.transformer import TransformerConfig
+from elephas_tpu.ops.ring_attention import ring_num_hops
+from elephas_tpu.tpu_model import TPUModel
+
+SEQ = 256
+SEQ_MESH = 4
+WINDOW = 48
+
+config = TransformerConfig(vocab_size=512, num_layers=4, num_heads=8,
+                           num_kv_heads=2, d_model=256, d_ff=512,
+                           max_seq_len=SEQ, positional="rope",
+                           attention_window=WINDOW)
+
+model = TransformerModel(config, sequence_parallel=SEQ_MESH)
+model.compile(Adam(learning_rate=1e-3), seed=0)
+
+shard = SEQ // SEQ_MESH
+print(f"seq {SEQ} over {SEQ_MESH}-way seq mesh (shard {shard}), "
+      f"window {WINDOW}: ring visits "
+      f"{ring_num_hops(SEQ_MESH, shard, WINDOW)}/{SEQ_MESH} hops "
+      "(out-of-band hops skipped statically)")
+
+# synthetic corpus with local structure a windowed model can learn:
+# next token = (previous token + 1) mod vocab, seeded randomly per row
+rng = np.random.default_rng(0)
+starts = rng.integers(0, config.vocab_size, size=(512, 1))
+tokens = ((starts + np.arange(SEQ)) % config.vocab_size).astype("int32")
+
+tpu_model = TPUModel(model, mode="synchronous")
+tpu_model.fit(tokens, epochs=3, batch_size=32, verbose=1,
+              validation_split=0.0)
+
+history = tpu_model.training_histories[-1]
+print("loss history:", [round(v, 4) for v in history["loss"]])
+assert history["loss"][-1] < history["loss"][0]
