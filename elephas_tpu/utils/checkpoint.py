@@ -23,6 +23,25 @@ except Exception:  # pragma: no cover - orbax is in the base image
     _HAS_ORBAX = False
 
 
+def _is_coordinator() -> bool:
+    """Process 0 owns remote-mirror writes (single-writer discipline).
+
+    Consults JAX only when a backend is already up: ``process_index()``
+    would otherwise *initialize* the backend as a side effect (pinning
+    the platform before the caller could configure it). Before backend
+    init there is no multi-process run to coordinate with."""
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return True
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # private API moved / import failure
+        return True
+
+
 class CheckpointManager:
     """Step-indexed training checkpoints under one directory.
 
@@ -36,7 +55,11 @@ class CheckpointManager:
     pattern): checkpoints are staged in a local directory and mirrored
     through the scheme's :mod:`~elephas_tpu.utils.storage` adapter; a
     fresh process restores by downloading the manifest and the requested
-    step on demand.
+    step on demand. Only process 0 mirrors (single-controller writes).
+    In a MULTI-process run whose arrays are sharded across hosts, stage
+    to a shared filesystem (or pass the ``gs://`` path straight to an
+    orbax/tensorstore checkpointer, which writes object stores natively)
+    — each host's local staging dir holds only its own array shards.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
@@ -91,7 +114,7 @@ class CheckpointManager:
             (step_dir / "treedef.json").write_text(json.dumps(treedef))
         manifest["steps"] = sorted(set(manifest["steps"]))
         (self.directory / "manifest.json").write_text(json.dumps(manifest))
-        if self._store is not None:
+        if self._store is not None and _is_coordinator():
             self._store.put_dir(str(step_dir),
                                 f"{self._remote_url}/step_{int(step)}")
             self._store.write_text(f"{self._remote_url}/manifest.json",
@@ -137,18 +160,22 @@ class CheckpointManager:
 
     def _gc(self):
         steps = self.steps()
+        evicted = False
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
+            evicted = True
             victim_dir = self.directory / f"step_{victim}"
             if victim_dir.exists():
                 shutil.rmtree(victim_dir)
-            if self._store is not None:
+            if self._store is not None and _is_coordinator():
                 self._store.delete(f"{self._remote_url}/step_{victim}",
                                    recursive=True)
+        if not evicted:
+            return  # manifest already written by save(); nothing changed
         manifest = self._read_manifest()
         manifest["steps"] = steps
         (self.directory / "manifest.json").write_text(json.dumps(manifest))
-        if self._store is not None:
+        if self._store is not None and _is_coordinator():
             self._store.write_text(f"{self._remote_url}/manifest.json",
                                    json.dumps(manifest))
 

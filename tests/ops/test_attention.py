@@ -189,6 +189,21 @@ def test_ring_flash_gqa_forward_and_grad():
                                    atol=3e-4, rtol=3e-4)
 
 
+def test_ring_flash_bf16():
+    """bf16 inputs (the chip dtype): flash ring matches the f32 einsum
+    ring within bf16 tolerance and returns bf16."""
+    q, k, v = _qkv(s=32, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    ref = ring_attention_sharded(q, k, v, mesh=mesh, seq_axis="seq",
+                                 causal=True)
+    got = ring_attention_sharded(qb, kb, vb, mesh=mesh, seq_axis="seq",
+                                 causal=True, impl="flash")
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref), atol=0.03, rtol=0.03)
+
+
 def test_ring_attention_gqa_matches_full_attention():
     """GQA ring (kv-width buffers on the wire) matches grouped full
     attention computed by head-broadcast."""
