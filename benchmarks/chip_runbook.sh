@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# One-shot on-chip measurement runbook: run the moment the TPU tunnel is
+# healthy. Captures every BASELINE.md row in sequence, appending JSON
+# lines (with per-step rc markers) to benchmarks/chip_results.jsonl so a
+# mid-run tunnel flap loses only the row in flight, never the session.
+#
+#   bash benchmarks/chip_runbook.sh            # full set (~15-25 min)
+#   bash benchmarks/chip_runbook.sh quick      # bench.py headline only
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/chip_results.jsonl
+STAMP=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+
+run_row () {
+    local name="$1"; shift
+    echo "--- $name ---" >&2
+    # no pipeline here: a pipe would report tail's rc, not the bench's
+    local tmp rc line
+    tmp=$(mktemp)
+    timeout 900 "$@" >"$tmp" 2>/dev/null
+    rc=$?
+    line=$(tail -1 "$tmp")
+    rm -f "$tmp"
+    if [ $rc -eq 0 ] && [ -n "$line" ]; then
+        printf '{"row": "%s", "at": "%s", "result": %s}\n' \
+            "$name" "$STAMP" "$line" >> "$OUT"
+        echo "$name OK: $line" >&2
+    else
+        printf '{"row": "%s", "at": "%s", "rc": %d}\n' \
+            "$name" "$STAMP" "$rc" >> "$OUT"
+        echo "$name FAILED rc=$rc" >&2
+    fi
+    return $rc
+}
+
+# headline first: the driver-recorded metric (resilient orchestrator —
+# writes benchmarks/last_good.json on success)
+run_row bench python bench.py
+[ "${1:-}" = quick ] && exit 0
+
+run_row otto python benchmarks/baseline_rows.py otto
+run_row resnet50 python benchmarks/baseline_rows.py resnet50
+run_row async python benchmarks/baseline_rows.py async
+run_row decode python benchmarks/baseline_rows.py decode
+run_row flash_scaling python benchmarks/baseline_rows.py flash
+echo "runbook complete; results in $OUT" >&2
