@@ -87,6 +87,9 @@ PAGES = [
       "make_pipelined_lm_loss", "make_pipelined_train_step"]),
     ("Callbacks", "elephas_tpu.models.callbacks",
      ["Callback", "EarlyStopping", "ModelCheckpoint", "LambdaCallback"]),
+    ("Quantized serving (int8)", "elephas_tpu.models.quantization",
+     ["QTensor", "quantize_weight", "quantize_lm_params",
+      "dequantize_lm_params"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",
      ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",

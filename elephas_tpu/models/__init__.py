@@ -16,6 +16,8 @@ from .optimizers import (LAMB, SGD, Adadelta, Adafactor, Adagrad, Adam,
 from .optimizers import deserialize as deserialize_optimizer
 from .optimizers import get as get_optimizer
 from .optimizers import serialize as serialize_optimizer
+from .quantization import (QTensor, dequantize_lm_params,
+                           quantize_lm_params)
 from .resnet import (build_resnet, build_resnet8, build_resnet50,
                      build_resnet_imagenet)
 from .saving import load_model, save_model

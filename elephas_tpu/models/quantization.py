@@ -1,0 +1,141 @@
+"""Weight-only int8 quantization for serving.
+
+Autoregressive decode is HBM-bandwidth-bound: each generated token reads
+every weight once, so halving the bytes per weight (~bf16 -> int8) is a
+direct decode-throughput lever on TPU — the modern weight-only
+post-training-quantization recipe (per-output-channel absmax scales; no
+activation quantization, so no calibration data needed).
+
+The reference has nothing comparable (its models are Keras MLPs,
+SURVEY.md §2); this is TPU-native serving upside layered on the flagship
+LM.
+
+Mechanics: the transformer consumes every large weight through
+``w.astype(config.dtype)`` (see ``_attn_apply`` / ``_mlp_apply`` /
+``decode_step`` / ``head_logits`` in
+:mod:`~elephas_tpu.models.transformer`). :class:`QTensor` is a pytree
+node whose ``astype`` dequantizes (``int8 * scale``), so a quantized
+parameter pytree drops into ``forward`` / ``decode_step`` / ``generate``
+/ :class:`~elephas_tpu.serving.TextGenerator` unchanged. XLA fuses the
+dequant multiply into the consuming matmul's operand read; HBM holds
+int8.
+
+Scope: serving/inference only. Training wants fp weights (STE tricks are
+out of scope), and ``shard_params`` specs name fp leaves — quantized
+decode runs replicated (single chip or dp), which is the serving
+deployment the decode row measures.
+"""
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QTensor", "quantize_weight", "quantize_lm_params",
+           "dequantize_lm_params"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 data + broadcastable per-output-channel f32 scales.
+
+    Quacks like an array exactly as far as the transformer needs:
+    ``astype`` (dequantize into the compute dtype), ``shape``/``ndim``,
+    and ``.T`` (the chunked-vocab loss transposes an untied quantized
+    ``head`` before consuming it; the tied-embedding table itself stays
+    fp and never becomes a QTensor).
+    """
+
+    data: jnp.ndarray
+    scale: jnp.ndarray
+
+    def astype(self, dtype):
+        # dequantize in f32 (int8 * f32 promotes) and round ONCE into the
+        # compute dtype — casting the scale to bf16 first would stack
+        # ~0.2% scale rounding on top of int8's quantization error
+        return (self.data * self.scale).astype(dtype)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def T(self):
+        return QTensor(self.data.T, self.scale.T)
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quantize_weight(w, reduce_axes: Tuple[int, ...]) -> QTensor:
+    """Symmetric per-output-channel int8: absmax over the CONTRACTED
+    (``reduce_axes``) dims sets each output channel's scale."""
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+#: weight name -> contracted axes (the dims absmax reduces over), per
+#: sublayer. Shapes per init_params: wq/wk/wv (d, h, k) contract d;
+#: wo (h, k, d) contracts (h, k); mlp w1/w3 (d, ff) and w2 (ff, d)
+#: contract their first dim; MoE w1 (E, d, f) / w2 (E, f, d) contract
+#: the middle dim (per-expert, per-output-channel scales).
+_ATTN_AXES = {"wq": (0,), "wk": (0,), "wv": (0,), "wo": (0, 1)}
+_MLP_AXES = {"w1": (0,), "w2": (0,), "w3": (0,)}
+_MOE_AXES = {"w1": (1,), "w2": (1,)}
+
+
+def quantize_lm_params(params: Dict, config) -> Dict:
+    """Quantize the transformer LM's matmul weights to int8 QTensors.
+
+    Covered: attention projections, dense-MLP weights, MoE expert and
+    shared-expert weights, and the untied ``head`` if present. Left in
+    fp: embeddings (gather table; also the tied head), norms, biases,
+    and MoE gates (tiny, routing-critical).
+    """
+    out = {k: v for k, v in params.items()}
+    for name, layer in params.items():
+        if not name.startswith("layer_"):
+            continue
+        new_layer = dict(layer)
+        new_layer["attn"] = {
+            k: (quantize_weight(v, _ATTN_AXES[k]) if k in _ATTN_AXES
+                else v)
+            for k, v in layer["attn"].items()}
+        if "mlp" in layer:
+            new_layer["mlp"] = {
+                k: (quantize_weight(v, _MLP_AXES[k]) if k in _MLP_AXES
+                    else v)
+                for k, v in layer["mlp"].items()}
+        if "moe" in layer:
+            moe = dict(layer["moe"])
+            for k in ("w1", "w2"):
+                moe[k] = quantize_weight(moe[k], _MOE_AXES[k])
+            if "shared" in moe:
+                moe["shared"] = {
+                    k: (quantize_weight(v, _MLP_AXES[k]) if k in _MLP_AXES
+                        else v)
+                    for k, v in moe["shared"].items()}
+            new_layer["moe"] = moe
+        out[name] = new_layer
+    if "head" in params and params["head"] is not None:
+        out["head"] = quantize_weight(params["head"], (0,))
+    return out
+
+
+def dequantize_lm_params(params: Dict) -> Dict:
+    """Materialize every QTensor back to f32 (round-trip/debug aid)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32) if isinstance(x, QTensor) else x,
+        params, is_leaf=lambda x: isinstance(x, QTensor))
