@@ -170,7 +170,7 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
         return batch * max_new_tokens / (time.perf_counter() - start)
 
     fp = tps(params)
-    int8 = tps(quantize_lm_params(params, c))
+    int8 = tps(quantize_lm_params(params))
     # fp is the stable headline (the row's historical meaning); int8 is
     # the candidate column, promoted explicitly once chip runs show a
     # consistent win — max(noisy fp, noisy int8) would bias upward and

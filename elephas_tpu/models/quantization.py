@@ -96,8 +96,10 @@ _MLP_AXES = {"w1": (0,), "w2": (0,), "w3": (0,)}
 _MOE_AXES = {"w1": (1,), "w2": (1,)}
 
 
-def quantize_lm_params(params: Dict, config) -> Dict:
+def quantize_lm_params(params: Dict) -> Dict:
     """Quantize the transformer LM's matmul weights to int8 QTensors.
+    Pure structure-driven (everything is derived from the params tree —
+    no config needed).
 
     Covered: attention projections, dense-MLP weights, MoE expert and
     shared-expert weights, and the untied ``head`` if present. Left in

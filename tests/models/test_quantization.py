@@ -44,7 +44,7 @@ def test_qtensor_transpose_and_shape():
 def test_quantized_forward_and_loss_close():
     config = _config()
     params = init_params(config, jax.random.PRNGKey(0))
-    qparams = quantize_lm_params(params, config)
+    qparams = quantize_lm_params(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0,
                                 config.vocab_size)
     ref = np.asarray(forward(params, tokens, config))
@@ -62,8 +62,7 @@ def test_quantized_decode_matches_quantized_forward():
     dequant multiply is f32 and XLA's excess-precision rules may fuse it
     into the two programs' matmuls differently."""
     config = _config()
-    params = quantize_lm_params(init_params(config, jax.random.PRNGKey(0)),
-                                config)
+    params = quantize_lm_params(init_params(config, jax.random.PRNGKey(0)))
     tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 10),
                                            0, config.vocab_size))
     full = np.asarray(forward(params, jnp.asarray(tokens), config))
@@ -80,7 +79,7 @@ def test_quantized_generate_and_text_generator():
 
     config = _config(vocab_size=256)
     params = init_params(config, jax.random.PRNGKey(0))
-    qparams = quantize_lm_params(params, config)
+    qparams = quantize_lm_params(params)
     prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 6),
                                            0, 256))
     out = np.asarray(generate(qparams, prompt, 8, config))
@@ -95,7 +94,7 @@ def test_quantize_moe_and_untied_head():
     config = _config(num_experts=2, expert_top_k=1, moe_shared_expert=True,
                      tied_embedding=False)
     params = init_params(config, jax.random.PRNGKey(0))
-    qparams = quantize_lm_params(params, config)
+    qparams = quantize_lm_params(params)
     assert isinstance(qparams["layer_0"]["moe"]["w1"], QTensor)
     assert isinstance(qparams["layer_0"]["moe"]["shared"]["w1"], QTensor)
     assert isinstance(qparams["head"], QTensor)
@@ -113,7 +112,7 @@ def test_quantized_untied_head_chunked_loss():
     quantized untied-head path must run and stay close to fp."""
     config = _config(tied_embedding=False, loss_vocab_chunk=32)
     params = init_params(config, jax.random.PRNGKey(0))
-    qparams = quantize_lm_params(params, config)
+    qparams = quantize_lm_params(params)
     assert isinstance(qparams["head"], QTensor)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
                                 config.vocab_size)
@@ -129,7 +128,7 @@ def test_quantized_untied_head_chunked_loss():
 def test_dequantize_round_trip():
     config = _config()
     params = init_params(config, jax.random.PRNGKey(0))
-    qparams = quantize_lm_params(params, config)
+    qparams = quantize_lm_params(params)
     deq = dequantize_lm_params(qparams)
     w = np.asarray(params["layer_0"]["attn"]["wq"], np.float32)
     dq = np.asarray(deq["layer_0"]["attn"]["wq"])

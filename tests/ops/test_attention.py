@@ -4,7 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 from elephas_tpu.ops import (attention, blockwise_attention, ring_attention,
                              ring_attention_sharded)
@@ -187,6 +187,78 @@ def test_ring_flash_gqa_forward_and_grad():
     for rg, gg in zip(ref_grads, got_grads):
         np.testing.assert_allclose(np.asarray(gg), np.asarray(rg),
                                    atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.parametrize("ring_size", [2, 4, 8])
+def test_zigzag_ring_flash_matches_full(ring_size):
+    """The balanced zigzag schedule (auto for full-causal flash rings)
+    matches the plain attention reference exactly."""
+    from functools import partial
+
+    from elephas_tpu.ops.ring_attention import ring_flash_attention
+
+    q, k, v = _qkv()
+    mesh = Mesh(np.array(jax.devices()[:ring_size]), ("seq",))
+    ref = attention(q, k, v, causal=True)
+    spec = PartitionSpec(None, None, "seq", None)
+    for zigzag in (True, None):  # explicit and auto both take the path
+        fn = jax.shard_map(
+            partial(ring_flash_attention, axis_name="seq", causal=True,
+                    zigzag=zigzag),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        got = fn(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+
+def test_zigzag_ring_flash_gradients_match_plain():
+    from functools import partial
+
+    from elephas_tpu.ops.ring_attention import ring_flash_attention
+
+    q, k, v = _qkv(s=16, d=8)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = PartitionSpec(None, None, "seq", None)
+    cot = jax.random.normal(jax.random.PRNGKey(7), q.shape, jnp.float32)
+
+    def loss(zigzag):
+        fn = jax.shard_map(
+            partial(ring_flash_attention, axis_name="seq", causal=True,
+                    zigzag=zigzag),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    ref_grads = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+    for rg, gg in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(rg),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_zigzag_ring_flash_gqa():
+    from functools import partial
+
+    from elephas_tpu.ops.ring_attention import ring_flash_attention
+
+    b, h, kvh, t, d = 2, 4, 2, 32, 8
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, kvh, t, d))
+    v = jax.random.normal(kv_, (b, kvh, t, d))
+    k_full = jnp.repeat(k, h // kvh, axis=1)
+    v_full = jnp.repeat(v, h // kvh, axis=1)
+    expected = attention(q, k_full, v_full, causal=True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = PartitionSpec(None, None, "seq", None)
+    fn = jax.shard_map(
+        partial(ring_flash_attention, axis_name="seq", causal=True,
+                zigzag=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    np.testing.assert_allclose(np.asarray(fn(q, k, v)),
+                               np.asarray(expected), atol=2e-5, rtol=2e-5)
 
 
 def test_ring_flash_bf16():
