@@ -163,17 +163,21 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, c.vocab_size)
 
-    def tps(p):
-        np.asarray(generate(p, prompt, max_new_tokens, c))  # compile
+    def tps(p, cfg):
+        np.asarray(generate(p, prompt, max_new_tokens, cfg))  # compile
         start = time.perf_counter()
-        np.asarray(generate(p, prompt, max_new_tokens, c))
+        np.asarray(generate(p, prompt, max_new_tokens, cfg))
         return batch * max_new_tokens / (time.perf_counter() - start)
 
-    fp = tps(params)
-    int8 = tps(quantize_lm_params(params))
-    # fp is the stable headline (the row's historical meaning); int8 is
-    # the candidate column, promoted explicitly once chip runs show a
-    # consistent win — max(noisy fp, noisy int8) would bias upward and
+    import dataclasses
+
+    fp = tps(params, c)
+    qp = quantize_lm_params(params)
+    int8 = tps(qp, c)
+    full_int8 = tps(qp, dataclasses.replace(c, kv_cache_quant=True))
+    # fp is the stable headline (the row's historical meaning); the int8
+    # variants are candidate columns, promoted explicitly once chip runs
+    # show a consistent win — max(noisy samples) would bias upward and
     # silently flip variants between runs
     return {"metric": "decode_tokens_per_sec",
             "value": round(fp, 1),
@@ -181,8 +185,11 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
             "max_new_tokens": max_new_tokens,
             "int8_tokens_per_sec": round(int8, 1),
             "int8_speedup": round(int8 / fp, 3),
+            "int8_kvq_tokens_per_sec": round(full_int8, 1),
+            "int8_kvq_speedup": round(full_int8 / fp, 3),
             "config": "L8 d1024 ff4096 h16 greedy KV-cache decode; "
-                      "int8 = weight-only per-channel quantization"}
+                      "int8 = weight-only per-channel quantization; "
+                      "kvq adds the int8 KV cache"}
 
 
 #: candidate (block_q, block_k) pairs for the flash kernel sweep — all

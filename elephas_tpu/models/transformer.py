@@ -104,6 +104,11 @@ class TransformerConfig:
     #: out-of-band hops statically (windowed sequence parallelism
     #: composes)
     attention_window: Optional[int] = None
+    #: int8 KV cache for decoding: cache entries store int8 with a
+    #: per-(position, head) absmax scale — long-context decode re-reads
+    #: the whole cache every step, so int8 halves that HBM traffic
+    #: (composes with GQA and weight-only int8 serving)
+    kv_cache_quant: bool = False
     #: MLP variant: ``gelu`` (GPT-2 style, w1/w2) or ``swiglu`` (Llama
     #: style: SiLU(x@w1) * (x@w3) @ w2 — the gated unit that wins at
     #: equal parameter count, Shazeer 2020). Dense blocks only; MoE
@@ -1402,13 +1407,34 @@ def init_kv_cache(config: TransformerConfig, batch: int,
     """Per-layer key/value cache for autoregressive decoding:
     ``(batch, kv_heads, max_len, head_dim)`` zeros in the compute dtype —
     GQA configs carry ``num_kv_heads`` cache heads, a
-    ``num_heads/num_kv_heads``-fold HBM saving at decode time."""
+    ``num_heads/num_kv_heads``-fold HBM saving at decode time.
+
+    With ``config.kv_cache_quant`` the cache stores int8 entries plus a
+    per-(position, head) f32 absmax scale — decode at long contexts is
+    bound by re-reading the cache every step, so int8 halves that
+    traffic on top of the GQA saving."""
     c = config
     length = max_len or c.max_seq_len
     shape = (batch, c.kv_heads, length, c.head_dim)
+    if c.kv_cache_quant:
+        sshape = shape[:-1] + (1,)
+        return {f"layer_{i}": {"k": jnp.zeros(shape, jnp.int8),
+                               "k_scale": jnp.zeros(sshape, jnp.float32),
+                               "v": jnp.zeros(shape, jnp.int8),
+                               "v_scale": jnp.zeros(sshape, jnp.float32)}
+                for i in range(c.num_layers)}
     return {f"layer_{i}": {"k": jnp.zeros(shape, c.dtype),
                            "v": jnp.zeros(shape, c.dtype)}
             for i in range(c.num_layers)}
+
+
+def _kv_quantize(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, H, D) bf16/f32 -> int8 data + (B, H, 1) absmax scale (the one
+    int8 recipe lives in :mod:`.quantization`)."""
+    from .quantization import quantize_weight
+
+    q = quantize_weight(x, (-1,))
+    return q.data, q.scale
 
 
 def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
@@ -1453,9 +1479,24 @@ def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
             # (_apply_rope broadcasts a scalar position over (B, H, half))
             q = _apply_rope(q, jnp.asarray(pos), c)
             k_new = _apply_rope(k_new, jnp.asarray(pos), c)
-        ck = cache[f"layer_{i}"]["k"].at[:, :, pos].set(k_new)
-        cv = cache[f"layer_{i}"]["v"].at[:, :, pos].set(v_new)
-        new_cache[f"layer_{i}"] = {"k": ck, "v": cv}
+        if c.kv_cache_quant:
+            kq8, ks = _kv_quantize(k_new)
+            vq8, vs = _kv_quantize(v_new)
+            lc = cache[f"layer_{i}"]
+            ck8 = lc["k"].at[:, :, pos].set(kq8)
+            cks = lc["k_scale"].at[:, :, pos].set(ks)
+            cv8 = lc["v"].at[:, :, pos].set(vq8)
+            cvs = lc["v_scale"].at[:, :, pos].set(vs)
+            new_cache[f"layer_{i}"] = {"k": ck8, "k_scale": cks,
+                                       "v": cv8, "v_scale": cvs}
+            # dequant feeds straight into the attention matmuls (XLA
+            # keeps it fused); HBM holds int8 + one scale per row
+            ck = (ck8 * cks).astype(c.dtype)
+            cv = (cv8 * cvs).astype(c.dtype)
+        else:
+            ck = cache[f"layer_{i}"]["k"].at[:, :, pos].set(k_new)
+            cv = cache[f"layer_{i}"]["v"].at[:, :, pos].set(v_new)
+            new_cache[f"layer_{i}"] = {"k": ck, "v": cv}
         # GQA: group query heads over the (smaller) kv-head axis — the
         # cache stays at kv_heads width and each group attends to its
         # shared k/v head (n = kv head, g = query heads per group)

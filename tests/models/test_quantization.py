@@ -125,6 +125,47 @@ def test_quantized_untied_head_chunked_loss():
     np.testing.assert_allclose(l_q, l_dense, atol=1e-5, rtol=1e-5)
 
 
+def test_int8_kv_cache_decode_close_to_fp():
+    """Decode with the int8 KV cache tracks the fp-cache logits within
+    int8 tolerance, and the cache actually stores int8."""
+    config = _config()
+    qcfg = dataclasses.replace(config, kv_cache_quant=True)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 12),
+                                           0, config.vocab_size))
+    cache_fp = init_kv_cache(config, 2, max_len=12)
+    cache_q = init_kv_cache(qcfg, 2, max_len=12)
+    assert cache_q["layer_0"]["k"].dtype == jnp.int8
+    max_rel = 0.0
+    for t in range(12):
+        l_fp, cache_fp = decode_step(params, cache_fp,
+                                     jnp.asarray(tokens[:, t]), t, config)
+        l_q, cache_q = decode_step(params, cache_q,
+                                   jnp.asarray(tokens[:, t]), t, qcfg)
+        diff = np.abs(np.asarray(l_q) - np.asarray(l_fp)).max()
+        max_rel = max(max_rel, diff / (np.abs(np.asarray(l_fp)).max()
+                                       + 1e-6))
+    assert max_rel < 0.05, max_rel
+
+
+def test_full_int8_serving_stack():
+    """Weight-only int8 + int8 KV cache together through generate,
+    beam_search and TextGenerator."""
+    from elephas_tpu.models.transformer import beam_search
+    from elephas_tpu.serving import TextGenerator
+
+    config = _config(vocab_size=256, kv_cache_quant=True)
+    qparams = quantize_lm_params(init_params(config, jax.random.PRNGKey(0)))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 6),
+                                           0, 256))
+    out = np.asarray(generate(qparams, prompt, 8, config))
+    assert out.shape == (2, 8)
+    seqs, scores = beam_search(qparams, prompt, 6, config, num_beams=2)
+    assert np.asarray(seqs).shape == (2, 2, 6)
+    texts = TextGenerator(qparams, config)(["ab", "cd"], max_new_tokens=5)
+    assert len(texts) == 2
+
+
 def test_dequantize_round_trip():
     config = _config()
     params = init_params(config, jax.random.PRNGKey(0))
