@@ -1437,6 +1437,103 @@ def _kv_quantize(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q.data, q.scale
 
 
+def prefill_cache(params: Dict, tokens: jnp.ndarray,
+                  config: TransformerConfig,
+                  max_len: int) -> Tuple[jnp.ndarray, Dict]:
+    """Batched prompt prefill: one forward pass over ``(batch, T)``
+    prompt tokens that writes every position's k/v into a fresh decode
+    cache and returns the last position's logits ``(batch, vocab)``.
+
+    The sequential alternative — teacher-forcing the prompt through
+    ``decode_step`` — re-reads all weights once PER PROMPT TOKEN; this
+    pass reads them once total, turning prefill from dispatch/bandwidth-
+    bound into a single MXU-bound forward. Math mirrors
+    :func:`decode_step` exactly (same norms, RoPE convention, GQA
+    grouping, window/alibi masks, dense MoE gating), so decode picks up
+    from the cache bit-consistently with the step-by-step path.
+
+    Uniform-length prompts only: ragged batches interleave per-row
+    generation with other rows' prefill (a row past its own prompt end
+    feeds back its sampled token), which a batched pass cannot express —
+    ``generate`` keeps the scan path for those.
+    """
+    c = config
+    b, t = tokens.shape
+    x = embed_apply(params["embed"], tokens, c)              # (B, T, D)
+    cache = init_kv_cache(c, b, max_len)
+    positions = jnp.arange(t)
+    q_pos = positions[:, None]
+    k_pos = positions[None, :]
+    mask = k_pos <= q_pos
+    if c.attention_window is not None:
+        mask = mask & (k_pos > q_pos - c.attention_window)
+    mask = mask[None, None]                                  # (1, 1, T, T)
+    scale = 1.0 / math.sqrt(c.head_dim)
+    new_cache: Dict = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        h = _norm(x, layer["ln1"], c)
+        h = h.astype(c.dtype)
+        q = jnp.einsum("btd,dhk->bhtk", h,
+                       layer["attn"]["wq"].astype(c.dtype))
+        k = jnp.einsum("btd,dhk->bhtk", h,
+                       layer["attn"]["wk"].astype(c.dtype))
+        v = jnp.einsum("btd,dhk->bhtk", h,
+                       layer["attn"]["wv"].astype(c.dtype))
+        if c.positional == "rope":
+            q = _apply_rope(q, positions, c)
+            k = _apply_rope(k, positions, c)
+        # write the whole prompt's k/v into the cache in one shot
+        # ((B, H, T, D) -> cache rows [0, T))
+        if c.kv_cache_quant:
+            kq8, ks = _kv_quantize(k)
+            vq8, vs = _kv_quantize(v)
+            lc = cache[f"layer_{i}"]
+            new_cache[f"layer_{i}"] = {
+                "k": lc["k"].at[:, :, :t].set(kq8),
+                "k_scale": lc["k_scale"].at[:, :, :t].set(ks),
+                "v": lc["v"].at[:, :, :t].set(vq8),
+                "v_scale": lc["v_scale"].at[:, :, :t].set(vs)}
+            # attention inside prefill consumes the QUANTIZED k/v, so the
+            # step-by-step path (which attends over dequantized cache
+            # entries) is reproduced exactly
+            k = (kq8 * ks).astype(c.dtype)
+            v = (vq8 * vs).astype(c.dtype)
+        else:
+            lc = cache[f"layer_{i}"]
+            new_cache[f"layer_{i}"] = {
+                "k": lc["k"].at[:, :, :t].set(k),
+                "v": lc["v"].at[:, :, :t].set(v)}
+        groups = c.num_heads // c.kv_heads
+        qg = q.reshape(b, c.kv_heads, groups, t, c.head_dim)
+        scores = jnp.einsum("bngqk,bntk->bngqt", qg, k) * scale
+        if c.positional == "alibi":
+            dist = (q_pos - k_pos).astype(jnp.float32)       # (T, T)
+            ab = (-_alibi_slopes(c.num_heads)[:, None, None]
+                  * dist[None]).reshape(c.kv_heads, groups, t, t)
+            scores = scores + ab[None]
+        scores = jnp.where(mask[:, :, None], scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bngqt,bntk->bngqk", weights, v)
+        o = o.reshape(b, c.num_heads, t, c.head_dim)
+        x = x + jnp.einsum("bhtk,hkd->btd", o,
+                           layer["attn"]["wo"].astype(c.dtype))
+        if c.num_experts > 1:
+            h2 = _norm(x, layer["ln2"], c)
+            h2 = h2.astype(c.dtype)
+            # dense gating, matching decode_step's decode-time semantics
+            h2_out, _ = _moe_block(h2, layer["moe"], c, dispatch="dense")
+            if c.moe_shared_expert:
+                h2_out = h2_out + _shared_expert(h2, layer["moe"]["shared"],
+                                                 c)
+            x = x + h2_out
+        else:
+            x = _mlp_apply(layer, x, c)
+    logits = head_logits(params["embed"], params["final_ln"], x[:, -1],
+                         head=params.get("head"), norm=c.norm)
+    return logits, new_cache
+
+
 def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
                 config: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
     """One autoregressive step: token ids ``(batch,)`` at position ``pos``
@@ -1573,7 +1670,8 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
     c = config
     batch = prompt.shape[0]
     total = prompt_len + max_new_tokens
-    cache = init_kv_cache(c, batch, total)
+    if max_new_tokens == 0:
+        return jnp.zeros((batch, 0), jnp.int32)
     lens = (prompt_lengths if prompt_lengths is not None
             else jnp.full((batch,), prompt_len, jnp.int32))
     seen0 = jnp.zeros((batch, c.vocab_size), bool)
@@ -1585,11 +1683,7 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
         seen0 = seen0.at[jnp.arange(batch)[:, None], marked].set(
             True, mode="drop")
 
-    def step_fn(carry, t):
-        cache, prev, key, seen = carry
-        tok = jnp.where(t < lens,
-                        prompt[:, jnp.minimum(t, prompt_len - 1)], prev)
-        logits, cache = decode_step(params, cache, tok, t, c)
+    def next_token(logits, seen, key):
         if use_rep_penalty:
             # CTRL-style: shrink already-emitted tokens' logits toward
             # "less likely" on whichever side of zero they sit
@@ -1604,22 +1698,57 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
             nxt = jax.random.categorical(sub, filtered, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
+        return nxt, key
+
+    def mark_seen(seen, nxt, t):
         if use_rep_penalty:
             # only tokens actually fed back (emitted) mark the presence
             # buffer; samples discarded for prompt positions scatter out
             # of range and drop — 'prompt or emitted so far' semantics
             mark = jnp.where(t + 1 >= lens, nxt, c.vocab_size)
             seen = seen.at[jnp.arange(batch), mark].set(True, mode="drop")
+        return seen
+
+    if prompt_lengths is None:
+        # uniform prompts: batched prefill — ONE forward writes the
+        # whole prompt's k/v (weights read once, not once per token),
+        # then the scan covers only the generated positions
+        logits0, cache = prefill_cache(params, prompt, c, total)
+        nxt0, key = next_token(logits0, seen0, key)
+        seen = mark_seen(seen0, nxt0, prompt_len - 1)
+
+        def gen_step(carry, t):
+            cache, prev, key, seen = carry
+            logits, cache = decode_step(params, cache, prev, t, c)
+            nxt, key = next_token(logits, seen, key)
+            seen = mark_seen(seen, nxt, t)
+            return (cache, nxt, key, seen), nxt
+
+        if max_new_tokens == 1:
+            return nxt0[:, None]
+        _, rest = jax.lax.scan(gen_step, (cache, nxt0, key, seen),
+                               jnp.arange(prompt_len, total - 1))
+        return jnp.concatenate([nxt0[:, None], rest.T], axis=1)
+
+    # ragged prompts: rows finish their prompts at different steps and
+    # start generating while others still teacher-force, so the cache
+    # fills token-by-token in one unified scan
+    cache = init_kv_cache(c, batch, total)
+
+    def step_fn(carry, t):
+        cache, prev, key, seen = carry
+        tok = jnp.where(t < lens,
+                        prompt[:, jnp.minimum(t, prompt_len - 1)], prev)
+        logits, cache = decode_step(params, cache, tok, t, c)
+        nxt, key = next_token(logits, seen, key)
+        seen = mark_seen(seen, nxt, t)
         return (cache, nxt, key, seen), nxt
 
     (_, _, _, _), sampled = jax.lax.scan(
         step_fn, (cache, prompt[:, 0], key, seen0), jnp.arange(total - 1))
     # sampled[t] is the model's token for position t+1: row b's
     # generation starts at its own prompt end, i.e. steps
-    # lens[b]-1 .. lens[b]+max_new-2 (a per-row gather for ragged
-    # batches; the uniform case reduces to sampled[prompt_len-1:])
-    if prompt_lengths is None:
-        return sampled[prompt_len - 1:].T
+    # lens[b]-1 .. lens[b]+max_new-2 (a per-row gather)
     idx = (lens[:, None] - 1) + jnp.arange(max_new_tokens)[None, :]
     return jnp.take_along_axis(sampled.T, idx, axis=1)
 
@@ -1689,19 +1818,13 @@ def _beam_search_scan(params, prompt, prompt_len: int, max_new_tokens: int,
     total = prompt_len + max_new_tokens
     bb = batch * num_beams
 
-    # beams ride the batch axis of one shared decode program
-    cache = init_kv_cache(c, bb, total)
-    flat_prompt = jnp.repeat(prompt, num_beams, axis=0)       # (B*K, P)
-
-    # teacher-force the prompt through all beams (identical prefixes)
-    def prefill(carry, t):
-        cache, _ = carry
-        logits, cache = decode_step(params, cache, flat_prompt[:, t], t, c)
-        return (cache, logits), None
-
-    zero_logits = jnp.zeros((bb, c.vocab_size), jnp.float32)
-    (cache, logits), _ = jax.lax.scan(prefill, (cache, zero_logits),
-                                      jnp.arange(prompt_len))
+    # beams ride the batch axis of one shared decode program; identical
+    # prefixes mean the prompt prefills ONCE per row (not per beam) and
+    # the resulting cache/logits repeat across the beam axis
+    logits_row, cache_row = prefill_cache(params, prompt, c, total)
+    logits = jnp.repeat(logits_row, num_beams, axis=0)        # (B*K, V)
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, num_beams, axis=0), cache_row)
 
     # only beam 0 is live initially (identical beams would tie)
     scores0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (num_beams - 1),

@@ -1,0 +1,119 @@
+"""Batched prefill: one forward pass fills the decode cache; must be
+bit-consistent with the token-by-token decode path across config
+variants, and generate's fast path must produce identical greedy output
+to the unified ragged scan."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.models.transformer import (TransformerConfig, decode_step,
+                                            forward, generate, init_params,
+                                            prefill_cache)
+
+
+def _config(**overrides):
+    base = dict(vocab_size=128, num_layers=2, num_heads=4, d_model=32,
+                d_ff=64, max_seq_len=48)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+VARIANTS = {
+    "base": {},
+    "gqa": {"num_kv_heads": 2},
+    "window": {"attention_window": 5},
+    "alibi": {"positional": "alibi"},
+    "sinusoidal": {"positional": "sinusoidal"},
+    "kvq": {"kv_cache_quant": True},
+    "moe": {"num_experts": 2, "expert_top_k": 1},
+}
+
+
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_prefill_then_decode_matches_stepwise(variant):
+    """prefill_cache(prompt) + decode_step continuation == teacher-
+    forcing every token through decode_step (cache contents and logits
+    agree)."""
+    config = _config(**VARIANTS[variant])
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 12),
+                                           0, config.vocab_size))
+    prompt_len, total = 8, 12
+
+    # stepwise reference
+    from elephas_tpu.models.transformer import init_kv_cache
+
+    cache_ref = init_kv_cache(config, 2, max_len=total)
+    for t in range(total):
+        logits_ref, cache_ref = decode_step(
+            params, cache_ref, jnp.asarray(tokens[:, t]), t, config)
+
+    # prefill + stepwise continuation
+    logits_pf, cache_pf = prefill_cache(params, jnp.asarray(
+        tokens[:, :prompt_len]), config, max_len=total)
+    # prefill's last-position logits == stepwise logits at that position
+    cache_chk = init_kv_cache(config, 2, max_len=total)
+    for t in range(prompt_len):
+        step_logits, cache_chk = decode_step(
+            params, cache_chk, jnp.asarray(tokens[:, t]), t, config)
+    np.testing.assert_allclose(np.asarray(logits_pf),
+                               np.asarray(step_logits), atol=2e-3,
+                               rtol=2e-3)
+    for t in range(prompt_len, total):
+        logits_pf, cache_pf = decode_step(
+            params, cache_pf, jnp.asarray(tokens[:, t]), t, config)
+    np.testing.assert_allclose(np.asarray(logits_pf),
+                               np.asarray(logits_ref), atol=2e-3,
+                               rtol=2e-3)
+    # and both agree with the batched forward at the last position —
+    # except under kv_cache_quant, where decode attends over the int8
+    # cache while forward uses full-precision k/v (int8-level gap by
+    # design; the prefill-vs-stepwise consistency above is the contract)
+    if not config.kv_cache_quant:
+        fwd = np.asarray(forward(params, jnp.asarray(tokens),
+                                 config))[:, -1]
+        np.testing.assert_allclose(np.asarray(logits_pf), fwd, atol=2e-3,
+                                   rtol=2e-3)
+
+
+def test_fast_path_greedy_equals_ragged_scan():
+    """Uniform prompts: the prefill fast path and the unified ragged
+    scan (forced via prompt_lengths) emit identical greedy tokens."""
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (3, 7),
+                                           0, config.vocab_size))
+    fast = np.asarray(generate(params, prompt, 10, config))
+    slow = np.asarray(generate(params, prompt, 10, config,
+                               prompt_lengths=np.full(3, 7)))
+    np.testing.assert_array_equal(fast, slow)
+
+
+def test_fast_path_single_new_token():
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 5),
+                                           0, config.vocab_size))
+    out = np.asarray(generate(params, prompt, 1, config))
+    assert out.shape == (2, 1)
+    slow = np.asarray(generate(params, prompt, 1, config,
+                               prompt_lengths=np.full(2, 5)))
+    np.testing.assert_array_equal(out, slow)
+
+
+def test_fast_path_repetition_penalty_semantics():
+    """Rep penalty through the fast path matches the ragged scan: the
+    prompt marks the seen buffer, then each emitted token does."""
+    config = _config()
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 6),
+                                           0, config.vocab_size))
+    fast = np.asarray(generate(params, prompt, 8, config,
+                               repetition_penalty=1.4))
+    slow = np.asarray(generate(params, prompt, 8, config,
+                               repetition_penalty=1.4,
+                               prompt_lengths=np.full(2, 6)))
+    np.testing.assert_array_equal(fast, slow)
