@@ -305,6 +305,8 @@ def main():
                 break
         if result is not None:
             result["stale"] = False
+            result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime())
             try:
                 os.makedirs(os.path.dirname(_LAST_GOOD), exist_ok=True)
                 with open(_LAST_GOOD, "w") as f:

@@ -255,15 +255,27 @@ def measure_flash_scaling(seqs=(1024, 2048, 4096, 8192), heads=16,
             "unit": "ms/step (fwd+bwd)", "dtype": dtype, "rows": rows}
 
 
+def _emit(row):
+    """Stamp measurement provenance (backend/device/time) onto a row so a
+    CPU-fallback run can never be mistaken for a chip number downstream."""
+    import jax
+
+    dev = jax.devices()[0]
+    row["backend"] = dev.platform
+    row["device"] = getattr(dev, "device_kind", dev.platform)
+    row["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(json.dumps(row))
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     if which in ("otto", "all"):
-        print(json.dumps(measure_otto()))
+        _emit(measure_otto())
     if which in ("resnet50", "all"):
-        print(json.dumps(measure_resnet50()))
+        _emit(measure_resnet50())
     if which in ("async", "all"):
-        print(json.dumps(measure_async()))
+        _emit(measure_async())
     if which in ("decode", "all"):
-        print(json.dumps(measure_decode()))
+        _emit(measure_decode())
     if which in ("flash", "all"):
-        print(json.dumps(measure_flash_scaling()))
+        _emit(measure_flash_scaling())
