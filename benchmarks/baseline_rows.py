@@ -175,6 +175,36 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
     qp = quantize_lm_params(params)
     int8 = tps(qp, c)
     full_int8 = tps(qp, dataclasses.replace(c, kv_cache_quant=True))
+
+    # speculative-decoding primitive: per-token cost of the gamma+1-wide
+    # verify block vs the sequential scan above — the weight-read
+    # amortization that bounds spec-decode's speedup (1 + gamma*accept),
+    # measured draft-free so it is model-quality-independent
+    import jax.numpy as jnp
+    from functools import partial
+
+    from elephas_tpu.models.transformer import decode_block, prefill_cache
+
+    gamma1 = 5
+    blk_tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, gamma1),
+                                    0, c.vocab_size)
+
+    @partial(jax.jit, static_argnames=())
+    def verify_rounds(p, cache):
+        def body(i, carry):
+            cache, acc = carry
+            lg, cache = decode_block(p, cache, blk_tokens,
+                                     prompt_len + i * gamma1, c)
+            return cache, acc + lg.sum()
+        return jax.lax.fori_loop(0, max_new_tokens // gamma1, body,
+                                 (cache, jnp.float32(0)))[1]
+
+    _, cache0 = prefill_cache(params, prompt, c, c.max_seq_len)
+    float(verify_rounds(params, cache0))  # compile
+    start = time.perf_counter()
+    float(verify_rounds(params, cache0))
+    verify_tps = (batch * gamma1 * (max_new_tokens // gamma1)
+                  / (time.perf_counter() - start))
     # fp is the stable headline (the row's historical meaning); the int8
     # variants are candidate columns, promoted explicitly once chip runs
     # show a consistent win — max(noisy samples) would bias upward and
@@ -187,9 +217,13 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
             "int8_speedup": round(int8 / fp, 3),
             "int8_kvq_tokens_per_sec": round(full_int8, 1),
             "int8_kvq_speedup": round(full_int8 / fp, 3),
+            "spec_verify_tokens_per_sec": round(verify_tps, 1),
+            "spec_verify_speedup": round(verify_tps / fp, 3),
             "config": "L8 d1024 ff4096 h16 greedy KV-cache decode; "
                       "int8 = weight-only per-channel quantization; "
-                      "kvq adds the int8 KV cache"}
+                      "kvq adds the int8 KV cache; spec_verify = "
+                      "5-token decode_block rounds (speculative "
+                      "decoding's verify primitive, draft-free ceiling)"}
 
 
 #: candidate (block_q, block_k) pairs for the flash kernel sweep — all

@@ -37,7 +37,8 @@ run_row () {   # $1 = row name, $2 = baseline_rows.py arg
     if [ $rc -eq 0 ] && [ -n "$line" ] && python -c '
 import json, sys
 row = json.loads(sys.argv[1])
-assert row.get("backend") == "tpu", f"backend={row.get(\"backend\")}"
+backend = row.get("backend")
+assert backend == "tpu", "backend=%s" % backend
 ' "$line" 2>>"$ERRDIR/$1.err"; then
         printf '{"row": "%s", "at": "%s", "result": %s}\n' \
             "$1" "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$line" >> "$OUT"

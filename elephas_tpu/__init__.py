@@ -23,4 +23,5 @@ if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
 from . import models, utils
 from .data import Dataset
 from .serving import TextGenerator
+from .serving_engine import DecodeEngine
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

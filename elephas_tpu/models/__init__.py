@@ -1,5 +1,6 @@
 from . import (activations, bert, encdec, initializers, lora, losses,
-               metrics, optimizers, schedules, transformer, vit)
+               metrics, optimizers, schedules, speculative, transformer,
+               vit)
 from .schedules import (CosineDecay, ExponentialDecay,
                         PiecewiseConstantDecay, WarmupCosine)
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,

@@ -1534,103 +1534,139 @@ def prefill_cache(params: Dict, tokens: jnp.ndarray,
     return logits, new_cache
 
 
-def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
-                config: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
-    """One autoregressive step: token ids ``(batch,)`` at position ``pos``
-    -> (next-token logits ``(batch, vocab)``, updated cache).
+def decode_block(params: Dict, cache: Dict, tokens: jnp.ndarray, pos0,
+                 config: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """Multi-token cached decode: process ``(batch, S)`` tokens sitting
+    at positions ``pos0 .. pos0+S-1`` of an ongoing sequence, reading and
+    writing the rolling k/v cache, and return (logits ``(batch, S,
+    vocab)`` for every block position, updated cache).
 
-    The incremental mirror of :func:`forward`: each layer projects one
-    query, writes its new k/v into the cache, and attends over the cached
-    prefix with a length mask — O(seq) per step instead of the O(seq^2)
-    full recompute. Softmax/score dtypes match the training attention
-    (``ops.attention``) so teacher-forced decoding reproduces `forward`'s
-    logits.
+    The block generalization of :func:`decode_step` (S=1) and
+    :func:`prefill_cache` (``pos0=0`` on a fresh cache): one weight read
+    covers S positions, so the verify pass of speculative decoding and
+    chunked continuation of long prompts run MXU-bound instead of
+    weight-bandwidth-bound. Math matches ``decode_step`` exactly (norms,
+    RoPE convention, GQA grouping, window/alibi masks, dense MoE gating,
+    int8 cache quantization), pinned by parity tests.
+
+    ``pos0`` may be a scalar or a ``(batch,)`` vector — per-row offsets
+    are what batched speculative decoding needs, because rows accept
+    different numbers of draft tokens per round. Within the block each
+    query attends causally: cache positions ``<= pos0+j`` for block slot
+    ``j`` (all S slots' k/v are written before attention, so intra-block
+    attention sees the new keys).
     """
     c = config
-    scale = 1.0 / math.sqrt(c.head_dim)
+    b, s = tokens.shape
+    pos0 = jnp.asarray(pos0)
+    vec = pos0.ndim == 1
+    length = next(iter(cache.values()))["k"].shape[2]
+    blockpos = (pos0[:, None] + jnp.arange(s)[None, :] if vec
+                else pos0 + jnp.arange(s))             # (B, S) or (S,)
     x = params["embed"]["tokens"][tokens]
     if c.positional == "learned":
-        x = x + params["embed"]["pos"][pos]
+        x = x + params["embed"]["pos"][blockpos]
     elif c.positional == "sinusoidal":
-        x = x + _sinusoidal_table(jnp.asarray(pos), c.d_model)
-    x = x.astype(c.dtype)                                    # (B, D)
-    length = next(iter(cache.values()))["k"].shape[2]
-    positions = jnp.arange(length)
-    mask = positions <= pos
+        x = x + _sinusoidal_table(blockpos, c.d_model)
+    x = x.astype(c.dtype)                              # (B, S, D)
+    kpos = jnp.arange(length)
+    qp = blockpos if vec else blockpos[None, :]        # (B|1, S)
+    mask = kpos[None, None, :] <= qp[:, :, None]       # (B|1, S, L)
     if c.attention_window is not None:
-        mask = mask & (positions > pos - c.attention_window)
-    mask = mask[None, None, :]                               # (1, 1, L)
+        mask = mask & (kpos[None, None, :]
+                       > qp[:, :, None] - c.attention_window)
+    scale = 1.0 / math.sqrt(c.head_dim)
+    # rope angle positions: (B, 1, S) broadcasts per-row angles over the
+    # head axis of (B, H, S, K); a (S,) vector broadcasts over B and H
+    rp = blockpos[:, None, :] if vec else blockpos
+    if vec:
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(c.kv_heads)[None, :, None]
+        widx = (bidx, hidx, blockpos[:, None, :])      # -> (B, H, S)
+    groups = c.num_heads // c.kv_heads
     new_cache: Dict = {}
     for i in range(c.num_layers):
         layer = params[f"layer_{i}"]
         h = _norm(x, layer["ln1"], c)
         h = h.astype(c.dtype)
-        q = jnp.einsum("bd,dhk->bhk", h, layer["attn"]["wq"].astype(c.dtype))
-        k_new = jnp.einsum("bd,dhk->bhk", h,
+        q = jnp.einsum("bsd,dhk->bhsk", h,
+                       layer["attn"]["wq"].astype(c.dtype))
+        k_new = jnp.einsum("bsd,dhk->bhsk", h,
                            layer["attn"]["wk"].astype(c.dtype))
-        v_new = jnp.einsum("bd,dhk->bhk", h,
+        v_new = jnp.einsum("bsd,dhk->bhsk", h,
                            layer["attn"]["wv"].astype(c.dtype))
         if c.positional == "rope":
-            # the cache stores rotated keys (standard practice): the new
-            # k/q rotate at this position, cached keys are already rotated
-            # (_apply_rope broadcasts a scalar position over (B, H, half))
-            q = _apply_rope(q, jnp.asarray(pos), c)
-            k_new = _apply_rope(k_new, jnp.asarray(pos), c)
+            q = _apply_rope(q, rp, c)
+            k_new = _apply_rope(k_new, rp, c)
+
+        def write(buf, val):
+            if vec:
+                return buf.at[widx].set(val)
+            return jax.lax.dynamic_update_slice(
+                buf, val.astype(buf.dtype), (0, 0, pos0, 0))
+
+        lc = cache[f"layer_{i}"]
         if c.kv_cache_quant:
             kq8, ks = _kv_quantize(k_new)
             vq8, vs = _kv_quantize(v_new)
-            lc = cache[f"layer_{i}"]
-            ck8 = lc["k"].at[:, :, pos].set(kq8)
-            cks = lc["k_scale"].at[:, :, pos].set(ks)
-            cv8 = lc["v"].at[:, :, pos].set(vq8)
-            cvs = lc["v_scale"].at[:, :, pos].set(vs)
+            ck8, cks = write(lc["k"], kq8), write(lc["k_scale"], ks)
+            cv8, cvs = write(lc["v"], vq8), write(lc["v_scale"], vs)
             new_cache[f"layer_{i}"] = {"k": ck8, "k_scale": cks,
                                        "v": cv8, "v_scale": cvs}
-            # dequant feeds straight into the attention matmuls (XLA
-            # keeps it fused); HBM holds int8 + one scale per row
             ck = (ck8 * cks).astype(c.dtype)
             cv = (cv8 * cvs).astype(c.dtype)
         else:
-            ck = cache[f"layer_{i}"]["k"].at[:, :, pos].set(k_new)
-            cv = cache[f"layer_{i}"]["v"].at[:, :, pos].set(v_new)
+            ck = write(lc["k"], k_new)
+            cv = write(lc["v"], v_new)
             new_cache[f"layer_{i}"] = {"k": ck, "v": cv}
-        # GQA: group query heads over the (smaller) kv-head axis — the
-        # cache stays at kv_heads width and each group attends to its
-        # shared k/v head (n = kv head, g = query heads per group)
-        groups = c.num_heads // c.kv_heads
-        qg = q.reshape(q.shape[0], c.kv_heads, groups, c.head_dim)
-        scores = jnp.einsum("bngk,bntk->bngt", qg, ck) * scale
+        qg = q.reshape(b, c.kv_heads, groups, s, c.head_dim)
+        scores = jnp.einsum("bngsk,bntk->bngst", qg, ck) * scale
         if c.positional == "alibi":
-            dist = (pos - positions).astype(jnp.float32)     # (L,)
-            ab = (-_alibi_slopes(c.num_heads)[:, None]
-                  * dist[None, :]).reshape(
-                      c.kv_heads, groups, length)            # (n, g, L)
-            scores = scores + ab[None]
-        scores = jnp.where(mask[:, :, None, :], scores, NEG_INF)
+            dist = (qp[:, :, None] - kpos[None, None, :]).astype(
+                jnp.float32)                           # (B|1, S, L)
+            ab = (-_alibi_slopes(c.num_heads)[None, :, None, None]
+                  * dist[:, None]).reshape(
+                      dist.shape[0], c.kv_heads, groups, s, length)
+            scores = scores + ab
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bngt,bntk->bngk", weights, cv)
-        o = o.reshape(o.shape[0], c.num_heads, c.head_dim)
-        x = x + jnp.einsum("bhk,hkd->bd", o,
+        o = jnp.einsum("bngst,bntk->bngsk", weights, cv)
+        o = o.reshape(b, c.num_heads, s, c.head_dim)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o,
                            layer["attn"]["wo"].astype(c.dtype))
         if c.num_experts > 1:
             h2 = _norm(x, layer["ln2"], c)
-            h2 = h2.astype(c.dtype)[:, None, :]              # (B, 1, D)
-            # always dense top-k gating at decode time: capacity-based
-            # dropping is a training-time load-balancing artifact — a
-            # per-step "capacity" over one position would drop tokens
-            # in a pattern unrelated to the full-sequence forward. Dense
-            # gating equals routed-without-drops, so teacher-forced
-            # parity with `forward` is exact whenever forward dropped
-            # nothing (and strictly better-behaved when it did).
+            h2 = h2.astype(c.dtype)
             h2_out, _ = _moe_block(h2, layer["moe"], c, dispatch="dense")
             if c.moe_shared_expert:
                 h2_out = h2_out + _shared_expert(h2, layer["moe"]["shared"],
                                                  c)
-            x = x + h2_out[:, 0]
+            x = x + h2_out
         else:
             x = _mlp_apply(layer, x, c)
-    return (head_logits(params["embed"], params["final_ln"], x,
-                        head=params.get("head"), norm=c.norm), new_cache)
+    logits = head_logits(params["embed"], params["final_ln"], x,
+                         head=params.get("head"), norm=c.norm)
+    return logits, new_cache
+
+
+def decode_step(params: Dict, cache: Dict, tokens: jnp.ndarray, pos,
+                config: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """One autoregressive step: token ids ``(batch,)`` at position ``pos``
+    -> (next-token logits ``(batch, vocab)``, updated cache).
+
+    The incremental mirror of :func:`forward` — O(seq) per step instead
+    of the O(seq^2) full recompute. ``pos`` may be a scalar (all rows at
+    the same position — the plain decode loop) or a ``(batch,)`` vector
+    of per-row positions, which speculative decoding and continuous
+    batching need because rows advance their caches independently.
+
+    Implemented as the S=1 case of :func:`decode_block`, so every
+    config variant (GQA, window, ALiBi, int8 cache, MoE) has exactly one
+    cached-attention implementation to keep bit-consistent.
+    """
+    logits, new_cache = decode_block(params, cache, tokens[:, None], pos,
+                                     config)
+    return logits[:, 0], new_cache
 
 
 def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],

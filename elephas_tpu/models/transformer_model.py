@@ -491,6 +491,27 @@ class TransformerModel:
                                     top_k=top_k, top_p=top_p,
                                     prompt_lengths=prompt_lengths))
 
+    def speculative_generate(self, draft: "TransformerModel",
+                             prompt: np.ndarray, max_new_tokens: int,
+                             gamma: int = 4, temperature: float = 0.0,
+                             seed: int = 0, return_stats: bool = False):
+        """Draft-and-verify decoding: ``draft`` (a smaller
+        TransformerModel sharing this model's vocabulary) proposes
+        ``gamma`` tokens per round and this model verifies them in one
+        cached block forward. Greedy output is token-identical to
+        :meth:`generate`; the speedup is ``1 + gamma * acceptance``
+        emitted tokens per target weight read."""
+        from .speculative import speculative_generate as _spec
+
+        out = _spec(self.params, draft.params, np.asarray(prompt),
+                    int(max_new_tokens), self.config, draft.config,
+                    gamma=gamma, temperature=temperature,
+                    key=jax.random.PRNGKey(seed),
+                    return_stats=return_stats)
+        if return_stats:
+            return np.asarray(out[0]), out[1]
+        return np.asarray(out)
+
     def beam_search(self, prompt: np.ndarray, max_new_tokens: int,
                     num_beams: int = 4, length_penalty: float = 0.0,
                     eos_id: Optional[int] = None):
