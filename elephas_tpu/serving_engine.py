@@ -87,7 +87,7 @@ class DecodeEngine:
         cfg = config
         temp = self.temperature
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(1,))
         def _step(params, cache, last, pos, key):
             logits, cache = decode_step(params, cache, last, pos, cfg)
             if temp > 0:
@@ -97,9 +97,11 @@ class DecodeEngine:
                 tok = jnp.argmax(logits, axis=-1)
             return tok.astype(jnp.int32), cache, key
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0,))
         def _install(cache, row_cache, slot):
-            # slot is traced: one compilation serves every slot index
+            # slot is traced: one compilation serves every slot index;
+            # the engine cache is donated (like _step's) so neither hot
+            # path copies the multi-layer k/v buffers
             return jax.tree_util.tree_map(
                 lambda big, row: jax.lax.dynamic_update_index_in_dim(
                     big, row[0], slot, 0), cache, row_cache)
@@ -160,20 +162,23 @@ class DecodeEngine:
             self._pos[slot] = prompt.size - 1
             self._last[slot] = t0
             self._budget[slot] = max_new
-            self._fresh[rid] = t0    # surfaced by the next step()
-            self._record(slot, t0)
+            if self._record(slot, t0):
+                self._fresh[rid] = t0    # surfaced by the next step()
 
-    def _record(self, slot: int, tok: int):
+    def _record(self, slot: int, tok: int) -> bool:
         """Book one emitted token for the slot's request; retire the
-        request when it hits eos or exhausts its budget."""
+        request when it hits eos or exhausts its budget. Returns whether
+        the token is part of the output (eos is not — and is therefore
+        never streamed either, keeping step() ≡ result())."""
         rid = self._rid[slot]
         if self.eos_id is not None and tok == self.eos_id:
             self._finish(slot)
-            return
+            return False
         self._outputs[rid].append(tok)
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
+        return True
 
     def _finish(self, slot: int):
         rid = self._rid[slot]
@@ -183,9 +188,14 @@ class DecodeEngine:
     # ------------------------------------------------------------- step
     @property
     def pending(self) -> int:
-        """Requests still in flight or queued."""
+        """Work remaining: requests queued or in flight, plus emitted
+        tokens not yet surfaced by step() — so the canonical
+        ``while eng.pending: eng.step()`` loop always delivers a
+        request's tokens even when it retires at admission time
+        (``max_new_tokens=1``)."""
         return (len(self._queue)
-                + sum(r is not None for r in self._rid))
+                + sum(r is not None for r in self._rid)
+                + len(self._fresh))
 
     def step(self) -> Dict[int, List[int]]:
         """Advance every active slot by one token; returns
@@ -212,8 +222,8 @@ class DecodeEngine:
             rid = self._rid[slot]
             self._pos[slot] += 1
             self._last[slot] = toks[slot]
-            self._record(slot, int(toks[slot]))
-            emitted.setdefault(rid, []).append(int(toks[slot]))
+            if self._record(slot, int(toks[slot])):
+                emitted.setdefault(rid, []).append(int(toks[slot]))
         self._admit()
         return emitted
 

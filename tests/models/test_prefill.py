@@ -117,3 +117,27 @@ def test_fast_path_repetition_penalty_semantics():
                                repetition_penalty=1.4,
                                prompt_lengths=np.full(2, 6)))
     np.testing.assert_array_equal(fast, slow)
+
+
+@pytest.mark.parametrize("variant", ["base", "gqa", "window", "kvq"])
+def test_chunked_prefill_matches_whole(variant):
+    """prefill_cache_chunked == prefill_cache (logits + cache), incl.
+    a chunk size that does not divide the prompt length."""
+    from elephas_tpu.models.transformer import prefill_cache_chunked
+
+    config = _config(**VARIANTS[variant])
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 11), 0,
+                                config.vocab_size)
+    ref_logits, ref_cache = prefill_cache(params, prompt, config, 24)
+    for chunk in (4, 11, 16):
+        lg, cache = prefill_cache_chunked(params, prompt, config, 24,
+                                          chunk=chunk)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(ref_logits),
+                                   atol=2e-5)
+        for k in ref_cache:
+            for kk in ref_cache[k]:
+                np.testing.assert_allclose(
+                    np.asarray(cache[k][kk], dtype=np.float32),
+                    np.asarray(ref_cache[k][kk], dtype=np.float32),
+                    atol=2e-5)

@@ -129,6 +129,34 @@ def test_streamed_tokens_reconstruct_outputs(model):
         assert eng.result(rid) == streamed[rid]
 
 
+def test_streaming_edge_cases(model):
+    """max_new_tokens=1 requests retire at admission — their token must
+    still surface through step(); an eos token is neither in result()
+    nor in the stream."""
+    params, config = model
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, 64, 5)
+    eng = DecodeEngine(params, config, max_slots=1)
+    rid = eng.submit(prompt, 1)
+    streamed = []
+    while eng.pending:
+        for r, toks in eng.step().items():
+            assert r == rid
+            streamed.extend(toks)
+    assert streamed == eng.result(rid) == _ref(params, config, prompt, 1)
+
+    full = _ref(params, config, prompt, 10)
+    eos = full[3]
+    eng2 = DecodeEngine(params, config, max_slots=1, eos_id=eos)
+    rid2 = eng2.submit(prompt, 10)
+    streamed2 = []
+    while eng2.pending:
+        for _, toks in eng2.step().items():
+            streamed2.extend(toks)
+    assert eos not in streamed2
+    assert streamed2 == eng2.result(rid2) == full[:3]
+
+
 def test_sampling_mode_runs(model):
     params, config = model
     rng = np.random.default_rng(4)
