@@ -90,6 +90,9 @@ PAGES = [
     ("Quantized serving (int8)", "elephas_tpu.models.quantization",
      ["QTensor", "quantize_weight", "quantize_lm_params",
       "dequantize_lm_params"]),
+    ("Speculative decoding", "elephas_tpu.models.speculative",
+     ["speculative_generate"]),
+    ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",
      ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",
