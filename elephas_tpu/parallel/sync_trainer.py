@@ -309,6 +309,7 @@ class SyncAverageTrainer:
             step_fn = jax.jit(step)
             self._step_fns[shape_key] = step_fn
 
+        from ..utils.prefetch import prefetch_to_device
         from ..utils.tracing import StepTimer
 
         self.timer = timer = StepTimer()
@@ -332,11 +333,20 @@ class SyncAverageTrainer:
                         if shuffle else np.arange(n_pad))
                 xs, ys, sws = x[perm], y[perm], sw[perm]
                 batch_stats = []
-                for i in range(nb):
-                    sl = slice(i * batch_size, (i + 1) * batch_size)
+
+                # prefetch: batch i+1's host->device copy overlaps batch
+                # i's compute (device_put is async) instead of blocking
+                # at the top of every dispatch
+                def slices():
+                    for i in range(nb):
+                        sl = slice(i * batch_size, (i + 1) * batch_size)
+                        yield xs[sl], ys[sl], sws[sl]
+
+                for i, (xb, yb, swb) in enumerate(
+                        prefetch_to_device(slices(), size=2)):
                     trainable, state, opt_state, st = step_fn(
-                        trainable, state, opt_state, xs[sl], ys[sl],
-                        sws[sl], jax.random.fold_in(key_e, i))
+                        trainable, state, opt_state, xb, yb, swb,
+                        jax.random.fold_in(key_e, i))
                     batch_stats.append(st)
                 totals = np.sum(np.asarray(jax.device_get(batch_stats)),
                                 axis=0)

@@ -24,12 +24,26 @@ class TextGenerator:
     :param config: the model's :class:`TransformerConfig`
     :param tokenizer: object with ``encode(str) -> List[int]`` and
         ``decode(ids) -> str`` (default: :class:`ByteTokenizer`)
+    :param draft_params: optional draft-model parameters enabling
+        speculative decoding (draft proposes, target verifies in one
+        block forward — up to ``1 + gamma*acceptance`` tokens per
+        target weight read). Used when the batch's prompts encode to
+        equal lengths and no top-k/top-p/repetition filter is
+        requested; other calls fall back to the plain decode scan.
+    :param draft_config: the draft model's config (same vocabulary)
+    :param gamma: draft tokens proposed per verify round
     """
 
-    def __init__(self, params, config: TransformerConfig, tokenizer=None):
+    def __init__(self, params, config: TransformerConfig, tokenizer=None,
+                 draft_params=None, draft_config=None, gamma: int = 4):
         self.params = params
         self.config = config
         self.tokenizer = tokenizer or ByteTokenizer()
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config go together")
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.gamma = int(gamma)
 
     def __call__(self, prompts: Sequence[str], max_new_tokens: int = 64,
                  temperature: float = 0.0, top_k: Optional[int] = None,
@@ -48,12 +62,32 @@ class TextGenerator:
         for i, e in enumerate(encoded):
             batch[i, :len(e)] = e
 
-        out = np.asarray(generate(
-            self.params, batch, int(max_new_tokens), self.config,
-            temperature=temperature, key=jax.random.PRNGKey(seed),
-            top_k=top_k, top_p=top_p,
-            repetition_penalty=repetition_penalty,
-            prompt_lengths=lens))
+        uniform = int(lens.min()) == lmax
+        plain_sampling = (top_k is None and top_p is None
+                          and repetition_penalty == 1.0)
+        # the speculative cache needs gamma slack past the last token;
+        # near-max_seq_len calls stay on the plain scan instead of
+        # failing where generate() would succeed
+        fits = all(lmax + int(max_new_tokens) + self.gamma <= c.max_seq_len
+                   for c in ((self.config, self.draft_config)
+                             if self.draft_config is not None
+                             else (self.config,)))
+        if (self.draft_params is not None and uniform and plain_sampling
+                and fits):
+            from .models.speculative import speculative_generate
+
+            out = np.asarray(speculative_generate(
+                self.params, self.draft_params, batch,
+                int(max_new_tokens), self.config, self.draft_config,
+                gamma=self.gamma, temperature=temperature,
+                key=jax.random.PRNGKey(seed)))
+        else:
+            out = np.asarray(generate(
+                self.params, batch, int(max_new_tokens), self.config,
+                temperature=temperature, key=jax.random.PRNGKey(seed),
+                top_k=top_k, top_p=top_p,
+                repetition_penalty=repetition_penalty,
+                prompt_lengths=lens))
 
         stop = stop_id if stop_id is not None else getattr(tok, "eos_id",
                                                            None)

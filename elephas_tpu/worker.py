@@ -334,11 +334,12 @@ class AsyncWorker:
                 if self.should_stop():
                     break
                 epoch_losses = []
-                for batch_start, batch_end in batches:
+                batch_iter = prefetch_to_device(
+                    ((x_all[s:e], y_all[s:e]) for s, e in batches), size=2)
+                for xb, yb in batch_iter:
                     trainable, state, opt_state, loss_val, _ = step(
                         trainable, state, opt_state, model._next_key(),
-                        x_all[batch_start:batch_end],
-                        y_all[batch_start:batch_end])
+                        xb, yb)
                     epoch_losses.append(loss_val)  # device scalar, no sync
                     window += 1
                     if window < self.accum_batches:

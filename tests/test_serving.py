@@ -50,3 +50,34 @@ def test_text_generator_options_and_validation():
     assert s1 == s2  # seeded determinism
     with pytest.raises(ValueError):
         gen([""])
+
+
+def test_text_generator_speculative_path():
+    """Uniform-length prompts + a draft model route through speculative
+    decoding; greedy output equals the plain path (the trained model
+    itself drafts, so acceptance is high). Ragged prompts fall back."""
+    params, config, tok = _trained_lm()
+    plain = TextGenerator(params, config, tok)
+    spec = TextGenerator(params, config, tok, draft_params=params,
+                         draft_config=config, gamma=3)
+    prompts = ["abc", "bca"]                    # uniform lengths
+    assert spec(prompts, max_new_tokens=8) == plain(prompts,
+                                                    max_new_tokens=8)
+    ragged = ["abc", "abcab"]                   # falls back to the scan
+    assert spec(ragged, max_new_tokens=6) == plain(ragged,
+                                                   max_new_tokens=6)
+    with pytest.raises(ValueError, match="go together"):
+        TextGenerator(params, config, tok, draft_params=params)
+
+
+def test_text_generator_speculative_near_limit_falls_back():
+    """Prompts near max_seq_len (no gamma slack) route to the plain
+    scan instead of erroring — draft configuration must never make a
+    previously valid call fail."""
+    params, config, tok = _trained_lm()  # max_seq_len = 64
+    spec = TextGenerator(params, config, tok, draft_params=params,
+                         draft_config=config, gamma=4)
+    plain = TextGenerator(params, config, tok)
+    prompts = ["abcabcab"]               # 8 tokens; 8 + 56 == 64 exactly
+    assert (spec(prompts, max_new_tokens=56)
+            == plain(prompts, max_new_tokens=56))

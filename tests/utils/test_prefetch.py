@@ -1,0 +1,48 @@
+"""prefetch_to_device: order-preserving async host->device staging."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.utils.prefetch import prefetch_to_device
+
+
+def test_order_and_values_preserved():
+    items = [np.full((4,), i, np.float32) for i in range(7)]
+    out = list(prefetch_to_device(iter(items), size=2))
+    assert len(out) == 7
+    for i, a in enumerate(out):
+        assert isinstance(a, jax.Array)
+        np.testing.assert_array_equal(np.asarray(a), items[i])
+
+
+def test_pytree_items():
+    items = [(np.ones((2,)) * i, {"y": np.zeros((3,)) + i})
+             for i in range(3)]
+    out = list(prefetch_to_device(iter(items), size=1))
+    for i, (x, d) in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(x), items[i][0])
+        np.testing.assert_array_equal(np.asarray(d["y"]), items[i][1]["y"])
+
+
+def test_size_zero_and_short_iterables():
+    assert list(prefetch_to_device(iter([]), size=2)) == []
+    items = [np.arange(3)]
+    [only] = list(prefetch_to_device(iter(items), size=4))  # size > len
+    np.testing.assert_array_equal(np.asarray(only), items[0])
+    [only0] = list(prefetch_to_device(iter(items), size=0))
+    np.testing.assert_array_equal(np.asarray(only0), items[0])
+    with pytest.raises(ValueError):
+        list(prefetch_to_device(iter(items), size=-1))
+
+
+def test_sharding_applied():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    items = [np.arange(8, dtype=np.float32) + i for i in range(3)]
+    out = list(prefetch_to_device(iter(items), size=2, sharding=sharding))
+    for i, a in enumerate(out):
+        assert a.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(a), items[i])
