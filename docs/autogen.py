@@ -92,6 +92,8 @@ PAGES = [
       "dequantize_lm_params"]),
     ("Speculative decoding", "elephas_tpu.models.speculative",
      ["speculative_generate"]),
+    ("Draft distillation", "elephas_tpu.models.distill",
+     ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",

@@ -1,6 +1,6 @@
-from . import (activations, bert, encdec, initializers, lora, losses,
-               metrics, optimizers, schedules, speculative, transformer,
-               vit)
+from . import (activations, bert, distill, encdec, initializers, lora,
+               losses, metrics, optimizers, schedules, speculative,
+               transformer, vit)
 from .schedules import (CosineDecay, ExponentialDecay,
                         PiecewiseConstantDecay, WarmupCosine)
 from .callbacks import (Callback, EarlyStopping, LambdaCallback,

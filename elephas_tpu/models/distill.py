@@ -1,0 +1,81 @@
+"""Draft-model distillation for speculative decoding.
+
+:func:`make_distill_step` trains a SMALL transformer (the draft) to
+imitate a frozen large one (the target) by minimizing the KL divergence
+between their next-token distributions, optionally mixed with the plain
+next-token cross-entropy. Distillation is what turns
+:mod:`.speculative` from a primitive into a speedup: speculative
+decoding emits ``1 + gamma * acceptance`` tokens per target weight
+read, and acceptance is exactly "how often the draft's argmax/top-mass
+matches the target's" — the quantity KL training maximizes directly
+(unlike ground-truth-only training, which optimizes against the data
+rather than against the model being served).
+
+The step is one jitted function; the target runs forward-only under
+``lax.stop_gradient`` semantics (its params are an argument but receive
+no gradient), so XLA shares nothing with the draft's backward pass and
+the target's activations are free to be released after the soft-label
+softmax.
+
+``tests/models/test_distill.py`` pins the loop's purpose end to end:
+distilling a 1-layer draft against a trained 2-layer target RAISES the
+measured speculative acceptance vs an undistilled draft on the same
+prompts.
+"""
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .transformer import TransformerConfig, forward
+
+__all__ = ["distill_loss", "make_distill_step"]
+
+
+def distill_loss(draft_params: Dict, target_params: Dict,
+                 tokens: jnp.ndarray, draft_config: TransformerConfig,
+                 target_config: TransformerConfig,
+                 temperature: float = 1.0,
+                 hard_weight: float = 0.0) -> jnp.ndarray:
+    """Mean KL(target || draft) over next-token positions at the given
+    softening ``temperature``, scaled by ``temperature**2`` (the
+    standard correction keeping gradient magnitude comparable across
+    temperatures); ``hard_weight`` mixes in ground-truth cross-entropy.
+    """
+    t_logits = jax.lax.stop_gradient(
+        forward(target_params, tokens, target_config))      # (B, T, V)
+    d_logits = forward(draft_params, tokens, draft_config)
+    t_logp = jax.nn.log_softmax(
+        t_logits[:, :-1].astype(jnp.float32) / temperature, axis=-1)
+    d_logp = jax.nn.log_softmax(
+        d_logits[:, :-1].astype(jnp.float32) / temperature, axis=-1)
+    kl = jnp.sum(jnp.exp(t_logp) * (t_logp - d_logp), axis=-1)
+    loss = (temperature ** 2) * jnp.mean(kl)
+    if hard_weight > 0.0:
+        targets = tokens[:, 1:]
+        ce = -jnp.take_along_axis(
+            jax.nn.log_softmax(d_logits[:, :-1].astype(jnp.float32), -1),
+            targets[..., None], axis=-1)[..., 0]
+        loss = loss + hard_weight * jnp.mean(ce)
+    return loss
+
+
+def make_distill_step(draft_config: TransformerConfig,
+                      target_config: TransformerConfig, tx,
+                      temperature: float = 1.0,
+                      hard_weight: float = 0.0):
+    """Build a jitted ``(draft_params, target_params, opt_state, tokens)
+    -> (draft_params, opt_state, loss)`` step. The target is frozen —
+    gradients flow only into the draft."""
+
+    @jax.jit
+    def step(draft_params, target_params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(distill_loss)(
+            draft_params, target_params, tokens, draft_config,
+            target_config, temperature, hard_weight)
+        updates, opt_state = tx.update(grads, opt_state, draft_params)
+        draft_params = optax.apply_updates(draft_params, updates)
+        return draft_params, opt_state, loss
+
+    return step

@@ -41,6 +41,13 @@ class TextGenerator:
         self.tokenizer = tokenizer or ByteTokenizer()
         if (draft_params is None) != (draft_config is None):
             raise ValueError("draft_params and draft_config go together")
+        if draft_config is not None:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {config.vocab_size}")
+            if gamma < 1:
+                raise ValueError("gamma must be >= 1")
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = int(gamma)

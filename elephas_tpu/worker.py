@@ -31,6 +31,7 @@ import numpy as np
 from .models import deserialize_optimizer, model_from_json
 from .parameter import BaseParameterClient
 from .utils.functional_utils import subtract_params
+from .utils.prefetch import prefetch_to_device
 
 
 class SyncWorker:

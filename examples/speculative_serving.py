@@ -60,6 +60,38 @@ print(f"random draft:   exact greedy match; {stats['rounds']} rounds, "
       f"acceptance {stats['draft_acceptance']:.2f} — correctness never "
       f"depends on draft quality")
 
+# ---- the middle of the spectrum: distill a real draft against the
+# target (train the target on a predictable corpus first, so there is
+# structure for the draft to learn)
+import optax
+
+from elephas_tpu.models.distill import make_distill_step
+from elephas_tpu.models.transformer import make_train_step
+
+rows = jnp.asarray(rng.integers(0, 4, (8, 33)) + 97)  # tiny 4-letter LM
+tx = optax.adam(1e-2)
+opt = tx.init(params)
+train = make_train_step(target_cfg, tx)
+for _ in range(40):
+    params, opt, _ = train(params, opt, rows)
+
+dtx = optax.adam(3e-3)
+dopt = dtx.init(draft_params)
+distill = make_distill_step(draft_cfg, target_cfg, dtx, temperature=2.0,
+                            hard_weight=0.1)
+for _ in range(120):
+    draft_params, dopt, dloss = distill(draft_params, params, dopt, rows)
+
+prompt2 = np.asarray(rows[:4, :8])
+ref2 = np.asarray(generate(params, prompt2, 24, target_cfg))
+spec, stats = speculative_generate(params, draft_params, prompt2, 24,
+                                   target_cfg, draft_cfg, gamma=4,
+                                   return_stats=True)
+assert (ref2 == np.asarray(spec)).all()
+print(f"distilled draft: exact greedy match; {stats['rounds']} rounds, "
+      f"acceptance {stats['draft_acceptance']:.2f} — the practical "
+      f"middle ground a distilled draft buys")
+
 # ---- continuous batching: 6 requests through 2 slots
 prompts = [rng.integers(0, 256, int(n)) for n in rng.integers(4, 12, 6)]
 eng = DecodeEngine(params, target_cfg, max_slots=2)

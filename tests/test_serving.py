@@ -81,3 +81,15 @@ def test_text_generator_speculative_near_limit_falls_back():
     prompts = ["abcabcab"]               # 8 tokens; 8 + 56 == 64 exactly
     assert (spec(prompts, max_new_tokens=56)
             == plain(prompts, max_new_tokens=56))
+
+
+def test_text_generator_draft_config_validated_at_construction():
+    params, config, tok = _trained_lm()
+    import dataclasses
+    bad_vocab = dataclasses.replace(config, vocab_size=32)
+    with pytest.raises(ValueError, match="vocab"):
+        TextGenerator(params, config, tok, draft_params=params,
+                      draft_config=bad_vocab)
+    with pytest.raises(ValueError, match="gamma"):
+        TextGenerator(params, config, tok, draft_params=params,
+                      draft_config=config, gamma=0)
