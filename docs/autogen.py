@@ -107,6 +107,10 @@ PAGES = [
      ["StepTimer", "profiler_trace", "annotate"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
      ["encode_tensors", "decode_tensors", "encode", "decode"]),
+    ("Delta compression", "elephas_tpu.utils.delta_compression",
+     ["quantize_delta", "dequantize_delta", "ErrorFeedback"]),
+    ("Input prefetch", "elephas_tpu.utils.prefetch",
+     ["prefetch_to_device"]),
 ]
 
 

@@ -15,8 +15,10 @@ from typing import List
 
 import numpy as np
 
+from ..utils.delta_compression import quantize_delta
 from ..utils.sockets import determine_master, receive, send
-from ..utils.tensor_codec import KIND_DELTA, decode_weights, encode
+from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
+                                  encode)
 
 #: default network timeout (seconds) — a dead parameter server must surface
 #: as an error in the training loop, not a hang
@@ -71,9 +73,35 @@ class BaseParameterClient(abc.ABC):
                         f"{err}") from err
                 time.sleep(pause)
 
-    @abc.abstractmethod
+    @staticmethod
+    def _check_compression(compression):
+        if compression not in (None, "int8"):
+            raise ValueError("compression must be None or 'int8', "
+                             f"got {compression!r}")
+        return compression
+
+    def _delta_frame(self, delta: List[np.ndarray]):
+        """(arrays, kind) for a delta push, honoring ``compression``
+        (``'int8'`` = per-tensor absmax quantization, ~4x fewer wire
+        bytes; see :mod:`~elephas_tpu.utils.delta_compression`)."""
+        if getattr(self, "compression", None) == "int8":
+            return quantize_delta(delta), KIND_DELTA_Q8
+        return delta, KIND_DELTA
+
     def update_parameters(self, delta: List[np.ndarray]):
         """Send a weight-delta update to the server."""
+        arrays, kind = self._delta_frame(delta)
+        return self.push_frame(arrays, kind)
+
+    def push_frame(self, arrays: List[np.ndarray], kind: int):
+        """Send an already-built update frame (``KIND_DELTA`` or
+        ``KIND_DELTA_Q8`` arrays). Workers carrying error feedback call
+        this with the frame :class:`ErrorFeedback` already built, so a
+        compressed push quantizes exactly once. Not abstract: custom
+        clients that only override ``update_parameters`` (e.g. in-memory
+        test doubles without compression) never need it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement push_frame")
 
     @abc.abstractmethod
     def get_parameters(self) -> List[np.ndarray]:
@@ -91,13 +119,14 @@ class HttpClient(BaseParameterClient):
 
     def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
                  max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
-                 deadline: float = None):
+                 deadline: float = None, compression: str = None):
         self.master_url = determine_master(port=port)
         self.headers = {"Content-Type": "application/elephas-tpu"}
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff = backoff
         self.deadline = deadline
+        self.compression = self._check_compression(compression)
 
     def get_parameters(self) -> List[np.ndarray]:
         def op():
@@ -108,8 +137,8 @@ class HttpClient(BaseParameterClient):
                 return decode_weights(response.read())
         return self._with_retry(op, "get_parameters")
 
-    def update_parameters(self, delta: List[np.ndarray]):
-        payload = bytes(encode(delta, KIND_DELTA))
+    def push_frame(self, arrays: List[np.ndarray], kind: int):
+        payload = bytes(encode(arrays, kind))
         # one id per logical update, stable across retries: the server
         # drops duplicates so a lost ack can't double-apply the delta
         headers = dict(self.headers, **{"X-Update-Id": uuid.uuid4().hex})
@@ -139,12 +168,13 @@ class SocketClient(BaseParameterClient):
 
     def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
                  max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
-                 deadline: float = None):
+                 deadline: float = None, compression: str = None):
         self.port = port
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff = backoff
         self.deadline = deadline
+        self.compression = self._check_compression(compression)
 
     def _connect(self, timeout=None) -> socket.socket:
         host = determine_master(port=self.port).split(":")[0]
@@ -160,13 +190,13 @@ class SocketClient(BaseParameterClient):
                 return receive(sock)
         return self._with_retry(op, "get_parameters")
 
-    def update_parameters(self, delta: List[np.ndarray]):
+    def push_frame(self, arrays: List[np.ndarray], kind: int):
         update_id = uuid.uuid4().hex.encode("ascii")  # stable across retries
 
         def op():
             with self._connect() as sock:
                 sock.sendall(b"U" + update_id)
-                send(sock, delta, kind=KIND_DELTA)
+                send(sock, arrays, kind=kind)
                 ack = sock.recv(1)  # block until the delta is applied
                 if ack != b"k":
                     raise ConnectionError("parameter server did not "

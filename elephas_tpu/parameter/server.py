@@ -28,8 +28,18 @@ import numpy as np
 
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
-from ..utils.sockets import determine_master, receive, send
-from ..utils.tensor_codec import decode_weights, encode_weights
+from ..utils.sockets import determine_master, receive_frame, send
+from ..utils.delta_compression import dequantize_delta
+from ..utils.tensor_codec import (KIND_DELTA_Q8, decode, decode_weights,
+                                  encode_weights)
+
+
+def _decode_delta(payload: bytes):
+    """Decode a delta push, dequantizing int8-compressed frames."""
+    arrays, kind = decode(payload)
+    if kind == KIND_DELTA_Q8:
+        return dequantize_delta(arrays)
+    return arrays
 
 
 class BaseParameterServer(abc.ABC):
@@ -179,7 +189,7 @@ class HttpServer(BaseParameterServer):
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
-                    delta = decode_weights(self.rfile.read(length))
+                    delta = _decode_delta(self.rfile.read(length))
                 except Exception:  # malformed payload -> clean 400, not a 500
                     self.send_response(400)
                     self.end_headers()
@@ -303,7 +313,9 @@ class SocketServer(BaseParameterServer):
                                 return
                             raw += chunk
                         update_id = raw.decode("ascii", "replace")
-                    delta = receive(conn)
+                    arrays, kind = receive_frame(conn)
+                    delta = (dequantize_delta(arrays)
+                             if kind == KIND_DELTA_Q8 else arrays)
                     self.apply_delta(delta, update_id=update_id)
                     try:
                         conn.sendall(b"k")  # ack: delta applied

@@ -141,6 +141,12 @@ class TPUModel:
         # + on-device delta accumulation window (1 = reference semantics)
         self.async_overlap = bool(kwargs.pop("async_overlap", False))
         self.async_accum = max(1, int(kwargs.pop("async_accum", 1)))
+        # int8 delta compression on the PS wire (~4x fewer push bytes;
+        # workers carry EF residuals so training stays unbiased)
+        self.delta_compression = kwargs.pop("delta_compression", None)
+        if self.delta_compression not in (None, "int8"):
+            raise ValueError("delta_compression must be None or 'int8', "
+                             f"got {self.delta_compression!r}")
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)
@@ -151,7 +157,8 @@ class TPUModel:
             self.parameter_server = transport.create_server(
                 self.serialized_model, self.port, self.mode,
                 custom_objects=self.custom_objects)
-            self.client = transport.create_client(self.port)
+            self.client = transport.create_client(
+                self.port, compression=self.delta_compression)
 
         self._replica = None  # lazily-built worker replica for predict/eval
         # trainers cached across fit() calls so their jitted epoch
@@ -470,7 +477,8 @@ class TPUModel:
             # (the HTTP client binds its URL at construction)
             coordinator_bind_env(self.port)
             transport = get_transport(self.parameter_server_mode)
-            self.client = transport.create_client(self.port)
+            self.client = transport.create_client(
+                self.port, compression=self.delta_compression)
         serving = (not multi) or is_coordinator()
 
         # Multi-host discipline: a barrier skipped by ONE process hangs

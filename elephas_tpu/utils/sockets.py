@@ -77,8 +77,8 @@ def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEI
     sock.sendall(payload)
 
 
-def receive(sock: socket.socket) -> List[np.ndarray]:
-    """Receive one length-prefixed ETPU frame; returns the array list.
+def receive_frame(sock: socket.socket):
+    """Receive one length-prefixed ETPU frame; returns ``(arrays, kind)``.
 
     The transport is chosen up front (native or Python) and errors
     propagate: once any bytes of a frame are consumed, falling back to the
@@ -87,10 +87,13 @@ def receive(sock: socket.socket) -> List[np.ndarray]:
     if _use_native(sock):
         from . import native
 
-        arrays, _ = decode(native.recv_frame_native(sock.fileno()))
-        return arrays
+        return decode(native.recv_frame_native(sock.fileno()))
     length = int.from_bytes(_receive_all(sock, LENGTH_BYTES), "little")
     if length > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame length {length} exceeds limit")
-    arrays, _ = decode(_receive_all(sock, length))
-    return arrays
+    return decode(_receive_all(sock, length))
+
+
+def receive(sock: socket.socket) -> List[np.ndarray]:
+    """Receive one ETPU frame; returns just the array list."""
+    return receive_frame(sock)[0]

@@ -31,6 +31,9 @@ MAX_FRAME_BYTES = 1 << 34
 KIND_WEIGHTS = 0
 KIND_DELTA = 1
 KIND_SCALARS = 2
+#: int8-quantized delta: interleaved (int8 data, float32 scale) pairs —
+#: see :mod:`elephas_tpu.utils.delta_compression`
+KIND_DELTA_Q8 = 3
 
 _DTYPE_CODES = {
     np.dtype("float32"): 0,

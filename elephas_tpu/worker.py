@@ -32,6 +32,7 @@ from .models import deserialize_optimizer, model_from_json
 from .parameter import BaseParameterClient
 from .utils.functional_utils import subtract_params
 from .utils.prefetch import prefetch_to_device
+from .utils.tensor_codec import KIND_DELTA_Q8 as _KIND_DELTA_Q8
 
 
 class SyncWorker:
@@ -110,6 +111,9 @@ class _AsyncCommunicator:
                 if kind == "push":
                     self.client.update_parameters(payload)
                     self._pushes_done += 1
+                elif kind == "push_frame":
+                    self.client.push_frame(*payload)
+                    self._pushes_done += 1
                 else:
                     weights = self.client.get_parameters()
                     with self._lock:
@@ -142,6 +146,12 @@ class _AsyncCommunicator:
 
     def push(self, delta: List[np.ndarray]):
         self._put(("push", delta))
+
+    def push_frame(self, arrays: List[np.ndarray], kind: int):
+        """Queue an already-built update frame (compressed pushes: the
+        worker's ErrorFeedback quantized once; no re-quantization on
+        this thread)."""
+        self._put(("push_frame", (arrays, kind)))
 
     def request_pull(self):
         self._put(("pull", None))
@@ -212,6 +222,24 @@ class AsyncWorker:
         self.epoch_event = epoch_event
         self.should_stop = should_stop or (lambda: False)
         self.model = None
+        # EF-SGD residual carrier when the client compresses pushes:
+        # per-worker state, so each worker corrects its own rounding
+        if getattr(self.client, "compression", None):
+            from .utils.delta_compression import ErrorFeedback
+
+            self._ef = ErrorFeedback()
+        else:
+            self._ef = None
+
+    def _push(self, delta):
+        """Push a delta, routing through error feedback when the wire
+        quantizes (keeps the server-side sum unbiased). The EF preview
+        frame IS the wire frame — one quantization pass per push."""
+        if self._ef is not None:
+            self._ef.apply(delta)
+            self.client.push_frame(self._ef.last_frame, _KIND_DELTA_Q8)
+        else:
+            self.client.update_parameters(delta)
 
     def _emit(self, epoch: int, loss: Optional[float]):
         if self.epoch_event is not None:
@@ -248,8 +276,7 @@ class AsyncWorker:
                     per_epoch["epochs"] = 1
                     history = self.model.fit(x_train, y_train, **per_epoch)
                 weights_after = self.model.get_weights()
-                self.client.update_parameters(
-                    subtract_params(weights_before, weights_after))
+                self._push(subtract_params(weights_before, weights_after))
                 loss = (history.history["loss"][-1]
                         if history and history.history.get("loss") else None)
                 self._emit(epoch, loss)
@@ -280,7 +307,7 @@ class AsyncWorker:
                         losses.append(vals[0] if isinstance(vals, list)
                                       else float(vals))
                         weights_after = self.model.get_weights()
-                        self.client.update_parameters(
+                        self._push(
                             subtract_params(weights_before, weights_after))
                 self._emit(epoch,
                            float(np.mean(losses)) if losses else None)
@@ -350,9 +377,20 @@ class AsyncWorker:
                     delta = jax.tree_util.tree_map(lambda a, b: a - b,
                                                    base, current)
                     host_delta = as_weights(delta)
-                    comm.push(host_delta)
+                    if self._ef is not None:
+                        # pending must hold what the server APPLIES (the
+                        # dequantized push), or the snapshot correction
+                        # drifts by the quantization error; the EF frame
+                        # ships as-is (one quantization per push)
+                        self._ef.apply(host_delta)
+                        comm.push_frame(self._ef.last_frame,
+                                        _KIND_DELTA_Q8)
+                        applied = self._ef.last_on_wire
+                    else:
+                        comm.push(host_delta)
+                        applied = host_delta
                     pushes_issued += 1
-                    pending[pushes_issued] = host_delta
+                    pending[pushes_issued] = applied
                     comm.request_pull()  # FIFO: pull sees our push applied
                     fresh = comm.take_latest(block=False)
                     if fresh is not None:
@@ -382,7 +420,12 @@ class AsyncWorker:
                 current = model._merge_params(trainable, state)
                 delta = jax.tree_util.tree_map(lambda a, b: a - b,
                                                base, current)
-                comm.push(as_weights(delta))
+                host_delta = as_weights(delta)
+                if self._ef is not None:
+                    self._ef.apply(host_delta)
+                    comm.push_frame(self._ef.last_frame, _KIND_DELTA_Q8)
+                else:
+                    comm.push(host_delta)
         finally:
             comm.close()
         model.params = model._merge_params(trainable, state)

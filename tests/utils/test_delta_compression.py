@@ -1,0 +1,129 @@
+"""int8 delta compression: bounded per-push error, unbiased under error
+feedback, and transparent on both parameter-server wires."""
+import numpy as np
+import pytest
+
+from elephas_tpu.utils.delta_compression import (ErrorFeedback,
+                                                 dequantize_delta,
+                                                 quantize_delta)
+
+
+def test_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    delta = [rng.normal(size=(32, 16)).astype(np.float32) * 0.01,
+             rng.normal(size=(16,)).astype(np.float32),
+             np.zeros((4, 4), np.float32)]
+    wire = quantize_delta(delta)
+    assert len(wire) == 6
+    assert wire[0].dtype == np.int8 and wire[1].dtype == np.float32
+    back = dequantize_delta(wire)
+    for d, b in zip(delta, back):
+        amax = np.abs(d).max()
+        assert np.abs(d - b).max() <= amax / 127.0 + 1e-9
+    # wire bytes ~4x smaller than float32
+    raw = sum(d.nbytes for d in delta)
+    compressed = sum(w.nbytes for w in wire)
+    assert compressed < raw / 3.5
+
+
+def test_dequantize_rejects_odd_frames():
+    with pytest.raises(ValueError, match="pairs"):
+        dequantize_delta([np.zeros((2,), np.int8)])
+
+
+def test_error_feedback_is_unbiased():
+    """Sum of what the server applies tracks the sum of raw deltas to
+    within one residual — rounding never accumulates."""
+    rng = np.random.default_rng(1)
+    ef = ErrorFeedback()
+    raw_sum = np.zeros((8, 8), np.float32)
+    applied_sum = np.zeros((8, 8), np.float32)
+    for _ in range(50):
+        d = rng.normal(size=(8, 8)).astype(np.float32) * 0.003
+        raw_sum += d
+        ef.apply([d])
+        applied_sum += ef.last_on_wire[0]
+    # bound: the outstanding residual of ONE push
+    bound = np.abs(raw_sum - applied_sum).max()
+    per_push = 0.003 * 3 / 127.0  # ~amax/127 of one push
+    assert bound <= per_push * 2, (bound, per_push)
+
+
+def test_wire_transparency_both_transports():
+    """A compressing client against each real server: the server's
+    weights move by the dequantized delta; an uncompressed client
+    coexists on the same server."""
+    import socket as socket_mod
+
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.parameter.client import HttpClient, SocketClient
+    from elephas_tpu.parameter.factory import get_transport
+    from elephas_tpu.utils.serialization import model_to_dict
+
+    model = Sequential([Dense(4, input_dim=3), Dense(2)])
+    model.build()
+    model.compile(SGD(learning_rate=0.1), "mse", seed=0)
+    rng = np.random.default_rng(2)
+
+    for name, port in (("socket", 15731), ("http", 15732)):
+        transport = get_transport(name)
+        server = transport.create_server(model_to_dict(model), port,
+                                         "asynchronous")
+        server.start()
+        try:
+            cli = transport.create_client(port, compression="int8")
+            assert cli.compression == "int8"
+            w0 = cli.get_parameters()
+            delta = [rng.normal(size=w.shape).astype(np.float32) * 0.01
+                     for w in w0]
+            cli.update_parameters(delta)
+            w1 = cli.get_parameters()
+            expect = dequantize_delta(quantize_delta(delta))
+            for a, b, d in zip(w0, w1, expect):
+                np.testing.assert_allclose(a - b, d, atol=1e-6)
+            # plain client against the same server still works
+            plain = transport.create_client(port)
+            plain.update_parameters(delta)
+            w2 = plain.get_parameters()
+            for b, c, d in zip(w1, w2, delta):
+                np.testing.assert_allclose(b - c, d, atol=1e-6)
+        finally:
+            server.stop()
+
+
+def test_async_fit_with_compression_converges():
+    """Product path: TPUModel(delta_compression='int8') trains through
+    the socket PS and holds the evaluate parity oracle."""
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(512, 16)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    m = Sequential([Dense(16, input_dim=16, activation="relu"),
+                    Dense(2, activation="softmax")])
+    m.compile(SGD(learning_rate=0.05), "categorical_crossentropy",
+              ["acc"], seed=0)
+    tm = TPUModel(m, mode="asynchronous", frequency="batch",
+                  parameter_server_mode="socket", num_workers=2,
+                  port=15733, delta_compression="int8")
+    tm.fit(to_dataset(x, y), epochs=4, batch_size=32,
+           validation_split=0.0, verbose=0)
+    ev = tm.evaluate(x, y)
+    ref = tm.master_network.evaluate(x, y)
+    assert abs(ev[0] - ref[0]) < 0.01
+    assert ev[-1] > 0.8, ev
+
+    with pytest.raises(ValueError, match="delta_compression"):
+        TPUModel(m, mode="asynchronous", delta_compression="zip",
+                 port=15734)
+
+
+def test_client_rejects_unknown_compression():
+    from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+    with pytest.raises(ValueError, match="compression"):
+        SocketClient(15740, compression="INT8")
+    with pytest.raises(ValueError, match="compression"):
+        HttpClient(15741, compression="fp16")
