@@ -35,7 +35,91 @@ import jax.numpy as jnp
 from .transformer import (TransformerConfig, decode_block, decode_step,
                           prefill_cache)
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "speculative_round"]
+
+
+def _pick(logits, key, temperature, greedy: bool):
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    key, sub = jax.random.split(key)
+    return jax.random.categorical(sub, logits / temperature,
+                                  axis=-1).astype(jnp.int32), key
+
+
+def speculative_round(params, draft_params, t_cache, d_cache, last, p,
+                      gamma: int, config: TransformerConfig,
+                      draft_config: TransformerConfig, temperature, key,
+                      greedy: bool):
+    """One draft-propose / target-verify round at per-row positions.
+
+    ``last`` ``(batch,)`` is each row's last emitted token, sitting at
+    position ``p`` ``(batch,)`` of its sequence (neither cache has
+    processed it yet; both are valid below ``p``). Returns
+    ``(emit, a, nxt, t_cache, d_cache, key)``: row ``b`` emits
+    ``emit[b, :a[b] + 1]`` — its accepted draft prefix with the
+    target's own token at slot ``a[b]`` — and continues from
+    ``nxt == emit[b, a[b]]`` at position ``p + a + 1``. Rejected tail
+    slots of ``emit`` are meaningless.
+
+    Shared by :func:`speculative_generate`'s fused while_loop and the
+    continuous-batching engine's per-step speculative mode (where the
+    host admits/retires requests between rounds).
+    """
+    c, dc = config, draft_config
+    b = last.shape[0]
+    # ---- draft proposes gamma tokens (its own rolling cache)
+    tok, d_toks, d_logits = last, [], []
+    for j in range(gamma):
+        lg, d_cache = decode_step(draft_params, d_cache, tok, p + j, dc)
+        tok, key = _pick(lg, key, temperature, greedy)
+        d_toks.append(tok)
+        d_logits.append(lg)
+    # cache-advance: process the last proposal too, so a fully accepted
+    # round leaves no k/v hole at the next round's start (rejected
+    # rounds leave stale tail entries, which the causal mask hides
+    # until the next rounds overwrite them)
+    _, d_cache = decode_step(draft_params, d_cache, tok, p + gamma, dc)
+    d = jnp.stack(d_toks, axis=1)                    # (B, gamma)
+    # ---- target verifies the whole block in one forward
+    block = jnp.concatenate([last[:, None], d], axis=1)
+    t_logits, t_cache = decode_block(params, t_cache, block, p, c)
+    if greedy:
+        tgt = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        match = (tgt[:, :gamma] == d).astype(jnp.int32)
+        accepted = jnp.cumprod(match, axis=1)        # agreeing prefix
+        a = accepted.sum(axis=1)                     # (B,) in [0, g]
+        nxt = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
+    else:
+        dl = jnp.stack(d_logits, axis=1)             # (B, gamma, V)
+        pt = jax.nn.softmax(t_logits / temperature, axis=-1)
+        pd = jax.nn.softmax(dl / temperature, axis=-1)
+        pt_d = jnp.take_along_axis(pt[:, :gamma], d[..., None],
+                                   axis=-1)[..., 0]
+        pd_d = jnp.take_along_axis(pd, d[..., None], axis=-1)[..., 0]
+        key, sub = jax.random.split(key)
+        u = jax.random.uniform(sub, (b, gamma))
+        # accept iff u < pt/pd, written multiplication-safe
+        accepted = jnp.cumprod((u * pd_d < pt_d).astype(jnp.int32),
+                               axis=1)
+        a = accepted.sum(axis=1)
+        # resample slot: norm(max(pt - pd, 0)); past the last draft
+        # slot (a == gamma) pd is zero and this is just pt's bonus
+        pd_pad = jnp.concatenate(
+            [pd, jnp.zeros_like(pt[:, :1])], axis=1)
+        pt_a = jnp.take_along_axis(pt, a[:, None, None],
+                                   axis=1)[:, 0]     # (B, V)
+        pd_a = jnp.take_along_axis(pd_pad, a[:, None, None],
+                                   axis=1)[:, 0]
+        res = jnp.maximum(pt_a - pd_a, 0.0)
+        res = res / jnp.maximum(res.sum(-1, keepdims=True), 1e-20)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(
+            sub, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
+    # ---- emit = accepted prefix with the target's token at slot a
+    slots = jnp.arange(gamma + 1)[None, :]
+    d_pad = jnp.concatenate([d, jnp.zeros_like(nxt[:, None])], axis=1)
+    emit = jnp.where(slots == a[:, None], nxt[:, None], d_pad)
+    return emit, a, nxt, t_cache, d_cache, key
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens", "gamma",
@@ -52,14 +136,7 @@ def _spec_loop(params, draft_params, prompt, temperature, key,
     t_logits0, t_cache = prefill_cache(params, prompt, c, cache_len)
     _, d_cache = prefill_cache(draft_params, prompt, dc, cache_len)
 
-    def pick(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
-        key, sub = jax.random.split(key)
-        return jax.random.categorical(sub, logits / temperature,
-                                      axis=-1).astype(jnp.int32), key
-
-    n0, key = pick(t_logits0, key)
+    n0, key = _pick(t_logits0, key, temperature, greedy)
     out = jnp.zeros((b, max_new_tokens + gamma + 1), jnp.int32)
     out = out.at[:, 0].set(n0)
     count = jnp.ones((b,), jnp.int32)
@@ -73,58 +150,10 @@ def _spec_loop(params, draft_params, prompt, temperature, key,
         # proposals are meaningless and stay out of the acceptance stat
         active = count < max_new_tokens                  # (B,)
         p = prompt_len - 1 + count                       # (B,) positions
-        # ---- draft proposes gamma tokens (its own rolling cache)
-        tok, d_toks, d_logits = last, [], []
-        for j in range(gamma):
-            lg, d_cache = decode_step(draft_params, d_cache, tok, p + j, dc)
-            tok, key = pick(lg, key)
-            d_toks.append(tok)
-            d_logits.append(lg)
-        # cache-advance: process the last proposal too, so a fully
-        # accepted round leaves no k/v hole at the next round's start
-        # (rejected rounds leave stale tail entries, which the causal
-        # mask hides until the next rounds overwrite them)
-        _, d_cache = decode_step(draft_params, d_cache, tok, p + gamma, dc)
-        d = jnp.stack(d_toks, axis=1)                    # (B, gamma)
-        # ---- target verifies the whole block in one forward
-        block = jnp.concatenate([last[:, None], d], axis=1)
-        t_logits, t_cache = decode_block(params, t_cache, block, p, c)
-        if greedy:
-            tgt = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-            match = (tgt[:, :gamma] == d).astype(jnp.int32)
-            accepted = jnp.cumprod(match, axis=1)        # agreeing prefix
-            a = accepted.sum(axis=1)                     # (B,) in [0, g]
-            nxt = jnp.take_along_axis(tgt, a[:, None], axis=1)[:, 0]
-        else:
-            dl = jnp.stack(d_logits, axis=1)             # (B, gamma, V)
-            pt = jax.nn.softmax(t_logits / temperature, axis=-1)
-            pd = jax.nn.softmax(dl / temperature, axis=-1)
-            pt_d = jnp.take_along_axis(pt[:, :gamma], d[..., None],
-                                       axis=-1)[..., 0]
-            pd_d = jnp.take_along_axis(pd, d[..., None], axis=-1)[..., 0]
-            key, sub = jax.random.split(key)
-            u = jax.random.uniform(sub, (b, gamma))
-            # accept iff u < pt/pd, written multiplication-safe
-            accepted = jnp.cumprod((u * pd_d < pt_d).astype(jnp.int32),
-                                   axis=1)
-            a = accepted.sum(axis=1)
-            # resample slot: norm(max(pt - pd, 0)); past the last draft
-            # slot (a == gamma) pd is zero and this is just pt's bonus
-            pd_pad = jnp.concatenate(
-                [pd, jnp.zeros_like(pt[:, :1])], axis=1)
-            pt_a = jnp.take_along_axis(pt, a[:, None, None],
-                                       axis=1)[:, 0]     # (B, V)
-            pd_a = jnp.take_along_axis(pd_pad, a[:, None, None],
-                                       axis=1)[:, 0]
-            res = jnp.maximum(pt_a - pd_a, 0.0)
-            res = res / jnp.maximum(res.sum(-1, keepdims=True), 1e-20)
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(
-                sub, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
-        # ---- emit the accepted prefix + the target's token at slot a
+        emit, a, nxt, t_cache, d_cache, key = speculative_round(
+            params, draft_params, t_cache, d_cache, last, p, gamma, c, dc,
+            temperature, key, greedy)
         slots = jnp.arange(gamma + 1)[None, :]
-        d_pad = jnp.concatenate([d, jnp.zeros_like(nxt[:, None])], axis=1)
-        emit = jnp.where(slots == a[:, None], nxt[:, None], d_pad)
         idx = count[:, None] + slots
         idx = jnp.where(slots <= a[:, None], idx, out.shape[1])  # drop
         out = out.at[jnp.arange(b)[:, None], idx].set(emit, mode="drop")

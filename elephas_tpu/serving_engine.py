@@ -24,6 +24,14 @@ retires requests between steps, which is exactly the host round trip.
 For offline batch generation, :func:`generate`'s single fused scan is
 the faster shape.
 
+With a draft model (``draft_params``/``draft_config``), stepping
+switches to SPECULATIVE rounds: each ``step()`` runs one
+draft-propose / target-verify round per slot, so a slot advances by
+``1 + accepted`` tokens per host round trip — continuous batching and
+speculative decoding compose because both ride the same per-row cache
+positions (rows accept different counts and simply advance
+independently).
+
 The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
@@ -55,12 +63,24 @@ class DecodeEngine:
         otherwise categorical sampling
     :param eos_id: optional stop token — a request finishes early when
         it emits this id (the id itself is not part of the output)
+    :param draft_params: optional draft-model parameters switching every
+        slot to SPECULATIVE stepping: each ``step()`` runs one
+        draft-propose / target-verify round
+        (:func:`~elephas_tpu.models.speculative.speculative_round`), so
+        a slot advances by ``1 + accepted`` tokens per step instead of
+        one — continuous batching composed with speculative decoding.
+        Per-request greedy output is unchanged (still ≡ solo
+        ``generate``); only the number of host steps shrinks.
+    :param draft_config: the draft model's config (same vocabulary)
+    :param gamma: draft tokens proposed per round (speculative mode)
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
                  max_slots: int = 8, max_len: Optional[int] = None,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0, draft_params: Optional[Dict] = None,
+                 draft_config: Optional[TransformerConfig] = None,
+                 gamma: int = 4):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -70,8 +90,27 @@ class DecodeEngine:
                              f"config.max_seq_len {config.max_seq_len}")
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config go together")
+        if draft_config is not None:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {config.vocab_size}")
+            if gamma < 1:
+                raise ValueError("gamma must be >= 1")
+            if self.max_len > draft_config.max_seq_len:
+                raise ValueError(
+                    f"max_len {self.max_len} exceeds draft max_seq_len "
+                    f"{draft_config.max_seq_len}")
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.gamma = int(gamma)
         self._key = jax.random.PRNGKey(seed)
         self.cache = init_kv_cache(config, self.max_slots, self.max_len)
+        self.draft_cache = (init_kv_cache(draft_config, self.max_slots,
+                                          self.max_len)
+                            if draft_config is not None else None)
         # host-side slot state: position of the last PROCESSED token,
         # the pending (emitted, not yet processed) token, budgets
         self._pos = np.zeros(self.max_slots, np.int32)
@@ -118,6 +157,30 @@ class DecodeEngine:
         self._install_fn = _install
         self._prefill_fn = _prefill
 
+        if draft_config is not None:
+            from .models.speculative import speculative_round
+
+            dcfg, g = draft_config, self.gamma
+
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def _spec_step(params, draft_params, cache, d_cache, last,
+                           pos, key):
+                emit, a, nxt, cache, d_cache, key = speculative_round(
+                    params, draft_params, cache, d_cache, last, pos, g,
+                    cfg, dcfg, jnp.float32(temp if temp > 0 else 1.0),
+                    key, not temp > 0)
+                return emit, a, nxt, cache, d_cache, key
+
+            @jax.jit
+            def _prefill_draft(draft_params, prompt):
+                return prefill_cache(draft_params, prompt, dcfg, max_len)
+
+            self._spec_step_fn = _spec_step
+            # _install handles any cache pytree (jit specializes per
+            # structure), so the draft cache reuses it
+            self._install_draft_fn = _install
+            self._prefill_draft_fn = _prefill_draft
+
     # ------------------------------------------------------------ queue
     def submit(self, prompt: Sequence[int], max_new_tokens: int) -> int:
         """Queue a request; returns its id. Admission happens lazily on
@@ -127,10 +190,15 @@ class DecodeEngine:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size + max_new_tokens > self.max_len:
+        # speculative rounds write verify blocks up to gamma positions
+        # past the last emitted token
+        slack = self.gamma if self.draft_config is not None else 0
+        if prompt.size + max_new_tokens + slack > self.max_len:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_len {self.max_len}")
+                f"({max_new_tokens})"
+                + (f" + gamma ({slack})" if slack else "")
+                + f" exceeds max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append((rid, prompt, int(max_new_tokens)))
@@ -151,6 +219,11 @@ class DecodeEngine:
             logits, row_cache = self._prefill_fn(
                 self.params, jnp.asarray(prompt[None]))
             self.cache = self._install_fn(self.cache, row_cache, slot)
+            if self.draft_config is not None:
+                _, d_row = self._prefill_draft_fn(self.draft_params,
+                                                  jnp.asarray(prompt[None]))
+                self.draft_cache = self._install_draft_fn(
+                    self.draft_cache, d_row, slot)
             if self.temperature > 0:
                 self._key, sub = jax.random.split(self._key)
                 t0 = int(jax.random.categorical(
@@ -198,12 +271,11 @@ class DecodeEngine:
                 + len(self._fresh))
 
     def step(self) -> Dict[int, List[int]]:
-        """Advance every active slot by one token; returns
-        ``{request_id: [tokens]}`` emitted since the last call. A list
-        because a request admitted mid-step emits its admission-time
-        first token (produced by the prefill forward) AND its first
-        step token in the same call. Finished requests retire and
-        queued ones join automatically."""
+        """Advance every active slot — by one token (plain mode) or by
+        ``1 + accepted`` tokens (speculative mode, up to ``gamma+1``);
+        returns ``{request_id: [tokens]}`` emitted since the last call
+        (admission-time first tokens ride along too). Finished requests
+        retire and queued ones join automatically."""
         self._admit()
         emitted = {rid: [tok] for rid, tok in self._fresh.items()}
         self._fresh = {}
@@ -214,6 +286,27 @@ class DecodeEngine:
         # shape); their writes are overwritten by the next admission's
         # prefill and masked until then
         pos = np.where(active, self._pos + 1, 0).astype(np.int32)
+        if self.draft_config is not None:
+            # speculative round: every active slot advances by its own
+            # 1 + accepted tokens in one dispatch
+            emit, acc, nxt, self.cache, self.draft_cache, self._key = (
+                self._spec_step_fn(self.params, self.draft_params,
+                                   self.cache, self.draft_cache,
+                                   jnp.asarray(self._last),
+                                   jnp.asarray(pos), self._key))
+            emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
+                              np.asarray(nxt))
+            for slot in np.nonzero(active)[0]:
+                rid = self._rid[slot]
+                self._pos[slot] += 1 + acc[slot]
+                self._last[slot] = nxt[slot]
+                for tok in emit[slot, :acc[slot] + 1]:
+                    if self._rid[slot] is None:
+                        break   # retired mid-chunk (eos or budget)
+                    if self._record(slot, int(tok)):
+                        emitted.setdefault(rid, []).append(int(tok))
+            self._admit()
+            return emitted
         toks, self.cache, self._key = self._step_fn(
             self.params, self.cache, jnp.asarray(self._last),
             jnp.asarray(pos), self._key)

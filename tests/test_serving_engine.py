@@ -166,3 +166,76 @@ def test_sampling_mode_runs(model):
     outs = eng.run(prompts, max_new_tokens=6)
     for o in outs:
         assert len(o) == 6 and all(0 <= t < 64 for t in o)
+
+
+def test_speculative_mode_matches_generate(model):
+    """Speculative stepping (draft per slot + verify round) preserves
+    per-request greedy parity with solo generate, across staggered
+    admission and an unrelated random draft."""
+    params, config = model
+    dcfg = _config(num_layers=1, num_heads=2, d_model=16, d_ff=32)
+    draft = init_params(dcfg, jax.random.PRNGKey(9))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 64, int(n))
+               for n in rng.integers(3, 10, size=6)]
+    eng = DecodeEngine(params, config, max_slots=2, draft_params=draft,
+                       draft_config=dcfg, gamma=3)
+    outs = eng.run(prompts, max_new_tokens=9)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 9)
+
+
+def test_speculative_mode_self_draft_fewer_steps(model):
+    """Draft == target: every proposal accepted, so draining takes
+    ~1/(gamma+1) the host steps of plain mode."""
+    params, config = model
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 64, 6)
+    eng = DecodeEngine(params, config, max_slots=1, draft_params=params,
+                       draft_config=config, gamma=3)
+    rid = eng.submit(prompt, 12)
+    steps = 0
+    while eng.pending:
+        eng.step()
+        steps += 1
+    assert eng.result(rid) == _ref(params, config, prompt, 12)
+    assert steps <= 4   # ceil((12-1)/4) rounds + the drain step
+
+
+def test_speculative_mode_eos_mid_chunk(model):
+    """An eos inside an accepted chunk truncates the output exactly as
+    the plain engine would, and frees the slot for the next request."""
+    params, config = model
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 64, 5)
+    full = _ref(params, config, prompt, 12)
+    # eos must be a token whose FIRST occurrence is the intended cut,
+    # and not at a chunk boundary by construction (any index works —
+    # chunks are gamma+1 = 5 wide, cut at first-occurrence semantics)
+    cut, eos = next((k, t) for k, t in enumerate(full)
+                    if full.index(t) == k and k >= 2)
+    eng = DecodeEngine(params, config, max_slots=1, draft_params=params,
+                       draft_config=config, gamma=4, eos_id=eos)
+    [out] = eng.run([prompt], max_new_tokens=12)
+    assert out == full[:cut]
+    p2 = rng.integers(0, 64, 7)
+    [out2] = eng.run([p2], max_new_tokens=5)
+    ref2 = _ref(params, config, p2, 5)
+    if eos in ref2:
+        ref2 = ref2[:ref2.index(eos)]
+    assert out2 == ref2
+
+
+def test_speculative_mode_validation(model):
+    params, config = model
+    import dataclasses
+    with pytest.raises(ValueError, match="go together"):
+        DecodeEngine(params, config, draft_params=params)
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeEngine(params, config, draft_params=params,
+                     draft_config=dataclasses.replace(config,
+                                                      vocab_size=32))
+    eng = DecodeEngine(params, config, max_slots=1, max_len=16,
+                       draft_params=params, draft_config=config, gamma=4)
+    with pytest.raises(ValueError, match="gamma"):
+        eng.submit(np.zeros(4, np.int32), 10)   # 4 + 10 + 4 > 16
