@@ -1719,13 +1719,14 @@ def _filter_logits(logits: jnp.ndarray, top_k: Optional[int],
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",
                                    "config", "sample", "top_k", "top_p",
-                                   "use_rep_penalty"))
+                                   "use_rep_penalty", "logits_processor"))
 def _generate_scan(params, prompt, temperature, key, prompt_len: int,
                    max_new_tokens: int, config: TransformerConfig,
                    sample: bool, top_k: Optional[int] = None,
                    top_p: Optional[float] = None,
                    repetition_penalty=1.0, use_rep_penalty: bool = False,
-                   prompt_lengths: Optional[jnp.ndarray] = None):
+                   prompt_lengths: Optional[jnp.ndarray] = None,
+                   logits_processor=None):
     c = config
     batch = prompt.shape[0]
     total = prompt_len + max_new_tokens
@@ -1743,6 +1744,11 @@ def _generate_scan(params, prompt, temperature, key, prompt_len: int,
             True, mode="drop")
 
     def next_token(logits, seen, key):
+        if logits_processor is not None:
+            # user constraint hook (jax-traceable): grammar masks, token
+            # bans, logit biases — applied before penalties and filters,
+            # so constraints bound what sampling can ever pick
+            logits = logits_processor(logits)
         if use_rep_penalty:
             # CTRL-style: shrink already-emitted tokens' logits toward
             # "less likely" on whichever side of zero they sit
@@ -1817,7 +1823,7 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
              key=None, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              repetition_penalty: float = 1.0,
-             prompt_lengths=None) -> jnp.ndarray:
+             prompt_lengths=None, logits_processor=None) -> jnp.ndarray:
     """Autoregressive generation: ``(batch, prompt_len)`` prompt ids ->
     ``(batch, max_new_tokens)`` sampled continuations.
 
@@ -1835,6 +1841,13 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
     Ragged batches: pass right-padded prompts plus ``prompt_lengths``
     ``(batch,)`` — each row teacher-forces its own prefix and its
     continuation aligns at index 0 of the output (per-row gather).
+
+    ``logits_processor`` is an optional jax-traceable
+    ``(batch, vocab) -> (batch, vocab)`` hook applied to every step's
+    logits before penalties and filters — the constraint point for
+    grammar masks, token bans, or logit biases (set banned entries to
+    ``-inf``; greedy and sampling both then never pick them). One
+    recompile per distinct function object.
     """
     c = config
     prompt = jnp.asarray(prompt)
@@ -1864,7 +1877,8 @@ def generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
                           float(top_p) if top_p is not None else None,
                           jnp.float32(repetition_penalty),
                           repetition_penalty != 1.0,
-                          prompt_lengths)
+                          prompt_lengths,
+                          logits_processor=logits_processor)
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens",

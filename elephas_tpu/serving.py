@@ -57,7 +57,13 @@ class TextGenerator:
                  top_p: Optional[float] = None,
                  repetition_penalty: float = 1.0,
                  seed: int = 0,
-                 stop_id: Optional[int] = None) -> List[str]:
+                 stop_id: Optional[int] = None,
+                 stop_sequences: Optional[Sequence[str]] = None
+                 ) -> List[str]:
+        """Generate continuations for ``prompts``. ``stop_sequences``
+        truncates each output at the earliest occurrence of any of the
+        given strings (the stop text itself is dropped) — multi-token
+        stop phrases the single-id ``stop_id`` cannot express."""
         tok = self.tokenizer
         encoded = [tok.encode(p) for p in prompts]
         lens = np.asarray([len(e) for e in encoded], np.int32)
@@ -103,5 +109,14 @@ class TextGenerator:
             ids = list(row)
             if stop is not None and stop in ids:
                 ids = ids[:ids.index(stop)]
-            texts.append(tok.decode(ids))
+            text = tok.decode(ids)
+            if stop_sequences:
+                # empty stops are skipped: find("") is 0 for every
+                # string and would silently blank all outputs
+                cut = min((idx for idx in (text.find(s)
+                                           for s in stop_sequences if s)
+                           if idx >= 0), default=-1)
+                if cut >= 0:
+                    text = text[:cut]
+            texts.append(text)
         return texts

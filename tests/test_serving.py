@@ -93,3 +93,23 @@ def test_text_generator_draft_config_validated_at_construction():
     with pytest.raises(ValueError, match="gamma"):
         TextGenerator(params, config, tok, draft_params=params,
                       draft_config=config, gamma=0)
+
+
+def test_text_generator_stop_sequences():
+    params, config, tok = _trained_lm()
+    gen = TextGenerator(params, config, tok)
+    base = gen(["abcabc"], max_new_tokens=12)[0]
+    assert len(base) >= 4
+    stop = base[2:4]  # a substring the output provably contains
+    stopped = gen(["abcabc"], max_new_tokens=12, stop_sequences=[stop])[0]
+    assert stopped == base[:base.find(stop)]
+    # earliest of several stops wins; non-occurring stops are ignored
+    multi = gen(["abcabc"], max_new_tokens=12,
+                stop_sequences=["zzzz", stop, base[1:3]])[0]
+    cut = min(base.find(stop), base.find(base[1:3]))
+    assert multi == base[:cut]
+    assert gen(["abcabc"], max_new_tokens=12,
+               stop_sequences=["zzzz"])[0] == base
+    # empty stop strings are ignored, never blank the output
+    assert gen(["abcabc"], max_new_tokens=12,
+               stop_sequences=[""])[0] == base
