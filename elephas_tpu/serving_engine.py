@@ -116,6 +116,7 @@ class DecodeEngine:
         self._pos = np.zeros(self.max_slots, np.int32)
         self._last = np.zeros(self.max_slots, np.int32)
         self._budget = np.zeros(self.max_slots, np.int32)
+        self._temp = np.full(self.max_slots, self.temperature, np.float32)
         self._rid = [None] * self.max_slots
         self._queue: deque = deque()
         self._outputs: Dict = {}
@@ -127,13 +128,18 @@ class DecodeEngine:
         temp = self.temperature
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _step(params, cache, last, pos, key):
+        def _step(params, cache, last, pos, temps, key):
+            # per-slot temperature: each request samples at its own
+            # setting (0 = greedy) inside one batched step — both
+            # branches are computed and a where() picks per row, which
+            # costs one categorical over (B, V), noise next to the
+            # model forward
             logits, cache = decode_step(params, cache, last, pos, cfg)
-            if temp > 0:
-                key, sub = jax.random.split(key)
-                tok = jax.random.categorical(sub, logits / temp, axis=-1)
-            else:
-                tok = jnp.argmax(logits, axis=-1)
+            key, sub = jax.random.split(key)
+            safe = jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(sub, logits / safe, axis=-1)
+            tok = jnp.where(temps > 0, sampled,
+                            jnp.argmax(logits, axis=-1))
             return tok.astype(jnp.int32), cache, key
 
         @partial(jax.jit, donate_argnums=(0,))
@@ -182,9 +188,17 @@ class DecodeEngine:
             self._prefill_draft_fn = _prefill_draft
 
     # ------------------------------------------------------------ queue
-    def submit(self, prompt: Sequence[int], max_new_tokens: int) -> int:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: Optional[float] = None) -> int:
         """Queue a request; returns its id. Admission happens lazily on
-        the next :meth:`step` (or immediately if a slot is free)."""
+        the next :meth:`step` (or immediately if a slot is free).
+        ``temperature`` overrides the engine default for THIS request
+        (plain stepping only — speculative mode samples every slot at
+        the engine temperature, since the accept/resample rule is
+        compiled for one setting)."""
+        if temperature is not None and self.draft_config is not None:
+            raise ValueError("per-request temperature is not supported "
+                             "in speculative mode")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -201,7 +215,9 @@ class DecodeEngine:
                 + f" exceeds max_len {self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append((rid, prompt, int(max_new_tokens)))
+        self._queue.append((rid, prompt, int(max_new_tokens),
+                    self.temperature if temperature is None
+                    else float(temperature)))
         self._admit()
         return rid
 
@@ -212,7 +228,7 @@ class DecodeEngine:
         for slot in self._free_slots():
             if not self._queue:
                 return
-            rid, prompt, max_new = self._queue.popleft()
+            rid, prompt, max_new, temp = self._queue.popleft()
             # exact-length prefill: one compile per distinct prompt
             # length (an online server batches by length bucket upstream
             # if compile churn matters)
@@ -224,10 +240,9 @@ class DecodeEngine:
                                                   jnp.asarray(prompt[None]))
                 self.draft_cache = self._install_draft_fn(
                     self.draft_cache, d_row, slot)
-            if self.temperature > 0:
+            if temp > 0:
                 self._key, sub = jax.random.split(self._key)
-                t0 = int(jax.random.categorical(
-                    sub, logits[0] / self.temperature))
+                t0 = int(jax.random.categorical(sub, logits[0] / temp))
             else:
                 t0 = int(jnp.argmax(logits[0]))
             self._rid[slot] = rid
@@ -235,6 +250,7 @@ class DecodeEngine:
             self._pos[slot] = prompt.size - 1
             self._last[slot] = t0
             self._budget[slot] = max_new
+            self._temp[slot] = temp
             if self._record(slot, t0):
                 self._fresh[rid] = t0    # surfaced by the next step()
 
@@ -309,7 +325,7 @@ class DecodeEngine:
             return emitted
         toks, self.cache, self._key = self._step_fn(
             self.params, self.cache, jnp.asarray(self._last),
-            jnp.asarray(pos), self._key)
+            jnp.asarray(pos), jnp.asarray(self._temp), self._key)
         toks = np.asarray(toks)
         for slot in np.nonzero(active)[0]:
             rid = self._rid[slot]

@@ -239,3 +239,25 @@ def test_speculative_mode_validation(model):
                        draft_params=params, draft_config=config, gamma=4)
     with pytest.raises(ValueError, match="gamma"):
         eng.submit(np.zeros(4, np.int32), 10)   # 4 + 10 + 4 > 16
+
+
+def test_per_request_temperature(model):
+    """One batch, mixed sampling settings: the temperature-0 request
+    still matches its solo greedy decode while a sampled request rides
+    the same steps."""
+    params, config = model
+    rng = np.random.default_rng(10)
+    p_greedy, p_sampled = rng.integers(0, 64, 6), rng.integers(0, 64, 8)
+    eng = DecodeEngine(params, config, max_slots=2, temperature=0.0)
+    r1 = eng.submit(p_greedy, 8)                    # engine default: greedy
+    r2 = eng.submit(p_sampled, 8, temperature=0.9)  # per-request override
+    while eng.pending:
+        eng.step()
+    assert eng.result(r1) == _ref(params, config, p_greedy, 8)
+    out2 = eng.result(r2)
+    assert len(out2) == 8 and all(0 <= t < 64 for t in out2)
+    # speculative mode rejects the override explicitly
+    spec = DecodeEngine(params, config, max_slots=1, draft_params=params,
+                        draft_config=config, gamma=2)
+    with pytest.raises(ValueError, match="speculative"):
+        spec.submit(p_greedy, 4, temperature=0.5)
