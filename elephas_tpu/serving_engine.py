@@ -123,6 +123,12 @@ class DecodeEngine:
         self._done: Dict = {}
         self._fresh: Dict = {}   # admission-time tokens awaiting step()
         self._next_rid = 0
+        # observability counters (see .stats)
+        self._n_steps = 0
+        self._n_emitted = 0
+        self._n_finished = 0
+        self._n_accepted = 0
+        self._n_proposed = 0
 
         cfg = config
         temp = self.temperature
@@ -196,9 +202,13 @@ class DecodeEngine:
         (plain stepping only — speculative mode samples every slot at
         the engine temperature, since the accept/resample rule is
         compiled for one setting)."""
-        if temperature is not None and self.draft_config is not None:
-            raise ValueError("per-request temperature is not supported "
-                             "in speculative mode")
+        if temperature is not None:
+            if self.draft_config is not None:
+                raise ValueError("per-request temperature is not "
+                                 "supported in speculative mode")
+            if not (temperature >= 0 and np.isfinite(temperature)):
+                raise ValueError("temperature must be >= 0 and finite, "
+                                 f"got {temperature}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -264,6 +274,7 @@ class DecodeEngine:
             self._finish(slot)
             return False
         self._outputs[rid].append(tok)
+        self._n_emitted += 1
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
@@ -273,6 +284,25 @@ class DecodeEngine:
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
+        self._n_finished += 1
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        """Serving counters since construction: ``steps`` (device round
+        trips), ``tokens_emitted``, ``requests_finished``,
+        ``tokens_per_step`` (the continuous-batching + speculation
+        payoff), and in speculative mode ``draft_acceptance`` (accepted
+        / proposed over active slots)."""
+        out = {"steps": self._n_steps,
+               "tokens_emitted": self._n_emitted,
+               "requests_finished": self._n_finished,
+               "tokens_per_step": (self._n_emitted / self._n_steps
+                                   if self._n_steps else 0.0)}
+        if self.draft_config is not None:
+            out["draft_acceptance"] = (
+                self._n_accepted / self._n_proposed
+                if self._n_proposed else 0.0)
+        return out
 
     # ------------------------------------------------------------- step
     @property
@@ -302,6 +332,7 @@ class DecodeEngine:
         # shape); their writes are overwritten by the next admission's
         # prefill and masked until then
         pos = np.where(active, self._pos + 1, 0).astype(np.int32)
+        self._n_steps += 1
         if self.draft_config is not None:
             # speculative round: every active slot advances by its own
             # 1 + accepted tokens in one dispatch
@@ -312,6 +343,8 @@ class DecodeEngine:
                                    jnp.asarray(pos), self._key))
             emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
                               np.asarray(nxt))
+            self._n_accepted += int(acc[active].sum())
+            self._n_proposed += self.gamma * int(active.sum())
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
                 self._pos[slot] += 1 + acc[slot]

@@ -261,3 +261,29 @@ def test_per_request_temperature(model):
                         draft_config=config, gamma=2)
     with pytest.raises(ValueError, match="speculative"):
         spec.submit(p_greedy, 4, temperature=0.5)
+    with pytest.raises(ValueError, match="finite"):
+        eng.submit(p_greedy, 4, temperature=-0.7)
+    with pytest.raises(ValueError, match="finite"):
+        eng.submit(p_greedy, 4, temperature=float("nan"))
+
+
+def test_stats_counters(model):
+    params, config = model
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 64, 5), rng.integers(0, 64, 7)]
+    eng = DecodeEngine(params, config, max_slots=2)
+    eng.run(prompts, max_new_tokens=6)
+    s = eng.stats
+    assert s["requests_finished"] == 2
+    assert s["tokens_emitted"] == 12
+    # two slots emit <= 2 per step, plus the two admission-time first
+    # tokens that ride along free of any step
+    assert 0 < s["tokens_per_step"] <= 2.5
+    assert "draft_acceptance" not in s
+
+    spec = DecodeEngine(params, config, max_slots=1, draft_params=params,
+                        draft_config=config, gamma=3)
+    spec.run([prompts[0]], max_new_tokens=8)
+    ss = spec.stats
+    assert ss["draft_acceptance"] == 1.0     # self-draft accepts all
+    assert ss["tokens_per_step"] > 1.5       # speculation's payoff
