@@ -154,11 +154,14 @@ class ModelCheckpoint(Callback):
     Resume with ``model.restore_training_state(directory)``.
 
     :param save_best_only: only write when ``monitor`` improves.
+    :param block: ``False`` writes checkpoints on a background thread
+        (state is snapshotted to host first), so epochs never stall on
+        checkpoint IO; the final write is flushed at ``on_train_end``.
     """
 
     def __init__(self, directory: str, monitor: str = "loss",
                  save_best_only: bool = False, mode: str = "min",
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, block: bool = True):
         super().__init__()
         from ..utils.checkpoint import CheckpointManager
 
@@ -169,6 +172,7 @@ class ModelCheckpoint(Callback):
         self.save_best_only = save_best_only
         self.mode = mode
         self.best = math.inf if mode == "min" else -math.inf
+        self.block = block
         self._epoch_offset = 0
         self._warned_missing = False
 
@@ -201,7 +205,11 @@ class ModelCheckpoint(Callback):
             self.best = float(value)
         self.manager.save(self._epoch_offset + epoch,
                           self.model.training_state(),
-                          model_json=self.model.to_json())
+                          model_json=self.model.to_json(),
+                          block=self.block)
+
+    def on_train_end(self, logs=None):
+        self.manager.wait_until_finished()
 
 
 class LambdaCallback(Callback):

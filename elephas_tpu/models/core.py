@@ -463,6 +463,22 @@ class BaseModel:
         cbs = CallbackList(callbacks, self)
         cbs.train_begin()
 
+        # the epoch loop runs under try/finally so train_end fires even on
+        # an interrupt/callback error — async ModelCheckpoint flushes its
+        # background writes there (a skipped flush = torn manifest)
+        try:
+            self._run_epochs(cbs, step, trainable, state, opt_state, x, y, n,
+                             epochs, batch_size, shuffle, shuffle_rng,
+                             validation_data, verbose, history)
+        finally:
+            cbs.train_end()
+        return history
+
+    def _run_epochs(self, cbs, step, trainable, state, opt_state, x, y, n,
+                    epochs, batch_size, shuffle, shuffle_rng,
+                    validation_data, verbose, history):
+        from ..utils.native import batch_iterator
+
         for epoch in range(int(epochs)):
             cbs.epoch_begin(epoch)
             order = shuffle_rng.permutation(n) if shuffle else np.arange(n)
@@ -523,8 +539,6 @@ class BaseModel:
 
         self.params = self._merge_params(trainable, state)
         self._opt_state = opt_state
-        cbs.train_end()
-        return history
 
     def train_on_batch(self, x, y):
         """Single optimization step on one batch; returns [loss, *metrics]."""

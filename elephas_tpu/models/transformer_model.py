@@ -439,11 +439,15 @@ class TransformerModel:
             cbs.epoch_end(epoch, logs)
             return bool(self.stop_training)
 
-        history = self.fit_tokens(
-            x, epochs=epochs, batch_size=batch_size,
-            validation_split=validation_split, seed=seed, verbose=verbose,
-            epoch_callback=epoch_cb if cbs else None)
-        cbs.train_end()
+        # finally: async ModelCheckpoint flushes background writes in
+        # train_end — it must run even when training raises
+        try:
+            history = self.fit_tokens(
+                x, epochs=epochs, batch_size=batch_size,
+                validation_split=validation_split, seed=seed, verbose=verbose,
+                epoch_callback=epoch_cb if cbs else None)
+        finally:
+            cbs.train_end()
         return history
 
     def apply_ema(self):

@@ -334,29 +334,36 @@ class TPUModel:
         cbs.train_begin()
         histories_before = len(self._training_histories)
 
-        if self.mode == "synchronous":
-            if self.sync_mode == "step":
-                self._fit_sync_step(ds, callbacks=cbs, **train_config)
+        # train_end must fire even when fit raises (interrupt, callback
+        # error): async ModelCheckpoint flushes its background writes
+        # there, and a skipped flush leaves a torn manifest racing any
+        # restore the user attempts from the except handler
+        try:
+            if self.mode == "synchronous":
+                if self.sync_mode == "step":
+                    self._fit_sync_step(ds, callbacks=cbs, **train_config)
+                else:
+                    self._fit_sync_average(ds, **train_config)
+            elif self.mode in ("asynchronous", "hogwild"):
+                self._fit_async(ds, callbacks=cbs, **train_config)
             else:
-                self._fit_sync_average(ds, **train_config)
-        elif self.mode in ("asynchronous", "hogwild"):
-            self._fit_async(ds, callbacks=cbs, **train_config)
-        else:
-            raise ValueError("Unsupported mode {}".format(self.mode))
+                raise ValueError("Unsupported mode {}".format(self.mode))
 
-        if cbs and self.mode == "synchronous" and self.sync_mode == "average":
-            # model averaging runs all epochs inside one compiled program,
-            # so callbacks get one round-level epoch_end: mean of each
-            # metric's final value across THIS fit's worker histories.
-            # (sync-step and async modes fire real per-epoch hooks.)
-            new_histories = self._training_histories[histories_before:]
-            sums: Dict[str, list] = {}
-            for hist in new_histories:
-                for k, v in hist.items():
-                    if v:
-                        sums.setdefault(k, []).append(v[-1])
-            cbs.epoch_end(0, {k: float(np.mean(v)) for k, v in sums.items()})
-        cbs.train_end()
+            if cbs and self.mode == "synchronous" and self.sync_mode == "average":
+                # model averaging runs all epochs inside one compiled program,
+                # so callbacks get one round-level epoch_end: mean of each
+                # metric's final value across THIS fit's worker histories.
+                # (sync-step and async modes fire real per-epoch hooks.)
+                new_histories = self._training_histories[histories_before:]
+                sums: Dict[str, list] = {}
+                for hist in new_histories:
+                    for k, v in hist.items():
+                        if v:
+                            sums.setdefault(k, []).append(v[-1])
+                cbs.epoch_end(0, {k: float(np.mean(v))
+                                  for k, v in sums.items()})
+        finally:
+            cbs.train_end()
 
     def _fit_transformer(self, data, epochs: int = 10,
                          batch_size: Optional[int] = None,

@@ -10,9 +10,12 @@ Falls back to a plain-numpy ``.npz`` format when orbax is unavailable.
 """
 import json
 import shutil
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+import jax
 import numpy as np
 
 try:
@@ -78,6 +81,11 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         self._checkpointer = (ocp.StandardCheckpointer() if _HAS_ORBAX
                               else None)
+        # async-save machinery: ONE worker thread so queued writes keep
+        # manifest ordering; errors surface at the next save()/wait()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._pending: List[Future] = []
+        self._pending_lock = threading.Lock()
         if self._store is not None:
             # adopt an existing remote run's manifest (resume-from-URL)
             manifest_url = f"{self._remote_url}/manifest.json"
@@ -88,10 +96,60 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
     def save(self, step: int, state: Dict[str, Any],
              model_json: Optional[str] = None,
-             distributed_config: Optional[Dict] = None):
+             distributed_config: Optional[Dict] = None,
+             block: bool = True):
         """Save a pytree ``state`` (e.g. ``{'params': ..., 'opt_state': ...}``)
-        at ``step`` and update the manifest."""
-        manifest = {"latest_step": int(step), "steps": self.steps() + [int(step)]}
+        at ``step`` and update the manifest.
+
+        ``block=False`` returns as soon as the state has been snapshotted
+        to host memory; the disk write, remote mirror, and GC run on a
+        background thread so the training loop is never stalled on IO
+        (the device arrays are free for donation immediately). Writes
+        queue on one worker, preserving step order; a failed background
+        write re-raises at the next ``save``/``wait_until_finished``.
+        Async saves snapshot via host transfer, so in a multi-process
+        run whose arrays are not fully addressable use ``block=True``
+        (orbax writes those shard-wise from device)."""
+        if block:
+            # earlier async writes must land first: the manifest is a
+            # running log and a blocking save must observe/extend it
+            self.wait_until_finished()
+            self._write(int(step), state, model_json, distributed_config)
+            return
+        self.check_error()
+        host_state = jax.tree_util.tree_map(_to_host, state)
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="etpu-ckpt")
+        with self._pending_lock:
+            self._pending.append(self._executor.submit(
+                self._write, int(step), host_state, model_json,
+                distributed_config))
+
+    def wait_until_finished(self):
+        """Block until every queued async save has been written; re-raise
+        the first background failure, if any."""
+        while True:
+            with self._pending_lock:
+                if not self._pending:
+                    return
+                fut = self._pending.pop(0)
+            fut.result()  # propagates the write's exception
+
+    def check_error(self):
+        """Re-raise a completed-and-failed background save without
+        waiting on the ones still in flight."""
+        with self._pending_lock:
+            done = [f for f in self._pending if f.done()]
+            self._pending = [f for f in self._pending if not f.done()]
+        for fut in done:
+            fut.result()
+
+    def _write(self, step: int, state: Dict[str, Any],
+               model_json: Optional[str],
+               distributed_config: Optional[Dict]):
+        manifest = {"latest_step": int(step),
+                    "steps": self._steps_nowait() + [int(step)]}
         if model_json is not None:
             manifest["model"] = model_json
         if distributed_config is not None:
@@ -125,6 +183,7 @@ class CheckpointManager:
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Restore the state pytree at ``step`` (default: latest)."""
+        self.wait_until_finished()
         manifest = self._read_manifest()
         if step is None:
             step = manifest.get("latest_step")
@@ -144,12 +203,18 @@ class CheckpointManager:
 
     # ------------------------------------------------------------- metadata
     def manifest(self) -> Dict[str, Any]:
+        self.wait_until_finished()
         return self._read_manifest()
 
     def latest_step(self) -> Optional[int]:
+        self.wait_until_finished()
         return self._read_manifest().get("latest_step")
 
     def steps(self) -> List[int]:
+        self.wait_until_finished()
+        return self._steps_nowait()
+
+    def _steps_nowait(self) -> List[int]:
         return list(self._read_manifest().get("steps", []))
 
     def _read_manifest(self) -> Dict[str, Any]:
@@ -159,7 +224,7 @@ class CheckpointManager:
         return json.loads(path.read_text())
 
     def _gc(self):
-        steps = self.steps()
+        steps = self._steps_nowait()
         evicted = False
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
@@ -178,6 +243,17 @@ class CheckpointManager:
         if self._store is not None and _is_coordinator():
             self._store.write_text(f"{self._remote_url}/manifest.json",
                                    json.dumps(manifest))
+
+
+def _to_host(leaf):
+    """Snapshot one pytree leaf to host memory so the async writer sees
+    a stable copy even if the caller donates/overwrites the device
+    buffer on the very next step."""
+    if isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    if isinstance(leaf, np.ndarray):
+        return leaf.copy()
+    return leaf
 
 
 def _flatten(tree, prefix=""):

@@ -167,3 +167,62 @@ def test_async_batch_frequency_per_epoch_hooks():
                       validation_split=0.0, callbacks=[cb])
         assert [e for e, _ in events] == [0, 1], (overlap, accum, events)
         assert all(isinstance(l, float) for _, l in events)
+
+
+def test_model_checkpoint_async_matches_blocking(tmp_path):
+    """block=False checkpoints must be byte-equivalent in content to the
+    blocking ones: same steps, same restored predictions."""
+    x, y = _data()
+
+    def run(ckpt_dir, block):
+        m = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+        m.compile("sgd", "mse", seed=0)
+        tpu_model = TPUModel(m, mode="synchronous", sync_mode="step",
+                             num_workers=2)
+        tpu_model.fit(to_dataset(x, y), epochs=2, batch_size=32, verbose=0,
+                      validation_split=0.0,
+                      callbacks=[ModelCheckpoint(ckpt_dir, block=block)])
+        return tpu_model
+
+    run(str(tmp_path / "sync_ck"), block=True)
+    run(str(tmp_path / "async_ck"), block=False)
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    sync_mgr = CheckpointManager(str(tmp_path / "sync_ck"))
+    async_mgr = CheckpointManager(str(tmp_path / "async_ck"))
+    assert sync_mgr.steps() == async_mgr.steps() == [0, 1]
+    a = sync_mgr.restore(1)["params"]
+    b = async_mgr.restore(1)["params"]
+    import jax
+
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+
+def test_train_end_flushes_async_checkpoints_on_error(tmp_path):
+    """An exception escaping fit() must still flush async checkpoint
+    writes (train_end runs in a finally), so a restore attempted from
+    the except handler never races a background write."""
+    import pytest
+    from elephas_tpu.models.callbacks import Callback
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    class _Bomb(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            if epoch == 1:
+                raise RuntimeError("mid-training failure")
+
+    x, y = _data()
+    ckpt_dir = str(tmp_path / "flush_ck")
+    m = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+    m.compile("sgd", "mse", seed=0)
+    ck = ModelCheckpoint(ckpt_dir, block=False)
+    with pytest.raises(RuntimeError, match="mid-training failure"):
+        m.fit(x, y, epochs=4, batch_size=32, verbose=0,
+              callbacks=[ck, _Bomb()])
+    # every save issued before the failure has fully landed on disk
+    fresh = CheckpointManager(ckpt_dir)
+    assert fresh.steps() == [0, 1]
+    restored = fresh.restore()
+    assert restored["params"]
