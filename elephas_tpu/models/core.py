@@ -456,7 +456,6 @@ class BaseModel:
         history = History()
         shuffle_rng = np.random.default_rng(self._rng_seed)
 
-        from ..utils.native import batch_iterator
         from .callbacks import CallbackList
 
         self.stop_training = False

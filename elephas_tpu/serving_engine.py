@@ -32,6 +32,13 @@ speculative decoding compose because both ride the same per-row cache
 positions (rows accept different counts and simply advance
 independently).
 
+``register_prefix`` pins the KV state of a shared prompt prefix (a
+system prompt): requests that start with it prefill only their suffix
+(longest registered match wins), cutting admission cost by the prefix's
+share of the prompt — the prefix-caching half of vLLM's automatic
+prefix sharing, with explicit registration instead of radix-tree
+detection.
+
 The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
@@ -44,8 +51,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.transformer import (TransformerConfig, decode_step,
-                                 init_kv_cache, prefill_cache)
+from .models.transformer import (TransformerConfig, decode_block,
+                                 decode_step, init_kv_cache, prefill_cache)
 
 __all__ = ["DecodeEngine"]
 
@@ -165,9 +172,27 @@ class DecodeEngine:
             # "one compile per distinct prompt length" admission cost
             return prefill_cache(params, prompt, cfg, max_len)
 
+        def _make_extend(xcfg):
+            @jax.jit
+            def _extend(params, row_cache, suffix, pos0):
+                # continue a batch-1 prefill past a cached prefix: the
+                # suffix attends to the prefix's k/v already in the row
+                # cache (row_cache is NOT donated — it is the shared
+                # prefix entry, reused by every admission that hits it)
+                logits, row_cache = decode_block(params, row_cache,
+                                                 suffix, pos0, xcfg)
+                return logits[:, -1], row_cache
+            return _extend
+
         self._step_fn = _step
         self._install_fn = _install
         self._prefill_fn = _prefill
+        self._extend_fn = _make_extend(cfg)
+        # registered shared prompt prefixes, longest first:
+        # (tokens, last-position logits, target row cache, draft row cache)
+        self._prefixes: List = []
+        self._n_prefix_hits = 0
+        self._n_prefix_tokens = 0
 
         if draft_config is not None:
             from .models.speculative import speculative_round
@@ -192,6 +217,60 @@ class DecodeEngine:
             # structure), so the draft cache reuses it
             self._install_draft_fn = _install
             self._prefill_draft_fn = _prefill_draft
+            self._extend_draft_fn = _make_extend(dcfg)
+
+    # ---------------------------------------------------------- prefixes
+    def register_prefix(self, tokens: Sequence[int]) -> None:
+        """Precompute and pin the KV state of a shared prompt prefix
+        (e.g. a system prompt). Any subsequent request whose prompt
+        starts with these tokens skips the prefix's share of prefill:
+        admission installs the cached k/v and runs one
+        :func:`~elephas_tpu.models.transformer.decode_block` over just
+        the suffix. Longest registered match wins. Each registration
+        holds one batch-1 cache row (``num_layers × kv_heads × max_len ×
+        head_dim`` k+v, per model) on device until
+        :meth:`clear_prefixes`."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("prefix must hold at least one token")
+        if tokens.size >= self.max_len:
+            raise ValueError(f"prefix ({tokens.size}) must leave room "
+                             f"below max_len {self.max_len}")
+        logits, row = self._prefill_fn(self.params,
+                                       jnp.asarray(tokens[None]))
+        d_row = None
+        if self.draft_config is not None:
+            _, d_row = self._prefill_draft_fn(self.draft_params,
+                                              jnp.asarray(tokens[None]))
+        self._prefixes.append((tokens, logits[0], row, d_row))
+        self._prefixes.sort(key=lambda e: -e[0].size)
+
+    def clear_prefixes(self) -> None:
+        """Drop every registered prefix (frees their device cache rows)."""
+        self._prefixes = []
+
+    def _match_prefix(self, prompt: np.ndarray):
+        for entry in self._prefixes:  # longest first
+            p = entry[0]
+            if p.size <= prompt.size and np.array_equal(prompt[:p.size], p):
+                return entry
+        return None
+
+    def _prefill_with_prefixes(self, prompt: np.ndarray, extend_fn,
+                               prefill_fn, params, entry, cache_idx: int):
+        """Batch-1 prefill that reuses a matched prefix entry's cache row.
+        Returns (last-position logits (vocab,), row cache)."""
+        if entry is None:
+            logits, row = prefill_fn(params, jnp.asarray(prompt[None]))
+            return logits[0], row
+        ptoks, plogits = entry[0], entry[1]
+        row = entry[cache_idx]
+        if prompt.size == ptoks.size:
+            return plogits, row
+        suffix = jnp.asarray(prompt[None, ptoks.size:])
+        logits, row = extend_fn(params, row, suffix,
+                                jnp.int32(ptoks.size))
+        return logits[0], row
 
     # ------------------------------------------------------------ queue
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -241,20 +320,27 @@ class DecodeEngine:
             rid, prompt, max_new, temp = self._queue.popleft()
             # exact-length prefill: one compile per distinct prompt
             # length (an online server batches by length bucket upstream
-            # if compile churn matters)
-            logits, row_cache = self._prefill_fn(
-                self.params, jnp.asarray(prompt[None]))
+            # if compile churn matters); a registered-prefix hit reuses
+            # the prefix's cached k/v and prefills only the suffix
+            entry = self._match_prefix(prompt)
+            if entry is not None:
+                self._n_prefix_hits += 1
+                self._n_prefix_tokens += int(entry[0].size)
+            logits, row_cache = self._prefill_with_prefixes(
+                prompt, self._extend_fn, self._prefill_fn, self.params,
+                entry, 2)
             self.cache = self._install_fn(self.cache, row_cache, slot)
             if self.draft_config is not None:
-                _, d_row = self._prefill_draft_fn(self.draft_params,
-                                                  jnp.asarray(prompt[None]))
+                _, d_row = self._prefill_with_prefixes(
+                    prompt, self._extend_draft_fn, self._prefill_draft_fn,
+                    self.draft_params, entry, 3)
                 self.draft_cache = self._install_draft_fn(
                     self.draft_cache, d_row, slot)
             if temp > 0:
                 self._key, sub = jax.random.split(self._key)
-                t0 = int(jax.random.categorical(sub, logits[0] / temp))
+                t0 = int(jax.random.categorical(sub, logits / temp))
             else:
-                t0 = int(jnp.argmax(logits[0]))
+                t0 = int(jnp.argmax(logits))
             self._rid[slot] = rid
             self._outputs[rid] = []
             self._pos[slot] = prompt.size - 1
@@ -298,6 +384,9 @@ class DecodeEngine:
                "requests_finished": self._n_finished,
                "tokens_per_step": (self._n_emitted / self._n_steps
                                    if self._n_steps else 0.0)}
+        if self._prefixes:
+            out["prefix_hits"] = self._n_prefix_hits
+            out["prefix_tokens_reused"] = self._n_prefix_tokens
         if self.draft_config is not None:
             out["draft_acceptance"] = (
                 self._n_accepted / self._n_proposed

@@ -127,23 +127,42 @@ class CheckpointManager:
                 distributed_config))
 
     def wait_until_finished(self):
-        """Block until every queued async save has been written; re-raise
-        the first background failure, if any."""
+        """Block until every queued async save has been written (the
+        flush always completes — a failure does not strand later
+        writes), then re-raise the first failure, if any."""
+        first: Optional[BaseException] = None
         while True:
             with self._pending_lock:
                 if not self._pending:
-                    return
+                    break
                 fut = self._pending.pop(0)
-            fut.result()  # propagates the write's exception
+            try:
+                fut.result()
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
 
     def check_error(self):
-        """Re-raise a completed-and-failed background save without
-        waiting on the ones still in flight."""
+        """Re-raise ONE completed-and-failed background save without
+        waiting on the ones still in flight; later failures stay queued
+        and surface on subsequent calls (none are swallowed)."""
         with self._pending_lock:
-            done = [f for f in self._pending if f.done()]
-            self._pending = [f for f in self._pending if not f.done()]
-        for fut in done:
-            fut.result()
+            failed = None
+            keep = []
+            for fut in self._pending:
+                if not fut.done():
+                    keep.append(fut)
+                elif fut.exception() is None:
+                    continue  # landed cleanly — drop
+                elif failed is None:
+                    failed = fut
+                else:
+                    keep.append(fut)  # surfaces on a later call
+            self._pending = keep
+        if failed is not None:
+            failed.result()
 
     def _write(self, step: int, state: Dict[str, Any],
                model_json: Optional[str],
@@ -250,7 +269,10 @@ def _to_host(leaf):
     a stable copy even if the caller donates/overwrites the device
     buffer on the very next step."""
     if isinstance(leaf, jax.Array):
-        return np.asarray(leaf)
+        # np.array (not asarray): on CPU backends asarray may return a
+        # zero-copy ALIAS of the device buffer, which donation would
+        # then overwrite under the background writer
+        return np.array(leaf)
     if isinstance(leaf, np.ndarray):
         return leaf.copy()
     return leaf

@@ -287,3 +287,76 @@ def test_stats_counters(model):
     ss = spec.stats
     assert ss["draft_acceptance"] == 1.0     # self-draft accepts all
     assert ss["tokens_per_step"] > 1.5       # speculation's payoff
+
+
+# ------------------------------------------------------------ prefix cache
+
+def test_prefix_cache_parity(model):
+    """Requests hitting a registered prefix must produce tokens identical
+    to the no-prefix engine (and to solo generate): the cached-prefix +
+    suffix decode_block admission is numerically the full prefill."""
+    params, config = model
+    rng = np.random.default_rng(7)
+    prefix = list(rng.integers(0, 64, 6))
+    prompts = [np.asarray(prefix + list(rng.integers(0, 64, int(n))))
+               for n in (1, 4, 9)]
+    prompts.append(rng.integers(0, 64, 5))        # no shared prefix
+    eng = DecodeEngine(params, config, max_slots=2)
+    eng.register_prefix(prefix)
+    outs = eng.run(prompts, max_new_tokens=8)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 8)
+    stats = eng.stats
+    assert stats["prefix_hits"] == 3
+    assert stats["prefix_tokens_reused"] == 18
+
+
+def test_prefix_cache_exact_match_prompt(model):
+    """A prompt that IS the registered prefix: admission reuses the
+    stored last-position logits, no extra forward at all."""
+    params, config = model
+    rng = np.random.default_rng(8)
+    prefix = rng.integers(0, 64, 9)
+    eng = DecodeEngine(params, config, max_slots=2)
+    eng.register_prefix(prefix)
+    [out] = eng.run([prefix], max_new_tokens=10)
+    assert out == _ref(params, config, prefix, 10)
+    assert eng.stats["prefix_hits"] == 1
+
+
+def test_prefix_cache_longest_match_wins(model):
+    params, config = model
+    rng = np.random.default_rng(9)
+    short = list(rng.integers(0, 64, 4))
+    long = short + list(rng.integers(0, 64, 5))
+    eng = DecodeEngine(params, config, max_slots=2)
+    eng.register_prefix(short)
+    eng.register_prefix(long)
+    prompt = np.asarray(long + list(rng.integers(0, 64, 3)))
+    [out] = eng.run([prompt], max_new_tokens=6)
+    assert out == _ref(params, config, prompt, 6)
+    assert eng.stats["prefix_tokens_reused"] == 9   # the LONG prefix
+
+    eng.clear_prefixes()
+    [out2] = eng.run([prompt], max_new_tokens=6)
+    assert out2 == out
+    assert "prefix_hits" not in eng.stats
+
+
+def test_prefix_cache_speculative_mode(model):
+    """Prefix caching composes with speculative stepping: both target
+    and draft caches are prefix-reused, output still ≡ solo generate."""
+    params, config = model
+    draft_params = init_params(config, jax.random.PRNGKey(3))
+    rng = np.random.default_rng(10)
+    prefix = list(rng.integers(0, 64, 5))
+    prompts = [np.asarray(prefix + list(rng.integers(0, 64, int(n))))
+               for n in (2, 6)]
+    eng = DecodeEngine(params, config, max_slots=2,
+                       draft_params=draft_params, draft_config=config,
+                       gamma=3)
+    eng.register_prefix(prefix)
+    outs = eng.run(prompts, max_new_tokens=7)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 7)
+    assert eng.stats["prefix_hits"] == 2
