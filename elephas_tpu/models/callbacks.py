@@ -157,11 +157,16 @@ class ModelCheckpoint(Callback):
     :param block: ``False`` writes checkpoints on a background thread
         (state is snapshotted to host first), so epochs never stall on
         checkpoint IO; the final write is flushed at ``on_train_end``.
+    :param checkpoint_on_preemption: trap SIGTERM (the Cloud TPU
+        eviction notice) for the duration of training and write one
+        final checkpoint of the live state before exiting (manifest gets
+        ``preempted: true``). Requires fit() to run in the main thread.
     """
 
     def __init__(self, directory: str, monitor: str = "loss",
                  save_best_only: bool = False, mode: str = "min",
-                 max_to_keep: int = 3, block: bool = True):
+                 max_to_keep: int = 3, block: bool = True,
+                 checkpoint_on_preemption: bool = False):
         super().__init__()
         from ..utils.checkpoint import CheckpointManager
 
@@ -173,6 +178,9 @@ class ModelCheckpoint(Callback):
         self.mode = mode
         self.best = math.inf if mode == "min" else -math.inf
         self.block = block
+        self.checkpoint_on_preemption = checkpoint_on_preemption
+        self._uninstall_preemption = None
+        self._cur_epoch = 0
         self._epoch_offset = 0
         self._warned_missing = False
 
@@ -181,8 +189,20 @@ class ModelCheckpoint(Callback):
         # number epochs after any already-checkpointed step
         self.best = math.inf if self.mode == "min" else -math.inf
         self._warned_missing = False
-        latest = self.manager.latest_step()
+        self._cur_epoch = 0   # stale value from a previous fit would
+        latest = self.manager.latest_step()  # stamp a phantom step
         self._epoch_offset = (latest + 1) if latest is not None else 0
+        if self.checkpoint_on_preemption:
+            from ..utils.checkpoint import install_preemption_checkpoint
+
+            self._uninstall_preemption = install_preemption_checkpoint(
+                self.manager,
+                lambda: (self._epoch_offset + self._cur_epoch,
+                         self.model.training_state()),
+                model_json=self.model.to_json())
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._cur_epoch = epoch
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_best_only:
@@ -209,6 +229,9 @@ class ModelCheckpoint(Callback):
                           block=self.block)
 
     def on_train_end(self, logs=None):
+        if self._uninstall_preemption is not None:
+            self._uninstall_preemption()
+            self._uninstall_preemption = None
         self.manager.wait_until_finished()
 
 

@@ -80,6 +80,14 @@ class DecodeEngine:
         ``generate``); only the number of host steps shrinks.
     :param draft_config: the draft model's config (same vocabulary)
     :param gamma: draft tokens proposed per round (speculative mode)
+    :param steps_per_sync: decode steps fused into each :meth:`step`
+        dispatch (plain mode): one jitted ``lax.scan`` advances every
+        slot by this many tokens per host round trip. Where dispatch
+        latency dominates (remote/tunneled chips), throughput scales
+        almost linearly with it; the cost is scheduling granularity —
+        admission/retirement happen every ``steps_per_sync`` tokens, and
+        a slot that hits eos/budget mid-chunk wastes the remainder.
+        Per-slot output is still exactly its solo greedy decode.
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
@@ -87,7 +95,7 @@ class DecodeEngine:
                  temperature: float = 0.0, eos_id: Optional[int] = None,
                  seed: int = 0, draft_params: Optional[Dict] = None,
                  draft_config: Optional[TransformerConfig] = None,
-                 gamma: int = 4):
+                 gamma: int = 4, steps_per_sync: int = 1):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -113,6 +121,13 @@ class DecodeEngine:
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = int(gamma)
+        self.steps_per_sync = int(steps_per_sync)
+        if self.steps_per_sync < 1:
+            raise ValueError("steps_per_sync must be >= 1")
+        if self.steps_per_sync > 1 and draft_config is not None:
+            raise ValueError("steps_per_sync > 1 applies to plain "
+                             "stepping; speculative mode already "
+                             "amortizes dispatches via draft rounds")
         self._key = jax.random.PRNGKey(seed)
         self.cache = init_kv_cache(config, self.max_slots, self.max_len)
         self.draft_cache = (init_kv_cache(draft_config, self.max_slots,
@@ -140,13 +155,13 @@ class DecodeEngine:
         cfg = config
         temp = self.temperature
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _step(params, cache, last, pos, temps, key):
+        def _one_step(params, cache, last, pos, temps, key):
             # per-slot temperature: each request samples at its own
             # setting (0 = greedy) inside one batched step — both
             # branches are computed and a where() picks per row, which
             # costs one categorical over (B, V), noise next to the
-            # model forward
+            # model forward. THE sampling body: _step and _multi_step
+            # both call it, so plain and fused modes cannot drift
             logits, cache = decode_step(params, cache, last, pos, cfg)
             key, sub = jax.random.split(key)
             safe = jnp.maximum(temps, 1e-6)[:, None]
@@ -154,6 +169,31 @@ class DecodeEngine:
             tok = jnp.where(temps > 0, sampled,
                             jnp.argmax(logits, axis=-1))
             return tok.astype(jnp.int32), cache, key
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _step(params, cache, last, pos, temps, key):
+            return _one_step(params, cache, last, pos, temps, key)
+
+        n_sync = self.steps_per_sync
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _multi_step(params, cache, last, pos, temps, key):
+            # steps_per_sync decode steps in one lax.scan: each slot's
+            # chain stays autoregressive (its sampled token feeds the
+            # next step), so per-slot output is exactly the solo decode;
+            # only the host's admission/retirement granularity changes.
+            # Slots that retire mid-chunk keep decoding; the host
+            # discards their surplus tokens, and their surplus cache
+            # writes land in a freed row (dead until the next prefill)
+            def body(carry, _):
+                cache, last, pos, key = carry
+                tok, cache, key = _one_step(params, cache, last, pos,
+                                            temps, key)
+                return (cache, tok, pos + 1, key), tok
+
+            (cache, _, _, key), toks = jax.lax.scan(
+                body, (cache, last, pos, key), None, length=n_sync)
+            return jnp.swapaxes(toks, 0, 1), cache, key   # (B, K)
 
         @partial(jax.jit, donate_argnums=(0,))
         def _install(cache, row_cache, slot):
@@ -185,6 +225,7 @@ class DecodeEngine:
             return _extend
 
         self._step_fn = _step
+        self._multi_step_fn = _multi_step
         self._install_fn = _install
         self._prefill_fn = _prefill
         self._extend_fn = _make_extend(cfg)
@@ -441,6 +482,22 @@ class DecodeEngine:
                 for tok in emit[slot, :acc[slot] + 1]:
                     if self._rid[slot] is None:
                         break   # retired mid-chunk (eos or budget)
+                    if self._record(slot, int(tok)):
+                        emitted.setdefault(rid, []).append(int(tok))
+            self._admit()
+            return emitted
+        if self.steps_per_sync > 1:
+            toks, self.cache, self._key = self._multi_step_fn(
+                self.params, self.cache, jnp.asarray(self._last),
+                jnp.asarray(pos), jnp.asarray(self._temp), self._key)
+            toks = np.asarray(toks)                       # (B, K)
+            for slot in np.nonzero(active)[0]:
+                rid = self._rid[slot]
+                for tok in toks[slot]:
+                    if self._rid[slot] is None:
+                        break       # retired mid-chunk — surplus dropped
+                    self._pos[slot] += 1
+                    self._last[slot] = tok
                     if self._record(slot, int(tok)):
                         emitted.setdefault(rid, []).append(int(tok))
             self._admit()

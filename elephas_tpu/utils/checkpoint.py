@@ -85,7 +85,10 @@ class CheckpointManager:
         # manifest ordering; errors surface at the next save()/wait()
         self._executor: Optional[ThreadPoolExecutor] = None
         self._pending: List[Future] = []
-        self._pending_lock = threading.Lock()
+        # RLock, not Lock: the preemption SIGTERM handler runs on this
+        # same (main) thread and may interrupt a holder mid-section —
+        # a non-reentrant lock would deadlock the final checkpoint
+        self._pending_lock = threading.RLock()
         if self._store is not None:
             # adopt an existing remote run's manifest (resume-from-URL)
             manifest_url = f"{self._remote_url}/manifest.json"
@@ -221,6 +224,18 @@ class CheckpointManager:
         return _unflatten({k: data[k] for k in data.files}, treedef)
 
     # ------------------------------------------------------------- metadata
+    def annotate(self, **fields):
+        """Merge extra fields into the manifest (and its remote mirror) —
+        e.g. preemption markers. Flushes async saves first so the merge
+        applies to the final manifest."""
+        self.wait_until_finished()
+        manifest = self._read_manifest()
+        manifest.update(fields)
+        (self.directory / "manifest.json").write_text(json.dumps(manifest))
+        if self._store is not None and _is_coordinator():
+            self._store.write_text(f"{self._remote_url}/manifest.json",
+                                   json.dumps(manifest))
+
     def manifest(self) -> Dict[str, Any]:
         self.wait_until_finished()
         return self._read_manifest()
@@ -262,6 +277,57 @@ class CheckpointManager:
         if self._store is not None and _is_coordinator():
             self._store.write_text(f"{self._remote_url}/manifest.json",
                                    json.dumps(manifest))
+
+
+def install_preemption_checkpoint(manager: CheckpointManager, state_fn,
+                                  signals=None, model_json: Optional[str] = None,
+                                  exit_code: int = 143):
+    """Checkpoint on preemption: Cloud TPU VMs get a SIGTERM grace window
+    before eviction — install a handler that writes one final blocking
+    checkpoint and marks the manifest (``preempted: true``,
+    ``preempted_step``), then exits. The reference has no failure
+    recovery at all (SURVEY.md §5: "PS failure is fatal"); this is the
+    TPU-native upgrade for the platform's actual failure mode.
+
+    :param state_fn: zero-arg callable returning ``(step, state_pytree)``
+        — called AT SIGNAL TIME so the checkpoint holds current weights.
+    :param signals: signal numbers to trap (default: ``SIGTERM``).
+    :returns: ``uninstall()`` restoring the previous handlers.
+
+    Signal handlers require the main thread — install from the training
+    process's main thread (where ``fit`` runs)."""
+    import signal as _signal
+
+    if signals is None:
+        signals = (_signal.SIGTERM,)
+    prev = {}
+
+    def _handler(signum, frame):
+        try:
+            step, state = state_fn()
+            manager.save(int(step), state, model_json=model_json,
+                         block=True)
+            manager.annotate(preempted=True, preempted_step=int(step),
+                             preempted_signal=int(signum))
+        except BaseException:   # noqa: BLE001 — the process exits next;
+            import traceback    # surface the failed final write instead
+            traceback.print_exc()  # of dying silently
+        finally:
+            # ALWAYS restore + exit: a failing save must not leave this
+            # handler installed, or the orchestrator's follow-up SIGTERM
+            # re-enters it and the process outlives its grace window
+            for sig, old in prev.items():
+                _signal.signal(sig, old)
+        raise SystemExit(exit_code)
+
+    for sig in signals:
+        prev[sig] = _signal.signal(sig, _handler)
+
+    def uninstall():
+        for sig, old in prev.items():
+            _signal.signal(sig, old)
+
+    return uninstall
 
 
 def _to_host(leaf):

@@ -360,3 +360,91 @@ def test_prefix_cache_speculative_mode(model):
     for p, o in zip(prompts, outs):
         assert o == _ref(params, config, p, 7)
     assert eng.stats["prefix_hits"] == 2
+
+
+# ------------------------------------------------------- multi-step sync
+
+def test_multi_step_parity_mixed_lengths(model):
+    """steps_per_sync=3: 7 requests through 2 slots, mixed prompt
+    lengths and max_new not divisible by the chunk — every output must
+    still equal its solo greedy decode (chunks only change host
+    scheduling granularity, never the per-slot chain)."""
+    params, config = model
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 64, int(n))
+               for n in rng.integers(3, 12, size=7)]
+    eng = DecodeEngine(params, config, max_slots=2, steps_per_sync=3)
+    outs = eng.run(prompts, max_new_tokens=10)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 10)
+    # 10 tokens per request at 3/dispatch: strictly fewer device round
+    # trips than tokens emitted
+    assert eng.stats["steps"] < eng.stats["tokens_emitted"] / 2
+
+
+def test_multi_step_eos_mid_chunk(model):
+    """A slot hitting eos inside a chunk retires there; surplus chunk
+    tokens are discarded, output ≡ solo decode with the same eos."""
+    params, config = model
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, 64, 6)
+    full = _ref(params, config, prompt, 12)
+    eos = full[5]                     # force an eos mid-generation
+    want = full[:full.index(eos)]
+    eng = DecodeEngine(params, config, max_slots=2, steps_per_sync=4,
+                       eos_id=eos)
+    [out] = eng.run([prompt], max_new_tokens=12)
+    assert out == want
+
+
+def test_multi_step_composes_with_prefix_cache(model):
+    params, config = model
+    rng = np.random.default_rng(13)
+    prefix = list(rng.integers(0, 64, 5))
+    prompts = [np.asarray(prefix + list(rng.integers(0, 64, int(n))))
+               for n in (2, 4, 6)]
+    eng = DecodeEngine(params, config, max_slots=2, steps_per_sync=4)
+    eng.register_prefix(prefix)
+    outs = eng.run(prompts, max_new_tokens=9)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 9)
+    assert eng.stats["prefix_hits"] == 3
+
+
+def test_multi_step_rejects_speculative(model):
+    params, config = model
+    with pytest.raises(ValueError, match="steps_per_sync"):
+        DecodeEngine(params, config, draft_params=params,
+                     draft_config=config, steps_per_sync=2)
+
+
+# ---------------------------------------------------- TP-sharded params
+
+def test_engine_with_tp_sharded_params():
+    """DecodeEngine with tensor-parallel GSPMD-sharded params (2x2
+    data x model mesh) must emit exactly the unsharded engine's tokens —
+    prefix caching and multi-step included. Pins the docstring's
+    'replicated or GSPMD-sharded' params claim for the engine."""
+    from jax.sharding import Mesh
+
+    from elephas_tpu.models.transformer import shard_params
+
+    config = _config(dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(21)
+    prefix = list(rng.integers(0, 64, 5))
+    prompts = [np.asarray(prefix + list(rng.integers(0, 64, int(n))))
+               for n in (3, 6, 4)]
+
+    def run(p):
+        eng = DecodeEngine(p, config, max_slots=2, steps_per_sync=3)
+        eng.register_prefix(prefix)
+        return eng.run(prompts, max_new_tokens=8)
+
+    expected = run(params)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+    got = run(shard_params(params, config, mesh))
+    assert got == expected
+    for p, o in zip(prompts, expected):
+        assert o == _ref(params, config, p, 8)

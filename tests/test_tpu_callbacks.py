@@ -226,3 +226,43 @@ def test_train_end_flushes_async_checkpoints_on_error(tmp_path):
     assert fresh.steps() == [0, 1]
     restored = fresh.restore()
     assert restored["params"]
+
+
+def test_model_checkpoint_preemption_option(tmp_path):
+    """checkpoint_on_preemption=True installs the SIGTERM trap for the
+    duration of fit and removes it after — and a signal mid-training
+    (fired from an epoch hook) checkpoints the live state."""
+    import os
+    import signal
+
+    import pytest
+
+    from elephas_tpu.models.callbacks import LambdaCallback
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    x, y = _data()
+    before = signal.getsignal(signal.SIGTERM)
+    ckpt_dir = str(tmp_path / "pre_fit_ck")
+
+    m = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+    m.compile("sgd", "mse", seed=0)
+    bomb = LambdaCallback(on_epoch_begin=lambda epoch, logs: (
+        os.kill(os.getpid(), signal.SIGTERM) if epoch == 2 else None))
+    ck = ModelCheckpoint(ckpt_dir, block=False,
+                         checkpoint_on_preemption=True)
+    with pytest.raises(SystemExit):
+        m.fit(x, y, epochs=5, batch_size=32, verbose=0,
+              callbacks=[ck, bomb])
+    mgr = CheckpointManager(ckpt_dir)
+    assert mgr.manifest()["preempted"] is True
+    assert mgr.latest_step() == 2          # the epoch being entered
+    restored = mgr.restore()
+    assert restored["params"]
+
+    # a clean fit installs and uninstalls without a trace
+    m2 = Sequential([Dense(8, input_dim=4, activation="relu"), Dense(1)])
+    m2.compile("sgd", "mse", seed=0)
+    m2.fit(x, y, epochs=1, batch_size=32, verbose=0,
+           callbacks=[ModelCheckpoint(str(tmp_path / "clean_ck"),
+                                      checkpoint_on_preemption=True)])
+    assert signal.getsignal(signal.SIGTERM) == before

@@ -237,3 +237,51 @@ def test_async_save_jax_arrays(tmp_path):
     np.testing.assert_allclose(np.asarray(restored["w"]),
                                np.arange(8, dtype=np.float32))
     assert np.asarray(restored["nested"]["b"]).dtype == jnp.bfloat16
+
+
+# ----------------------------------------------------------- preemption
+
+def test_preemption_handler_checkpoints_and_exits(tmp_path):
+    """SIGTERM (the Cloud TPU eviction notice) triggers one blocking
+    checkpoint of the CURRENT state plus a manifest marker, then
+    SystemExit(143)."""
+    import os
+    import signal
+
+    import pytest
+
+    from elephas_tpu.utils.checkpoint import install_preemption_checkpoint
+
+    manager = CheckpointManager(str(tmp_path / "pre_ck"))
+    current = {"step": 3}
+    uninstall = install_preemption_checkpoint(
+        manager, lambda: (current["step"], _state(float(current["step"]))))
+    try:
+        current["step"] = 7      # state advances after install
+        with pytest.raises(SystemExit) as exc:
+            os.kill(os.getpid(), signal.SIGTERM)
+        assert exc.value.code == 143
+    finally:
+        uninstall()
+    fresh = CheckpointManager(str(tmp_path / "pre_ck"))
+    assert fresh.latest_step() == 7
+    np.testing.assert_allclose(fresh.restore()["step_scalar"], 7.0)
+    m = fresh.manifest()
+    assert m["preempted"] is True and m["preempted_step"] == 7
+    # handler restored: a second SIGTERM must use the default disposition
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_preemption_uninstall_restores_handler(tmp_path):
+    import signal
+
+    from elephas_tpu.utils.checkpoint import install_preemption_checkpoint
+
+    before = signal.getsignal(signal.SIGTERM)
+    manager = CheckpointManager(str(tmp_path / "pre_ck2"))
+    uninstall = install_preemption_checkpoint(manager,
+                                              lambda: (0, _state(0.0)))
+    assert signal.getsignal(signal.SIGTERM) != before
+    uninstall()
+    assert signal.getsignal(signal.SIGTERM) == before
+    assert manager.latest_step() is None   # nothing written without a signal
