@@ -51,8 +51,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.transformer import (TransformerConfig, decode_block,
+from .models.transformer import (NEG_INF, TransformerConfig, decode_block,
                                  decode_step, init_kv_cache, prefill_cache)
+
+
+def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
+                        top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-ROW top-k / nucleus filters over ``(B, V)`` logits — the
+    vectorized form of the scalar
+    :func:`~elephas_tpu.models.transformer._filter_logits` (same
+    keep-until-mass-passes semantics, always keeping the top token).
+    ``top_k[b] <= 0`` and ``top_p[b] >= 1`` disable the respective
+    filter for that row, so one batched program serves every mix of
+    per-request settings."""
+    v = logits.shape[-1]
+    # top-k first, then the nucleus over the top-k SURVIVORS — the same
+    # sequential composition as the scalar filter (the nucleus mass is
+    # renormalized within the top-k set, so the two are not independent)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kidx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=-1)
+    k_thr = jnp.where(((top_k > 0) & (top_k < v))[:, None], kth, -jnp.inf)
+    logits = jnp.where(logits >= k_thr, logits, NEG_INF)
+    # top-k masking cannot reorder survivors, so masking the FIRST sort
+    # gives the sorted view of the masked logits — no second sort
+    sorted_desc = jnp.where(sorted_desc >= k_thr, sorted_desc, NEG_INF)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p[:, None]],
+        axis=-1)
+    p_kth = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf),
+                    axis=-1, keepdims=True)
+    p_thr = jnp.where(top_p[:, None] < 1.0, p_kth, -jnp.inf)
+    return jnp.where(logits >= p_thr, logits, NEG_INF)
 
 __all__ = ["DecodeEngine"]
 
@@ -139,6 +171,8 @@ class DecodeEngine:
         self._last = np.zeros(self.max_slots, np.int32)
         self._budget = np.zeros(self.max_slots, np.int32)
         self._temp = np.full(self.max_slots, self.temperature, np.float32)
+        self._topk = np.zeros(self.max_slots, np.int32)    # 0 = off
+        self._topp = np.ones(self.max_slots, np.float32)   # 1 = off
         self._rid = [None] * self.max_slots
         self._queue: deque = deque()
         self._outputs: Dict = {}
@@ -155,29 +189,39 @@ class DecodeEngine:
         cfg = config
         temp = self.temperature
 
-        def _one_step(params, cache, last, pos, temps, key):
-            # per-slot temperature: each request samples at its own
-            # setting (0 = greedy) inside one batched step — both
-            # branches are computed and a where() picks per row, which
-            # costs one categorical over (B, V), noise next to the
-            # model forward. THE sampling body: _step and _multi_step
-            # both call it, so plain and fused modes cannot drift
+        def _one_step(params, cache, last, pos, temps, topk, topp, key):
+            # per-slot sampling settings: each request samples at its
+            # own temperature (0 = greedy) / top-k / top-p inside one
+            # batched step — all branches are computed and where() picks
+            # per row, one sort + categorical over (B, V), noise next to
+            # the model forward. THE sampling body: _step and
+            # _multi_step both call it, so plain and fused modes cannot
+            # drift. Order matches generate: temperature scales first,
+            # THEN the nucleus is chosen on the scaled logits
             logits, cache = decode_step(params, cache, last, pos, cfg)
             key, sub = jax.random.split(key)
             safe = jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(sub, logits / safe, axis=-1)
+            # the sort/softmax/cumsum filter only runs when some SAMPLED
+            # row asked for it — the default all-greedy engine pays
+            # nothing (one compiled program either way via cond)
+            need = jnp.any(((topk > 0) | (topp < 1.0)) & (temps > 0))
+            filtered = jax.lax.cond(
+                need, lambda x: _filter_logits_rows(x, topk, topp),
+                lambda x: x, logits / safe)
+            sampled = jax.random.categorical(sub, filtered, axis=-1)
             tok = jnp.where(temps > 0, sampled,
                             jnp.argmax(logits, axis=-1))
             return tok.astype(jnp.int32), cache, key
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _step(params, cache, last, pos, temps, key):
-            return _one_step(params, cache, last, pos, temps, key)
+        def _step(params, cache, last, pos, temps, topk, topp, key):
+            return _one_step(params, cache, last, pos, temps, topk, topp,
+                             key)
 
         n_sync = self.steps_per_sync
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _multi_step(params, cache, last, pos, temps, key):
+        def _multi_step(params, cache, last, pos, temps, topk, topp, key):
             # steps_per_sync decode steps in one lax.scan: each slot's
             # chain stays autoregressive (its sampled token feeds the
             # next step), so per-slot output is exactly the solo decode;
@@ -188,7 +232,7 @@ class DecodeEngine:
             def body(carry, _):
                 cache, last, pos, key = carry
                 tok, cache, key = _one_step(params, cache, last, pos,
-                                            temps, key)
+                                            temps, topk, topp, key)
                 return (cache, tok, pos + 1, key), tok
 
             (cache, _, _, key), toks = jax.lax.scan(
@@ -315,20 +359,28 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ queue
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               temperature: Optional[float] = None) -> int:
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
-        ``temperature`` overrides the engine default for THIS request
-        (plain stepping only — speculative mode samples every slot at
-        the engine temperature, since the accept/resample rule is
-        compiled for one setting)."""
-        if temperature is not None:
+        ``temperature``/``top_k``/``top_p`` override the engine defaults
+        for THIS request (plain stepping only — speculative mode samples
+        every slot at the engine temperature, since the accept/resample
+        rule is compiled for one setting)."""
+        if (temperature is not None or top_k is not None
+                or top_p is not None):
             if self.draft_config is not None:
-                raise ValueError("per-request temperature is not "
+                raise ValueError("per-request sampling settings are not "
                                  "supported in speculative mode")
+        if temperature is not None:
             if not (temperature >= 0 and np.isfinite(temperature)):
                 raise ValueError("temperature must be >= 0 and finite, "
                                  f"got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -346,10 +398,29 @@ class DecodeEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append((rid, prompt, int(max_new_tokens),
-                    self.temperature if temperature is None
-                    else float(temperature)))
+                            self.temperature if temperature is None
+                            else float(temperature),
+                            0 if top_k is None else int(top_k),
+                            1.0 if top_p is None else float(top_p)))
         self._admit()
         return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Abort a request: drop it from the queue, or free its slot and
+        discard its partial output. Returns whether anything was
+        cancelled (False for unknown or already-finished ids —
+        :meth:`result` still serves finished ones)."""
+        for i, item in enumerate(self._queue):
+            if item[0] == rid:
+                del self._queue[i]
+                return True
+        for slot, r in enumerate(self._rid):
+            if r == rid:
+                self._outputs.pop(rid, None)
+                self._fresh.pop(rid, None)
+                self._rid[slot] = None
+                return True
+        return False
 
     def _free_slots(self) -> List[int]:
         return [s for s in range(self.max_slots) if self._rid[s] is None]
@@ -358,7 +429,7 @@ class DecodeEngine:
         for slot in self._free_slots():
             if not self._queue:
                 return
-            rid, prompt, max_new, temp = self._queue.popleft()
+            rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
             # exact-length prefill: one compile per distinct prompt
             # length (an online server batches by length bucket upstream
             # if compile churn matters); a registered-prefix hit reuses
@@ -379,7 +450,11 @@ class DecodeEngine:
                     self.draft_cache, d_row, slot)
             if temp > 0:
                 self._key, sub = jax.random.split(self._key)
-                t0 = int(jax.random.categorical(sub, logits / temp))
+                filt = _filter_logits_rows(
+                    logits[None] / temp,
+                    jnp.asarray([topk], jnp.int32),
+                    jnp.asarray([topp], jnp.float32))[0]
+                t0 = int(jax.random.categorical(sub, filt))
             else:
                 t0 = int(jnp.argmax(logits))
             self._rid[slot] = rid
@@ -388,6 +463,8 @@ class DecodeEngine:
             self._last[slot] = t0
             self._budget[slot] = max_new
             self._temp[slot] = temp
+            self._topk[slot] = topk
+            self._topp[slot] = topp
             if self._record(slot, t0):
                 self._fresh[rid] = t0    # surfaced by the next step()
 
@@ -489,7 +566,9 @@ class DecodeEngine:
         if self.steps_per_sync > 1:
             toks, self.cache, self._key = self._multi_step_fn(
                 self.params, self.cache, jnp.asarray(self._last),
-                jnp.asarray(pos), jnp.asarray(self._temp), self._key)
+                jnp.asarray(pos), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                self._key)
             toks = np.asarray(toks)                       # (B, K)
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
@@ -504,7 +583,8 @@ class DecodeEngine:
             return emitted
         toks, self.cache, self._key = self._step_fn(
             self.params, self.cache, jnp.asarray(self._last),
-            jnp.asarray(pos), jnp.asarray(self._temp), self._key)
+            jnp.asarray(pos), jnp.asarray(self._temp),
+            jnp.asarray(self._topk), jnp.asarray(self._topp), self._key)
         toks = np.asarray(toks)
         for slot in np.nonzero(active)[0]:
             rid = self._rid[slot]

@@ -448,3 +448,71 @@ def test_engine_with_tp_sharded_params():
     assert got == expected
     for p, o in zip(prompts, expected):
         assert o == _ref(params, config, p, 8)
+
+
+# -------------------------------------------- per-request sampling knobs
+
+def test_filter_rows_matches_scalar_filter():
+    """The engine's per-row top-k/top-p filter must reproduce the scalar
+    _filter_logits used by generate, for every (k, p) combination."""
+    from elephas_tpu.models.transformer import _filter_logits
+    from elephas_tpu.serving_engine import _filter_logits_rows
+
+    logits = jax.random.normal(jax.random.PRNGKey(5), (4, 32)) * 3
+    for k, p in [(None, None), (5, None), (None, 0.7), (3, 0.9),
+                 (1, None), (None, 1.0), (32, 0.2)]:
+        want = np.asarray(_filter_logits(logits, k, p))
+        got = np.asarray(_filter_logits_rows(
+            logits,
+            jnp.full(4, 0 if k is None else k, jnp.int32),
+            jnp.full(4, 1.0 if p is None else p, jnp.float32)))
+        np.testing.assert_allclose(got, want, err_msg=f"k={k} p={p}")
+
+
+def test_per_request_topk1_equals_greedy(model):
+    """top_k=1 with temperature>0 collapses sampling to argmax — output
+    must equal the greedy solo decode even though the slot 'samples';
+    mixed with a plain greedy request in the same batch."""
+    params, config = model
+    rng = np.random.default_rng(22)
+    p1, p2 = rng.integers(0, 64, 6), rng.integers(0, 64, 9)
+    eng = DecodeEngine(params, config, max_slots=2, seed=3)
+    r1 = eng.submit(p1, 8, temperature=1.0, top_k=1)
+    r2 = eng.submit(p2, 8)                   # engine-default greedy
+    while eng.pending:
+        eng.step()
+    assert eng.result(r1) == _ref(params, config, p1, 8)
+    assert eng.result(r2) == _ref(params, config, p2, 8)
+
+
+def test_per_request_sampling_rejected_in_spec_mode(model):
+    params, config = model
+    eng = DecodeEngine(params, config, max_slots=2, draft_params=params,
+                       draft_config=config)
+    with pytest.raises(ValueError, match="sampling settings"):
+        eng.submit([1, 2, 3], 4, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        DecodeEngine(params, config).submit([1], 4, top_p=1.5)
+
+
+# ---------------------------------------------------------- cancellation
+
+def test_cancel_queued_and_active(model):
+    """Cancelling a queued request prevents admission; cancelling an
+    active one frees its slot for the next queued request; the others'
+    outputs are untouched."""
+    params, config = model
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, 64, int(n)) for n in (5, 7, 4, 6)]
+    eng = DecodeEngine(params, config, max_slots=2)
+    rids = [eng.submit(p, 10) for p in prompts]
+    # rids[0]/rids[1] hold the slots; rids[2]/rids[3] are queued
+    assert eng.cancel(rids[2]) is True       # queued: dropped pre-admission
+    eng.step()
+    assert eng.cancel(rids[1]) is True       # active: slot freed mid-flight
+    while eng.pending:
+        eng.step()
+    assert eng.result(rids[0]) == _ref(params, config, prompts[0], 10)
+    assert eng.result(rids[3]) == _ref(params, config, prompts[3], 10)
+    assert eng.result(rids[1]) is None and eng.result(rids[2]) is None
+    assert eng.cancel(rids[0]) is False      # finished: not cancellable
