@@ -166,7 +166,8 @@ def main(out_dir: str = None):
         nav.append((title, f"{slug}.md"))
         print(f"wrote {slug}.md")
     mkdocs = ["site_name: elephas_tpu", "nav:", "  - Home: index.md",
-              "  - Scaling guide: scaling-guide.md"]
+              "  - Scaling guide: scaling-guide.md",
+              "  - Serving guide: serving-guide.md"]
     mkdocs += [f"  - {title}: {page}" for title, page in nav]
     (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")
     index = ROOT / "README.md"
