@@ -95,6 +95,7 @@ PAGES = [
     ("Draft distillation", "elephas_tpu.models.distill",
      ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
+    ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",
      ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",

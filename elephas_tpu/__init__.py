@@ -24,4 +24,5 @@ from . import models, utils
 from .data import Dataset
 from .serving import TextGenerator
 from .serving_engine import DecodeEngine
+from .serving_http import ServingServer
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

@@ -1,0 +1,249 @@
+"""HTTP serving front-end: an online text/token server over
+:class:`~elephas_tpu.serving_engine.DecodeEngine`.
+
+Transport matches the framework's parameter servers
+(``parameter/server.py``): stdlib ``ThreadingHTTPServer``, typed JSON
+bodies, no web framework. Request handler threads only enqueue/poll;
+ONE background engine thread drives ``step()``, so the device program
+stays single-threaded while requests arrive, finish, and cancel
+concurrently — continuous batching does the interleaving on-device.
+
+Endpoints (JSON in/out):
+
+- ``POST /v1/generate`` — ``{"prompt": [ids...]}`` or ``{"text": "..."}``
+  plus optional ``max_new_tokens``, ``temperature``, ``top_k``,
+  ``top_p``. Blocks until the request finishes; returns
+  ``{"tokens": [...]}`` (and ``"text"`` when a tokenizer is attached).
+- ``POST /v1/submit`` — same body; returns ``{"id": rid}`` immediately.
+- ``GET /v1/result?id=N`` — ``{"status": "pending"}`` until done, then
+  ``{"status": "done", "tokens": [...]}`` (one-shot, like
+  ``DecodeEngine.result``).
+- ``POST /v1/cancel`` — ``{"id": rid}`` → ``{"cancelled": bool}``.
+- ``GET /stats`` — engine counters; ``GET /health`` — liveness.
+
+The reference has no serving server at all (SURVEY.md §2: inference is
+Spark ``mapPartitions``); this is the online half of the framework's
+beyond-parity serving stack.
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["ServingServer"]
+
+_IDLE_SLEEP = 0.005
+
+
+class ServingServer:
+    """Serve a :class:`~elephas_tpu.serving_engine.DecodeEngine` over
+    HTTP.
+
+    :param engine: a constructed engine (any configuration — prefix
+        caching, multi-step, speculative all work; per-request sampling
+        fields are rejected by the engine in speculative mode).
+    :param host, port: bind address (port 0 picks a free port; see
+        :attr:`port` after :meth:`start`).
+    :param tokenizer: optional ``encode``/``decode`` object (e.g.
+        :class:`~elephas_tpu.utils.text.ByteTokenizer`) enabling
+        ``"text"`` requests and text in responses.
+    :param default_max_new_tokens: used when a request omits the field.
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 tokenizer=None, default_max_new_tokens: int = 64,
+                 max_stored_results: int = 1024):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_stored_results = int(max_stored_results)
+        self._host, self._port = host, int(port)
+        self._lock = threading.Lock()          # guards every engine call
+        self._cond = threading.Condition(self._lock)
+        # finished-but-unfetched outputs, insertion-ordered and capped:
+        # a client that submits and never polls must not leak memory for
+        # the life of the server (oldest results evict first)
+        self._results: Dict[int, list] = {}
+        self._tracked: set = set()             # rids the loop must watch
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads = []
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self):
+        """Bind, start the HTTP threads and the engine-step loop."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):      # quiet, like the PS server
+                pass
+
+            def _json(self, code: int, payload: Dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Dict:
+                length = int(self.headers.get("Content-Length", 0))
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/health":
+                    self._json(200, {"status": "ok"})
+                elif url.path == "/stats":
+                    with server._lock:
+                        self._json(200, dict(server.engine.stats))
+                elif url.path == "/v1/result":
+                    rid = parse_qs(url.query).get("id")
+                    try:
+                        rid = int(rid[0]) if rid else None
+                    except ValueError:
+                        rid = None
+                    if rid is None:
+                        self._json(400, {"error": "missing/invalid id"})
+                        return
+                    self._json(200, server._poll(rid))
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                try:
+                    body = self._body()
+                except (ValueError, json.JSONDecodeError):
+                    self._json(400, {"error": "invalid JSON body"})
+                    return
+                try:
+                    if url.path == "/v1/generate":
+                        self._json(200, server._generate(body))
+                    elif url.path == "/v1/submit":
+                        self._json(200, {"id": server._submit(body)})
+                    elif url.path == "/v1/cancel":
+                        self._json(200, server._cancel(body))
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except Exception as exc:  # noqa: BLE001 — malformed-but-
+                    # valid-JSON payloads (wrong types/shapes) and engine
+                    # validation errors all answer a clean 400, never a
+                    # connection drop (the parameter server's convention)
+                    self._json(400, {"error": str(exc)})
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._threads = [
+            threading.Thread(target=self._httpd.serve_forever, daemon=True),
+            threading.Thread(target=self._engine_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- engine
+    def _engine_loop(self):
+        """The single driver of the device program: steps whenever work
+        is pending, harvests finished requests, wakes blocked waiters."""
+        while not self._stop.is_set():
+            with self._cond:
+                if self.engine.pending:
+                    self.engine.step()
+                finished = []
+                for rid in list(self._tracked):
+                    out = self.engine.result(rid)
+                    if out is not None:
+                        self._results[rid] = out
+                        finished.append(rid)
+                if finished:
+                    self._tracked.difference_update(finished)
+                    while len(self._results) > self.max_stored_results:
+                        # abandoned submits: evict oldest unfetched
+                        self._results.pop(next(iter(self._results)))
+                    self._cond.notify_all()
+                idle = not self.engine.pending
+            if idle:
+                time.sleep(_IDLE_SLEEP)
+
+    def _prompt_ids(self, body: Dict):
+        if "prompt" in body:
+            return [int(t) for t in body["prompt"]]
+        if "text" in body:
+            if self.tokenizer is None:
+                raise ValueError('"text" requests need a tokenizer '
+                                 "attached to the server")
+            return self.tokenizer.encode(body["text"])
+        raise ValueError('body needs "prompt" (token ids) or "text"')
+
+    def _submit(self, body: Dict) -> int:
+        ids = self._prompt_ids(body)
+        kwargs = {}
+        for field in ("temperature", "top_k", "top_p"):
+            if body.get(field) is not None:
+                kwargs[field] = body[field]
+        with self._cond:
+            rid = self.engine.submit(
+                ids, int(body.get("max_new_tokens",
+                                  self.default_max_new_tokens)), **kwargs)
+            self._tracked.add(rid)
+            return rid
+
+    def _finish_payload(self, tokens: list) -> Dict:
+        out = {"status": "done", "tokens": tokens}
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(tokens)
+        return out
+
+    def _generate(self, body: Dict) -> Dict:
+        rid = self._submit(body)
+        with self._cond:
+            # exit on completion OR when the rid vanishes (cancelled by
+            # another client, or its result fetched/evicted) — a blocked
+            # handler must never outlive its request
+            while rid not in self._results and rid in self._tracked:
+                self._cond.wait(timeout=0.5)
+                if self._stop.is_set():
+                    raise ValueError("server shutting down")
+            if rid in self._results:
+                return self._finish_payload(self._results.pop(rid))
+            return {"status": "cancelled", "id": rid}
+
+    def _poll(self, rid: int) -> Dict:
+        with self._cond:
+            if rid in self._results:
+                return self._finish_payload(self._results.pop(rid))
+            if rid in self._tracked:
+                return {"status": "pending"}
+            return {"status": "unknown"}
+
+    def _cancel(self, body: Dict) -> Dict:
+        rid = int(body.get("id", -1))
+        with self._cond:
+            cancelled = self.engine.cancel(rid)
+            self._tracked.discard(rid)
+            self._results.pop(rid, None)
+            self._cond.notify_all()   # wake a /v1/generate blocked on rid
+            return {"cancelled": bool(cancelled)}
