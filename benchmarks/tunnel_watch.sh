@@ -54,7 +54,16 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     for i in "${!ROWS[@]}"; do
         have_row "${NAMES[$i]}" || pending=1
     done
-    [ $pending -eq 0 ] && { echo "all rows captured"; exit 0; }
+    if [ $pending -eq 0 ]; then
+        # rows done: refresh the headline bench once so last_good.json
+        # (the driver's fallback if the tunnel is down at round end)
+        # carries this window's numbers, then retire
+        echo "$(date -u +%H:%M:%S) all rows captured; refreshing headline"
+        timeout 1500 python bench.py >/dev/null 2>"$ERRDIR/bench_refresh.err" \
+            && echo "headline refreshed (last_good.json updated)" \
+            || echo "headline refresh failed (kept previous last_good)"
+        exit 0
+    fi
     if probe; then
         echo "$(date -u +%H:%M:%S) tunnel healthy"
         for i in "${!ROWS[@]}"; do
