@@ -59,9 +59,18 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         # (the driver's fallback if the tunnel is down at round end)
         # carries this window's numbers, then retire
         echo "$(date -u +%H:%M:%S) all rows captured; refreshing headline"
-        timeout 1500 python bench.py >/dev/null 2>"$ERRDIR/bench_refresh.err" \
-            && echo "headline refreshed (last_good.json updated)" \
-            || echo "headline refresh failed (kept previous last_good)"
+        # bench.py exits 0 on its stale-fallback path too — only a
+        # non-stale emitted row means last_good.json actually updated
+        out=$(timeout 1500 python bench.py 2>"$ERRDIR/bench_refresh.err" | tail -1)
+        if [ -n "$out" ] && python -c '
+import json, sys
+row = json.loads(sys.argv[1])
+assert not row.get("stale"), "stale fallback"
+' "$out" 2>>"$ERRDIR/bench_refresh.err"; then
+            echo "headline refreshed (last_good.json updated)"
+        else
+            echo "headline refresh failed/stale (kept previous last_good)"
+        fi
         exit 0
     fi
     if probe; then

@@ -1649,6 +1649,21 @@ def decode_block(params: Dict, cache: Dict, tokens: jnp.ndarray, pos0,
     return logits, new_cache
 
 
+def chunked_blocks(block_fn, cache, tokens, pos0: int, chunk: int):
+    """Thread ``(logits, cache)`` through ``block_fn`` over
+    ``chunk``-sized column slices of ``tokens`` ``(B, T)`` starting at
+    position ``pos0``. ``block_fn(cache, block, start_pos, is_first) ->
+    (logits, cache)``; returns the LAST block's logits and the final
+    cache. THE chunk loop — :func:`prefill_cache_chunked` and the
+    serving engine's chunked admission both ride it, so chunk-boundary
+    semantics live in one place."""
+    logits = None
+    for start in range(0, tokens.shape[1], chunk):
+        logits, cache = block_fn(cache, tokens[:, start:start + chunk],
+                                 pos0 + start, start == 0)
+    return logits, cache
+
+
 def prefill_cache_chunked(params: Dict, tokens: jnp.ndarray,
                           config: TransformerConfig, max_len: int,
                           chunk: int = 512) -> Tuple[jnp.ndarray, Dict]:
@@ -1663,12 +1678,11 @@ def prefill_cache_chunked(params: Dict, tokens: jnp.ndarray,
     natural (smaller) size, costing at most one extra compile.
     """
     c = config
-    b, t = tokens.shape
-    cache = init_kv_cache(c, b, max_len)
-    logits = None
-    for start in range(0, t, chunk):
-        blk = tokens[:, start:start + chunk]
-        logits, cache = decode_block(params, cache, blk, start, c)
+    b, _ = tokens.shape
+    logits, cache = chunked_blocks(
+        lambda cache, blk, pos, _first: decode_block(params, cache, blk,
+                                                     pos, c),
+        init_kv_cache(c, b, max_len), tokens, 0, chunk)
     return logits[:, -1], cache
 
 

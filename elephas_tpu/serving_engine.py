@@ -120,6 +120,13 @@ class DecodeEngine:
         admission/retirement happen every ``steps_per_sync`` tokens, and
         a slot that hits eos/budget mid-chunk wastes the remainder.
         Per-slot output is still exactly its solo greedy decode.
+    :param prefill_chunk: when set, admission prefills prompts in
+        fixed ``prefill_chunk``-token blocks (plus one natural-size
+        tail), so jit compilation stops scaling with distinct prompt
+        lengths: an online server sees at most ``prefill_chunk`` block
+        shapes ever, instead of one compile per new length. Numerically
+        identical to whole-prompt prefill; composes with prefix caching
+        (the suffix is what gets chunked).
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
@@ -127,7 +134,8 @@ class DecodeEngine:
                  temperature: float = 0.0, eos_id: Optional[int] = None,
                  seed: int = 0, draft_params: Optional[Dict] = None,
                  draft_config: Optional[TransformerConfig] = None,
-                 gamma: int = 4, steps_per_sync: int = 1):
+                 gamma: int = 4, steps_per_sync: int = 1,
+                 prefill_chunk: Optional[int] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -156,6 +164,10 @@ class DecodeEngine:
         self.steps_per_sync = int(steps_per_sync)
         if self.steps_per_sync < 1:
             raise ValueError("steps_per_sync must be >= 1")
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         if self.steps_per_sync > 1 and draft_config is not None:
             raise ValueError("steps_per_sync > 1 applies to plain "
                              "stepping; speculative mode already "
@@ -256,23 +268,30 @@ class DecodeEngine:
             # "one compile per distinct prompt length" admission cost
             return prefill_cache(params, prompt, cfg, max_len)
 
-        def _make_extend(xcfg):
-            @jax.jit
+        def _make_extend(xcfg, donate=False):
+            # two variants: the non-donating one serves shared prefix
+            # entries (reused by every admission that hits them); the
+            # donating one serves engine-OWNED rows — fresh prefill rows
+            # and every chunk after the first — so chunked admission
+            # rewrites one buffer instead of copying the full row cache
+            # per block
             def _extend(params, row_cache, suffix, pos0):
-                # continue a batch-1 prefill past a cached prefix: the
-                # suffix attends to the prefix's k/v already in the row
-                # cache (row_cache is NOT donated — it is the shared
-                # prefix entry, reused by every admission that hits it)
+                # continue a batch-1 prefill past what the row cache
+                # already holds: the suffix attends to the cached k/v
                 logits, row_cache = decode_block(params, row_cache,
                                                  suffix, pos0, xcfg)
                 return logits[:, -1], row_cache
-            return _extend
+            if donate:
+                return partial(jax.jit, donate_argnums=(1,))(_extend)
+            return jax.jit(_extend)
 
         self._step_fn = _step
         self._multi_step_fn = _multi_step
         self._install_fn = _install
         self._prefill_fn = _prefill
         self._extend_fn = _make_extend(cfg)
+        self._extend_owned_fn = _make_extend(cfg, donate=True)
+        self._fresh_row_fn = lambda: init_kv_cache(cfg, 1, max_len)
         # registered shared prompt prefixes, longest first:
         # (tokens, last-position logits, target row cache, draft row cache)
         self._prefixes: List = []
@@ -303,6 +322,9 @@ class DecodeEngine:
             self._install_draft_fn = _install
             self._prefill_draft_fn = _prefill_draft
             self._extend_draft_fn = _make_extend(dcfg)
+            self._extend_draft_owned_fn = _make_extend(dcfg, donate=True)
+            self._fresh_draft_row_fn = lambda: init_kv_cache(dcfg, 1,
+                                                             max_len)
 
     # ---------------------------------------------------------- prefixes
     def register_prefix(self, tokens: Sequence[int]) -> None:
@@ -321,12 +343,25 @@ class DecodeEngine:
         if tokens.size >= self.max_len:
             raise ValueError(f"prefix ({tokens.size}) must leave room "
                              f"below max_len {self.max_len}")
-        logits, row = self._prefill_fn(self.params,
-                                       jnp.asarray(tokens[None]))
+        if self.prefill_chunk is not None:
+            # registration rides the same bounded block shapes as
+            # admission — distinct prefix lengths cost no new compiles
+            logits, row = self._extend_chunked(
+                self.params, self._fresh_row_fn(), tokens, 0,
+                self._extend_fn, self._extend_owned_fn, owned=True)
+        else:
+            logits, row = self._prefill_fn(self.params,
+                                           jnp.asarray(tokens[None]))
         d_row = None
         if self.draft_config is not None:
-            _, d_row = self._prefill_draft_fn(self.draft_params,
-                                              jnp.asarray(tokens[None]))
+            if self.prefill_chunk is not None:
+                _, d_row = self._extend_chunked(
+                    self.draft_params, self._fresh_draft_row_fn(),
+                    tokens, 0, self._extend_draft_fn,
+                    self._extend_draft_owned_fn, owned=True)
+            else:
+                _, d_row = self._prefill_draft_fn(
+                    self.draft_params, jnp.asarray(tokens[None]))
         self._prefixes.append((tokens, logits[0], row, d_row))
         self._prefixes.sort(key=lambda e: -e[0].size)
 
@@ -341,17 +376,46 @@ class DecodeEngine:
                 return entry
         return None
 
+    def _extend_chunked(self, params, row, tokens: np.ndarray, pos0: int,
+                        extend_fn, extend_owned_fn, owned: bool):
+        """Feed ``tokens`` (1-D) through the extend fns in
+        ``prefill_chunk``-sized blocks — at most ``prefill_chunk``
+        distinct block shapes ever compile, regardless of how many
+        prompt lengths an online server sees. ``owned`` marks the INPUT
+        row as engine-owned (donatable); blocks after the first always
+        operate on engine-owned intermediates."""
+        from .models.transformer import chunked_blocks
+
+        def block(cache, blk, pos, first):
+            fn = extend_owned_fn if (owned or not first) else extend_fn
+            return fn(params, cache, jnp.asarray(blk), jnp.int32(pos))
+
+        return chunked_blocks(block, row, tokens[None], int(pos0),
+                              self.prefill_chunk)
+
     def _prefill_with_prefixes(self, prompt: np.ndarray, extend_fn,
-                               prefill_fn, params, entry, cache_idx: int):
+                               extend_owned_fn, prefill_fn, params, entry,
+                               cache_idx: int, fresh_fn):
         """Batch-1 prefill that reuses a matched prefix entry's cache row.
         Returns (last-position logits (vocab,), row cache)."""
+        chunked = self.prefill_chunk is not None
         if entry is None:
+            if chunked:
+                logits, row = self._extend_chunked(
+                    params, fresh_fn(), prompt, 0, extend_fn,
+                    extend_owned_fn, owned=True)
+                return logits[0], row
             logits, row = prefill_fn(params, jnp.asarray(prompt[None]))
             return logits[0], row
         ptoks, plogits = entry[0], entry[1]
         row = entry[cache_idx]
         if prompt.size == ptoks.size:
             return plogits, row
+        if chunked:
+            logits, row = self._extend_chunked(
+                params, row, prompt[ptoks.size:], int(ptoks.size),
+                extend_fn, extend_owned_fn, owned=False)
+            return logits[0], row
         suffix = jnp.asarray(prompt[None, ptoks.size:])
         logits, row = extend_fn(params, row, suffix,
                                 jnp.int32(ptoks.size))
@@ -439,13 +503,15 @@ class DecodeEngine:
                 self._n_prefix_hits += 1
                 self._n_prefix_tokens += int(entry[0].size)
             logits, row_cache = self._prefill_with_prefixes(
-                prompt, self._extend_fn, self._prefill_fn, self.params,
-                entry, 2)
+                prompt, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, entry, 2,
+                self._fresh_row_fn)
             self.cache = self._install_fn(self.cache, row_cache, slot)
             if self.draft_config is not None:
                 _, d_row = self._prefill_with_prefixes(
-                    prompt, self._extend_draft_fn, self._prefill_draft_fn,
-                    self.draft_params, entry, 3)
+                    prompt, self._extend_draft_fn,
+                    self._extend_draft_owned_fn, self._prefill_draft_fn,
+                    self.draft_params, entry, 3, self._fresh_draft_row_fn)
                 self.draft_cache = self._install_draft_fn(
                     self.draft_cache, d_row, slot)
             if temp > 0:

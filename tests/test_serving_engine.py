@@ -516,3 +516,61 @@ def test_cancel_queued_and_active(model):
     assert eng.result(rids[3]) == _ref(params, config, prompts[3], 10)
     assert eng.result(rids[1]) is None and eng.result(rids[2]) is None
     assert eng.cancel(rids[0]) is False      # finished: not cancellable
+
+
+# ------------------------------------------------------- chunked prefill
+
+def test_prefill_chunk_parity_and_bounded_compiles(model):
+    """prefill_chunk=4: many distinct prompt lengths must (a) produce
+    exactly the unchunked engine's outputs, and (b) compile at most
+    `chunk` distinct extend-block shapes — admission cost stops scaling
+    with prompt-length diversity."""
+    params, config = model
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, 64, int(n))
+               for n in (3, 4, 5, 7, 8, 9, 11, 13)]
+
+    plain = DecodeEngine(params, config, max_slots=2)
+    chunked = DecodeEngine(params, config, max_slots=2, prefill_chunk=4)
+    expected = plain.run(prompts, max_new_tokens=6)
+    got = chunked.run(prompts, max_new_tokens=6)
+    assert got == expected
+    for p, o in zip(prompts, expected):
+        assert o == _ref(params, config, p, 6)
+    # block shapes seen: 4 (full) + tails {3, 1, 2} -> ≤ chunk compiles
+    # (fresh rows are engine-owned, so blocks ride the donating variant)
+    assert (chunked._extend_owned_fn._cache_size()
+            + chunked._extend_fn._cache_size()) <= 4
+    # the whole-prompt prefill path was never compiled
+    assert chunked._prefill_fn._cache_size() == 0
+
+
+def test_prefill_chunk_composes_with_prefix_cache(model):
+    params, config = model
+    rng = np.random.default_rng(32)
+    prefix = list(rng.integers(0, 64, 6))
+    prompts = [np.asarray(prefix + list(rng.integers(0, 64, int(n))))
+               for n in (2, 5, 9)]
+    eng = DecodeEngine(params, config, max_slots=2, prefill_chunk=3)
+    eng.register_prefix(prefix)
+    outs = eng.run(prompts, max_new_tokens=7)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 7)
+    assert eng.stats["prefix_hits"] == 3
+
+
+def test_prefill_chunk_speculative_prefix(model):
+    """prefill_chunk + speculative + prefix registration: target AND
+    draft caches both ride the chunked block path; output ≡ solo."""
+    params, config = model
+    draft_params = init_params(config, jax.random.PRNGKey(9))
+    rng = np.random.default_rng(33)
+    prefix = list(rng.integers(0, 64, 7))
+    prompt = np.asarray(prefix + list(rng.integers(0, 64, 4)))
+    eng = DecodeEngine(params, config, max_slots=2, prefill_chunk=3,
+                       draft_params=draft_params, draft_config=config,
+                       gamma=3)
+    eng.register_prefix(prefix)
+    [out] = eng.run([prompt], max_new_tokens=6)
+    assert out == _ref(params, config, prompt, 6)
+    assert eng.stats["prefix_hits"] == 1
