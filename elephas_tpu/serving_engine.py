@@ -51,8 +51,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.transformer import (NEG_INF, TransformerConfig, decode_block,
-                                 decode_step, init_kv_cache, prefill_cache)
+from .models.transformer import (NEG_INF, TransformerConfig, chunked_blocks,
+                                 decode_block, decode_step, init_kv_cache,
+                                 prefill_cache)
 
 
 def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
@@ -384,8 +385,6 @@ class DecodeEngine:
         prompt lengths an online server sees. ``owned`` marks the INPUT
         row as engine-owned (donatable); blocks after the first always
         operate on engine-owned intermediates."""
-        from .models.transformer import chunked_blocks
-
         def block(cache, blk, pos, first):
             fn = extend_owned_fn if (owned or not first) else extend_fn
             return fn(params, cache, jnp.asarray(blk), jnp.int32(pos))

@@ -14,6 +14,10 @@ Endpoints (JSON in/out):
   plus optional ``max_new_tokens``, ``temperature``, ``top_k``,
   ``top_p``. Blocks until the request finishes; returns
   ``{"tokens": [...]}`` (and ``"text"`` when a tokenizer is attached).
+  With ``"stream": true`` the response is newline-delimited JSON
+  written as tokens are emitted — ``{"tokens": [...]}`` lines followed
+  by a final ``{"status": "done"|"cancelled"}`` line (connection-close
+  delimited).
 - ``POST /v1/submit`` — same body; returns ``{"id": rid}`` immediately.
 - ``GET /v1/result?id=N`` — ``{"status": "pending"}`` until done, then
   ``{"status": "done", "tokens": [...]}`` (one-shot, like
@@ -67,6 +71,7 @@ class ServingServer:
         # the life of the server (oldest results evict first)
         self._results: Dict[int, list] = {}
         self._tracked: set = set()             # rids the loop must watch
+        self._streams: Dict[int, list] = {}    # live token feeds
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads = []
@@ -126,6 +131,29 @@ class ServingServer:
                     self._json(400, {"error": "invalid JSON body"})
                     return
                 try:
+                    if url.path == "/v1/generate" and body.get("stream"):
+                        # submit FIRST: validation errors still answer a
+                        # clean 400 before any bytes of the stream
+                        rid = server._submit(body, stream=True)
+                        try:
+                            self.send_response(200)
+                            self.send_header("Content-Type",
+                                             "application/x-ndjson")
+                            self.end_headers()
+
+                            def line(payload):
+                                self.wfile.write(
+                                    (json.dumps(payload) + "\n").encode())
+                                self.wfile.flush()
+
+                            server._run_stream(rid, line)
+                        except Exception:  # noqa: BLE001 — client gone
+                            # mid-stream: the status line is already on
+                            # the wire, so no 400 can follow; cancel the
+                            # in-flight request instead of decoding for
+                            # nobody
+                            server._abort_stream(rid)
+                        return
                     if url.path == "/v1/generate":
                         self._json(200, server._generate(body))
                     elif url.path == "/v1/submit":
@@ -170,8 +198,14 @@ class ServingServer:
         is pending, harvests finished requests, wakes blocked waiters."""
         while not self._stop.is_set():
             with self._cond:
+                emitted = {}
                 if self.engine.pending:
-                    self.engine.step()
+                    emitted = self.engine.step()
+                for rid, toks in emitted.items():
+                    if rid in self._streams:
+                        self._streams[rid].extend(toks)
+                if emitted:
+                    self._cond.notify_all()
                 finished = []
                 for rid in list(self._tracked):
                     out = self.engine.result(rid)
@@ -198,7 +232,7 @@ class ServingServer:
             return self.tokenizer.encode(body["text"])
         raise ValueError('body needs "prompt" (token ids) or "text"')
 
-    def _submit(self, body: Dict) -> int:
+    def _submit(self, body: Dict, stream: bool = False) -> int:
         ids = self._prompt_ids(body)
         kwargs = {}
         for field in ("temperature", "top_k", "top_p"):
@@ -209,7 +243,57 @@ class ServingServer:
                 ids, int(body.get("max_new_tokens",
                                   self.default_max_new_tokens)), **kwargs)
             self._tracked.add(rid)
+            if stream:
+                # registered under the SAME lock as submit, so the very
+                # first engine-loop step already routes into the feed
+                self._streams[rid] = []
             return rid
+
+    def _run_stream(self, rid: int, write_line):
+        """Relay a request's tokens to ``write_line`` as the engine
+        emits them; terminates with a status line on completion,
+        cancellation, or server shutdown. Writes happen OUTSIDE the
+        condition lock — a stalled client must never hold up the
+        server-wide lock on backpressure."""
+        try:
+            while True:
+                stopping = False
+                with self._cond:
+                    while (not self._streams.get(rid)
+                           and rid in self._tracked
+                           and rid not in self._results):
+                        self._cond.wait(timeout=0.5)
+                        if self._stop.is_set():
+                            stopping = True
+                            break
+                    toks = self._streams.get(rid) or []
+                    if toks:
+                        self._streams[rid] = []
+                    done = rid in self._results
+                    if done:
+                        self._results.pop(rid)  # consumed via the feed
+                    gone = not done and rid not in self._tracked
+                if toks:
+                    write_line({"tokens": toks})
+                if done:
+                    write_line({"status": "done"})
+                    return
+                if stopping or (gone and not toks):
+                    write_line({"status": "cancelled"})
+                    return
+        finally:
+            with self._cond:
+                self._streams.pop(rid, None)
+
+    def _abort_stream(self, rid: int):
+        """Server-side teardown for a stream whose client went away:
+        cancel the in-flight request and drop every trace of it."""
+        with self._cond:
+            self.engine.cancel(rid)
+            self._tracked.discard(rid)
+            self._results.pop(rid, None)
+            self._streams.pop(rid, None)
+            self._cond.notify_all()
 
     def _finish_payload(self, tokens: list) -> Dict:
         out = {"status": "done", "tokens": tokens}

@@ -166,3 +166,100 @@ def test_result_invalid_id_is_400(model):
         with pytest.raises(urllib.error.HTTPError) as exc:
             _post(srv.port, "/v1/generate", {"prompt": 5})
         assert exc.value.code == 400           # wrong type -> clean 400
+
+
+def test_streaming_generate(model):
+    """stream:true delivers newline-delimited token chunks incrementally;
+    their concatenation is exactly the solo greedy decode, terminated by
+    a done line."""
+    params, config = model
+    rng = np.random.default_rng(3)
+    prompt = [int(t) for t in rng.integers(0, 300, 5)]
+    with ServingServer(DecodeEngine(params, config, max_slots=2)) as srv:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            data=json.dumps({"prompt": prompt, "max_new_tokens": 10,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            for raw in resp:
+                lines.append(json.loads(raw))
+        assert lines[-1] == {"status": "done"}
+        token_lines = [ln["tokens"] for ln in lines[:-1]]
+        assert len(token_lines) >= 2          # incremental, not one blob
+        streamed = [t for chunk in token_lines for t in chunk]
+        assert streamed == _ref(params, config, prompt, 10)
+        # streamed requests never linger in the poll store
+        assert _get(srv.port, f"/v1/result?id=0")["status"] == "unknown"
+
+
+def test_streaming_cancel_terminates(model):
+    import time
+
+    params, config = model
+    rng = np.random.default_rng(4)
+    with ServingServer(DecodeEngine(params, config, max_slots=1)) as srv:
+        # slot occupied -> the streamed request queues; cancel it
+        _post(srv.port, "/v1/submit",
+              {"prompt": [int(t) for t in rng.integers(0, 300, 4)],
+               "max_new_tokens": 40})
+        box = {}
+
+        def streamer():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/generate",
+                data=json.dumps(
+                    {"prompt": [int(t) for t in rng.integers(0, 300, 6)],
+                     "max_new_tokens": 30, "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                box["lines"] = [json.loads(raw) for raw in resp]
+
+        t = threading.Thread(target=streamer)
+        t.start()
+        deadline = time.time() + 60
+        while srv.engine._next_rid < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert _post(srv.port, "/v1/cancel", {"id": 1})["cancelled"]
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert box["lines"][-1]["status"] in ("cancelled", "done")
+
+
+def test_stream_client_disconnect_cancels_request(model):
+    """A client that drops mid-stream must not keep its slot decoding
+    for nobody: the handler aborts the request server-side and every
+    trace (slot, stream feed, stored result) is released."""
+    import socket
+    import time
+
+    params, config = model
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(0, 300, 4)]
+    with ServingServer(DecodeEngine(params, config, max_slots=1)) as srv:
+        body = json.dumps({"prompt": prompt, "max_new_tokens": 40,
+                           "stream": True}).encode()
+        raw = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+        raw.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/json\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body)
+        raw.recv(1)               # first byte of the response arrived
+        raw.close()               # client vanishes mid-stream
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with srv._cond:
+                if (all(r is None for r in srv.engine._rid)
+                        and not srv.engine._queue and not srv._streams):
+                    break
+            time.sleep(0.05)
+        with srv._cond:
+            assert all(r is None for r in srv.engine._rid), \
+                "slot still decoding for a dead client"
+            assert not srv._streams
+        # the server still serves live clients afterwards
+        out = _post(srv.port, "/v1/generate",
+                    {"prompt": prompt, "max_new_tokens": 5})
+        assert out["tokens"] == _ref(params, config, prompt, 5)
