@@ -96,6 +96,8 @@ PAGES = [
      ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
+    ("Paged KV cache", "elephas_tpu.models.paged_decode",
+     ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",
      ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",

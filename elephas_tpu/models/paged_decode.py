@@ -1,0 +1,193 @@
+"""Paged KV cache: block-pool decode for memory-oversubscribed serving.
+
+The engine's default cache gives every slot a contiguous
+``max_len``-row strip — simple and fastest, but memory is reserved for
+the worst case: ``max_slots × max_len`` positions whether requests use
+them or not. Paged mode (vLLM's PagedAttention memory model) allocates
+cache in fixed ``block_size``-position blocks from one shared pool;
+each slot holds a small block table. Capacity then scales with TOKENS
+IN FLIGHT, not worst-case sequence length — short requests and early
+eos retirements return their blocks immediately, so a pool far smaller
+than ``max_slots × max_len`` serves the same traffic (admission simply
+queues when the pool is momentarily empty).
+
+The trade: each step gathers the slot's blocks into attention order
+(one extra O(cache) HBM pass versus reading a contiguous strip), so
+paged mode is a CAPACITY lever, not a speed lever — exactly like the
+int8 KV cache (BASELINE.md decode row). Use it when concurrency ×
+max_len exceeds HBM, not to make a fitting workload faster.
+
+Math mirrors :func:`~elephas_tpu.models.transformer.decode_block`
+(S=1) exactly — same norms, RoPE convention, GQA grouping,
+window/ALiBi masks — pinned by parity tests against the contiguous
+engine. Safety invariant: block id 0 is a reserved scratch sink that
+is never allocated; freed slots' tables are reset to 0, so an inactive
+slot's garbage decode (the engine's static-batch idiom) can never
+write into a block owned by a live request.
+
+Not supported in paged mode (constructor raises): ``kv_cache_quant``
+(compose the int8 cache with the contiguous engine instead) and MoE
+layers.
+"""
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
+                          _apply_rope, _mlp_apply, _norm,
+                          _sinusoidal_table, head_logits)
+
+__all__ = ["init_paged_pool", "decode_step_paged", "install_row_paged",
+           "validate_paged_config"]
+
+
+def validate_paged_config(config: TransformerConfig):
+    if config.kv_cache_quant:
+        raise ValueError("paged KV mode does not compose with "
+                         "kv_cache_quant; use the contiguous engine for "
+                         "the int8 cache")
+    if config.num_experts > 1:
+        raise ValueError("paged KV mode does not support MoE layers")
+
+
+def init_paged_pool(config: TransformerConfig, num_blocks: int,
+                    block_size: int) -> Dict:
+    """Shared block pool: per layer ``k``/``v`` of shape
+    ``(num_blocks, kv_heads, block_size, head_dim)``. Block 0 is the
+    reserved scratch sink (allocators must hand out ids >= 1)."""
+    validate_paged_config(config)
+    c = config
+    shape = (num_blocks, c.kv_heads, block_size, c.head_dim)
+    return {f"layer_{i}": {"k": jnp.zeros(shape, c.dtype),
+                           "v": jnp.zeros(shape, c.dtype)}
+            for i in range(c.num_layers)}
+
+
+def install_row_paged(pool: Dict, row_cache: Dict, block_ids,
+                      nblocks: int) -> Dict:
+    """Scatter a contiguous batch-1 prefill row into pool blocks:
+    positions ``[0, nblocks*block_size)`` of ``row_cache`` land in
+    ``block_ids[:nblocks]``. One jit specialization per ``nblocks``
+    (bounded by the per-slot table width)."""
+    return _install_jit(pool, row_cache, jnp.asarray(block_ids),
+                        nblocks)
+
+
+def _install(pool, row_cache, block_ids, nblocks: int):
+    out = {}
+    for name, lc in pool.items():
+        bs = lc["k"].shape[2]
+
+        def to_blocks(row):                      # (H, L, D) -> blocks
+            h, length, d = row.shape
+            take = min(nblocks * bs, length)
+            chunk = row[:, :take]
+            if take < nblocks * bs:
+                # max_len need not divide block_size: the final block's
+                # tail holds zero padding that no position ever reads
+                # (every valid position is < max_len)
+                chunk = jnp.pad(chunk,
+                                ((0, 0), (0, nblocks * bs - take),
+                                 (0, 0)))
+            return chunk.reshape(h, nblocks, bs, d)
+
+        chunk_k = to_blocks(row_cache[name]["k"][0])
+        chunk_v = to_blocks(row_cache[name]["v"][0])
+        ids = block_ids[:nblocks]
+        out[name] = {
+            "k": lc["k"].at[ids].set(jnp.swapaxes(chunk_k, 0, 1)),
+            "v": lc["v"].at[ids].set(jnp.swapaxes(chunk_v, 0, 1))}
+    return out
+
+
+_install_jit = jax.jit(_install, static_argnums=(3,),
+                       donate_argnums=(0,))
+
+
+def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,
+                      tokens: jnp.ndarray, pos,
+                      config: TransformerConfig) -> Tuple[jnp.ndarray,
+                                                          Dict]:
+    """One autoregressive step over the block pool: token ids ``(B,)``
+    at per-row positions ``pos`` ``(B,)``; ``tables`` is ``(B,
+    max_blocks)`` of block ids. Returns (logits ``(B, vocab)``, updated
+    pool). The paged mirror of
+    :func:`~elephas_tpu.models.transformer.decode_step`."""
+    c = config
+    b = tokens.shape[0]
+    first = next(iter(pool.values()))["k"]
+    bs = first.shape[2]
+    mb = tables.shape[1]
+    length = mb * bs                               # gathered view length
+    pos = jnp.asarray(pos)
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]        # (B,) owning block
+    off = pos % bs
+
+    x = params["embed"]["tokens"][tokens]          # (B, D)
+    if c.positional == "learned":
+        x = x + params["embed"]["pos"][pos]
+    elif c.positional == "sinusoidal":
+        x = x + _sinusoidal_table(pos, c.d_model)
+    x = x.astype(c.dtype)[:, None]                 # (B, 1, D)
+
+    kpos = jnp.arange(length)
+    mask = kpos[None, :] <= pos[:, None]           # (B, L)
+    if c.attention_window is not None:
+        mask = mask & (kpos[None, :] > (pos[:, None]
+                                        - c.attention_window))
+    scale = 1.0 / math.sqrt(c.head_dim)
+    rp = pos[:, None, None]                        # (B, 1, 1) rope angles
+    groups = c.num_heads // c.kv_heads
+    hidx = jnp.arange(c.kv_heads)
+    new_pool: Dict = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        h = _norm(x, layer["ln1"], c).astype(c.dtype)
+        q = jnp.einsum("bsd,dhk->bhsk", h,
+                       layer["attn"]["wq"].astype(c.dtype))
+        k_new = jnp.einsum("bsd,dhk->bhsk", h,
+                           layer["attn"]["wk"].astype(c.dtype))
+        v_new = jnp.einsum("bsd,dhk->bhsk", h,
+                           layer["attn"]["wv"].astype(c.dtype))
+        if c.positional == "rope":
+            q = _apply_rope(q, rp, c)
+            k_new = _apply_rope(k_new, rp, c)
+
+        lc = pool[f"layer_{i}"]
+        # scatter this position's k/v into each row's owning block:
+        # target (block, head, offset) per (b, h)
+        widx = (blk[:, None], hidx[None, :], off[:, None])
+        pk = lc["k"].at[widx].set(k_new[:, :, 0])
+        pv = lc["v"].at[widx].set(v_new[:, :, 0])
+        new_pool[f"layer_{i}"] = {"k": pk, "v": pv}
+
+        # gather each row's blocks into attention order: (B, MB, H, bs,
+        # D) -> (B, H, MB*bs, D). The one extra O(cache) pass paged
+        # mode pays; positions beyond the row's allocation land on
+        # stale/scratch data and are masked
+        ck = jnp.swapaxes(pk[tables], 1, 2).reshape(
+            b, c.kv_heads, length, c.head_dim)
+        cv = jnp.swapaxes(pv[tables], 1, 2).reshape(
+            b, c.kv_heads, length, c.head_dim)
+
+        qg = q.reshape(b, c.kv_heads, groups, 1, c.head_dim)
+        scores = jnp.einsum("bngsk,bntk->bngst", qg, ck) * scale
+        if c.positional == "alibi":
+            dist = (pos[:, None] - kpos[None, :]).astype(jnp.float32)
+            ab = (-_alibi_slopes(c.num_heads)[None, :, None, None]
+                  * dist[:, None, None]).reshape(b, c.kv_heads, groups,
+                                                 1, length)
+            scores = scores + ab
+        scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bngst,bntk->bngsk", weights, cv)
+        o = o.reshape(b, c.num_heads, 1, c.head_dim)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o,
+                           layer["attn"]["wo"].astype(c.dtype))
+        x = _mlp_apply(layer, x, c)
+    logits = head_logits(params["embed"], params["final_ln"], x[:, 0],
+                         head=params.get("head"), norm=c.norm)
+    return logits, new_pool

@@ -45,7 +45,7 @@ continuous batching is a beyond-parity serving feature.
 """
 from collections import deque
 from functools import partial
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -128,6 +128,16 @@ class DecodeEngine:
         shapes ever, instead of one compile per new length. Numerically
         identical to whole-prompt prefill; composes with prefix caching
         (the suffix is what gets chunked).
+    :param paged: ``(num_blocks, block_size)`` switches the KV cache to
+        a shared block pool with per-slot block tables (vLLM's paged
+        memory model): cache memory scales with tokens in flight
+        instead of ``max_slots × max_len``, requests queue while the
+        pool is momentarily empty, and blocks return on retirement —
+        a CAPACITY lever for oversubscribed serving (each step pays one
+        extra gather pass over the cache; see
+        :mod:`~elephas_tpu.models.paged_decode`). Composes with prefix
+        caching, chunked prefill, and multi-step; not with speculative
+        mode, ``kv_cache_quant``, or MoE.
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
@@ -136,7 +146,8 @@ class DecodeEngine:
                  seed: int = 0, draft_params: Optional[Dict] = None,
                  draft_config: Optional[TransformerConfig] = None,
                  gamma: int = 4, steps_per_sync: int = 1,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 paged: Optional[Tuple[int, int]] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -169,12 +180,40 @@ class DecodeEngine:
                               else int(prefill_chunk))
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        self.paged = None
+        if paged is not None:
+            from .models.paged_decode import validate_paged_config
+
+            num_blocks, block_size = int(paged[0]), int(paged[1])
+            if draft_config is not None:
+                raise ValueError("paged KV mode does not compose with "
+                                 "speculative stepping")
+            validate_paged_config(config)
+            if block_size < 1 or num_blocks < 2:
+                raise ValueError("paged needs block_size >= 1 and "
+                                 "num_blocks >= 2 (block 0 is the "
+                                 "reserved scratch sink)")
+            self.paged = (num_blocks, block_size)
+            # per-slot table width: enough blocks to cover max_len
+            self._mb = -(-self.max_len // block_size)
         if self.steps_per_sync > 1 and draft_config is not None:
             raise ValueError("steps_per_sync > 1 applies to plain "
                              "stepping; speculative mode already "
                              "amortizes dispatches via draft rounds")
         self._key = jax.random.PRNGKey(seed)
-        self.cache = init_kv_cache(config, self.max_slots, self.max_len)
+        if self.paged is not None:
+            from .models.paged_decode import init_paged_pool
+
+            nb, bsz = self.paged
+            self.cache = None        # the pool replaces the contiguous cache
+            self.pool = init_paged_pool(config, nb, bsz)
+            self._tables = np.zeros((self.max_slots, self._mb), np.int32)
+            self._free_block_ids = deque(range(1, nb))  # 0 = scratch
+            self._slot_blocks: List[List[int]] = [
+                [] for _ in range(self.max_slots)]
+        else:
+            self.cache = init_kv_cache(config, self.max_slots,
+                                       self.max_len)
         self.draft_cache = (init_kv_cache(draft_config, self.max_slots,
                                           self.max_len)
                             if draft_config is not None else None)
@@ -202,16 +241,15 @@ class DecodeEngine:
         cfg = config
         temp = self.temperature
 
-        def _one_step(params, cache, last, pos, temps, topk, topp, key):
+        def _sample_tok(logits, temps, topk, topp, key):
             # per-slot sampling settings: each request samples at its
             # own temperature (0 = greedy) / top-k / top-p inside one
             # batched step — all branches are computed and where() picks
             # per row, one sort + categorical over (B, V), noise next to
-            # the model forward. THE sampling body: _step and
-            # _multi_step both call it, so plain and fused modes cannot
+            # the model forward. THE sampling body: every step variant
+            # (plain/fused, contiguous/paged) calls it, so modes cannot
             # drift. Order matches generate: temperature scales first,
             # THEN the nucleus is chosen on the scaled logits
-            logits, cache = decode_step(params, cache, last, pos, cfg)
             key, sub = jax.random.split(key)
             safe = jnp.maximum(temps, 1e-6)[:, None]
             # the sort/softmax/cumsum filter only runs when some SAMPLED
@@ -224,7 +262,12 @@ class DecodeEngine:
             sampled = jax.random.categorical(sub, filtered, axis=-1)
             tok = jnp.where(temps > 0, sampled,
                             jnp.argmax(logits, axis=-1))
-            return tok.astype(jnp.int32), cache, key
+            return tok.astype(jnp.int32), key
+
+        def _one_step(params, cache, last, pos, temps, topk, topp, key):
+            logits, cache = decode_step(params, cache, last, pos, cfg)
+            tok, key = _sample_tok(logits, temps, topk, topp, key)
+            return tok, cache, key
 
         @partial(jax.jit, donate_argnums=(1,))
         def _step(params, cache, last, pos, temps, topk, topp, key):
@@ -251,6 +294,39 @@ class DecodeEngine:
             (cache, _, _, key), toks = jax.lax.scan(
                 body, (cache, last, pos, key), None, length=n_sync)
             return jnp.swapaxes(toks, 0, 1), cache, key   # (B, K)
+
+        if self.paged is not None:
+            from .models.paged_decode import decode_step_paged
+
+            def _one_step_paged(params, pool, tables, last, pos, temps,
+                                topk, topp, key):
+                logits, pool = decode_step_paged(params, pool, tables,
+                                                 last, pos, cfg)
+                tok, key = _sample_tok(logits, temps, topk, topp, key)
+                return tok, pool, key
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def _step_paged(params, pool, tables, last, pos, temps,
+                            topk, topp, key):
+                return _one_step_paged(params, pool, tables, last, pos,
+                                       temps, topk, topp, key)
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def _multi_step_paged(params, pool, tables, last, pos, temps,
+                                  topk, topp, key):
+                def body(carry, _):
+                    pool, last, pos, key = carry
+                    tok, pool, key = _one_step_paged(
+                        params, pool, tables, last, pos, temps, topk,
+                        topp, key)
+                    return (pool, tok, pos + 1, key), tok
+
+                (pool, _, _, key), toks = jax.lax.scan(
+                    body, (pool, last, pos, key), None, length=n_sync)
+                return jnp.swapaxes(toks, 0, 1), pool, key
+
+            self._step_paged_fn = _step_paged
+            self._multi_step_paged_fn = _multi_step_paged
 
         @partial(jax.jit, donate_argnums=(0,))
         def _install(cache, row_cache, slot):
@@ -458,6 +534,13 @@ class DecodeEngine:
                 f"({max_new_tokens})"
                 + (f" + gamma ({slack})" if slack else "")
                 + f" exceeds max_len {self.max_len}")
+        if self.paged is not None:
+            needed = -(-(prompt.size + max_new_tokens) // self.paged[1])
+            if needed > self.paged[0] - 1:      # block 0 never allocates
+                raise ValueError(
+                    f"request needs {needed} blocks but the pool only "
+                    f"has {self.paged[0] - 1} allocatable — it could "
+                    "never be admitted")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append((rid, prompt, int(max_new_tokens),
@@ -482,6 +565,7 @@ class DecodeEngine:
                 self._outputs.pop(rid, None)
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
+                self._release_blocks(slot)
                 return True
         return False
 
@@ -492,6 +576,20 @@ class DecodeEngine:
         for slot in self._free_slots():
             if not self._queue:
                 return
+            if self.paged is not None:
+                # allocate BEFORE popping: when the pool is momentarily
+                # empty the head request simply waits (FIFO — no
+                # smaller-request overtaking, so no starvation)
+                _, nxt_prompt, nxt_max_new = self._queue[0][:3]
+                bsz = self.paged[1]
+                needed = -(-(nxt_prompt.size + nxt_max_new) // bsz)
+                if len(self._free_block_ids) < needed:
+                    return
+                blocks = [self._free_block_ids.popleft()
+                          for _ in range(needed)]
+                self._slot_blocks[slot] = blocks
+                self._tables[slot, :] = 0      # unused entries -> scratch
+                self._tables[slot, :needed] = blocks
             rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
             # exact-length prefill: one compile per distinct prompt
             # length (an online server batches by length bucket upstream
@@ -505,7 +603,15 @@ class DecodeEngine:
                 prompt, self._extend_fn, self._extend_owned_fn,
                 self._prefill_fn, self.params, entry, 2,
                 self._fresh_row_fn)
-            self.cache = self._install_fn(self.cache, row_cache, slot)
+            if self.paged is not None:
+                from .models.paged_decode import install_row_paged
+
+                nprefill = -(-prompt.size // self.paged[1])
+                self.pool = install_row_paged(
+                    self.pool, row_cache, self._tables[slot], nprefill)
+            else:
+                self.cache = self._install_fn(self.cache, row_cache,
+                                              slot)
             if self.draft_config is not None:
                 _, d_row = self._prefill_with_prefixes(
                     prompt, self._extend_draft_fn,
@@ -549,10 +655,17 @@ class DecodeEngine:
             self._finish(slot)
         return True
 
+    def _release_blocks(self, slot: int):
+        if self.paged is not None and self._slot_blocks[slot]:
+            self._free_block_ids.extend(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._tables[slot, :] = 0          # back to the scratch sink
+
     def _finish(self, slot: int):
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
+        self._release_blocks(slot)
         self._n_finished += 1
 
     @property
@@ -570,6 +683,9 @@ class DecodeEngine:
         if self._prefixes:
             out["prefix_hits"] = self._n_prefix_hits
             out["prefix_tokens_reused"] = self._n_prefix_tokens
+        if self.paged is not None:
+            out["blocks_total"] = self.paged[0] - 1
+            out["blocks_free"] = len(self._free_block_ids)
         if self.draft_config is not None:
             out["draft_acceptance"] = (
                 self._n_accepted / self._n_proposed
@@ -629,11 +745,18 @@ class DecodeEngine:
             self._admit()
             return emitted
         if self.steps_per_sync > 1:
-            toks, self.cache, self._key = self._multi_step_fn(
-                self.params, self.cache, jnp.asarray(self._last),
-                jnp.asarray(pos), jnp.asarray(self._temp),
-                jnp.asarray(self._topk), jnp.asarray(self._topp),
-                self._key)
+            if self.paged is not None:
+                toks, self.pool, self._key = self._multi_step_paged_fn(
+                    self.params, self.pool, jnp.asarray(self._tables),
+                    jnp.asarray(self._last), jnp.asarray(pos),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), self._key)
+            else:
+                toks, self.cache, self._key = self._multi_step_fn(
+                    self.params, self.cache, jnp.asarray(self._last),
+                    jnp.asarray(pos), jnp.asarray(self._temp),
+                    jnp.asarray(self._topk), jnp.asarray(self._topp),
+                    self._key)
             toks = np.asarray(toks)                       # (B, K)
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
@@ -646,10 +769,18 @@ class DecodeEngine:
                         emitted.setdefault(rid, []).append(int(tok))
             self._admit()
             return emitted
-        toks, self.cache, self._key = self._step_fn(
-            self.params, self.cache, jnp.asarray(self._last),
-            jnp.asarray(pos), jnp.asarray(self._temp),
-            jnp.asarray(self._topk), jnp.asarray(self._topp), self._key)
+        if self.paged is not None:
+            toks, self.pool, self._key = self._step_paged_fn(
+                self.params, self.pool, jnp.asarray(self._tables),
+                jnp.asarray(self._last), jnp.asarray(pos),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), self._key)
+        else:
+            toks, self.cache, self._key = self._step_fn(
+                self.params, self.cache, jnp.asarray(self._last),
+                jnp.asarray(pos), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                self._key)
         toks = np.asarray(toks)
         for slot in np.nonzero(active)[0]:
             rid = self._rid[slot]
