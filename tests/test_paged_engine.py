@@ -104,6 +104,8 @@ def test_paged_window_and_alibi_variants():
     """Masking variants flow through the paged gather identically."""
     for overrides in ({"attention_window": 6},
                       {"positional": "alibi"},
+                      {"positional": "rope"},
+                      {"positional": "sinusoidal"},
                       {"num_kv_heads": 2}):
         config = _config(**overrides)
         params = init_params(config, jax.random.PRNGKey(1))
