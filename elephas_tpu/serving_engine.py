@@ -43,6 +43,7 @@ The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
 """
+import time
 from collections import deque
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -233,6 +234,11 @@ class DecodeEngine:
         self._next_rid = 0
         # observability counters (see .stats)
         self._n_steps = 0
+        # per-request wall-clock: submit time per rid + a bounded window
+        # of completed (queue_wait_s, total_s) samples for percentiles
+        self._submit_t: Dict[int, float] = {}
+        self._admit_t: Dict[int, float] = {}
+        self._latency_window: deque = deque(maxlen=1024)
         self._n_emitted = 0
         self._n_finished = 0
         self._n_accepted = 0
@@ -403,6 +409,73 @@ class DecodeEngine:
             self._fresh_draft_row_fn = lambda: init_kv_cache(dcfg, 1,
                                                              max_len)
 
+    # ------------------------------------------------------------ warmup
+    def warmup(self, prompt_lengths: Sequence[int] = ()):
+        """Compile the hot programs BEFORE traffic arrives: the decode
+        step (plain or fused, paged or contiguous) plus, for each
+        length in ``prompt_lengths``, the admission prefill path exactly
+        as a real admission runs it (chunked block shapes when
+        ``prefill_chunk`` is set, whole-prompt prefill otherwise) and
+        the cache-install program. Call on an IDLE engine (it scribbles
+        into free slots' cache rows, which the next admission
+        overwrites); afterwards the first real request pays no jit
+        latency for any warmed shape."""
+        if any(r is not None for r in self._rid) or self._queue:
+            raise RuntimeError("warmup() needs an idle engine")
+        dummy = dict(last=jnp.zeros(self.max_slots, jnp.int32),
+                     pos=jnp.zeros(self.max_slots, jnp.int32),
+                     temps=jnp.asarray(self._temp),
+                     topk=jnp.asarray(self._topk),
+                     topp=jnp.asarray(self._topp),
+                     key=jax.random.PRNGKey(0))
+        # the step fns donate the cache argument, so warming on the
+        # engine's OWN cache (idle: every slot free, paged writes land
+        # on scratch block 0) costs zero extra device memory — an
+        # engine sized to fill the chip can still warm up
+        if self.paged is not None:
+            fn = (self._multi_step_paged_fn if self.steps_per_sync > 1
+                  else self._step_paged_fn)
+            _, self.pool, _ = fn(
+                self.params, self.pool, jnp.asarray(self._tables),
+                dummy["last"], dummy["pos"], dummy["temps"],
+                dummy["topk"], dummy["topp"], dummy["key"])
+        elif self.draft_config is not None:
+            out = self._spec_step_fn(
+                self.params, self.draft_params, self.cache,
+                self.draft_cache, dummy["last"], dummy["pos"],
+                dummy["key"])
+            self.cache, self.draft_cache = out[3], out[4]
+        else:
+            fn = (self._multi_step_fn if self.steps_per_sync > 1
+                  else self._step_fn)
+            _, self.cache, _ = fn(
+                self.params, self.cache, dummy["last"], dummy["pos"],
+                dummy["temps"], dummy["topk"], dummy["topp"],
+                dummy["key"])
+        for length in sorted(set(int(n) for n in prompt_lengths)):
+            if not 1 <= length < self.max_len:
+                raise ValueError(f"prompt length {length} out of range")
+            fake = np.zeros(length, np.int32)
+            _, row = self._prefill_with_prefixes(
+                fake, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, None, 2,
+                self._fresh_row_fn)
+            if self.paged is not None:
+                from .models.paged_decode import install_row_paged
+
+                nprefill = -(-length // self.paged[1])
+                self.pool = install_row_paged(
+                    self.pool, row, self._tables[0], nprefill)
+            else:
+                self.cache = self._install_fn(self.cache, row, 0)
+            if self.draft_config is not None:
+                _, d_row = self._prefill_with_prefixes(
+                    fake, self._extend_draft_fn,
+                    self._extend_draft_owned_fn, self._prefill_draft_fn,
+                    self.draft_params, None, 3, self._fresh_draft_row_fn)
+                self.draft_cache = self._install_draft_fn(
+                    self.draft_cache, d_row, 0)
+
     # ---------------------------------------------------------- prefixes
     def register_prefix(self, tokens: Sequence[int]) -> None:
         """Precompute and pin the KV state of a shared prompt prefix
@@ -543,6 +616,7 @@ class DecodeEngine:
                     "never be admitted")
         rid = self._next_rid
         self._next_rid += 1
+        self._submit_t[rid] = time.monotonic()
         self._queue.append((rid, prompt, int(max_new_tokens),
                             self.temperature if temperature is None
                             else float(temperature),
@@ -559,6 +633,7 @@ class DecodeEngine:
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
+                self._submit_t.pop(rid, None)
                 return True
         for slot, r in enumerate(self._rid):
             if r == rid:
@@ -566,6 +641,8 @@ class DecodeEngine:
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
                 self._release_blocks(slot)
+                self._submit_t.pop(rid, None)
+                self._admit_t.pop(rid, None)
                 return True
         return False
 
@@ -591,6 +668,9 @@ class DecodeEngine:
                 self._tables[slot, :] = 0      # unused entries -> scratch
                 self._tables[slot, :needed] = blocks
             rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
+            # queue wait ends HERE — prefill compute/compile time below
+            # belongs to total latency, not to time-spent-queued
+            self._admit_t[rid] = time.monotonic()
             # exact-length prefill: one compile per distinct prompt
             # length (an online server batches by length bucket upstream
             # if compile churn matters); a registered-prefix hit reuses
@@ -667,6 +747,11 @@ class DecodeEngine:
         self._rid[slot] = None
         self._release_blocks(slot)
         self._n_finished += 1
+        now = time.monotonic()
+        t_sub = self._submit_t.pop(rid, None)
+        t_adm = self._admit_t.pop(rid, now)
+        if t_sub is not None:
+            self._latency_window.append((t_adm - t_sub, now - t_sub))
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -686,6 +771,14 @@ class DecodeEngine:
         if self.paged is not None:
             out["blocks_total"] = self.paged[0] - 1
             out["blocks_free"] = len(self._free_block_ids)
+        if self._latency_window:
+            totals = [t for _, t in self._latency_window]
+            waits = [w for w, _ in self._latency_window]
+            out["latency_p50_s"] = round(float(np.quantile(totals, 0.5)),
+                                         4)
+            out["latency_p99_s"] = round(float(np.quantile(totals, 0.99)),
+                                         4)
+            out["queue_wait_mean_s"] = round(sum(waits) / len(waits), 4)
         if self.draft_config is not None:
             out["draft_acceptance"] = (
                 self._n_accepted / self._n_proposed

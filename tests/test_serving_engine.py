@@ -574,3 +574,56 @@ def test_prefill_chunk_speculative_prefix(model):
     [out] = eng.run([prompt], max_new_tokens=6)
     assert out == _ref(params, config, prompt, 6)
     assert eng.stats["prefix_hits"] == 1
+
+
+# ------------------------------------------------------ warmup + latency
+
+def test_warmup_precompiles_all_traffic_shapes(model):
+    """After warmup(lengths), serving prompts of exactly those lengths
+    compiles NOTHING new — the first request pays no jit latency."""
+    params, config = model
+    rng = np.random.default_rng(50)
+    eng = DecodeEngine(params, config, max_slots=2)
+    eng.warmup(prompt_lengths=(4, 7))
+    sizes = (eng._step_fn._cache_size(), eng._prefill_fn._cache_size(),
+             eng._install_fn._cache_size())
+    prompts = [rng.integers(0, 64, 4), rng.integers(0, 64, 7)]
+    outs = eng.run(prompts, max_new_tokens=6)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 6)
+    assert (eng._step_fn._cache_size(), eng._prefill_fn._cache_size(),
+            eng._install_fn._cache_size()) == sizes
+    # warmup on a busy engine is refused
+    eng.submit(rng.integers(0, 64, 4), 30)
+    with pytest.raises(RuntimeError, match="idle"):
+        eng.warmup((4,))
+
+
+def test_warmup_paged_multistep(model):
+    params, config = model
+    rng = np.random.default_rng(51)
+    eng = DecodeEngine(params, config, max_slots=2, steps_per_sync=3,
+                       paged=(16, 8), prefill_chunk=4)
+    eng.warmup(prompt_lengths=(5, 9))
+    n_ext = (eng._extend_owned_fn._cache_size()
+             + eng._extend_fn._cache_size())
+    n_step = eng._multi_step_paged_fn._cache_size()
+    prompts = [rng.integers(0, 64, 5), rng.integers(0, 64, 9)]
+    outs = eng.run(prompts, max_new_tokens=7)
+    for p, o in zip(prompts, outs):
+        assert o == _ref(params, config, p, 7)
+    assert (eng._extend_owned_fn._cache_size()
+            + eng._extend_fn._cache_size()) == n_ext
+    assert eng._multi_step_paged_fn._cache_size() == n_step
+
+
+def test_latency_stats(model):
+    params, config = model
+    rng = np.random.default_rng(52)
+    eng = DecodeEngine(params, config, max_slots=1)
+    eng.run([rng.integers(0, 64, 5), rng.integers(0, 64, 6)],
+            max_new_tokens=5)
+    s = eng.stats
+    assert 0 < s["latency_p50_s"] <= s["latency_p99_s"]
+    # the second request waited for the single slot
+    assert s["queue_wait_mean_s"] > 0
