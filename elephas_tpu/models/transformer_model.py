@@ -495,6 +495,40 @@ class TransformerModel:
                                     top_k=top_k, top_p=top_p,
                                     prompt_lengths=prompt_lengths))
 
+    def engine(self, draft: Optional["TransformerModel"] = None,
+               **engine_kwargs):
+        """A :class:`~elephas_tpu.serving_engine.DecodeEngine` over this
+        model's parameters (continuous batching, prefix caching,
+        multi-step scheduling, paged KV — see the serving guide). Pass
+        ``draft=`` for speculative stepping."""
+        from ..serving_engine import DecodeEngine
+
+        if self.params is None:
+            raise RuntimeError("build() or load weights before serving")
+        if draft is not None:
+            if draft.params is None:
+                raise RuntimeError("the draft model needs build() or "
+                                   "loaded weights before serving")
+            engine_kwargs.setdefault("draft_params", draft.params)
+            engine_kwargs.setdefault("draft_config", draft.config)
+        return DecodeEngine(self.params, self.config, **engine_kwargs)
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              tokenizer=None, draft: Optional["TransformerModel"] = None,
+              warmup_lengths=(), **engine_kwargs):
+        """One call from a trained model to a RUNNING HTTP server:
+        builds the engine, optionally warms the given prompt lengths,
+        and starts a :class:`~elephas_tpu.serving_http.ServingServer`
+        (returned started; ``.port`` has the bound port, ``.stop()``
+        shuts down)."""
+        from ..serving_http import ServingServer
+
+        eng = self.engine(draft=draft, **engine_kwargs)
+        if warmup_lengths:
+            eng.warmup(prompt_lengths=warmup_lengths)
+        return ServingServer(eng, host=host, port=port,
+                             tokenizer=tokenizer).start()
+
     def speculative_generate(self, draft: "TransformerModel",
                              prompt: np.ndarray, max_new_tokens: int,
                              gamma: int = 4, temperature: float = 0.0,

@@ -263,3 +263,24 @@ def test_stream_client_disconnect_cancels_request(model):
         out = _post(srv.port, "/v1/generate",
                     {"prompt": prompt, "max_new_tokens": 5})
         assert out["tokens"] == _ref(params, config, prompt, 5)
+
+
+def test_transformer_model_serve_one_call():
+    """TransformerModel.serve(): trained model -> running HTTP server in
+    one call, warmed, output ≡ the model's own generate."""
+    from elephas_tpu.models.transformer_model import TransformerModel
+
+    tm = TransformerModel(TransformerConfig(
+        vocab_size=300, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=48, dtype=jnp.float32))
+    tm.build(seed=0)
+    srv = tm.serve(warmup_lengths=(4,), max_slots=2, steps_per_sync=2)
+    try:
+        prompt = [int(t) for t in np.random.default_rng(7).integers(
+            0, 300, 4)]
+        out = _post(srv.port, "/v1/generate",
+                    {"prompt": prompt, "max_new_tokens": 6})
+        ref = [int(t) for t in tm.generate(np.asarray(prompt)[None], 6)[0]]
+        assert out["tokens"] == ref
+    finally:
+        srv.stop()
