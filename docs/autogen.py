@@ -98,6 +98,10 @@ PAGES = [
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
+    ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",
+     ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
+      "make_ssm_train_step", "init_ssm_state", "ssm_decode_step",
+      "ssm_generate"]),
     ("Checkpointing", "elephas_tpu.utils.checkpoint", ["CheckpointManager"]),
     ("Object storage", "elephas_tpu.utils.storage",
      ["ObjectStore", "CliObjectStore", "LocalMirrorStore", "register_store",

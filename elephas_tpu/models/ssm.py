@@ -1,0 +1,274 @@
+"""Selective state-space (Mamba-style) LM family — the linear-time
+complement to the attention transformer.
+
+TPU-first design: training computes the whole input-dependent diagonal
+recurrence ``h_t = a_t * h_{t-1} + b_t * u_t`` in ONE
+``lax.associative_scan`` (log-depth, MXU/VPU-friendly, no sequential
+loop), and decode carries a constant ``(batch, d_inner)`` hidden state
+per layer — O(1) cache versus attention's O(seq) KV, which is the whole
+serving story for very long contexts. The reference has no sequence
+models at all (its models are user-supplied Keras MLPs/convs,
+``elephas/spark_model.py``); this family is beyond-parity breadth, and
+its API mirrors :mod:`.transformer` (init/loss/generate + cached
+decode) so the trainers and serving utilities compose the same way.
+
+Block structure (per layer, pre-norm residual):
+
+    u, g = x @ W_in  (split)                 # expand D -> 2E
+    a_t  = exp(-softplus(x @ W_dt + b_dt))   # input-SELECTIVE decay
+    b_t  = x @ W_b                           # input-dependent drive
+    h_t  = a_t * h_{t-1} + b_t * silu(u_t)   # diagonal recurrence
+    y    = (h_t * silu(g_t)) @ W_out + d * u # gated readout + skip
+
+First-order recurrences compose associatively:
+``(a2, s2) ∘ (a1, s1) = (a1*a2, a2*s1 + s2)`` — exactly what
+``lax.associative_scan`` parallelizes. The step-by-step decode applies
+the same update once per token; scan ≡ sequential is pinned by tests.
+"""
+import math
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
+           "init_ssm_state", "ssm_decode_step", "ssm_generate",
+           "make_ssm_train_step"]
+
+
+class SSMConfig:
+    """Hyperparameters for the selective-SSM LM. ``d_inner`` is the
+    expanded state width (Mamba's ``expand * d_model``).
+    ``max_seq_len`` is advisory only (an SSM has no positional table or
+    cache bound — any sequence length runs); it exists so generic
+    tooling written against the transformer config keeps working.
+    Value-hashable so it can ride as a jit static argument."""
+
+    def __init__(self, vocab_size: int, num_layers: int = 4,
+                 d_model: int = 256, d_inner: Optional[int] = None,
+                 max_seq_len: int = 2048, dtype=jnp.float32):
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.d_model = int(d_model)
+        self.d_inner = int(d_inner if d_inner is not None else 2 * d_model)
+        self.max_seq_len = int(max_seq_len)
+        self.dtype = dtype
+
+    def _key(self):
+        return (self.vocab_size, self.num_layers, self.d_model,
+                self.d_inner, self.max_seq_len,
+                jnp.dtype(self.dtype).name)
+
+    def __eq__(self, other):
+        return (isinstance(other, SSMConfig)
+                and self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+
+def init_ssm_params(config: SSMConfig, key) -> Dict:
+    c = config
+    keys = jax.random.split(key, 2 + 4 * c.num_layers)
+    scale_in = 1.0 / math.sqrt(c.d_model)
+    scale_out = 1.0 / math.sqrt(c.d_inner)
+    params: Dict = {
+        "embed": jax.random.normal(keys[0], (c.vocab_size, c.d_model),
+                                   jnp.float32) * 0.02,
+        "final_ln": {"scale": jnp.ones(c.d_model, jnp.float32)},
+    }
+    for i in range(c.num_layers):
+        k1, k2, k3, k4 = keys[2 + 4 * i: 6 + 4 * i]
+        params[f"layer_{i}"] = {
+            "ln": {"scale": jnp.ones(c.d_model, jnp.float32)},
+            "w_in": jax.random.normal(k1, (c.d_model, 2 * c.d_inner),
+                                      jnp.float32) * scale_in,
+            "w_dt": jax.random.normal(k2, (c.d_model, c.d_inner),
+                                      jnp.float32) * scale_in,
+            # softplus(b_dt) ~ decay rate; init spread over timescales
+            # (Mamba's dt init): decays between ~0.9 and ~0.999
+            "b_dt": jnp.asarray(np.log(np.expm1(np.geomspace(
+                0.001, 0.1, c.d_inner))), jnp.float32),
+            "w_b": jax.random.normal(k3, (c.d_model, c.d_inner),
+                                     jnp.float32) * scale_in,
+            "w_out": jax.random.normal(k4, (c.d_inner, c.d_model),
+                                       jnp.float32) * scale_out,
+            "d_skip": jnp.ones(c.d_inner, jnp.float32),
+        }
+    return params
+
+
+def _rms(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def _layer_coeffs(layer: Dict, x: jnp.ndarray, c: SSMConfig):
+    """Shared by the parallel scan and the single decode step: the
+    input-dependent (a, drive, gate, u) of one layer at the given
+    position(s)."""
+    h = _rms(x, layer["ln"]["scale"]).astype(c.dtype)
+    ug = h @ layer["w_in"].astype(c.dtype)
+    u, g = jnp.split(ug, 2, axis=-1)
+    u = jax.nn.silu(u)
+    a = jnp.exp(-jax.nn.softplus(
+        h @ layer["w_dt"].astype(c.dtype)
+        + layer["b_dt"].astype(c.dtype)))
+    drive = (h @ layer["w_b"].astype(c.dtype)) * u
+    return a, drive, g, u
+
+
+def _layer_readout(layer: Dict, h_states: jnp.ndarray, g: jnp.ndarray,
+                   u: jnp.ndarray, c: SSMConfig) -> jnp.ndarray:
+    y = (h_states * jax.nn.silu(g)
+         + layer["d_skip"].astype(c.dtype) * u)
+    return y @ layer["w_out"].astype(c.dtype)
+
+
+def _scan_recurrence(a: jnp.ndarray, drive: jnp.ndarray) -> jnp.ndarray:
+    """All T hidden states of ``h_t = a_t h_{t-1} + drive_t`` (h_0 = 0)
+    in one log-depth associative scan over the time axis."""
+
+    def combine(left, right):
+        a1, s1 = left
+        a2, s2 = right
+        return a1 * a2, a2 * s1 + s2
+
+    _, states = jax.lax.associative_scan(combine, (a, drive), axis=1)
+    return states
+
+
+def ssm_forward(params: Dict, tokens: jnp.ndarray,
+                config: SSMConfig) -> jnp.ndarray:
+    """Full-sequence logits ``(B, T, V)`` — training/prefill path, the
+    whole recurrence parallelized per layer."""
+    c = config
+    x = params["embed"][tokens].astype(c.dtype)
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        a, drive, g, u = _layer_coeffs(layer, x, c)
+        states = _scan_recurrence(a, drive)
+        x = x + _layer_readout(layer, states, g, u, c)
+    x = _rms(x, params["final_ln"]["scale"])
+    return x.astype(jnp.float32) @ params["embed"].T
+
+
+def ssm_lm_loss(params: Dict, tokens: jnp.ndarray,
+                config: SSMConfig) -> jnp.ndarray:
+    logits = ssm_forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_ssm_train_step(config: SSMConfig, tx, mesh=None,
+                        data_axis: str = "data"):
+    """(params, opt_state, tokens) -> (params, opt_state, loss), batch
+    dp-sharded when a mesh is given (same pattern as the transformer's
+    :func:`~elephas_tpu.models.transformer.make_train_step`)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def step(params, opt_state, tokens):
+        if mesh is not None:
+            tokens = jax.lax.with_sharding_constraint(
+                tokens, NamedSharding(mesh, P(data_axis, None)))
+        loss, grads = jax.value_and_grad(ssm_lm_loss)(params, tokens,
+                                                      config)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# ------------------------------------------------------------- decoding
+def init_ssm_state(config: SSMConfig, batch: int) -> Dict:
+    """O(1) decode state: one ``(batch, d_inner)`` hidden vector per
+    layer — independent of sequence length (attention's KV cache is
+    O(seq); this is the SSM serving advantage)."""
+    return {f"layer_{i}": jnp.zeros((batch, config.d_inner),
+                                    config.dtype)
+            for i in range(config.num_layers)}
+
+
+def ssm_decode_step(params: Dict, state: Dict, tokens: jnp.ndarray,
+                    config: SSMConfig) -> Tuple[jnp.ndarray, Dict]:
+    """One token per row: ``(B,)`` ids -> (logits ``(B, V)``, new
+    state). Applies exactly the recurrence the parallel scan computes,
+    once."""
+    c = config
+    x = params["embed"][tokens].astype(c.dtype)        # (B, D)
+    new_state: Dict = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        a, drive, g, u = _layer_coeffs(layer, x, c)
+        h_new = a * state[f"layer_{i}"] + drive
+        new_state[f"layer_{i}"] = h_new
+        x = x + _layer_readout(layer, h_new, g, u, c)
+    x = _rms(x, params["final_ln"]["scale"])
+    return x.astype(jnp.float32) @ params["embed"].T, new_state
+
+
+@partial(jax.jit, static_argnames=("max_new_tokens", "config",
+                                   "temperature"))
+def _ssm_generate_scan(params, prompt, key, max_new_tokens: int,
+                       config: SSMConfig, temperature: float):
+    # prefill: teacher-force the prompt through the parallel path and
+    # grab the final hidden state of every layer
+    c = config
+    x = params["embed"][prompt].astype(c.dtype)
+    state = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        a, drive, g, u = _layer_coeffs(layer, x, c)
+        states = _scan_recurrence(a, drive)
+        state[f"layer_{i}"] = states[:, -1]
+        x = x + _layer_readout(layer, states, g, u, c)
+    x = _rms(x, params["final_ln"]["scale"])
+    logits0 = x[:, -1].astype(jnp.float32) @ params["embed"].T
+
+    def pick(logits, k):
+        if temperature > 0:
+            k, sub = jax.random.split(k)
+            return (jax.random.categorical(
+                sub, logits / temperature).astype(jnp.int32), k)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k
+
+    tok0, key2 = pick(logits0, key)
+
+    def body(carry, _):
+        state, tok, k = carry
+        logits, state = ssm_decode_step(params, state, tok, c)
+        nxt, k = pick(logits, k)
+        return (state, nxt, k), tok
+
+    (_, last, _), toks = jax.lax.scan(
+        body, (state, tok0, key2), None, length=max_new_tokens - 1)
+    return jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
+                           axis=1)
+
+
+def ssm_generate(params: Dict, prompt: jnp.ndarray, max_new_tokens: int,
+                 config: SSMConfig, temperature: float = 0.0,
+                 key=None) -> jnp.ndarray:
+    """Greedy (or sampled) continuation of ``(B, T)`` prompts: prefill
+    runs the parallel scan once to build the O(1) state, then one fused
+    ``lax.scan`` emits tokens — no KV cache, state size is constant in
+    sequence length. Compiled once per (shape, config,
+    ``max_new_tokens``, sampled-or-greedy); repeated calls reuse the
+    executable."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return _ssm_generate_scan(params, jnp.asarray(prompt), key,
+                              int(max_new_tokens), config,
+                              float(temperature))

@@ -1,0 +1,135 @@
+"""Selective-SSM family: the associative-scan recurrence must equal the
+sequential one exactly, cached O(1)-state decode must continue exactly
+where the parallel prefill left off, and the LM must actually train."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elephas_tpu.models.ssm import (SSMConfig, init_ssm_params,
+                                    init_ssm_state, make_ssm_train_step,
+                                    ssm_decode_step, ssm_forward,
+                                    ssm_generate, ssm_lm_loss)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = SSMConfig(vocab_size=64, num_layers=2, d_model=32,
+                       d_inner=48, max_seq_len=64)
+    params = init_ssm_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def test_scan_equals_sequential_decode(model):
+    """The parallel associative scan and token-by-token decode are THE
+    SAME recurrence: full-sequence logits from ssm_forward must match
+    feeding tokens one at a time through ssm_decode_step."""
+    params, config = model
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 9)))
+    par = np.asarray(ssm_forward(params, tokens, config))
+
+    state = init_ssm_state(config, 2)
+    seq = []
+    for t in range(tokens.shape[1]):
+        logits, state = ssm_decode_step(params, state, tokens[:, t],
+                                        config)
+        seq.append(np.asarray(logits))
+    seq = np.stack(seq, axis=1)
+    np.testing.assert_allclose(par, seq, atol=1e-4, rtol=1e-4)
+
+
+def test_generate_matches_teacher_forced_argmax(model):
+    """Greedy generate's first token must equal the forward pass's
+    argmax at the prompt end, and the continuation must be
+    self-consistent under re-prefill (cached state ≡ recompute)."""
+    params, config = model
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 7)))
+    out = np.asarray(ssm_generate(params, prompt, 8, config))
+    assert out.shape == (2, 8)
+    first = np.asarray(
+        jnp.argmax(ssm_forward(params, prompt, config)[:, -1], axis=-1))
+    np.testing.assert_array_equal(out[:, 0], first)
+    # appending the emitted tokens and re-prefilling reproduces the
+    # remaining continuation exactly (state carried vs recomputed)
+    full = jnp.concatenate([prompt, jnp.asarray(out[:, :4])], axis=1)
+    out2 = np.asarray(ssm_generate(params, full, 4, config))
+    np.testing.assert_array_equal(out[:, 4:], out2)
+
+
+def test_ssm_trains(model):
+    """Loss decreases on a learnable pattern (next token = +1 mod V)."""
+    _, config = model
+    params = init_ssm_params(config, jax.random.PRNGKey(2))
+    rng = np.random.default_rng(3)
+    start = rng.integers(0, 64, (16, 1))
+    tokens = jnp.asarray((start + np.arange(12)) % 64)
+    tx = optax.adam(1e-2)
+    step = make_ssm_train_step(config, tx)
+    opt_state = tx.init(params)
+    first = last = None
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert last < first * 0.5, (first, last)
+
+
+def test_ssm_train_step_dp_mesh(model):
+    """The train step runs batch-sharded over a data mesh (same dp
+    pattern as the transformer's)."""
+    from jax.sharding import Mesh
+
+    _, config = model
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    params = init_ssm_params(config, jax.random.PRNGKey(4))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    tx = optax.sgd(0.1)
+    step = make_ssm_train_step(config, tx, mesh=mesh)
+    tokens = jnp.asarray(np.random.default_rng(5).integers(0, 64, (8, 10)))
+    # reference BEFORE the step: the jitted step donates params
+    loss_ref = float(ssm_lm_loss(params, tokens, config))
+    with mesh:
+        params2, _, loss = step(params, tx.init(params), tokens)
+    assert np.isfinite(float(loss))
+    # the sharded step computes the same loss as the unsharded one
+    assert abs(float(loss) - loss_ref) < 1e-4
+
+
+def test_ssm_bf16_state_dtype_stable():
+    """bf16 config: decode state dtype must stay bf16 (a drifting carry
+    dtype breaks lax.scan); forward runs and produces finite logits."""
+    config = SSMConfig(vocab_size=64, num_layers=2, d_model=32,
+                       d_inner=48, dtype=jnp.bfloat16)
+    params = init_ssm_params(config, jax.random.PRNGKey(6))
+    tokens = jnp.asarray(np.random.default_rng(7).integers(0, 64, (2, 6)))
+    state = init_ssm_state(config, 2)
+    logits, state2 = ssm_decode_step(params, state, tokens[:, 0], config)
+    assert state2["layer_0"].dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(logits)).all()
+    out = np.asarray(ssm_generate(params, tokens, 4, config))
+    assert out.shape == (2, 4)
+
+
+def test_ssm_generate_edge_cases(model):
+    params, config = model
+    prompt = jnp.asarray(np.random.default_rng(8).integers(0, 64, (2, 5)))
+    # single token: matches forward argmax, and sampling is honored
+    out1 = np.asarray(ssm_generate(params, prompt, 1, config))
+    ref = np.asarray(jnp.argmax(
+        ssm_forward(params, prompt, config)[:, -1], axis=-1))
+    np.testing.assert_array_equal(out1[:, 0], ref)
+    s1 = np.asarray(ssm_generate(params, prompt, 1, config,
+                                 temperature=5.0,
+                                 key=jax.random.PRNGKey(1)))
+    s2 = np.asarray(ssm_generate(params, prompt, 1, config,
+                                 temperature=5.0,
+                                 key=jax.random.PRNGKey(2)))
+    assert s1.shape == s2.shape == (2, 1)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        ssm_generate(params, prompt, 0, config)
+    with pytest.raises(ValueError, match="PRNG"):
+        ssm_generate(params, prompt, 3, config, temperature=1.0)
