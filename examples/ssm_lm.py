@@ -1,0 +1,57 @@
+"""Selective SSM (Mamba-style) LM: train with one parallel scan per
+layer, decode with O(1) state.
+
+The attention transformer's KV cache grows with context; the SSM's
+decode state is a constant ``(batch, d_inner)`` per layer — this
+example trains a small selective SSM on byte text and then streams a
+continuation whose serving memory would be identical at 1k or 1M
+context. The reference has no sequence models at all (SURVEY.md §2 —
+user-supplied Keras MLPs/convs); this family is beyond-parity breadth.
+
+Run: JAX_PLATFORMS=cpu python examples/ssm_lm.py
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elephas_tpu.models.ssm import (SSMConfig, init_ssm_params,
+                                    init_ssm_state, make_ssm_train_step,
+                                    ssm_generate)
+from elephas_tpu.utils.text import ByteTokenizer
+
+tok = ByteTokenizer()
+TEXT = ("the quick brown fox jumps over the lazy dog. " * 40)
+
+config = SSMConfig(vocab_size=tok.vocab_size, num_layers=2, d_model=64,
+                   d_inner=128)
+params = init_ssm_params(config, jax.random.PRNGKey(0))
+
+# pack the corpus into fixed windows
+ids = np.asarray(tok.encode(TEXT), np.int32)
+seq = 48
+n = (len(ids) - 1) // seq
+tokens = jnp.asarray(ids[: n * seq].reshape(n, seq))
+
+tx = optax.adam(3e-3)
+step = make_ssm_train_step(config, tx)
+opt_state = tx.init(params)
+first = last = None
+for epoch in range(120):
+    params, opt_state, loss = step(params, opt_state, tokens)
+    first = float(loss) if first is None else first
+    last = float(loss)
+print(f"loss {first:.3f} -> {last:.3f} over 120 steps "
+      f"(one associative scan per layer per step)")
+assert last < 0.25 * first
+
+prompt = np.asarray(tok.encode("the quick brown "))[None]
+out = np.asarray(ssm_generate(params, jnp.asarray(prompt), 24, config))
+print("continuation:", repr(tok.decode(out[0])))
+
+state = init_ssm_state(config, 1)
+state_bytes = sum(int(np.prod(s.shape)) * s.dtype.itemsize
+                  for s in state.values())
+print(f"decode state: {state_bytes} bytes TOTAL, constant in context "
+      f"length (a transformer KV cache grows per token)")
+assert "fox" in tok.decode(out[0]) or "quick" in tok.decode(out[0])
