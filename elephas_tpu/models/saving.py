@@ -83,13 +83,15 @@ def _config_registry():
     if not _CONFIG_CLASSES:
         from .bert import BertConfig
         from .encdec import EncDecConfig
+        from .ssm import SSMConfig
         from .transformer import TransformerConfig
         from .vit import ViTConfig
 
         _CONFIG_CLASSES.update({"TransformerConfig": TransformerConfig,
                                 "ViTConfig": ViTConfig,
                                 "BertConfig": BertConfig,
-                                "EncDecConfig": EncDecConfig})
+                                "EncDecConfig": EncDecConfig,
+                                "SSMConfig": SSMConfig})
     return _CONFIG_CLASSES
 
 

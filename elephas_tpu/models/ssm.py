@@ -25,9 +25,10 @@ First-order recurrences compose associatively:
 ``lax.associative_scan`` parallelizes. The step-by-step decode applies
 the same update once per token; scan ≡ sequential is pinned by tests.
 """
+import dataclasses
 import math
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,35 +39,27 @@ __all__ = ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
            "make_ssm_train_step"]
 
 
+@dataclasses.dataclass(frozen=True)
 class SSMConfig:
     """Hyperparameters for the selective-SSM LM. ``d_inner`` is the
-    expanded state width (Mamba's ``expand * d_model``).
-    ``max_seq_len`` is advisory only (an SSM has no positional table or
-    cache bound — any sequence length runs); it exists so generic
-    tooling written against the transformer config keeps working.
-    Value-hashable so it can ride as a jit static argument."""
+    expanded state width (Mamba's ``expand * d_model``; defaults to
+    ``2 * d_model``). ``max_seq_len`` is advisory only (an SSM has no
+    positional table or cache bound — any sequence length runs); it
+    exists so generic tooling written against the transformer config
+    keeps working. Frozen dataclass like the other families' configs:
+    value-hashable (jit static arg) and checkpoint-manifest
+    round-trippable via :mod:`.saving`."""
 
-    def __init__(self, vocab_size: int, num_layers: int = 4,
-                 d_model: int = 256, d_inner: Optional[int] = None,
-                 max_seq_len: int = 2048, dtype=jnp.float32):
-        self.vocab_size = int(vocab_size)
-        self.num_layers = int(num_layers)
-        self.d_model = int(d_model)
-        self.d_inner = int(d_inner if d_inner is not None else 2 * d_model)
-        self.max_seq_len = int(max_seq_len)
-        self.dtype = dtype
+    vocab_size: int
+    num_layers: int = 4
+    d_model: int = 256
+    d_inner: Optional[int] = None
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
 
-    def _key(self):
-        return (self.vocab_size, self.num_layers, self.d_model,
-                self.d_inner, self.max_seq_len,
-                jnp.dtype(self.dtype).name)
-
-    def __eq__(self, other):
-        return (isinstance(other, SSMConfig)
-                and self._key() == other._key())
-
-    def __hash__(self):
-        return hash(self._key())
+    def __post_init__(self):
+        if self.d_inner is None:
+            object.__setattr__(self, "d_inner", 2 * self.d_model)
 
 
 def init_ssm_params(config: SSMConfig, key) -> Dict:

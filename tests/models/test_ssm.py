@@ -133,3 +133,29 @@ def test_ssm_generate_edge_cases(model):
         ssm_generate(params, prompt, 0, config)
     with pytest.raises(ValueError, match="PRNG"):
         ssm_generate(params, prompt, 3, config, temperature=1.0)
+
+
+def test_ssm_config_and_checkpoint_round_trip(tmp_path, model):
+    """SSMConfig rides the same manifest machinery as the other model
+    families; a checkpointed training state restores bit-exactly."""
+    import json
+
+    from elephas_tpu.models.saving import config_from_dict, config_to_dict
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    params, config = model
+    d = json.loads(json.dumps(config_to_dict(config)))
+    back = config_from_dict(d)
+    assert back == config
+
+    mgr = CheckpointManager(str(tmp_path / "ssm_ck"))
+    mgr.save(3, {"params": params},
+             distributed_config={"model_config": config_to_dict(config)})
+    fresh = CheckpointManager(str(tmp_path / "ssm_ck"))
+    restored = fresh.restore(3)["params"]
+    cfg2 = config_from_dict(
+        fresh.manifest()["distributed_config"]["model_config"])
+    tokens = jnp.asarray(np.random.default_rng(9).integers(0, 64, (2, 6)))
+    np.testing.assert_allclose(
+        np.asarray(ssm_forward(params, tokens, config)),
+        np.asarray(ssm_forward(restored, tokens, cfg2)), atol=1e-6)
