@@ -11,11 +11,21 @@ problem (13 features), matching the shapes of the reference's fixtures
 (``/root/reference/tests/conftest.py``).
 """
 import os
+import sys
 
 # Plain env vars are not enough here: the environment's sitecustomize pins
 # JAX_PLATFORMS to the TPU plugin, so force the platform through jax.config
 # before any backend initialization.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Harder still: the TPU plugin rides PYTHONPATH (.axon_site) and its
+# REGISTRATION can block on a half-open tunnel even when the cpu
+# platform is selected (observed 2026-07-31: jax.devices() hung with
+# JAX_PLATFORMS=cpu while the tunnel was wedged). The CPU suite must
+# never touch it — drop the plugin path before jax imports.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
