@@ -96,6 +96,7 @@ PAGES = [
      ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
+    ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
     ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",

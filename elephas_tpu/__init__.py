@@ -25,4 +25,5 @@ from .data import Dataset
 from .serving import TextGenerator
 from .serving_engine import DecodeEngine
 from .serving_http import ServingServer
+from .ssm_engine import SSMEngine
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

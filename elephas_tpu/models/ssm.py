@@ -35,8 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
-           "init_ssm_state", "ssm_decode_step", "ssm_generate",
-           "make_ssm_train_step"]
+           "init_ssm_state", "ssm_prefill", "ssm_decode_step",
+           "ssm_generate", "make_ssm_train_step"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,16 +121,22 @@ def _layer_readout(layer: Dict, h_states: jnp.ndarray, g: jnp.ndarray,
     return y @ layer["w_out"].astype(c.dtype)
 
 
-def _scan_recurrence(a: jnp.ndarray, drive: jnp.ndarray) -> jnp.ndarray:
-    """All T hidden states of ``h_t = a_t h_{t-1} + drive_t`` (h_0 = 0)
-    in one log-depth associative scan over the time axis."""
+def _combine(left, right):
+    a1, s1 = left
+    a2, s2 = right
+    return a1 * a2, a2 * s1 + s2
 
-    def combine(left, right):
-        a1, s1 = left
-        a2, s2 = right
-        return a1 * a2, a2 * s1 + s2
 
-    _, states = jax.lax.associative_scan(combine, (a, drive), axis=1)
+def _scan_recurrence(a: jnp.ndarray, drive: jnp.ndarray,
+                     init: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """All T hidden states of ``h_t = a_t h_{t-1} + drive_t`` in one
+    log-depth associative scan over the time axis. ``init`` (``(B, E)``,
+    default zeros) continues from an earlier chunk's final state:
+    ``h_t = (prod a_{1..t}) init + zero-init scan`` — the cumulative
+    decay product falls out of the same scan for free."""
+    cum_a, states = jax.lax.associative_scan(_combine, (a, drive), axis=1)
+    if init is not None:
+        states = states + cum_a * init[:, None, :]
     return states
 
 
@@ -181,6 +187,28 @@ def make_ssm_train_step(config: SSMConfig, tx, mesh=None,
 
 
 # ------------------------------------------------------------- decoding
+def ssm_prefill(params: Dict, tokens: jnp.ndarray, config: SSMConfig,
+                state: Optional[Dict] = None) -> Tuple[jnp.ndarray, Dict]:
+    """Parallel prefill: run ``(B, T)`` tokens through every layer's
+    associative scan and return (last-position logits ``(B, V)``, final
+    per-layer state). ``state`` continues from a previous chunk's
+    output, so long prompts can prefill in fixed-size pieces with
+    bounded compile shapes. THE prefill: ``ssm_generate`` and the
+    serving engine both call it, so the block math lives in one place."""
+    c = config
+    x = params["embed"][tokens].astype(c.dtype)
+    new_state: Dict = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        a, drive, g, u = _layer_coeffs(layer, x, c)
+        states = _scan_recurrence(
+            a, drive, None if state is None else state[f"layer_{i}"])
+        new_state[f"layer_{i}"] = states[:, -1]
+        x = x + _layer_readout(layer, states, g, u, c)
+    x = _rms(x, params["final_ln"]["scale"])
+    return x[:, -1].astype(jnp.float32) @ params["embed"].T, new_state
+
+
 def init_ssm_state(config: SSMConfig, batch: int) -> Dict:
     """O(1) decode state: one ``(batch, d_inner)`` hidden vector per
     layer — independent of sequence length (attention's KV cache is
@@ -212,19 +240,8 @@ def ssm_decode_step(params: Dict, state: Dict, tokens: jnp.ndarray,
                                    "temperature"))
 def _ssm_generate_scan(params, prompt, key, max_new_tokens: int,
                        config: SSMConfig, temperature: float):
-    # prefill: teacher-force the prompt through the parallel path and
-    # grab the final hidden state of every layer
     c = config
-    x = params["embed"][prompt].astype(c.dtype)
-    state = {}
-    for i in range(c.num_layers):
-        layer = params[f"layer_{i}"]
-        a, drive, g, u = _layer_coeffs(layer, x, c)
-        states = _scan_recurrence(a, drive)
-        state[f"layer_{i}"] = states[:, -1]
-        x = x + _layer_readout(layer, states, g, u, c)
-    x = _rms(x, params["final_ln"]["scale"])
-    logits0 = x[:, -1].astype(jnp.float32) @ params["embed"].T
+    logits0, state = ssm_prefill(params, prompt, c)
 
     def pick(logits, k):
         if temperature > 0:

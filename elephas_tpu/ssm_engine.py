@@ -1,0 +1,318 @@
+"""Continuous-batching engine for the selective-SSM family.
+
+The SSM's decode state is a constant ``(d_inner,)`` vector per layer
+per sequence — no KV cache, no block tables, no position bookkeeping.
+That collapses most of what :class:`~elephas_tpu.serving_engine.
+DecodeEngine` manages for transformers (cache rows, prefix KV, paged
+pools) into one ``(max_slots, d_inner)`` state matrix per layer, which
+is why this engine is its own small class rather than a configuration
+of the transformer engine: the two share the slot/queue SEMANTICS
+(submit with per-request sampling settings, step/run/result/cancel,
+eos + budget retirement, streamed per-step token returns — same parity
+oracle, per-request greedy output ≡ solo
+:func:`~elephas_tpu.models.ssm.ssm_generate`) but none of the cache
+machinery. Prefix caching is pointless here (a prefix's entire effect
+IS the state vector), and paged memory is moot (state is O(1) per slot
+by construction: serving memory never grows with context length).
+Prefill rides the shared :func:`~elephas_tpu.models.ssm.ssm_prefill`;
+``prefill_chunk`` bounds its compile shapes exactly like the
+transformer engine's.
+"""
+from collections import deque
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.ssm import SSMConfig, init_ssm_state, ssm_decode_step, ssm_prefill
+from .serving_engine import _filter_logits_rows
+
+__all__ = ["SSMEngine"]
+
+
+class SSMEngine:
+    """Slot-based online serving over one SSM parameter pytree.
+
+    :param params: :func:`~elephas_tpu.models.ssm.init_ssm_params` tree
+    :param config: the model's :class:`~elephas_tpu.models.ssm.SSMConfig`
+    :param max_slots: device batch width (concurrent requests)
+    :param temperature: default sampling temperature (0 = greedy,
+        parity with ``ssm_generate``); overridable per request
+    :param eos_id: optional stop token (not part of the output)
+    :param steps_per_sync: decode steps fused per dispatch (one
+        ``lax.scan``) — same dispatch-latency lever as the transformer
+        engine's; per-slot output is unchanged.
+    :param prefill_chunk: prefill prompts in fixed-size pieces (the
+        recurrence continues across chunks through the carried state),
+        bounding admission compiles to at most ``prefill_chunk`` shapes.
+    """
+
+    def __init__(self, params: Dict, config: SSMConfig,
+                 max_slots: int = 8, temperature: float = 0.0,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 steps_per_sync: int = 1,
+                 prefill_chunk: Optional[int] = None):
+        self.params = params
+        self.config = config
+        self.max_slots = int(max_slots)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.steps_per_sync = int(steps_per_sync)
+        if self.steps_per_sync < 1:
+            raise ValueError("steps_per_sync must be >= 1")
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self._key = jax.random.PRNGKey(seed)
+        self.state = init_ssm_state(config, self.max_slots)
+        self._last = np.zeros(self.max_slots, np.int32)
+        self._budget = np.zeros(self.max_slots, np.int32)
+        self._temp = np.full(self.max_slots, self.temperature, np.float32)
+        self._topk = np.zeros(self.max_slots, np.int32)    # 0 = off
+        self._topp = np.ones(self.max_slots, np.float32)   # 1 = off
+        self._rid: List[Optional[int]] = [None] * self.max_slots
+        self._queue: deque = deque()
+        self._outputs: Dict = {}
+        self._done: Dict = {}
+        self._fresh: Dict = {}
+        self._next_rid = 0
+        self._n_steps = 0
+        self._n_emitted = 0
+        self._n_finished = 0
+
+        c = config
+        n_sync = self.steps_per_sync
+
+        @jax.jit
+        def _prefill(params, prompt):
+            return ssm_prefill(params, prompt, c)
+
+        @jax.jit
+        def _prefill_cont(params, prompt, state):
+            return ssm_prefill(params, prompt, c, state=state)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _install(state, row_state, slot):
+            # the ENGINE state is donated (updated in place); the
+            # batch-1 prefill row is read-only
+            return jax.tree_util.tree_map(
+                lambda big, row: jax.lax.dynamic_update_index_in_dim(
+                    big, row[0], slot, 0), state, row_state)
+
+        def _one(params, state, last, temps, topk, topp, key):
+            # same scale-then-filter semantics as the transformer
+            # engine's shared sampling body (the lax.cond skips the
+            # filter work for all-greedy batches)
+            logits, state = ssm_decode_step(params, state, last, c)
+            key, sub = jax.random.split(key)
+            safe = jnp.maximum(temps, 1e-6)[:, None]
+            need = jnp.any(((topk > 0) | (topp < 1.0)) & (temps > 0))
+            filtered = jax.lax.cond(
+                need, lambda x: _filter_logits_rows(x, topk, topp),
+                lambda x: x, logits / safe)
+            sampled = jax.random.categorical(sub, filtered, axis=-1)
+            tok = jnp.where(temps > 0, sampled,
+                            jnp.argmax(logits, axis=-1))
+            return tok.astype(jnp.int32), state, key
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _step(params, state, last, temps, topk, topp, key):
+            tok, state, key = _one(params, state, last, temps, topk,
+                                   topp, key)
+            return tok[:, None], state, key            # (B, 1)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _multi_step(params, state, last, temps, topk, topp, key):
+            def body(carry, _):
+                state, tok, key = carry
+                nxt, state, key = _one(params, state, tok, temps, topk,
+                                       topp, key)
+                return (state, nxt, key), nxt
+
+            (state, _, key), toks = jax.lax.scan(
+                body, (state, last, key), None, length=n_sync)
+            return jnp.swapaxes(toks, 0, 1), state, key  # (B, K)
+
+        self._prefill_fn = _prefill
+        self._prefill_cont_fn = _prefill_cont
+        self._install_fn = _install
+        self._step_fn = (_multi_step if n_sync > 1 else _step)
+
+    # ------------------------------------------------------------ warmup
+    def warmup(self, prompt_lengths: Sequence[int] = ()):
+        """Compile the decode step and each length's admission prefill
+        before traffic (idle engine only) — the SSM analog of
+        :meth:`DecodeEngine.warmup`, zero extra device memory (the step
+        warms by donating the engine's own state)."""
+        if any(r is not None for r in self._rid) or self._queue:
+            raise RuntimeError("warmup() needs an idle engine")
+        _, self.state, _ = self._step_fn(
+            self.params, self.state, jnp.zeros(self.max_slots, jnp.int32),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jax.random.PRNGKey(0))
+        for length in sorted(set(int(n) for n in prompt_lengths)):
+            if length < 1:
+                raise ValueError(f"prompt length {length} out of range")
+            _, row = self._row_prefill(np.zeros(length, np.int32))
+            self.state = self._install_fn(self.state, row, 0)
+
+    # ------------------------------------------------------------ queue
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> int:
+        """Queue a request; per-request sampling settings mirror the
+        transformer engine's (so the HTTP server's request fields work
+        identically against either family)."""
+        if temperature is not None and not (
+                temperature >= 0 and np.isfinite(temperature)):
+            raise ValueError("temperature must be >= 0 and finite, "
+                             f"got {temperature}")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append((rid, prompt, int(max_new_tokens),
+                            self.temperature if temperature is None
+                            else float(temperature),
+                            0 if top_k is None else int(top_k),
+                            1.0 if top_p is None else float(top_p)))
+        self._admit()
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Same contract as the transformer engine's ``cancel``."""
+        for i, item in enumerate(self._queue):
+            if item[0] == rid:
+                del self._queue[i]
+                return True
+        for slot, r in enumerate(self._rid):
+            if r == rid:
+                self._outputs.pop(rid, None)
+                self._fresh.pop(rid, None)
+                self._rid[slot] = None
+                return True
+        return False
+
+    def _row_prefill(self, prompt: np.ndarray):
+        """Batch-1 prefill, chunked when ``prefill_chunk`` bounds the
+        compile shapes (the recurrence carries across chunks)."""
+        chunk = self.prefill_chunk
+        if chunk is None or prompt.size <= chunk:
+            return self._prefill_fn(self.params,
+                                    jnp.asarray(prompt[None]))
+        logits = row = None
+        for start in range(0, prompt.size, chunk):
+            blk = jnp.asarray(prompt[None, start:start + chunk])
+            if row is None:
+                logits, row = self._prefill_fn(self.params, blk)
+            else:
+                logits, row = self._prefill_cont_fn(self.params, blk,
+                                                    row)
+        return logits, row
+
+    def _admit(self):
+        for slot in range(self.max_slots):
+            if self._rid[slot] is not None:
+                continue
+            if not self._queue:
+                return
+            rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
+            logits, row = self._row_prefill(prompt)
+            self.state = self._install_fn(self.state, row, slot)
+            if temp > 0:
+                self._key, sub = jax.random.split(self._key)
+                filt = _filter_logits_rows(
+                    logits / temp, jnp.asarray([topk], jnp.int32),
+                    jnp.asarray([topp], jnp.float32))[0]
+                t0 = int(jax.random.categorical(sub, filt))
+            else:
+                t0 = int(jnp.argmax(logits[0]))
+            self._rid[slot] = rid
+            self._outputs[rid] = []
+            self._last[slot] = t0
+            self._budget[slot] = max_new
+            self._temp[slot] = temp
+            self._topk[slot] = topk
+            self._topp[slot] = topp
+            if self._record(slot, t0):
+                self._fresh[rid] = t0
+
+    def _record(self, slot: int, tok: int) -> bool:
+        rid = self._rid[slot]
+        if self.eos_id is not None and tok == self.eos_id:
+            self._finish(slot)
+            return False
+        self._outputs[rid].append(tok)
+        self._n_emitted += 1
+        self._budget[slot] -= 1
+        if self._budget[slot] <= 0:
+            self._finish(slot)
+        return True
+
+    def _finish(self, slot: int):
+        rid = self._rid[slot]
+        self._done[rid] = self._outputs.pop(rid)
+        self._rid[slot] = None
+        self._n_finished += 1
+
+    # ------------------------------------------------------------- step
+    @property
+    def pending(self) -> int:
+        return (len(self._queue)
+                + sum(r is not None for r in self._rid)
+                + len(self._fresh))
+
+    def step(self) -> Dict[int, List[int]]:
+        """Advance every active slot by ``steps_per_sync`` tokens;
+        returns ``{rid: [tokens]}`` emitted since the last call."""
+        self._admit()
+        emitted = {rid: [tok] for rid, tok in self._fresh.items()}
+        self._fresh = {}
+        active = np.asarray([r is not None for r in self._rid])
+        if not active.any():
+            return emitted
+        self._n_steps += 1
+        toks, self.state, self._key = self._step_fn(
+            self.params, self.state, jnp.asarray(self._last),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), self._key)
+        toks = np.asarray(toks)                        # (B, K)
+        for slot in np.nonzero(active)[0]:
+            rid = self._rid[slot]
+            for tok in toks[slot]:
+                if self._rid[slot] is None:
+                    break                  # retired mid-chunk
+                self._last[slot] = tok
+                if self._record(slot, int(tok)):
+                    emitted.setdefault(rid, []).append(int(tok))
+        self._admit()
+        return emitted
+
+    def run(self, requests: Sequence[Sequence[int]],
+            max_new_tokens: int) -> List[List[int]]:
+        rids = [self.submit(p, max_new_tokens) for p in requests]
+        while self.pending:
+            self.step()
+        return [self.result(r) for r in rids]
+
+    def result(self, rid: int) -> Optional[List[int]]:
+        return self._done.pop(rid, None)
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        return {"steps": self._n_steps,
+                "tokens_emitted": self._n_emitted,
+                "requests_finished": self._n_finished,
+                "tokens_per_step": (self._n_emitted / self._n_steps
+                                    if self._n_steps else 0.0)}
