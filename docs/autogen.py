@@ -99,6 +99,7 @@ PAGES = [
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
+    ("SSMModel", "elephas_tpu.models.ssm_model", ["SSMModel"]),
     ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",
      ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
       "make_ssm_train_step", "init_ssm_state", "ssm_decode_step",

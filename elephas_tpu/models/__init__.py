@@ -22,4 +22,5 @@ from .quantization import (QTensor, dequantize_lm_params,
 from .resnet import (build_resnet, build_resnet8, build_resnet50,
                      build_resnet_imagenet)
 from .saving import load_model, save_model
+from .ssm_model import SSMModel
 from .transformer_model import TransformerModel

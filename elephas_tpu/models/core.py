@@ -879,4 +879,7 @@ def model_from_json(json_string: str,
     if class_name == "TransformerModel":
         from .transformer_model import TransformerModel
         return TransformerModel.from_config(config, custom_objects)
+    if class_name == "SSMModel":
+        from .ssm_model import SSMModel
+        return SSMModel.from_config(config, custom_objects)
     raise ValueError(f"Unknown model class: {class_name!r}")
