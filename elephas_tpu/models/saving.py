@@ -95,6 +95,39 @@ def _config_registry():
     return _CONFIG_CLASSES
 
 
+def pack_training_state(params, opt_state) -> Dict:
+    """THE checkpoint payload for the LM families (params + flattened
+    optimizer leaves) — one encoding, shared by every model class."""
+    import jax
+
+    leaves = (jax.tree_util.tree_leaves(opt_state)
+              if opt_state is not None else [])
+    return {"params": params,
+            "opt_state_leaves": {f"leaf_{i}": leaf
+                                 for i, leaf in enumerate(leaves)}}
+
+
+def unpack_training_state(state: Dict, tx, params_template):
+    """Inverse of :func:`pack_training_state`: returns ``(params,
+    opt_state)``; ``opt_state`` is None when the checkpoint carried no
+    optimizer leaves. ``tx`` may be None only in that case."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+    leaves_dict = state.get("opt_state_leaves") or {}
+    if not leaves_dict:
+        return params, None
+    if tx is None:
+        raise RuntimeError("checkpoint contains optimizer state but the "
+                           "model is not compiled — compile() first")
+    ref = tx.init(params)
+    treedef = jax.tree_util.tree_structure(ref)
+    leaves = [jnp.asarray(leaves_dict[f"leaf_{i}"])
+              for i in range(len(leaves_dict))]
+    return params, jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def config_to_dict(config) -> Dict:
     """Serialize a TransformerConfig / ViTConfig / BertConfig to a plain
     JSON-able dict (dtypes by numpy name, class recorded) — the manifest

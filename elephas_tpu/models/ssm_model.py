@@ -33,9 +33,14 @@ class SSMModel:
         self.data_axis = data_axis
         self.name = name
         self.params: Optional[Dict] = None
+        self.optimizer = None
+        self.loss: Optional[str] = None
+        self.metrics: list = []
         self._tx = None
         self._opt_state = None
         self._step_fn = None
+        self._jit_forward = None
+        self._jit_loss = None
         self.stop_training = False
 
     # ----------------------------------------------------------- build
@@ -56,8 +61,22 @@ class SSMModel:
         resolved through the shared registry)."""
         from . import optimizers as optimizers_mod
 
-        self._tx = optimizers_mod.get(optimizer).to_optax()
+        self.optimizer = optimizers_mod.get(optimizer)
+        self.loss = "lm_cross_entropy"
+        self._tx = self.optimizer.to_optax()
         self._opt_state = None
+        self._step_fn = None
+        return self
+
+    @property
+    def compiled(self) -> bool:
+        return self._tx is not None
+
+    def attach_mesh(self, mesh):
+        """Point training at a device mesh (dp over ``data_axis``) and
+        invalidate every mesh-dependent cache — the one place that
+        knows which caches a mesh change touches."""
+        self.mesh = mesh
         self._step_fn = None
         return self
 
@@ -84,10 +103,13 @@ class SSMModel:
     # ------------------------------------------------------------- fit
     def fit(self, tokens: np.ndarray, epochs: int = 1,
             batch_size: int = 32, verbose: int = 0, shuffle: bool = True,
-            seed: int = 0, callbacks=None) -> Dict:
+            seed: int = 0, callbacks=None,
+            validation_split: float = 0.0) -> Dict:
         """Next-token training over ``(N, T)`` token rows. Returns a
         Keras-style history dict; callbacks get real per-epoch hooks
-        (checkpoint/early-stop/preemption all work unchanged)."""
+        (checkpoint/early-stop/preemption all work unchanged).
+        ``validation_split`` holds out the trailing fraction of rows and
+        reports ``val_loss`` per epoch."""
         from .callbacks import CallbackList
 
         if self._tx is None:
@@ -95,6 +117,10 @@ class SSMModel:
         if not self.built:
             self.build(seed=seed)
         tokens = np.asarray(tokens)
+        val_tokens = None
+        if validation_split and 0.0 < validation_split < 1.0:
+            split_at = int(len(tokens) * (1.0 - validation_split))
+            tokens, val_tokens = tokens[:split_at], tokens[split_at:]
         if self._step_fn is None:
             self._step_fn = make_ssm_train_step(
                 self.config, self._tx, mesh=self.mesh,
@@ -110,6 +136,12 @@ class SSMModel:
             raise ValueError(f"need at least one full batch "
                              f"({len(tokens)} rows < batch_size "
                              f"{batch_size})")
+        if self.mesh is not None:
+            dp = self.mesh.shape.get(self.data_axis, 1)
+            if batch_size % dp:
+                raise ValueError(
+                    f"batch_size {batch_size} must divide over the "
+                    f"data-parallel axis ({dp} devices)")
 
         cbs = CallbackList(callbacks, self)
         self.stop_training = False
@@ -133,55 +165,80 @@ class SSMModel:
                     losses.append(loss)
                 epoch_loss = float(np.mean([float(l) for l in losses]))
                 history["loss"].append(epoch_loss)
+                logs = {"loss": epoch_loss}
+                if val_tokens is not None:
+                    logs["val_loss"] = self.evaluate(val_tokens)
+                    history.setdefault("val_loss", []).append(
+                        logs["val_loss"])
                 if verbose:
-                    print(f"Epoch {epoch + 1}/{epochs} - "
-                          f"loss: {epoch_loss:.4f}")
-                cbs.epoch_end(epoch, {"loss": epoch_loss})
+                    print(f"Epoch {epoch + 1}/{epochs} - " + " - ".join(
+                        f"{k}: {v:.4f}" for k, v in logs.items()))
+                cbs.epoch_end(epoch, logs)
                 if self.stop_training:
                     break
         finally:
             cbs.train_end()   # flushes async checkpoint writes
         return history
 
-    def evaluate(self, tokens: np.ndarray) -> float:
-        """Mean next-token loss over ``(N, T)`` rows."""
-        return float(ssm_lm_loss(self.params, jnp.asarray(tokens),
-                                 self.config))
+    def evaluate(self, tokens: np.ndarray, y=None,
+                 batch_size: Optional[int] = None, **_) -> float:
+        """Mean next-token loss over ``(N, T)`` rows, computed in
+        ``batch_size`` chunks so eval memory is bounded (``y`` ignored —
+        LM targets are the shifted input; cross-family signature)."""
+        tokens = np.asarray(tokens)
+        bs = int(batch_size or 8)
+        if self._jit_loss is None:
+            config = self.config
+            self._jit_loss = jax.jit(
+                lambda p, t: ssm_lm_loss(p, t, config))
+        total = n = 0.0
+        for start in range(0, len(tokens), bs):
+            chunk = tokens[start:start + bs]
+            total += float(self._jit_loss(
+                self.params, jnp.asarray(chunk))) * len(chunk)
+            n += len(chunk)
+        return total / n
+
+    def predict(self, tokens: np.ndarray, batch_size: int = 8,
+                verbose: int = 0) -> np.ndarray:
+        """Logits ``(rows, seq, vocab)`` in input order (the same
+        contract as ``TransformerModel.predict``)."""
+        from .ssm import ssm_forward
+
+        tokens = np.asarray(tokens)
+        config = self.config
+        if self._jit_forward is None:
+            self._jit_forward = jax.jit(
+                lambda p, t: ssm_forward(p, t, config))
+        outs = [np.asarray(self._jit_forward(
+                    self.params, jnp.asarray(tokens[i:i + batch_size])))
+                for i in range(0, tokens.shape[0], batch_size)]
+        return np.concatenate(outs, axis=0)
 
     # ------------------------------------------------ checkpoint contract
     def training_state(self) -> Dict:
         """Same contract as the other model families', so
         :class:`~elephas_tpu.models.callbacks.ModelCheckpoint` drives
         this model unchanged."""
+        from .saving import pack_training_state
+
         if self.params is None:
             raise ValueError("build() before training_state()")
-        leaves = (jax.tree_util.tree_leaves(self._opt_state)
-                  if self._opt_state is not None else [])
-        return {"params": self.params,
-                "opt_state_leaves": {f"leaf_{i}": leaf
-                                     for i, leaf in enumerate(leaves)}}
+        return pack_training_state(self.params, self._opt_state)
 
     def restore_training_state(self, directory: str,
                                step: Optional[int] = None) -> Optional[int]:
         from ..utils.checkpoint import CheckpointManager
+        from .saving import unpack_training_state
 
         if not self.built:
             raise RuntimeError("build() before restore_training_state")
         manager = CheckpointManager(directory)
-        state = manager.restore(step)
-        self.params = jax.tree_util.tree_map(jnp.asarray,
-                                             state["params"])
-        leaves_dict = state.get("opt_state_leaves") or {}
-        if leaves_dict:
-            if self._tx is None:
-                raise RuntimeError("checkpoint holds optimizer state — "
-                                   "compile() first")
-            ref = self._tx.init(self.params)
-            treedef = jax.tree_util.tree_structure(ref)
-            leaves = [jnp.asarray(leaves_dict[f"leaf_{i}"])
-                      for i in range(len(leaves_dict))]
-            self._opt_state = jax.tree_util.tree_unflatten(treedef,
-                                                           leaves)
+        params, opt_state = unpack_training_state(manager.restore(step),
+                                                  self._tx, self.params)
+        self.params = params
+        if opt_state is not None:
+            self._opt_state = opt_state
         return step if step is not None else manager.latest_step()
 
     def to_json(self, **kwargs) -> str:

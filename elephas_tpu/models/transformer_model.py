@@ -190,13 +190,11 @@ class TransformerModel:
         """Same contract as ``BaseModel.training_state`` so
         :class:`~elephas_tpu.models.callbacks.ModelCheckpoint` drives this
         model unchanged."""
+        from .saving import pack_training_state
+
         if self.params is None:
             raise ValueError("Model must be built before training_state()")
-        leaves = (jax.tree_util.tree_leaves(self._opt_state)
-                  if self._opt_state is not None else [])
-        return {"params": self.params,
-                "opt_state_leaves": {f"leaf_{i}": leaf
-                                     for i, leaf in enumerate(leaves)}}
+        return pack_training_state(self.params, self._opt_state)
 
     def restore_training_state(self, directory: str,
                                step: Optional[int] = None) -> Optional[int]:
@@ -204,24 +202,17 @@ class TransformerModel:
         bit-exact resume (no layer renaming needed — the param pytree keys
         are positional and stable)."""
         from ..utils.checkpoint import CheckpointManager
+        from .saving import unpack_training_state
 
         if not self.built:
             raise RuntimeError("build()/compile() before "
                                "restore_training_state")
         manager = CheckpointManager(directory)
-        state = manager.restore(step)
-        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
-        leaves_dict = state.get("opt_state_leaves") or {}
-        if leaves_dict:
-            if self._tx is None:
-                raise RuntimeError(
-                    "checkpoint contains optimizer state but the model is "
-                    "not compiled — compile() first")
-            ref = self._tx.init(self.params)
-            treedef = jax.tree_util.tree_structure(ref)
-            leaves = [jnp.asarray(leaves_dict[f"leaf_{i}"])
-                      for i in range(len(leaves_dict))]
-            self._opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        params, opt_state = unpack_training_state(manager.restore(step),
+                                                  self._tx, self.params)
+        self.params = params
+        if opt_state is not None:
+            self._opt_state = opt_state
         return step if step is not None else manager.latest_step()
 
     # -------------------------------------------------------- serialization

@@ -298,11 +298,12 @@ class TPUModel:
         :param dataset: pair :class:`Dataset` or ``(features, labels)``
         :param epochs, batch_size, verbose, validation_split: as in Keras
         """
+        from .models.ssm_model import SSMModel
         from .models.transformer_model import TransformerModel
         from .parallel.multihost import ensure_multihost
 
         ensure_multihost()
-        if isinstance(self._master_network, TransformerModel):
+        if isinstance(self._master_network, (TransformerModel, SSMModel)):
             self._fit_transformer(dataset, **kwargs)
             return
         ds = self._as_dataset(dataset)
@@ -369,17 +370,30 @@ class TPUModel:
                          batch_size: Optional[int] = None,
                          verbose: int = 0, validation_split: float = 0.1,
                          **kwargs):
-        """Train the flagship :class:`TransformerModel` through the same
-        callback/history/checkpoint plumbing as the Keras-style models.
+        """Train an LM family (:class:`TransformerModel` /
+        :class:`SSMModel`) through the same callback/history/checkpoint
+        plumbing as the Keras-style models.
 
-        Transformer training is per-step synchronous SGD over the dp×tp
-        mesh (the ``sync_mode='step'`` semantics); parameter-server modes
+        LM training is per-step synchronous SGD over the device mesh
+        (the ``sync_mode='step'`` semantics); parameter-server modes
         target the delta-exchange Keras-style models."""
         if self.mode != "synchronous":
             raise ValueError(
-                "TransformerModel trains synchronously (per-step sync SGD "
+                "LM families train synchronously (per-step sync SGD "
                 "over the device mesh); asynchronous/hogwild parameter-"
                 "server modes apply to the Keras-style models")
+        from .models.ssm_model import SSMModel
+
+        import jax
+
+        net = self._master_network
+        if (isinstance(net, SSMModel) and net.mesh is None
+                and len(jax.devices()) > 1):
+            # hand the SSM its dp mesh (TransformerModel builds its own)
+            from jax.sharding import Mesh
+
+            net.attach_mesh(Mesh(np.array(jax.devices()),
+                                 (net.data_axis,)))
         # TransformerModel.fit owns the callback plumbing (CallbackList,
         # stop_training, train_begin/end) — one implementation, not two
         history = self._master_network.fit(
@@ -672,10 +686,11 @@ class TPUModel:
     def predict(self, data: Union[Dataset, np.ndarray],
                 batch_size: Optional[int] = None) -> np.ndarray:
         """Distributed inference; returns predictions in input order."""
+        from .models.ssm_model import SSMModel
         from .models.transformer_model import TransformerModel
         from .parallel.sync_trainer import build_sharded_predict
 
-        if isinstance(self._master_network, TransformerModel):
+        if isinstance(self._master_network, (TransformerModel, SSMModel)):
             return self._master_network.predict(
                 self._extract_tokens(data),
                 batch_size=batch_size or self.batch_size)
@@ -696,10 +711,11 @@ class TPUModel:
                  **kwargs) -> Union[List[float], float]:
         """Distributed evaluation: sample-count-weighted loss/metric means
         (parity: ``elephas/spark_model.py:274-308``)."""
+        from .models.ssm_model import SSMModel
         from .models.transformer_model import TransformerModel
         from .parallel.sync_trainer import build_sharded_evaluate
 
-        if isinstance(self._master_network, TransformerModel):
+        if isinstance(self._master_network, (TransformerModel, SSMModel)):
             return self._master_network.evaluate(
                 np.asarray(x_test),
                 batch_size=kwargs.get("batch_size", self.batch_size))
