@@ -160,14 +160,13 @@ class BaseModel:
     def training_state(self) -> Dict:
         """Full resumable training state as a dict-of-arrays pytree:
         model params plus the optimizer state's leaves (dict-keyed, so
-        both the orbax and npz checkpoint backends can store it)."""
+        both the orbax and npz checkpoint backends can store it) — the
+        one shared encoding (``saving.pack_training_state``)."""
+        from .saving import pack_training_state
+
         if self.params is None:
             raise ValueError("Model must be built before training_state()")
-        leaves = (jax.tree_util.tree_leaves(self._opt_state)
-                  if self._opt_state is not None else [])
-        return {"params": self.params,
-                "opt_state_leaves": {f"leaf_{i}": leaf
-                                     for i, leaf in enumerate(leaves)}}
+        return pack_training_state(self.params, self._opt_state)
 
     def restore_training_state(self, directory: str,
                                step: Optional[int] = None) -> Optional[int]:
