@@ -1,6 +1,6 @@
 """Benchmark: framework training throughput on real hardware.
 
-Two workloads:
+Workloads (each an independently-captured ROW — see "Tunnel resilience"):
 
 1. **MNIST-MLP sync-step** (the reference's canonical config,
    ``examples/mnist_mlp_spark_synchronous.py``): samples/sec of
@@ -10,24 +10,33 @@ Two workloads:
 2. **Transformer LM** (the flagship model): tokens/sec and **MFU**
    (model FLOPs / chip peak FLOPs) of a jitted train step, measured for
    the Pallas flash-attention path AND the XLA attention path so the
-   kernel's win is a number, not a claim.
+   kernel's win is a number, not a claim; plus the chunked-vocab-loss
+   A/B and a batch-32 probe.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": R,
      "transformer": {"tokens_per_sec": T, "mfu": M,
-                     "xla_tokens_per_sec": Tx, "flash_speedup": S, ...}}
+                     "xla_tokens_per_sec": Tx, "flash_speedup": S, ...},
+     "rows": {row_name: captured_at_iso, ...}}
 where vs_baseline = framework_throughput / pure_jax_throughput.
 
-**Tunnel resilience** (this environment reaches its one TPU chip through
-a tunnel that can hang — not error — for hours): the default entry point
-is an orchestrator that runs the actual measurement in a *subprocess*
-with a hard timeout, retries with backoff across a bounded window
-(``ELEPHAS_BENCH_WINDOW_SEC``, default 1500s; per-attempt cap
-``ELEPHAS_BENCH_ATTEMPT_SEC``, default 600s), and — if no attempt
-succeeds — falls back to the last successful on-chip numbers
-(``benchmarks/last_good.json``) with ``"stale": true`` so one tunnel
-flap does not erase the round's perf record. ``python bench.py --child``
-runs the measurement directly.
+**Tunnel resilience — resumable per-row capture** (this environment
+reaches its one TPU chip through a tunnel that serves short healthy
+windows between hangs): each row runs in its own subprocess under its
+own hard timeout (``ELEPHAS_BENCH_ROW_SEC``, default 300s) and its
+result is checkpointed to ``benchmarks/bench_rows.json`` the moment it
+lands. A later invocation — the driver's retry, the tunnel watcher's
+refresh, the next healthy window — skips rows already captured within
+``ELEPHAS_BENCH_ROW_TTL`` (default 6h) and runs only what's missing, so
+progress accumulates across attempts instead of resetting. A cheap
+backend probe gates each pass so a wedged tunnel costs one probe
+timeout, not a row timeout per row. If, when the window
+(``ELEPHAS_BENCH_WINDOW_SEC``, default 1500s) closes, the headline row
+was never captured fresh, the last successful on-chip numbers
+(``benchmarks/last_good.json``) are emitted with ``"stale": true`` so
+one tunnel flap does not erase the round's perf record.
+
+``python bench.py --row NAME [args]`` runs one row directly.
 """
 import json
 import os
@@ -37,8 +46,10 @@ import time
 
 import numpy as np
 
-_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "benchmarks", "last_good.json")
+_BENCH_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks")
+_LAST_GOOD = os.path.join(_BENCH_DIR, "last_good.json")
+_ROW_STORE = os.path.join(_BENCH_DIR, "bench_rows.json")
 
 #: advertised peak dense-matmul TFLOP/s per JAX device (bf16), by device
 #: kind prefix — the MFU denominator. v2/v3 expose one device per CORE
@@ -196,68 +207,100 @@ def bench_transformer(attention_impl: str, steps: int = 20,
     return tokens_per_sec, mfu
 
 
-def child_main():
-    import jax
+# ---------------------------------------------------------------------------
+# Row children — each prints one JSON line and exits.
+# ---------------------------------------------------------------------------
 
+def _env_fields():
+    import jax
+    return {"backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "?")}
+
+
+def row_mnist():
     batch_size = 64
     x, y = _data()
     framework = bench_framework(x, y, batch_size)
     pure = bench_pure_jax(x, y, batch_size)
+    return {"metric": "mnist_mlp_sync_samples_per_sec",
+            "value": round(framework, 1), "unit": "samples/sec",
+            "vs_baseline": round(framework / pure, 4), **_env_fields()}
 
-    result = {
-        "metric": "mnist_mlp_sync_samples_per_sec",
-        "value": round(framework, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(framework / pure, 4),
-        "backend": jax.default_backend(),
-        "device": getattr(jax.devices()[0], "device_kind", "?"),
-    }
 
-    xla_tps, xla_mfu = bench_transformer("xla")
-    result["transformer"] = {
-        "tokens_per_sec": round(xla_tps, 1),
-        "mfu": round(xla_mfu, 4),
-        "xla_tokens_per_sec": round(xla_tps, 1),
-        "config": "L8 d1024 ff4096 h16 seq1024 batch8 bf16 adamw",
-    }
-    if jax.default_backend() == "tpu":
-        # the Pallas kernel only exists on TPU; elsewhere a "flash" run
-        # would silently re-measure XLA and report noise as a speedup
-        flash_tps, flash_mfu = bench_transformer("flash")
-        if flash_tps >= xla_tps:
-            result["transformer"]["tokens_per_sec"] = round(flash_tps, 1)
-            result["transformer"]["mfu"] = round(flash_mfu, 4)
-        result["transformer"]["flash_tokens_per_sec"] = round(flash_tps, 1)
-        result["transformer"]["flash_speedup"] = round(flash_tps / xla_tps, 4)
-        # chunked-vocab streamed loss: trades the (B,T,V) f32 logits HBM
-        # round-trip for a scanned logsumexp — measure, promote only if
-        # it wins on this chip
-        best_attn = "flash" if flash_tps >= xla_tps else "xla"
-        chunk_tps, chunk_mfu = bench_transformer(best_attn,
-                                                 loss_vocab_chunk=8192)
-        result["transformer"]["chunked_loss_tokens_per_sec"] = round(
-            chunk_tps, 1)
-        result["transformer"]["chunked_loss_attention"] = best_attn
-        if chunk_tps > result["transformer"]["tokens_per_sec"]:
-            result["transformer"]["tokens_per_sec"] = round(chunk_tps, 1)
-            result["transformer"]["mfu"] = round(chunk_mfu, 4)
-            result["transformer"]["config"] += (
-                f" {best_attn}-attention chunked-vocab-loss")
-        # batch-32 probe: the BASELINE row is defined at batch 8, but the
-        # 8x1024 = 8k-token step underfeeds the MXU; this shows the
-        # chip's achievable MFU when the step is fed properly
-        best_chunk = (8192 if chunk_tps > max(flash_tps, xla_tps)
-                      else None)
-        b32_tps, b32_mfu = bench_transformer(best_attn, steps=10,
-                                             loss_vocab_chunk=best_chunk,
-                                             batch=32)
-        result["transformer"]["b32_tokens_per_sec"] = round(b32_tps, 1)
-        result["transformer"]["b32_mfu"] = round(b32_mfu, 4)
-    print(json.dumps(result))
+def row_tx(attn: str, chunk=None, batch: int = 8, steps: int = 20):
+    tps, mfu = bench_transformer(attn, steps=steps, loss_vocab_chunk=chunk,
+                                 batch=batch)
+    return {"metric": "transformer_tokens_per_sec", "value": round(tps, 1),
+            "unit": "tokens/sec", "mfu": round(mfu, 4), "attention": attn,
+            "loss_vocab_chunk": chunk, "batch": batch, **_env_fields()}
+
+
+def run_row_child(argv):
+    if not argv:
+        raise SystemExit("usage: bench.py --row "
+                         "{mnist|tx_xla|tx_flash|tx_chunked ATTN"
+                         "|tx_b32 ATTN CHUNK}")
+    name = argv[0]
+    if name == "mnist":
+        out = row_mnist()
+    elif name == "tx_xla":
+        out = row_tx("xla")
+    elif name == "tx_flash":
+        out = row_tx("flash")
+    elif name == "tx_chunked":
+        if len(argv) < 2:
+            raise SystemExit("usage: bench.py --row tx_chunked {flash|xla}")
+        out = row_tx(argv[1], chunk=8192)
+    elif name == "tx_b32":
+        if len(argv) < 3:
+            raise SystemExit(
+                "usage: bench.py --row tx_b32 {flash|xla} {8192|none}")
+        chunk = int(argv[2]) if argv[2] != "none" else None
+        out = row_tx(argv[1], chunk=chunk, batch=32, steps=10)
+    else:
+        raise SystemExit(f"unknown row {name!r}")
+    print(json.dumps(out))
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator — resumable per-row capture.
+# ---------------------------------------------------------------------------
+
+def _now_iso():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _load_rows(ttl: float) -> dict:
+    """Row store entries younger than ttl: {name: {"t", "at", "result"}}."""
+    try:
+        with open(_ROW_STORE) as f:
+            store = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    now = time.time()
+    return {k: v for k, v in store.items()
+            if isinstance(v, dict) and now - v.get("t", 0) <= ttl}
+
+
+def _save_row(name: str, entry: dict):
+    try:
+        try:
+            with open(_ROW_STORE) as f:
+                store = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            store = {}
+        store[name] = entry
+        os.makedirs(_BENCH_DIR, exist_ok=True)
+        tmp = _ROW_STORE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(store, f, indent=1)
+        os.replace(tmp, _ROW_STORE)
+    except OSError:
+        pass  # read-only checkout: the in-memory copy still gets emitted
 
 
 def _parse_result(stdout: str):
-    """Last stdout line that parses as the result JSON, or None."""
+    """Last stdout line that parses as a result JSON, or None."""
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
@@ -271,59 +314,188 @@ def _parse_result(stdout: str):
     return None
 
 
+def _probe(timeout: float = 90.0) -> str:
+    """Cheap gate before burning row timeouts. Returns:
+    ``"ok"`` — a real TPU backend is up; ``"no-tpu"`` — the backend came
+    up promptly but is not TPU (this host will never produce a chip
+    number, retrying is pointless); ``"down"`` — the probe hung (the
+    tunnel's wedge signature) or errored."""
+    start = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return "down"
+    if proc.returncode == 0:
+        return "ok"
+    quick = time.monotonic() - start < min(30.0, timeout)
+    failed_assert = "AssertionError" in (proc.stderr or "")[-4096:]
+    return "no-tpu" if (quick and failed_assert) else "down"
+
+
+def _capture_row(name: str, extra, timeout: float):
+    """Run one row child; checkpoint + return its result on success."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--row", name,
+             *extra],
+            capture_output=True, text=True, timeout=timeout)
+        result = _parse_result(proc.stdout)
+        err = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
+    except subprocess.TimeoutExpired:
+        result, err = None, ["row timed out"]
+    if result is None:
+        print(f"# row {name} failed: {err}", file=sys.stderr)
+        return None
+    if result.get("backend") != "tpu":
+        # a CPU-fallback run must never be recorded as a chip number;
+        # stale real-chip numbers beat fresh host numbers here
+        print(f"# row {name} ran on {result.get('backend')}, not tpu — "
+              f"discarded", file=sys.stderr)
+        return None
+    entry = {"t": time.time(), "at": _now_iso(), "result": result}
+    _save_row(name, entry)
+    print(f"# row {name} captured", file=sys.stderr)
+    return entry
+
+
+def _plan(rows: dict):
+    """Rows still to capture, in order, with their child args. Dependent
+    rows (chunked-loss / b32 config choices) only appear once their
+    prerequisites are in the store."""
+    todo = []
+    if "mnist" not in rows:
+        todo.append(("mnist", []))
+    if "tx_xla" not in rows:
+        todo.append(("tx_xla", []))
+    if "tx_flash" not in rows:
+        todo.append(("tx_flash", []))
+    if "tx_xla" in rows and "tx_flash" in rows:
+        xla = rows["tx_xla"]["result"]["value"]
+        flash = rows["tx_flash"]["result"]["value"]
+        best_attn = "flash" if flash >= xla else "xla"
+        if "tx_chunked" not in rows:
+            todo.append(("tx_chunked", [best_attn]))
+        else:
+            chunk_won = rows["tx_chunked"]["result"]["value"] > max(xla,
+                                                                    flash)
+            if "tx_b32" not in rows:
+                todo.append(("tx_b32", [best_attn,
+                                        "8192" if chunk_won else "none"]))
+    return todo
+
+
+def _merge(rows: dict):
+    """Assemble the single output line from captured rows. Returns None
+    when the headline row is absent (caller falls back to last-good)."""
+    if "mnist" not in rows:
+        return None
+    result = dict(rows["mnist"]["result"])
+    t = {}
+    xla = rows.get("tx_xla", {}).get("result")
+    flash = rows.get("tx_flash", {}).get("result")
+    chunked = rows.get("tx_chunked", {}).get("result")
+    b32 = rows.get("tx_b32", {}).get("result")
+    if xla:
+        t["tokens_per_sec"] = xla["value"]
+        t["mfu"] = xla["mfu"]
+        t["xla_tokens_per_sec"] = xla["value"]
+        t["config"] = "L8 d1024 ff4096 h16 seq1024 batch8 bf16 adamw"
+    if flash and xla:
+        if flash["value"] >= t["tokens_per_sec"]:
+            t["tokens_per_sec"] = flash["value"]
+            t["mfu"] = flash["mfu"]
+        t["flash_tokens_per_sec"] = flash["value"]
+        t["flash_speedup"] = round(flash["value"] / xla["value"], 4)
+    if chunked:
+        t["chunked_loss_tokens_per_sec"] = chunked["value"]
+        t["chunked_loss_attention"] = chunked["attention"]
+        if xla and chunked["value"] > t["tokens_per_sec"]:
+            t["tokens_per_sec"] = chunked["value"]
+            t["mfu"] = chunked["mfu"]
+            t["config"] += (f" {chunked['attention']}-attention "
+                            f"chunked-vocab-loss")
+    if b32:
+        t["b32_tokens_per_sec"] = b32["value"]
+        t["b32_mfu"] = b32["mfu"]
+    if t:
+        result["transformer"] = t
+    result["rows"] = {name: rows[name]["at"] for name in rows}
+    return result
+
+
 def main():
-    """Orchestrator: bounded attempts + backoff + last-good fallback."""
+    """Orchestrator: probe-gated resumable rows + last-good fallback."""
     window = float(os.environ.get("ELEPHAS_BENCH_WINDOW_SEC", "1500"))
-    attempt_cap = float(os.environ.get("ELEPHAS_BENCH_ATTEMPT_SEC", "600"))
+    row_cap = float(os.environ.get("ELEPHAS_BENCH_ROW_SEC", "300"))
+    ttl = float(os.environ.get("ELEPHAS_BENCH_ROW_TTL", "21600"))
     deadline = time.monotonic() + window
     backoff = 30.0
-    attempt = 0
-    non_tpu_runs = 0
+    mem = {}  # fresh captures, kept in-memory too (store may be read-only)
+    no_tpu_probes = 0
     while True:
-        attempt += 1
-        budget = min(attempt_cap, max(60.0, deadline - time.monotonic()))
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=budget)
-            result = _parse_result(proc.stdout)
-        except subprocess.TimeoutExpired:
-            result = None
-            proc = None
-        if result is not None and result.get("backend") != "tpu":
-            # a CPU-fallback run must never be recorded as a chip number;
-            # stale real-chip numbers beat fresh host numbers here
-            print(f"# bench attempt {attempt} ran on "
-                  f"{result.get('backend')}, not tpu — discarded",
-                  file=sys.stderr)
-            result = None
-            non_tpu_runs += 1
-            if non_tpu_runs >= 2:
-                # the child completes fine but no TPU is configured —
-                # retrying cannot change that; emit the fallback now
-                # instead of idling through the whole window
+        rows = {**_load_rows(ttl), **mem}
+        if not _plan(rows):
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        verdict = _probe(timeout=min(90.0, max(10.0, remaining)))
+        if verdict == "no-tpu":
+            # the backend comes up fine but no TPU is configured —
+            # retrying cannot change that; emit the fallback now
+            # instead of idling through the whole window
+            no_tpu_probes += 1
+            print("# backend is up but not TPU", file=sys.stderr)
+            if no_tpu_probes >= 2:
                 break
-        if result is not None:
-            result["stale"] = False
-            result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                  time.gmtime())
-            try:
-                os.makedirs(os.path.dirname(_LAST_GOOD), exist_ok=True)
-                with open(_LAST_GOOD, "w") as f:
-                    json.dump(result, f, indent=1)
-            except OSError:
-                pass  # read-only checkout: still report the fresh numbers
-            print(json.dumps(result))
-            return 0
-        detail = ("attempt timed out" if proc is None else
-                  (proc.stderr or "").strip().splitlines()[-1:] or ["?"])
-        print(f"# bench attempt {attempt} failed: {detail}", file=sys.stderr)
+        progressed = False
+        if verdict == "ok":
+            # recompute the plan after every capture so dependent rows
+            # (chunked/b32 config choices) unlock within the same pass
+            while True:
+                todo = _plan(rows)
+                if not todo:
+                    break
+                budget = min(row_cap, deadline - time.monotonic())
+                if budget < 30.0:
+                    break
+                name, extra = todo[0]
+                entry = _capture_row(name, extra, budget)
+                if entry is None:
+                    break  # tunnel likely flapped mid-row: back to probing
+                mem[name] = rows[name] = entry
+                progressed = True
+        if progressed:
+            backoff = 30.0
+            continue
+        if verdict == "down":
+            print("# backend probe failed (tunnel down)", file=sys.stderr)
+        # back off whether the probe failed or a row did — a fast-failing
+        # row must not hammer the flaky tunnel for the whole window
         if time.monotonic() + backoff >= deadline:
             break
         time.sleep(backoff)
         backoff = min(backoff * 2, 300.0)
-    # window exhausted: emit the last on-chip numbers, marked stale, so
-    # the round keeps a perf record even when the tunnel is down
+
+    rows = {**_load_rows(ttl), **mem}
+    result = _merge(rows)
+    if result is not None:
+        result["stale"] = False
+        result["measured_at"] = _now_iso()
+        try:
+            os.makedirs(_BENCH_DIR, exist_ok=True)
+            with open(_LAST_GOOD, "w") as f:
+                json.dump(result, f, indent=1)
+        except OSError:
+            pass  # read-only checkout: still report the fresh numbers
+        print(json.dumps(result))
+        return 0
+    # window exhausted with no fresh headline: emit the last on-chip
+    # numbers, marked stale, so the round keeps a perf record even when
+    # the tunnel is down
     try:
         with open(_LAST_GOOD) as f:
             last = json.load(f)
@@ -338,7 +510,7 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv[1:]:
-        child_main()
+    if "--row" in sys.argv[1:]:
+        run_row_child(sys.argv[sys.argv.index("--row") + 1:])
     else:
         sys.exit(main())
