@@ -573,13 +573,19 @@ class DecodeEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               top_p: Optional[float] = None) -> int:
+               top_p: Optional[float] = None,
+               admit: bool = True) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
         ``temperature``/``top_k``/``top_p`` override the engine defaults
         for THIS request (plain stepping only — speculative mode samples
         every slot at the engine temperature, since the accept/resample
-        rule is compiled for one setting)."""
+        rule is compiled for one setting). ``admit=False`` skips the
+        immediate admission attempt entirely, deferring it — and any
+        prefill jit compile a new prompt length triggers — to the next
+        :meth:`step`; callers that serialize engine access behind a lock
+        (the HTTP server) use this so submitting never holds that lock
+        across a multi-second compile."""
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
@@ -622,7 +628,8 @@ class DecodeEngine:
                             else float(temperature),
                             0 if top_k is None else int(top_k),
                             1.0 if top_p is None else float(top_p)))
-        self._admit()
+        if admit:
+            self._admit()
         return rid
 
     def cancel(self, rid: int) -> bool:

@@ -72,6 +72,8 @@ class ServingServer:
         self._results: Dict[int, list] = {}
         self._tracked: set = set()             # rids the loop must watch
         self._streams: Dict[int, list] = {}    # live token feeds
+        self._waiters: set = set()             # rids with a blocked handler
+        self._failure: Optional[str] = None    # set when the loop dies
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads = []
@@ -106,7 +108,15 @@ class ServingServer:
             def do_GET(self):
                 url = urlparse(self.path)
                 if url.path == "/health":
-                    self._json(200, {"status": "ok"})
+                    # lock-free read: liveness must answer instantly even
+                    # while the engine loop holds the lock across a
+                    # prefill compile (attribute reads are atomic)
+                    failure = server._failure
+                    if failure is None:
+                        self._json(200, {"status": "ok"})
+                    else:
+                        self._json(500, {"status": "error",
+                                         "error": failure})
                 elif url.path == "/stats":
                     with server._lock:
                         self._json(200, dict(server.engine.stats))
@@ -195,32 +205,50 @@ class ServingServer:
     # ------------------------------------------------------------- engine
     def _engine_loop(self):
         """The single driver of the device program: steps whenever work
-        is pending, harvests finished requests, wakes blocked waiters."""
-        while not self._stop.is_set():
+        is pending, harvests finished requests, wakes blocked waiters.
+        If the engine itself raises, the failure is recorded (``/health``
+        turns 500, new submits are rejected), every in-flight request is
+        failed, and all blocked handlers are woken — a dead engine must
+        answer errors, not hang its clients."""
+        try:
+            while not self._stop.is_set():
+                with self._cond:
+                    emitted = {}
+                    if self.engine.pending:
+                        emitted = self.engine.step()
+                    for rid, toks in emitted.items():
+                        if rid in self._streams:
+                            self._streams[rid].extend(toks)
+                    if emitted:
+                        self._cond.notify_all()
+                    finished = []
+                    for rid in list(self._tracked):
+                        out = self.engine.result(rid)
+                        if out is not None:
+                            self._results[rid] = out
+                            finished.append(rid)
+                    if finished:
+                        self._tracked.difference_update(finished)
+                        while len(self._results) > self.max_stored_results:
+                            # abandoned submits: evict oldest unfetched —
+                            # but never a result a blocked /v1/generate
+                            # handler or live stream is about to claim
+                            victim = next(
+                                (r for r in self._results
+                                 if r not in self._waiters
+                                 and r not in self._streams), None)
+                            if victim is None:
+                                break
+                            self._results.pop(victim)
+                        self._cond.notify_all()
+                    idle = not self.engine.pending
+                if idle:
+                    time.sleep(_IDLE_SLEEP)
+        except Exception as exc:  # noqa: BLE001 — record ANY engine death
             with self._cond:
-                emitted = {}
-                if self.engine.pending:
-                    emitted = self.engine.step()
-                for rid, toks in emitted.items():
-                    if rid in self._streams:
-                        self._streams[rid].extend(toks)
-                if emitted:
-                    self._cond.notify_all()
-                finished = []
-                for rid in list(self._tracked):
-                    out = self.engine.result(rid)
-                    if out is not None:
-                        self._results[rid] = out
-                        finished.append(rid)
-                if finished:
-                    self._tracked.difference_update(finished)
-                    while len(self._results) > self.max_stored_results:
-                        # abandoned submits: evict oldest unfetched
-                        self._results.pop(next(iter(self._results)))
-                    self._cond.notify_all()
-                idle = not self.engine.pending
-            if idle:
-                time.sleep(_IDLE_SLEEP)
+                self._failure = f"{type(exc).__name__}: {exc}"
+                self._tracked.clear()
+                self._cond.notify_all()
 
     def _prompt_ids(self, body: Dict):
         if "prompt" in body:
@@ -232,21 +260,32 @@ class ServingServer:
             return self.tokenizer.encode(body["text"])
         raise ValueError('body needs "prompt" (token ids) or "text"')
 
-    def _submit(self, body: Dict, stream: bool = False) -> int:
+    def _submit(self, body: Dict, stream: bool = False,
+                waiter: bool = False) -> int:
         ids = self._prompt_ids(body)
         kwargs = {}
         for field in ("temperature", "top_k", "top_p"):
             if body.get(field) is not None:
                 kwargs[field] = body[field]
         with self._cond:
+            if self._failure is not None:
+                raise ValueError(f"engine failed: {self._failure}")
+            # admit=False: admission (and any prefill compile a new
+            # prompt length triggers) happens in the engine loop's next
+            # step, never while this handler holds the server-wide lock
             rid = self.engine.submit(
                 ids, int(body.get("max_new_tokens",
-                                  self.default_max_new_tokens)), **kwargs)
+                                  self.default_max_new_tokens)),
+                admit=False, **kwargs)
             self._tracked.add(rid)
             if stream:
                 # registered under the SAME lock as submit, so the very
                 # first engine-loop step already routes into the feed
                 self._streams[rid] = []
+            if waiter:
+                # likewise: the eviction guard must see this rid as
+                # waited-on before the engine loop can ever finish it
+                self._waiters.add(rid)
             return rid
 
     def _run_stream(self, rid: int, write_line):
@@ -279,7 +318,13 @@ class ServingServer:
                     write_line({"status": "done"})
                     return
                 if stopping or (gone and not toks):
-                    write_line({"status": "cancelled"})
+                    with self._cond:
+                        failure = self._failure
+                    if failure is not None:
+                        write_line({"status": "error",
+                                    "error": f"engine failed: {failure}"})
+                    else:
+                        write_line({"status": "cancelled"})
                     return
         finally:
             with self._cond:
@@ -302,17 +347,23 @@ class ServingServer:
         return out
 
     def _generate(self, body: Dict) -> Dict:
-        rid = self._submit(body)
+        rid = self._submit(body, waiter=True)
         with self._cond:
             # exit on completion OR when the rid vanishes (cancelled by
             # another client, or its result fetched/evicted) — a blocked
             # handler must never outlive its request
-            while rid not in self._results and rid in self._tracked:
-                self._cond.wait(timeout=0.5)
-                if self._stop.is_set():
-                    raise ValueError("server shutting down")
+            try:
+                while rid not in self._results and rid in self._tracked:
+                    self._cond.wait(timeout=0.5)
+                    if self._stop.is_set():
+                        raise ValueError("server shutting down")
+            finally:
+                self._waiters.discard(rid)
             if rid in self._results:
                 return self._finish_payload(self._results.pop(rid))
+            if self._failure is not None:
+                return {"status": "error", "id": rid,
+                        "error": f"engine failed: {self._failure}"}
             return {"status": "cancelled", "id": rid}
 
     def _poll(self, rid: int) -> Dict:
@@ -321,6 +372,9 @@ class ServingServer:
                 return self._finish_payload(self._results.pop(rid))
             if rid in self._tracked:
                 return {"status": "pending"}
+            if self._failure is not None:
+                return {"status": "error",
+                        "error": f"engine failed: {self._failure}"}
             return {"status": "unknown"}
 
     def _cancel(self, body: Dict) -> Dict:

@@ -89,6 +89,13 @@ class CheckpointManager:
         # same (main) thread and may interrupt a holder mid-section —
         # a non-reentrant lock would deadlock the final checkpoint
         self._pending_lock = threading.RLock()
+        # serializes _write bodies: the SIGTERM handler's blocking save
+        # can interrupt the main thread BETWEEN executor.submit and the
+        # _pending append, so its wait_until_finished may miss that
+        # in-flight future — this lock keeps the handler's write and the
+        # background write from interleaving on manifest.json anyway
+        # (RLock for the same same-thread-reentrancy reason as above)
+        self._write_lock = threading.RLock()
         if self._store is not None:
             # adopt an existing remote run's manifest (resume-from-URL)
             manifest_url = f"{self._remote_url}/manifest.json"
@@ -170,17 +177,29 @@ class CheckpointManager:
     def _write(self, step: int, state: Dict[str, Any],
                model_json: Optional[str],
                distributed_config: Optional[Dict]):
-        manifest = {"latest_step": int(step),
-                    "steps": self._steps_nowait() + [int(step)]}
+        with self._write_lock:
+            self._write_locked(int(step), state, model_json,
+                               distributed_config)
+
+    def _write_locked(self, step: int, state: Dict[str, Any],
+                      model_json: Optional[str],
+                      distributed_config: Optional[Dict]):
+        # Start from the existing manifest and overwrite known keys —
+        # a straggler write must carry forward everything it does not
+        # own (model/distributed_config AND annotate() markers like the
+        # preemption flag), and one read keeps the locked section short.
+        manifest = self._read_manifest()
+        prev_latest = manifest.get("latest_step")
+        # latest_step is monotonic: if the preemption handler's final
+        # write beat a still-queued older write to the lock, the older
+        # write must not regress the resume point
+        manifest["latest_step"] = (step if prev_latest is None
+                                   else max(int(prev_latest), step))
+        manifest["steps"] = list(manifest.get("steps", [])) + [int(step)]
         if model_json is not None:
             manifest["model"] = model_json
         if distributed_config is not None:
             manifest["distributed_config"] = distributed_config
-        else:
-            old = self._read_manifest()
-            for key in ("model", "distributed_config"):
-                if key in old and key not in manifest:
-                    manifest[key] = old[key]
         step_dir = self.directory / f"step_{int(step)}"
         if step_dir.exists():
             shutil.rmtree(step_dir)
@@ -229,12 +248,14 @@ class CheckpointManager:
         e.g. preemption markers. Flushes async saves first so the merge
         applies to the final manifest."""
         self.wait_until_finished()
-        manifest = self._read_manifest()
-        manifest.update(fields)
-        (self.directory / "manifest.json").write_text(json.dumps(manifest))
-        if self._store is not None and _is_coordinator():
-            self._store.write_text(f"{self._remote_url}/manifest.json",
-                                   json.dumps(manifest))
+        with self._write_lock:
+            manifest = self._read_manifest()
+            manifest.update(fields)
+            (self.directory / "manifest.json").write_text(
+                json.dumps(manifest))
+            if self._store is not None and _is_coordinator():
+                self._store.write_text(f"{self._remote_url}/manifest.json",
+                                       json.dumps(manifest))
 
     def manifest(self) -> Dict[str, Any]:
         self.wait_until_finished()

@@ -284,3 +284,67 @@ def test_transformer_model_serve_one_call():
         assert out["tokens"] == ref
     finally:
         srv.stop()
+
+
+def test_engine_failure_fails_fast_not_hangs(model):
+    """ADVICE r3: a raising engine.step() must not silently kill the
+    driver loop — /health turns 500, a blocked /v1/generate returns an
+    error payload instead of waiting forever, polls surface the
+    failure, and new submits are rejected."""
+    params, config = model
+    srv = ServingServer(DecodeEngine(params, config, max_slots=1))
+    srv.start()
+    try:
+        boom = RuntimeError("injected device loss")
+
+        def exploding_step():
+            raise boom
+
+        srv.engine.step = exploding_step
+        prompt = [1, 2, 3]
+        out = _post(srv.port, "/v1/generate",
+                    {"prompt": prompt, "max_new_tokens": 4})
+        assert out["status"] == "error"
+        assert "injected device loss" in out["error"]
+        # liveness now reports the failure (500 + error body)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/health")
+        assert err.value.code == 500
+        assert json.loads(err.value.read())["status"] == "error"
+        # a poll for the dead rid explains itself
+        assert _get(srv.port, "/v1/result?id=0")["status"] == "error"
+        # new submissions are refused with the failure, not queued
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.port, "/v1/submit", {"prompt": [1],
+                                           "max_new_tokens": 1})
+        assert err.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_eviction_never_takes_a_waiters_result(model):
+    """ADVICE r3: the finished-result cap must not evict a result whose
+    blocking /v1/generate handler hasn't woken yet — with a cap of 1 and
+    concurrent blocking clients, every client still gets its tokens."""
+    params, config = model
+    rng = np.random.default_rng(3)
+    prompts = [[int(t) for t in rng.integers(0, 300, 4 + i)]
+               for i in range(3)]
+    engine = DecodeEngine(params, config, max_slots=2)
+    with ServingServer(engine, max_stored_results=1) as srv:
+        results = {}
+
+        def call(i):
+            results[i] = _post(srv.port, "/v1/generate",
+                               {"prompt": prompts[i],
+                                "max_new_tokens": 6})
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, p in enumerate(prompts):
+            assert results[i].get("tokens") == _ref(params, config, p, 6), \
+                f"client {i} lost its result to eviction: {results[i]}"

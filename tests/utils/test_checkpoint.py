@@ -285,3 +285,18 @@ def test_preemption_uninstall_restores_handler(tmp_path):
     uninstall()
     assert signal.getsignal(signal.SIGTERM) == before
     assert manager.latest_step() is None   # nothing written without a signal
+
+
+def test_out_of_order_write_cannot_regress_latest(tmp_path):
+    """ADVICE r3 (preemption race): if an older queued write lands after
+    the handler's final write, the manifest's resume point must not move
+    backwards — latest_step is monotonic; the steps list keeps both."""
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=5)
+    state5 = {"w": np.arange(3.0)}
+    mgr.save(5, state5)                      # the "final" write
+    mgr._write(3, {"w": np.zeros(3)}, None, None)  # late older write
+    assert mgr.latest_step() == 5
+    assert mgr.steps() == [3, 5]
+    np.testing.assert_array_equal(mgr.restore()["w"], state5["w"])
