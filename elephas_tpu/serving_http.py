@@ -318,8 +318,10 @@ class ServingServer:
                     write_line({"status": "done"})
                     return
                 if stopping or (gone and not toks):
-                    with self._cond:
-                        failure = self._failure
+                    # lock-free like /health: the terminal status must
+                    # not wait out a compile the engine loop is holding
+                    # the lock across
+                    failure = self._failure
                     if failure is not None:
                         write_line({"status": "error",
                                     "error": f"engine failed: {failure}"})

@@ -163,10 +163,12 @@ class SSMEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               top_p: Optional[float] = None) -> int:
-        """Queue a request; per-request sampling settings mirror the
-        transformer engine's (so the HTTP server's request fields work
-        identically against either family)."""
+               top_p: Optional[float] = None,
+               admit: bool = True) -> int:
+        """Queue a request; per-request sampling settings and the
+        ``admit=False`` deferred-admission knob mirror the transformer
+        engine's (so the HTTP server's request fields work identically
+        against either family)."""
         if temperature is not None and not (
                 temperature >= 0 and np.isfinite(temperature)):
             raise ValueError("temperature must be >= 0 and finite, "
@@ -187,7 +189,8 @@ class SSMEngine:
                             else float(temperature),
                             0 if top_k is None else int(top_k),
                             1.0 if top_p is None else float(top_p)))
-        self._admit()
+        if admit:
+            self._admit()
         return rid
 
     def cancel(self, rid: int) -> bool:

@@ -47,6 +47,22 @@ def _temp_model_path(file_name: str) -> str:
     return str(uuid4()) + "-temp-model-file." + file_name.split(".")[-1]
 
 
+def _run_hadoop(cli, detail: str = ""):
+    """Run a ``hadoop fs`` command, raising on ANY failure — a missing
+    CLI or non-zero exit must never read as success (the reference
+    swallows both, ``spark_model.py:127-134``)."""
+    suffix = f" {detail}" if detail else ""
+    try:
+        proc = subprocess.run(cli, capture_output=True, text=True)
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"hadoop CLI not found — cannot run {' '.join(cli)}{suffix}")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{' '.join(cli[:3])} failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip() or proc.stdout.strip()}{suffix}")
+
+
 class _EpochAggregator:
     """Turns per-worker epoch completions into driver-level epoch_end.
 
@@ -255,7 +271,10 @@ class TPUModel:
             if overwrite:
                 cli.append("-f")
             cli.extend([file_name, cluster_file_path])
-            subprocess.run(cli)
+            # a failed put must raise, not silently "succeed" (the
+            # reference swallows this — spark_model.py:127-134 — but
+            # silent success on save is data loss)
+            _run_hadoop(cli, f"(local copy kept at {file_name})")
         elif remote_url is not None:
             store = get_store(remote_url)
             if not overwrite and store.exists(remote_url):
@@ -785,7 +804,7 @@ def load_tpu_model(file_name: str, from_hadoop: bool = False,
     temp_download = from_hadoop or remote
     if from_hadoop:
         temp_file = _temp_model_path(file_name)
-        subprocess.run(["hadoop", "fs", "-copyToLocal", file_name, temp_file])
+        _run_hadoop(["hadoop", "fs", "-copyToLocal", file_name, temp_file])
         file_name = temp_file
     elif remote:
         temp_file = _temp_model_path(file_name)

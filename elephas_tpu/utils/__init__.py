@@ -3,7 +3,6 @@ from .functional_utils import (add_params, divide_by, get_neutral,
                                tree_scale, tree_subtract, tree_zeros_like)
 from .model_utils import (LossModelTypeMapper, ModelType, ModelTypeEncoder,
                           as_enum)
-from .notebook_utils import is_running_in_notebook
 from .rwlock import RWLock
 from .serialization import dict_to_model, model_to_dict
 from .sockets import determine_master, receive, send

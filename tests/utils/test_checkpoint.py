@@ -295,8 +295,25 @@ def test_out_of_order_write_cannot_regress_latest(tmp_path):
 
     mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=5)
     state5 = {"w": np.arange(3.0)}
-    mgr.save(5, state5)                      # the "final" write
-    mgr._write(3, {"w": np.zeros(3)}, None, None)  # late older write
+    mgr.save(5, state5)                      # the "final" write (seq 0)
+    # late older write carrying an EARLIER save sequence (the straggler
+    # the handler's wait_until_finished missed)
+    mgr._write(3, {"w": np.zeros(3)}, None, None, seq=-5)
     assert mgr.latest_step() == 5
     assert mgr.steps() == [3, 5]
     np.testing.assert_array_equal(mgr.restore()["w"], state5["w"])
+
+
+def test_rollback_save_moves_latest_backwards(tmp_path):
+    """The straggler guard must NOT break deliberate rollback: restore
+    an older step, keep training, save a smaller step — that save is
+    the newest by request order, so it owns the resume point."""
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=5)
+    mgr.save(10, {"w": np.full(3, 10.0)})
+    mgr.restore(step=10)
+    state6 = {"w": np.full(3, 6.0)}
+    mgr.save(6, state6)                       # post-rollback run
+    assert mgr.latest_step() == 6
+    np.testing.assert_array_equal(mgr.restore()["w"], state6["w"])
