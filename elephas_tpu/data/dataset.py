@@ -13,10 +13,22 @@ Partitioning is contiguous and order-preserving (``np.array_split``
 semantics: partition sizes differ by at most one). Unlike Spark's shuffle
 repartition this keeps sample order stable, which makes order-preserving
 distributed predict exact by construction.
+
+Columns may also be file-backed :class:`~elephas_tpu.data.sources.
+ColumnSource` objects (:meth:`Dataset.from_npy`,
+:meth:`Dataset.from_parquet`): partitioning and host-shard slicing stay
+lazy views, and only the ranges a worker actually trains/predicts on
+are ever read into memory — the executor-resident analog of the
+reference's per-partition materialization (``elephas/worker.py:36-38``).
+See :mod:`~elephas_tpu.data.sources` for which paths stream O(batch)
+(``sync_mode='step'`` fit, predict, evaluate) vs materialize per-worker
+partitions (async workers, sync-average).
 """
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+from .sources import ColumnSource, NpySource, ParquetSource
 
 
 def _default_partitions() -> int:
@@ -41,7 +53,8 @@ class Dataset:
     def __init__(self, data: Union[Tuple[np.ndarray, ...], List[Any]],
                  num_partitions: Optional[int] = None):
         if isinstance(data, tuple):
-            columns = tuple(np.asarray(c) for c in data)
+            columns = tuple(c if isinstance(c, ColumnSource)
+                            else np.asarray(c) for c in data)
             if not columns:
                 raise ValueError("Dataset needs at least one column")
             n = columns[0].shape[0]
@@ -72,10 +85,36 @@ class Dataset:
         ys = np.asarray([p[1] for p in pairs])
         return cls((xs, ys), num_partitions=num_partitions)
 
+    @classmethod
+    def from_npy(cls, *paths: str,
+                 num_partitions: Optional[int] = None) -> "Dataset":
+        """File-backed dataset over memory-mapped ``.npy`` columns
+        (e.g. ``from_npy("x.npy", "y.npy")``). Reads are lazy: training,
+        prediction, and evaluation touch only the row ranges their
+        shards/batches need — the out-of-core path (SURVEY §7 step 5)."""
+        return cls(tuple(NpySource(p) for p in paths),
+                   num_partitions=num_partitions)
+
+    @classmethod
+    def from_parquet(cls, path: str, columns: Sequence[str],
+                     num_partitions: Optional[int] = None) -> "Dataset":
+        """File-backed dataset over Parquet columns (via pyarrow).
+        List-typed columns (fixed row width) become 2-D feature
+        matrices; reads decode one row group at a time."""
+        return cls(tuple(ParquetSource(path, c) for c in columns),
+                   num_partitions=num_partitions)
+
     # -- properties ----------------------------------------------------------
     @property
     def is_columnar(self) -> bool:
         return self._columns is not None
+
+    @property
+    def is_file_backed(self) -> bool:
+        """Whether any column is a lazy :class:`ColumnSource` (reads
+        stream from disk instead of living in process memory)."""
+        return self._columns is not None and any(
+            isinstance(c, ColumnSource) for c in self._columns)
 
     @property
     def columns(self) -> Tuple[np.ndarray, ...]:

@@ -1,0 +1,318 @@
+"""Out-of-core column sources — the executor-resident data plane.
+
+In the reference, training data *lives distributed*: an RDD is
+partitioned across executor JVMs and each worker materializes only its
+own partition (``elephas/spark_model.py:182-183``,
+``elephas/worker.py:36-38``). The TPU-native analog is file-backed
+columns with lazy, range-addressed reads: a :class:`ColumnSource` knows
+its shape/dtype up front but touches storage only when a concrete row
+range (a partition, a host shard, a training batch) is requested.
+Streaming paths over a file-backed
+:class:`~elephas_tpu.data.dataset.Dataset`:
+``TPUModel.fit(sync_mode='step')`` reads O(batch) at a time;
+``predict``/``evaluate`` read O(chunk); async/hogwild workers and the
+sync-average trainer materialize each worker's own partition (the
+reference's executor semantics) — O(this process's shards), and in a
+multi-host run each process reads only its own strided slice of the
+file. For data that dwarfs even one process's RAM, train with
+``sync_mode='step'``.
+
+Two backends:
+
+- :class:`NpySource` — memory-mapped ``.npy`` (zero-copy range reads;
+  the OS pages in only what's touched). The cheapest path for numeric
+  columns and the format the framework's own tooling writes.
+- :class:`ParquetSource` — one column of a Parquet file via pyarrow,
+  read row-group-at-a-time with a tiny LRU so sequential scans (fit
+  without shuffle, predict, evaluate) read each row group exactly once.
+  List/FixedSizeList columns become 2-D feature matrices.
+
+Sources are picklable by path: a spawned worker process reopens the
+file lazily on first read, which is what makes "each process reads only
+its slice" literal — no array ever rides the pickle.
+"""
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ColumnSource", "NpySource", "ParquetSource", "SourceView"]
+
+
+class ColumnSource:
+    """A lazily-read column with numpy-like indexing.
+
+    Subclasses implement :meth:`_read` (contiguous range ->
+    materialized ndarray) and :meth:`_take` (row indices -> ndarray),
+    plus ``shape``/``dtype``. Contiguous slices (``src[lo:hi]``) stay
+    lazy (:class:`SourceView`); integer/fancy indexing materializes
+    just those rows; ``np.asarray(src)`` materializes everything
+    (explicit opt-in).
+
+    Every read is routed through the ROOT source, which keeps
+    ``rows_read`` / ``max_read_rows`` counters — the memory-bound tests
+    assert on them, and they make "how much did this process actually
+    touch" observable in production too.
+    """
+
+    #: running counters (root sources only)
+    rows_read: int = 0
+    max_read_rows: int = 0
+
+    # -- to implement -----------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    @property
+    def dtype(self):
+        raise NotImplementedError
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def _take(self, idx: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- provided ---------------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 0
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def _count(self, nrows: int):
+        self.rows_read += int(nrows)
+        self.max_read_rows = max(self.max_read_rows, int(nrows))
+
+    def read(self, lo: int, hi: int) -> np.ndarray:
+        lo = max(0, int(lo))
+        hi = min(self.shape[0], int(hi))
+        if hi <= lo:
+            return np.zeros((0,) + self.shape[1:], dtype=self.dtype)
+        self._count(hi - lo)
+        return self._read(lo, hi)
+
+    def take(self, idx) -> np.ndarray:
+        idx = np.asarray(idx, dtype=np.int64)
+        self._count(idx.size)
+        return self._take(idx)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            lo, hi, step = key.indices(self.shape[0])
+            if step == 1:
+                return SourceView(self, lo, hi)
+            return self.take(np.arange(lo, hi, step))
+        if isinstance(key, (int, np.integer)):
+            i = int(key)
+            if i < 0:
+                i += self.shape[0]
+            return self.take(np.asarray([i]))[0]
+        return self.take(key)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self.read(0, self.shape[0])
+        return arr if dtype is None else arr.astype(dtype)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+class SourceView(ColumnSource):
+    """A contiguous, still-lazy window onto another source. Reads
+    delegate to the ROOT source (absolute offsets), so counters
+    accumulate in one place no matter how views nest."""
+
+    def __init__(self, base: ColumnSource, lo: int, hi: int):
+        if isinstance(base, SourceView):
+            lo, hi = base._lo + lo, base._lo + hi
+            base = base._base
+        self._base = base
+        self._lo, self._hi = int(lo), int(max(lo, hi))
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self._hi - self._lo,) + self._base.shape[1:]
+
+    @property
+    def dtype(self):
+        return self._base.dtype
+
+    def read(self, lo: int, hi: int) -> np.ndarray:
+        lo = max(0, int(lo))
+        hi = min(self.shape[0], int(hi))
+        return self._base.read(self._lo + lo, self._lo + hi)
+
+    def take(self, idx) -> np.ndarray:
+        return self._base.take(np.asarray(idx, dtype=np.int64) + self._lo)
+
+    def _read(self, lo, hi):  # pragma: no cover - read() is overridden
+        raise AssertionError("SourceView.read delegates to its base")
+
+    _take = _read
+
+
+class NpySource(ColumnSource):
+    """A ``.npy`` file as a lazy column, via ``np.load(mmap_mode='r')``.
+
+    The memmap is opened on first read (per process): pickling the
+    source ships only the path, and the OS pages in only the byte
+    ranges a process actually touches — per-worker shard reads on a
+    multi-host run never fault in another host's rows.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._mm: Optional[np.memmap] = None
+        # header-only peek: shape/dtype without mapping the data
+        # (public header readers only — the private _read_array_header
+        # has no cross-release stability guarantee)
+        with open(self.path, "rb") as f:
+            version = np.lib.format.read_magic(f)
+            reader = {(1, 0): np.lib.format.read_array_header_1_0,
+                      (2, 0): np.lib.format.read_array_header_2_0}.get(
+                          tuple(version))
+            if reader is None:
+                raise ValueError(f"{path}: unsupported .npy format "
+                                 f"version {version}")
+            hdr = reader(f)
+        self._shape, fortran, self._dtype = hdr
+        if fortran:
+            raise ValueError(f"{path}: Fortran-ordered .npy is not "
+                             "supported for lazy row reads")
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.__init__(state["path"])
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def _mmap(self) -> np.memmap:
+        if self._mm is None:
+            self._mm = np.load(self.path, mmap_mode="r")
+        return self._mm
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        # a view into the map: zero-copy, pages load on access
+        return self._mmap()[lo:hi]
+
+    def _take(self, idx: np.ndarray) -> np.ndarray:
+        return np.asarray(self._mmap()[idx])
+
+
+def _arrow_to_numpy(column) -> np.ndarray:
+    """An arrow ChunkedArray/Array -> ndarray; list-typed columns become
+    2-D (fixed row width enforced)."""
+    import pyarrow as pa
+
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_fixed_size_list(column.type):
+        width = column.type.list_size
+        return np.asarray(column.flatten()).reshape(-1, width)
+    if pa.types.is_list(column.type) or pa.types.is_large_list(column.type):
+        offsets = np.asarray(column.offsets)
+        widths = np.diff(offsets)
+        if widths.size and not (widths == widths[0]).all():
+            raise ValueError("list column has ragged row widths — "
+                             "cannot form a feature matrix")
+        width = int(widths[0]) if widths.size else 0
+        return np.asarray(column.flatten()).reshape(-1, width)
+    return column.to_numpy(zero_copy_only=False)
+
+
+class ParquetSource(ColumnSource):
+    """One column of a Parquet file as a lazy 1-D/2-D numpy column.
+
+    Reads materialize whole row groups (Parquet's random-access
+    granularity) through a 2-entry LRU: sequential scans — fit without
+    shuffle, predict, evaluate, per-partition worker reads — decode
+    each row group exactly once; shuffled training still works but
+    re-decodes groups, so prefer :class:`NpySource` (or
+    ``shuffle=False``) for shuffled out-of-core fits.
+    """
+
+    _LRU_SIZE = 2
+
+    def __init__(self, path: str, column: str):
+        import pyarrow.parquet as pq
+
+        self.path, self.column = str(path), str(column)
+        self._pf = pq.ParquetFile(self.path)
+        md = self._pf.metadata
+        names = self._pf.schema_arrow.names  # top-level (parquet leaf
+        # names flatten list columns to their element field)
+        if self.column not in names:
+            raise KeyError(f"{path} has no column {column!r} "
+                           f"(has {names})")
+        sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+        self._bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(
+            np.int64)
+        self._n = int(self._bounds[-1])
+        self._lru: List[Tuple[int, np.ndarray]] = []
+        # the shape/dtype probe decodes group 0 INTO the LRU, so the
+        # first real read reuses it instead of decoding twice
+        probe = self._group(0) if self._n else np.zeros((0,), np.float32)
+        self._row_shape = probe.shape[1:]
+        self._dtype = probe.dtype
+
+    def __getstate__(self):
+        return {"path": self.path, "column": self.column}
+
+    def __setstate__(self, state):
+        self.__init__(state["path"], state["column"])
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self._n,) + tuple(self._row_shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def _group(self, g: int) -> np.ndarray:
+        for key, arr in getattr(self, "_lru", []):
+            if key == g:
+                return arr
+        arr = _arrow_to_numpy(
+            self._pf.read_row_group(g, columns=[self.column]).column(0))
+        self._lru.insert(0, (g, arr))
+        del self._lru[self._LRU_SIZE:]
+        return arr
+
+    def _groups_for(self, lo: int, hi: int) -> range:
+        g0 = int(np.searchsorted(self._bounds, lo, side="right") - 1)
+        g1 = int(np.searchsorted(self._bounds, hi, side="left"))
+        return range(max(0, g0), max(g0 + 1, g1))
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        parts = []
+        for g in self._groups_for(lo, hi):
+            base = int(self._bounds[g])
+            arr = self._group(g)
+            parts.append(arr[max(0, lo - base):hi - base])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def _take(self, idx: np.ndarray) -> np.ndarray:
+        out = np.empty((idx.size,) + tuple(self._row_shape),
+                       dtype=self._dtype)
+        groups = np.searchsorted(self._bounds, idx, side="right") - 1
+        for g in np.unique(groups):
+            mask = groups == g
+            arr = self._group(int(g))
+            out[mask] = arr[idx[mask] - int(self._bounds[g])]
+        return out

@@ -45,6 +45,30 @@ def _pad_to(arr: np.ndarray, size: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+def _take_rows(col, idx: np.ndarray) -> np.ndarray:
+    """Materialize the given rows of an ndarray or lazy ColumnSource.
+    (isinstance, not hasattr: ndarray.take defaults to axis=None, which
+    would silently flatten a column of a mixed lazy/in-memory dataset.)"""
+    from ..data.sources import ColumnSource
+
+    return col.take(idx) if isinstance(col, ColumnSource) else col[idx]
+
+
+def _gather_lazy_batch(model, x, y, sl: np.ndarray, n: int):
+    """Assemble one zero-padded training batch from (possibly
+    file-backed) columns: only ``sl``'s in-range rows are read from
+    disk; padding slots are zeros with weight 0 — numerically identical
+    to the in-memory per-batch path's padded epoch arrays."""
+    valid = sl < n
+    rows_x = model._prepare_x(np.asarray(_take_rows(x, sl[valid])))
+    rows_y = model._prepare_y(np.asarray(_take_rows(y, sl[valid])))
+    xb = np.zeros((sl.size,) + rows_x.shape[1:], dtype=rows_x.dtype)
+    yb = np.zeros((sl.size,) + rows_y.shape[1:], dtype=rows_y.dtype)
+    xb[valid] = rows_x
+    yb[valid] = rows_y
+    return xb, yb, valid.astype(np.float32)
+
+
 def stack_shards(shards: Sequence[Tuple[np.ndarray, np.ndarray]],
                  pad_multiple: int = 1):
     """Stack uneven (x, y) shards into masked fixed-shape arrays.
@@ -519,14 +543,20 @@ class SyncStepTrainer:
         on remote-attached TPUs) unless verbose/callbacks need it anyway.
         """
         from .mesh import replicate, shard_leading
+        from ..data.sources import ColumnSource
 
         model = self.model
         model.set_weights(weights)
-        x = model._prepare_x(x)
-        y = model._prepare_y(y)
+        # file-backed columns stream: batches are read from disk as the
+        # epoch progresses (per-batch dispatch), so peak host memory is
+        # O(batch), never O(dataset) — the out-of-core training path
+        lazy = isinstance(x, ColumnSource) or isinstance(y, ColumnSource)
+        if not lazy:
+            x = model._prepare_x(x)
+            y = model._prepare_y(y)
         if validation_split and 0.0 < validation_split < 1.0:
             split_at = int(x.shape[0] * (1.0 - validation_split))
-            x, y = x[:split_at], y[:split_at]
+            x, y = x[:split_at], y[:split_at]  # lazy slices stay lazy
 
         mesh = self.mesh
         ndev = int(np.prod(mesh.devices.shape))
@@ -536,10 +566,11 @@ class SyncStepTrainer:
         nb = max(1, -(-n // global_batch))
         n_pad = nb * global_batch
 
-        sw = np.zeros(n_pad, dtype=np.float32)
-        sw[:n] = 1.0
-        mode = self._resolve_mode()
-        x_pad, y_pad = _pad_to(x, n_pad), _pad_to(y, n_pad)
+        mode = "per_batch" if lazy else self._resolve_mode()
+        if not lazy:
+            sw = np.zeros(n_pad, dtype=np.float32)
+            sw[:n] = 1.0
+            x_pad, y_pad = _pad_to(x, n_pad), _pad_to(y, n_pad)
         if mode == "scan":
             # transfer the (padded) epoch data and parameters once
             x_d = shard_leading(mesh, "data", x_pad)
@@ -585,9 +616,14 @@ class SyncStepTrainer:
                 batch_stats = []
                 for b in range(nb):
                     sl = perm[b * global_batch:(b + 1) * global_batch]
-                    xb = shard_leading(mesh, "data", x_pad[sl])
-                    yb = shard_leading(mesh, "data", y_pad[sl])
-                    swb = shard_leading(mesh, "data", sw[sl])
+                    if lazy:
+                        xb_np, yb_np, swb_np = _gather_lazy_batch(
+                            model, x, y, sl, n)
+                    else:
+                        xb_np, yb_np, swb_np = x_pad[sl], y_pad[sl], sw[sl]
+                    xb = shard_leading(mesh, "data", xb_np)
+                    yb = shard_leading(mesh, "data", yb_np)
+                    swb = shard_leading(mesh, "data", swb_np)
                     trainable, state, opt_state, key, st = step_fn(
                         trainable, state, opt_state, key, xb, yb, swb)
                     batch_stats.append(st)
@@ -663,21 +699,38 @@ def build_sharded_predict(model: BaseModel, mesh=None):
             cache["key"] = model.params
         return cache["value"]
 
-    def predict(x: np.ndarray, batch_size: int = 1024) -> np.ndarray:
-        x = model._prepare_x(x)
+    def predict(x: np.ndarray, batch_size: int = 1024,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+        """``out``: optional preallocated array (e.g. a writable
+        ``np.lib.format.open_memmap``) receiving predictions in place —
+        with a file-backed ``x`` neither the inputs nor the outputs
+        ever fully materialize in process memory."""
+        from ..data.sources import ColumnSource
+
+        lazy = isinstance(x, ColumnSource)
+        if not lazy:
+            x = model._prepare_x(x)
         n = x.shape[0]
         if n == 0:
-            return np.zeros((0,) + tuple(model.output_shape), dtype=np.float32)
+            return (out if out is not None else
+                    np.zeros((0,) + tuple(model.output_shape),
+                             dtype=np.float32))
         chunk = int(-(-min(batch_size, n) // ndev) * ndev)
         params = replicated_params()
         outs = []
         for start in range(0, n, chunk):
-            xb = _pad_to(x[start:start + chunk], chunk)
+            xc = x[start:start + chunk]
+            if lazy:  # chunk-local materialization + dtype prep
+                xc = model._prepare_x(np.asarray(xc))
+            xb = _pad_to(xc, chunk)
             real = min(chunk, n - start)
             xb = shard_leading(mesh, "data", xb)
-            out = np.asarray(jax.device_get(jit_apply(params, xb)))
-            outs.append(out[:real])
-        return np.concatenate(outs, axis=0)
+            res = np.asarray(jax.device_get(jit_apply(params, xb)))
+            if out is not None:
+                out[start:start + real] = res[:real]
+            else:
+                outs.append(res[:real])
+        return out if out is not None else np.concatenate(outs, axis=0)
 
     return predict
 
@@ -715,8 +768,14 @@ def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
         return cache["value"]
 
     def evaluate(x: np.ndarray, y: np.ndarray, batch_size: int = 1024):
-        x = model._prepare_x(x)
-        y = model._prepare_y(y)
+        from ..data.sources import ColumnSource
+
+        x_lazy = isinstance(x, ColumnSource)
+        y_lazy = isinstance(y, ColumnSource)
+        if not x_lazy:
+            x = model._prepare_x(x)
+        if not y_lazy:
+            y = model._prepare_y(y)
         n = x.shape[0]
         chunk = int(-(-min(batch_size, max(n, 1)) // ndev) * ndev)
         params = replicated_params()
@@ -725,10 +784,16 @@ def build_sharded_evaluate(model: BaseModel, loss, metrics=None,
             real = min(chunk, n - start)
             swb = np.zeros(chunk, dtype=np.float32)
             swb[:real] = 1.0
+            xc = x[start:start + chunk]
+            yc = y[start:start + chunk]
+            if x_lazy:
+                xc = model._prepare_x(np.asarray(xc))
+            if y_lazy:
+                yc = model._prepare_y(np.asarray(yc))
             vals = np.asarray(jax.device_get(jit_stats(
                 params,
-                shard_leading(mesh, "data", _pad_to(x[start:start + chunk], chunk)),
-                shard_leading(mesh, "data", _pad_to(y[start:start + chunk], chunk)),
+                shard_leading(mesh, "data", _pad_to(xc, chunk)),
+                shard_leading(mesh, "data", _pad_to(yc, chunk)),
                 shard_leading(mesh, "data", swb))))
             totals = vals if totals is None else totals + vals
         count = max(totals[-1], 1.0)

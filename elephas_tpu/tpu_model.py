@@ -560,7 +560,7 @@ class TPUModel:
                 aggregator = None
                 if callbacks:
                     participants = sum(
-                        1 for shard in shards if np.asarray(shard[0]).size)
+                        1 for shard in shards if shard[0].shape[0])
 
                     def on_epoch(epoch_idx, logs):
                         import warnings as _warnings
@@ -703,19 +703,32 @@ class TPUModel:
         return self._replica
 
     def predict(self, data: Union[Dataset, np.ndarray],
-                batch_size: Optional[int] = None) -> np.ndarray:
-        """Distributed inference; returns predictions in input order."""
+                batch_size: Optional[int] = None,
+                out: Union[None, str, np.ndarray] = None) -> np.ndarray:
+        """Distributed inference; returns predictions in input order.
+
+        ``out``: stream predictions into a preallocated array or (as a
+        string) a ``.npy`` file created with ``open_memmap`` — with a
+        file-backed dataset neither the inputs nor the outputs ever
+        fully materialize in process memory (the analog of the
+        reference predicting over an RDD it never collects,
+        ``elephas/spark_model.py:154-160``)."""
         from .models.ssm_model import SSMModel
         from .models.transformer_model import TransformerModel
         from .parallel.sync_trainer import build_sharded_predict
 
         if isinstance(self._master_network, (TransformerModel, SSMModel)):
+            if out is not None:
+                raise ValueError("out= streaming is not supported for "
+                                 "transformer/SSM masters (their predict "
+                                 "returns token logits via the model's "
+                                 "own batching)")
             return self._master_network.predict(
                 self._extract_tokens(data),
                 batch_size=batch_size or self.batch_size)
         if isinstance(data, Dataset):
             if data.is_columnar:
-                x = data.columns[0]
+                x = data.columns[0]  # lazy sources pass through unread
             else:
                 x = np.asarray(data.rows())
         else:
@@ -723,8 +736,13 @@ class TPUModel:
         replica = self._get_replica()
         if self._predict_fn is None:
             self._predict_fn = build_sharded_predict(replica)
-        return self._predict_fn(x,
-                                batch_size=batch_size or max(self.batch_size, 256))
+        if isinstance(out, str):
+            out = np.lib.format.open_memmap(
+                out, mode="w+",
+                shape=(int(x.shape[0]),) + tuple(replica.output_shape),
+                dtype=np.float32)
+        return self._predict_fn(
+            x, batch_size=batch_size or max(self.batch_size, 256), out=out)
 
     def evaluate(self, x_test: np.ndarray, y_test: np.ndarray,
                  **kwargs) -> Union[List[float], float]:
@@ -743,7 +761,12 @@ class TPUModel:
             self._evaluate_fn = build_sharded_evaluate(
                 replica, self.master_loss, self._worker_metric_fns(),
                 self.custom_objects)
-        return self._evaluate_fn(np.asarray(x_test), np.asarray(y_test),
+        from .data.sources import ColumnSource
+
+        def _keep_lazy(arr):
+            return arr if isinstance(arr, ColumnSource) else np.asarray(arr)
+
+        return self._evaluate_fn(_keep_lazy(x_test), _keep_lazy(y_test),
                                  batch_size=kwargs.get("batch_size",
                                                        max(self.batch_size, 256)))
 

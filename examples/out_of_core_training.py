@@ -1,0 +1,59 @@
+"""Out-of-core training over file-backed data.
+
+The reference's training data lives distributed in RDD partitions that
+each executor materializes on demand (``elephas/worker.py:36-38``).
+Here the data lives on disk — memory-mapped ``.npy`` (or Parquet via
+``Dataset.from_parquet``) — and fit/predict/evaluate stream it: peak
+host memory is O(batch), never O(dataset), and predictions can stream
+straight back to a ``.npy`` memmap without accumulating in memory.
+"""
+import os
+import tempfile
+
+import numpy as np
+from common import mnist_like
+
+from elephas_tpu.data import Dataset
+from elephas_tpu.models import SGD, Activation, Dense, Dropout, Sequential
+from elephas_tpu.tpu_model import TPUModel
+
+batch_size = 64
+epochs = 3
+
+# Stage the dataset as .npy files — in production these already exist
+# (one shard-readable file per column; any size, they are never loaded
+# whole).
+(x_train, y_train), (x_test, y_test) = mnist_like()
+workdir = tempfile.mkdtemp(prefix="elephas_ooc_")
+np.save(os.path.join(workdir, "x.npy"), x_train)
+np.save(os.path.join(workdir, "y.npy"), y_train)
+
+dataset = Dataset.from_npy(os.path.join(workdir, "x.npy"),
+                           os.path.join(workdir, "y.npy"),
+                           num_partitions=4)
+
+model = Sequential([Dense(128, input_dim=784), Activation("relu"),
+                    Dropout(0.2),
+                    Dense(128), Activation("relu"), Dropout(0.2),
+                    Dense(10), Activation("softmax")])
+model.compile(SGD(learning_rate=0.05), "categorical_crossentropy", ["acc"])
+
+tpu_model = TPUModel(model, mode="synchronous", sync_mode="step",
+                     batch_size=batch_size)
+tpu_model.fit(dataset, epochs=epochs, batch_size=batch_size, verbose=1,
+              validation_split=0.1)
+
+src = dataset.columns[0]
+print(f"rows read during fit: {src.rows_read} "
+      f"(max single read {src.max_read_rows} rows — one batch)")
+
+# streamed inference: predictions land in a .npy memmap, in input order
+pred_path = os.path.join(workdir, "predictions.npy")
+tpu_model.predict(dataset, out=pred_path)
+preds = np.load(pred_path, mmap_mode="r")
+acc = float(np.mean(np.argmax(np.asarray(preds[: len(y_train)]), axis=1)
+                    == np.argmax(y_train, axis=1)))
+print(f"train accuracy from streamed predictions: {acc:.4f}")
+
+score = tpu_model.evaluate(x_test, y_test)
+print(f"test loss/acc: {score}")

@@ -1,0 +1,312 @@
+"""Out-of-core data plane: file-backed columns must (a) read lazily —
+peak host materialization stays O(shard)/O(batch), never O(dataset) —
+(b) produce numerically identical training/inference to the in-memory
+path, and (c) let each process of a multi-host run read only its own
+slice (the executor-resident semantics of the reference's RDD
+partitions, ``elephas/spark_model.py:182-183``, ``elephas/worker.py:36-38``).
+"""
+import multiprocessing
+import os
+import random
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data import Dataset
+from elephas_tpu.data.sources import NpySource, ParquetSource, SourceView
+
+
+def _write_npy(tmp_path, n=512, dim=12, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, dim), dtype=np.float32)
+    w = rng.normal(size=(dim, classes))
+    y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    xp, yp = str(tmp_path / "x.npy"), str(tmp_path / "y.npy")
+    np.save(xp, x)
+    np.save(yp, y)
+    return xp, yp, x, y
+
+
+def _write_parquet(tmp_path, x, y_labels, row_group_size=64):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "data.parquet")
+    table = pa.table({
+        "features": pa.FixedSizeListArray.from_arrays(
+            pa.array(x.reshape(-1)), x.shape[1]),
+        "label": pa.array(y_labels),
+    })
+    pq.write_table(table, path, row_group_size=row_group_size)
+    return path
+
+
+# --------------------------------------------------------------- sources
+def test_npy_source_header_only_until_read(tmp_path):
+    xp, _, x, _ = _write_npy(tmp_path)
+    src = NpySource(xp)
+    assert src.shape == x.shape and src.dtype == x.dtype
+    assert src.rows_read == 0, "constructing must not read data"
+    view = src[100:200]
+    assert isinstance(view, SourceView) and view.shape == (100,) + x.shape[1:]
+    assert src.rows_read == 0, "slicing must stay lazy"
+    np.testing.assert_array_equal(np.asarray(view), x[100:200])
+    assert src.rows_read == 100 and src.max_read_rows == 100
+    # nested views resolve to absolute offsets on the root
+    np.testing.assert_array_equal(np.asarray(view[10:20]), x[110:120])
+    idx = np.array([5, 400, 17])
+    np.testing.assert_array_equal(src.take(idx), x[idx])
+    np.testing.assert_array_equal(src[3], x[3])
+
+
+def test_parquet_source_reads_and_row_groups(tmp_path):
+    _, _, x, y = _write_npy(tmp_path, n=300)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    path = _write_parquet(tmp_path, x, labels, row_group_size=64)
+    feat = ParquetSource(path, "features")
+    lab = ParquetSource(path, "label")
+    assert feat.shape == x.shape and lab.shape == (300,)
+    np.testing.assert_allclose(feat.read(60, 130), x[60:130], rtol=1e-6)
+    np.testing.assert_array_equal(lab.read(250, 300), labels[250:300])
+    idx = np.array([0, 299, 64, 63, 128])
+    np.testing.assert_allclose(feat.take(idx), x[idx], rtol=1e-6)
+    with pytest.raises(KeyError):
+        ParquetSource(path, "nope")
+
+
+def test_sources_pickle_by_path(tmp_path):
+    import pickle
+
+    xp, _, x, _ = _write_npy(tmp_path)
+    src = NpySource(xp)
+    np.asarray(src[0:10])
+    clone = pickle.loads(pickle.dumps(src))
+    assert clone.rows_read == 0, "pickle must ship the path, not data"
+    np.testing.assert_array_equal(np.asarray(clone[20:30]), x[20:30])
+
+
+# ------------------------------------------------------------- dataset
+def test_file_backed_dataset_partitions_stay_lazy(tmp_path):
+    xp, yp, x, y = _write_npy(tmp_path)
+    ds = Dataset.from_npy(xp, yp, num_partitions=4)
+    assert ds.is_file_backed and ds.count() == len(x)
+    parts = ds.partitions()
+    assert ds.columns[0].rows_read == 0, "partitioning must not read"
+    lo, hi = ds.partition_bounds()[2]
+    np.testing.assert_array_equal(np.asarray(parts[2][0]), x[lo:hi])
+    # only that one shard was read — O(shard), not O(dataset)
+    assert ds.columns[0].rows_read == hi - lo
+    assert ds.columns[0].max_read_rows == hi - lo
+
+
+def _model(dim=12, classes=4, hidden=16):
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+
+    m = Sequential([Dense(hidden, input_dim=dim), Activation("relu"),
+                    Dense(classes), Activation("softmax")])
+    m.compile(SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"],
+              seed=0)
+    return m
+
+
+def test_streaming_fit_matches_in_memory_per_batch(tmp_path):
+    """The lazy per-batch epoch must be numerically IDENTICAL to the
+    in-memory per-batch epoch (same seed, same shuffle, same padding)."""
+    from elephas_tpu.models.optimizers import SGD as OptSGD
+    from elephas_tpu.parallel.sync_trainer import SyncStepTrainer
+
+    xp, yp, x, y = _write_npy(tmp_path, n=210)  # uneven: padding in play
+    model_a, model_b = _model(), _model()
+    w0 = model_a.get_weights()
+
+    def trainer(model):
+        from elephas_tpu.models import optimizers as opt_mod
+
+        return SyncStepTrainer(model, opt_mod.deserialize(
+            opt_mod.serialize(OptSGD(learning_rate=0.1))),
+            "categorical_crossentropy", [], epoch_mode="per_batch")
+
+    wa, ha = trainer(model_a).fit(w0, x, y, epochs=3, batch_size=32,
+                                  validation_split=0.0, seed=7)
+    src_x, src_y = NpySource(xp), NpySource(yp)
+    wb, hb = trainer(model_b).fit(w0, src_x, src_y, epochs=3, batch_size=32,
+                                  validation_split=0.0, seed=7)
+    for a, b in zip(wa, wb):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    np.testing.assert_allclose(ha["loss"], hb["loss"], atol=1e-6)
+    # streaming reads are O(batch): no single read touched more rows
+    # than one global batch, and the epoch never materialized the file
+    assert src_x.max_read_rows <= 32
+    assert src_y.max_read_rows <= 32
+
+
+def test_tpu_model_fit_predict_evaluate_file_backed(tmp_path):
+    """End-to-end through TPUModel over a file-backed Dataset: training
+    streams (reads bounded by the batch), predict/evaluate match the
+    same weights applied to the in-memory arrays, and predict can
+    stream its output to a .npy memmap."""
+    from elephas_tpu.tpu_model import TPUModel
+
+    xp, yp, x, y = _write_npy(tmp_path, n=400)
+    ds = Dataset.from_npy(xp, yp, num_partitions=4)
+    tpu_model = TPUModel(_model(), mode="synchronous", sync_mode="step",
+                         batch_size=32)
+    tpu_model.fit(ds, epochs=4, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    src = ds.columns[0]
+    assert src.max_read_rows <= 32, "fit must stream batches, not load all"
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][-1] < history["loss"][0], "should learn"
+
+    # predict: lazy input, parity with in-memory input, bounded reads
+    src.rows_read = src.max_read_rows = 0
+    pred_lazy = tpu_model.predict(ds, batch_size=64)
+    assert src.max_read_rows <= 64
+    pred_mem = tpu_model.predict(x, batch_size=64)
+    np.testing.assert_allclose(pred_lazy, pred_mem, atol=1e-6)
+
+    # predict with streamed .npy output: nothing accumulates in memory
+    out_path = str(tmp_path / "pred.npy")
+    returned = tpu_model.predict(ds, batch_size=64, out=out_path)
+    np.testing.assert_allclose(np.load(out_path), pred_mem, atol=1e-6)
+    assert isinstance(returned, np.memmap)
+
+    # evaluate: lazy columns, parity with in-memory
+    ev_lazy = tpu_model.evaluate(ds.columns[0], ds.columns[1],
+                                 batch_size=64)
+    ev_mem = tpu_model.evaluate(x, y, batch_size=64)
+    np.testing.assert_allclose(ev_lazy, ev_mem, atol=1e-5)
+
+
+def test_tpu_model_fit_parquet_backed(tmp_path):
+    """The parquet path end-to-end: fit + predict parity (labels ride as
+    a one-hot-encoded .npy next to the parquet features)."""
+    from elephas_tpu.tpu_model import TPUModel
+
+    xp, yp, x, y = _write_npy(tmp_path, n=256)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    path = _write_parquet(tmp_path, x, labels, row_group_size=64)
+    feat = ParquetSource(path, "features")
+    ds = Dataset((feat, NpySource(yp)), num_partitions=2)
+    tpu_model = TPUModel(_model(), mode="synchronous", sync_mode="step",
+                         batch_size=32)
+    tpu_model.fit(ds, epochs=2, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][-1] < history["loss"][0]
+    np.testing.assert_allclose(tpu_model.predict(ds),
+                               tpu_model.predict(x), atol=1e-6)
+
+
+def test_async_fit_file_backed_reads_only_shards(tmp_path):
+    """Async workers over a file-backed dataset: each worker
+    materializes its own partition (reference semantics,
+    elephas/worker.py:36-38) — total reads stay O(n), bounded by a few
+    epochs' worth, never O(n * workers^2)."""
+    from elephas_tpu.tpu_model import TPUModel
+
+    xp, yp, x, y = _write_npy(tmp_path, n=240)
+    ds = Dataset.from_npy(xp, yp, num_partitions=2)
+    tpu_model = TPUModel(_model(), mode="asynchronous", frequency="epoch",
+                         parameter_server_mode="socket", num_workers=2,
+                         batch_size=32,
+                         port=random.randint(24000, 29000))
+    tpu_model.fit(ds, epochs=2, batch_size=32, verbose=0,
+                  validation_split=0.1)
+    assert tpu_model.master_network is not None
+    src = ds.columns[0]
+    # each worker reads its own 120-row shard once (validation split is
+    # sliced lazily); nothing reads the whole file per batch
+    assert src.max_read_rows <= 120
+
+
+# ------------------------------------------------- multi-process slicing
+def _proc_read_shard(args):
+    xp, n_procs, proc_idx, n_parts, q = args
+    # mimic tpu_model's multi-host flow: same dataset everywhere, each
+    # process takes the strided slice shards[process_index::process_count]
+    ds = Dataset.from_npy(xp, num_partitions=n_parts)
+    shards = ds.partitions()[proc_idx::n_procs]
+    total = 0
+    ranges = []
+    for (col,) in shards:
+        arr = np.asarray(col)  # materialize ONLY this shard
+        total += arr.shape[0]
+        ranges.append((float(arr[0, 0]), arr.shape[0]))
+    q.put((proc_idx, total, ds.columns[0].rows_read, ranges))
+
+
+def test_multiprocess_spawn_each_reads_own_slice(tmp_path):
+    """Spawned processes (fresh interpreters — nothing inherited) open
+    the same file-backed dataset and each reads ONLY its strided shard
+    slice: per-process rows_read equals its own shards' size, and the
+    shards cover the dataset disjointly."""
+    xp, _, x, _ = _write_npy(tmp_path, n=320)
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    n_procs, n_parts = 2, 4
+    procs = [ctx.Process(target=_proc_read_shard,
+                         args=((xp, n_procs, i, n_parts, q),))
+             for i in range(n_procs)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=60)
+    by_idx = {r[0]: r for r in results}
+    assert set(by_idx) == {0, 1}
+    sizes = [320 // n_parts] * n_parts
+    for idx, total, rows_read, _ in results:
+        expect = sum(sizes[idx::n_procs])
+        assert total == expect
+        assert rows_read == expect, \
+            f"process {idx} read {rows_read} rows, owns only {expect}"
+    assert sum(r[1] for r in results) == 320  # disjoint full coverage
+
+
+def test_mixed_lazy_and_in_memory_columns_train_identically(tmp_path):
+    """A Dataset may mix a file-backed column with an in-memory one;
+    the streaming gather must NOT flatten the ndarray column
+    (ndarray.take defaults to axis=None) — weights must match the
+    all-in-memory per-batch run exactly."""
+    from elephas_tpu.models import optimizers as opt_mod
+    from elephas_tpu.models.optimizers import SGD as OptSGD
+    from elephas_tpu.parallel.sync_trainer import SyncStepTrainer
+
+    xp, yp, x, y = _write_npy(tmp_path, n=130)
+    model_a, model_b = _model(), _model()
+    w0 = model_a.get_weights()
+
+    def trainer(model):
+        return SyncStepTrainer(model, opt_mod.deserialize(
+            opt_mod.serialize(OptSGD(learning_rate=0.1))),
+            "categorical_crossentropy", [], epoch_mode="per_batch")
+
+    wa, _ = trainer(model_a).fit(w0, x, y, epochs=2, batch_size=32,
+                                 validation_split=0.0, seed=3)
+    wb, _ = trainer(model_b).fit(w0, NpySource(xp), y, epochs=2,
+                                 batch_size=32, validation_split=0.0,
+                                 seed=3)
+    for a, b in zip(wa, wb):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_predict_out_rejected_for_token_models(tmp_path):
+    """out= must raise, not silently return memory, for model families
+    whose predict doesn't stream."""
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import TransformerConfig
+    from elephas_tpu.models.transformer_model import TransformerModel
+    from elephas_tpu.tpu_model import TPUModel
+
+    from elephas_tpu.models import Adam
+
+    tm = TransformerModel(TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32))
+    tm.compile(Adam(learning_rate=1e-3), seed=0)
+    tpu_model = TPUModel(tm, mode="synchronous")
+    tokens = np.ones((2, 8), dtype=np.int32)
+    with pytest.raises(ValueError, match="out="):
+        tpu_model.predict(tokens, out=str(tmp_path / "p.npy"))
