@@ -1,0 +1,65 @@
+"""Parameter-server RPC round-trip: persistent vs per-RPC connections.
+
+Host-side measurement (loopback TCP — no TPU involved): the socket
+client's default long-lived connection vs the reference-style fresh
+connection per RPC (``SocketClient(persistent=False)``), over the
+MNIST-MLP weight payload (~470 KB: 784-128-128-10). One "round" is the
+batch-frequency worker's wire work per batch: one ``get_parameters`` +
+one ``update_parameters``.
+
+Prints one JSON line:
+  {"metric": "ps_rpc_rounds_per_sec", "value": P, "fresh": F,
+   "speedup": P/F, ...}
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+from elephas_tpu.models import SGD, Activation, Dense, Sequential
+from elephas_tpu.parameter.client import SocketClient
+from elephas_tpu.parameter.server import SocketServer
+from elephas_tpu.utils.serialization import model_to_dict
+
+
+def _server(port: int) -> SocketServer:
+    model = Sequential([Dense(128, input_dim=784), Activation("relu"),
+                        Dense(128), Activation("relu"),
+                        Dense(10), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy", seed=0)
+    server = SocketServer(model_to_dict(model), port, "asynchronous")
+    server.start()
+    return server
+
+
+def _measure(client: SocketClient, rounds: int) -> float:
+    weights = client.get_parameters()  # warm (and the delta template)
+    delta = [np.zeros_like(w) for w in weights]
+    start = time.perf_counter()
+    for _ in range(rounds):
+        client.get_parameters()
+        client.update_parameters(delta)
+    elapsed = time.perf_counter() - start
+    return rounds / elapsed
+
+
+def main(port: int = 27311, rounds: int = 200):
+    server = _server(port)
+    try:
+        persistent = _measure(SocketClient(port=port, persistent=True),
+                              rounds)
+        fresh = _measure(SocketClient(port=port, persistent=False), rounds)
+    finally:
+        server.stop()
+    out = {"metric": "ps_rpc_rounds_per_sec", "value": round(persistent, 1),
+           "unit": "rounds/sec (get+update, MNIST-MLP weights)",
+           "fresh": round(fresh, 1),
+           "speedup": round(persistent / fresh, 3),
+           "rounds": rounds, "transport": "socket loopback (host-side)"}
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main(port=int(sys.argv[1]) if len(sys.argv) > 1 else 27311)

@@ -7,6 +7,7 @@ probes — the reference has no failure detection at all, SURVEY.md §5.)
 """
 import abc
 import socket
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -111,6 +112,18 @@ class BaseParameterClient(abc.ABC):
     def health_check(self) -> bool:
         """True when the server answers its liveness probe."""
 
+    def close(self):
+        """Release any long-lived transport state (no-op by default;
+        the socket client drops its persistent connection)."""
+
+    def clone(self) -> "BaseParameterClient":
+        """A client with the same configuration but its OWN transport
+        state. Workers clone the driver's client so each holds its own
+        persistent connection instead of serializing every RPC over one
+        socket. Default: return self (stateless transports, in-memory
+        test doubles)."""
+        return self
+
 
 class HttpClient(BaseParameterClient):
     """Talks to :class:`~elephas_tpu.parameter.server.HttpServer`."""
@@ -162,48 +175,102 @@ class HttpClient(BaseParameterClient):
 
 
 class SocketClient(BaseParameterClient):
-    """Talks to :class:`~elephas_tpu.parameter.server.SocketServer`."""
+    """Talks to :class:`~elephas_tpu.parameter.server.SocketServer`.
+
+    By default the client keeps ONE long-lived connection and runs every
+    RPC over it (the server's per-connection handler loops on opcodes),
+    so a batch-frequency worker pays the TCP+thread setup once, not
+    twice per batch. A transient failure closes the connection and the
+    retry path reconnects — surviving a parameter-server restart.
+    ``persistent=False`` restores the reference-style
+    connection-per-RPC behavior (and is the bench A/B baseline).
+    """
 
     client_type = "socket"
 
     def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
                  max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
-                 deadline: float = None, compression: str = None):
+                 deadline: float = None, compression: str = None,
+                 persistent: bool = True):
         self.port = port
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff = backoff
         self.deadline = deadline
         self.compression = self._check_compression(compression)
+        self.persistent = bool(persistent)
+        self._sock_lock = threading.RLock()   # one RPC on the wire at a time
+        self._persistent_sock: socket.socket = None
+
+    def clone(self) -> "SocketClient":
+        return SocketClient(port=self.port, timeout=self.timeout,
+                            max_retries=self.max_retries,
+                            backoff=self.backoff, deadline=self.deadline,
+                            compression=self.compression,
+                            persistent=self.persistent)
 
     def _connect(self, timeout=None) -> socket.socket:
         host = determine_master(port=self.port).split(":")[0]
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.settimeout(timeout if timeout is not None else self.timeout)
         sock.connect((host, self.port))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
+
+    def close(self):
+        """Drop the persistent connection (a context-managed fit calls
+        this on teardown; safe to call any time — the next RPC
+        reconnects)."""
+        with self._sock_lock:
+            if self._persistent_sock is not None:
+                try:
+                    self._persistent_sock.close()
+                except OSError:
+                    pass
+                self._persistent_sock = None
+
+    def _run_op(self, fn):
+        """Run ``fn(sock)`` on the persistent connection (establishing
+        it if needed); any transient failure tears the connection down
+        before re-raising, so ``_with_retry``'s next attempt starts
+        from a fresh connect — including against a restarted server."""
+        if not self.persistent:
+            with self._connect() as sock:
+                return fn(sock)
+        with self._sock_lock:
+            if self._persistent_sock is None:
+                self._persistent_sock = self._connect()
+            try:
+                return fn(self._persistent_sock)
+            except _TRANSIENT:
+                self.close()
+                raise
 
     def get_parameters(self) -> List[np.ndarray]:
         def op():
-            with self._connect() as sock:
+            def rpc(sock):
                 sock.sendall(b"g")
                 return receive(sock)
+            return self._run_op(rpc)
         return self._with_retry(op, "get_parameters")
 
     def push_frame(self, arrays: List[np.ndarray], kind: int):
         update_id = uuid.uuid4().hex.encode("ascii")  # stable across retries
 
         def op():
-            with self._connect() as sock:
+            def rpc(sock):
                 sock.sendall(b"U" + update_id)
                 send(sock, arrays, kind=kind)
                 ack = sock.recv(1)  # block until the delta is applied
                 if ack != b"k":
                     raise ConnectionError("parameter server did not "
                                           "acknowledge the update")
+            return self._run_op(rpc)
         return self._with_retry(op, "update_parameters")
 
     def health_check(self) -> bool:
+        # deliberately a fresh short-timeout connection: the probe must
+        # answer fast even while a long RPC holds the persistent socket
         try:
             with self._connect(timeout=5.0) as sock:
                 sock.sendall(b"h")

@@ -17,6 +17,7 @@ Both servers hold the authoritative weights as a flat numpy list — the
 wire currency — so no JAX device state lives on the serving threads.
 """
 import abc
+import select
 import socket
 import threading
 import time
@@ -288,43 +289,60 @@ class SocketServer(BaseParameterServer):
                 break
             t = threading.Thread(target=self._listen, args=(conn,), daemon=True)
             t.start()
+            # prune finished handlers on every accept: a long run with
+            # reconnecting clients must hold O(live connections) thread
+            # objects, not one per connection ever made
+            self.connections = [c for c in self.connections if c.is_alive()]
             self.connections.append(t)
         try:
             sock.close()
         except OSError:
             pass
 
+    #: between-RPC poll interval: a handler waiting on an idle persistent
+    #: connection re-checks ``self.runs`` this often, so server stop()
+    #: never strands handler threads. The wait is select()-based — the
+    #: socket itself stays in blocking mode, because a socket timeout
+    #: would disable the native C++ framing fast path for the RPC body
+    #: (``utils/sockets._use_native``) and cap stalls the client's own
+    #: configurable timeout is meant to govern.
+    IDLE_TIMEOUT = 0.5
+
     def _listen(self, conn: socket.socket):
         with conn:
             while self.runs:
                 try:
+                    readable, _, _ = select.select([conn], [], [],
+                                                   self.IDLE_TIMEOUT)
+                    if not readable:
+                        continue  # idle persistent connection: poll runs
                     opcode = conn.recv(1)
                 except OSError:
                     return
                 if not opcode:
                     return
-                if opcode in (b"u", b"U"):
-                    update_id = None
-                    if opcode == b"U":
-                        raw = bytearray()
-                        while len(raw) < 32:
-                            chunk = conn.recv(32 - len(raw))
-                            if not chunk:
-                                return
-                            raw += chunk
-                        update_id = raw.decode("ascii", "replace")
-                    arrays, kind = receive_frame(conn)
-                    delta = (dequantize_delta(arrays)
-                             if kind == KIND_DELTA_Q8 else arrays)
-                    self.apply_delta(delta, update_id=update_id)
-                    try:
+                try:
+                    if opcode in (b"u", b"U"):
+                        update_id = None
+                        if opcode == b"U":
+                            raw = bytearray()
+                            while len(raw) < 32:
+                                chunk = conn.recv(32 - len(raw))
+                                if not chunk:
+                                    return
+                                raw += chunk
+                            update_id = raw.decode("ascii", "replace")
+                        arrays, kind = receive_frame(conn)
+                        delta = (dequantize_delta(arrays)
+                                 if kind == KIND_DELTA_Q8 else arrays)
+                        self.apply_delta(delta, update_id=update_id)
                         conn.sendall(b"k")  # ack: delta applied
-                    except OSError:
-                        return
-                elif opcode == b"g":
-                    send(conn, self.get_weights())
-                elif opcode == b"h":
-                    try:
+                    elif opcode == b"g":
+                        send(conn, self.get_weights())
+                    elif opcode == b"h":
                         conn.sendall(b"k")  # alive
-                    except OSError:
-                        return
+                except OSError:
+                    # mid-RPC stall or client death: drop the connection
+                    # (the client's retry opens a fresh one); a half-read
+                    # frame must never be applied
+                    return

@@ -226,6 +226,8 @@ class TPUModel:
         self.parameter_server.start()
 
     def stop_server(self):
+        if self.client is not None:
+            self.client.close()  # drop the persistent PS connection
         self.parameter_server.stop()
 
     # ------------------------------------------------------------------- save
@@ -593,7 +595,10 @@ class TPUModel:
                                      else None),
                         should_stop=(aggregator.should_stop if aggregator
                                      else None))
-                    worker.train(np.asarray(x_w), np.asarray(y_w))
+                    try:
+                        worker.train(np.asarray(x_w), np.asarray(y_w))
+                    finally:
+                        worker.client.close()
 
                 if shards:
                     with concurrent.futures.ThreadPoolExecutor(

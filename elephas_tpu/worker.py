@@ -205,7 +205,9 @@ class AsyncWorker:
                  epoch_event=None, should_stop=None,
                  compute_dtype: Optional[str] = None):
         if isinstance(client, BaseParameterClient):
-            self.client = client
+            # own transport state per worker: N workers must not
+            # serialize their RPCs over the driver's one connection
+            self.client = client.clone()
         else:
             self.client = BaseParameterClient.get_client(client, port)
         self.json = json_config
