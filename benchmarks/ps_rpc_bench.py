@@ -47,8 +47,9 @@ def _measure(client: SocketClient, rounds: int) -> float:
 def main(port: int = 27311, rounds: int = 200):
     server = _server(port)
     try:
-        persistent = _measure(SocketClient(port=port, persistent=True),
-                              rounds)
+        client_p = SocketClient(port=port, persistent=True)
+        persistent = _measure(client_p, rounds)
+        client_p.close()   # the A side must not linger into the B run
         fresh = _measure(SocketClient(port=port, persistent=False), rounds)
     finally:
         server.stop()

@@ -17,7 +17,7 @@ Both servers hold the authoritative weights as a flat numpy list — the
 wire currency — so no JAX device state lives on the serving threads.
 """
 import abc
-import select
+import selectors
 import socket
 import threading
 import time
@@ -234,6 +234,7 @@ class SocketServer(BaseParameterServer):
         self.runs = False
         self.connections: List[threading.Thread] = []
         self.thread: Optional[threading.Thread] = None
+        self._conn_lock = threading.Lock()
 
     def start(self):
         if self.thread is not None:
@@ -259,9 +260,13 @@ class SocketServer(BaseParameterServer):
         if self.thread is not None:
             self.thread.join(timeout=5)
             self.thread = None
-        for t in self.connections:
+        # the serve thread is joined (or timed out) — snapshot under the
+        # lock anyway so a straggling accept can't append to a list this
+        # loop never sees
+        with self._conn_lock:
+            handlers, self.connections = self.connections, []
+        for t in handlers:
             t.join(timeout=1)
-        self.connections = []
         if self.socket is not None:
             try:
                 self.socket.close()
@@ -292,8 +297,10 @@ class SocketServer(BaseParameterServer):
             # prune finished handlers on every accept: a long run with
             # reconnecting clients must hold O(live connections) thread
             # objects, not one per connection ever made
-            self.connections = [c for c in self.connections if c.is_alive()]
-            self.connections.append(t)
+            with self._conn_lock:
+                self.connections = [c for c in self.connections
+                                    if c.is_alive()]
+                self.connections.append(t)
         try:
             sock.close()
         except OSError:
@@ -301,7 +308,7 @@ class SocketServer(BaseParameterServer):
 
     #: between-RPC poll interval: a handler waiting on an idle persistent
     #: connection re-checks ``self.runs`` this often, so server stop()
-    #: never strands handler threads. The wait is select()-based — the
+    #: never strands handler threads. The wait is selectors-based (epoll) — the
     #: socket itself stays in blocking mode, because a socket timeout
     #: would disable the native C++ framing fast path for the RPC body
     #: (``utils/sockets._use_native``) and cap stalls the client's own
@@ -309,12 +316,15 @@ class SocketServer(BaseParameterServer):
     IDLE_TIMEOUT = 0.5
 
     def _listen(self, conn: socket.socket):
-        with conn:
+        # selectors (epoll/kqueue), not select.select: the latter raises
+        # ValueError for fds >= FD_SETSIZE (1024), which a busy server
+        # (many connections + file-backed data columns) can exceed
+        sel = selectors.DefaultSelector()
+        with conn, sel:
+            sel.register(conn, selectors.EVENT_READ)
             while self.runs:
                 try:
-                    readable, _, _ = select.select([conn], [], [],
-                                                   self.IDLE_TIMEOUT)
-                    if not readable:
+                    if not sel.select(timeout=self.IDLE_TIMEOUT):
                         continue  # idle persistent connection: poll runs
                     opcode = conn.recv(1)
                 except OSError:

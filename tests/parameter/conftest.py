@@ -1,0 +1,15 @@
+"""Shared helpers for the parameter-layer tests."""
+from itertools import count
+
+import pytest
+
+_PORT_COUNTER = count(26000)
+
+
+@pytest.fixture
+def next_port():
+    """Collision-free test ports: monotonically increasing, in a range
+    disjoint from test_server.py's 3000+ counter."""
+    def _next():
+        return next(_PORT_COUNTER)
+    return _next

@@ -1,0 +1,177 @@
+"""Fault injection on the parameter-server path (VERDICT r3 #6 — the
+failure-detection coverage SURVEY §5 flags as wholly absent in the
+reference): a dead PS fails workers within the retry deadline instead
+of hanging them; training resumes from the latest checkpoint against a
+restarted PS; a crashed worker thread fails fit() with the remaining
+workers drained, never a hang.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu.models import SGD, Activation, Dense, Sequential
+from elephas_tpu.tpu_model import TPUModel
+from elephas_tpu.utils.dataset_utils import to_dataset
+
+
+def _data(n=192, dim=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, dim), dtype=np.float32)
+    w = rng.normal(size=(dim, classes))
+    y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _model(dim=16, classes=4, seed=0):
+    m = Sequential([Dense(16, input_dim=dim), Activation("relu"),
+                    Dense(classes), Activation("softmax")])
+    m.compile(SGD(learning_rate=0.1), "categorical_crossentropy", seed=seed)
+    return m
+
+
+@pytest.mark.parametrize("transport", ["socket", "http"])
+def test_ps_death_mid_fit_fails_within_deadline(transport, next_port):
+    """Kill the PS while workers are mid-epoch: fit must raise a
+    ConnectionError within the client's bounded retry deadline — not
+    hang, not succeed silently."""
+    x, y = _data(n=256)
+    tpu_model = TPUModel(_model(), mode="asynchronous", frequency="batch",
+                         parameter_server_mode=transport, num_workers=2,
+                         batch_size=8, port=next_port())
+
+    result = {}
+
+    def run_fit():
+        try:
+            tpu_model.fit(to_dataset(x, y), epochs=50, batch_size=8,
+                          verbose=0, validation_split=0.0)
+            result["outcome"] = "completed"
+        except Exception as err:  # noqa: BLE001 — recording for asserts
+            result["outcome"] = "raised"
+            result["error"] = err
+
+    # shrink the retry budget so "bounded time" is test-sized
+    tpu_model.client.timeout = 2.0
+    tpu_model.client.deadline = 3.0
+    tpu_model.client.backoff = 0.1
+
+    t = threading.Thread(target=run_fit)
+    t.start()
+    # let workers start exchanging, then murder the server
+    deadline = time.monotonic() + 10
+    while tpu_model.parameter_server.num_updates < 2:
+        assert time.monotonic() < deadline, "fit never started updating"
+        time.sleep(0.05)
+    killed_at = time.monotonic()
+    tpu_model.parameter_server.stop()
+    t.join(timeout=30)
+    assert not t.is_alive(), "fit hung after PS death"
+    assert result["outcome"] == "raised", result
+    assert isinstance(result["error"], ConnectionError)
+    # "within the retry deadline": worker deadline (3s) + drain slack
+    assert time.monotonic() - killed_at < 25
+
+
+def test_resume_from_checkpoint_after_ps_death(tmp_path, next_port):
+    """The recovery story end to end: checkpoint mid-training, lose the
+    PS run, restart from the latest checkpoint, finish training against
+    a fresh PS — final weights keep improving from the restored state."""
+    from elephas_tpu.utils.checkpoint import CheckpointManager
+
+    x, y = _data(n=192)
+    ds = to_dataset(x, y)
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=3)
+
+    # phase 1: train a few epochs, checkpointing weights each epoch via
+    # the PS pull that async epoch callbacks perform
+    model = _model()
+    tpu_model = TPUModel(model, mode="asynchronous", frequency="epoch",
+                         parameter_server_mode="socket", num_workers=2,
+                         batch_size=16, port=next_port())
+
+    from elephas_tpu.models.callbacks import Callback
+
+    class CkptEveryEpoch(Callback):
+        def __init__(self):
+            self.epochs = 0
+
+        def on_epoch_end(self, epoch, logs=None):
+            self.epochs += 1
+            mgr.save(epoch, {"weights": {str(i): w for i, w in
+                                         enumerate(self.model.get_weights())}})
+
+    cb = CkptEveryEpoch()
+    tpu_model.fit(ds, epochs=3, batch_size=16, verbose=0,
+                  validation_split=0.0, callbacks=[cb])
+    assert cb.epochs == 3
+    assert mgr.latest_step() == 2
+    loss_phase1 = tpu_model.evaluate(x, y)
+    if isinstance(loss_phase1, list):
+        loss_phase1 = loss_phase1[0]
+
+    # the PS run is gone (fit tears its server down); a NEW process
+    # restores the latest checkpoint and continues against a fresh PS
+    restored = mgr.restore()
+    weights = [restored["weights"][str(i)]
+               for i in range(len(restored["weights"]))]
+    model2 = _model(seed=9)          # different init — must be overwritten
+    model2.set_weights(weights)
+    resumed = TPUModel(model2, mode="asynchronous", frequency="epoch",
+                       parameter_server_mode="socket", num_workers=2,
+                       batch_size=16, port=next_port())
+    loss_restored = resumed.evaluate(x, y)
+    if isinstance(loss_restored, list):
+        loss_restored = loss_restored[0]
+    np.testing.assert_allclose(loss_restored, loss_phase1, atol=1e-5)
+
+    resumed.fit(ds, epochs=3, batch_size=16, verbose=0,
+                validation_split=0.0)
+    loss_phase2 = resumed.evaluate(x, y)
+    if isinstance(loss_phase2, list):
+        loss_phase2 = loss_phase2[0]
+    assert loss_phase2 < loss_phase1, (
+        f"resumed training should improve on the checkpoint "
+        f"({loss_phase2} vs {loss_phase1})")
+
+
+def test_worker_crash_fails_fit_with_others_drained(monkeypatch, next_port):
+    """One worker thread dying must surface as a fit() exception after
+    the OTHER workers drain (finish or fail) — never a hang, never a
+    silent partial success."""
+    import elephas_tpu.tpu_model as tpu_module
+    from elephas_tpu.worker import AsyncWorker
+
+    x, y = _data(n=128)
+    boom = RuntimeError("injected worker crash")
+    real_train = AsyncWorker.train
+    crashed = threading.Event()
+    survivors = []
+
+    call_idx = {"n": 0}
+    lock = threading.Lock()
+
+    def train_with_crash(self, x_train, y_train):
+        with lock:
+            idx = call_idx["n"]
+            call_idx["n"] += 1
+        if idx == 0:
+            crashed.set()
+            raise boom
+        out = real_train(self, x_train, y_train)
+        survivors.append(idx)
+        return out
+
+    monkeypatch.setattr(AsyncWorker, "train", train_with_crash)
+    tpu_model = TPUModel(_model(), mode="asynchronous", frequency="epoch",
+                         parameter_server_mode="socket", num_workers=2,
+                         batch_size=16, port=next_port())
+    with pytest.raises(RuntimeError, match="injected worker crash"):
+        tpu_model.fit(to_dataset(x, y), epochs=2, batch_size=16,
+                      verbose=0, validation_split=0.0)
+    assert crashed.is_set()
+    assert survivors == [1], "the other worker should have drained"
+    # the server was torn down despite the failure
+    assert tpu_model.parameter_server.thread is None or \
+        not tpu_model.parameter_server.thread.is_alive()
