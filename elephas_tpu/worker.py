@@ -203,7 +203,7 @@ class AsyncWorker:
                  custom_objects: Optional[Dict] = None, port: int = 4000,
                  overlap: bool = False, accum_batches: int = 1,
                  epoch_event=None, should_stop=None,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None, device=None):
         if isinstance(client, BaseParameterClient):
             # own transport state per worker: N workers must not
             # serialize their RPCs over the driver's one connection
@@ -223,6 +223,11 @@ class AsyncWorker:
         self.accum_batches = max(1, int(accum_batches))
         self.epoch_event = epoch_event
         self.should_stop = should_stop or (lambda: False)
+        #: the JAX device this worker's compute is pinned to (None =
+        #: process default). On a multi-chip host the driver assigns
+        #: workers round-robin over local devices so N async workers
+        #: drive N chips instead of contending for chip 0.
+        self.device = device
         self.model = None
         # EF-SGD residual carrier when the client compresses pushes:
         # per-worker state, so each worker corrects its own rounding
@@ -250,7 +255,15 @@ class AsyncWorker:
     def train(self, x_train: np.ndarray, y_train: np.ndarray):
         if x_train.size == 0:
             return
+        if self.device is not None:
+            # jax.default_device is a thread-local config context: every
+            # array this worker thread creates and every step it compiles
+            # lands on ITS chip, concurrently with its siblings on theirs
+            with jax.default_device(self.device):
+                return self._train_pinned(x_train, y_train)
+        return self._train_pinned(x_train, y_train)
 
+    def _train_pinned(self, x_train: np.ndarray, y_train: np.ndarray):
         self.model = model_from_json(self.json, self.custom_objects)
         self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
                            loss=self.master_loss, metrics=self.master_metrics,
