@@ -581,7 +581,14 @@ class TPUModel:
                     if participants:
                         aggregator = _EpochAggregator(participants, on_epoch)
 
-                def run_worker(shard):
+                # round-robin worker→chip assignment: N async workers on
+                # an M-chip host drive all M chips concurrently instead of
+                # contending for chip 0 (the TPU-native analog of each
+                # reference worker owning an executor's compute,
+                # elephas/worker.py:52-131)
+                local_devices = jax.local_devices()
+
+                def run_worker(index, shard):
                     x_w, y_w = shard
                     worker = AsyncWorker(
                         model_json, init, self.client, train_config,
@@ -594,7 +601,8 @@ class TPUModel:
                         epoch_event=(aggregator.report if aggregator
                                      else None),
                         should_stop=(aggregator.should_stop if aggregator
-                                     else None))
+                                     else None),
+                        device=local_devices[index % len(local_devices)])
                     try:
                         worker.train(np.asarray(x_w), np.asarray(y_w))
                     finally:
@@ -603,8 +611,8 @@ class TPUModel:
                 if shards:
                     with concurrent.futures.ThreadPoolExecutor(
                             max_workers=len(shards)) as pool:
-                        futures = [pool.submit(run_worker, shard)
-                                   for shard in shards]
+                        futures = [pool.submit(run_worker, i, shard)
+                                   for i, shard in enumerate(shards)]
                         for f in futures:
                             f.result()
         except Exception as err:

@@ -26,6 +26,7 @@ import queue
 import threading
 from typing import Any, Dict, List, Optional, Union
 
+import jax
 import numpy as np
 
 from .models import deserialize_optimizer, model_from_json
@@ -341,7 +342,6 @@ class AsyncWorker:
         latest (the price of not blocking compute on the pull) — a
         staleness already inherent to asynchronous SGD.
         """
-        import jax
         import jax.numpy as jnp
 
         model = self.model
