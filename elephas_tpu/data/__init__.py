@@ -1,1 +1,3 @@
 from .dataset import Dataset
+from .sources import (ColumnSource, ConcatSource, NpySource, ParquetSource,
+                      SourceView)

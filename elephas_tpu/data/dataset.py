@@ -28,7 +28,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from .sources import ColumnSource, NpySource, ParquetSource
+from .sources import ColumnSource, ConcatSource, NpySource, ParquetSource
 
 
 def _default_partitions() -> int:
@@ -86,23 +86,73 @@ class Dataset:
         return cls((xs, ys), num_partitions=num_partitions)
 
     @classmethod
-    def from_npy(cls, *paths: str,
+    def from_npy(cls, *paths,
                  num_partitions: Optional[int] = None) -> "Dataset":
         """File-backed dataset over memory-mapped ``.npy`` columns
-        (e.g. ``from_npy("x.npy", "y.npy")``). Reads are lazy: training,
-        prediction, and evaluation touch only the row ranges their
-        shards/batches need — the out-of-core path (SURVEY §7 step 5)."""
-        return cls(tuple(NpySource(p) for p in paths),
+        (e.g. ``from_npy("x.npy", "y.npy")``). Each column may also be a
+        sequence of shard paths (``from_npy(["x0.npy", "x1.npy"],
+        ["y0.npy", "y1.npy"])``) — shards concatenate lazily, in order.
+        Reads are lazy: training, prediction, and evaluation touch only
+        the row ranges their shards/batches need — the out-of-core path
+        (SURVEY §7 step 5)."""
+
+        def column(spec):
+            if isinstance(spec, (list, tuple)):
+                parts = [NpySource(p) for p in spec]
+                return parts[0] if len(parts) == 1 else ConcatSource(parts)
+            return NpySource(spec)
+
+        return cls(tuple(column(p) for p in paths),
                    num_partitions=num_partitions)
 
     @classmethod
-    def from_parquet(cls, path: str, columns: Sequence[str],
+    def from_parquet(cls, path: Union[str, Sequence[str]],
+                     columns: Sequence[str],
                      num_partitions: Optional[int] = None) -> "Dataset":
         """File-backed dataset over Parquet columns (via pyarrow).
-        List-typed columns (fixed row width) become 2-D feature
-        matrices; reads decode one row group at a time."""
-        return cls(tuple(ParquetSource(path, c) for c in columns),
+        ``path`` may be one file or an ordered sequence of files (lazy
+        concatenation). List-typed columns (fixed row width) become 2-D
+        feature matrices; reads decode one row group at a time."""
+        import os as _os
+
+        if isinstance(path, (str, _os.PathLike)):
+            paths: Sequence[str] = [str(path)]
+        else:
+            paths = [str(p) for p in path]
+            if not paths:
+                raise ValueError("from_parquet needs at least one file")
+
+        import pyarrow.parquet as pq
+
+        # one footer parse per file, shared across all columns
+        metas = [pq.read_metadata(p) for p in paths]
+
+        def column(name):
+            parts = [ParquetSource(p, name, metadata=m)
+                     for p, m in zip(paths, metas)]
+            return parts[0] if len(parts) == 1 else ConcatSource(parts)
+
+        return cls(tuple(column(c) for c in columns),
                    num_partitions=num_partitions)
+
+    @classmethod
+    def from_parquet_dir(cls, path: str, columns: Sequence[str],
+                         pattern: str = "*.parquet",
+                         num_partitions: Optional[int] = None) -> "Dataset":
+        """All Parquet files under a directory as one lazily-concatenated
+        dataset — the normal on-disk shape of a multi-part dataset
+        (Spark writes directories of part files,
+        ``elephas/spark_model.py:182``). Files order lexicographically
+        (part-00000, part-00001, ... stay in write order)."""
+        import glob as _glob
+        import os
+
+        files = sorted(_glob.glob(os.path.join(path, pattern)))
+        if not files:
+            raise FileNotFoundError(
+                f"no files matching {pattern!r} under {path}")
+        return cls.from_parquet(files, columns,
+                                num_partitions=num_partitions)
 
     # -- properties ----------------------------------------------------------
     @property

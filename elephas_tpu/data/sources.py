@@ -23,19 +23,31 @@ Two backends:
   the OS pages in only what's touched). The cheapest path for numeric
   columns and the format the framework's own tooling writes.
 - :class:`ParquetSource` — one column of a Parquet file via pyarrow,
-  read row-group-at-a-time with a tiny LRU so sequential scans (fit
-  without shuffle, predict, evaluate) read each row group exactly once.
-  List/FixedSizeList columns become 2-D feature matrices.
+  read row-group-at-a-time with a tiny LRU so sequential scans (fit,
+  predict, evaluate) read each row group exactly once. Shuffled
+  streaming fits permute at row-group granularity (via
+  :meth:`ColumnSource.chunk_bounds`), so they keep the
+  decode-each-group-once property. List/FixedSizeList columns become
+  2-D feature matrices.
+
+Multi-file data (the normal on-disk shape — Spark writes directories of
+part files) concatenates lazily via :class:`ConcatSource`:
+``Dataset.from_parquet_dir(path, cols)`` and
+``Dataset.from_npy([xs...], [ys...])``. Partition ranges map onto the
+files that hold them, so a contiguous partition's reads touch only its
+own files.
 
 Sources are picklable by path: a spawned worker process reopens the
 file lazily on first read, which is what makes "each process reads only
 its slice" literal — no array ever rides the pickle.
 """
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["ColumnSource", "NpySource", "ParquetSource", "SourceView"]
+__all__ = ["ColumnSource", "ConcatSource", "NpySource", "ParquetSource",
+           "SourceView"]
 
 
 class ColumnSource:
@@ -74,6 +86,14 @@ class ColumnSource:
         raise NotImplementedError
 
     # -- provided ---------------------------------------------------------
+    def chunk_bounds(self) -> Optional[np.ndarray]:
+        """Boundaries of the source's natural read granularity (row-group
+        edges for Parquet, file edges for concatenated shards), as an
+        int64 array ``[0, ..., n]`` — or ``None`` when random access is
+        cheap (memmaps). Epoch shuffles use this to permute chunk order
+        instead of rows globally, so each chunk is decoded once per
+        epoch instead of once per batch."""
+        return None
     @property
     def ndim(self) -> int:
         return len(self.shape)
@@ -97,8 +117,20 @@ class ColumnSource:
         self._count(hi - lo)
         return self._read(lo, hi)
 
-    def take(self, idx) -> np.ndarray:
+    def _norm_idx(self, idx) -> np.ndarray:
+        """numpy-style index normalization shared by every subclass:
+        negatives wrap, out-of-range raises."""
         idx = np.asarray(idx, dtype=np.int64)
+        n = self.shape[0]
+        if idx.size:
+            if int(idx.min()) < -n or int(idx.max()) >= n:
+                raise IndexError(
+                    f"index out of range for source of {n} rows")
+            idx = np.where(idx < 0, idx + n, idx)
+        return idx
+
+    def take(self, idx) -> np.ndarray:
+        idx = self._norm_idx(idx)
         self._count(idx.size)
         return self._take(idx)
 
@@ -150,12 +182,18 @@ class SourceView(ColumnSource):
         return self._base.read(self._lo + lo, self._lo + hi)
 
     def take(self, idx) -> np.ndarray:
-        return self._base.take(np.asarray(idx, dtype=np.int64) + self._lo)
+        return self._base.take(self._norm_idx(idx) + self._lo)
 
     def _read(self, lo, hi):  # pragma: no cover - read() is overridden
         raise AssertionError("SourceView.read delegates to its base")
 
     _take = _read
+
+    def chunk_bounds(self) -> Optional[np.ndarray]:
+        base = self._base.chunk_bounds()
+        if base is None:
+            return None
+        return np.unique(np.clip(base, self._lo, self._hi)) - self._lo
 
 
 class NpySource(ColumnSource):
@@ -241,34 +279,81 @@ class ParquetSource(ColumnSource):
     Reads materialize whole row groups (Parquet's random-access
     granularity) through a 2-entry LRU: sequential scans — fit without
     shuffle, predict, evaluate, per-partition worker reads — decode
-    each row group exactly once; shuffled training still works but
-    re-decodes groups, so prefer :class:`NpySource` (or
-    ``shuffle=False``) for shuffled out-of-core fits.
+    each row group exactly once. Shuffled streaming fits permute at
+    row-group granularity (:meth:`chunk_bounds`), so they too decode
+    each group once per epoch; ``chunks_decoded`` counts actual decodes
+    for observability. All decoding and LRU mutation is serialized
+    behind a per-source lock — pyarrow's ``ParquetFile`` is not
+    thread-safe, and async/hogwild/sync-average fits materialize worker
+    shards from concurrent threads.
     """
 
     _LRU_SIZE = 2
 
-    def __init__(self, path: str, column: str):
+    #: row groups actually decoded (LRU misses) — the unit of real IO
+    chunks_decoded: int = 0
+
+    def __init__(self, path: str, column: str, metadata=None):
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
         self.path, self.column = str(path), str(column)
-        self._pf = pq.ParquetFile(self.path)
-        md = self._pf.metadata
-        names = self._pf.schema_arrow.names  # top-level (parquet leaf
-        # names flatten list columns to their element field)
-        if self.column not in names:
+        self._lock = threading.Lock()
+        # footer-only metadata read: no persistent file handle until the
+        # first actual decode (a 1000-part directory must not open 1000
+        # files — or decode 1000 row groups — just to construct). The
+        # caller may pass the already-read footer (``pq.read_metadata``)
+        # so multi-column datasets parse each file's footer once.
+        self._pf = None
+        md = metadata if metadata is not None else pq.read_metadata(
+            self.path)
+        schema = md.schema.to_arrow_schema()
+        if self.column not in schema.names:
             raise KeyError(f"{path} has no column {column!r} "
-                           f"(has {names})")
+                           f"(has {schema.names})")
         sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
         self._bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(
             np.int64)
         self._n = int(self._bounds[-1])
         self._lru: List[Tuple[int, np.ndarray]] = []
-        # the shape/dtype probe decodes group 0 INTO the LRU, so the
-        # first real read reuses it instead of decoding twice
-        probe = self._group(0) if self._n else np.zeros((0,), np.float32)
-        self._row_shape = probe.shape[1:]
-        self._dtype = probe.dtype
+        # shape/dtype come from the schema when statically known there;
+        # only ragged (variable-length) list columns need a decode probe
+        # — this also gives zero-row part files (Spark writes them for
+        # empty partitions) their true shape/dtype
+        t = schema.field(self.column).type
+        if pa.types.is_fixed_size_list(t):
+            self._row_shape: Tuple[int, ...] = (t.list_size,)
+            self._dtype = np.dtype(t.value_type.to_pandas_dtype())
+        elif pa.types.is_list(t) or pa.types.is_large_list(t):
+            probe = (self._group(0) if self._n
+                     else np.zeros((0, 0), np.dtype(
+                         t.value_type.to_pandas_dtype())))
+            self._row_shape = probe.shape[1:]
+            self._dtype = probe.dtype
+        else:
+            self._row_shape = ()
+            self._dtype = np.dtype(t.to_pandas_dtype())
+        # nullable int/bool columns decode as float64 (NaN for nulls,
+        # pandas semantics) — widen the declared dtype up front when the
+        # footer statistics prove nulls exist, so declared == decoded
+        if self._dtype.kind in "iub" and self._null_count(md) > 0:
+            self._dtype = np.dtype(np.float64)
+
+    def _null_count(self, md) -> int:
+        """Total nulls in this column per footer statistics; 0 when
+        statistics are absent (the decode-time dtype check still guards
+        that case)."""
+        total = 0
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            for c in range(rg.num_columns):
+                col = rg.column(c)
+                if col.path_in_schema.split(".")[0] != self.column:
+                    continue
+                st = col.statistics
+                if st is not None and st.has_null_count:
+                    total += st.null_count
+        return total
 
     def __getstate__(self):
         return {"path": self.path, "column": self.column}
@@ -285,14 +370,37 @@ class ParquetSource(ColumnSource):
         return self._dtype
 
     def _group(self, g: int) -> np.ndarray:
-        for key, arr in getattr(self, "_lru", []):
-            if key == g:
-                return arr
-        arr = _arrow_to_numpy(
-            self._pf.read_row_group(g, columns=[self.column]).column(0))
-        self._lru.insert(0, (g, arr))
-        del self._lru[self._LRU_SIZE:]
-        return arr
+        with self._lock:
+            for key, arr in getattr(self, "_lru", []):
+                if key == g:
+                    return arr
+            if self._pf is None:
+                import pyarrow.parquet as pq
+
+                self._pf = pq.ParquetFile(self.path)
+            arr = _arrow_to_numpy(
+                self._pf.read_row_group(g, columns=[self.column]).column(0))
+            # declared dtype is absent exactly once: during the ragged-
+            # list shape probe __init__ itself runs through here
+            declared = getattr(self, "_dtype", None)
+            if declared is not None and arr.dtype != declared:
+                # per-group decode dtype can drift from the declared one
+                # (a nullable int group WITH nulls decodes float64, one
+                # without decodes int64) — safe casts unify; anything
+                # else would corrupt silently, so refuse loudly
+                if np.can_cast(arr.dtype, declared, casting="safe"):
+                    arr = arr.astype(declared)
+                else:
+                    raise ValueError(
+                        f"{self.path}:{self.column}: row group {g} "
+                        f"decoded {arr.dtype} but the declared dtype is "
+                        f"{declared} — the column likely contains "
+                        "nulls the footer statistics didn't report; "
+                        "fill or cast it at write time")
+            self.chunks_decoded += 1
+            self._lru.insert(0, (g, arr))
+            del self._lru[self._LRU_SIZE:]
+            return arr
 
     def _groups_for(self, lo: int, hi: int) -> range:
         g0 = int(np.searchsorted(self._bounds, lo, side="right") - 1)
@@ -316,3 +424,95 @@ class ParquetSource(ColumnSource):
             arr = self._group(int(g))
             out[mask] = arr[idx[mask] - int(self._bounds[g])]
         return out
+
+    def chunk_bounds(self) -> np.ndarray:
+        return self._bounds.copy()
+
+
+class ConcatSource(ColumnSource):
+    """Lazy concatenation of per-file sources — a multi-part dataset
+    column (the analog of Spark's multi-part RDDs,
+    ``elephas/spark_model.py:182``).
+
+    Row ranges map to the files that hold them: a contiguous partition's
+    reads touch only the overlapping parts (locality), and per-part
+    ``rows_read`` counters make that observable. Reads route through
+    each part's own ``read``/``take``, so Parquet parts keep their
+    row-group LRU and lock; the concat keeps its own root counters on
+    top. Picklable whenever the parts are (paths ride the pickle, data
+    never does).
+    """
+
+    def __init__(self, parts: Sequence[ColumnSource]):
+        parts = list(parts)
+        if not parts:
+            raise ValueError("ConcatSource needs at least one part")
+        # drop zero-row parts (Spark writes empty part files for empty
+        # partitions): they contribute nothing and must not constrain
+        # the row shape or promote the dtype
+        nonempty = [p for p in parts if p.shape[0]]
+        self.parts = nonempty or parts[:1]
+        tail = self.parts[0].shape[1:]
+        for p in self.parts[1:]:
+            if p.shape[1:] != tail:
+                raise ValueError(
+                    "all parts must share the row shape: "
+                    f"{tail} vs {p.shape[1:]}")
+        self._dtype = np.result_type(*[p.dtype for p in self.parts])
+        sizes = [p.shape[0] for p in self.parts]
+        self._bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(
+            np.int64)
+
+    def __getstate__(self):
+        # parts pickle by path; counters don't ride (a fresh process
+        # starts its accounting at zero, like the leaf sources)
+        return {"parts": self.parts}
+
+    def __setstate__(self, state):
+        self.__init__(state["parts"])
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (int(self._bounds[-1]),) + tuple(self.parts[0].shape[1:])
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        out = []
+        p0 = int(np.searchsorted(self._bounds, lo, side="right") - 1)
+        for p in range(max(0, p0), len(self.parts)):
+            base = int(self._bounds[p])
+            if base >= hi:
+                break
+            part = self.parts[p]
+            chunk = part.read(max(0, lo - base),
+                              min(part.shape[0], hi - base))
+            out.append(chunk.astype(self._dtype, copy=False))
+        return out[0] if len(out) == 1 else np.concatenate(out)
+
+    def _take(self, idx: np.ndarray) -> np.ndarray:
+        out = np.empty((idx.size,) + tuple(self.parts[0].shape[1:]),
+                       dtype=self._dtype)
+        owner = np.searchsorted(self._bounds, idx, side="right") - 1
+        for p in np.unique(owner):
+            mask = owner == p
+            rows = self.parts[int(p)].take(idx[mask] - int(self._bounds[p]))
+            out[mask] = rows.astype(self._dtype, copy=False)
+        return out
+
+    def chunk_bounds(self) -> Optional[np.ndarray]:
+        """Part edges refined by each part's own chunking (row groups
+        within each Parquet part) — or ``None`` when every part is
+        random-access-cheap (memmap shards): forcing file-granular
+        shuffle there would weaken mixing with nothing saved."""
+        inners = [p.chunk_bounds() for p in self.parts]
+        if all(b is None for b in inners):
+            return None
+        points = [np.asarray([0], dtype=np.int64)]
+        for p, base, inner in zip(self.parts, self._bounds[:-1], inners):
+            if inner is None:
+                inner = np.asarray([0, p.shape[0]], dtype=np.int64)
+            points.append(inner[1:].astype(np.int64) + int(base))
+        return np.unique(np.concatenate(points))

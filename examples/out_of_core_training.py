@@ -20,17 +20,24 @@ from elephas_tpu.tpu_model import TPUModel
 batch_size = 64
 epochs = 3
 
-# Stage the dataset as .npy files — in production these already exist
-# (one shard-readable file per column; any size, they are never loaded
-# whole).
+# Stage the dataset as sharded .npy files — the multi-part shape real
+# data arrives in (Spark writes directories of part files). Each column
+# is an ordered list of shards, concatenated lazily; any size, never
+# loaded whole. (A directory of parquet part files works the same way:
+# ``Dataset.from_parquet_dir(dirpath, ["features"])``.)
 (x_train, y_train), (x_test, y_test) = mnist_like()
 workdir = tempfile.mkdtemp(prefix="elephas_ooc_")
-np.save(os.path.join(workdir, "x.npy"), x_train)
-np.save(os.path.join(workdir, "y.npy"), y_train)
+half = len(x_train) // 2
+x_shards, y_shards = [], []
+for i, sl in enumerate((slice(0, half), slice(half, None))):
+    xp = os.path.join(workdir, f"x-{i:05d}.npy")
+    yp = os.path.join(workdir, f"y-{i:05d}.npy")
+    np.save(xp, x_train[sl])
+    np.save(yp, y_train[sl])
+    x_shards.append(xp)
+    y_shards.append(yp)
 
-dataset = Dataset.from_npy(os.path.join(workdir, "x.npy"),
-                           os.path.join(workdir, "y.npy"),
-                           num_partitions=4)
+dataset = Dataset.from_npy(x_shards, y_shards, num_partitions=4)
 
 model = Sequential([Dense(128, input_dim=784), Activation("relu"),
                     Dropout(0.2),

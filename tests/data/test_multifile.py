@@ -1,0 +1,364 @@
+"""Multi-file out-of-core datasets (the shape of real data on disk:
+Spark writes directories of part files, ``elephas/spark_model.py:182``):
+lazy concatenation of per-file sources, partition→file locality,
+row-group-granular epoch shuffle (no per-batch re-decoding), and
+thread-safe Parquet reads.
+"""
+import concurrent.futures
+import pickle
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data import Dataset
+from elephas_tpu.data.sources import (ConcatSource, NpySource, ParquetSource,
+                                      SourceView)
+
+
+def _problem(n=300, dim=12, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, dim), dtype=np.float32)
+    w = rng.normal(size=(dim, classes))
+    y = np.eye(classes, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def _write_npy_shards(tmp_path, x, y, cuts):
+    """Split (x, y) at ``cuts`` into numbered shard files."""
+    xs, ys = [], []
+    edges = [0] + list(cuts) + [len(x)]
+    for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
+        xp = str(tmp_path / f"x-{i:05d}.npy")
+        yp = str(tmp_path / f"y-{i:05d}.npy")
+        np.save(xp, x[lo:hi])
+        np.save(yp, y[lo:hi])
+        xs.append(xp)
+        ys.append(yp)
+    return xs, ys
+
+
+def _write_parquet_parts(tmp_path, x, labels, cuts, row_group_size=32):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    edges = [0] + list(cuts) + [len(x)]
+    for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
+        table = pa.table({
+            "features": pa.FixedSizeListArray.from_arrays(
+                pa.array(x[lo:hi].reshape(-1)), x.shape[1]),
+            "label": pa.array(labels[lo:hi]),
+        })
+        pq.write_table(table, str(tmp_path / f"part-{i:05d}.parquet"),
+                       row_group_size=row_group_size)
+
+
+def _model(dim=12, classes=4, hidden=16):
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+
+    m = Sequential([Dense(hidden, input_dim=dim), Activation("relu"),
+                    Dense(classes), Activation("softmax")])
+    m.compile(SGD(learning_rate=0.1), "categorical_crossentropy", ["acc"],
+              seed=0)
+    return m
+
+
+# ----------------------------------------------------------- ConcatSource
+def test_concat_source_reads_across_file_boundaries(tmp_path):
+    x, y = _problem(n=250)
+    xs, _ = _write_npy_shards(tmp_path, x, y, cuts=[80, 170])
+    src = ConcatSource([NpySource(p) for p in xs])
+    assert src.shape == x.shape and src.dtype == x.dtype
+    assert src.rows_read == 0
+    # a read spanning two files
+    np.testing.assert_array_equal(src.read(70, 100), x[70:100])
+    # fancy indexing across all three
+    idx = np.array([0, 79, 80, 169, 170, 249, 5])
+    np.testing.assert_array_equal(src.take(idx), x[idx])
+    # contiguous slices stay lazy
+    assert isinstance(src[10:200], SourceView)
+    np.testing.assert_array_equal(np.asarray(src[10:200]), x[10:200])
+    # all-memmap shards: random access is cheap, so no chunk constraint
+    # (epoch shuffles stay global-row; file-granular shuffle would only
+    # weaken mixing)
+    assert src.chunk_bounds() is None
+
+
+def test_concat_source_locality_and_pickle(tmp_path):
+    """A contiguous partition reads only the files it overlaps, and the
+    concat pickles by path (no data rides the pickle)."""
+    x, y = _problem(n=240)
+    xs, _ = _write_npy_shards(tmp_path, x, y, cuts=[80, 160])
+    src = ConcatSource([NpySource(p) for p in xs])
+    ds = Dataset((src,), num_partitions=3)
+    np.asarray(ds.partitions()[0][0])  # partition 0 = rows [0, 80)
+    assert src.parts[0].rows_read == 80
+    assert src.parts[1].rows_read == 0 and src.parts[2].rows_read == 0
+
+    clone = pickle.loads(pickle.dumps(src))
+    assert clone.rows_read == 0
+    np.testing.assert_array_equal(np.asarray(clone[100:120]), x[100:120])
+
+
+def test_concat_source_rejects_mismatched_row_shapes(tmp_path):
+    np.save(str(tmp_path / "a.npy"), np.zeros((4, 3), np.float32))
+    np.save(str(tmp_path / "b.npy"), np.zeros((4, 5), np.float32))
+    with pytest.raises(ValueError, match="row shape"):
+        ConcatSource([NpySource(str(tmp_path / "a.npy")),
+                      NpySource(str(tmp_path / "b.npy"))])
+
+
+# ------------------------------------------------------- Dataset surface
+def test_from_npy_shard_lists_end_to_end(tmp_path):
+    """Sharded .npy columns: fit streams, predict parity vs in-memory."""
+    from elephas_tpu.tpu_model import TPUModel
+
+    x, y = _problem(n=320)
+    xs, ys = _write_npy_shards(tmp_path, x, y, cuts=[100, 200])
+    ds = Dataset.from_npy(xs, ys, num_partitions=4)
+    assert ds.is_file_backed and ds.count() == 320
+    tpu_model = TPUModel(_model(), mode="synchronous", sync_mode="step",
+                         batch_size=32)
+    tpu_model.fit(ds, epochs=3, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][-1] < history["loss"][0]
+    np.testing.assert_allclose(tpu_model.predict(ds),
+                               tpu_model.predict(x), atol=1e-6)
+
+
+def test_from_parquet_dir_multifile_parity(tmp_path):
+    """A directory of parquet part files behaves exactly like the same
+    rows in memory: fit learns, predict parity, evaluate parity."""
+    from elephas_tpu.tpu_model import TPUModel
+
+    x, y = _problem(n=300)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    _write_parquet_parts(tmp_path, x, labels, cuts=[90, 210])
+    yp = str(tmp_path / "y.npy")
+    np.save(yp, y)
+    ds = Dataset.from_parquet_dir(str(tmp_path), ["features"],
+                                  num_partitions=2)
+    feat = ds.columns[0]
+    assert isinstance(feat, ConcatSource) and feat.shape == x.shape
+    # row-group edges refine the file edges (32-row groups inside parts)
+    bounds = feat.chunk_bounds()
+    assert set([0, 90, 210, 300]) <= set(bounds.tolist())
+    assert len(bounds) > 4
+
+    full = Dataset((feat, NpySource(yp)), num_partitions=2)
+    tpu_model = TPUModel(_model(), mode="synchronous", sync_mode="step",
+                         batch_size=32)
+    tpu_model.fit(full, epochs=3, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    history = tpu_model.training_histories[-1]
+    assert history["loss"][-1] < history["loss"][0]
+    np.testing.assert_allclose(tpu_model.predict(full),
+                               tpu_model.predict(x), atol=1e-5)
+    ev_lazy = tpu_model.evaluate(full.columns[0], full.columns[1])
+    ev_mem = tpu_model.evaluate(x, y)
+    np.testing.assert_allclose(ev_lazy, ev_mem, atol=1e-5)
+
+
+def test_from_parquet_dir_empty_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Dataset.from_parquet_dir(str(tmp_path), ["features"])
+
+
+def test_zero_row_part_files_are_tolerated(tmp_path):
+    """Spark writes zero-row part files for empty partitions: they must
+    neither crash the concat nor promote the column dtype, and an int
+    label column must stay int."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    x, y = _problem(n=120)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    # part 1 of 3 is empty
+    for i, sl in enumerate((slice(0, 60), slice(0, 0), slice(60, None))):
+        pq.write_table(pa.table({
+            "features": pa.FixedSizeListArray.from_arrays(
+                pa.array(x[sl].reshape(-1)), x.shape[1]),
+            "label": pa.array(labels[sl]),
+        }), str(tmp_path / f"part-{i:05d}.parquet"), row_group_size=32)
+    ds = Dataset.from_parquet_dir(str(tmp_path), ["features", "label"])
+    feat, lab = ds.columns
+    assert feat.shape == x.shape
+    assert lab.dtype == np.int64, "empty part must not promote the dtype"
+    np.testing.assert_allclose(np.asarray(feat), x, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(lab), labels)
+
+
+# ------------------------------------------------- shuffle without re-IO
+def test_shuffled_streaming_fit_decodes_each_group_once(tmp_path):
+    """A shuffled out-of-core fit must do sequential-scan IO: rows_read
+    == n per epoch, and Parquet decodes each row group ~once per epoch
+    (global-row shuffle would re-decode a group for nearly every batch
+    that touches it)."""
+    from elephas_tpu.models import optimizers as opt_mod
+    from elephas_tpu.models.optimizers import SGD as OptSGD
+    from elephas_tpu.parallel.sync_trainer import SyncStepTrainer
+
+    x, y = _problem(n=256)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    _write_parquet_parts(tmp_path, x, labels, cuts=[128],
+                         row_group_size=32)  # 8 groups over 2 files
+    yp = str(tmp_path / "y.npy")
+    np.save(yp, y)
+    feat = ConcatSource([
+        ParquetSource(str(tmp_path / "part-00000.parquet"), "features"),
+        ParquetSource(str(tmp_path / "part-00001.parquet"), "features")])
+    lab = NpySource(yp)
+    decoded_at_init = sum(p.chunks_decoded for p in feat.parts)
+
+    model = _model()
+    epochs = 3
+    trainer = SyncStepTrainer(
+        model, opt_mod.deserialize(opt_mod.serialize(
+            OptSGD(learning_rate=0.1))),
+        "categorical_crossentropy", [], epoch_mode="per_batch")
+    _, history = trainer.fit(model.get_weights(), feat, lab, epochs=epochs,
+                             batch_size=32, validation_split=0.0,
+                             shuffle=True, seed=11)
+    assert history["loss"][-1] < history["loss"][0]
+    # every row visited exactly once per epoch
+    assert feat.rows_read == 256 * epochs
+    decoded = sum(p.chunks_decoded for p in feat.parts) - decoded_at_init
+    assert decoded <= 8 * epochs, \
+        f"{decoded} group decodes for {8 * epochs} group-epochs"
+
+    # and the shuffle is real: consecutive epochs see different orders
+    # (chunk order is permuted per epoch) — check via the permutation
+    # helper directly
+    from elephas_tpu.parallel.sync_trainer import _epoch_permutation
+
+    rng = np.random.default_rng(0)
+    p1 = _epoch_permutation(feat, lab, 256, 256, True, rng)
+    p2 = _epoch_permutation(feat, lab, 256, 256, True, rng)
+    assert sorted(p1.tolist()) == list(range(256))
+    assert p1.tolist() != list(range(256)), "must actually shuffle"
+    assert p1.tolist() != p2.tolist(), "epochs must differ"
+
+
+def test_mixed_granularity_columns_both_decode_once(tmp_path):
+    """x and y Parquet columns with DIFFERENT row-group sizes: the epoch
+    permutation merges both columns' boundaries, so each keeps the
+    decode-each-group-once property (neither thrashes its LRU)."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from elephas_tpu.models import optimizers as opt_mod
+    from elephas_tpu.models.optimizers import SGD as OptSGD
+    from elephas_tpu.parallel.sync_trainer import SyncStepTrainer
+
+    x, y = _problem(n=256)
+    xp, ypq = str(tmp_path / "x.parquet"), str(tmp_path / "y.parquet")
+    pq.write_table(pa.table({"features": pa.FixedSizeListArray.from_arrays(
+        pa.array(x.reshape(-1)), x.shape[1])}), xp, row_group_size=32)
+    pq.write_table(pa.table({"label": pa.FixedSizeListArray.from_arrays(
+        pa.array(y.reshape(-1)), y.shape[1])}), ypq, row_group_size=100)
+    feat = ParquetSource(xp, "features")    # 8 groups
+    lab = ParquetSource(ypq, "label")       # 3 groups
+    d0_x, d0_y = feat.chunks_decoded, lab.chunks_decoded
+
+    model = _model()
+    epochs = 3
+    trainer = SyncStepTrainer(
+        model, opt_mod.deserialize(opt_mod.serialize(
+            OptSGD(learning_rate=0.1))),
+        "categorical_crossentropy", [], epoch_mode="per_batch")
+    trainer.fit(model.get_weights(), feat, lab, epochs=epochs,
+                batch_size=32, validation_split=0.0, shuffle=True, seed=5)
+    # coarse column: its groups set the outer visit order → exactly once
+    assert lab.chunks_decoded - d0_y <= 3 * epochs, \
+        "coarse column must not thrash its row-group LRU"
+    # fine column: once per outer group it overlaps (8 + 2 straddles),
+    # plus at most one LRU eviction per batch around the sliver chunks
+    # the boundary merge creates (8 batches) — still O(groups)/epoch,
+    # where a global-row shuffle would decode ~every group per batch
+    # (~64/epoch at this config)
+    assert feat.chunks_decoded - d0_x <= (8 + 2 + 8) * epochs
+
+
+def test_nullable_int_column_widens_not_corrupts(tmp_path):
+    """A nullable int64 column with nulls must surface as float64 with
+    NaN (pandas semantics) — never silently cast NaN into int garbage."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "n.parquet")
+    vals = [1, None, 3, 4, None, 6]
+    pq.write_table(pa.table({"v": pa.array(vals, type=pa.int64())}), path,
+                   row_group_size=3)
+    src = ParquetSource(path, "v")
+    assert src.dtype == np.float64
+    got = src.take(np.array([0, 1, 4, 5]))
+    np.testing.assert_array_equal(got, [1.0, np.nan, np.nan, 6.0])
+    # mixed groups: group starting at 2 has rows [3, 4, None]
+    np.testing.assert_array_equal(src.read(2, 4), [3.0, 4.0])
+
+    # a clean int column stays int
+    clean = str(tmp_path / "c.parquet")
+    pq.write_table(pa.table({"v": pa.array([1, 2, 3], type=pa.int64())}),
+                   clean)
+    assert ParquetSource(clean, "v").dtype == np.int64
+
+
+def test_plain_list_column_probe(tmp_path):
+    """Variable-length list columns (what pandas/Spark write by default)
+    need a decode probe for the row width — the probe itself must not
+    trip the declared-dtype check."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    path = str(tmp_path / "l.parquet")
+    pq.write_table(pa.table({"f": pa.array([row for row in x])}), path,
+                   row_group_size=4)
+    src = ParquetSource(path, "f")
+    assert src.shape == (10, 4)
+    np.testing.assert_allclose(np.asarray(src), x, rtol=1e-6)
+    np.testing.assert_allclose(src.take([-1, 3]), x[[-1, 3]], rtol=1e-6)
+
+
+def test_negative_fancy_indices_wrap_like_numpy(tmp_path):
+    x, y = _problem(n=200)
+    xs, _ = _write_npy_shards(tmp_path, x, y, cuts=[100])
+    src = ConcatSource([NpySource(p) for p in xs])
+    np.testing.assert_array_equal(src[np.array([-1, -200, 5])],
+                                  x[np.array([-1, -200, 5])])
+    view = src[50:150]
+    np.testing.assert_array_equal(view.take([-1, 0]), x[[149, 50]])
+    with pytest.raises(IndexError):
+        src.take([200])
+    with pytest.raises(IndexError):
+        src.take([-201])
+
+
+def test_parquet_source_concurrent_reads_are_safe(tmp_path):
+    """Concurrent reads (async/hogwild workers materialize shards from a
+    thread pool) must serialize behind the per-source lock and return
+    correct rows — pyarrow's ParquetFile is not thread-safe."""
+    x, y = _problem(n=512)
+    labels = np.argmax(y, axis=1).astype(np.int64)
+    _write_parquet_parts(tmp_path, x, labels, cuts=[], row_group_size=32)
+    src = ParquetSource(str(tmp_path / "part-00000.parquet"), "features")
+
+    rng = np.random.default_rng(3)
+    jobs = []
+    for _ in range(64):
+        if rng.random() < 0.5:
+            lo = int(rng.integers(0, 480))
+            jobs.append(("read", lo, lo + int(rng.integers(1, 32))))
+        else:
+            jobs.append(("take", rng.integers(0, 512, size=40), None))
+
+    def run(job):
+        kind, a, b = job
+        return src.read(a, b) if kind == "read" else src.take(a)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(run, jobs))
+    for job, got in zip(jobs, results):
+        kind, a, b = job
+        want = x[a:b] if kind == "read" else x[a]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
