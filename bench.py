@@ -283,18 +283,28 @@ def _load_rows(ttl: float) -> dict:
 
 
 def _save_row(name: str, entry: dict):
+    # concurrent captures are expected (driver retry, tunnel watcher, a
+    # next healthy window): the read-modify-write runs under an fcntl
+    # lock so two writers can't last-writer-wins away each other's rows
     try:
-        try:
-            with open(_ROW_STORE) as f:
-                store = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            store = {}
-        store[name] = entry
+        import fcntl
+    except ImportError:  # non-POSIX: best-effort unlocked fallback
+        fcntl = None
+    try:
         os.makedirs(_BENCH_DIR, exist_ok=True)
-        tmp = _ROW_STORE + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(store, f, indent=1)
-        os.replace(tmp, _ROW_STORE)
+        with open(_ROW_STORE + ".lock", "w") as lock_f:
+            if fcntl is not None:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                with open(_ROW_STORE) as f:
+                    store = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                store = {}
+            store[name] = entry
+            tmp = _ROW_STORE + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(store, f, indent=1)
+            os.replace(tmp, _ROW_STORE)
     except OSError:
         pass  # read-only checkout: the in-memory copy still gets emitted
 

@@ -86,6 +86,18 @@ class ColumnSource:
         raise NotImplementedError
 
     # -- provided ---------------------------------------------------------
+    def num_rows(self) -> int:
+        """Row count, guaranteed cheap (no data decode). Subclasses with
+        a lazily-probed row shape override this so containers can size
+        themselves without triggering the probe."""
+        return self.shape[0]
+
+    def row_shape_hint(self) -> Optional[Tuple[int, ...]]:
+        """Trailing (per-row) shape when it is knowable without decoding
+        data, else ``None`` (ragged-list Parquet columns need a decode
+        to learn their width)."""
+        return tuple(self.shape[1:])
+
     def chunk_bounds(self) -> Optional[np.ndarray]:
         """Boundaries of the source's natural read granularity (row-group
         edges for Parquet, file edges for concatenated shards), as an
@@ -188,6 +200,12 @@ class SourceView(ColumnSource):
         raise AssertionError("SourceView.read delegates to its base")
 
     _take = _read
+
+    def num_rows(self) -> int:
+        return self._hi - self._lo
+
+    def row_shape_hint(self) -> Optional[Tuple[int, ...]]:
+        return self._base.row_shape_hint()
 
     def chunk_bounds(self) -> Optional[np.ndarray]:
         base = self._base.chunk_bounds()
@@ -322,14 +340,14 @@ class ParquetSource(ColumnSource):
         # empty partitions) their true shape/dtype
         t = schema.field(self.column).type
         if pa.types.is_fixed_size_list(t):
-            self._row_shape: Tuple[int, ...] = (t.list_size,)
+            self._row_shape: Optional[Tuple[int, ...]] = (t.list_size,)
             self._dtype = np.dtype(t.value_type.to_pandas_dtype())
         elif pa.types.is_list(t) or pa.types.is_large_list(t):
-            probe = (self._group(0) if self._n
-                     else np.zeros((0, 0), np.dtype(
-                         t.value_type.to_pandas_dtype())))
-            self._row_shape = probe.shape[1:]
-            self._dtype = probe.dtype
+            # ragged list: the row WIDTH needs a decode, so it resolves
+            # lazily at first shape access — constructing a 1000-part
+            # dataset must not decode 1000 row groups
+            self._row_shape = None
+            self._dtype = np.dtype(t.value_type.to_pandas_dtype())
         else:
             self._row_shape = ()
             self._dtype = np.dtype(t.to_pandas_dtype())
@@ -363,11 +381,25 @@ class ParquetSource(ColumnSource):
 
     @property
     def shape(self) -> Tuple[int, ...]:
+        if self._row_shape is None:
+            # ragged-list width probe; the probe group may also widen
+            # the declared dtype (nulls the footer statistics didn't
+            # report decode int as float64)
+            probe = (self._group(0) if self._n
+                     else np.zeros((0, 0), self._dtype))
+            self._row_shape = tuple(probe.shape[1:])
+            self._dtype = np.result_type(self._dtype, probe.dtype)
         return (self._n,) + tuple(self._row_shape)
 
     @property
     def dtype(self):
         return self._dtype
+
+    def num_rows(self) -> int:
+        return self._n
+
+    def row_shape_hint(self) -> Optional[Tuple[int, ...]]:
+        return None if self._row_shape is None else tuple(self._row_shape)
 
     def _group(self, g: int) -> np.ndarray:
         with self._lock:
@@ -380,9 +412,10 @@ class ParquetSource(ColumnSource):
                 self._pf = pq.ParquetFile(self.path)
             arr = _arrow_to_numpy(
                 self._pf.read_row_group(g, columns=[self.column]).column(0))
-            # declared dtype is absent exactly once: during the ragged-
-            # list shape probe __init__ itself runs through here
-            declared = getattr(self, "_dtype", None)
+            # while the ragged width is unprobed the dtype is not final
+            # either (the probe may widen it) — skip the drift check for
+            # the probe decode itself
+            declared = self._dtype if self._row_shape is not None else None
             if declared is not None and arr.dtype != declared:
                 # per-group decode dtype can drift from the declared one
                 # (a nullable int group WITH nulls decodes float64, one
@@ -416,7 +449,7 @@ class ParquetSource(ColumnSource):
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
-        out = np.empty((idx.size,) + tuple(self._row_shape),
+        out = np.empty((idx.size,) + tuple(self.shape[1:]),
                        dtype=self._dtype)
         groups = np.searchsorted(self._bounds, idx, side="right") - 1
         for g in np.unique(groups):
@@ -450,16 +483,19 @@ class ConcatSource(ColumnSource):
         # drop zero-row parts (Spark writes empty part files for empty
         # partitions): they contribute nothing and must not constrain
         # the row shape or promote the dtype
-        nonempty = [p for p in parts if p.shape[0]]
+        nonempty = [p for p in parts if p.num_rows()]
         self.parts = nonempty or parts[:1]
-        tail = self.parts[0].shape[1:]
-        for p in self.parts[1:]:
-            if p.shape[1:] != tail:
-                raise ValueError(
-                    "all parts must share the row shape: "
-                    f"{tail} vs {p.shape[1:]}")
+        # validate row shapes across the parts that know theirs cheaply
+        # (npy headers, fixed-width parquet); ragged-list parts resolve
+        # at first read and are checked there — constructing over 1000
+        # parts must not decode 1000 row groups just to cross-check
+        hints = {p.row_shape_hint() for p in self.parts} - {None}
+        if len(hints) > 1:
+            raise ValueError(
+                f"all parts must share the row shape: got {sorted(hints)}")
+        self._tail: Optional[Tuple[int, ...]] = hints.pop() if hints else None
         self._dtype = np.result_type(*[p.dtype for p in self.parts])
-        sizes = [p.shape[0] for p in self.parts]
+        sizes = [p.num_rows() for p in self.parts]
         self._bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(
             np.int64)
 
@@ -473,11 +509,27 @@ class ConcatSource(ColumnSource):
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (int(self._bounds[-1]),) + tuple(self.parts[0].shape[1:])
+        if self._tail is None:
+            self._tail = tuple(self.parts[0].shape[1:])
+        return (int(self._bounds[-1]),) + self._tail
 
     @property
     def dtype(self):
         return self._dtype
+
+    def num_rows(self) -> int:
+        return int(self._bounds[-1])
+
+    def row_shape_hint(self) -> Optional[Tuple[int, ...]]:
+        return self._tail
+
+    def _check_tail(self, part_idx: int, chunk: np.ndarray) -> np.ndarray:
+        tail = self.shape[1:]
+        if tuple(chunk.shape[1:]) != tail:
+            raise ValueError(
+                f"part {part_idx} ({self.parts[part_idx]!r}) has row "
+                f"shape {tuple(chunk.shape[1:])}, expected {tail}")
+        return chunk.astype(self._dtype, copy=False)
 
     def _read(self, lo: int, hi: int) -> np.ndarray:
         out = []
@@ -488,18 +540,18 @@ class ConcatSource(ColumnSource):
                 break
             part = self.parts[p]
             chunk = part.read(max(0, lo - base),
-                              min(part.shape[0], hi - base))
-            out.append(chunk.astype(self._dtype, copy=False))
+                              min(part.num_rows(), hi - base))
+            out.append(self._check_tail(p, chunk))
         return out[0] if len(out) == 1 else np.concatenate(out)
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
-        out = np.empty((idx.size,) + tuple(self.parts[0].shape[1:]),
+        out = np.empty((idx.size,) + tuple(self.shape[1:]),
                        dtype=self._dtype)
         owner = np.searchsorted(self._bounds, idx, side="right") - 1
         for p in np.unique(owner):
             mask = owner == p
             rows = self.parts[int(p)].take(idx[mask] - int(self._bounds[p]))
-            out[mask] = rows.astype(self._dtype, copy=False)
+            out[mask] = self._check_tail(int(p), rows)
         return out
 
     def chunk_bounds(self) -> Optional[np.ndarray]:

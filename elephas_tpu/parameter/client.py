@@ -262,6 +262,12 @@ class SocketClient(BaseParameterClient):
                 sock.sendall(b"U" + update_id)
                 send(sock, arrays, kind=kind)
                 ack = sock.recv(1)  # block until the delta is applied
+                if ack == b"e":
+                    # permanent rejection (wrong arity/shapes): fail
+                    # fast — retrying would resend the same bad frame
+                    raise ValueError(
+                        "parameter server rejected the delta "
+                        "(mismatched array count or shapes)")
                 if ack != b"k":
                     raise ConnectionError("parameter server did not "
                                           "acknowledge the update")

@@ -17,8 +17,10 @@ Both servers hold the authoritative weights as a flat numpy list — the
 wire currency — so no JAX device state lives on the serving threads.
 """
 import abc
+import logging
 import selectors
 import socket
+import struct
 import threading
 import time
 from collections import OrderedDict
@@ -26,6 +28,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
 
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
@@ -87,6 +91,18 @@ class BaseParameterServer(abc.ABC):
 
     def apply_delta(self, delta: List[np.ndarray],
                     update_id: Optional[str] = None):
+        # validate BEFORE applying: subtract_params zips the lists, so a
+        # short or mis-shaped delta would silently truncate/corrupt the
+        # served weights for every client until restart
+        if len(delta) != len(self.weights):
+            raise ValueError(
+                f"delta has {len(delta)} arrays, model has "
+                f"{len(self.weights)}")
+        for i, (d, w) in enumerate(zip(delta, self.weights)):
+            if tuple(np.shape(d)) != tuple(np.shape(w)):
+                raise ValueError(
+                    f"delta[{i}] shape {np.shape(d)} != weight shape "
+                    f"{np.shape(w)}")
         if update_id is not None:
             # claim the id before applying. A duplicate of a completed
             # apply returns immediately; a duplicate of an IN-FLIGHT apply
@@ -195,8 +211,14 @@ class HttpServer(BaseParameterServer):
                     self.send_response(400)
                     self.end_headers()
                     return
-                server.apply_delta(delta,
-                                   update_id=self.headers.get("X-Update-Id"))
+                try:
+                    server.apply_delta(
+                        delta, update_id=self.headers.get("X-Update-Id"))
+                except ValueError as err:  # wrong arity/shapes -> 400
+                    _LOG.warning("rejected delta: %s", err)
+                    self.send_response(400)
+                    self.end_headers()
+                    return
                 body = b"Update done"
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
@@ -345,14 +367,38 @@ class SocketServer(BaseParameterServer):
                         arrays, kind = receive_frame(conn)
                         delta = (dequantize_delta(arrays)
                                  if kind == KIND_DELTA_Q8 else arrays)
-                        self.apply_delta(delta, update_id=update_id)
+                        try:
+                            self.apply_delta(delta, update_id=update_id)
+                        except ValueError as err:
+                            # the frame was fully read, so the stream is
+                            # still in sync: NACK a validation-rejected
+                            # delta so the client fails fast instead of
+                            # retrying a permanent error
+                            _LOG.warning("rejected delta: %s", err)
+                            conn.sendall(b"e")
+                            continue
                         conn.sendall(b"k")  # ack: delta applied
                     elif opcode == b"g":
                         send(conn, self.get_weights())
                     elif opcode == b"h":
                         conn.sendall(b"k")  # alive
+                    else:
+                        # unknown opcode = desynced or garbage stream;
+                        # continuing would interpret payload bytes as
+                        # opcodes — drop the connection instead
+                        _LOG.warning("dropping connection: unknown "
+                                     "opcode %r", opcode)
+                        return
                 except OSError:
-                    # mid-RPC stall or client death: drop the connection
-                    # (the client's retry opens a fresh one); a half-read
+                    # mid-RPC stall or client death: drop silently (the
+                    # client's retry opens a fresh one); a half-read
                     # frame must never be applied
+                    return
+                except (ValueError, struct.error, KeyError) as err:
+                    # corrupt/garbage frame (decode errors) or a
+                    # validation-rejected delta: drop the connection,
+                    # loudly — malformed input must not kill the handler
+                    # thread, but repeated drops must be diagnosable
+                    _LOG.warning("dropping connection after bad frame/"
+                                 "delta: %s", err)
                     return

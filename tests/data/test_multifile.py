@@ -320,6 +320,43 @@ def test_plain_list_column_probe(tmp_path):
     np.testing.assert_allclose(src.take([-1, 3]), x[[-1, 3]], rtol=1e-6)
 
 
+def test_ragged_list_with_nulls_and_no_stats_widens_at_probe(tmp_path):
+    """Ragged int lists containing nulls, written WITHOUT footer
+    statistics: the lazy width probe must widen the declared dtype to
+    float64 (NaN for nulls) instead of raising."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "r.parquet")
+    pq.write_table(pa.table({"f": pa.array([[1, 2], [3, None], [5, 6]])}),
+                   path, write_statistics=False)
+    src = ParquetSource(path, "f")
+    assert src.shape == (3, 2)
+    assert src.dtype == np.float64
+    got = np.asarray(src)
+    assert got[1, 0] == 3.0 and np.isnan(got[1, 1])
+
+
+def test_ragged_list_directory_constructs_without_decoding_all(tmp_path):
+    """A directory of plain-list part files must not decode a row group
+    per part at construction — the width probe is lazy (at most one
+    group, from the first part)."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    x = np.arange(120, dtype=np.float32).reshape(30, 4)
+    for i, sl in enumerate((slice(0, 10), slice(10, 20), slice(20, 30))):
+        pq.write_table(pa.table({"f": pa.array([r for r in x[sl]])}),
+                       str(tmp_path / f"part-{i:05d}.parquet"),
+                       row_group_size=5)
+    ds = Dataset.from_parquet_dir(str(tmp_path), ["f"])
+    src = ds.columns[0]
+    assert sum(p.chunks_decoded for p in src.parts) <= 1, \
+        "construction must not probe every part"
+    np.testing.assert_allclose(np.asarray(src), x, rtol=1e-6)
+    assert src.shape == (30, 4)
+
+
 def test_negative_fancy_indices_wrap_like_numpy(tmp_path):
     x, y = _problem(n=200)
     xs, _ = _write_npy_shards(tmp_path, x, y, cuts=[100])

@@ -95,6 +95,62 @@ def test_socket_server_restart():
         server.stop()
 
 
+def test_socket_server_survives_corrupt_frames():
+    """Garbage on the wire (bad opcode, truncated/corrupt frame header)
+    must degrade to a dropped connection — never an unhandled traceback
+    in the handler thread — and the server must keep serving."""
+    import socket as socket_mod
+
+    port = _next_port()
+    server = SocketServer(_serialized_model(), port, "asynchronous")
+    server.start()
+    try:
+        for garbage in (b"\xff\x00\x01", b"u" + b"\x7f" * 40,
+                        b"g",  # valid opcode, then die mid-response read
+                        b"U" + b"z" * 32 + b"\xde\xad\xbe\xef" * 8):
+            with socket_mod.create_connection(("127.0.0.1", port),
+                                              timeout=5) as s:
+                s.sendall(garbage)
+                time.sleep(0.05)
+        # a healthy client still gets clean service afterwards
+        client = SocketClient(port)
+        weights = client.get_parameters()
+        assert len(weights) == 4
+        client.update_parameters([np.zeros_like(w) for w in weights])
+        client.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(HttpServer, HttpClient),
+                          (SocketServer, SocketClient)])
+def test_short_or_misshaped_delta_rejected_not_applied(server_cls,
+                                                       client_cls):
+    """A structurally valid frame carrying the wrong number of arrays
+    (or wrong shapes) must be rejected BEFORE it reaches the weights —
+    subtract_params zips, so applying would silently truncate the
+    served model for every client."""
+    port = _next_port()
+    server = server_cls(_serialized_model(), port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port)
+        before = client.get_parameters()
+        for bad in ([np.zeros_like(before[0])],                 # short
+                    [np.zeros((2, 2), np.float32)] * 4):        # misshaped
+            with pytest.raises(Exception):
+                client.update_parameters(bad)
+        after = client.get_parameters()
+        assert len(after) == len(before)
+        for a, b in zip(after, before):
+            np.testing.assert_array_equal(a, b)
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
 def test_hogwild_mode_lock_free_still_serves():
     port = _next_port()
     server = HttpServer(_serialized_model(), port, "hogwild")
