@@ -98,6 +98,12 @@ class ColumnSource:
         to learn their width)."""
         return tuple(self.shape[1:])
 
+    def dtype_may_widen(self) -> bool:
+        """Whether ``dtype`` could still change once data is decoded
+        (a ragged int Parquet column whose footer statistics can't
+        rule out nulls). Containers eager-probe only such parts."""
+        return False
+
     def chunk_bounds(self) -> Optional[np.ndarray]:
         """Boundaries of the source's natural read granularity (row-group
         edges for Parquet, file edges for concatenated shards), as an
@@ -354,14 +360,22 @@ class ParquetSource(ColumnSource):
         # nullable int/bool columns decode as float64 (NaN for nulls,
         # pandas semantics) — widen the declared dtype up front when the
         # footer statistics prove nulls exist, so declared == decoded
-        if self._dtype.kind in "iub" and self._null_count(md) > 0:
+        nulls, stats_complete = self._null_stats(md)
+        if self._dtype.kind in "iub" and nulls > 0:
             self._dtype = np.dtype(np.float64)
+        # an unresolved ragged int column WITHOUT complete statistics
+        # might still widen at probe time — containers consult this so
+        # they only eager-probe genuinely uncertain parts
+        self._dtype_uncertain = (self._row_shape is None
+                                 and self._dtype.kind in "iub"
+                                 and not stats_complete)
 
-    def _null_count(self, md) -> int:
-        """Total nulls in this column per footer statistics; 0 when
-        statistics are absent (the decode-time dtype check still guards
-        that case)."""
+    def _null_stats(self, md) -> Tuple[int, bool]:
+        """(total nulls per footer statistics, statistics complete?).
+        With complete statistics a zero count PROVES no nulls; without,
+        the decode-time dtype check still guards corruption."""
         total = 0
+        complete = True
         for g in range(md.num_row_groups):
             rg = md.row_group(g)
             for c in range(rg.num_columns):
@@ -371,7 +385,12 @@ class ParquetSource(ColumnSource):
                 st = col.statistics
                 if st is not None and st.has_null_count:
                     total += st.null_count
-        return total
+                else:
+                    complete = False
+        return total, complete
+
+    def dtype_may_widen(self) -> bool:
+        return self._dtype_uncertain and self._row_shape is None
 
     def __getstate__(self):
         return {"path": self.path, "column": self.column}
@@ -384,11 +403,14 @@ class ParquetSource(ColumnSource):
         if self._row_shape is None:
             # ragged-list width probe; the probe group may also widen
             # the declared dtype (nulls the footer statistics didn't
-            # report decode int as float64)
+            # report decode int as float64). Dtype settles BEFORE
+            # _row_shape: a concurrent _group gates its drift check on
+            # _row_shape being set, so the narrow dtype must never be
+            # observable alongside a non-None row shape
             probe = (self._group(0) if self._n
                      else np.zeros((0, 0), self._dtype))
-            self._row_shape = tuple(probe.shape[1:])
             self._dtype = np.result_type(self._dtype, probe.dtype)
+            self._row_shape = tuple(probe.shape[1:])
         return (self._n,) + tuple(self._row_shape)
 
     @property
@@ -494,6 +516,14 @@ class ConcatSource(ColumnSource):
             raise ValueError(
                 f"all parts must share the row shape: got {sorted(hints)}")
         self._tail: Optional[Tuple[int, ...]] = hints.pop() if hints else None
+        # a part whose dtype could still widen at decode time (ragged
+        # int lists with incomplete footer statistics) must settle
+        # before the concat freezes its own dtype and allocates buffers
+        # against it; parts with complete statistics — the normal write
+        # path — and float parts stay construction-lazy
+        for p in self.parts:
+            if p.dtype_may_widen():
+                p.shape  # forces the part's width/dtype probe
         self._dtype = np.result_type(*[p.dtype for p in self.parts])
         sizes = [p.num_rows() for p in self.parts]
         self._bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(
@@ -529,6 +559,14 @@ class ConcatSource(ColumnSource):
             raise ValueError(
                 f"part {part_idx} ({self.parts[part_idx]!r}) has row "
                 f"shape {tuple(chunk.shape[1:])}, expected {tail}")
+        if chunk.dtype != self._dtype and not np.can_cast(
+                chunk.dtype, self._dtype, casting="safe"):
+            # never silently narrow (NaN -> int garbage); this only
+            # fires if a part's dtype widened after construction in a
+            # way the init-time probe couldn't anticipate
+            raise ValueError(
+                f"part {part_idx} ({self.parts[part_idx]!r}) decoded "
+                f"{chunk.dtype}, concat dtype is {self._dtype}")
         return chunk.astype(self._dtype, copy=False)
 
     def _read(self, lo: int, hi: int) -> np.ndarray:

@@ -1,0 +1,34 @@
+"""Shared batched-logits predict loop for the token-model families."""
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def batched_logits_predict(jit_forward, params, tokens, batch_size: int,
+                           out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Run ``jit_forward(params, batch)`` over ``tokens`` in input order.
+
+    ``tokens`` may be an ndarray or a lazy
+    :class:`~elephas_tpu.data.sources.ColumnSource` (read O(batch) at a
+    time). ``out``: optional preallocated ``(rows, seq, vocab)`` array
+    (e.g. a writable memmap) receiving each batch's logits in place —
+    with a file-backed token column neither the inputs nor the
+    (rows×seq×vocab, typically huge) outputs ever fully materialize in
+    memory. Without ``out`` the batches concatenate as before.
+    """
+    from ..data.sources import ColumnSource
+
+    if not isinstance(tokens, ColumnSource):
+        tokens = np.asarray(tokens)
+    outs = []
+    for i in range(0, tokens.shape[0], batch_size):
+        chunk = np.asarray(jit_forward(
+            params, jnp.asarray(np.asarray(tokens[i:i + batch_size]))))
+        if out is not None:
+            out[i:i + chunk.shape[0]] = chunk
+        else:
+            outs.append(chunk)
+    if out is not None:
+        return out
+    return np.concatenate(outs, axis=0)

@@ -200,20 +200,20 @@ class SSMModel:
         return total / n
 
     def predict(self, tokens: np.ndarray, batch_size: int = 8,
-                verbose: int = 0) -> np.ndarray:
+                verbose: int = 0,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
         """Logits ``(rows, seq, vocab)`` in input order (the same
-        contract as ``TransformerModel.predict``)."""
+        contract as ``TransformerModel.predict``, including ``out=``
+        streaming into a preallocated array/memmap)."""
         from .ssm import ssm_forward
+        from ._streaming import batched_logits_predict
 
-        tokens = np.asarray(tokens)
         config = self.config
         if self._jit_forward is None:
             self._jit_forward = jax.jit(
                 lambda p, t: ssm_forward(p, t, config))
-        outs = [np.asarray(self._jit_forward(
-                    self.params, jnp.asarray(tokens[i:i + batch_size])))
-                for i in range(0, tokens.shape[0], batch_size)]
-        return np.concatenate(outs, axis=0)
+        return batched_logits_predict(self._jit_forward, self.params,
+                                      tokens, batch_size, out=out)
 
     # ------------------------------------------------ checkpoint contract
     def training_state(self) -> Dict:

@@ -458,17 +458,23 @@ class TransformerModel:
 
     # ------------------------------------------------------ inference/eval
     def predict(self, tokens: np.ndarray, batch_size: int = 8,
-                verbose: int = 0) -> np.ndarray:
-        """Logits ``(rows, seq, vocab)`` in input order."""
-        tokens = np.asarray(tokens)
+                verbose: int = 0,
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Logits ``(rows, seq, vocab)`` in input order.
+
+        ``out``: optional preallocated ``(rows, seq, vocab)`` array
+        (e.g. a writable memmap) receiving each batch's logits in
+        place — with a file-backed token column neither the inputs nor
+        the (rows×seq×vocab, typically huge) outputs ever fully
+        materialize in memory."""
+        from ._streaming import batched_logits_predict
+
         if self._jit_forward is None:
             config = self.config
             self._jit_forward = jax.jit(
                 lambda p, t: forward(p, t, config))
-        outs = [np.asarray(self._jit_forward(
-                    self.params, jnp.asarray(tokens[i:i + batch_size])))
-                for i in range(0, tokens.shape[0], batch_size)]
-        return np.concatenate(outs, axis=0)
+        return batched_logits_predict(self._jit_forward, self.params,
+                                      tokens, batch_size, out=out)
 
     def generate(self, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,

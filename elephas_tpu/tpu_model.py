@@ -426,14 +426,20 @@ class TPUModel:
         self._training_histories.append(history)
 
     @staticmethod
-    def _extract_tokens(data) -> np.ndarray:
+    def _extract_tokens(data):
         """Token rows from a Dataset / (tokens, labels) pair / array — LM
-        targets are the shifted input, so any label column is ignored."""
+        targets are the shifted input, so any label column is ignored.
+        Returns an ndarray, or a lazy ColumnSource passed through unread
+        (predict streams those batch-at-a-time; fit materializes them)."""
+        from .data.sources import ColumnSource
+
         if isinstance(data, Dataset):
             return (data.columns[0] if data.is_columnar
                     else np.asarray(data.rows()))
         if isinstance(data, tuple) and len(data) == 2:
-            return np.asarray(data[0])
+            data = data[0]
+        if isinstance(data, ColumnSource):
+            return data
         return np.asarray(data)
 
     def _worker_metric_fns(self):
@@ -731,14 +737,18 @@ class TPUModel:
         from .parallel.sync_trainer import build_sharded_predict
 
         if isinstance(self._master_network, (TransformerModel, SSMModel)):
-            if out is not None:
-                raise ValueError("out= streaming is not supported for "
-                                 "transformer/SSM masters (their predict "
-                                 "returns token logits via the model's "
-                                 "own batching)")
+            tokens = self._extract_tokens(data)
+            if isinstance(out, str):
+                # (rows, seq, vocab) logits stream straight to a .npy
+                # memmap: the output of a long-corpus predict is usually
+                # far larger than the inputs and must not accumulate
+                out = np.lib.format.open_memmap(
+                    out, mode="w+",
+                    shape=(int(tokens.shape[0]), int(tokens.shape[1]),
+                           int(self._master_network.config.vocab_size)),
+                    dtype=np.float32)
             return self._master_network.predict(
-                self._extract_tokens(data),
-                batch_size=batch_size or self.batch_size)
+                tokens, batch_size=batch_size or self.batch_size, out=out)
         if isinstance(data, Dataset):
             if data.is_columnar:
                 x = data.columns[0]  # lazy sources pass through unread

@@ -337,6 +337,28 @@ def test_ragged_list_with_nulls_and_no_stats_widens_at_probe(tmp_path):
     assert got[1, 0] == 3.0 and np.isnan(got[1, 1])
 
 
+def test_concat_of_ragged_int_parts_with_nulls_widens(tmp_path):
+    """A directory of ragged INT-list parts where one part holds nulls
+    (no footer stats): the concat dtype must settle to float64 before
+    any buffer is allocated — NaN rows must never be cast to int
+    garbage."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"f": pa.array([[1, 2], [3, None]])}),
+                   str(tmp_path / "part-00000.parquet"),
+                   write_statistics=False)
+    pq.write_table(pa.table({"f": pa.array([[5, 6], [7, 8]])}),
+                   str(tmp_path / "part-00001.parquet"),
+                   write_statistics=False)
+    ds = Dataset.from_parquet_dir(str(tmp_path), ["f"])
+    src = ds.columns[0]
+    assert src.dtype == np.float64
+    got = src.take(np.array([1, 3]))
+    assert got[0, 0] == 3.0 and np.isnan(got[0, 1]) and got[1, 1] == 8.0
+    np.testing.assert_array_equal(src.read(2, 4), [[5.0, 6.0], [7.0, 8.0]])
+
+
 def test_ragged_list_directory_constructs_without_decoding_all(tmp_path):
     """A directory of plain-list part files must not decode a row group
     per part at construction — the width probe is lazy (at most one
@@ -355,6 +377,19 @@ def test_ragged_list_directory_constructs_without_decoding_all(tmp_path):
         "construction must not probe every part"
     np.testing.assert_allclose(np.asarray(src), x, rtol=1e-6)
     assert src.shape == (30, 4)
+
+    # ragged INT token parts written with default (complete) statistics:
+    # null-freedom is proven by the footer, so construction stays lazy
+    toks = np.arange(60, dtype=np.int64).reshape(20, 3)
+    idir = tmp_path / "int"
+    idir.mkdir()
+    for i, sl in enumerate((slice(0, 10), slice(10, 20))):
+        pq.write_table(pa.table({"t": pa.array([r for r in toks[sl]])}),
+                       str(idir / f"part-{i:05d}.parquet"))
+    isrc = Dataset.from_parquet_dir(str(idir), ["t"]).columns[0]
+    assert sum(p.chunks_decoded for p in isrc.parts) <= 1
+    assert isrc.dtype == np.int64
+    np.testing.assert_array_equal(np.asarray(isrc), toks)
 
 
 def test_negative_fancy_indices_wrap_like_numpy(tmp_path):

@@ -291,22 +291,49 @@ def test_mixed_lazy_and_in_memory_columns_train_identically(tmp_path):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
-def test_predict_out_rejected_for_token_models(tmp_path):
-    """out= must raise, not silently return memory, for model families
-    whose predict doesn't stream."""
+def _token_tpu_model(family):
     import jax.numpy as jnp
 
-    from elephas_tpu.models.transformer import TransformerConfig
-    from elephas_tpu.models.transformer_model import TransformerModel
+    from elephas_tpu.models import Adam
     from elephas_tpu.tpu_model import TPUModel
 
-    from elephas_tpu.models import Adam
+    if family == "transformer":
+        from elephas_tpu.models.transformer import TransformerConfig
+        from elephas_tpu.models.transformer_model import TransformerModel
 
-    tm = TransformerModel(TransformerConfig(
-        vocab_size=64, num_layers=1, num_heads=2, d_model=16, d_ff=32,
-        max_seq_len=16, dtype=jnp.float32))
-    tm.compile(Adam(learning_rate=1e-3), seed=0)
-    tpu_model = TPUModel(tm, mode="synchronous")
-    tokens = np.ones((2, 8), dtype=np.int32)
-    with pytest.raises(ValueError, match="out="):
-        tpu_model.predict(tokens, out=str(tmp_path / "p.npy"))
+        master = TransformerModel(TransformerConfig(
+            vocab_size=64, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+            max_seq_len=16, dtype=jnp.float32))
+    else:
+        from elephas_tpu.models.ssm import SSMConfig
+        from elephas_tpu.models.ssm_model import SSMModel
+
+        master = SSMModel(SSMConfig(
+            vocab_size=64, num_layers=1, d_model=16, dtype=jnp.float32))
+        master.build(seed=0)
+        master.compile("adam")
+        return TPUModel(master, mode="synchronous")
+    master.compile(Adam(learning_rate=1e-3), seed=0)
+    return TPUModel(master, mode="synchronous")
+
+
+@pytest.mark.parametrize("family", ["transformer", "ssm"])
+def test_predict_out_streams_token_models(family, tmp_path):
+    """Token-model predict streams its (rows, seq, vocab) logits to a
+    .npy memmap — parity with the in-memory result, bounded input reads
+    when the token column is file-backed."""
+    tpu_model = _token_tpu_model(family)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 64, size=(10, 8)).astype(np.int32)
+    tok_path = str(tmp_path / "tokens.npy")
+    np.save(tok_path, tokens)
+    src = NpySource(tok_path)
+
+    in_mem = tpu_model.predict(tokens, batch_size=4)
+    out_path = str(tmp_path / "logits.npy")
+    returned = tpu_model.predict(src, batch_size=4, out=out_path)
+    assert isinstance(returned, np.memmap)
+    assert src.max_read_rows <= 4, "token reads must stay O(batch)"
+    streamed = np.load(out_path, mmap_mode="r")
+    assert streamed.shape == (10, 8, 64)
+    np.testing.assert_allclose(np.asarray(streamed), in_mem, atol=1e-6)
