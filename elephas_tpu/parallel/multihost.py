@@ -109,12 +109,64 @@ def maybe_initialize_from_env():
         pass  # fit()'s ensure_multihost will surface the warning
 
 
-def barrier(name: str):
-    """Cross-process rendezvous (no-op single-process)."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+#: set to the barrier name after a timeout: further barriers in this
+#: process refuse to run (the abandoned rendezvous could pair with them)
+_POISONED_BARRIER: Optional[str] = None
 
-        multihost_utils.sync_global_devices(name)
+
+def barrier(name: str, timeout_s: Optional[float] = None):
+    """Cross-process rendezvous (no-op single-process).
+
+    Bounded: if a peer process died, its side of the rendezvous never
+    arrives and an unguarded ``sync_global_devices`` can block far past
+    the coordination service's failure detection. The sync runs on a
+    watchdog thread; on timeout (``ELEPHAS_TPU_BARRIER_TIMEOUT_S``,
+    default 900 s) the caller gets a clear RuntimeError naming the
+    barrier instead of a silent hang — the failure-detection contract
+    (SURVEY §5) at the DCN level.
+    """
+    global _POISONED_BARRIER
+    if jax.process_count() <= 1:
+        return
+    if _POISONED_BARRIER is not None:
+        # a previous timeout abandoned a watchdog thread still parked in
+        # its rendezvous; letting a NEW sync start could pair the stale
+        # rendezvous with a different barrier on the peers and corrupt
+        # the protocol — this process must restart, not retry
+        raise RuntimeError(
+            f"barrier {_POISONED_BARRIER!r} timed out earlier; the "
+            "cross-process rendezvous state of this process is "
+            "undefined. Restart the process — training resumes from "
+            "the latest checkpoint.")
+    import threading
+
+    from jax.experimental import multihost_utils
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("ELEPHAS_TPU_BARRIER_TIMEOUT_S",
+                                         "900"))
+    outcome = {}
+
+    def sync():
+        try:
+            multihost_utils.sync_global_devices(name)
+            outcome["ok"] = True
+        except Exception as err:  # noqa: BLE001 — re-raised on the caller
+            outcome["err"] = err
+
+    t = threading.Thread(target=sync, daemon=True, name=f"barrier-{name}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        _POISONED_BARRIER = name
+        raise RuntimeError(
+            f"barrier {name!r} timed out after {timeout_s:.0f}s — a peer "
+            "process likely died mid-run (crash or preemption), or is "
+            "pathologically slow. Restart the job; training resumes "
+            "from the latest checkpoint. ELEPHAS_TPU_BARRIER_TIMEOUT_S "
+            "tunes this deadline.")
+    if "err" in outcome:
+        raise outcome["err"]
 
 
 def is_coordinator() -> bool:

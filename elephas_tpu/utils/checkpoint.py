@@ -26,6 +26,21 @@ except Exception:  # pragma: no cover - orbax is in the base image
     _HAS_ORBAX = False
 
 
+def _spans_processes() -> bool:
+    """True in an initialized multi-process (DCN) run. Never initializes
+    the backend as a side effect."""
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return False
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:  # private API moved / import failure
+        return False
+
+
 def _is_coordinator() -> bool:
     """Process 0 owns remote-mirror writes (single-writer discipline).
 
@@ -79,8 +94,10 @@ class CheckpointManager:
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
-        self._checkpointer = (ocp.StandardCheckpointer() if _HAS_ORBAX
-                              else None)
+        # the orbax-vs-npz writer choice is made PER SAVE, not here: a
+        # manager built before jax.distributed is visible must not
+        # freeze the wrong backend (see _writer())
+        self._checkpointer = None
         # async-save machinery: ONE worker thread so queued writes keep
         # manifest ordering; errors surface at the next save()/wait()
         self._executor: Optional[ThreadPoolExecutor] = None
@@ -125,9 +142,11 @@ class CheckpointManager:
         (the device arrays are free for donation immediately). Writes
         queue on one worker, preserving step order; a failed background
         write re-raises at the next ``save``/``wait_until_finished``.
-        Async saves snapshot via host transfer, so in a multi-process
-        run whose arrays are not fully addressable use ``block=True``
-        (orbax writes those shard-wise from device)."""
+        Multi-process runs write process-local npz (single-writer
+        discipline — see ``_writer()``), so state must be host-fetchable
+        on the saving process: fully-replicate or all-gather cross-host-
+        sharded arrays first (the framework's own save currency, numpy
+        weight lists, always is)."""
         with self._pending_lock:
             seq = self._save_seq
             self._save_seq += 1
@@ -186,6 +205,24 @@ class CheckpointManager:
         if failed is not None:
             failed.result()
 
+    def _writer(self):
+        """The checkpoint writer for THIS save, decided at save time.
+
+        Orbax only when the run does not span processes: orbax's save
+        runs its own cross-process rendezvous, but this framework's
+        checkpoint discipline is single-writer (the coordinator saves,
+        peers don't) — an orbax save on one process collides with
+        whatever named barrier the peers are in (observed: corrupted
+        'workers_done' sync). Multi-process runs take the process-local
+        npz writer; state must be host-fetchable there (numpy weight
+        lists — the framework's save currency — always are).
+        """
+        if _HAS_ORBAX and not _spans_processes():
+            if self._checkpointer is None:
+                self._checkpointer = ocp.StandardCheckpointer()
+            return self._checkpointer
+        return None
+
     def _write(self, step: int, state: Dict[str, Any],
                model_json: Optional[str],
                distributed_config: Optional[Dict],
@@ -223,12 +260,22 @@ class CheckpointManager:
         step_dir = self.directory / f"step_{int(step)}"
         if step_dir.exists():
             shutil.rmtree(step_dir)
-        if self._checkpointer is not None:
-            self._checkpointer.save(step_dir.absolute(), state)
-            self._checkpointer.wait_until_finished()
+        writer = self._writer()
+        if writer is not None:
+            writer.save(step_dir.absolute(), state)
+            writer.wait_until_finished()
         else:
             step_dir.mkdir(parents=True)
             flat, treedef = _flatten(state)
+            try:
+                flat = {k: np.asarray(v) for k, v in flat.items()}
+            except RuntimeError as err:
+                raise RuntimeError(
+                    "multi-process checkpoint saves are process-local "
+                    "(npz), so state must be host-fetchable on the "
+                    "saving process; fully-replicate or all-gather "
+                    "cross-host-sharded arrays before save() "
+                    f"(leaf fetch failed: {err})") from err
             np.savez(step_dir / "state.npz", **flat)
             (step_dir / "treedef.json").write_text(json.dumps(treedef))
         manifest["steps"] = sorted(set(manifest["steps"]))
@@ -255,12 +302,24 @@ class CheckpointManager:
         if self._store is not None and not step_dir.exists():
             self._store.get_dir(f"{self._remote_url}/step_{int(step)}",
                                 str(step_dir))
-        if self._checkpointer is not None:
+        # format detection, not writer state: a multi-process run writes
+        # npz while a single-process run writes orbax — either side must
+        # restore what the other wrote
+        if (step_dir / "state.npz").exists():
+            data = np.load(step_dir / "state.npz")
+            treedef = json.loads((step_dir / "treedef.json").read_text())
+            return _unflatten({k: data[k] for k in data.files}, treedef)
+        if _HAS_ORBAX and any(step_dir.iterdir()):
+            if self._checkpointer is None:
+                self._checkpointer = ocp.StandardCheckpointer()
             return self._checkpointer.restore(step_dir.absolute(),
                                               target=template)
-        data = np.load(step_dir / "state.npz")
-        treedef = json.loads((step_dir / "treedef.json").read_text())
-        return _unflatten({k: data[k] for k in data.files}, treedef)
+        raise FileNotFoundError(
+            f"{step_dir} has no state.npz"
+            + (" and no orbax files — the write was likely interrupted "
+               "(truncated checkpoint)" if _HAS_ORBAX else
+               " — if it was written by orbax, orbax is needed to "
+               "restore it; otherwise the write was interrupted"))
 
     # ------------------------------------------------------------- metadata
     def annotate(self, **fields):

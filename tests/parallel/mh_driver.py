@@ -70,6 +70,71 @@ def main():
     model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
                   metrics=["acc"], seed=0)
 
+    if mode in ("async_crash", "async_resume"):
+        # DCN-level fault injection: "async_crash" hard-kills the last
+        # process mid-fit (simulated host death / preemption) while the
+        # coordinator checkpoints each epoch; "async_resume" restarts
+        # fresh processes that restore the latest checkpoint and finish.
+        from elephas_tpu.models.callbacks import Callback
+        from elephas_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(os.path.join(outdir, "ckpt"),
+                                max_to_keep=20)
+
+        if mode == "async_crash" and pid == nprocs - 1:
+            import elephas_tpu.worker as worker_mod
+
+            real_train = worker_mod.AsyncWorker.train
+
+            def dying_train(self, xt, yt):
+                orig_emit = self._emit
+
+                def emit(epoch, loss):
+                    orig_emit(epoch, loss)
+                    if epoch >= 1:
+                        os._exit(43)  # hard death: no cleanup, no barriers
+                self._emit = emit
+                return real_train(self, xt, yt)
+
+            worker_mod.AsyncWorker.train = dying_train
+
+        restored_step = -1
+        if mode == "async_resume":
+            latest = mgr.latest_step()
+            if latest is not None:
+                state = mgr.restore()
+                model.set_weights(
+                    [state["weights"][str(i)]
+                     for i in range(len(state["weights"]))])
+                restored_step = latest
+
+        callbacks = []
+        if pid == 0:
+            class CkptEveryEpoch(Callback):
+                def on_epoch_end(cb_self, epoch, logs=None):
+                    mgr.save(epoch, {"weights": {
+                        str(i): w for i, w in
+                        enumerate(cb_self.model.get_weights())}})
+
+            callbacks = [CkptEveryEpoch()]
+
+        tpu_model = TPUModel(model, mode="asynchronous", frequency="epoch",
+                             num_workers=2, batch_size=32, port=ps_port,
+                             parameter_server_mode="http")
+        try:
+            tpu_model.fit((x, y), epochs=4, batch_size=32,
+                          validation_split=0.0, verbose=0,
+                          callbacks=callbacks)
+        except Exception as err:  # noqa: BLE001 — the test asserts on this
+            print(f"SURVIVOR_ERROR: {type(err).__name__}: {err}",
+                  flush=True)
+            sys.exit(3)
+        weights = tpu_model.master_network.get_weights()
+        np.savez(os.path.join(outdir, f"weights_{pid}.npz"),
+                 *[np.asarray(w) for w in weights])
+        print(f"proc {pid}: OK restored_step={restored_step}", flush=True)
+        return
+
     kwargs = {"sync_mode": sync_mode} if mode == "synchronous" else {}
     tpu_model = TPUModel(model, mode=mode, num_workers=4, batch_size=32,
                          port=ps_port, parameter_server_mode="http", **kwargs)

@@ -17,6 +17,8 @@ import numpy as np
 import pytest
 
 _DRIVER = os.path.join(os.path.dirname(__file__), "mh_driver.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 _PORT = [29810]
 
 
@@ -28,7 +30,7 @@ def _ports():
 def _run_procs(mode, sync_mode, nprocs, outdir, jax_port, ps_port,
                timeout=420):
     env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
         [sys.executable, _DRIVER, mode, sync_mode, str(i), str(nprocs),
          str(jax_port), str(ps_port), str(outdir)],
