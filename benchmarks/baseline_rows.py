@@ -430,6 +430,155 @@ def measure_ssm(seqs=(1024, 4096, 8192), batch_tokens=8192,
                       "decode = batch 8 x 128 new tokens, O(1) state"}
 
 
+def measure_mfu(steps: int = 10, batch: int = 8, seq: int = 1024,
+                base_overrides=None):
+    """MFU ceiling decomposition for the headline LM config (L8 d1024
+    ff4096 h16 seq1024 batch8 bf16): where do the non-MXU cycles go, and
+    what would close the 0.43 -> 0.48 gap?
+
+    Components:
+    - ``matmul_roofline``: the model's exact matmul chain (qkv/o, mlp,
+      head) in bf16, nothing else — the achievable ceiling for THIS
+      shape mix on THIS chip. If the end-to-end MFU is close to this,
+      ~0.43 is the config ceiling, not framework overhead.
+    - block-size sweep for the flash kernel at seq 1024
+    - rmsnorm vs layernorm (the norm cost share)
+    - sgd vs adamw (the optimizer update's HBM share)
+    - forward-only vs train step (the backward share)
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params,
+                                                make_train_step)
+
+    base = dict(vocab_size=32000, num_layers=8, num_heads=16,
+                d_model=1024, d_ff=4096, max_seq_len=seq,
+                attention_impl="flash")
+    base.update(base_overrides or {})  # tiny dims for the CPU smoke test
+    peak = _peak_tflops()
+
+    def flops_per_token(c):
+        p_matmul = (c.num_layers * (4 * c.d_model * c.d_model
+                                    + 2 * c.d_model * c.d_ff)
+                    + c.d_model * c.vocab_size)
+        attn = 2 * 2 * (seq / 2) * c.d_model
+        return 3 * (2 * p_matmul + c.num_layers * attn)
+
+    def time_train(c, tx):
+        params = init_params(c, jax.random.PRNGKey(0))
+        opt_state = tx.init(params)
+        step = make_train_step(c, tx)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq),
+                                    0, c.vocab_size)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        start = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        return batch * seq * steps / (time.perf_counter() - start)
+
+    # 1) matmul roofline: the model's own shape mix, pure chained matmuls
+    c0 = TransformerConfig(**base)
+    tok = batch * seq
+    key = jax.random.PRNGKey(2)
+    shapes = []
+    for _ in range(c0.num_layers):
+        shapes += [(c0.d_model, c0.d_model)] * 4
+        shapes += [(c0.d_model, c0.d_ff), (c0.d_ff, c0.d_model)]
+    shapes.append((c0.d_model, c0.vocab_size))
+    ws = [jax.random.normal(jax.random.fold_in(key, i), s, jnp.bfloat16)
+          * 0.01 for i, s in enumerate(shapes)]
+    a0 = jax.random.normal(key, (tok, c0.d_model), jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, ws):
+        acc = jnp.zeros((), jnp.float32)
+        h = a
+        for i, w in enumerate(ws):
+            y = h @ w
+            if i == len(ws) - 1:
+                # the head has no successor: a sliced read would let XLA
+                # sink the slice into the dot and skip ~25% of the
+                # counted FLOPs — sum the WHOLE product to keep it live
+                acc = acc + jnp.sum(y.astype(jnp.float32))
+            else:
+                # successors consume y in full; a tiny read suffices
+                acc = acc + jnp.sum(y[0, :8].astype(jnp.float32))
+                h = y
+        return acc
+
+    float(chain(a0, ws))
+    start = time.perf_counter()
+    reps = 3 * steps
+    for _ in range(reps):
+        float_val = chain(a0, ws)
+    jax.block_until_ready(float_val)
+    elapsed = time.perf_counter() - start
+    matmul_flops = 2 * tok * sum(m * n for m, n in shapes)
+    roofline_tflops = matmul_flops * reps / elapsed / 1e12
+    roofline_util = roofline_tflops / peak
+
+    # 2) the headline step + levers
+    adamw = optax.adamw(3e-4)
+    tps_base = time_train(c0, adamw)
+    mfu_base = flops_per_token(c0) * tps_base / (peak * 1e12)
+    sweep = {}
+    for bq, bk in ((512, 512), (512, 1024)):
+        c = TransformerConfig(**base, flash_block_q=bq, flash_block_k=bk)
+        sweep[f"{bq}x{bk}"] = round(time_train(c, adamw), 1)
+    tps_rms = time_train(TransformerConfig(**base, norm="rmsnorm"), adamw)
+    tps_sgd = time_train(c0, optax.sgd(3e-4))
+
+    # 3) forward-only share
+    from elephas_tpu.models.transformer import forward, next_token_loss
+
+    params = init_params(c0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                c0.vocab_size)
+
+    @jax.jit
+    def fwd_loss(p, t):
+        return next_token_loss(forward(p, t, c0), t)
+
+    float(fwd_loss(params, tokens))
+    start = time.perf_counter()
+    for _ in range(steps):
+        loss = fwd_loss(params, tokens)
+    float(loss)
+    tps_fwd = batch * seq * steps / (time.perf_counter() - start)
+
+    best_tps = max([tps_base, tps_rms] + list(sweep.values()))
+    return {"metric": "transformer_mfu_ablation",
+            "value": round(mfu_base, 4), "unit": "MFU (headline step)",
+            "tokens_per_sec": round(tps_base, 1),
+            "matmul_roofline_tflops": round(roofline_tflops, 1),
+            "matmul_roofline_util": round(roofline_util, 4),
+            "mfu_vs_roofline": round(mfu_base / max(roofline_util, 1e-9),
+                                     4),
+            "block_sweep_tokens_per_sec": sweep,
+            "rmsnorm_tokens_per_sec": round(tps_rms, 1),
+            "sgd_tokens_per_sec": round(tps_sgd, 1),
+            "optimizer_share": round(max(0.0, 1.0 - tps_base / tps_sgd), 4),
+            "fwd_only_tokens_per_sec": round(tps_fwd, 1),
+            "best_tokens_per_sec": round(best_tps, 1),
+            "best_mfu": round(flops_per_token(c0) * best_tps
+                              / (peak * 1e12), 4),
+            "config": (f"L{c0.num_layers} d{c0.d_model} ff{c0.d_ff} "
+                       f"h{c0.num_heads} seq{seq} batch{batch} bf16")}
+
+
+def _peak_tflops():
+    import jax
+
+    from bench import _chip_peak_tflops  # repo root is on sys.path (top)
+
+    return _chip_peak_tflops(jax.devices()[0])
+
+
 def _emit(row):
     """Stamp measurement provenance (backend/device/time) onto a row so a
     CPU-fallback run can never be mistaken for a chip number downstream."""
@@ -458,3 +607,5 @@ if __name__ == "__main__":
         _emit(measure_engine())
     if which in ("ssm", "all"):
         _emit(measure_ssm())
+    if which in ("mfu", "all"):
+        _emit(measure_mfu())

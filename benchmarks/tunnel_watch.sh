@@ -13,8 +13,8 @@ cd "$(dirname "$0")/.."
 OUT=benchmarks/chip_results.jsonl
 ERRDIR=benchmarks/row_errs
 mkdir -p "$ERRDIR"
-ROWS=(otto resnet50 async decode flash engine ssm)
-NAMES=(otto resnet50 async decode flash_scaling engine ssm)
+ROWS=(otto resnet50 async decode flash engine ssm mfu)
+NAMES=(otto resnet50 async decode flash_scaling engine ssm mfu)
 DEADLINE=$(( $(date +%s) + 36000 ))   # give up after 10h
 
 probe () {  # healthy = backend comes up AND it is a real TPU, not CPU

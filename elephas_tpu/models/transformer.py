@@ -118,6 +118,12 @@ class TransformerConfig:
     #: ``rmsnorm`` (scale-only, no centering — cheaper and the modern
     #: default, Zhang & Sennrich 2019)
     norm: str = "layernorm"
+    #: flash-attention tile sizes (None = the kernel defaults, 256/512).
+    #: The best tiles move with sequence length — the seq-scaling bench
+    #: measured block_q=512, block_k=1024 fastest for seq >= 2k — so the
+    #: MFU ablation row sweeps these on-chip
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
     #: tie the LM head to the token embedding (GPT-2 style, the
     #: default); False gives the head its own (d_model, vocab) matrix —
     #: common at larger scales where input/output roles diverge
@@ -453,6 +459,16 @@ def _norm(x, sub: Dict, c) -> jnp.ndarray:
     if getattr(c, "norm", "layernorm") == "rmsnorm":
         return _rms_norm(x, sub["gamma"])
     return _layer_norm(x, sub["gamma"], sub["beta"])
+
+
+def _flash_blocks(c: TransformerConfig) -> Dict[str, int]:
+    """Configured flash tile overrides as kwargs (empty = kernel defaults)."""
+    blocks = {}
+    if getattr(c, "flash_block_q", None):
+        blocks["block_q"] = int(c.flash_block_q)
+    if getattr(c, "flash_block_k", None):
+        blocks["block_k"] = int(c.flash_block_k)
+    return blocks
 
 
 def _attn_apply(layer: Dict, x: jnp.ndarray, c: TransformerConfig,
@@ -941,14 +957,14 @@ def _hidden_with_aux(params: Dict, tokens: jnp.ndarray,
         # attention needs no cross-device communication)
         attn_fn = partial(flash_attention_sharded, mesh=mesh, causal=True,
                           batch_axis=batch_axis, head_axis=model_axis,
-                          window=c.attention_window)
+                          window=c.attention_window, **_flash_blocks(c))
         # the kernel resolves GQA via its kv-row index maps — narrow k/v
         # all the way into VMEM, no head-broadcast materialization; a
         # sliding window skips out-of-band blocks in-kernel
         attn_fn.handles_gqa = True
     elif attn_impl == "flash":
         attn_fn = partial(flash_attention, causal=True,
-                          window=c.attention_window)
+                          window=c.attention_window, **_flash_blocks(c))
         attn_fn.handles_gqa = True
     elif (segment_ids is not None or c.attention_window is not None
           or c.positional == "alibi"):
