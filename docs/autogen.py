@@ -49,6 +49,9 @@ PAGES = [
     ("DataFrame adapters", "elephas_tpu.ml.adapter",
      ["to_data_frame", "from_data_frame", "df_to_dataset"]),
     ("Datasets", "elephas_tpu.data.dataset", ["Dataset"]),
+    ("Out-of-core sources", "elephas_tpu.data.sources",
+     ["ColumnSource", "ConcatSource", "NpySource", "ParquetSource",
+      "SourceView"]),
     ("Dataset utilities", "elephas_tpu.utils.dataset_utils",
      ["to_dataset", "to_labeled_points", "from_labeled_points",
       "lp_to_dataset", "encode_label"]),
