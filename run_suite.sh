@@ -20,7 +20,7 @@ declare -a PATHS=(
   "tests/models/test_transformer.py"
   "tests/models/test_speculative.py tests/models/test_distill.py tests/test_serving.py tests/test_serving_http.py"
   "tests/test_serving_engine.py tests/test_paged_engine.py tests/test_ssm_engine.py"
-  "tests/integration tests/parallel"
+  "tests/integration tests/parallel tests/data"
 )
 fail=0
 for i in "${!NAMES[@]}"; do
