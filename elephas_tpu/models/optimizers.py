@@ -107,20 +107,35 @@ class SGD(Optimizer):
 
 
 class Adam(Optimizer):
+    """``mu_dtype='bfloat16'`` stores the FIRST moment in bf16 — the
+    optimizer update re-reads every moment from HBM each step, so
+    halving the mu stream trims optimizer HBM traffic on
+    bandwidth-bound steps at negligible quality cost (the second
+    moment stays f32: its magnitudes span too many decades for bf16).
+    None (default) keeps both moments at parameter dtype."""
+
     def __init__(self, learning_rate: float = 0.001, beta_1: float = 0.9,
-                 beta_2: float = 0.999, epsilon: float = 1e-7, **kwargs):
+                 beta_2: float = 0.999, epsilon: float = 1e-7,
+                 mu_dtype=None, **kwargs):
         if "lr" in kwargs:
             learning_rate = kwargs.pop("lr")
         super().__init__(learning_rate, **kwargs)
         self.beta_1, self.beta_2, self.epsilon = float(beta_1), float(beta_2), float(epsilon)
+        # normalized to a dtype NAME so optimizer configs stay
+        # JSON-serializable (save/load, PS wire)
+        import numpy as _np
+
+        self.mu_dtype = (None if mu_dtype is None
+                         else str(_np.dtype(mu_dtype)))
 
     def to_optax(self):
         return self._clipped(optax.adam(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                          eps=self.epsilon))
+                          eps=self.epsilon, mu_dtype=self.mu_dtype))
 
     def get_config(self):
         return {"learning_rate": self._lr_config(), "beta_1": self.beta_1,
                 "beta_2": self.beta_2, "epsilon": self.epsilon,
+                "mu_dtype": self.mu_dtype,
                 **self._clip_config()}
 
 
@@ -149,6 +164,7 @@ class AdamW(Adam):
         return self._clipped(optax.adamw(
             self._lr(), b1=self.beta_1, b2=self.beta_2,
             eps=self.epsilon, weight_decay=self.weight_decay,
+            mu_dtype=self.mu_dtype,
             mask=None if self.decay_1d else _decay_mask_fn))
 
     def get_config(self):
@@ -210,7 +226,7 @@ class Adadelta(Optimizer):
 class Nadam(Adam):
     def to_optax(self):
         return self._clipped(optax.nadam(self._lr(), b1=self.beta_1, b2=self.beta_2,
-                           eps=self.epsilon))
+                           eps=self.epsilon, mu_dtype=self.mu_dtype))
 
 
 class Adafactor(Optimizer):

@@ -303,6 +303,45 @@ def test_gradient_clipping_semantics_and_training():
     assert history.history["loss"][-1] < history.history["loss"][0]
 
 
+def test_adam_mu_dtype_bf16_moments_and_convergence():
+    """mu_dtype='bfloat16' halves the first-moment HBM stream: the
+    stored mu really is bf16, the config round-trips as a JSON-safe
+    name, and training converges like the f32-moment run."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models import AdamW, Nadam
+    import elephas_tpu.models.optimizers as om
+
+    opt = AdamW(learning_rate=1e-2, mu_dtype=jnp.bfloat16)
+    assert opt.mu_dtype == "bfloat16"
+    clone = om.deserialize(om.serialize(opt))
+    assert clone.mu_dtype == "bfloat16"
+
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    for built in (clone, Nadam(learning_rate=1e-2, mu_dtype="bfloat16")):
+        tx = built.to_optax()
+        state = tx.init(params)
+        mu_leaf = [s for s in jax.tree_util.tree_leaves(state)
+                   if getattr(s, "dtype", None) == jnp.bfloat16]
+        assert mu_leaf, \
+            f"{type(built).__name__} first moment should be stored bf16"
+
+    def losses(mu_dtype):
+        m = M.Sequential([M.Dense(32, activation="relu", input_dim=20),
+                          M.Dense(4, activation="softmax")])
+        m.compile(AdamW(learning_rate=5e-3, mu_dtype=mu_dtype),
+                  "categorical_crossentropy", seed=0)
+        x, y = _toy_classification()
+        h = m.fit(x, y, epochs=5, batch_size=32, verbose=0)
+        return h.history["loss"]
+
+    l32, l16 = losses(None), losses("bfloat16")
+    assert l16[-1] < l16[0], "bf16-moment run must converge"
+    assert abs(l16[-1] - l32[-1]) < 0.1 * max(l32[0] - l32[-1], 1e-3), \
+        (l32, l16)
+
+
 def test_adamw_decay_mask_excludes_1d_params():
     """Default AdamW decays matrices but not biases/LN vectors; the
     legacy unmasked behavior stays available via decay_1d=True."""
