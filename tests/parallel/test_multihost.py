@@ -28,3 +28,55 @@ def test_global_batch_from_host_data():
     local = np.arange(16, dtype=np.float32).reshape(16, 1)
     arr = global_batch_from_host_data(mesh, local)
     np.testing.assert_array_equal(np.asarray(arr), local)
+
+
+def test_barrier_watchdog_timeout_and_poison(monkeypatch):
+    """A barrier whose peers never arrive times out with a clear error,
+    and every later barrier in the process refuses to run (the
+    abandoned rendezvous could pair with it and corrupt the protocol)."""
+    import threading
+
+    import pytest
+    from jax.experimental import multihost_utils
+
+    import elephas_tpu.parallel.multihost as mh
+
+    release = threading.Event()  # lets the parked watchdog thread exit
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: release.wait())
+    monkeypatch.setattr(mh, "_POISONED_BARRIER", None)
+    try:
+        with pytest.raises(RuntimeError, match="timed out"):
+            mh.barrier("test_rendezvous", timeout_s=0.2)
+        # poisoned: even a barrier that WOULD succeed now refuses
+        monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                            lambda name: None)
+        with pytest.raises(RuntimeError, match="undefined"):
+            mh.barrier("next_barrier", timeout_s=5.0)
+    finally:
+        release.set()  # don't leak a blocked thread into the suite
+        mh._POISONED_BARRIER = None  # never leak poison into other tests
+
+
+def test_barrier_propagates_sync_errors(monkeypatch):
+    """An error raised inside the rendezvous (peer died, Gloo reset)
+    surfaces to the caller — and does NOT poison later barriers (the
+    sync itself completed; no thread was abandoned)."""
+    import pytest
+    from jax.experimental import multihost_utils
+
+    import elephas_tpu.parallel.multihost as mh
+
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(mh, "_POISONED_BARRIER", None)
+
+    def boom(name):
+        raise ConnectionError("peer closed")
+
+    monkeypatch.setattr(multihost_utils, "sync_global_devices", boom)
+    with pytest.raises(ConnectionError, match="peer closed"):
+        mh.barrier("erroring", timeout_s=5.0)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: None)
+    mh.barrier("after_error", timeout_s=5.0)  # not poisoned
