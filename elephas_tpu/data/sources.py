@@ -276,6 +276,36 @@ class NpySource(ColumnSource):
         return np.asarray(self._mmap()[idx])
 
 
+def _route_read(bounds: np.ndarray, lo: int, hi: int, fetch) -> np.ndarray:
+    """Assemble rows ``[lo, hi)`` from bounded chunks:
+    ``fetch(chunk, local_lo, local_hi) -> ndarray``. Shared by the
+    row-group router (ParquetSource) and the part router (ConcatSource)
+    so the boundary arithmetic lives once."""
+    if hi <= lo:  # empty range: an empty fetch carries the row shape
+        return fetch(0, 0, 0)
+    out = []
+    c0 = int(np.searchsorted(bounds, lo, side="right") - 1)
+    for c in range(max(0, c0), len(bounds) - 1):
+        base = int(bounds[c])
+        if base >= hi:
+            break
+        out.append(fetch(c, max(0, lo - base),
+                         int(min(bounds[c + 1], hi)) - base))
+    return out[0] if len(out) == 1 else np.concatenate(out)
+
+
+def _route_take(bounds: np.ndarray, idx: np.ndarray, fetch,
+                row_shape: Tuple[int, ...], dtype) -> np.ndarray:
+    """Gather fancy-indexed rows from bounded chunks:
+    ``fetch(chunk, local_idx) -> rows``."""
+    out = np.empty((idx.size,) + tuple(row_shape), dtype=dtype)
+    owner = np.searchsorted(bounds, idx, side="right") - 1
+    for c in np.unique(owner):
+        mask = owner == c
+        out[mask] = fetch(int(c), idx[mask] - int(bounds[c]))
+    return out
+
+
 def _arrow_to_numpy(column) -> np.ndarray:
     """An arrow ChunkedArray/Array -> ndarray; list-typed columns become
     2-D (fixed row width enforced)."""
@@ -457,28 +487,14 @@ class ParquetSource(ColumnSource):
             del self._lru[self._LRU_SIZE:]
             return arr
 
-    def _groups_for(self, lo: int, hi: int) -> range:
-        g0 = int(np.searchsorted(self._bounds, lo, side="right") - 1)
-        g1 = int(np.searchsorted(self._bounds, hi, side="left"))
-        return range(max(0, g0), max(g0 + 1, g1))
-
     def _read(self, lo: int, hi: int) -> np.ndarray:
-        parts = []
-        for g in self._groups_for(lo, hi):
-            base = int(self._bounds[g])
-            arr = self._group(g)
-            parts.append(arr[max(0, lo - base):hi - base])
-        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return _route_read(self._bounds, lo, hi,
+                           lambda g, l, h: self._group(g)[l:h])
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
-        out = np.empty((idx.size,) + tuple(self.shape[1:]),
-                       dtype=self._dtype)
-        groups = np.searchsorted(self._bounds, idx, side="right") - 1
-        for g in np.unique(groups):
-            mask = groups == g
-            arr = self._group(int(g))
-            out[mask] = arr[idx[mask] - int(self._bounds[g])]
-        return out
+        return _route_take(self._bounds, idx,
+                           lambda g, li: self._group(g)[li],
+                           self.shape[1:], self._dtype)
 
     def chunk_bounds(self) -> np.ndarray:
         return self._bounds.copy()
@@ -570,27 +586,15 @@ class ConcatSource(ColumnSource):
         return chunk.astype(self._dtype, copy=False)
 
     def _read(self, lo: int, hi: int) -> np.ndarray:
-        out = []
-        p0 = int(np.searchsorted(self._bounds, lo, side="right") - 1)
-        for p in range(max(0, p0), len(self.parts)):
-            base = int(self._bounds[p])
-            if base >= hi:
-                break
-            part = self.parts[p]
-            chunk = part.read(max(0, lo - base),
-                              min(part.num_rows(), hi - base))
-            out.append(self._check_tail(p, chunk))
-        return out[0] if len(out) == 1 else np.concatenate(out)
+        return _route_read(
+            self._bounds, lo, hi,
+            lambda p, l, h: self._check_tail(p, self.parts[p].read(l, h)))
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
-        out = np.empty((idx.size,) + tuple(self.shape[1:]),
-                       dtype=self._dtype)
-        owner = np.searchsorted(self._bounds, idx, side="right") - 1
-        for p in np.unique(owner):
-            mask = owner == p
-            rows = self.parts[int(p)].take(idx[mask] - int(self._bounds[p]))
-            out[mask] = self._check_tail(int(p), rows)
-        return out
+        return _route_take(
+            self._bounds, idx,
+            lambda p, li: self._check_tail(p, self.parts[p].take(li)),
+            self.shape[1:], self._dtype)
 
     def chunk_bounds(self) -> Optional[np.ndarray]:
         """Part edges refined by each part's own chunking (row groups
