@@ -21,6 +21,14 @@ def batched_logits_predict(jit_forward, params, tokens, batch_size: int,
 
     if not isinstance(tokens, ColumnSource):
         tokens = np.asarray(tokens)
+    if tokens.shape[0] == 0 and out is None:
+        # zero rows: shape/dtype via abstract evaluation — no compile,
+        # no device call (np.concatenate([]) would raise instead)
+        import jax
+
+        spec = jax.eval_shape(jit_forward, params,
+                              jnp.asarray(np.asarray(tokens[:0])))
+        return np.zeros(spec.shape, spec.dtype)
     outs = []
     for i in range(0, tokens.shape[0], batch_size):
         chunk = np.asarray(jit_forward(

@@ -318,6 +318,15 @@ def _token_tpu_model(family):
 
 
 @pytest.mark.parametrize("family", ["transformer", "ssm"])
+def test_token_predict_zero_rows(family):
+    """Zero-row input returns an empty (0, seq, vocab) array instead of
+    crashing in np.concatenate."""
+    tpu_model = _token_tpu_model(family)
+    out = tpu_model.predict(np.zeros((0, 8), np.int32), batch_size=4)
+    assert out.shape == (0, 8, 64)
+
+
+@pytest.mark.parametrize("family", ["transformer", "ssm"])
 def test_predict_out_streams_token_models(family, tmp_path):
     """Token-model predict streams its (rows, seq, vocab) logits to a
     .npy memmap — parity with the in-memory result, bounded input reads
