@@ -31,6 +31,11 @@ PAGES = [
      ["ExponentialDecay", "CosineDecay", "PiecewiseConstantDecay",
       "WarmupCosine"]),
     ("Workers", "elephas_tpu.worker", ["SyncWorker", "AsyncWorker"]),
+    ("Worker supervision", "elephas_tpu.parallel.supervisor",
+     ["WorkerSupervisor", "SupervisorReport", "QuorumLostError"]),
+    ("Fault injection", "elephas_tpu.utils.faults",
+     ["FaultPlan", "FaultEvent", "fault_site", "install_plan",
+      "clear_plan", "active_plan", "InjectedFault"]),
     ("Parameter servers", "elephas_tpu.parameter.server",
      ["BaseParameterServer", "HttpServer", "SocketServer"]),
     ("Parameter clients", "elephas_tpu.parameter.client",
@@ -179,7 +184,8 @@ def main(out_dir: str = None):
         print(f"wrote {slug}.md")
     mkdocs = ["site_name: elephas_tpu", "nav:", "  - Home: index.md",
               "  - Scaling guide: scaling-guide.md",
-              "  - Serving guide: serving-guide.md"]
+              "  - Serving guide: serving-guide.md",
+              "  - Fault tolerance: fault-tolerance.md"]
     mkdocs += [f"  - {title}: {page}" for title, page in nav]
     (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")
     index = ROOT / "README.md"

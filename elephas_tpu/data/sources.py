@@ -276,13 +276,17 @@ class NpySource(ColumnSource):
         return np.asarray(self._mmap()[idx])
 
 
-def _route_read(bounds: np.ndarray, lo: int, hi: int, fetch) -> np.ndarray:
+def _route_read(bounds: np.ndarray, lo: int, hi: int, fetch,
+                empty) -> np.ndarray:
     """Assemble rows ``[lo, hi)`` from bounded chunks:
     ``fetch(chunk, local_lo, local_hi) -> ndarray``. Shared by the
     row-group router (ParquetSource) and the part router (ConcatSource)
-    so the boundary arithmetic lives once."""
-    if hi <= lo:  # empty range: an empty fetch carries the row shape
-        return fetch(0, 0, 0)
+    so the boundary arithmetic lives once. ``empty`` (required) builds
+    the explicitly shaped zero-row result — fetching chunk 0 for an
+    empty range would raise on a source with no chunks at all (a zero-
+    row-group Parquet part)."""
+    if hi <= lo:
+        return empty()
     out = []
     c0 = int(np.searchsorted(bounds, lo, side="right") - 1)
     for c in range(max(0, c0), len(bounds) - 1):
@@ -436,11 +440,16 @@ class ParquetSource(ColumnSource):
             # report decode int as float64). Dtype settles BEFORE
             # _row_shape: a concurrent _group gates its drift check on
             # _row_shape being set, so the narrow dtype must never be
-            # observable alongside a non-None row shape
-            probe = (self._group(0) if self._n
-                     else np.zeros((0, 0), self._dtype))
-            self._dtype = np.result_type(self._dtype, probe.dtype)
-            self._row_shape = tuple(probe.shape[1:])
+            # observable alongside a non-None row shape. The whole
+            # probe-and-assign runs under the source lock (double-
+            # checked) so two first-shape threads cannot interleave the
+            # decode and the assignments
+            with self._lock:
+                if self._row_shape is None:
+                    probe = (self._group_locked(0) if self._n
+                             else np.zeros((0, 0), self._dtype))
+                    self._dtype = np.result_type(self._dtype, probe.dtype)
+                    self._row_shape = tuple(probe.shape[1:])
         return (self._n,) + tuple(self._row_shape)
 
     @property
@@ -455,41 +464,49 @@ class ParquetSource(ColumnSource):
 
     def _group(self, g: int) -> np.ndarray:
         with self._lock:
-            for key, arr in getattr(self, "_lru", []):
-                if key == g:
-                    return arr
-            if self._pf is None:
-                import pyarrow.parquet as pq
+            return self._group_locked(g)
 
-                self._pf = pq.ParquetFile(self.path)
-            arr = _arrow_to_numpy(
-                self._pf.read_row_group(g, columns=[self.column]).column(0))
-            # while the ragged width is unprobed the dtype is not final
-            # either (the probe may widen it) — skip the drift check for
-            # the probe decode itself
-            declared = self._dtype if self._row_shape is not None else None
-            if declared is not None and arr.dtype != declared:
-                # per-group decode dtype can drift from the declared one
-                # (a nullable int group WITH nulls decodes float64, one
-                # without decodes int64) — safe casts unify; anything
-                # else would corrupt silently, so refuse loudly
-                if np.can_cast(arr.dtype, declared, casting="safe"):
-                    arr = arr.astype(declared)
-                else:
-                    raise ValueError(
-                        f"{self.path}:{self.column}: row group {g} "
-                        f"decoded {arr.dtype} but the declared dtype is "
-                        f"{declared} — the column likely contains "
-                        "nulls the footer statistics didn't report; "
-                        "fill or cast it at write time")
-            self.chunks_decoded += 1
-            self._lru.insert(0, (g, arr))
-            del self._lru[self._LRU_SIZE:]
-            return arr
+    def _group_locked(self, g: int) -> np.ndarray:
+        # caller holds self._lock (the shape probe reuses this body
+        # while already inside the lock — threading.Lock is not
+        # reentrant)
+        for key, arr in getattr(self, "_lru", []):
+            if key == g:
+                return arr
+        if self._pf is None:
+            import pyarrow.parquet as pq
+
+            self._pf = pq.ParquetFile(self.path)
+        arr = _arrow_to_numpy(
+            self._pf.read_row_group(g, columns=[self.column]).column(0))
+        # while the ragged width is unprobed the dtype is not final
+        # either (the probe may widen it) — skip the drift check for
+        # the probe decode itself
+        declared = self._dtype if self._row_shape is not None else None
+        if declared is not None and arr.dtype != declared:
+            # per-group decode dtype can drift from the declared one
+            # (a nullable int group WITH nulls decodes float64, one
+            # without decodes int64) — safe casts unify; anything
+            # else would corrupt silently, so refuse loudly
+            if np.can_cast(arr.dtype, declared, casting="safe"):
+                arr = arr.astype(declared)
+            else:
+                raise ValueError(
+                    f"{self.path}:{self.column}: row group {g} "
+                    f"decoded {arr.dtype} but the declared dtype is "
+                    f"{declared} — the column likely contains "
+                    "nulls the footer statistics didn't report; "
+                    "fill or cast it at write time")
+        self.chunks_decoded += 1
+        self._lru.insert(0, (g, arr))
+        del self._lru[self._LRU_SIZE:]
+        return arr
 
     def _read(self, lo: int, hi: int) -> np.ndarray:
-        return _route_read(self._bounds, lo, hi,
-                           lambda g, l, h: self._group(g)[l:h])
+        return _route_read(
+            self._bounds, lo, hi,
+            lambda g, l, h: self._group(g)[l:h],
+            empty=lambda: np.zeros((0,) + self.shape[1:], self._dtype))
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
         return _route_take(self._bounds, idx,
@@ -588,7 +605,8 @@ class ConcatSource(ColumnSource):
     def _read(self, lo: int, hi: int) -> np.ndarray:
         return _route_read(
             self._bounds, lo, hi,
-            lambda p, l, h: self._check_tail(p, self.parts[p].read(l, h)))
+            lambda p, l, h: self._check_tail(p, self.parts[p].read(l, h)),
+            empty=lambda: np.zeros((0,) + self.shape[1:], self._dtype))
 
     def _take(self, idx: np.ndarray) -> np.ndarray:
         return _route_take(

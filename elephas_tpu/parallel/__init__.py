@@ -8,6 +8,7 @@ from .pipeline import (make_pipeline_fn, make_pipelined_lm_loss,
                        make_pipelined_train_step, merge_transformer_stages,
                        shard_pipelined_params, split_transformer_stages,
                        stack_stage_params)
+from .supervisor import QuorumLostError, SupervisorReport, WorkerSupervisor
 from .sync_trainer import (SyncAverageTrainer, SyncStepTrainer,
                            build_sharded_evaluate, build_sharded_predict,
                            stack_shards)

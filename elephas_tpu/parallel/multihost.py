@@ -124,6 +124,13 @@ def barrier(name: str, timeout_s: Optional[float] = None):
     default 900 s) the caller gets a clear RuntimeError naming the
     barrier instead of a silent hang — the failure-detection contract
     (SURVEY §5) at the DCN level.
+
+    Recovery requires a process restart: the watchdog thread stays
+    parked (leaked) in the abandoned rendezvous, and the process's
+    cross-process rendezvous state is undefined from then on — every
+    later :func:`barrier` call in this process refuses to run
+    (poisoned) rather than risk pairing the stale rendezvous with a
+    different barrier on the peers.
     """
     global _POISONED_BARRIER
     if jax.process_count() <= 1:
@@ -162,9 +169,11 @@ def barrier(name: str, timeout_s: Optional[float] = None):
         raise RuntimeError(
             f"barrier {name!r} timed out after {timeout_s:.0f}s — a peer "
             "process likely died mid-run (crash or preemption), or is "
-            "pathologically slow. Restart the job; training resumes "
-            "from the latest checkpoint. ELEPHAS_TPU_BARRIER_TIMEOUT_S "
-            "tunes this deadline.")
+            "pathologically slow. The watchdog thread remains parked in "
+            "the abandoned rendezvous (leaked) and this process's "
+            "rendezvous state is now undefined: restart the process to "
+            "recover; training resumes from the latest checkpoint. "
+            "ELEPHAS_TPU_BARRIER_TIMEOUT_S tunes this deadline.")
     if "err" in outcome:
         raise outcome["err"]
 

@@ -17,6 +17,7 @@ from typing import List
 import numpy as np
 
 from ..utils.delta_compression import quantize_delta
+from ..utils.faults import InjectedFault, fault_site
 from ..utils.sockets import determine_master, receive, send
 from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
                                   encode)
@@ -143,6 +144,8 @@ class HttpClient(BaseParameterClient):
 
     def get_parameters(self) -> List[np.ndarray]:
         def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
             request = urllib.request.Request(
                 f"http://{self.master_url}/parameters", headers=self.headers)
             with urllib.request.urlopen(request,
@@ -157,11 +160,19 @@ class HttpClient(BaseParameterClient):
         headers = dict(self.headers, **{"X-Update-Id": uuid.uuid4().hex})
 
         def op():
+            if fault_site("client.update_parameters"):
+                raise InjectedFault("push request dropped")
             request = urllib.request.Request(
                 f"http://{self.master_url}/update", payload, headers=headers)
             with urllib.request.urlopen(request,
                                         timeout=self.timeout) as response:
-                return response.read()
+                body = response.read()
+            if fault_site("client.push_ack"):
+                # the server already applied the delta; losing the ack
+                # forces a resend of the SAME update id — the
+                # idempotency-window scenario
+                raise InjectedFault("push ack dropped")
+            return body
         return self._with_retry(op, "update_parameters")
 
     def health_check(self) -> bool:
@@ -248,6 +259,9 @@ class SocketClient(BaseParameterClient):
 
     def get_parameters(self) -> List[np.ndarray]:
         def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
+
             def rpc(sock):
                 sock.sendall(b"g")
                 return receive(sock)
@@ -258,10 +272,17 @@ class SocketClient(BaseParameterClient):
         update_id = uuid.uuid4().hex.encode("ascii")  # stable across retries
 
         def op():
+            if fault_site("client.update_parameters"):
+                raise InjectedFault("push request dropped")
+
             def rpc(sock):
                 sock.sendall(b"U" + update_id)
                 send(sock, arrays, kind=kind)
                 ack = sock.recv(1)  # block until the delta is applied
+                if ack == b"k" and fault_site("client.push_ack"):
+                    # the server applied and acked; eat the ack so the
+                    # retry resends the SAME id (idempotency scenario)
+                    raise InjectedFault("push ack dropped")
                 if ack == b"e":
                     # permanent rejection (wrong arity/shapes): fail
                     # fast — retrying would resend the same bad frame

@@ -31,6 +31,7 @@ import numpy as np
 
 _LOG = logging.getLogger(__name__)
 
+from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
 from ..utils.sockets import determine_master, receive_frame, send
@@ -81,6 +82,7 @@ class BaseParameterServer(abc.ABC):
         self._in_flight: Dict[str, threading.Event] = {}
 
     def get_weights(self) -> List[np.ndarray]:
+        fault_site("ps.get_weights")
         if self.mode == "asynchronous":
             self.lock.acquire_read()
         try:
@@ -89,8 +91,48 @@ class BaseParameterServer(abc.ABC):
             if self.mode == "asynchronous":
                 self.lock.release()
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Restartable server state: weights, the applied-update counter,
+        and the idempotency window. A supervisor snapshots on every
+        healthy probe so a crashed server can be rebuilt on the same
+        port via :meth:`restore` — client retries after a lost ack stay
+        deduplicated across the restart.
+
+        The idempotency window is read BEFORE the weights: a delta that
+        lands between the two reads is then present in the weights but
+        absent from ``seen_ids``, so a post-restore resend re-applies it
+        (at-least-once, a benign duplicate gradient). The reverse order
+        would record the id without its weights — a resend after the
+        restore would be deduplicated and the acked update silently
+        lost."""
+        with self._seen_lock:
+            seen = list(self._seen_ids.items())
+        with self._counter_lock:
+            num_updates = self.num_updates
+        weights = self.get_weights()  # honors the mode's locking policy
+        return {"weights": weights, "num_updates": num_updates,
+                "seen_ids": seen}
+
+    def restore(self, snapshot: Dict[str, Any]):
+        """Adopt a :meth:`snapshot` (typically on a fresh server before
+        :meth:`start`, the kill→restart→reconnect recovery path)."""
+        if self.mode == "asynchronous":
+            self.lock.acquire_write()
+        try:
+            self.weights = [np.asarray(w, dtype=np.float32).copy()
+                            for w in snapshot["weights"]]
+        finally:
+            if self.mode == "asynchronous":
+                self.lock.release()
+        with self._counter_lock:
+            self.num_updates = int(snapshot.get("num_updates", 0))
+        with self._seen_lock:
+            self._seen_ids = OrderedDict(snapshot.get("seen_ids", ()))
+
     def apply_delta(self, delta: List[np.ndarray],
                     update_id: Optional[str] = None):
+        if fault_site("ps.apply_delta"):
+            return  # drop: the delta is silently lost (still acked)
         # validate BEFORE applying: subtract_params zips the lists, so a
         # short or mis-shaped delta would silently truncate/corrupt the
         # served weights for every client until restart

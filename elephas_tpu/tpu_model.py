@@ -74,6 +74,11 @@ class _EpochAggregator:
     returning True latches the stop flag every worker polls at its epoch
     boundaries — EarlyStopping that actually stops asynchronous training
     mid-run.
+
+    A dead worker must not park every callback forever: the supervisor
+    calls :meth:`remove_participant` when it declares a worker failed,
+    which shrinks the quorum and immediately fires any epoch the
+    survivors have already completed.
     """
 
     def __init__(self, participants: int, on_epoch):
@@ -84,22 +89,73 @@ class _EpochAggregator:
         self._lock = threading.Lock()
         self._counts: Dict[int, int] = {}
         self._losses: Dict[int, List[float]] = {}
+        self._fired: set = set()
+        self._member_epochs: Dict[Any, set] = {}
         self._stop = threading.Event()
 
-    def report(self, epoch: int, loss: Optional[float]):
+    def _fire_locked(self, epoch: int):
+        # fire under the lock: callbacks mutate the master network,
+        # and serializing here keeps reports cheap (callbacks are
+        # epoch-granular)
+        self._fired.add(epoch)
+        losses = self._losses.pop(epoch, [])
+        logs = {"loss": float(np.mean(losses))} if losses else {}
+        if self.on_epoch(epoch, logs):
+            self._stop.set()
+
+    def report(self, epoch: int, loss: Optional[float], member=None):
         with self._lock:
+            if epoch in self._fired:
+                return  # late report for an epoch fired after a removal
+            if member is not None:
+                seen = self._member_epochs.setdefault(member, set())
+                if epoch in seen:
+                    # idempotent per member: a re-run of the same shard
+                    # (after a PS restart) re-reports epochs it already
+                    # counted — they must not stand in for other members
+                    return
+                seen.add(epoch)
             self._counts[epoch] = self._counts.get(epoch, 0) + 1
             if loss is not None:
                 self._losses.setdefault(epoch, []).append(float(loss))
-            if self._counts[epoch] != self.participants:
+            if (self.participants <= 0
+                    or self._counts[epoch] < self.participants):
                 return
-            losses = self._losses.pop(epoch, [])
-            # fire under the lock: callbacks mutate the master network,
-            # and serializing here keeps reports cheap (callbacks are
-            # epoch-granular)
-            logs = {"loss": float(np.mean(losses))} if losses else {}
-            if self.on_epoch(epoch, logs):
-                self._stop.set()
+            self._fire_locked(epoch)
+
+    def remove_participant(self, member=None):
+        """A participant died: shrink the quorum and fire every pending
+        epoch the survivors have already fully reported — the stall fix
+        for EarlyStopping/ModelCheckpoint waiting on a dead worker.
+
+        The dead member's own reports are retracted from unfired epochs
+        first (its ``member`` key as passed to :meth:`report`): a count
+        it contributed must not stand in for a live survivor still
+        mid-epoch, or the epoch would fire early."""
+        with self._lock:
+            self.participants -= 1
+            for epoch in self._member_epochs.pop(member, ()):
+                if epoch not in self._fired and self._counts.get(epoch, 0):
+                    self._counts[epoch] -= 1
+            if self.participants <= 0:
+                return  # nobody left; the supervisor policy decides
+            for epoch in sorted(self._counts):
+                if (epoch not in self._fired
+                        and self._counts[epoch] >= self.participants):
+                    self._fire_locked(epoch)
+
+    def rejoin_if_empty(self) -> bool:
+        """Re-register one participant iff every participant has been
+        removed. A re-run normally reports no epoch events (its dead
+        predecessor's role is gone), but when NOBODY is left reporting
+        — single-worker fit, or a blip that felled every worker — the
+        retry must take the role back or callbacks go silently dead for
+        the rest of the fit."""
+        with self._lock:
+            if self.participants > 0:
+                return False
+            self.participants = 1
+            return True
 
     def should_stop(self) -> bool:
         return self._stop.is_set()
@@ -120,6 +176,15 @@ class TPUModel:
     :param port: parameter-server port
     :param sync_mode: ``average`` (reference model-averaging semantics) or
         ``step`` (per-step sync SGD; throughput configuration)
+    :param on_worker_failure: async/hogwild failure policy —
+        ``reassign`` (default: a failed worker's shard is re-run on a
+        surviving slot, bounded by ``max_worker_restarts`` per shard),
+        ``fail`` (fail-fast) or ``continue`` (drop the shard while at
+        least a ``min_workers`` fraction of shards completes)
+    :param ps_auto_restart: supervise the parameter server too: snapshot
+        it while healthy and restart it from the latest snapshot on the
+        same port if it dies mid-fit (probed every
+        ``ps_probe_interval`` seconds); workers reconnect via retry
     """
 
     def __init__(self, model: BaseModel, mode: str = "asynchronous",
@@ -163,6 +228,36 @@ class TPUModel:
         if self.delta_compression not in (None, "int8"):
             raise ValueError("delta_compression must be None or 'int8', "
                              f"got {self.delta_compression!r}")
+        # elastic supervision (async/hogwild): what to do when a worker
+        # thread dies mid-fit — 'reassign' re-runs its shard (bounded by
+        # max_worker_restarts per shard), 'fail' is fail-fast, 'continue'
+        # drops the shard while at least a min_workers fraction succeeds
+        from .parallel.supervisor import POLICIES
+
+        self.on_worker_failure = kwargs.pop("on_worker_failure", "reassign")
+        if self.on_worker_failure not in POLICIES:
+            raise ValueError(
+                f"on_worker_failure must be one of {POLICIES}, "
+                f"got {self.on_worker_failure!r}")
+        self.max_worker_restarts = max(
+            0, int(kwargs.pop("max_worker_restarts", 2)))
+        self.min_workers = float(kwargs.pop("min_workers", 0.5))
+        if not (0.0 < self.min_workers <= 1.0):
+            # fail at construction, not mid-fit after the PS is up
+            raise ValueError(
+                f"min_workers must be in (0, 1], got {self.min_workers}")
+        # PS crash survivability: when True, the supervisor health-probes
+        # the parameter server, snapshots it while healthy, and restarts
+        # it from the latest snapshot on the same port if it dies —
+        # workers reconnect through the client retry path
+        self.ps_auto_restart = bool(kwargs.pop("ps_auto_restart", False))
+        self.ps_probe_interval = float(kwargs.pop("ps_probe_interval", 2.0))
+        if self.ps_probe_interval <= 0:
+            # fail at construction: 0 would busy-spin the PS monitor
+            raise ValueError(
+                f"ps_probe_interval must be > 0, got "
+                f"{self.ps_probe_interval}")
+        self.max_ps_restarts = max(0, int(kwargs.pop("max_ps_restarts", 5)))
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)
@@ -200,6 +295,18 @@ class TPUModel:
             config["async_overlap"] = True
         if self.async_accum != 1:
             config["async_accum"] = self.async_accum
+        if self.on_worker_failure != "reassign":
+            config["on_worker_failure"] = self.on_worker_failure
+        if self.max_worker_restarts != 2:
+            config["max_worker_restarts"] = self.max_worker_restarts
+        if self.min_workers != 0.5:
+            config["min_workers"] = self.min_workers
+        if self.ps_auto_restart:
+            config["ps_auto_restart"] = True
+        if self.ps_probe_interval != 2.0:
+            config["ps_probe_interval"] = self.ps_probe_interval
+        if self.max_ps_restarts != 5:
+            config["max_ps_restarts"] = self.max_ps_restarts
         config.update(self.kwargs)
         return config
 
@@ -224,6 +331,55 @@ class TPUModel:
 
     def start_server(self):
         self.parameter_server.start()
+
+    def _ps_supervision(self):
+        """(probe, restart) hooks for the worker supervisor's parameter-
+        server watchdog. The probe snapshots the live server while it is
+        healthy; restart rebuilds a server of the same transport on the
+        same port from the latest snapshot and starts it — workers
+        reconnect through the client retry path, with the idempotency
+        window carried over so in-flight resends stay deduplicated."""
+        import time as _time
+
+        state = {"snapshot": self.parameter_server.snapshot(),
+                 "t": _time.monotonic()}
+        state["at"] = state["snapshot"]["num_updates"]
+        # snapshotting copies every weight under the server's read lock;
+        # during active training every probe would otherwise pay it, so
+        # the copy cadence is floored well below the probe cadence (the
+        # price: a restart rolls back at most this much progress)
+        min_spacing = max(5 * self.ps_probe_interval, 2.0)
+
+        def probe() -> bool:
+            if not self.client.health_check():
+                return False
+            try:
+                server = self.parameter_server
+                now = _time.monotonic()
+                if (server.num_updates != state["at"]
+                        and now - state["t"] >= min_spacing):
+                    snap = server.snapshot()
+                    state["snapshot"] = snap
+                    state["at"] = snap["num_updates"]
+                    state["t"] = now
+            except Exception:
+                pass  # keep serving the previous snapshot
+            return True
+
+        def restart():
+            try:
+                self.parameter_server.stop()  # release the port/threads
+            except Exception:
+                pass
+            transport = get_transport(self.parameter_server_mode)
+            server = transport.create_server(
+                self.serialized_model, self.port, self.mode,
+                custom_objects=self.custom_objects)
+            server.restore(state["snapshot"])
+            server.start()
+            self.parameter_server = server
+
+        return probe, restart
 
     def stop_server(self):
         if self.client is not None:
@@ -510,8 +666,6 @@ class TPUModel:
     def _fit_async(self, ds: Dataset, epochs: int = 10, batch_size: int = 32,
                    verbose: int = 0, validation_split: float = 0.1,
                    callbacks=None, **kwargs):
-        import concurrent.futures
-
         import jax
 
         from .parallel.multihost import (barrier, coordinator_bind_env,
@@ -565,22 +719,49 @@ class TPUModel:
                 # can stop async training mid-run. (Multi-host: each
                 # process aggregates its own workers; a stop triggered
                 # here halts this process's workers.)
+                # shape[0] only — np.asarray here would materialize an
+                # out-of-core shard's whole column on the driver
+                nonempty = [bool(shard[0].shape[0]) for shard in shards]
                 aggregator = None
+                cb_failure: Dict[str, BaseException] = {}
                 if callbacks:
-                    participants = sum(
-                        1 for shard in shards if shard[0].shape[0])
+                    participants = sum(nonempty)
 
                     def on_epoch(epoch_idx, logs):
                         import warnings as _warnings
 
                         try:
-                            self._master_network.set_weights(
-                                self.client.get_parameters())
+                            # cheap liveness probes first: on_epoch runs
+                            # under the aggregator lock (including from
+                            # the supervisor's failure path), so a dead
+                            # PS must cost the ~5s probes, not a full
+                            # pull-retry deadline, before degrading to
+                            # the previous weights. Two chances: one
+                            # timed-out probe on a busy-but-live server
+                            # must not skip a checkpoint-relevant pull.
+                            if (self.client.health_check()
+                                    or self.client.health_check()):
+                                self._master_network.set_weights(
+                                    self.client.get_parameters())
+                            else:
+                                _warnings.warn(
+                                    "parameter server unreachable; "
+                                    "callbacks see the previous weights")
                         except Exception as err:
                             _warnings.warn(
                                 f"per-epoch weight pull failed ({err}); "
                                 "callbacks see the previous weights")
-                        callbacks.epoch_end(epoch_idx, logs)
+                        try:
+                            callbacks.epoch_end(epoch_idx, logs)
+                        except BaseException as err:  # noqa: BLE001
+                            # a callback error must FAIL the fit, not
+                            # leak into the reporting worker's thread
+                            # (the supervisor would classify it as a
+                            # worker crash and quietly reassign the
+                            # shard, swallowing the exception)
+                            cb_failure.setdefault("err", err)
+                            return True  # stop every worker at its next
+                            # epoch boundary; re-raised after the drain
                         return bool(getattr(self._master_network,
                                             "stop_training", False))
 
@@ -593,8 +774,28 @@ class TPUModel:
                 # reference worker owning an executor's compute,
                 # elephas/worker.py:52-131)
                 local_devices = jax.local_devices()
+                import threading as _threading
 
-                def run_worker(index, shard):
+                # shards whose aggregator seat was removed after a
+                # policy-level failure; a re-run of one reports no epoch
+                # events (unless it rejoins an emptied aggregator). A
+                # PS-restart free retry never lands here, so it keeps
+                # its seat — per-member idempotent reports make its
+                # re-reported epochs harmless.
+                removed: set = set()
+                removed_lock = _threading.Lock()
+
+                def run_shard(slot, shard_idx, shard, attempt):
+                    with removed_lock:
+                        attach = (aggregator is not None
+                                  and nonempty[shard_idx]
+                                  and (shard_idx not in removed
+                                       or aggregator.rejoin_if_empty()))
+                        if attach:
+                            # rejoining re-runs take the seat back (the
+                            # sole-worker-crash case: without this,
+                            # callbacks go silently dead for the fit)
+                            removed.discard(shard_idx)
                     x_w, y_w = shard
                     worker = AsyncWorker(
                         model_json, init, self.client, train_config,
@@ -604,24 +805,71 @@ class TPUModel:
                         compute_dtype=self.master_compute_dtype,
                         overlap=self.async_overlap,
                         accum_batches=self.async_accum,
-                        epoch_event=(aggregator.report if aggregator
-                                     else None),
+                        epoch_event=(
+                            (lambda e, l, _m=shard_idx:
+                             aggregator.report(e, l, member=_m))
+                            if attach else None),
                         should_stop=(aggregator.should_stop if aggregator
                                      else None),
-                        device=local_devices[index % len(local_devices)])
+                        device=local_devices[slot % len(local_devices)])
                     try:
                         worker.train(np.asarray(x_w), np.asarray(y_w))
                     finally:
                         worker.client.close()
 
+                def on_item_failure(shard_idx, attempt, error):
+                    # a failed worker leaves the epoch aggregator (once
+                    # per shard, however many times its re-runs fail);
+                    # removing it fires any epoch the survivors already
+                    # completed, so callbacks never stall on the dead
+                    if aggregator is None or not nonempty[shard_idx]:
+                        return
+                    with removed_lock:
+                        if shard_idx in removed:
+                            return
+                        removed.add(shard_idx)
+                    aggregator.remove_participant(member=shard_idx)
+
+                ps_probe = ps_restart = None
+                if self.ps_auto_restart and serving:
+                    ps_probe, ps_restart = self._ps_supervision()
+
                 if shards:
-                    with concurrent.futures.ThreadPoolExecutor(
-                            max_workers=len(shards)) as pool:
-                        futures = [pool.submit(run_worker, i, shard)
-                                   for i, shard in enumerate(shards)]
-                        for f in futures:
-                            f.result()
-        except Exception as err:
+                    from .parallel.supervisor import WorkerSupervisor
+
+                    supervisor = WorkerSupervisor(
+                        run_shard,
+                        on_worker_failure=self.on_worker_failure,
+                        max_worker_restarts=self.max_worker_restarts,
+                        min_workers=self.min_workers,
+                        ps_probe=ps_probe, ps_restart=ps_restart,
+                        ps_probe_interval=self.ps_probe_interval,
+                        max_ps_restarts=self.max_ps_restarts,
+                        on_item_failure=on_item_failure)
+                    try:
+                        supervisor.run(shards)
+                    except BaseException as run_err:
+                        # a captured callback error is the ROOT cause
+                        # (it stopped the workers); a drain-time worker
+                        # error must not mask it
+                        if cb_failure:
+                            raise cb_failure["err"] from run_err
+                        raise
+                    finally:
+                        # the report must survive a failed fit too —
+                        # which shards failed/restarted is exactly what
+                        # the operator needs when run() raises
+                        self._training_histories.append(
+                            {"supervisor": supervisor.report.as_dict()})
+                    if cb_failure:
+                        raise cb_failure["err"]
+        except BaseException as err:
+            # BaseException, not Exception: a callback may raise
+            # SystemExit/KeyboardInterrupt (captured in cb_failure and
+            # re-raised above), and skipping the barrier drain below
+            # would hang every peer process forever — the exact failure
+            # mode the barrier discipline here exists to prevent. The
+            # failure is re-raised after the drain.
             failure = err
         if multi:
             barrier("elephas_tpu_workers_done")

@@ -9,4 +9,6 @@ from .sockets import determine_master, receive, send
 from .dataset_utils import (encode_label, from_labeled_points, lp_to_dataset,
                             to_dataset, to_labeled_points)
 from .checkpoint import CheckpointManager
+from .faults import (FaultEvent, FaultPlan, InjectedFault, active_plan,
+                     clear_plan, fault_site, install_plan)
 from .tracing import StepTimer, annotate, profiler_trace

@@ -1,0 +1,26 @@
+"""Version compatibility shims for the JAX API surface.
+
+The repo targets current JAX but must degrade cleanly on the 0.4.x
+series still common in site images (CI pins current JAX; the test
+environment may not).
+"""
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` with the replication/VMA check flag spelled
+    correctly for the running JAX: top-level ``jax.shard_map`` where it
+    exists (falling back to ``jax.experimental.shard_map`` on jax < 0.5),
+    and ``check_vma``/``check_rep`` chosen by what the function actually
+    accepts — the API promotion and the flag rename did not happen in
+    the same release, so the two must be probed independently."""
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check)
+    except TypeError:
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check)

@@ -1,0 +1,245 @@
+"""Deterministic fault injection: a seeded plan of failures at named sites.
+
+Chaos testing for the distributed layers without monkeypatching: hot
+paths (parameter client transport, PS apply/get, the async worker train
+loop) call :func:`fault_site` with a stable site name; a
+:class:`FaultPlan` — installed in-process via :func:`install_plan` or
+through the ``ELEPHAS_TPU_FAULT_PLAN`` environment variable for spawned
+processes — decides, deterministically, whether that particular hit
+``drop``-s the message, ``delay``-s it, or ``error``-s out.
+
+Determinism contract: every site keeps a per-plan hit counter, events
+trigger on counter windows (``after``/``times``), and probabilistic
+events (``p``) draw from a per-site RNG derived from the plan seed — so
+the same plan against the same call sequence injects the same faults,
+in-process or in a spawned test process.
+
+Instrumented sites (the stable names tests target):
+
+================================ ==============================================
+``client.get_parameters``        each pull attempt on the PS client transport
+``client.update_parameters``     each delta-push attempt before it is sent
+``client.push_ack``              after the server applied a push, before the
+                                 client observes the ack (``drop`` = lost ack:
+                                 the idempotent-resend scenario)
+``ps.get_weights``               each server-side weight read
+``ps.apply_delta``               each server-side delta apply (``drop`` =
+                                 delta silently discarded)
+``worker.train``                 async worker entry, once per (re)start
+``worker.epoch``                 each async worker local-epoch boundary
+================================ ==============================================
+
+With no plan installed :func:`fault_site` is a near-free attribute check.
+"""
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: environment variable holding a plan for spawned processes: either an
+#: inline JSON document or a path to a JSON file
+ENV_VAR = "ELEPHAS_TPU_FAULT_PLAN"
+
+_ACTIONS = ("drop", "delay", "error")
+
+
+class InjectedFault(ConnectionError):
+    """Raised for ``error`` events (and by call sites translating a
+    ``drop`` into a lost request). Subclasses :class:`ConnectionError`
+    so the parameter client's transient-retry machinery treats injected
+    transport faults exactly like real network failures."""
+
+
+class FaultEvent:
+    """One scheduled fault: at site ``site``, starting at hit ``after``
+    (0-based, per-site counter), for ``times`` consecutive hits
+    (``None`` = every hit from ``after`` on), apply ``action``.
+
+    ``p`` (0..1) makes the event probabilistic: eligible hits fire with
+    probability ``p`` drawn from the plan's per-site seeded RNG — still
+    deterministic for a fixed plan seed and call sequence.
+    """
+
+    __slots__ = ("site", "action", "after", "times", "delay", "message", "p")
+
+    def __init__(self, site: str, action: str, after: int = 0,
+                 times: Optional[int] = 1, delay: float = 0.05,
+                 message: Optional[str] = None, p: Optional[float] = None):
+        if action not in _ACTIONS:
+            raise ValueError(f"action must be one of {_ACTIONS}, "
+                             f"got {action!r}")
+        if times is not None and times < 1:
+            raise ValueError(f"times must be None or >= 1, got {times}")
+        self.site = str(site)
+        self.action = action
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.delay = float(delay)
+        self.message = message
+        self.p = None if p is None else float(p)
+
+    def matches(self, hit: int) -> bool:
+        """Is per-site hit index ``hit`` inside this event's window?"""
+        if hit < self.after:
+            return False
+        return self.times is None or hit < self.after + self.times
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"site": self.site, "action": self.action}
+        if self.after:
+            d["after"] = self.after
+        if self.times != 1:
+            d["times"] = self.times
+        if self.action == "delay":
+            d["delay"] = self.delay
+        if self.message is not None:
+            d["message"] = self.message
+        if self.p is not None:
+            d["p"] = self.p
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultEvent":
+        return cls(d["site"], d["action"], after=d.get("after", 0),
+                   times=d.get("times", 1), delay=d.get("delay", 0.05),
+                   message=d.get("message"), p=d.get("p"))
+
+    def __repr__(self):
+        return f"FaultEvent({self.to_dict()!r})"
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of fault events keyed by site.
+
+    Thread-safe: hit counters and the fired log live behind one lock
+    (fault sites sit on concurrent worker/server threads by design).
+    """
+
+    def __init__(self, events: Sequence = (), seed: int = 0):
+        self.events: List[FaultEvent] = [
+            e if isinstance(e, FaultEvent) else FaultEvent.from_dict(e)
+            for e in events]
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._rngs: Dict[str, Any] = {}
+        self._fired: List[Tuple[str, int, str]] = []
+
+    # ------------------------------------------------------------- dispatch
+    def check(self, site: str) -> Optional[FaultEvent]:
+        """Record one hit at ``site``; return the event to apply, if any."""
+        with self._lock:
+            hit = self._hits.get(site, 0)
+            self._hits[site] = hit + 1
+            for ev in self.events:
+                if ev.site != site or not ev.matches(hit):
+                    continue
+                if ev.p is not None and self._draw(site) >= ev.p:
+                    continue
+                self._fired.append((site, hit, ev.action))
+                return ev
+        return None
+
+    def _draw(self, site: str) -> float:
+        # per-site RNG stream seeded from (plan seed, crc32(site)): the
+        # interleaving of OTHER sites' hits cannot perturb this site's
+        # draw sequence, which is what makes `p` events reproducible
+        rng = self._rngs.get(site)
+        if rng is None:
+            import numpy as np
+
+            rng = np.random.default_rng(
+                (self.seed, zlib.crc32(site.encode("utf8"))))
+            self._rngs[site] = rng
+        return float(rng.random())
+
+    # -------------------------------------------------------- observability
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fired(self, site: Optional[str] = None) -> List[Tuple[str, int, str]]:
+        """``(site, hit_index, action)`` triples of events that fired."""
+        with self._lock:
+            return [f for f in self._fired if site is None or f[0] == site]
+
+    # -------------------------------------------------------- serialization
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "events": [e.to_dict() for e in self.events]})
+
+    @classmethod
+    def from_json(cls, doc: str) -> "FaultPlan":
+        d = json.loads(doc)
+        if isinstance(d, list):  # bare event list, seed 0
+            return cls(events=d)
+        return cls(events=d.get("events", ()), seed=d.get("seed", 0))
+
+
+# ------------------------------------------------------------ global plan
+_STATE_LOCK = threading.Lock()
+_PLAN: Optional[FaultPlan] = None
+_LOADED = False  # env examined (or a plan installed explicitly)
+
+
+def install_plan(plan: Optional[FaultPlan]):
+    """Install ``plan`` as this process's active plan (overrides the
+    environment). ``None`` disables injection without re-reading the env."""
+    global _PLAN, _LOADED
+    with _STATE_LOCK:
+        _PLAN = plan
+        _LOADED = True
+
+
+def clear_plan():
+    """Drop the active plan AND the loaded flag, so the next
+    :func:`fault_site` call re-examines ``ELEPHAS_TPU_FAULT_PLAN``."""
+    global _PLAN, _LOADED
+    with _STATE_LOCK:
+        _PLAN = None
+        _LOADED = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The live plan: explicitly installed, or lazily loaded from
+    ``ELEPHAS_TPU_FAULT_PLAN`` (inline JSON, or a path to a JSON file)."""
+    global _PLAN, _LOADED
+    if _LOADED:
+        return _PLAN
+    with _STATE_LOCK:
+        if not _LOADED:
+            raw = os.environ.get(ENV_VAR)
+            if raw:
+                raw = raw.strip()
+                if not (raw.startswith("{") or raw.startswith("[")):
+                    with open(raw, "r", encoding="utf8") as f:
+                        raw = f.read()
+                _PLAN = FaultPlan.from_json(raw)
+            _LOADED = True
+    return _PLAN
+
+
+def fault_site(name: str) -> bool:
+    """The hook hot paths call. No plan: returns False (near-free).
+
+    With a plan: ``error`` raises :class:`InjectedFault`, ``delay``
+    sleeps the event's ``delay`` then returns False, ``drop`` returns
+    True — the call site applies its lost-message semantics (skip the
+    apply, eat the ack, ...); sites with no meaningful drop treat it
+    as a no-op.
+    """
+    plan = _PLAN if _LOADED else active_plan()
+    if plan is None:
+        return False
+    ev = plan.check(name)
+    if ev is None:
+        return False
+    if ev.action == "delay":
+        time.sleep(ev.delay)
+        return False
+    if ev.action == "error":
+        raise InjectedFault(ev.message
+                            or f"injected fault at site {name!r}")
+    return True  # drop

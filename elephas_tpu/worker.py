@@ -31,6 +31,7 @@ import numpy as np
 
 from .models import deserialize_optimizer, model_from_json
 from .parameter import BaseParameterClient
+from .utils.faults import fault_site
 from .utils.functional_utils import subtract_params
 from .utils.prefetch import prefetch_to_device
 from .utils.tensor_codec import KIND_DELTA_Q8 as _KIND_DELTA_Q8
@@ -265,6 +266,7 @@ class AsyncWorker:
         return self._train_pinned(x_train, y_train)
 
     def _train_pinned(self, x_train: np.ndarray, y_train: np.ndarray):
+        fault_site("worker.train")  # chaos hook: crash/stall a worker
         self.model = model_from_json(self.json, self.custom_objects)
         self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
                            loss=self.master_loss, metrics=self.master_metrics,
@@ -284,6 +286,7 @@ class AsyncWorker:
             for epoch in range(epochs):
                 if self.should_stop():
                     break
+                fault_site("worker.epoch")  # chaos hook: die mid-fit
                 weights_before = self.client.get_parameters()
                 self.model.set_weights(weights_before)
                 history = None
@@ -312,6 +315,7 @@ class AsyncWorker:
             for epoch in range(epochs):
                 if self.should_stop():
                     break
+                fault_site("worker.epoch")  # chaos hook: die mid-fit
                 losses = []
                 if x_train.shape[0] > batch_size:
                     for batch_start, batch_end in batches:
@@ -376,6 +380,7 @@ class AsyncWorker:
             for epoch in range(epochs):
                 if self.should_stop():
                     break
+                fault_site("worker.epoch")  # chaos hook: die mid-fit
                 epoch_losses = []
                 batch_iter = prefetch_to_device(
                     ((x_all[s:e], y_all[s:e]) for s, e in batches), size=2)

@@ -33,7 +33,12 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no such option; the XLA_FLAGS fallback above
+    # already forces the 8-device host platform
+    pass
 
 import numpy as np
 import pytest

@@ -188,9 +188,13 @@ def test_async_worker_crash_propagates_and_frees_the_port(
         return worker
 
     monkeypatch.setattr(tm, "AsyncWorker", exploding_worker)
+    # on_worker_failure='fail': the propagate-and-free-port contract under
+    # test (the default 'reassign' policy would re-run the crashed shard
+    # on a fresh worker and complete — tests/parallel/test_supervisor.py)
     model = tm.TPUModel(classification_model, mode="asynchronous",
                         num_workers=3, batch_size=32, port=port,
-                        parameter_server_mode="http")
+                        parameter_server_mode="http",
+                        on_worker_failure="fail")
     with pytest.raises(Boom):
         model.fit(to_dataset(x_train[:256], y_train[:256]), epochs=1,
                   batch_size=32, validation_split=0.0)

@@ -22,7 +22,15 @@ def main():
     # the env's sitecustomize pins JAX_PLATFORMS to the TPU plugin; tests
     # must override through jax.config BEFORE any backend initialization
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2 if nprocs > 1 else 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2 if nprocs > 1 else 4)
+    except AttributeError:
+        # older jax (< 0.5) has no such option: force the device count
+        # through XLA_FLAGS instead (still before backend initialization)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count="
+              f"{2 if nprocs > 1 else 4}").strip()
     if nprocs > 1:
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{jax_port}",

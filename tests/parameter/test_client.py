@@ -1,6 +1,20 @@
+import time
+
+import numpy as np
 import pytest
 
-from elephas_tpu.parameter import BaseParameterClient, HttpClient, SocketClient
+from elephas_tpu.models import SGD, Dense, Sequential
+from elephas_tpu.parameter import (BaseParameterClient, HttpClient,
+                                   HttpServer, SocketClient, SocketServer)
+from elephas_tpu.utils.faults import FaultPlan, clear_plan, install_plan
+from elephas_tpu.utils.serialization import model_to_dict
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    clear_plan()
+    yield
+    clear_plan()
 
 
 def test_client_factory_dispatch():
@@ -11,3 +25,61 @@ def test_client_factory_dispatch():
 def test_client_factory_unknown():
     with pytest.raises(ValueError):
         BaseParameterClient.get_client("carrier-pigeon", 4000)
+
+
+def _serialized_model():
+    model = Sequential([Dense(4, input_dim=3), Dense(1)])
+    model.compile(SGD(learning_rate=0.1), "mse", seed=1)
+    return model_to_dict(model)
+
+
+@pytest.mark.parametrize("client_cls", [SocketClient, HttpClient])
+def test_retry_deadline_bounds_wall_clock_not_timeout_times_attempts(
+        client_cls, next_port):
+    """A server that stays down must fail the call within ``deadline``
+    wall-clock. With timeout=5 and max_retries=50 the naive bound
+    (timeout x attempts) is minutes; the deadline cuts the backoff
+    schedule short instead."""
+    client = client_cls(port=next_port(), timeout=5.0, max_retries=50,
+                        backoff=0.05, deadline=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="get_parameters failed"):
+        client.get_parameters()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 4.0, (
+        f"deadline=1.0 but the call burned {elapsed:.1f}s — retries are "
+        "not deadline-bounded")
+
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(SocketServer, SocketClient),
+                          (HttpServer, HttpClient)])
+def test_lost_ack_resend_does_not_double_apply(server_cls, client_cls,
+                                               next_port):
+    """The idempotency window end to end: the server applies a delta but
+    the ack is lost (FaultPlan drop at ``client.push_ack``); the client
+    retries with the SAME update id and the server must ack without
+    applying the delta a second time."""
+    port = next_port()
+    payload = _serialized_model()
+    server = server_cls(payload, port, "asynchronous")
+    server.start()
+    try:
+        plan = FaultPlan([{"site": "client.push_ack", "action": "drop",
+                           "times": 1}])
+        install_plan(plan)
+        client = client_cls(port=port, timeout=5.0, backoff=0.05)
+        initial = client.get_parameters()
+        delta = [np.ones_like(np.asarray(w)) for w in initial]
+        client.update_parameters(delta)
+
+        assert plan.fired("client.push_ack"), "the ack drop must have fired"
+        assert server.num_updates == 1, (
+            "the resend after the lost ack double-applied the delta")
+        final = client.get_parameters()
+        for got, before in zip(final, initial):
+            np.testing.assert_allclose(got, np.asarray(before) - 1.0,
+                                       atol=1e-6)
+        client.close()
+    finally:
+        server.stop()

@@ -137,9 +137,12 @@ def test_resume_from_checkpoint_after_ps_death(tmp_path, next_port):
 
 
 def test_worker_crash_fails_fit_with_others_drained(monkeypatch, next_port):
-    """One worker thread dying must surface as a fit() exception after
-    the OTHER workers drain (finish or fail) — never a hang, never a
-    silent partial success."""
+    """``on_worker_failure='fail'`` preserves fail-fast semantics: one
+    worker thread dying must surface as a fit() exception after the
+    OTHER workers drain (finish or fail) — never a hang, never a silent
+    partial success. (The supervisor's default policy, ``reassign``,
+    would instead re-run the crashed shard; see
+    tests/parallel/test_supervisor.py.)"""
     import elephas_tpu.tpu_model as tpu_module
     from elephas_tpu.worker import AsyncWorker
 
@@ -166,7 +169,8 @@ def test_worker_crash_fails_fit_with_others_drained(monkeypatch, next_port):
     monkeypatch.setattr(AsyncWorker, "train", train_with_crash)
     tpu_model = TPUModel(_model(), mode="asynchronous", frequency="epoch",
                          parameter_server_mode="socket", num_workers=2,
-                         batch_size=16, port=next_port())
+                         batch_size=16, port=next_port(),
+                         on_worker_failure="fail")
     with pytest.raises(RuntimeError, match="injected worker crash"):
         tpu_model.fit(to_dataset(x, y), epochs=2, batch_size=16,
                       verbose=0, validation_split=0.0)

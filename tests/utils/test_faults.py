@@ -1,0 +1,117 @@
+"""FaultPlan / fault_site: the deterministic chaos layer itself."""
+import time
+
+import pytest
+
+from elephas_tpu.utils.faults import (ENV_VAR, FaultEvent, FaultPlan,
+                                      InjectedFault, active_plan, clear_plan,
+                                      fault_site, install_plan)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    """Fault state is process-global: every test starts and ends clean."""
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def test_no_plan_is_a_noop():
+    assert active_plan() is None
+    assert fault_site("anything") is False
+
+
+def test_event_window_after_and_times():
+    plan = FaultPlan([{"site": "s", "action": "drop", "after": 2,
+                       "times": 2}])
+    install_plan(plan)
+    hits = [fault_site("s") for _ in range(6)]
+    assert hits == [False, False, True, True, False, False]
+    assert plan.hits("s") == 6
+    assert plan.fired() == [("s", 2, "drop"), ("s", 3, "drop")]
+
+
+def test_times_none_fires_forever():
+    install_plan(FaultPlan([{"site": "s", "action": "drop", "after": 1,
+                             "times": None}]))
+    assert [fault_site("s") for _ in range(4)] == [False, True, True, True]
+
+
+def test_error_raises_injected_fault_as_connection_error():
+    install_plan(FaultPlan([{"site": "s", "action": "error",
+                             "message": "boom"}]))
+    with pytest.raises(InjectedFault, match="boom") as exc:
+        fault_site("s")
+    # the retry machinery must see it as a transient transport failure
+    assert isinstance(exc.value, ConnectionError)
+    assert fault_site("s") is False  # times=1: second hit is clean
+
+
+def test_delay_sleeps_then_continues():
+    install_plan(FaultPlan([{"site": "s", "action": "delay",
+                             "delay": 0.15}]))
+    t0 = time.monotonic()
+    assert fault_site("s") is False
+    assert time.monotonic() - t0 >= 0.12
+
+
+def test_sites_count_independently():
+    plan = FaultPlan([{"site": "a", "action": "drop", "after": 1}])
+    install_plan(plan)
+    assert fault_site("b") is False  # does not advance site a's window
+    assert fault_site("a") is False
+    assert fault_site("a") is True
+
+
+def test_json_round_trip():
+    plan = FaultPlan([FaultEvent("x", "delay", after=3, times=None,
+                                 delay=0.5),
+                      FaultEvent("y", "error", message="m", p=0.25)],
+                     seed=7)
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.seed == 7
+    assert [e.to_dict() for e in clone.events] == \
+        [e.to_dict() for e in plan.events]
+
+
+def test_seeded_probabilistic_events_are_reproducible():
+    def pattern(seed):
+        plan = FaultPlan([{"site": "s", "action": "drop", "times": None,
+                           "p": 0.5}], seed=seed)
+        install_plan(plan)
+        return [fault_site("s") for _ in range(64)]
+
+    a, b = pattern(3), pattern(3)
+    assert a == b, "same seed must inject the same fault sequence"
+    assert any(a) and not all(a), "p=0.5 should fire some but not all"
+    assert pattern(4) != a, "a different seed should differ (p=0.5, 64 hits)"
+
+
+def test_env_var_inline_json(monkeypatch):
+    plan = FaultPlan([{"site": "s", "action": "drop"}])
+    monkeypatch.setenv(ENV_VAR, plan.to_json())
+    clear_plan()  # force a reload from the env
+    assert fault_site("s") is True
+    assert fault_site("s") is False
+
+
+def test_env_var_file_path(monkeypatch, tmp_path):
+    f = tmp_path / "plan.json"
+    f.write_text(FaultPlan([{"site": "s", "action": "error"}]).to_json())
+    monkeypatch.setenv(ENV_VAR, str(f))
+    clear_plan()
+    with pytest.raises(InjectedFault):
+        fault_site("s")
+
+
+def test_install_none_disables_even_with_env(monkeypatch):
+    monkeypatch.setenv(ENV_VAR, FaultPlan([{"site": "s",
+                                            "action": "drop"}]).to_json())
+    install_plan(None)  # explicit install wins over the environment
+    assert fault_site("s") is False
+
+
+def test_invalid_action_rejected():
+    with pytest.raises(ValueError, match="action"):
+        FaultEvent("s", "explode")
