@@ -102,7 +102,8 @@ PAGES = [
      ["speculative_generate"]),
     ("Draft distillation", "elephas_tpu.models.distill",
      ["distill_loss", "make_distill_step"]),
-    ("Continuous batching", "elephas_tpu.serving_engine", ["DecodeEngine"]),
+    ("Continuous batching", "elephas_tpu.serving_engine",
+     ["DecodeEngine", "QueueFullError", "DeadlineExceededError"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
@@ -185,6 +186,7 @@ def main(out_dir: str = None):
     mkdocs = ["site_name: elephas_tpu", "nav:", "  - Home: index.md",
               "  - Scaling guide: scaling-guide.md",
               "  - Serving guide: serving-guide.md",
+              "  - Serving operations: serving-operations.md",
               "  - Fault tolerance: fault-tolerance.md"]
     mkdocs += [f"  - {title}: {page}" for title, page in nav]
     (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")

@@ -23,7 +23,8 @@ if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
 from . import models, utils
 from .data import Dataset
 from .serving import TextGenerator
-from .serving_engine import DecodeEngine
+from .serving_engine import (DeadlineExceededError, DecodeEngine,
+                             QueueFullError)
 from .serving_http import ServingServer
 from .ssm_engine import SSMEngine
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model

@@ -4,13 +4,25 @@
 ragged-prompt decode loop: prompts of different lengths batch into one
 jitted scan (right-padded + per-row lengths), with the full sampling
 suite (temperature / top-k / nucleus / repetition penalty).
+
+Overload safety for the BLOCKING path: ``max_batch_prompts`` /
+``max_batch_tokens`` bound what one call may dispatch (an oversized
+batch raises :class:`~elephas_tpu.serving_engine.QueueFullError` with a
+suggested split instead of monopolizing the chip), and ``deadline_ms``
+refuses to dispatch work whose deadline already passed during
+tokenization/queueing upstream. The fused decode scan itself is NOT
+preemptible — once dispatched it runs to completion; callers that need
+mid-decode deadlines and per-request shedding serve through
+:class:`~elephas_tpu.serving_engine.DecodeEngine`, which enforces both.
 """
+import time
 from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from .models.transformer import TransformerConfig, generate
+from .serving_engine import DeadlineExceededError, QueueFullError
 from .utils.text import ByteTokenizer
 
 __all__ = ["TextGenerator"]
@@ -32,13 +44,31 @@ class TextGenerator:
         requested; other calls fall back to the plain decode scan.
     :param draft_config: the draft model's config (same vocabulary)
     :param gamma: draft tokens proposed per verify round
+    :param max_batch_prompts: admission bound on prompts per call; an
+        oversized batch raises :class:`QueueFullError` (``None`` =
+        unbounded)
+    :param max_batch_tokens: admission bound on the TOTAL encoded
+        prompt tokens per call — the real memory/prefill cost a prompt
+        count alone cannot see
     """
 
     def __init__(self, params, config: TransformerConfig, tokenizer=None,
-                 draft_params=None, draft_config=None, gamma: int = 4):
+                 draft_params=None, draft_config=None, gamma: int = 4,
+                 max_batch_prompts: Optional[int] = None,
+                 max_batch_tokens: Optional[int] = None):
         self.params = params
         self.config = config
         self.tokenizer = tokenizer or ByteTokenizer()
+        self.max_batch_prompts = (None if max_batch_prompts is None
+                                  else int(max_batch_prompts))
+        if (self.max_batch_prompts is not None
+                and self.max_batch_prompts < 1):
+            raise ValueError("max_batch_prompts must be None or >= 1")
+        self.max_batch_tokens = (None if max_batch_tokens is None
+                                 else int(max_batch_tokens))
+        if (self.max_batch_tokens is not None
+                and self.max_batch_tokens < 1):
+            raise ValueError("max_batch_tokens must be None or >= 1")
         if (draft_params is None) != (draft_config is None):
             raise ValueError("draft_params and draft_config go together")
         if draft_config is not None:
@@ -58,17 +88,55 @@ class TextGenerator:
                  repetition_penalty: float = 1.0,
                  seed: int = 0,
                  stop_id: Optional[int] = None,
-                 stop_sequences: Optional[Sequence[str]] = None
+                 stop_sequences: Optional[Sequence[str]] = None,
+                 deadline_ms: Optional[float] = None
                  ) -> List[str]:
         """Generate continuations for ``prompts``. ``stop_sequences``
         truncates each output at the earliest occurrence of any of the
         given strings (the stop text itself is dropped) — multi-token
-        stop phrases the single-id ``stop_id`` cannot express."""
+        stop phrases the single-id ``stop_id`` cannot express.
+
+        ``deadline_ms`` bounds ADMISSION: if tokenizing the batch alone
+        eats the deadline, :class:`DeadlineExceededError` is raised
+        before any device work is dispatched. The fused scan itself is
+        not preemptible — use :class:`DecodeEngine` deadlines for
+        mid-decode enforcement."""
+        t0 = time.monotonic()
+        if deadline_ms is not None and not deadline_ms > 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         tok = self.tokenizer
+        if (self.max_batch_prompts is not None
+                and len(prompts) > self.max_batch_prompts):
+            raise QueueFullError(
+                f"batch of {len(prompts)} prompts exceeds "
+                f"max_batch_prompts={self.max_batch_prompts}; split into "
+                f"{-(-len(prompts) // self.max_batch_prompts)} calls")
         encoded = [tok.encode(p) for p in prompts]
         lens = np.asarray([len(e) for e in encoded], np.int32)
         if lens.min() < 1:
             raise ValueError("prompts must encode to at least one token")
+        total_tokens = int(lens.sum())
+        if (self.max_batch_tokens is not None
+                and int(lens.max()) > self.max_batch_tokens):
+            # permanently inadmissible — splitting the batch cannot get
+            # a single over-bound prompt under the cap, so a retryable
+            # QueueFullError would have well-behaved clients retrying
+            # forever (same rule as DecodeEngine's max_queued_tokens)
+            raise ValueError(
+                f"a single prompt of {int(lens.max())} tokens exceeds "
+                f"max_batch_tokens={self.max_batch_tokens} — it could "
+                "never be dispatched")
+        if (self.max_batch_tokens is not None
+                and total_tokens > self.max_batch_tokens):
+            raise QueueFullError(
+                f"batch of {total_tokens} prompt tokens exceeds "
+                f"max_batch_tokens={self.max_batch_tokens}; split the "
+                "batch or trim the prompts")
+        if (deadline_ms is not None
+                and (time.monotonic() - t0) * 1000.0 >= deadline_ms):
+            raise DeadlineExceededError(
+                f"deadline of {deadline_ms}ms expired during admission "
+                "(before any device work was dispatched)")
         lmax = int(lens.max())
         pad = getattr(tok, "pad_id", 0)
         batch = np.full((len(encoded), lmax), pad, np.int32)

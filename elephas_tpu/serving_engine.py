@@ -55,6 +55,26 @@ import numpy as np
 from .models.transformer import (NEG_INF, TransformerConfig, chunked_blocks,
                                  decode_block, decode_step, init_kv_cache,
                                  prefill_cache)
+from .utils.faults import fault_site
+
+
+class QueueFullError(RuntimeError):
+    """Admission rejected: accepting the request would exceed the
+    engine's queue-depth or queued-token bound (or a ``serving.submit``
+    fault-plan ``drop`` simulated the same). Carries ``retry_after_ms``,
+    a backoff hint derived from recent request latency and the current
+    backlog — the HTTP layer forwards it with its 429."""
+
+    def __init__(self, message: str, retry_after_ms: int = 100):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request's deadline passed before any work was dispatched for
+    it (the blocking :class:`~elephas_tpu.serving.TextGenerator` path;
+    the engine itself never raises this — it sheds expired requests and
+    marks their results instead)."""
 
 
 def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
@@ -88,7 +108,7 @@ def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
     p_thr = jnp.where(top_p[:, None] < 1.0, p_kth, -jnp.inf)
     return jnp.where(logits >= p_thr, logits, NEG_INF)
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "QueueFullError", "DeadlineExceededError"]
 
 
 class DecodeEngine:
@@ -139,6 +159,19 @@ class DecodeEngine:
         :mod:`~elephas_tpu.models.paged_decode`). Composes with prefix
         caching, chunked prefill, and multi-step; not with speculative
         mode, ``kv_cache_quant``, or MoE.
+    :param max_queue: admission bound on the backlog of queued
+        (not-yet-admitted) requests; a :meth:`submit` that would push the
+        backlog past it raises :class:`QueueFullError` instead of
+        queueing forever (``None`` = unbounded, the pre-overload-safety
+        behavior). Must be >= 1: the HTTP server submits with
+        ``admit=False``, so every request passes through the queue even
+        when a slot is free.
+    :param max_queued_tokens: companion bound on the TOTAL prompt tokens
+        waiting in the queue — a few enormous prompts can exhaust
+        prefill capacity long before ``max_queue`` counts them.
+    :param clock: monotonic time source for deadline bookkeeping
+        (``time.monotonic``); injectable so chaos tests drive expiry
+        deterministically without sleeping.
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
@@ -148,7 +181,10 @@ class DecodeEngine:
                  draft_config: Optional[TransformerConfig] = None,
                  gamma: int = 4, steps_per_sync: int = 1,
                  prefill_chunk: Optional[int] = None,
-                 paged: Optional[Tuple[int, int]] = None):
+                 paged: Optional[Tuple[int, int]] = None,
+                 max_queue: Optional[int] = None,
+                 max_queued_tokens: Optional[int] = None,
+                 clock=time.monotonic):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -232,6 +268,25 @@ class DecodeEngine:
         self._done: Dict = {}
         self._fresh: Dict = {}   # admission-time tokens awaiting step()
         self._next_rid = 0
+        # overload safety: admission bounds + per-request deadlines
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError("max_queue must be None or >= 1 (the HTTP "
+                             "server's admit=False submits always pass "
+                             "through the queue)")
+        self.max_queued_tokens = (None if max_queued_tokens is None
+                                  else int(max_queued_tokens))
+        if (self.max_queued_tokens is not None
+                and self.max_queued_tokens < 1):
+            raise ValueError("max_queued_tokens must be None or >= 1")
+        self._clock = clock
+        self._queued_tokens = 0              # prompt tokens in the queue
+        self._deadline: Dict[int, float] = {}  # rid -> absolute deadline
+        self._expired: set = set()   # shed while queued (never prefilled)
+        self._timed_out: set = set()  # deadline hit mid-decode (partial)
+        self._n_shed = 0
+        self._n_expired = 0
+        self._n_timed_out = 0
         # observability counters (see .stats)
         self._n_steps = 0
         # per-request wall-clock: submit time per rid + a bounded window
@@ -574,7 +629,8 @@ class DecodeEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               admit: bool = True) -> int:
+               admit: bool = True,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
         ``temperature``/``top_k``/``top_p`` override the engine defaults
@@ -585,7 +641,16 @@ class DecodeEngine:
         prefill jit compile a new prompt length triggers — to the next
         :meth:`step`; callers that serialize engine access behind a lock
         (the HTTP server) use this so submitting never holds that lock
-        across a multi-second compile."""
+        across a multi-second compile.
+
+        ``deadline_ms`` bounds the request's TOTAL time in the engine:
+        if it is still queued when the deadline passes it is shed before
+        prefill (``result_info`` reports ``expired``); if the deadline
+        passes mid-decode the slot is freed and the tokens emitted so
+        far become the final output (``timeout``). Raises
+        :class:`QueueFullError` when ``max_queue``/``max_queued_tokens``
+        is configured and the backlog is at capacity — overload answers
+        immediately instead of queueing unboundedly."""
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
@@ -620,17 +685,68 @@ class DecodeEngine:
                     f"request needs {needed} blocks but the pool only "
                     f"has {self.paged[0] - 1} allocatable — it could "
                     "never be admitted")
+        if deadline_ms is not None and not deadline_ms > 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        # expired backlog entries must not hold capacity against a live
+        # admission decision
+        self._shed_expired_queued()
+        if fault_site("serving.submit"):
+            # a plan 'drop' here is a deterministic shed: the request is
+            # rejected exactly as if the queue were at capacity
+            self._n_shed += 1
+            raise QueueFullError("admission rejected (injected shed)",
+                                 self._retry_after_ms())
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            self._n_shed += 1
+            raise QueueFullError(
+                f"queue full: {len(self._queue)} requests backlogged "
+                f"(max_queue={self.max_queue})", self._retry_after_ms())
+        if (self.max_queued_tokens is not None
+                and prompt.size > self.max_queued_tokens):
+            # permanently inadmissible, like the oversized-paged-request
+            # check above: a retryable QueueFullError (429 + backoff)
+            # would have well-behaved clients retrying forever
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds "
+                f"max_queued_tokens={self.max_queued_tokens} — it could "
+                "never be admitted")
+        if (self.max_queued_tokens is not None
+                and self._queued_tokens + prompt.size
+                > self.max_queued_tokens):
+            self._n_shed += 1
+            raise QueueFullError(
+                f"queue full: {self._queued_tokens} prompt tokens "
+                f"backlogged + {prompt.size} would exceed "
+                f"max_queued_tokens={self.max_queued_tokens}",
+                self._retry_after_ms())
         rid = self._next_rid
         self._next_rid += 1
         self._submit_t[rid] = time.monotonic()
+        if deadline_ms is not None:
+            self._deadline[rid] = self._clock() + deadline_ms / 1000.0
         self._queue.append((rid, prompt, int(max_new_tokens),
                             self.temperature if temperature is None
                             else float(temperature),
                             0 if top_k is None else int(top_k),
                             1.0 if top_p is None else float(top_p)))
+        self._queued_tokens += int(prompt.size)
         if admit:
             self._admit()
         return rid
+
+    def _retry_after_ms(self) -> int:
+        """Backoff hint for a shed request: roughly how long until the
+        backlog drains enough to retry, from the median observed request
+        latency scaled by the queue's depth relative to slot capacity
+        (clamped to a sane window; 100ms before any sample exists)."""
+        if self._latency_window:
+            med = float(np.quantile([t for _, t in self._latency_window],
+                                    0.5))
+            est = 1000.0 * med * max(1, len(self._queue)) / self.max_slots
+        else:
+            est = 100.0
+        return int(min(10000.0, max(50.0, est)))
 
     def cancel(self, rid: int) -> bool:
         """Abort a request: drop it from the queue, or free its slot and
@@ -640,7 +756,9 @@ class DecodeEngine:
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
+                self._queued_tokens -= int(item[1].size)
                 self._submit_t.pop(rid, None)
+                self._deadline.pop(rid, None)
                 return True
         for slot, r in enumerate(self._rid):
             if r == rid:
@@ -650,13 +768,54 @@ class DecodeEngine:
                 self._release_blocks(slot)
                 self._submit_t.pop(rid, None)
                 self._admit_t.pop(rid, None)
+                self._deadline.pop(rid, None)
                 return True
         return False
 
     def _free_slots(self) -> List[int]:
         return [s for s in range(self.max_slots) if self._rid[s] is None]
 
+    def _shed_expired_queued(self):
+        """Drop every queued request whose deadline already passed —
+        BEFORE it ever reaches prefill. Each becomes a finished result
+        with no tokens, marked ``expired`` (the HTTP layer's 504)."""
+        if not self._deadline or not self._queue:
+            return
+        now = self._clock()
+        keep: deque = deque()
+        for item in self._queue:
+            rid = item[0]
+            dl = self._deadline.get(rid)
+            if dl is not None and now >= dl:
+                self._queued_tokens -= int(item[1].size)
+                self._deadline.pop(rid, None)
+                self._submit_t.pop(rid, None)
+                self._done[rid] = []
+                self._expired.add(rid)
+                self._n_expired += 1
+            else:
+                keep.append(item)
+        self._queue = keep
+
+    def _enforce_active_deadlines(self):
+        """Retire every ACTIVE slot whose request deadline passed: the
+        slot (and its paged blocks) frees immediately and the tokens
+        emitted so far become the final output, marked ``timeout``."""
+        if not self._deadline:
+            return
+        now = self._clock()
+        for slot, rid in enumerate(self._rid):
+            if rid is None or self._deadline.get(rid, now + 1) > now:
+                continue
+            # _fresh stays: an admission-time token not yet surfaced by
+            # step() still reaches streaming clients on the next call
+            self._retire_slot(slot)
+            self._timed_out.add(rid)
+            self._n_timed_out += 1
+
     def _admit(self):
+        self._shed_expired_queued()
+        self._enforce_active_deadlines()
         for slot in self._free_slots():
             if not self._queue:
                 return
@@ -675,6 +834,7 @@ class DecodeEngine:
                 self._tables[slot, :] = 0      # unused entries -> scratch
                 self._tables[slot, :needed] = blocks
             rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
+            self._queued_tokens -= int(prompt.size)
             # queue wait ends HERE — prefill compute/compile time below
             # belongs to total latency, not to time-spent-queued
             self._admit_t[rid] = time.monotonic()
@@ -748,17 +908,26 @@ class DecodeEngine:
             self._slot_blocks[slot] = []
             self._tables[slot, :] = 0          # back to the scratch sink
 
-    def _finish(self, slot: int):
+    def _retire_slot(self, slot: int) -> int:
+        """Slot-retirement bookkeeping shared by normal completion and
+        deadline enforcement: tokens move to ``_done``, the slot (and
+        paged blocks) frees, the deadline drops, latency is recorded.
+        Callers bump their own outcome counter/marker."""
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
         self._release_blocks(slot)
-        self._n_finished += 1
+        self._deadline.pop(rid, None)
         now = time.monotonic()
         t_sub = self._submit_t.pop(rid, None)
         t_adm = self._admit_t.pop(rid, now)
         if t_sub is not None:
             self._latency_window.append((t_adm - t_sub, now - t_sub))
+        return rid
+
+    def _finish(self, slot: int):
+        self._retire_slot(slot)
+        self._n_finished += 1
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -771,7 +940,15 @@ class DecodeEngine:
                "tokens_emitted": self._n_emitted,
                "requests_finished": self._n_finished,
                "tokens_per_step": (self._n_emitted / self._n_steps
-                                   if self._n_steps else 0.0)}
+                                   if self._n_steps else 0.0),
+               # overload-safety counters: admission rejections (429),
+               # queued-deadline sheds (504), mid-decode timeouts, and
+               # the live backlog the admission bounds act on
+               "requests_shed": self._n_shed,
+               "requests_expired": self._n_expired,
+               "requests_timed_out": self._n_timed_out,
+               "queue_depth": len(self._queue),
+               "queued_tokens": self._queued_tokens}
         if self._prefixes:
             out["prefix_hits"] = self._n_prefix_hits
             out["prefix_tokens_reused"] = self._n_prefix_tokens
@@ -809,7 +986,12 @@ class DecodeEngine:
         ``1 + accepted`` tokens (speculative mode, up to ``gamma+1``);
         returns ``{request_id: [tokens]}`` emitted since the last call
         (admission-time first tokens ride along too). Finished requests
-        retire and queued ones join automatically."""
+        retire and queued ones join automatically; expired queued
+        requests are shed before prefill and over-deadline active slots
+        are freed (their partial output finishes as a ``timeout``)."""
+        # chaos site: 'error' = engine crash mid-serve (the HTTP loop
+        # records it and /health turns red), 'delay' = a slow step
+        fault_site("serving.step")
         self._admit()
         emitted = {rid: [tok] for rid, tok in self._fresh.items()}
         self._fresh = {}
@@ -904,4 +1086,23 @@ class DecodeEngine:
         """Finished output for ``rid`` (None while still in flight).
         Pops the entry: a long-running server does not accumulate every
         finished request's tokens; call once per request."""
-        return self._done.pop(rid, None)
+        info = self.result_info(rid)
+        return None if info is None else info["tokens"]
+
+    def result_info(self, rid: int) -> Optional[Dict]:
+        """Like :meth:`result` but returns the full outcome:
+        ``{"tokens": [...], "timeout": bool, "expired": bool}``.
+        ``expired`` — the deadline passed while queued (no token was
+        ever decoded; the request never reached prefill); ``timeout`` —
+        the deadline cut the request short (set for BOTH cases; for a
+        mid-decode cut ``tokens`` holds the partial output). One-shot,
+        like :meth:`result`."""
+        if rid not in self._done:
+            return None
+        tokens = self._done.pop(rid)
+        expired = rid in self._expired
+        timed_out = expired or rid in self._timed_out
+        self._expired.discard(rid)
+        self._timed_out.discard(rid)
+        return {"tokens": tokens, "timeout": timed_out,
+                "expired": expired}

@@ -23,7 +23,25 @@ Endpoints (JSON in/out):
   ``{"status": "done", "tokens": [...]}`` (one-shot, like
   ``DecodeEngine.result``).
 - ``POST /v1/cancel`` — ``{"id": rid}`` → ``{"cancelled": bool}``.
-- ``GET /stats`` — engine counters; ``GET /health`` — liveness.
+- ``GET /stats`` — engine + server counters; ``GET /health`` — liveness
+  (200 until the engine loop dies); ``GET /ready`` — readiness (503
+  while warming and while draining; load balancers route on this one).
+
+Overload safety (the serving-operations doc page has the full story):
+
+- Admission control: construct the engine with ``max_queue`` /
+  ``max_queued_tokens`` and an over-capacity submit answers **429**
+  with a ``retry_after_ms`` backoff hint instead of queueing forever.
+- Deadlines: requests may carry ``deadline_ms`` (or inherit the
+  server's ``default_deadline_ms``). Expired-while-queued answers
+  **504** (shed before prefill); expired mid-decode returns the partial
+  tokens with ``"timeout": true``.
+- Oversized bodies answer **413** (``max_body_bytes``); unknown result
+  ids answer **404**.
+- Graceful drain: ``stop(drain_timeout)`` flips ``/ready`` to 503,
+  rejects new submits with **503**, lets in-flight (including
+  streaming) requests finish up to the timeout, then cancels the
+  stragglers — replacing the abrupt shutdown that stranded streams.
 
 The reference has no serving server at all (SURVEY.md §2: inference is
 Spark ``mapPartitions``); this is the online half of the framework's
@@ -36,9 +54,24 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from .serving_engine import QueueFullError
+from .utils.faults import fault_site
+
 __all__ = ["ServingServer"]
 
 _IDLE_SLEEP = 0.005
+
+
+class _HTTPError(Exception):
+    """A route outcome with a specific status code: raised anywhere
+    under a handler's dispatch, answered as ``code`` + JSON payload
+    (the generic handler fallback answers 400, which overload responses
+    like 429/503/504 must not collapse into)."""
+
+    def __init__(self, code: int, payload: Dict):
+        super().__init__(payload.get("error", f"http {code}"))
+        self.code = code
+        self.payload = payload
 
 
 class ServingServer:
@@ -54,15 +87,40 @@ class ServingServer:
         :class:`~elephas_tpu.utils.text.ByteTokenizer`) enabling
         ``"text"`` requests and text in responses.
     :param default_max_new_tokens: used when a request omits the field.
+    :param default_deadline_ms: server-side default deadline applied to
+        every request that does not carry its own ``deadline_ms``
+        (``None`` = no default; a request's explicit value always
+        wins). The backstop against clients that would happily wait
+        forever while the backlog grows.
+    :param max_body_bytes: reject request bodies whose Content-Length
+        exceeds this with 413 before reading a byte (default 1 MiB) —
+        the header is a claim, not a license to buffer unbounded input.
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  tokenizer=None, default_max_new_tokens: int = 64,
-                 max_stored_results: int = 1024):
+                 max_stored_results: int = 1024,
+                 default_deadline_ms: Optional[float] = None,
+                 max_body_bytes: int = 1 << 20):
         self.engine = engine
         self.tokenizer = tokenizer
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.max_stored_results = int(max_stored_results)
+        self.default_deadline_ms = (None if default_deadline_ms is None
+                                    else float(default_deadline_ms))
+        self.max_body_bytes = int(max_body_bytes)
+        # engine capability probe: SSMEngine's submit has no deadline
+        # support — the server default must not poison every request
+        # with an unexpected kwarg, and a client's explicit deadline
+        # must fail loudly, not be silently dropped
+        import inspect
+
+        try:
+            self._engine_has_deadline = ("deadline_ms" in inspect
+                                         .signature(engine.submit)
+                                         .parameters)
+        except (TypeError, ValueError):
+            self._engine_has_deadline = True   # assume the full engine
         self._host, self._port = host, int(port)
         self._lock = threading.Lock()          # guards every engine call
         self._cond = threading.Condition(self._lock)
@@ -77,6 +135,18 @@ class ServingServer:
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads = []
+        # readiness/drain state: /ready is 503 until the engine loop has
+        # run once (warming) and again from begin_drain() on (draining);
+        # /health stays the pure liveness signal throughout
+        self._ready = False
+        self._draining = False
+        self._n_drained = 0      # in-flight requests cancelled at drain
+        # set by stop(): the ENGINE LOOP enforces the drain deadline and
+        # signals completion (it holds the lock across every step, so a
+        # stop() thread polling for the lock could starve past its
+        # drain budget while work it should cancel runs to completion)
+        self._drain_deadline: Optional[float] = None
+        self._drain_done: Optional[threading.Event] = None
 
     # ---------------------------------------------------------- lifecycle
     @property
@@ -100,43 +170,87 @@ class ServingServer:
                 self.wfile.write(body)
 
             def _body(self) -> Dict:
-                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    raise _HTTPError(400,
+                                     {"error": "invalid Content-Length"})
+                if length < 0:
+                    # a negative length is truthy AND under the cap; it
+                    # would reach read(-1) = read-to-EOF — the unbounded
+                    # buffering this guard exists to prevent
+                    raise _HTTPError(400,
+                                     {"error": "invalid Content-Length"})
+                if length > server.max_body_bytes:
+                    # reject on the CLAIMED size, before reading a byte:
+                    # trusting the header and buffering is exactly the
+                    # unbounded-read this cap exists to prevent
+                    raise _HTTPError(413, {
+                        "error": f"request body of {length} bytes "
+                                 f"exceeds max_body_bytes "
+                                 f"{server.max_body_bytes}",
+                        "max_body_bytes": server.max_body_bytes})
                 if not length:
                     return {}
                 return json.loads(self.rfile.read(length))
 
             def do_GET(self):
                 url = urlparse(self.path)
-                if url.path == "/health":
-                    # lock-free read: liveness must answer instantly even
-                    # while the engine loop holds the lock across a
-                    # prefill compile (attribute reads are atomic)
-                    failure = server._failure
-                    if failure is None:
-                        self._json(200, {"status": "ok"})
+                try:
+                    if url.path == "/health":
+                        # lock-free read: liveness must answer instantly
+                        # even while the engine loop holds the lock
+                        # across a prefill compile (attribute reads are
+                        # atomic)
+                        failure = server._failure
+                        if failure is None:
+                            self._json(200, {"status": "ok"})
+                        else:
+                            self._json(500, {"status": "error",
+                                             "error": failure})
+                    elif url.path == "/ready":
+                        # readiness ≠ liveness: a warming or draining
+                        # server is alive but must not receive new
+                        # traffic. Lock-free, like /health.
+                        failure = server._failure
+                        if failure is not None:
+                            self._json(503, {"status": "failed",
+                                             "error": failure})
+                        elif server._draining or server._stop.is_set():
+                            self._json(503, {"status": "draining"})
+                        elif not server._ready:
+                            self._json(503, {"status": "warming"})
+                        else:
+                            self._json(200, {"status": "ready"})
+                    elif url.path == "/stats":
+                        with server._lock:
+                            stats = dict(server.engine.stats)
+                            stats["requests_drained"] = server._n_drained
+                            stats["draining"] = server._draining
+                        self._json(200, stats)
+                    elif url.path == "/v1/result":
+                        rid = parse_qs(url.query).get("id")
+                        try:
+                            rid = int(rid[0]) if rid else None
+                        except ValueError:
+                            rid = None
+                        if rid is None:
+                            self._json(400,
+                                       {"error": "missing/invalid id"})
+                            return
+                        self._json(200, server._poll(rid))
                     else:
-                        self._json(500, {"status": "error",
-                                         "error": failure})
-                elif url.path == "/stats":
-                    with server._lock:
-                        self._json(200, dict(server.engine.stats))
-                elif url.path == "/v1/result":
-                    rid = parse_qs(url.query).get("id")
-                    try:
-                        rid = int(rid[0]) if rid else None
-                    except ValueError:
-                        rid = None
-                    if rid is None:
-                        self._json(400, {"error": "missing/invalid id"})
-                        return
-                    self._json(200, server._poll(rid))
-                else:
-                    self._json(404, {"error": "unknown path"})
+                        self._json(404, {"error": "unknown path"})
+                except _HTTPError as err:
+                    self._json(err.code, err.payload)
 
             def do_POST(self):
                 url = urlparse(self.path)
                 try:
                     body = self._body()
+                except _HTTPError as err:      # oversize body -> 413
+                    self._json(err.code, err.payload)
+                    return
                 except (ValueError, json.JSONDecodeError):
                     self._json(400, {"error": "invalid JSON body"})
                     return
@@ -152,6 +266,12 @@ class ServingServer:
                             self.end_headers()
 
                             def line(payload):
+                                # chaos site: 'drop' loses this line on
+                                # the wire (half-dead client), 'error'
+                                # is a deterministic mid-stream client
+                                # disconnect — the abort path below
+                                if fault_site("serving.stream_write"):
+                                    return
                                 self.wfile.write(
                                     (json.dumps(payload) + "\n").encode())
                                 self.wfile.flush()
@@ -172,6 +292,10 @@ class ServingServer:
                         self._json(200, server._cancel(body))
                     else:
                         self._json(404, {"error": "unknown path"})
+                except _HTTPError as err:
+                    # overload/drain outcomes carry their own status:
+                    # 429 shed, 503 draining, 504 expired, 413 oversize
+                    self._json(err.code, err.payload)
                 except Exception as exc:  # noqa: BLE001 — malformed-but-
                     # valid-JSON payloads (wrong types/shapes) and engine
                     # validation errors all answer a clean 400, never a
@@ -188,7 +312,36 @@ class ServingServer:
             t.start()
         return self
 
-    def stop(self):
+    def begin_drain(self):
+        """Enter draining: ``/ready`` answers 503 and new submits are
+        rejected with 503, while requests already in flight (including
+        live streams) keep running. Idempotent; :meth:`stop` calls it
+        first, but an orchestrator may flip it early so the load
+        balancer stops routing here before the actual stop."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def stop(self, drain_timeout: float = 0.0):
+        """Shut down, draining gracefully for up to ``drain_timeout``
+        seconds: new submits 503 immediately, in-flight and streaming
+        requests run to completion, and whatever is still unfinished at
+        the timeout is cancelled (streams get their terminal
+        ``cancelled`` line rather than a severed socket). The default
+        ``drain_timeout=0`` is the old abrupt behavior."""
+        self.begin_drain()
+        if (drain_timeout > 0 and self._failure is None
+                and any(t.is_alive() for t in self._threads)):
+            done = threading.Event()
+            with self._cond:
+                self._drain_deadline = time.monotonic() + float(
+                    drain_timeout)
+                self._drain_done = done
+                self._check_drain_locked()   # maybe already drained
+            # cushion past the deadline: after the loop cancels the
+            # stragglers, their handlers still need a moment to write
+            # terminal lines (a stalled client must not wedge stop)
+            done.wait(timeout=float(drain_timeout) + 10)
         self._stop.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -203,6 +356,48 @@ class ServingServer:
         self.stop()
 
     # ------------------------------------------------------------- engine
+    def _result_info(self, rid: int) -> Optional[Dict]:
+        """Fetch a finished request's outcome dict. The server is
+        engine-agnostic: engines without deadline support (SSMEngine)
+        only expose ``result()``, so their outputs are wrapped in a
+        plain non-timeout outcome."""
+        fn = getattr(self.engine, "result_info", None)
+        if fn is not None:
+            return fn(rid)
+        out = self.engine.result(rid)
+        if out is None:
+            return None
+        return {"tokens": out, "timeout": False, "expired": False}
+
+    def _check_drain_locked(self):
+        """Drain enforcement, run by whichever thread holds the lock
+        (normally the engine loop, once per iteration): past the drain
+        deadline every still-tracked request is cancelled, and the
+        drain completes — waking :meth:`stop` — once no handler owes a
+        client a response (``_tracked``: compute owed; ``_streams`` /
+        ``_waiters``: a handler mid-reply)."""
+        done = self._drain_done
+        if done is None:
+            return
+        if self._failure is not None:
+            # dead engine loop: nothing will ever finish — stop() must
+            # not sit out its cushion (covers the race where the loop
+            # died between stop()'s failure check and arming the event)
+            self._drain_done = None
+            done.set()
+            return
+        if (self._drain_deadline is not None
+                and time.monotonic() >= self._drain_deadline
+                and self._tracked):
+            for rid in list(self._tracked):
+                if self.engine.cancel(rid):
+                    self._n_drained += 1
+            self._tracked.clear()
+            self._cond.notify_all()
+        if not (self._tracked or self._streams or self._waiters):
+            self._drain_done = None
+            done.set()
+
     def _engine_loop(self):
         """The single driver of the device program: steps whenever work
         is pending, harvests finished requests, wakes blocked waiters.
@@ -211,6 +406,7 @@ class ServingServer:
         failed, and all blocked handlers are woken — a dead engine must
         answer errors, not hang its clients."""
         try:
+            first_pass_done = False
             while not self._stop.is_set():
                 with self._cond:
                     emitted = {}
@@ -223,7 +419,7 @@ class ServingServer:
                         self._cond.notify_all()
                     finished = []
                     for rid in list(self._tracked):
-                        out = self.engine.result(rid)
+                        out = self._result_info(rid)
                         if out is not None:
                             self._results[rid] = out
                             finished.append(rid)
@@ -241,13 +437,35 @@ class ServingServer:
                                 break
                             self._results.pop(victim)
                         self._cond.notify_all()
+                    self._check_drain_locked()
                     idle = not self.engine.pending
+                if not first_pass_done:
+                    # ready only after a FULL first iteration — a loop
+                    # whose very first step will crash must never show
+                    # a 200 /ready window before it does
+                    first_pass_done = True
+                    self._ready = True
                 if idle:
                     time.sleep(_IDLE_SLEEP)
+                else:
+                    # fairness yield: this loop holds the serving lock
+                    # for the whole of every step, re-acquiring it
+                    # microseconds after release — without an explicit
+                    # scheduler yield, handler threads (submit, cancel,
+                    # /stats) can starve on the lock for SECONDS while
+                    # the batch is busy (observed: a 2s submit under a
+                    # 50ms-step fault plan). sleep(0) parks this thread
+                    # just long enough for a waiting acquirer to win.
+                    time.sleep(0)
         except Exception as exc:  # noqa: BLE001 — record ANY engine death
             with self._cond:
                 self._failure = f"{type(exc).__name__}: {exc}"
                 self._tracked.clear()
+                if self._drain_done is not None:
+                    # a draining stop() must not wait out its cushion on
+                    # a loop that can no longer finish anything
+                    self._drain_done.set()
+                    self._drain_done = None
                 self._cond.notify_all()
 
     def _prompt_ids(self, body: Dict):
@@ -267,16 +485,36 @@ class ServingServer:
         for field in ("temperature", "top_k", "top_p"):
             if body.get(field) is not None:
                 kwargs[field] = body[field]
+        if body.get("deadline_ms") is not None:
+            if not self._engine_has_deadline:
+                # never drop a requested deadline silently
+                raise ValueError("this engine does not support "
+                                 "per-request deadlines")
+            kwargs["deadline_ms"] = float(body["deadline_ms"])
+        elif (self.default_deadline_ms is not None
+                and self._engine_has_deadline):
+            kwargs["deadline_ms"] = self.default_deadline_ms
         with self._cond:
+            if self._draining or self._stop.is_set():
+                raise _HTTPError(503, {"error": "server is draining; "
+                                                "not accepting new work",
+                                       "draining": True})
             if self._failure is not None:
                 raise ValueError(f"engine failed: {self._failure}")
             # admit=False: admission (and any prefill compile a new
             # prompt length triggers) happens in the engine loop's next
             # step, never while this handler holds the server-wide lock
-            rid = self.engine.submit(
-                ids, int(body.get("max_new_tokens",
-                                  self.default_max_new_tokens)),
-                admit=False, **kwargs)
+            try:
+                rid = self.engine.submit(
+                    ids, int(body.get("max_new_tokens",
+                                      self.default_max_new_tokens)),
+                    admit=False, **kwargs)
+            except QueueFullError as exc:
+                # overload answers NOW, with a backoff hint — the whole
+                # point of admission control is never to queue forever
+                raise _HTTPError(429, {
+                    "error": str(exc),
+                    "retry_after_ms": exc.retry_after_ms})
             self._tracked.add(rid)
             if stream:
                 # registered under the SAME lock as submit, so the very
@@ -308,14 +546,19 @@ class ServingServer:
                     toks = self._streams.get(rid) or []
                     if toks:
                         self._streams[rid] = []
-                    done = rid in self._results
-                    if done:
-                        self._results.pop(rid)  # consumed via the feed
-                    gone = not done and rid not in self._tracked
+                    info = self._results.pop(rid, None)  # fed via stream
+                    gone = info is None and rid not in self._tracked
                 if toks:
                     write_line({"tokens": toks})
-                if done:
-                    write_line({"status": "done"})
+                if info is not None:
+                    if info.get("expired"):
+                        write_line({"status": "expired"})
+                    elif info.get("timeout"):
+                        # partial output: what was streamed is what the
+                        # deadline allowed
+                        write_line({"status": "done", "timeout": True})
+                    else:
+                        write_line({"status": "done"})
                     return
                 if stopping or (gone and not toks):
                     # lock-free like /health: the terminal status must
@@ -331,6 +574,10 @@ class ServingServer:
         finally:
             with self._cond:
                 self._streams.pop(rid, None)
+                # complete a waiting drain even if the engine loop (its
+                # usual driver) is already dead
+                self._check_drain_locked()
+                self._cond.notify_all()   # a draining stop() waits on this
 
     def _abort_stream(self, rid: int):
         """Server-side teardown for a stream whose client went away:
@@ -342,10 +589,21 @@ class ServingServer:
             self._streams.pop(rid, None)
             self._cond.notify_all()
 
-    def _finish_payload(self, tokens: list) -> Dict:
-        out = {"status": "done", "tokens": tokens}
+    def _finish_payload(self, info: Dict) -> Dict:
+        """Response body for a finished request. A mid-decode deadline
+        is still a 200 — the client gets the partial tokens plus
+        ``"timeout": true``; an expired-in-queue request instead raises
+        the 504 (no work was ever done for it)."""
+        if info.get("expired"):
+            raise _HTTPError(504, {
+                "status": "expired",
+                "error": "deadline expired before the request reached "
+                         "prefill (shed from the queue)"})
+        out = {"status": "done", "tokens": info["tokens"]}
+        if info.get("timeout"):
+            out["timeout"] = True
         if self.tokenizer is not None:
-            out["text"] = self.tokenizer.decode(tokens)
+            out["text"] = self.tokenizer.decode(info["tokens"])
         return out
 
     def _generate(self, body: Dict) -> Dict:
@@ -361,6 +619,7 @@ class ServingServer:
                         raise ValueError("server shutting down")
             finally:
                 self._waiters.discard(rid)
+                self._check_drain_locked()   # see _run_stream's finally
             if rid in self._results:
                 return self._finish_payload(self._results.pop(rid))
             if self._failure is not None:
@@ -377,7 +636,13 @@ class ServingServer:
             if self._failure is not None:
                 return {"status": "error",
                         "error": f"engine failed: {self._failure}"}
-            return {"status": "unknown"}
+            # unknown, never issued, or already fetched (results are
+            # one-shot): a real 404, not a 200 the client must parse
+            raise _HTTPError(404, {
+                "status": "unknown",
+                "error": f"no such request id {rid} (never issued, "
+                         "cancelled, or its result was already "
+                         "fetched)"})
 
     def _cancel(self, body: Dict) -> Dict:
         rid = int(body.get("id", -1))

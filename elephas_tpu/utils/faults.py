@@ -27,6 +27,18 @@ Instrumented sites (the stable names tests target):
                                  delta silently discarded)
 ``worker.train``                 async worker entry, once per (re)start
 ``worker.epoch``                 each async worker local-epoch boundary
+``serving.submit``               each ``DecodeEngine.submit`` admission
+                                 attempt (``drop`` = deterministic shed:
+                                 rejected as if the queue were full, the
+                                 HTTP layer's 429)
+``serving.step``                 each ``DecodeEngine.step`` device round
+                                 trip (``delay`` = slow step, ``error`` =
+                                 engine crash: the serving loop records it
+                                 and ``/health`` turns red)
+``serving.stream_write``         each streamed response line before its
+                                 socket write (``drop`` = line lost on the
+                                 wire, ``error`` = mid-stream client
+                                 disconnect: the server aborts the request)
 ================================ ==============================================
 
 With no plan installed :func:`fault_site` is a near-free attribute check.

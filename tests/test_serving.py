@@ -113,3 +113,39 @@ def test_text_generator_stop_sequences():
     # empty stop strings are ignored, never blank the output
     assert gen(["abcabc"], max_new_tokens=12,
                stop_sequences=[""])[0] == base
+
+
+def test_text_generator_admission_bounds_and_deadline():
+    """Blocking-path overload safety: oversized batches raise
+    QueueFullError at admission (with a suggested split), an
+    already-expired deadline refuses to dispatch, and in-bounds calls
+    are unaffected."""
+    from elephas_tpu.serving_engine import (DeadlineExceededError,
+                                            QueueFullError)
+
+    params, config, tok = _trained_lm()
+    with pytest.raises(ValueError, match="max_batch_prompts"):
+        TextGenerator(params, config, tok, max_batch_prompts=0)
+    with pytest.raises(ValueError, match="max_batch_tokens"):
+        TextGenerator(params, config, tok, max_batch_tokens=-1)
+    gen = TextGenerator(params, config, tok, max_batch_prompts=2,
+                        max_batch_tokens=10)
+    with pytest.raises(QueueFullError, match="max_batch_prompts"):
+        gen(["a", "b", "c"], max_new_tokens=2)
+    with pytest.raises(QueueFullError, match="max_batch_tokens"):
+        gen(["abcdefgh", "abcdefgh"], max_new_tokens=2)   # 16 > 10 tokens
+    # a SINGLE prompt over the token bound can never be dispatched by
+    # splitting — permanent ValueError, not a retryable shed
+    with pytest.raises(ValueError, match="never be dispatched"):
+        gen(["abcdefghijkl"], max_new_tokens=2)           # 12 > 10 alone
+    # within bounds: identical to an unbounded generator's output
+    free = TextGenerator(params, config, tok)
+    assert (gen(["abc", "ab"], max_new_tokens=4)
+            == free(["abc", "ab"], max_new_tokens=4))
+    with pytest.raises(ValueError, match="deadline_ms"):
+        gen(["abc"], max_new_tokens=2, deadline_ms=0)
+    # an effectively-unmeetable deadline refuses at admission; a
+    # generous one dispatches normally
+    with pytest.raises(DeadlineExceededError):
+        gen(["abc"], max_new_tokens=2, deadline_ms=1e-9)
+    assert gen(["abc"], max_new_tokens=2, deadline_ms=600000)

@@ -79,10 +79,13 @@ def test_submit_poll_and_cancel(model):
     with ServingServer(DecodeEngine(params, config, max_slots=1)) as srv:
         p1 = [int(t) for t in rng.integers(0, 300, 5)]
         p2 = [int(t) for t in rng.integers(0, 300, 6)]
+        # r2 gets a wide budget: even if r1 finishes and r2 is admitted
+        # before the cancel below lands (a stall of THIS thread), r2
+        # cannot have completed — the cancel still finds it live
         r1 = _post(srv.port, "/v1/submit",
                    {"prompt": p1, "max_new_tokens": 6})["id"]
         r2 = _post(srv.port, "/v1/submit",
-                   {"prompt": p2, "max_new_tokens": 6})["id"]
+                   {"prompt": p2, "max_new_tokens": 40})["id"]
         # r2 queues behind the single slot; cancel it before admission
         assert _post(srv.port, "/v1/cancel", {"id": r2})["cancelled"]
         while True:
@@ -90,9 +93,13 @@ def test_submit_poll_and_cancel(model):
             if out["status"] == "done":
                 break
         assert out["tokens"] == _ref(params, config, p1, 6)
-        # one-shot semantics after fetch; cancelled rid is unknown
-        assert _get(srv.port, f"/v1/result?id={r1}")["status"] == "unknown"
-        assert _get(srv.port, f"/v1/result?id={r2}")["status"] == "unknown"
+        # one-shot semantics after fetch; cancelled rid is unknown — and
+        # an unknown id is a real 404, not a 200 payload
+        for rid in (r1, r2):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(srv.port, f"/v1/result?id={rid}")
+            assert exc.value.code == 404
+            assert json.loads(exc.value.read())["status"] == "unknown"
 
 
 def test_text_mode_round_trip(model):
@@ -191,8 +198,11 @@ def test_streaming_generate(model):
         assert len(token_lines) >= 2          # incremental, not one blob
         streamed = [t for chunk in token_lines for t in chunk]
         assert streamed == _ref(params, config, prompt, 10)
-        # streamed requests never linger in the poll store
-        assert _get(srv.port, f"/v1/result?id=0")["status"] == "unknown"
+        # streamed requests never linger in the poll store (404: the
+        # result was consumed through the stream)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.port, "/v1/result?id=0")
+        assert exc.value.code == 404
 
 
 def test_streaming_cancel_terminates(model):

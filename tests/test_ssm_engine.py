@@ -41,11 +41,16 @@ def test_multi_step_and_eos(model):
     rng = np.random.default_rng(61)
     prompt = rng.integers(0, 64, 6)
     full = _ref(params, config, prompt, 12)
-    eos = full[4]
+    # eos at a token's FIRST occurrence (a fixed full[k] silently
+    # breaks when that token also appears earlier in the decode —
+    # which depends on the machine's numerics)
+    cut = next(i for i, t in enumerate(full) if i >= 1
+               and t not in full[:i])
+    eos = full[cut]
     eng = SSMEngine(params, config, max_slots=2, steps_per_sync=3,
                     eos_id=eos)
     [out] = eng.run([prompt], max_new_tokens=12)
-    assert out == full[:4]
+    assert out == full[:cut]
     # slot freed mid-chunk serves the next request exactly
     p2 = rng.integers(0, 64, 4)
     [out2] = eng.run([p2], max_new_tokens=5)
@@ -92,6 +97,42 @@ def test_http_server_composes(model):
             headers={"Content-Type": "application/json"})
         out = json.loads(urllib.request.urlopen(req, timeout=60).read())
         assert out["tokens"] == _ref(params, config, prompt, 7)
+
+
+def test_http_server_default_deadline_skipped_for_ssm(model):
+    """A server-wide default_deadline_ms must not poison every request
+    against an engine without deadline support — the default is skipped
+    (SSMEngine serves normally) while a client's EXPLICIT deadline
+    fails loudly instead of being silently dropped."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from elephas_tpu.serving_http import ServingServer
+
+    params, config = model
+    rng = np.random.default_rng(64)
+    prompt = [int(t) for t in rng.integers(0, 64, 5)]
+    with ServingServer(SSMEngine(params, config, max_slots=2),
+                       default_deadline_ms=60000) as srv:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            data=json.dumps({"prompt": prompt,
+                             "max_new_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert out["tokens"] == _ref(params, config, prompt, 5)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            data=json.dumps({"prompt": prompt, "max_new_tokens": 5,
+                             "deadline_ms": 100}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError("explicit deadline silently dropped")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+            assert "deadline" in json.loads(err.read())["error"]
 
 
 def test_per_request_sampling_and_chunked_prefill(model):

@@ -1,5 +1,6 @@
 import json
 import numpy as np
+import pytest
 
 from elephas_tpu.utils.checkpoint import CheckpointManager
 
@@ -302,6 +303,59 @@ def test_out_of_order_write_cannot_regress_latest(tmp_path):
     assert mgr.latest_step() == 5
     assert mgr.steps() == [3, 5]
     np.testing.assert_array_equal(mgr.restore()["w"], state5["w"])
+
+
+def test_check_error_reraises_background_writer_failure(tmp_path):
+    """A failed ASYNC save must not vanish: the next save() re-raises
+    it (via check_error), the failure is consumed exactly once, and
+    later saves proceed cleanly."""
+    import time
+
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    orig_write = manager._write
+
+    def failing_write(*args, **kwargs):
+        raise RuntimeError("disk full (injected)")
+
+    manager._write = failing_write
+    manager.save(1, _state(1.0), block=False)
+    # wait for the background future to complete (with its failure)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with manager._pending_lock:
+            if manager._pending and all(f.done()
+                                        for f in manager._pending):
+                break
+        time.sleep(0.01)
+    manager._write = orig_write
+    with pytest.raises(RuntimeError, match="disk full"):
+        manager.save(2, _state(2.0), block=False)   # check_error path
+    # consumed once: the next save is clean and the manager still works
+    manager.save(3, _state(3.0), block=False)
+    manager.wait_until_finished()
+    assert manager.latest_step() == 3
+    np.testing.assert_allclose(manager.restore()["step_scalar"], 3.0)
+
+
+def test_wait_until_finished_reraises_background_writer_failure(tmp_path):
+    """wait_until_finished() flushes every queued async write and then
+    re-raises the first failure — a blocking save() (which flushes
+    first) surfaces it the same way instead of swallowing it."""
+    manager = CheckpointManager(str(tmp_path / "ckpt"))
+    orig_write = manager._write
+
+    def failing_write(*args, **kwargs):
+        raise RuntimeError("writer exploded (injected)")
+
+    manager._write = failing_write
+    manager.save(1, _state(1.0), block=False)
+    manager._write = orig_write
+    with pytest.raises(RuntimeError, match="writer exploded"):
+        manager.wait_until_finished()
+    # the flush completed despite the failure: nothing is stranded and
+    # a subsequent blocking save lands normally
+    manager.save(2, _state(2.0))
+    assert manager.latest_step() == 2
 
 
 def test_rollback_save_moves_latest_backwards(tmp_path):
