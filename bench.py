@@ -259,6 +259,17 @@ def run_row_child(argv):
         out = row_tx(argv[1], chunk=chunk, batch=32, steps=10)
     else:
         raise SystemExit(f"unknown row {name!r}")
+    # attach the process registry snapshot: the training-step histogram
+    # (StepTimer publishes into it) rides along with the scalar, so the
+    # BENCH record carries latency DISTRIBUTIONS, not just throughput
+    from elephas_tpu.obs import default_registry
+
+    metrics = {name: fam for name, fam in default_registry()
+               .snapshot().items()
+               if any(s.get("count") or s.get("value")
+                      for s in fam["series"])}
+    if metrics:
+        out["metrics"] = metrics
     print(json.dumps(out))
 
 
@@ -432,6 +443,15 @@ def _merge(rows: dict):
         t["b32_mfu"] = b32["mfu"]
     if t:
         result["transformer"] = t
+    # per-row registry snapshots (step-latency histograms etc.) under
+    # one "metrics" key, so future perf trajectories can diff
+    # distributions across rounds
+    snaps = {name: rows[name]["result"]["metrics"] for name in rows
+             if isinstance(rows[name]["result"], dict)
+             and rows[name]["result"].get("metrics")}
+    result.pop("metrics", None)   # the headline row's copy moves under its name
+    if snaps:
+        result["metrics"] = snaps
     result["rows"] = {name: rows[name]["at"] for name in rows}
     return result
 

@@ -7,9 +7,16 @@ MNIST-MLP weight payload (~470 KB: 784-128-128-10). One "round" is the
 batch-frequency worker's wire work per batch: one ``get_parameters`` +
 one ``update_parameters``.
 
+Per-RPC percentiles come from the observability layer's
+``ps_client_rpc_latency_seconds`` histogram (each client gets its own
+injected registry, so the A and B sides cannot pollute each other) —
+bench numbers and production ``/metrics`` latency come from the SAME
+instrumented code path in ``BaseParameterClient._with_retry``, not a
+hand-rolled timing list.
+
 Prints one JSON line:
   {"metric": "ps_rpc_rounds_per_sec", "value": P, "fresh": F,
-   "speedup": P/F, ...}
+   "speedup": P/F, "latency_ms": {...}, ...}
 """
 import json
 import sys
@@ -18,6 +25,7 @@ import time
 import numpy as np
 
 from elephas_tpu.models import SGD, Activation, Dense, Sequential
+from elephas_tpu.obs import MetricsRegistry
 from elephas_tpu.parameter.client import SocketClient
 from elephas_tpu.parameter.server import SocketServer
 from elephas_tpu.utils.serialization import model_to_dict
@@ -33,7 +41,22 @@ def _server(port: int) -> SocketServer:
     return server
 
 
-def _measure(client: SocketClient, rounds: int) -> float:
+def _rpc_quantiles_ms(registry: MetricsRegistry) -> dict:
+    """p50/p99 per op from the client's RPC latency histogram — the
+    series ``_with_retry`` populates on every successful attempt."""
+    fam = registry.get("ps_client_rpc_latency_seconds")
+    out = {}
+    if fam is None:
+        return out
+    for (op,), hist in sorted(fam.series().items()):
+        p50, p99 = hist.quantile(0.5), hist.quantile(0.99)
+        if p50 is not None:
+            out[op] = {"p50": round(p50 * 1000, 3),
+                       "p99": round(p99 * 1000, 3)}
+    return out
+
+
+def _measure(client: SocketClient, rounds: int):
     weights = client.get_parameters()  # warm (and the delta template)
     delta = [np.zeros_like(w) for w in weights]
     start = time.perf_counter()
@@ -41,22 +64,26 @@ def _measure(client: SocketClient, rounds: int) -> float:
         client.get_parameters()
         client.update_parameters(delta)
     elapsed = time.perf_counter() - start
-    return rounds / elapsed
+    return rounds / elapsed, _rpc_quantiles_ms(client.registry)
 
 
 def main(port: int = 27311, rounds: int = 200):
     server = _server(port)
     try:
-        client_p = SocketClient(port=port, persistent=True)
-        persistent = _measure(client_p, rounds)
+        client_p = SocketClient(port=port, persistent=True,
+                                registry=MetricsRegistry())
+        persistent, lat_p = _measure(client_p, rounds)
         client_p.close()   # the A side must not linger into the B run
-        fresh = _measure(SocketClient(port=port, persistent=False), rounds)
+        fresh, lat_f = _measure(
+            SocketClient(port=port, persistent=False,
+                         registry=MetricsRegistry()), rounds)
     finally:
         server.stop()
     out = {"metric": "ps_rpc_rounds_per_sec", "value": round(persistent, 1),
            "unit": "rounds/sec (get+update, MNIST-MLP weights)",
            "fresh": round(fresh, 1),
            "speedup": round(persistent / fresh, 3),
+           "latency_ms": lat_p, "fresh_latency_ms": lat_f,
            "rounds": rounds, "transport": "socket loopback (host-side)"}
     print(json.dumps(out))
     return out

@@ -123,6 +123,12 @@ PAGES = [
     ("Serving", "elephas_tpu.serving", ["TextGenerator"]),
     ("Tracing", "elephas_tpu.utils.tracing",
      ["StepTimer", "profiler_trace", "annotate"]),
+    ("Observability metrics API", "elephas_tpu.obs.metrics",
+     ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+      "default_registry", "percentile"]),
+    ("Trace spans API", "elephas_tpu.obs.trace",
+     ["span", "span_if_counted", "record_span", "recent_slow_spans",
+      "clear_slow_spans", "set_slow_span_threshold"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
      ["encode_tensors", "decode_tensors", "encode", "decode"]),
     ("Delta compression", "elephas_tpu.utils.delta_compression",
@@ -187,7 +193,8 @@ def main(out_dir: str = None):
               "  - Scaling guide: scaling-guide.md",
               "  - Serving guide: serving-guide.md",
               "  - Serving operations: serving-operations.md",
-              "  - Fault tolerance: fault-tolerance.md"]
+              "  - Fault tolerance: fault-tolerance.md",
+              "  - Observability: observability.md"]
     mkdocs += [f"  - {title}: {page}" for title, page in nav]
     (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")
     index = ROOT / "README.md"

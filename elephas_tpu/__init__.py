@@ -20,7 +20,7 @@ if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
 
     _mh_init()
 
-from . import models, utils
+from . import models, obs, utils
 from .data import Dataset
 from .serving import TextGenerator
 from .serving_engine import (DeadlineExceededError, DecodeEngine,

@@ -1,0 +1,15 @@
+"""Unified observability: metrics registry, Prometheus exposition, and
+trace spans (see :mod:`.metrics` and :mod:`.trace`; the metric catalog
+lives in ``docs/sources/observability.md``)."""
+from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS, Counter, Gauge,
+                      Histogram, MetricsRegistry, default_registry,
+                      percentile)
+from .trace import (RING_SIZE, SPAN_METRIC, clear_slow_spans,
+                    recent_slow_spans, record_span,
+                    set_slow_span_threshold, span, span_if_counted)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_registry", "percentile", "DEFAULT_BUCKETS",
+           "MAX_LABEL_SETS", "span", "span_if_counted", "record_span",
+           "recent_slow_spans", "clear_slow_spans",
+           "set_slow_span_threshold", "SPAN_METRIC", "RING_SIZE"]

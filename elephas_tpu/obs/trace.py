@@ -1,0 +1,123 @@
+"""Lightweight trace spans over the metrics registry.
+
+``span("serving.step")`` wraps a block, records its wall time into a
+duration histogram (``trace_span_duration_seconds{span="serving.step"}``
+by default, or any explicit :class:`~.metrics.Histogram` handle — the
+serving engines pass their own step-latency histograms so span timing
+and the scraped histogram are one measurement, not two), and appends
+spans slower than a threshold to a bounded in-memory ring buffer.
+``recent_slow_spans()`` is the post-incident question "what was slow
+just now?" answered without a tracing backend: the last
+:data:`RING_SIZE` offenders with names, durations, and attributes.
+
+Not a distributed tracer — no context propagation, no ids. It is the
+5% of tracing that pays for itself in a single-process serving or
+training job.
+"""
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["span", "span_if_counted", "record_span", "recent_slow_spans",
+           "clear_slow_spans", "set_slow_span_threshold",
+           "SPAN_METRIC", "RING_SIZE"]
+
+#: histogram family that unnamed-destination spans record into
+SPAN_METRIC = "trace_span_duration_seconds"
+
+#: bounded slow-span ring: oldest entries fall off
+RING_SIZE = 256
+
+_ring_lock = threading.Lock()
+_ring: deque = deque(maxlen=RING_SIZE)
+_slow_threshold_s = 0.1
+
+
+def set_slow_span_threshold(seconds: float) -> None:
+    """Process-wide default for "slow enough to remember" (0 records
+    every span — useful in tests)."""
+    global _slow_threshold_s
+    if seconds < 0:
+        raise ValueError(f"threshold must be >= 0, got {seconds}")
+    _slow_threshold_s = float(seconds)
+
+
+def recent_slow_spans(name: Optional[str] = None) -> List[Dict]:
+    """Newest-last slow-span records ``{"span", "duration_s", "at",
+    ...attrs}``, optionally filtered by span name."""
+    with _ring_lock:
+        items = list(_ring)
+    return [s for s in items if name is None or s["span"] == name]
+
+
+def clear_slow_spans() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+def record_span(name: str, duration_s: float, histogram=None,
+                registry: Optional[MetricsRegistry] = None,
+                threshold_s: Optional[float] = None, **attrs) -> None:
+    """Record one already-measured span: observe the duration histogram
+    and remember it in the slow ring if it crossed the threshold. The
+    building block :func:`span` wraps; call it directly where the
+    timing already exists (the engines time steps themselves)."""
+    if histogram is None:
+        reg = registry if registry is not None else default_registry()
+        histogram = reg.histogram(
+            SPAN_METRIC, "trace span durations",
+            labels=("span",)).labels(span=name)
+    histogram.observe(duration_s)
+    thr = _slow_threshold_s if threshold_s is None else float(threshold_s)
+    if duration_s >= thr:
+        entry = {"span": name, "duration_s": float(duration_s),
+                 "at": time.time()}
+        entry.update(attrs)
+        with _ring_lock:
+            _ring.append(entry)
+
+
+@contextlib.contextmanager
+def span(name: str, histogram=None,
+         registry: Optional[MetricsRegistry] = None,
+         threshold_s: Optional[float] = None, **attrs):
+    """Time the wrapped block as a named span. Records even when the
+    block raises (a failing step is exactly the one you want on the
+    slow ring)."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_span(name, time.perf_counter() - start,
+                    histogram=histogram, registry=registry,
+                    threshold_s=threshold_s, **attrs)
+
+
+@contextlib.contextmanager
+def span_if_counted(name: str, counter, histogram=None,
+                    registry: Optional[MetricsRegistry] = None,
+                    threshold_s: Optional[float] = None, **attrs):
+    """Like :func:`span`, but record only if ``counter`` advanced while
+    the block ran — OR the block raised. The serving engines wrap
+    ``step()`` with this so only device round trips land in the
+    step-latency histogram (an idle step must not pollute the
+    distribution with microsecond samples), while a step that died
+    mid-flight — the one an operator most needs to see — always lands
+    on the record."""
+    before = counter.value
+    start = time.perf_counter()
+    failed = False
+    try:
+        yield
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        if failed or counter.value != before:
+            record_span(name, time.perf_counter() - start,
+                        histogram=histogram, registry=registry,
+                        threshold_s=threshold_s, **attrs)

@@ -31,6 +31,8 @@ import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..obs.metrics import default_registry
+
 _LOG = logging.getLogger(__name__)
 
 POLICIES = ("reassign", "fail", "continue")
@@ -148,6 +150,28 @@ class WorkerSupervisor:
         self._stop_monitor = threading.Event()
         self._outstanding = 0
         self._fatal: Optional[BaseException] = None
+        # process-wide counters mirroring the per-fit report: the report
+        # answers "what did THIS fit survive", the registry answers "how
+        # often does this fleet member restart things" across fits
+        reg = default_registry()
+        self._m_failures = reg.counter(
+            "supervisor_worker_failures_total",
+            "worker failures observed by the supervisor")
+        self._m_restarts = reg.counter(
+            "supervisor_restarts_total",
+            "shard re-executions after a worker failure")
+        self._m_reassigned = reg.counter(
+            "supervisor_shards_reassigned_total",
+            "shard re-queues onto surviving slots")
+        self._m_lost = reg.counter(
+            "supervisor_shards_lost_total",
+            "shards dropped under the 'continue' policy")
+        self._m_completed = reg.counter(
+            "supervisor_shards_completed_total",
+            "shards that finished successfully")
+        self._m_ps_restarts = reg.counter(
+            "supervisor_ps_restarts_total",
+            "parameter-server snapshot restarts performed")
 
     # ------------------------------------------------------------------ run
     def run(self, shards: Sequence) -> SupervisorReport:
@@ -208,6 +232,7 @@ class WorkerSupervisor:
             else:
                 with self._lock:
                     self.report.completed_shards.append(idx)
+                self._m_completed.inc()
                 self._finish_item()
 
     def _finish_item(self):
@@ -220,6 +245,7 @@ class WorkerSupervisor:
     def _on_failure(self, idx: int, shard, attempt: int,
                     err: BaseException):
         _LOG.warning("shard %d failed on attempt %d: %r", idx, attempt, err)
+        self._m_failures.inc()
         with self._lock:
             self.report.failures.append((idx, attempt, repr(err)))
 
@@ -232,6 +258,8 @@ class WorkerSupervisor:
             with self._lock:
                 self.report.restarts += 1
                 self.report.reassigned_shards.append(idx)
+            self._m_restarts.inc()
+            self._m_reassigned.inc()
             self._queue.put((idx, shard, attempt))
             return
 
@@ -250,6 +278,8 @@ class WorkerSupervisor:
                 with self._lock:
                     self.report.restarts += 1
                     self.report.reassigned_shards.append(idx)
+                self._m_restarts.inc()
+                self._m_reassigned.inc()
                 self._queue.put((idx, shard, attempt + 1))
             else:
                 _LOG.error("shard %d exhausted its %d restart(s)",
@@ -258,6 +288,7 @@ class WorkerSupervisor:
         else:  # continue: drop the shard, quorum checked at the end
             with self._lock:
                 self.report.lost_shards.append(idx)
+            self._m_lost.inc()
             self._finish_item()
 
     def _trip_fatal(self, err: BaseException):
@@ -338,6 +369,7 @@ class WorkerSupervisor:
                 self._ps_restart_time = _time.monotonic()
                 with self._lock:
                     self.report.ps_restarts += 1
+                self._m_ps_restarts.inc()
                 _LOG.warning("parameter server restarted from snapshot%s",
                              context)
                 return True

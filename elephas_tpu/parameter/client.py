@@ -16,6 +16,7 @@ from typing import List
 
 import numpy as np
 
+from ..obs.metrics import default_registry
 from ..utils.delta_compression import quantize_delta
 from ..utils.faults import InjectedFault, fault_site
 from ..utils.sockets import determine_master, receive, send
@@ -39,6 +40,12 @@ class BaseParameterClient(abc.ABC):
 
     client_type = "base"
 
+    #: metrics destination for the retry loop — ``None`` (subclasses may
+    #: set an injectable :class:`~elephas_tpu.obs.MetricsRegistry`; the
+    #: process default registry is used otherwise, so in-memory test
+    #: doubles that never call a transport __init__ still record)
+    registry = None
+
     @classmethod
     def get_client(cls, client_type: str, port: int = 4000) -> "BaseParameterClient":
         try:
@@ -56,12 +63,20 @@ class BaseParameterClient(abc.ABC):
 
         Updates carry idempotency ids (stable across resends), so the
         server skips a delta whose first application's ack was lost.
+
+        Every successful attempt's wall time lands in the
+        ``ps_client_rpc_latency_seconds{op=...}`` histogram (the SAME
+        series ``benchmarks/ps_rpc_bench.py`` reports percentiles from),
+        retries in ``ps_client_rpc_retries_total`` and exhausted calls
+        in ``ps_client_rpc_failures_total``.
         """
+        latency, retries, failures = self._rpc_metrics(describe)
         deadline = time.monotonic() + (
             self.deadline if self.deadline is not None else 2 * self.timeout)
         for attempt in range(self.max_retries + 1):
+            t0 = time.perf_counter()
             try:
-                return op()
+                result = op()
             except _TRANSIENT as err:
                 # 4xx means a protocol/caller bug, not a flaky network
                 if (isinstance(err, urllib.error.HTTPError)
@@ -70,10 +85,44 @@ class BaseParameterClient(abc.ABC):
                 pause = self.backoff * (2 ** attempt)
                 if (attempt == self.max_retries
                         or time.monotonic() + pause > deadline):
+                    failures.inc()
                     raise ConnectionError(
                         f"{describe} failed after {attempt + 1} attempt(s): "
                         f"{err}") from err
+                retries.inc()
                 time.sleep(pause)
+            else:
+                latency.observe(time.perf_counter() - t0)
+                return result
+
+    def _rpc_metrics(self, describe: str):
+        """(latency histogram, retries counter, failures counter)
+        children for one op — resolved once and cached on the instance,
+        keeping the per-RPC hot path to plain attribute reads (test
+        doubles that never ran a transport ``__init__`` still work:
+        the cache dict is created lazily)."""
+        cache = getattr(self, "_rpc_metric_cache", None)
+        if cache is None:
+            cache = {}
+            self._rpc_metric_cache = cache
+        handles = cache.get(describe)
+        if handles is None:
+            reg = self.registry if self.registry is not None \
+                else default_registry()
+            handles = cache[describe] = (
+                reg.histogram(
+                    "ps_client_rpc_latency_seconds",
+                    "successful PS client RPC attempt latency",
+                    labels=("op",)).labels(op=describe),
+                reg.counter(
+                    "ps_client_rpc_retries_total",
+                    "transient-failure retries in the PS client",
+                    labels=("op",)).labels(op=describe),
+                reg.counter(
+                    "ps_client_rpc_failures_total",
+                    "PS client calls that exhausted their retries",
+                    labels=("op",)).labels(op=describe))
+        return handles
 
     @staticmethod
     def _check_compression(compression):
@@ -133,7 +182,8 @@ class HttpClient(BaseParameterClient):
 
     def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
                  max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
-                 deadline: float = None, compression: str = None):
+                 deadline: float = None, compression: str = None,
+                 registry=None):
         self.master_url = determine_master(port=port)
         self.headers = {"Content-Type": "application/elephas-tpu"}
         self.timeout = timeout
@@ -141,6 +191,7 @@ class HttpClient(BaseParameterClient):
         self.backoff = backoff
         self.deadline = deadline
         self.compression = self._check_compression(compression)
+        self.registry = registry
 
     def get_parameters(self) -> List[np.ndarray]:
         def op():
@@ -202,7 +253,7 @@ class SocketClient(BaseParameterClient):
     def __init__(self, port: int = 4000, timeout: float = DEFAULT_TIMEOUT,
                  max_retries: int = MAX_RETRIES, backoff: float = BACKOFF,
                  deadline: float = None, compression: str = None,
-                 persistent: bool = True):
+                 persistent: bool = True, registry=None):
         self.port = port
         self.timeout = timeout
         self.max_retries = max_retries
@@ -210,6 +261,7 @@ class SocketClient(BaseParameterClient):
         self.deadline = deadline
         self.compression = self._check_compression(compression)
         self.persistent = bool(persistent)
+        self.registry = registry
         self._sock_lock = threading.RLock()   # one RPC on the wire at a time
         self._persistent_sock: socket.socket = None
 
@@ -218,7 +270,8 @@ class SocketClient(BaseParameterClient):
                             max_retries=self.max_retries,
                             backoff=self.backoff, deadline=self.deadline,
                             compression=self.compression,
-                            persistent=self.persistent)
+                            persistent=self.persistent,
+                            registry=self.registry)
 
     def _connect(self, timeout=None) -> socket.socket:
         host = determine_master(port=self.port).split(":")[0]

@@ -31,6 +31,7 @@ import numpy as np
 
 _LOG = logging.getLogger(__name__)
 
+from ..obs.metrics import default_registry
 from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
@@ -80,6 +81,43 @@ class BaseParameterServer(abc.ABC):
         # waits on the latch instead of racing past the _seen_ids check
         # and double-applying the delta
         self._in_flight: Dict[str, threading.Event] = {}
+        # parameter-plane RPC metrics live in the PROCESS default
+        # registry (labeled by transport/op): every PS in the process
+        # pools into one scrape surface, exposed via the HTTP server's
+        # /metrics route
+        reg = default_registry()
+        self._m_rpc_latency = reg.histogram(
+            "ps_rpc_latency_seconds",
+            "parameter-server RPC service time (receive through reply)",
+            labels=("transport", "op"))
+        self._m_rpc_total = reg.counter(
+            "ps_rpc_total", "parameter-server RPCs served",
+            labels=("transport", "op", "status"))
+        self._m_rpc_bytes = reg.counter(
+            "ps_rpc_bytes_total",
+            "tensor payload bytes moved by PS RPCs",
+            labels=("transport", "direction"))
+        self._m_http_requests = reg.counter(
+            "ps_http_requests_total",
+            "PS HTTP requests by method, path, and status "
+            "(the log_message replacement)",
+            labels=("method", "path", "status"))
+
+    # ---------------------------------------------------------- metrics
+    def _obs_rpc(self, transport: str, op: str, status: str, t0: float,
+                 bytes_in: int = 0, bytes_out: int = 0):
+        """Record one served RPC (best-effort: dropped connections that
+        never reach a reply are not counted as RPCs)."""
+        self._m_rpc_latency.labels(transport=transport, op=op).observe(
+            time.perf_counter() - t0)
+        self._m_rpc_total.labels(transport=transport, op=op,
+                                 status=status).inc()
+        if bytes_in:
+            self._m_rpc_bytes.labels(transport=transport,
+                                     direction="in").inc(bytes_in)
+        if bytes_out:
+            self._m_rpc_bytes.labels(transport=transport,
+                                     direction="out").inc(bytes_out)
 
     def get_weights(self) -> List[np.ndarray]:
         fault_site("ps.get_weights")
@@ -216,10 +254,39 @@ class HttpServer(BaseParameterServer):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):  # silence request logging
+            def log_message(self, *args):
+                # quiet on stderr — requests are recorded as
+                # ps_http_requests_total{method,path,status} instead,
+                # so PS traffic is visible to a scrape, not a terminal
                 pass
 
+            def _route(self) -> str:
+                # bounded label domain: arbitrary probed paths must not
+                # mint new label sets
+                if self.path.rstrip("/") in ("", "/"):
+                    return "/"
+                for known in ("/health", "/metrics", "/parameters",
+                              "/update"):
+                    if self.path.startswith(known):
+                        return known
+                return "other"
+
+            def _record(self, status: int):
+                server._m_http_requests.labels(
+                    method=self.command, path=self._route(),
+                    status=str(status)).inc()
+
+            def _empty(self, status: int):
+                # explicit empty body: a status line with no
+                # Content-Length leaves clients to wait for EOF
+                self._record(status)
+                self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def do_GET(self):
+                t0 = time.perf_counter()
+                content_type = "application/elephas-tpu"
                 if self.path.rstrip("/") in ("", "/"):
                     body = b"elephas_tpu"
                 elif self.path.startswith("/health"):
@@ -229,38 +296,54 @@ class HttpServer(BaseParameterServer):
                     body = (b'{"status": "ok", "mode": "%s", '
                             b'"num_updates": %d}'
                             % (server.mode.encode(), server.num_updates))
+                elif self.path.startswith("/metrics"):
+                    # Prometheus exposition of the process default
+                    # registry: PS RPC counters, fault injections, and
+                    # any training telemetry co-resident in this process
+                    body = default_registry().render().encode()
+                    content_type = ("text/plain; version=0.0.4; "
+                                    "charset=utf-8")
                 elif self.path.startswith("/parameters"):
                     body = encode_weights(server.get_weights())
+                    server._obs_rpc("http", "get_weights", "ok", t0,
+                                    bytes_out=len(body))
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._empty(404)
                     return
+                # record BEFORE the body goes out, so a client that
+                # scrapes /metrics right after this response already
+                # sees its request counted
+                self._record(200)
                 self.send_response(200)
-                self.send_header("Content-Type", "application/elephas-tpu")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_POST(self):
+                t0 = time.perf_counter()
                 if not self.path.startswith("/update"):
-                    self.send_response(404)
-                    self.end_headers()
+                    self._empty(404)
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     delta = _decode_delta(self.rfile.read(length))
                 except Exception:  # malformed payload -> clean 400, not a 500
-                    self.send_response(400)
-                    self.end_headers()
+                    server._obs_rpc("http", "apply_delta", "bad_frame", t0)
+                    self._empty(400)
                     return
                 try:
                     server.apply_delta(
                         delta, update_id=self.headers.get("X-Update-Id"))
                 except ValueError as err:  # wrong arity/shapes -> 400
                     _LOG.warning("rejected delta: %s", err)
-                    self.send_response(400)
-                    self.end_headers()
+                    server._obs_rpc("http", "apply_delta", "rejected", t0,
+                                    bytes_in=length)
+                    self._empty(400)
                     return
+                server._obs_rpc("http", "apply_delta", "ok", t0,
+                                bytes_in=length)
+                self._record(200)    # before the reply, like do_GET
                 body = b"Update done"
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
@@ -395,6 +478,7 @@ class SocketServer(BaseParameterServer):
                     return
                 if not opcode:
                     return
+                t0 = time.perf_counter()
                 try:
                     if opcode in (b"u", b"U"):
                         update_id = None
@@ -407,6 +491,7 @@ class SocketServer(BaseParameterServer):
                                 raw += chunk
                             update_id = raw.decode("ascii", "replace")
                         arrays, kind = receive_frame(conn)
+                        nbytes_in = sum(int(a.nbytes) for a in arrays)
                         delta = (dequantize_delta(arrays)
                                  if kind == KIND_DELTA_Q8 else arrays)
                         try:
@@ -418,12 +503,22 @@ class SocketServer(BaseParameterServer):
                             # retrying a permanent error
                             _LOG.warning("rejected delta: %s", err)
                             conn.sendall(b"e")
+                            self._obs_rpc("socket", "apply_delta",
+                                          "rejected", t0,
+                                          bytes_in=nbytes_in)
                             continue
                         conn.sendall(b"k")  # ack: delta applied
+                        self._obs_rpc("socket", "apply_delta", "ok", t0,
+                                      bytes_in=nbytes_in)
                     elif opcode == b"g":
-                        send(conn, self.get_weights())
+                        weights = self.get_weights()
+                        send(conn, weights)
+                        self._obs_rpc(
+                            "socket", "get_weights", "ok", t0,
+                            bytes_out=sum(int(w.nbytes) for w in weights))
                     elif opcode == b"h":
                         conn.sendall(b"k")  # alive
+                        self._obs_rpc("socket", "health", "ok", t0)
                     else:
                         # unknown opcode = desynced or garbage stream;
                         # continuing would interpret payload bytes as

@@ -55,6 +55,9 @@ import numpy as np
 from .models.transformer import (NEG_INF, TransformerConfig, chunked_blocks,
                                  decode_block, decode_step, init_kv_cache,
                                  prefill_cache)
+from .obs.metrics import (MetricsRegistry, counter_baseline,
+                          since_baseline)
+from .obs.trace import span_if_counted
 from .utils.faults import fault_site
 
 
@@ -172,6 +175,18 @@ class DecodeEngine:
     :param clock: monotonic time source for deadline bookkeeping
         (``time.monotonic``); injectable so chaos tests drive expiry
         deterministically without sleeping.
+    :param registry: the :class:`~elephas_tpu.obs.MetricsRegistry` this
+        engine's series land in. Defaults to a FRESH per-engine registry
+        (not the process default): the registry counters are the single
+        source of truth behind :attr:`stats`, which is a per-engine
+        surface. Injecting a shared registry supports the sequential
+        weight-reload flow — the replacement engine snapshots the
+        counters at construction, so its stats start at zero while the
+        scraped series keep pooled totals — but two CONCURRENTLY-live
+        engines on one registry do pool counts (and the newest engine's
+        queue gauges win); keep simultaneous engines on their default
+        fresh registries. The HTTP server merges this registry with the
+        process default registry on its ``GET /metrics`` route.
     """
 
     def __init__(self, params: Dict, config: TransformerConfig,
@@ -184,7 +199,8 @@ class DecodeEngine:
                  paged: Optional[Tuple[int, int]] = None,
                  max_queue: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -284,20 +300,81 @@ class DecodeEngine:
         self._deadline: Dict[int, float] = {}  # rid -> absolute deadline
         self._expired: set = set()   # shed while queued (never prefilled)
         self._timed_out: set = set()  # deadline hit mid-decode (partial)
-        self._n_shed = 0
-        self._n_expired = 0
-        self._n_timed_out = 0
-        # observability counters (see .stats)
-        self._n_steps = 0
+        # observability: the registry is the single store behind .stats
+        # (per-engine by default — see the registry param docstring)
+        self.registry = reg = (registry if registry is not None
+                               else MetricsRegistry())
+        # label-less children are resolved ONCE (.labels() with no
+        # labels): per-token hot paths pay one child-lock inc, never a
+        # family lock + dict lookup per token
+        self._m_steps = reg.counter(
+            "serving_steps_total",
+            "device round trips (engine steps)").labels()
+        self._m_emitted = reg.counter(
+            "serving_tokens_emitted_total", "output tokens emitted"
+            ).labels()
+        self._m_finished = reg.counter(
+            "serving_requests_finished_total",
+            "requests retired at eos or budget").labels()
+        self._m_shed = reg.counter(
+            "serving_requests_shed_total",
+            "admission rejections (queue full / injected shed; HTTP 429)"
+            ).labels()
+        self._m_expired = reg.counter(
+            "serving_requests_expired_total",
+            "deadline passed while queued — shed before prefill (504)"
+            ).labels()
+        self._m_timed_out = reg.counter(
+            "serving_requests_timed_out_total",
+            "deadline passed mid-decode — partial output returned"
+            ).labels()
+        # gauge callbacks hold a WEAK reference: with an injected
+        # long-lived registry, a discarded engine (weight reload) must
+        # not be pinned — with its params — by its own scrape callbacks
+        import weakref
+
+        ref = weakref.ref(self)
+        self._m_queue_depth = reg.gauge(
+            "serving_queue_depth", "requests backlogged, not yet admitted")
+        self._m_queue_depth.set_function(
+            lambda: float(len(e._queue))
+            if (e := ref()) is not None else 0.0)
+        self._m_queued_tokens = reg.gauge(
+            "serving_queued_tokens", "prompt tokens waiting in the queue")
+        self._m_queued_tokens.set_function(
+            lambda: float(e._queued_tokens)
+            if (e := ref()) is not None else 0.0)
+        self._m_step_latency = reg.histogram(
+            "serving_step_latency_seconds",
+            "wall time of one engine step (admission + device dispatch)"
+            ).labels()
+        self._m_request_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "submit-to-retirement wall time per finished request"
+            ).labels()
+        self._m_queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit-to-admission wall time per admitted request").labels()
         # per-request wall-clock: submit time per rid + a bounded window
         # of completed (queue_wait_s, total_s) samples for percentiles
+        # (kept alongside the histograms: _retry_after_ms needs raw
+        # medians over exactly this window)
         self._submit_t: Dict[int, float] = {}
         self._admit_t: Dict[int, float] = {}
         self._latency_window: deque = deque(maxlen=1024)
-        self._n_emitted = 0
-        self._n_finished = 0
-        self._n_accepted = 0
-        self._n_proposed = 0
+        self._m_accepted = reg.counter(
+            "serving_draft_tokens_accepted_total",
+            "speculative draft tokens accepted by the target model"
+            ).labels()
+        self._m_proposed = reg.counter(
+            "serving_draft_tokens_proposed_total",
+            "speculative draft tokens proposed").labels()
+        if self.paged is not None:
+            reg.gauge("serving_paged_blocks_free",
+                      "allocatable KV blocks currently free"
+                      ).set_function(
+                lambda: float(len(e._free_block_ids))
+                if (e := ref()) is not None else 0.0)
 
         cfg = config
         temp = self.temperature
@@ -433,8 +510,23 @@ class DecodeEngine:
         # registered shared prompt prefixes, longest first:
         # (tokens, last-position logits, target row cache, draft row cache)
         self._prefixes: List = []
-        self._n_prefix_hits = 0
-        self._n_prefix_tokens = 0
+        self._m_prefix_hits = reg.counter(
+            "serving_prefix_hits_total",
+            "admissions that reused a registered prompt prefix").labels()
+        self._m_prefix_tokens = reg.counter(
+            "serving_prefix_tokens_reused_total",
+            "prompt tokens whose prefill was skipped via a prefix hit"
+            ).labels()
+        # construction-time baselines: an INJECTED shared registry may
+        # already carry a predecessor engine's totals (weight-reload
+        # flow) — stats must report THIS engine's deltas, never pooled
+        # counts. With the default fresh registry every baseline is
+        # zero and stats equals the scraped series exactly.
+        self._stat_base = counter_baseline(
+            self._m_steps, self._m_emitted, self._m_finished,
+            self._m_shed, self._m_expired, self._m_timed_out,
+            self._m_accepted, self._m_proposed,
+            self._m_prefix_hits, self._m_prefix_tokens)
 
         if draft_config is not None:
             from .models.speculative import speculative_round
@@ -693,12 +785,12 @@ class DecodeEngine:
         if fault_site("serving.submit"):
             # a plan 'drop' here is a deterministic shed: the request is
             # rejected exactly as if the queue were at capacity
-            self._n_shed += 1
+            self._m_shed.inc()
             raise QueueFullError("admission rejected (injected shed)",
                                  self._retry_after_ms())
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
-            self._n_shed += 1
+            self._m_shed.inc()
             raise QueueFullError(
                 f"queue full: {len(self._queue)} requests backlogged "
                 f"(max_queue={self.max_queue})", self._retry_after_ms())
@@ -714,7 +806,7 @@ class DecodeEngine:
         if (self.max_queued_tokens is not None
                 and self._queued_tokens + prompt.size
                 > self.max_queued_tokens):
-            self._n_shed += 1
+            self._m_shed.inc()
             raise QueueFullError(
                 f"queue full: {self._queued_tokens} prompt tokens "
                 f"backlogged + {prompt.size} would exceed "
@@ -792,7 +884,7 @@ class DecodeEngine:
                 self._submit_t.pop(rid, None)
                 self._done[rid] = []
                 self._expired.add(rid)
-                self._n_expired += 1
+                self._m_expired.inc()
             else:
                 keep.append(item)
         self._queue = keep
@@ -811,7 +903,7 @@ class DecodeEngine:
             # step() still reaches streaming clients on the next call
             self._retire_slot(slot)
             self._timed_out.add(rid)
-            self._n_timed_out += 1
+            self._m_timed_out.inc()
 
     def _admit(self):
         self._shed_expired_queued()
@@ -844,8 +936,8 @@ class DecodeEngine:
             # the prefix's cached k/v and prefills only the suffix
             entry = self._match_prefix(prompt)
             if entry is not None:
-                self._n_prefix_hits += 1
-                self._n_prefix_tokens += int(entry[0].size)
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(int(entry[0].size))
             logits, row_cache = self._prefill_with_prefixes(
                 prompt, self._extend_fn, self._extend_owned_fn,
                 self._prefill_fn, self.params, entry, 2,
@@ -896,7 +988,7 @@ class DecodeEngine:
             self._finish(slot)
             return False
         self._outputs[rid].append(tok)
-        self._n_emitted += 1
+        self._m_emitted.inc()
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
@@ -923,11 +1015,13 @@ class DecodeEngine:
         t_adm = self._admit_t.pop(rid, now)
         if t_sub is not None:
             self._latency_window.append((t_adm - t_sub, now - t_sub))
+            self._m_queue_wait.observe(t_adm - t_sub)
+            self._m_request_latency.observe(now - t_sub)
         return rid
 
     def _finish(self, slot: int):
         self._retire_slot(slot)
-        self._n_finished += 1
+        self._m_finished.inc()
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -935,23 +1029,31 @@ class DecodeEngine:
         trips), ``tokens_emitted``, ``requests_finished``,
         ``tokens_per_step`` (the continuous-batching + speculation
         payoff), and in speculative mode ``draft_acceptance`` (accepted
-        / proposed over active slots)."""
-        out = {"steps": self._n_steps,
-               "tokens_emitted": self._n_emitted,
-               "requests_finished": self._n_finished,
-               "tokens_per_step": (self._n_emitted / self._n_steps
-                                   if self._n_steps else 0.0),
+        / proposed over active slots). Every counter is a read of this
+        engine's :attr:`registry` minus this engine's construction-time
+        baseline — zero for the default fresh registry, so stats and
+        ``GET /metrics`` agree exactly; with a shared injected registry
+        the scrape keeps process-lifetime totals while stats stays
+        per-engine."""
+        steps = int(self._since_init(self._m_steps))
+        emitted = int(self._since_init(self._m_emitted))
+        out = {"steps": steps,
+               "tokens_emitted": emitted,
+               "requests_finished": int(self._since_init(self._m_finished)),
+               "tokens_per_step": (emitted / steps if steps else 0.0),
                # overload-safety counters: admission rejections (429),
                # queued-deadline sheds (504), mid-decode timeouts, and
                # the live backlog the admission bounds act on
-               "requests_shed": self._n_shed,
-               "requests_expired": self._n_expired,
-               "requests_timed_out": self._n_timed_out,
+               "requests_shed": int(self._since_init(self._m_shed)),
+               "requests_expired": int(self._since_init(self._m_expired)),
+               "requests_timed_out": int(
+                   self._since_init(self._m_timed_out)),
                "queue_depth": len(self._queue),
                "queued_tokens": self._queued_tokens}
         if self._prefixes:
-            out["prefix_hits"] = self._n_prefix_hits
-            out["prefix_tokens_reused"] = self._n_prefix_tokens
+            out["prefix_hits"] = int(self._since_init(self._m_prefix_hits))
+            out["prefix_tokens_reused"] = int(
+                self._since_init(self._m_prefix_tokens))
         if self.paged is not None:
             out["blocks_total"] = self.paged[0] - 1
             out["blocks_free"] = len(self._free_block_ids)
@@ -964,10 +1066,16 @@ class DecodeEngine:
                                          4)
             out["queue_wait_mean_s"] = round(sum(waits) / len(waits), 4)
         if self.draft_config is not None:
+            proposed = self._since_init(self._m_proposed)
             out["draft_acceptance"] = (
-                self._n_accepted / self._n_proposed
-                if self._n_proposed else 0.0)
+                self._since_init(self._m_accepted) / proposed
+                if proposed else 0.0)
         return out
+
+    def _since_init(self, metric) -> float:
+        """This engine's share of a counter: current value minus the
+        construction-time baseline (see ``_stat_base``)."""
+        return since_baseline(self._stat_base, metric)
 
     # ------------------------------------------------------------- step
     @property
@@ -989,6 +1097,13 @@ class DecodeEngine:
         retire and queued ones join automatically; expired queued
         requests are shed before prefill and over-deadline active slots
         are freed (their partial output finishes as a ``timeout``)."""
+        # slow steps (a prefill-compile-heavy one) also land on the
+        # slow-span ring by name
+        with span_if_counted("serving.step", self._m_steps,
+                             histogram=self._m_step_latency):
+            return self._step_impl()
+
+    def _step_impl(self) -> Dict[int, List[int]]:
         # chaos site: 'error' = engine crash mid-serve (the HTTP loop
         # records it and /health turns red), 'delay' = a slow step
         fault_site("serving.step")
@@ -1002,7 +1117,7 @@ class DecodeEngine:
         # shape); their writes are overwritten by the next admission's
         # prefill and masked until then
         pos = np.where(active, self._pos + 1, 0).astype(np.int32)
-        self._n_steps += 1
+        self._m_steps.inc()
         if self.draft_config is not None:
             # speculative round: every active slot advances by its own
             # 1 + accepted tokens in one dispatch
@@ -1013,8 +1128,8 @@ class DecodeEngine:
                                    jnp.asarray(pos), self._key))
             emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
                               np.asarray(nxt))
-            self._n_accepted += int(acc[active].sum())
-            self._n_proposed += self.gamma * int(active.sum())
+            self._m_accepted.inc(int(acc[active].sum()))
+            self._m_proposed.inc(self.gamma * int(active.sum()))
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
                 self._pos[slot] += 1 + acc[slot]

@@ -26,6 +26,11 @@ Endpoints (JSON in/out):
 - ``GET /stats`` — engine + server counters; ``GET /health`` — liveness
   (200 until the engine loop dies); ``GET /ready`` — readiness (503
   while warming and while draining; load balancers route on this one).
+- ``GET /metrics`` — Prometheus text exposition of the engine/server
+  registry plus the process default registry (step-latency histograms,
+  queue gauges, per-route request latency, fault injections — the
+  docs' observability page has the catalog). The JSON ``/stats`` reads
+  the same registry, so the two surfaces cannot drift.
 
 Overload safety (the serving-operations doc page has the full story):
 
@@ -54,12 +59,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from .obs.metrics import (MetricsRegistry, counter_baseline,
+                          default_registry, since_baseline)
 from .serving_engine import QueueFullError
 from .utils.faults import fault_site
 
 __all__ = ["ServingServer"]
 
 _IDLE_SLEEP = 0.005
+
+#: the route label domain for http_* metrics — anything else is
+#: "other", so a scanner probing random paths cannot grow label
+#: cardinality past the registry's bound
+_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/v1/result",
+                 "/v1/generate", "/v1/submit", "/v1/cancel")
 
 
 class _HTTPError(Exception):
@@ -95,13 +108,20 @@ class ServingServer:
     :param max_body_bytes: reject request bodies whose Content-Length
         exceeds this with 413 before reading a byte (default 1 MiB) —
         the header is a claim, not a license to buffer unbounded input.
+    :param registry: metrics registry for the server's HTTP series
+        (request latency by route and status, drain counters). Defaults
+        to the ENGINE's registry so ``GET /metrics`` serves engine and
+        server series from one store; the route also appends the
+        process default registry (fault injections, parameter-plane
+        clients, training timers living on the same host).
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  tokenizer=None, default_max_new_tokens: int = 64,
                  max_stored_results: int = 1024,
                  default_deadline_ms: Optional[float] = None,
-                 max_body_bytes: int = 1 << 20):
+                 max_body_bytes: int = 1 << 20,
+                 registry: Optional[MetricsRegistry] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.default_max_new_tokens = int(default_max_new_tokens)
@@ -140,7 +160,25 @@ class ServingServer:
         # /health stays the pure liveness signal throughout
         self._ready = False
         self._draining = False
-        self._n_drained = 0      # in-flight requests cancelled at drain
+        # HTTP-layer metrics live in the engine's registry by default so
+        # /metrics is one consistent store (see the registry param)
+        self.registry = reg = (registry
+                               or getattr(engine, "registry", None)
+                               or MetricsRegistry())
+        self._m_http_latency = reg.histogram(
+            "http_request_duration_seconds",
+            "request wall time by route and status",
+            labels=("route", "status"))
+        self._m_http_requests = reg.counter(
+            "http_requests_total", "requests served by route and status",
+            labels=("route", "status"))
+        self._m_drained = reg.counter(
+            "serving_requests_drained_total",
+            "in-flight requests cancelled at the drain deadline").labels()
+        # per-server baseline, like the engines' counters: a new server
+        # over a reused engine/registry must not report a predecessor's
+        # drain totals in /stats (the scrape keeps pooled totals)
+        self._drained_base = counter_baseline(self._m_drained)
         # set by stop(): the ENGINE LOOP enforces the drain deadline and
         # signals completion (it holds the lock across every step, so a
         # stop() thread polling for the lock could starve past its
@@ -153,6 +191,36 @@ class ServingServer:
     def port(self) -> int:
         return self._port
 
+    @property
+    def _n_drained(self) -> int:
+        # registry-backed (the counter IS the store); kept as the
+        # attribute the /stats route and drain tests always read
+        return int(since_baseline(self._drained_base, self._m_drained))
+
+    # ------------------------------------------------------------ metrics
+    def _observe_http(self, path: str, status: int, t0: float):
+        route = path if path in _KNOWN_ROUTES else "other"
+        dur = time.perf_counter() - t0
+        labels = dict(route=route, status=str(int(status)))
+        self._m_http_latency.labels(**labels).observe(dur)
+        self._m_http_requests.labels(**labels).inc()
+
+    def _metrics_text(self) -> str:
+        """Prometheus exposition for ``GET /metrics``: the server
+        registry, the engine's registry, and the process default
+        registry (each rendered once — they are usually the same
+        object), so one scrape covers serving AND the cross-cutting
+        series (fault injections, PS clients, training step times) of
+        this process regardless of which registry was injected where."""
+        seen, text = [], ""
+        for reg in (self.registry, getattr(self.engine, "registry", None),
+                    default_registry()):
+            if reg is None or any(reg is s for s in seen):
+                continue
+            seen.append(reg)
+            text += reg.render()
+        return text
+
     def start(self):
         """Bind, start the HTTP threads and the engine-step loop."""
         server = self
@@ -161,13 +229,22 @@ class ServingServer:
             def log_message(self, *args):      # quiet, like the PS server
                 pass
 
-            def _json(self, code: int, payload: Dict):
-                body = json.dumps(payload).encode()
+            def _reply(self, code: int, body: bytes, content_type: str):
+                # record BEFORE the body goes out: a client must find
+                # its own request already counted if it scrapes /metrics
+                # right after reading this response
+                server._observe_http(urlparse(self.path).path, code,
+                                     getattr(self, "_t0", None)
+                                     or time.perf_counter())
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _json(self, code: int, payload: Dict):
+                self._reply(code, json.dumps(payload).encode(),
+                            "application/json")
 
             def _body(self) -> Dict:
                 try:
@@ -195,9 +272,18 @@ class ServingServer:
                 return json.loads(self.rfile.read(length))
 
             def do_GET(self):
+                self._t0 = time.perf_counter()
                 url = urlparse(self.path)
                 try:
-                    if url.path == "/health":
+                    if url.path == "/metrics":
+                        # Prometheus exposition: engine + server series
+                        # (and the process default registry). Lock-free
+                        # like /health — the registry takes per-family
+                        # locks only.
+                        self._reply(
+                            200, server._metrics_text().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif url.path == "/health":
                         # lock-free read: liveness must answer instantly
                         # even while the engine loop holds the lock
                         # across a prefill compile (attribute reads are
@@ -245,6 +331,7 @@ class ServingServer:
                     self._json(err.code, err.payload)
 
             def do_POST(self):
+                self._t0 = time.perf_counter()
                 url = urlparse(self.path)
                 try:
                     body = self._body()
@@ -283,6 +370,12 @@ class ServingServer:
                             # in-flight request instead of decoding for
                             # nobody
                             server._abort_stream(rid)
+                        finally:
+                            # the 200 went out before the first token;
+                            # the latency recorded here is the full
+                            # stream duration
+                            server._observe_http("/v1/generate", 200,
+                                                 self._t0)
                         return
                     if url.path == "/v1/generate":
                         self._json(200, server._generate(body))
@@ -391,7 +484,7 @@ class ServingServer:
                 and self._tracked):
             for rid in list(self._tracked):
                 if self.engine.cancel(rid):
-                    self._n_drained += 1
+                    self._m_drained.inc()
             self._tracked.clear()
             self._cond.notify_all()
         if not (self._tracked or self._streams or self._waiters):

@@ -27,6 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .models.ssm import SSMConfig, init_ssm_state, ssm_decode_step, ssm_prefill
+from .obs.metrics import (MetricsRegistry, counter_baseline,
+                          since_baseline)
+from .obs.trace import span_if_counted
 from .serving_engine import _filter_logits_rows
 
 __all__ = ["SSMEngine"]
@@ -47,13 +50,18 @@ class SSMEngine:
     :param prefill_chunk: prefill prompts in fixed-size pieces (the
         recurrence continues across chunks through the carried state),
         bounding admission compiles to at most ``prefill_chunk`` shapes.
+    :param registry: metrics registry backing :attr:`stats` (fresh
+        per-engine instance by default, exactly like
+        :class:`~elephas_tpu.serving_engine.DecodeEngine`'s; the HTTP
+        server's ``GET /metrics`` reads it).
     """
 
     def __init__(self, params: Dict, config: SSMConfig,
                  max_slots: int = 8, temperature: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  steps_per_sync: int = 1,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -79,9 +87,38 @@ class SSMEngine:
         self._done: Dict = {}
         self._fresh: Dict = {}
         self._next_rid = 0
-        self._n_steps = 0
-        self._n_emitted = 0
-        self._n_finished = 0
+        # registry-backed counters (the store behind .stats and /metrics)
+        self.registry = reg = (registry if registry is not None
+                               else MetricsRegistry())
+        self._m_steps = reg.counter(
+            "serving_steps_total",
+            "device round trips (engine steps)").labels()
+        self._m_emitted = reg.counter(
+            "serving_tokens_emitted_total", "output tokens emitted"
+            ).labels()
+        self._m_finished = reg.counter(
+            "serving_requests_finished_total",
+            "requests retired at eos or budget").labels()
+        # weak ref, like DecodeEngine's gauges: an injected shared
+        # registry must not pin a discarded engine via its callbacks
+        import weakref
+
+        ref = weakref.ref(self)
+        self._m_queue_depth = reg.gauge(
+            "serving_queue_depth", "requests backlogged, not yet admitted")
+        self._m_queue_depth.set_function(
+            lambda: float(len(e._queue))
+            if (e := ref()) is not None else 0.0)
+        self._m_step_latency = reg.histogram(
+            "serving_step_latency_seconds",
+            "wall time of one engine step (admission + device dispatch)"
+            ).labels()
+        # per-engine baselines, like DecodeEngine's: a shared injected
+        # registry may carry a predecessor's totals; stats reports
+        # this engine's deltas (zero baselines for the default fresh
+        # registry, where stats ≡ the scraped series)
+        self._stat_base = counter_baseline(
+            self._m_steps, self._m_emitted, self._m_finished)
 
         c = config
         n_sync = self.steps_per_sync
@@ -257,7 +294,7 @@ class SSMEngine:
             self._finish(slot)
             return False
         self._outputs[rid].append(tok)
-        self._n_emitted += 1
+        self._m_emitted.inc()
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
@@ -267,7 +304,7 @@ class SSMEngine:
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
-        self._n_finished += 1
+        self._m_finished.inc()
 
     # ------------------------------------------------------------- step
     @property
@@ -279,13 +316,19 @@ class SSMEngine:
     def step(self) -> Dict[int, List[int]]:
         """Advance every active slot by ``steps_per_sync`` tokens;
         returns ``{rid: [tokens]}`` emitted since the last call."""
+        # device round trips only, like DecodeEngine.step
+        with span_if_counted("serving.step", self._m_steps,
+                             histogram=self._m_step_latency):
+            return self._step_impl()
+
+    def _step_impl(self) -> Dict[int, List[int]]:
         self._admit()
         emitted = {rid: [tok] for rid, tok in self._fresh.items()}
         self._fresh = {}
         active = np.asarray([r is not None for r in self._rid])
         if not active.any():
             return emitted
-        self._n_steps += 1
+        self._m_steps.inc()
         toks, self.state, self._key = self._step_fn(
             self.params, self.state, jnp.asarray(self._last),
             jnp.asarray(self._temp), jnp.asarray(self._topk),
@@ -314,8 +357,11 @@ class SSMEngine:
 
     @property
     def stats(self) -> Dict[str, float]:
-        return {"steps": self._n_steps,
-                "tokens_emitted": self._n_emitted,
-                "requests_finished": self._n_finished,
-                "tokens_per_step": (self._n_emitted / self._n_steps
-                                    if self._n_steps else 0.0)}
+        steps = int(since_baseline(self._stat_base, self._m_steps))
+        emitted = int(since_baseline(self._stat_base, self._m_emitted))
+        return {"steps": steps,
+                "tokens_emitted": emitted,
+                "requests_finished": int(
+                    since_baseline(self._stat_base, self._m_finished)),
+                "tokens_per_step": (emitted / steps if steps else 0.0),
+                "queue_depth": len(self._queue)}

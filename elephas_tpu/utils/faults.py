@@ -50,6 +50,8 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..obs.metrics import default_registry
+
 #: environment variable holding a plan for spawned processes: either an
 #: inline JSON document or a path to a JSON file
 ENV_VAR = "ELEPHAS_TPU_FAULT_PLAN"
@@ -248,6 +250,13 @@ def fault_site(name: str) -> bool:
     ev = plan.check(name)
     if ev is None:
         return False
+    # every fired event surfaces as a labeled series in the process
+    # default registry — chaos runs are diagnosable from /metrics alone
+    default_registry().counter(
+        "faults_injected_total",
+        "fault-plan events fired, by site and action",
+        labels=("site", "action")).labels(
+        site=name, action=ev.action).inc()
     if ev.action == "delay":
         time.sleep(ev.delay)
         return False

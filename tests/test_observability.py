@@ -1,0 +1,383 @@
+"""Unified observability: the registry must count exactly under
+concurrency, bound label cardinality, emit parseable Prometheus text,
+and the ``/metrics`` routes on BOTH the serving server and the
+parameter-server HTTP front-end must serve series consistent with their
+JSON ``/stats``-style surfaces; injected faults must surface as labeled
+``faults_injected_total`` series."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.obs import (MAX_LABEL_SETS, MetricsRegistry,
+                             clear_slow_spans, default_registry,
+                             percentile, recent_slow_spans, span)
+from elephas_tpu.obs.metrics import Histogram
+
+
+def _parse_prometheus(text):
+    """Minimal exposition parser: ``{series_key: value}`` plus
+    ``{family: type}`` — enough to round-trip what we render."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        key, value = line.rsplit(" ", 1)
+        assert key not in samples, f"duplicate series {key}"
+        samples[key] = float(value)
+    return samples, types
+
+
+# --------------------------------------------------------------- registry
+
+def test_counter_concurrent_increments_land_exactly():
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "hits")
+    n_threads, per_thread = 8, 5000
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+
+
+def test_label_cardinality_is_bounded():
+    reg = MetricsRegistry()
+    fam = reg.counter("labeled_total", "x", labels=("k",))
+    for i in range(MAX_LABEL_SETS):
+        fam.labels(k=str(i)).inc()
+    # re-touching an existing set is fine at the bound
+    fam.labels(k="0").inc()
+    with pytest.raises(ValueError, match="label"):
+        fam.labels(k="one-too-many")
+
+
+def test_conflicting_reregistration_raises():
+    reg = MetricsRegistry()
+    fam = reg.counter("thing_total", "x", labels=("a",))
+    # same name+kind+labels: the existing family comes back
+    assert reg.counter("thing_total", labels=("a",)) is fam
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("thing_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("thing_total", labels=("b",))
+    # histograms also conflict on buckets/window — a silent fallback to
+    # the first registrant's buckets would make quantiles garbage
+    reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    assert reg.histogram("h_seconds", buckets=(1.0, 2.0)) is not None
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("h_seconds", buckets=(30.0, 60.0))
+
+
+def test_exposition_round_trips_through_parser():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests", labels=("route", "status"))
+    c.labels(route="/a", status="200").inc(3)
+    c.labels(route="/b", status="404").inc()
+    reg.gauge("depth", "queue depth").set(7)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    samples, types = _parse_prometheus(reg.render())
+    assert types == {"reqs_total": "counter", "depth": "gauge",
+                     "lat_seconds": "histogram"}
+    assert samples['reqs_total{route="/a",status="200"}'] == 3
+    assert samples['reqs_total{route="/b",status="404"}'] == 1
+    assert samples["depth"] == 7
+    # cumulative buckets, exact sum/count
+    assert samples['lat_seconds_bucket{le="0.1"}'] == 1
+    assert samples['lat_seconds_bucket{le="1"}'] == 2
+    assert samples['lat_seconds_bucket{le="+Inf"}'] == 3
+    assert samples["lat_seconds_count"] == 3
+    assert samples["lat_seconds_sum"] == pytest.approx(5.55)
+
+
+def test_render_survives_nan_and_inf_values():
+    # one bad observation (a user gauge computing 0/0) must not poison
+    # every subsequent /metrics scrape with an exposition crash
+    reg = MetricsRegistry()
+    reg.gauge("bad").set(float("nan"))
+    reg.gauge("low").set(float("-inf"))
+    reg.histogram("h_seconds", buckets=(1.0,)).observe(float("nan"))
+    text = reg.render()
+    assert "bad NaN" in text
+    assert "low -Inf" in text
+    assert "h_seconds_sum NaN" in text
+
+
+def test_nearest_rank_percentile_small_n():
+    # the old durations[n // 2] indexing reported the max as the median
+    # of two samples; nearest-rank must report the lower one
+    assert percentile([1.0, 2.0], 0.5) == 1.0
+    assert percentile([1.0, 2.0], 0.99) == 2.0
+    assert percentile([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert percentile([5.0], 0.5) == 5.0
+    with pytest.raises(ValueError):
+        percentile([], 0.5)
+
+
+def test_histogram_quantile_uses_same_helper():
+    h = Histogram(buckets=(1.0,), window=16)
+    assert h.quantile(0.5) is None
+    for v in (0.2, 0.1):
+        h.observe(v)
+    assert h.quantile(0.5) == percentile([0.1, 0.2], 0.5) == 0.1
+    assert h.quantile(0.99) == 0.2
+
+
+def test_steptimer_summary_nearest_rank_percentiles():
+    from elephas_tpu.utils.tracing import StepTimer
+
+    timer = StepTimer(registry=MetricsRegistry())
+    timer.durations = [0.010, 0.020]   # n=2: p50 must be the LOWER one
+    s = timer.summary()
+    assert s["p50_s"] == 0.010
+    assert s["p99_s"] == 0.020
+
+
+def test_steptimer_publishes_to_registry_histogram():
+    from elephas_tpu.utils.tracing import StepTimer
+
+    reg = MetricsRegistry()
+    timer = StepTimer(registry=reg)
+    with timer:
+        pass
+    fam = reg.get("training_step_duration_seconds")
+    assert fam is not None and fam.count == 1
+
+
+def test_span_records_histogram_and_slow_ring():
+    clear_slow_spans()
+    reg = MetricsRegistry()
+    with span("unit.work", registry=reg, threshold_s=0.0):
+        pass
+    fam = reg.get("trace_span_duration_seconds")
+    assert fam.labels(span="unit.work").count == 1
+    slow = recent_slow_spans("unit.work")
+    assert len(slow) == 1 and slow[0]["duration_s"] >= 0
+    # under the default threshold nothing this fast is remembered
+    clear_slow_spans()
+    with span("unit.work", registry=reg):
+        pass
+    assert recent_slow_spans("unit.work") == []
+
+
+# ------------------------------------------------------- serving /metrics
+
+@pytest.fixture(scope="module")
+def model():
+    from elephas_tpu.models.transformer import TransformerConfig, init_params
+
+    config = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                               d_model=16, d_ff=32, max_seq_len=32,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def _get_text(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=60) as resp:
+        return resp.read().decode(), resp.headers.get("Content-Type", "")
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def test_serving_server_metrics_consistent_with_stats(model):
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.serving_http import ServingServer
+
+    params, config = model
+    eng = DecodeEngine(params, config, max_slots=2)
+    with ServingServer(eng) as srv:
+        out = _post(srv.port, "/v1/generate",
+                    {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert len(out["tokens"]) == 4
+        stats = json.loads(_get_text(srv.port, "/stats")[0])
+        text, ctype = _get_text(srv.port, "/metrics")
+        assert ctype.startswith("text/plain")
+        samples, types = _parse_prometheus(text)
+        # step-latency histogram buckets are present and populated
+        assert types["serving_step_latency_seconds"] == "histogram"
+        assert (samples['serving_step_latency_seconds_bucket{le="+Inf"}']
+                == stats["steps"] > 0)
+        # gauge + overload counters agree with the JSON surface
+        assert samples["serving_queue_depth"] == stats["queue_depth"]
+        assert samples["serving_queued_tokens"] == stats["queued_tokens"]
+        for series, key in (
+                ("serving_requests_shed_total", "requests_shed"),
+                ("serving_requests_expired_total", "requests_expired"),
+                ("serving_requests_timed_out_total",
+                 "requests_timed_out"),
+                ("serving_tokens_emitted_total", "tokens_emitted"),
+                ("serving_requests_finished_total", "requests_finished")):
+            assert samples[series] == stats[key], series
+        # the HTTP layer's own route/status series are in the same scrape
+        assert samples[
+            'http_requests_total{route="/v1/generate",status="200"}'] >= 1
+
+
+def test_engine_shed_lands_in_registry(model):
+    from elephas_tpu.serving_engine import DecodeEngine, QueueFullError
+
+    params, config = model
+    eng = DecodeEngine(params, config, max_slots=1, max_queue=1)
+    eng.submit([1, 2], 2, admit=False)
+    with pytest.raises(QueueFullError):
+        eng.submit([3, 4], 2, admit=False)
+    assert eng.stats["requests_shed"] == 1
+    samples, _ = _parse_prometheus(eng.registry.render())
+    assert samples["serving_requests_shed_total"] == 1
+    assert samples["serving_queue_depth"] == 1
+
+
+def test_replacement_engine_stats_start_at_zero_on_shared_registry(model):
+    """The weight-reload flow: engine B constructed with engine A's
+    registry must report ITS OWN stats (zeros at birth), while the
+    scraped series keep the pooled process-lifetime totals."""
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    params, config = model
+    a = DecodeEngine(params, config, max_slots=1)
+    [out] = a.run([[1, 2, 3]], 3)
+    assert len(out) == 3 and a.stats["steps"] > 0
+    b = DecodeEngine(params, config, max_slots=1, registry=a.registry)
+    assert b.stats["steps"] == 0
+    assert b.stats["tokens_emitted"] == 0
+    finished_a = a.stats["requests_finished"]
+    [out_b] = b.run([[4, 5]], 2)
+    assert len(out_b) == 2
+    assert b.stats["requests_finished"] == 1
+    # the scrape keeps pooled totals for continuity across the reload
+    samples, _ = _parse_prometheus(a.registry.render())
+    assert (samples["serving_requests_finished_total"]
+            == finished_a + b.stats["requests_finished"] == 2)
+
+
+# ------------------------------------------------ parameter-server /metrics
+
+def _ps_model():
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.utils.serialization import model_to_dict
+
+    m = Sequential([Dense(4, input_dim=3), Dense(1)])
+    m.compile(SGD(learning_rate=0.1), "mse", seed=1)
+    return model_to_dict(m)
+
+
+def test_ps_http_server_metrics_endpoint_and_404():
+    from elephas_tpu.parameter import HttpClient, HttpServer
+
+    port = 26900
+    server = HttpServer(_ps_model(), port, "asynchronous")
+    server.start()
+    try:
+        client = HttpClient(port)
+        weights = client.get_parameters()
+        client.update_parameters([np.zeros_like(w) for w in weights])
+        # unknown path answers a clean 404 (with an explicit empty body)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_text(port, "/no-such-route")
+        assert err.value.code == 404
+        text, ctype = _get_text(port, "/metrics")
+        assert ctype.startswith("text/plain")
+        samples, types = _parse_prometheus(text)
+        assert types["ps_rpc_latency_seconds"] == "histogram"
+        # the log_message replacement: method/path/status series exist
+        assert samples[
+            'ps_http_requests_total{method="GET",path="/parameters",'
+            'status="200"}'] >= 1
+        assert samples[
+            'ps_http_requests_total{method="POST",path="/update",'
+            'status="200"}'] >= 1
+        assert samples[
+            'ps_http_requests_total{method="GET",path="other",'
+            'status="404"}'] >= 1
+        # RPC counters + latency observed for both ops over HTTP
+        assert samples['ps_rpc_total{transport="http",'
+                       'op="get_weights",status="ok"}'] >= 1
+        assert samples['ps_rpc_total{transport="http",'
+                       'op="apply_delta",status="ok"}'] >= 1
+        assert samples['ps_rpc_latency_seconds_count{transport="http",'
+                       'op="apply_delta"}'] >= 1
+        assert samples['ps_rpc_bytes_total{transport="http",'
+                       'direction="in"}'] > 0
+        # client-side series land in the same (default) registry
+        assert samples['ps_client_rpc_latency_seconds_count'
+                       '{op="get_parameters"}'] >= 1
+    finally:
+        server.stop()
+
+
+def test_socket_server_rpc_metrics():
+    from elephas_tpu.parameter import SocketClient, SocketServer
+
+    before = default_registry().counter(
+        "ps_rpc_total", labels=("transport", "op", "status")).labels(
+        transport="socket", op="get_weights", status="ok").value
+    port = 26901
+    server = SocketServer(_ps_model(), port, "asynchronous")
+    server.start()
+    try:
+        client = SocketClient(port)
+        weights = client.get_parameters()
+        client.update_parameters([np.zeros_like(w) for w in weights])
+        client.close()
+        fam = default_registry().counter(
+            "ps_rpc_total", labels=("transport", "op", "status"))
+        assert fam.labels(transport="socket", op="get_weights",
+                          status="ok").value == before + 1
+        assert fam.labels(transport="socket", op="apply_delta",
+                          status="ok").value >= 1
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------ chaos faults
+
+@pytest.mark.chaos
+def test_injected_faults_surface_as_labeled_series(model):
+    from elephas_tpu.serving_engine import DecodeEngine, QueueFullError
+    from elephas_tpu.utils.faults import FaultPlan, clear_plan, install_plan
+
+    params, config = model
+    fam = default_registry().counter("faults_injected_total",
+                                     labels=("site", "action"))
+    before = fam.labels(site="serving.submit", action="drop").value
+    install_plan(FaultPlan([{"site": "serving.submit", "action": "drop"}]))
+    try:
+        eng = DecodeEngine(params, config, max_slots=1)
+        with pytest.raises(QueueFullError):
+            eng.submit([1, 2, 3], 2)
+    finally:
+        clear_plan()
+    after = fam.labels(site="serving.submit", action="drop").value
+    assert after == before + 1
+    # and it is visible in the exposition text, labeled
+    samples, _ = _parse_prometheus(default_registry().render())
+    assert samples['faults_injected_total{site="serving.submit",'
+                   'action="drop"}'] == after

@@ -121,13 +121,18 @@ def test_paged_eos_returns_blocks_early(model):
     rng = np.random.default_rng(44)
     prompt = rng.integers(0, 64, 6)
     full = _ref(params, config, prompt, 12)
+    # the eos at its FIRST occurrence: under this machine's numerics the
+    # token at a fixed index can also appear earlier in the decode, and
+    # the engine (correctly) stops at the first hit — same seed-flake
+    # hardening as test_serving_engine/test_ssm_engine's eos tests
     eos = full[4]
+    want = full[:full.index(eos)]
     eng = DecodeEngine(params, config, max_slots=1, paged=(16, 8),
                        eos_id=eos)
     rid = eng.submit(prompt, 12)
     while eng.pending:
         eng.step()
-    assert eng.result(rid) == full[:4]
+    assert eng.result(rid) == want
     assert eng.stats["blocks_free"] == eng.stats["blocks_total"]
 
 
