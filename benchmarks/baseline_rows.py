@@ -322,6 +322,10 @@ def measure_engine(max_slots=8, n_requests=16, prompt_len=16,
     eng = DecodeEngine(params, c, max_slots=max_slots)
     drain(eng)                       # compile prefill/step/install
     plain_tps = drain(eng)
+    # per-stage latency from the flight-recorder timelines of the
+    # measured drain (newest n_requests): queue-wait and prefill
+    # percentiles, not just end-to-end throughput
+    stage_metrics = _stage_percentiles(eng.recorder, n_requests)
 
     eng_pc = DecodeEngine(params, c, max_slots=max_slots)
     eng_pc.register_prefix(prefix)
@@ -374,10 +378,36 @@ def measure_engine(max_slots=8, n_requests=16, prompt_len=16,
             "prefix_admission_ms": round(prefix_adm, 2),
             "prefix_admission_speedup": round(plain_adm / prefix_adm, 3),
             "tokens_per_step": round(eng.stats["tokens_per_step"], 3),
+            "metrics": stage_metrics,
             "config": f"L8 d1024 ff4096 h16 continuous batching, "
                       f"{n_requests} reqs x {prompt_len}-tok prompts "
                       f"({prefix_len} shared prefix) through "
                       f"{max_slots} slots, greedy"}
+
+
+def _stage_percentiles(recorder, n: int) -> dict:
+    """Queue-wait and prefill p50/p99 derived from the newest ``n``
+    flight-recorder timelines — the BENCH record's per-stage latency
+    companion to the end-to-end tokens/sec scalar."""
+    from elephas_tpu.obs import percentile
+
+    waits, prefills = [], []
+    for t in recorder.recent(limit=n):
+        for e in t["events"]:
+            if (e["event"] == "admitted"
+                    and e.get("queue_wait_s") is not None):
+                waits.append(e["queue_wait_s"])
+            elif (e["event"] == "prefill"
+                    and e.get("duration_s") is not None):
+                prefills.append(e["duration_s"])
+    out = {}
+    if waits:
+        out["queue_wait_p50_s"] = round(percentile(waits, 0.5), 6)
+        out["queue_wait_p99_s"] = round(percentile(waits, 0.99), 6)
+    if prefills:
+        out["prefill_p50_s"] = round(percentile(prefills, 0.5), 6)
+        out["prefill_p99_s"] = round(percentile(prefills, 0.99), 6)
+    return out
 
 
 def measure_ssm(seqs=(1024, 4096, 8192), batch_tokens=8192,

@@ -121,7 +121,7 @@ PAGES = [
      ["build", "available", "NativeBatchLoader", "batch_iterator"]),
     ("Text utilities", "elephas_tpu.utils.text", ["ByteTokenizer"]),
     ("Serving", "elephas_tpu.serving", ["TextGenerator"]),
-    ("Tracing", "elephas_tpu.utils.tracing",
+    ("Step timing", "elephas_tpu.utils.tracing",
      ["StepTimer", "profiler_trace", "annotate"]),
     ("Observability metrics API", "elephas_tpu.obs.metrics",
      ["MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -129,6 +129,13 @@ PAGES = [
     ("Trace spans API", "elephas_tpu.obs.trace",
      ["span", "span_if_counted", "record_span", "recent_slow_spans",
       "clear_slow_spans", "set_slow_span_threshold"]),
+    ("Trace context API", "elephas_tpu.obs.context",
+     ["TraceContext", "current_context", "current_trace_id", "new_root",
+      "parse_traceparent", "set_context", "reset_context",
+      "use_context"]),
+    ("Event log API", "elephas_tpu.obs.events",
+     ["EventLog", "FlightRecorder", "default_event_log", "emit",
+      "recent_events", "clear_events"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
      ["encode_tensors", "decode_tensors", "encode", "decode"]),
     ("Delta compression", "elephas_tpu.utils.delta_compression",
@@ -194,7 +201,8 @@ def main(out_dir: str = None):
               "  - Serving guide: serving-guide.md",
               "  - Serving operations: serving-operations.md",
               "  - Fault tolerance: fault-tolerance.md",
-              "  - Observability: observability.md"]
+              "  - Observability: observability.md",
+              "  - Distributed tracing: tracing.md"]
     mkdocs += [f"  - {title}: {page}" for title, page in nav]
     (ROOT / "docs" / "mkdocs.yml").write_text("\n".join(mkdocs) + "\n")
     index = ROOT / "README.md"

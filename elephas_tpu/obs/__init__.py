@@ -1,6 +1,13 @@
-"""Unified observability: metrics registry, Prometheus exposition, and
-trace spans (see :mod:`.metrics` and :mod:`.trace`; the metric catalog
-lives in ``docs/sources/observability.md``)."""
+"""Unified observability: metrics registry, Prometheus exposition,
+trace spans, distributed trace context, and structured events (see
+:mod:`.metrics`, :mod:`.trace`, :mod:`.context`, :mod:`.events`; the
+metric catalog lives in ``docs/sources/observability.md`` and the
+tracing story in ``docs/sources/tracing.md``)."""
+from .context import (TRACEPARENT_LEN, TraceContext, current_context,
+                      current_trace_id, new_root, parse_traceparent,
+                      reset_context, set_context, use_context)
+from .events import (EVENT_RING_SIZE, EventLog, FlightRecorder,
+                     clear_events, default_event_log, emit, recent_events)
 from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS, Counter, Gauge,
                       Histogram, MetricsRegistry, default_registry,
                       percentile)
@@ -12,4 +19,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "percentile", "DEFAULT_BUCKETS",
            "MAX_LABEL_SETS", "span", "span_if_counted", "record_span",
            "recent_slow_spans", "clear_slow_spans",
-           "set_slow_span_threshold", "SPAN_METRIC", "RING_SIZE"]
+           "set_slow_span_threshold", "SPAN_METRIC", "RING_SIZE",
+           "TraceContext", "current_context", "current_trace_id",
+           "set_context", "reset_context", "use_context", "new_root",
+           "parse_traceparent", "TRACEPARENT_LEN", "EventLog",
+           "FlightRecorder", "default_event_log", "emit",
+           "recent_events", "clear_events", "EVENT_RING_SIZE"]

@@ -10,9 +10,10 @@ spans slower than a threshold to a bounded in-memory ring buffer.
 just now?" answered without a tracing backend: the last
 :data:`RING_SIZE` offenders with names, durations, and attributes.
 
-Not a distributed tracer — no context propagation, no ids. It is the
-5% of tracing that pays for itself in a single-process serving or
-training job.
+Identity comes from :mod:`.context`: every ring entry is stamped with
+the active ``trace_id`` (None outside any context), so a slow span is
+joinable against the flight-recorder timeline, fault events, and PS
+RPC events of the request that caused it.
 """
 import contextlib
 import threading
@@ -20,6 +21,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from .context import current_trace_id
 from .metrics import MetricsRegistry, default_registry
 
 __all__ = ["span", "span_if_counted", "record_span", "recent_slow_spans",
@@ -48,7 +50,9 @@ def set_slow_span_threshold(seconds: float) -> None:
 
 def recent_slow_spans(name: Optional[str] = None) -> List[Dict]:
     """Newest-last slow-span records ``{"span", "duration_s", "at",
-    ...attrs}``, optionally filtered by span name."""
+    "trace_id", ...attrs}``, optionally filtered by span name
+    (``trace_id`` is the context active when the span was recorded, or
+    None — join it against flight-recorder timelines)."""
     with _ring_lock:
         items = list(_ring)
     return [s for s in items if name is None or s["span"] == name]
@@ -75,7 +79,7 @@ def record_span(name: str, duration_s: float, histogram=None,
     thr = _slow_threshold_s if threshold_s is None else float(threshold_s)
     if duration_s >= thr:
         entry = {"span": name, "duration_s": float(duration_s),
-                 "at": time.time()}
+                 "at": time.time(), "trace_id": current_trace_id()}
         entry.update(attrs)
         with _ring_lock:
             _ring.append(entry)

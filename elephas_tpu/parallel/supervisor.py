@@ -31,6 +31,7 @@ import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..obs.context import current_context, current_trace_id, set_context
 from ..obs.metrics import default_registry
 
 _LOG = logging.getLogger(__name__)
@@ -53,6 +54,9 @@ class SupervisorReport:
     :ivar completed_shards: shard indices that finished successfully
     :ivar ps_restarts: parameter-server restarts performed
     :ivar failures: ``(shard, attempt, repr(error))`` per observed failure
+    :ivar trace_id: the trace id active when :meth:`WorkerSupervisor.run`
+        started (None outside any context) — joins this fit's decisions
+        to the fleet's event log / flight-recorder artifacts
     """
 
     def __init__(self):
@@ -62,6 +66,7 @@ class SupervisorReport:
         self.completed_shards: List[int] = []
         self.ps_restarts = 0
         self.failures: List[tuple] = []
+        self.trace_id: Optional[str] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {"restarts": self.restarts,
@@ -69,7 +74,8 @@ class SupervisorReport:
                 "lost_shards": sorted(self.lost_shards),
                 "completed_shards": sorted(self.completed_shards),
                 "ps_restarts": self.ps_restarts,
-                "failures": [(s, a, e) for s, a, e in self.failures]}
+                "failures": [(s, a, e) for s, a, e in self.failures],
+                "trace_id": self.trace_id}
 
 
 class WorkerSupervisor:
@@ -146,6 +152,7 @@ class WorkerSupervisor:
         self._ps_generation = 0
         self._ps_restart_time: Optional[float] = None
         self._shard_ps_gen: Dict[int, int] = {}
+        self._trace_ctx = None             # captured by run()
         self._done = threading.Event()
         self._stop_monitor = threading.Event()
         self._outstanding = 0
@@ -179,6 +186,12 @@ class WorkerSupervisor:
         fatal error (policy ``fail``, an exhausted restart budget, or a
         lost quorum) after running work has drained."""
         shards = list(shards)
+        # the fit's trace context: stamped on the report and restored in
+        # every slot/monitor thread (contextvars do not cross threads),
+        # so fault events and PS RPCs fired by workers carry the
+        # caller's trace id
+        self._trace_ctx = current_context()
+        self.report.trace_id = current_trace_id()
         if not shards:
             return self.report
         self._outstanding = len(shards)
@@ -219,6 +232,7 @@ class WorkerSupervisor:
 
     # ---------------------------------------------------------- slot loop
     def _slot_loop(self, slot: int):
+        set_context(self._trace_ctx)       # inherit the fit's context
         while not self._done.is_set():
             try:
                 item = self._queue.get(timeout=0.1)
@@ -391,6 +405,7 @@ class WorkerSupervisor:
         consecutive failed probes — plus :meth:`_try_restart`'s own
         under-lock confirmation — before acting; a single timed-out
         probe on a loaded but healthy server must not trigger it."""
+        set_context(self._trace_ctx)       # inherit the fit's context
         suspect = 0
         while not self._stop_monitor.wait(self.ps_probe_interval):
             try:

@@ -16,10 +16,12 @@ from typing import List
 
 import numpy as np
 
+from ..obs.context import current_context
 from ..obs.metrics import default_registry
 from ..utils.delta_compression import quantize_delta
 from ..utils.faults import InjectedFault, fault_site
-from ..utils.sockets import determine_master, receive, send
+from ..utils.sockets import (determine_master, receive, send,
+                             send_trace_context)
 from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
                                   encode)
 
@@ -193,12 +195,22 @@ class HttpClient(BaseParameterClient):
         self.compression = self._check_compression(compression)
         self.registry = registry
 
+    def _headers(self) -> dict:
+        """Per-RPC headers: the base set plus the active trace context
+        as a W3C ``traceparent`` (read at call time, so one client
+        instance serves many requests' contexts correctly)."""
+        ctx = current_context()
+        if ctx is None:
+            return self.headers
+        return dict(self.headers, traceparent=ctx.to_traceparent())
+
     def get_parameters(self) -> List[np.ndarray]:
         def op():
             if fault_site("client.get_parameters"):
                 raise InjectedFault("pull request dropped")
             request = urllib.request.Request(
-                f"http://{self.master_url}/parameters", headers=self.headers)
+                f"http://{self.master_url}/parameters",
+                headers=self._headers())
             with urllib.request.urlopen(request,
                                         timeout=self.timeout) as response:
                 return decode_weights(response.read())
@@ -208,11 +220,12 @@ class HttpClient(BaseParameterClient):
         payload = bytes(encode(arrays, kind))
         # one id per logical update, stable across retries: the server
         # drops duplicates so a lost ack can't double-apply the delta
-        headers = dict(self.headers, **{"X-Update-Id": uuid.uuid4().hex})
+        update_id = uuid.uuid4().hex
 
         def op():
             if fault_site("client.update_parameters"):
                 raise InjectedFault("push request dropped")
+            headers = dict(self._headers(), **{"X-Update-Id": update_id})
             request = urllib.request.Request(
                 f"http://{self.master_url}/update", payload, headers=headers)
             with urllib.request.urlopen(request,
@@ -297,15 +310,28 @@ class SocketClient(BaseParameterClient):
         """Run ``fn(sock)`` on the persistent connection (establishing
         it if needed); any transient failure tears the connection down
         before re-raising, so ``_with_retry``'s next attempt starts
-        from a fresh connect — including against a restarted server."""
+        from a fresh connect — including against a restarted server.
+
+        With an active trace context, the RPC is prefixed with the
+        backward-compatible ``b'T'`` traceparent frame, so the server
+        restores the caller's context for that one RPC (old servers
+        never see the frame from context-less callers, and servers
+        without the extension only matter to new callers)."""
+        ctx = current_context()
+
+        def run(sock):
+            if ctx is not None:
+                send_trace_context(sock, ctx)
+            return fn(sock)
+
         if not self.persistent:
             with self._connect() as sock:
-                return fn(sock)
+                return run(sock)
         with self._sock_lock:
             if self._persistent_sock is None:
                 self._persistent_sock = self._connect()
             try:
-                return fn(self._persistent_sock)
+                return run(self._persistent_sock)
             except _TRANSIENT:
                 self.close()
                 raise

@@ -31,11 +31,15 @@ import numpy as np
 
 _LOG = logging.getLogger(__name__)
 
+from ..obs.context import (parse_traceparent, reset_context, set_context,
+                           use_context)
+from ..obs.events import emit as emit_event
 from ..obs.metrics import default_registry
 from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
-from ..utils.sockets import determine_master, receive_frame, send
+from ..utils.sockets import (TRACE_OPCODE, determine_master, receive_frame,
+                             receive_traceparent, send)
 from ..utils.delta_compression import dequantize_delta
 from ..utils.tensor_codec import (KIND_DELTA_Q8, decode, decode_weights,
                                   encode_weights)
@@ -107,11 +111,21 @@ class BaseParameterServer(abc.ABC):
     def _obs_rpc(self, transport: str, op: str, status: str, t0: float,
                  bytes_in: int = 0, bytes_out: int = 0):
         """Record one served RPC (best-effort: dropped connections that
-        never reach a reply are not counted as RPCs)."""
+        never reach a reply are not counted as RPCs). Metrics stay
+        id-free (an id label would be unbounded cardinality); the
+        per-request identity goes to the structured event log instead —
+        a ``ps.rpc`` event stamped with the caller's trace id (None for
+        context-less callers), joinable against the serving side's
+        flight-recorder timelines."""
+        duration = time.perf_counter() - t0
         self._m_rpc_latency.labels(transport=transport, op=op).observe(
-            time.perf_counter() - t0)
+            duration)
         self._m_rpc_total.labels(transport=transport, op=op,
                                  status=status).inc()
+        # the event carries the SAME duration the histogram observed,
+        # so joining the two surfaces for one RPC is exact
+        emit_event("ps.rpc", transport=transport, op=op, status=status,
+                   duration_s=round(duration, 6))
         if bytes_in:
             self._m_rpc_bytes.labels(transport=transport,
                                      direction="in").inc(bytes_in)
@@ -285,6 +299,15 @@ class HttpServer(BaseParameterServer):
                 self.end_headers()
 
             def do_GET(self):
+                # restore the caller's trace context (W3C traceparent
+                # header) for this request, so ps.rpc events — and
+                # anything else emitted while serving it — carry the
+                # originating request's id; no header, no context
+                with use_context(parse_traceparent(
+                        self.headers.get("traceparent"))):
+                    self._handle_get()
+
+            def _handle_get(self):
                 t0 = time.perf_counter()
                 content_type = "application/elephas-tpu"
                 if self.path.rstrip("/") in ("", "/"):
@@ -321,6 +344,11 @@ class HttpServer(BaseParameterServer):
                 self.wfile.write(body)
 
             def do_POST(self):
+                with use_context(parse_traceparent(
+                        self.headers.get("traceparent"))):
+                    self._handle_post()
+
+            def _handle_post(self):
                 t0 = time.perf_counter()
                 if not self.path.startswith("/update"):
                     self._empty(404)
@@ -369,7 +397,10 @@ class HttpServer(BaseParameterServer):
 class SocketServer(BaseParameterServer):
     """Raw-TCP parameter server with a 1-byte opcode protocol:
     ``'g'`` = get weights, ``'u'`` = apply update, ``'U'`` = apply update
-    with a 32-byte idempotency id (safe to resend), ``'h'`` = health probe.
+    with a 32-byte idempotency id (safe to resend), ``'h'`` = health
+    probe, ``'T'`` = trace-context frame (55-byte ``traceparent``
+    applying to the next RPC — a backward-compatible extension old
+    clients simply never send).
 
     (Parity surface: ``elephas/parameter/server.py:140-233``; framing is the
     length-prefixed ETPU format instead of pickled payloads.)
@@ -467,6 +498,7 @@ class SocketServer(BaseParameterServer):
         # ValueError for fds >= FD_SETSIZE (1024), which a busy server
         # (many connections + file-backed data columns) can exceed
         sel = selectors.DefaultSelector()
+        pending_ctx = None   # trace context for the NEXT RPC (b"T" frame)
         with conn, sel:
             sel.register(conn, selectors.EVENT_READ)
             while self.runs:
@@ -478,7 +510,19 @@ class SocketServer(BaseParameterServer):
                     return
                 if not opcode:
                     return
+                if opcode == TRACE_OPCODE:
+                    # trace-context frame extension: fixed-length
+                    # traceparent applying to the one RPC that follows.
+                    # Old clients never send it; a malformed payload
+                    # parses to None and the stream stays in sync.
+                    try:
+                        pending_ctx = receive_traceparent(conn)
+                    except (ConnectionError, OSError):
+                        return
+                    continue
                 t0 = time.perf_counter()
+                token = set_context(pending_ctx)
+                pending_ctx = None
                 try:
                     if opcode in (b"u", b"U"):
                         update_id = None
@@ -539,3 +583,8 @@ class SocketServer(BaseParameterServer):
                     _LOG.warning("dropping connection after bad frame/"
                                  "delta: %s", err)
                     return
+                finally:
+                    # the context applies to exactly one RPC: the next
+                    # opcode on this connection starts clean unless the
+                    # client sends another b"T" frame
+                    reset_context(token)

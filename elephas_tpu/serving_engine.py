@@ -55,6 +55,9 @@ import numpy as np
 from .models.transformer import (NEG_INF, TransformerConfig, chunked_blocks,
                                  decode_block, decode_step, init_kv_cache,
                                  prefill_cache)
+from .obs.context import current_context, use_context
+from .obs.events import FlightRecorder
+from .obs.events import emit as emit_event
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
 from .obs.trace import span_if_counted
@@ -189,6 +192,11 @@ class DecodeEngine:
         process default registry on its ``GET /metrics`` route.
     """
 
+    #: flight-recorder decode sampling: one ``step`` timeline event per
+    #: this many emitted tokens per request (every token would blow the
+    #: per-request event cap on long generations for no diagnostic gain)
+    TRACE_STEP_EVERY = 8
+
     def __init__(self, params: Dict, config: TransformerConfig,
                  max_slots: int = 8, max_len: Optional[int] = None,
                  temperature: float = 0.0, eos_id: Optional[int] = None,
@@ -298,6 +306,12 @@ class DecodeEngine:
         self._clock = clock
         self._queued_tokens = 0              # prompt tokens in the queue
         self._deadline: Dict[int, float] = {}  # rid -> absolute deadline
+        # distributed tracing: the context captured at submit (the HTTP
+        # handler thread's), restored around THIS request's share of the
+        # engine loop's work, plus the per-request flight recorder the
+        # trace endpoints read (every event stamped with the trace id)
+        self._trace_ctx: Dict[int, object] = {}
+        self.recorder = FlightRecorder()
         self._expired: set = set()   # shed while queued (never prefilled)
         self._timed_out: set = set()  # deadline hit mid-decode (partial)
         # observability: the registry is the single store behind .stats
@@ -786,11 +800,14 @@ class DecodeEngine:
             # a plan 'drop' here is a deterministic shed: the request is
             # rejected exactly as if the queue were at capacity
             self._m_shed.inc()
+            emit_event("serving.shed", reason="injected")
             raise QueueFullError("admission rejected (injected shed)",
                                  self._retry_after_ms())
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
             self._m_shed.inc()
+            emit_event("serving.shed", reason="max_queue",
+                       queue_depth=len(self._queue))
             raise QueueFullError(
                 f"queue full: {len(self._queue)} requests backlogged "
                 f"(max_queue={self.max_queue})", self._retry_after_ms())
@@ -807,6 +824,8 @@ class DecodeEngine:
                 and self._queued_tokens + prompt.size
                 > self.max_queued_tokens):
             self._m_shed.inc()
+            emit_event("serving.shed", reason="max_queued_tokens",
+                       queued_tokens=self._queued_tokens)
             raise QueueFullError(
                 f"queue full: {self._queued_tokens} prompt tokens "
                 f"backlogged + {prompt.size} would exceed "
@@ -815,6 +834,17 @@ class DecodeEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._submit_t[rid] = time.monotonic()
+        # capture the submitter's trace context HERE: the engine loop
+        # thread that admits/steps/retires this request later runs
+        # without it, so the flight recorder stamps every event with
+        # the id now, and _admit restores the context per request
+        ctx = current_context()
+        if ctx is not None:
+            self._trace_ctx[rid] = ctx
+        self.recorder.start(rid,
+                            trace_id=None if ctx is None else ctx.trace_id,
+                            prompt_tokens=int(prompt.size),
+                            max_new_tokens=int(max_new_tokens))
         if deadline_ms is not None:
             self._deadline[rid] = self._clock() + deadline_ms / 1000.0
         self._queue.append((rid, prompt, int(max_new_tokens),
@@ -851,9 +881,12 @@ class DecodeEngine:
                 self._queued_tokens -= int(item[1].size)
                 self._submit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
+                self._trace_ctx.pop(rid, None)
+                self.recorder.record(rid, "cancelled", stage="queued")
                 return True
         for slot, r in enumerate(self._rid):
             if r == rid:
+                tokens = len(self._outputs.get(rid, ()))
                 self._outputs.pop(rid, None)
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
@@ -861,6 +894,9 @@ class DecodeEngine:
                 self._submit_t.pop(rid, None)
                 self._admit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
+                self._trace_ctx.pop(rid, None)
+                self.recorder.record(rid, "cancelled", stage="decoding",
+                                     tokens=tokens)
                 return True
         return False
 
@@ -881,10 +917,15 @@ class DecodeEngine:
             if dl is not None and now >= dl:
                 self._queued_tokens -= int(item[1].size)
                 self._deadline.pop(rid, None)
-                self._submit_t.pop(rid, None)
+                t_sub = self._submit_t.pop(rid, None)
                 self._done[rid] = []
                 self._expired.add(rid)
                 self._m_expired.inc()
+                self._trace_ctx.pop(rid, None)
+                self.recorder.record(
+                    rid, "expired",
+                    queue_wait_s=(None if t_sub is None
+                                  else round(time.monotonic() - t_sub, 6)))
             else:
                 keep.append(item)
         self._queue = keep
@@ -901,7 +942,7 @@ class DecodeEngine:
                 continue
             # _fresh stays: an admission-time token not yet surfaced by
             # step() still reaches streaming clients on the next call
-            self._retire_slot(slot)
+            self._retire_slot(slot, "timed_out")
             self._timed_out.add(rid)
             self._m_timed_out.inc()
 
@@ -930,43 +971,59 @@ class DecodeEngine:
             # queue wait ends HERE — prefill compute/compile time below
             # belongs to total latency, not to time-spent-queued
             self._admit_t[rid] = time.monotonic()
-            # exact-length prefill: one compile per distinct prompt
-            # length (an online server batches by length bucket upstream
-            # if compile churn matters); a registered-prefix hit reuses
-            # the prefix's cached k/v and prefills only the suffix
-            entry = self._match_prefix(prompt)
-            if entry is not None:
-                self._m_prefix_hits.inc()
-                self._m_prefix_tokens.inc(int(entry[0].size))
-            logits, row_cache = self._prefill_with_prefixes(
-                prompt, self._extend_fn, self._extend_owned_fn,
-                self._prefill_fn, self.params, entry, 2,
-                self._fresh_row_fn)
-            if self.paged is not None:
-                from .models.paged_decode import install_row_paged
+            t_sub = self._submit_t.get(rid)
+            self.recorder.record(
+                rid, "admitted", slot=slot,
+                queue_wait_s=(None if t_sub is None
+                              else round(self._admit_t[rid] - t_sub, 6)))
+            # per-request context restore: this loop runs on the engine
+            # thread, but prefill (and any span/fault/event it emits)
+            # belongs to the request whose context was captured at
+            # submit — None for requests submitted without one
+            with use_context(self._trace_ctx.get(rid)):
+                # exact-length prefill: one compile per distinct prompt
+                # length (an online server batches by length bucket
+                # upstream if compile churn matters); a registered-
+                # prefix hit reuses the prefix's cached k/v and
+                # prefills only the suffix
+                entry = self._match_prefix(prompt)
+                if entry is not None:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(int(entry[0].size))
+                logits, row_cache = self._prefill_with_prefixes(
+                    prompt, self._extend_fn, self._extend_owned_fn,
+                    self._prefill_fn, self.params, entry, 2,
+                    self._fresh_row_fn)
+                if self.paged is not None:
+                    from .models.paged_decode import install_row_paged
 
-                nprefill = -(-prompt.size // self.paged[1])
-                self.pool = install_row_paged(
-                    self.pool, row_cache, self._tables[slot], nprefill)
-            else:
-                self.cache = self._install_fn(self.cache, row_cache,
-                                              slot)
-            if self.draft_config is not None:
-                _, d_row = self._prefill_with_prefixes(
-                    prompt, self._extend_draft_fn,
-                    self._extend_draft_owned_fn, self._prefill_draft_fn,
-                    self.draft_params, entry, 3, self._fresh_draft_row_fn)
-                self.draft_cache = self._install_draft_fn(
-                    self.draft_cache, d_row, slot)
-            if temp > 0:
-                self._key, sub = jax.random.split(self._key)
-                filt = _filter_logits_rows(
-                    logits[None] / temp,
-                    jnp.asarray([topk], jnp.int32),
-                    jnp.asarray([topp], jnp.float32))[0]
-                t0 = int(jax.random.categorical(sub, filt))
-            else:
-                t0 = int(jnp.argmax(logits))
+                    nprefill = -(-prompt.size // self.paged[1])
+                    self.pool = install_row_paged(
+                        self.pool, row_cache, self._tables[slot], nprefill)
+                else:
+                    self.cache = self._install_fn(self.cache, row_cache,
+                                                  slot)
+                if self.draft_config is not None:
+                    _, d_row = self._prefill_with_prefixes(
+                        prompt, self._extend_draft_fn,
+                        self._extend_draft_owned_fn,
+                        self._prefill_draft_fn, self.draft_params, entry,
+                        3, self._fresh_draft_row_fn)
+                    self.draft_cache = self._install_draft_fn(
+                        self.draft_cache, d_row, slot)
+                if temp > 0:
+                    self._key, sub = jax.random.split(self._key)
+                    filt = _filter_logits_rows(
+                        logits[None] / temp,
+                        jnp.asarray([topk], jnp.int32),
+                        jnp.asarray([topp], jnp.float32))[0]
+                    t0 = int(jax.random.categorical(sub, filt))
+                else:
+                    t0 = int(jnp.argmax(logits))
+            self.recorder.record(
+                rid, "prefill", prompt_tokens=int(prompt.size),
+                prefix_tokens=(0 if entry is None else int(entry[0].size)),
+                duration_s=round(time.monotonic() - self._admit_t[rid], 6))
             self._rid[slot] = rid
             self._outputs[rid] = []
             self._pos[slot] = prompt.size - 1
@@ -989,6 +1046,13 @@ class DecodeEngine:
             return False
         self._outputs[rid].append(tok)
         self._m_emitted.inc()
+        n = len(self._outputs[rid])
+        if n % self.TRACE_STEP_EVERY == 0:
+            # sampled decode progress on the flight recorder: enough to
+            # see a request advancing (or stalled) without one event
+            # per token
+            self.recorder.record(rid, "step", tokens=n,
+                                 pos=int(self._pos[slot]))
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
@@ -1000,11 +1064,13 @@ class DecodeEngine:
             self._slot_blocks[slot] = []
             self._tables[slot, :] = 0          # back to the scratch sink
 
-    def _retire_slot(self, slot: int) -> int:
+    def _retire_slot(self, slot: int, outcome: str = "finished") -> int:
         """Slot-retirement bookkeeping shared by normal completion and
         deadline enforcement: tokens move to ``_done``, the slot (and
-        paged blocks) frees, the deadline drops, latency is recorded.
-        Callers bump their own outcome counter/marker."""
+        paged blocks) frees, the deadline drops, latency is recorded,
+        and the flight recorder gets the terminal ``outcome`` event with
+        the per-stage durations. Callers bump their own outcome
+        counter/marker."""
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
@@ -1017,10 +1083,16 @@ class DecodeEngine:
             self._latency_window.append((t_adm - t_sub, now - t_sub))
             self._m_queue_wait.observe(t_adm - t_sub)
             self._m_request_latency.observe(now - t_sub)
+        self._trace_ctx.pop(rid, None)
+        self.recorder.record(
+            rid, outcome, tokens=len(self._done[rid]),
+            queue_wait_s=(None if t_sub is None
+                          else round(t_adm - t_sub, 6)),
+            total_s=(None if t_sub is None else round(now - t_sub, 6)))
         return rid
 
     def _finish(self, slot: int):
-        self._retire_slot(slot)
+        self._retire_slot(slot, "finished")
         self._m_finished.inc()
 
     @property
@@ -1221,3 +1293,18 @@ class DecodeEngine:
         self._timed_out.discard(rid)
         return {"tokens": tokens, "timeout": timed_out,
                 "expired": expired}
+
+    # ---------------------------------------------------------- tracing
+    def request_trace(self, rid: int) -> Optional[Dict]:
+        """The request's flight-recorder timeline ``{"id", "trace_id",
+        "events": [...]}`` — every event stamped with the trace id
+        captured at submit. Unlike :meth:`result` this is NOT one-shot
+        (it answers "what happened", possibly long after the result was
+        fetched), but it IS a bounded ring: old requests eventually
+        evict. None for unknown/evicted ids."""
+        return self.recorder.trace(rid)
+
+    def recent_traces(self, limit: int = 32) -> List[Dict]:
+        """The newest ``limit`` request timelines, oldest first (the
+        ``GET /debug/trace/recent`` payload)."""
+        return self.recorder.recent(limit)

@@ -31,6 +31,19 @@ Endpoints (JSON in/out):
   queue gauges, per-route request latency, fault injections — the
   docs' observability page has the catalog). The JSON ``/stats`` reads
   the same registry, so the two surfaces cannot drift.
+- ``GET /v1/requests/<id>/trace`` — the request's flight-recorder
+  timeline (queued/admitted/prefill/sampled steps/terminal outcome,
+  with per-stage durations), every event stamped with its trace id;
+  ``GET /debug/trace/recent`` — the newest timelines (``?limit=``).
+
+Distributed tracing (``docs/sources/tracing.md`` has the full story):
+every request runs under a :mod:`~elephas_tpu.obs.context`
+``TraceContext`` — the client's W3C ``traceparent`` header when present
+and well-formed, a freshly-generated root otherwise (a malformed header
+starts a new trace, never an error) — and every response carries
+``X-Trace-Id``. The context is captured at submit, so the engine-loop
+thread stamps the whole request lifetime with the same id, and
+parameter-plane RPCs issued under it forward the id to the PS.
 
 Overload safety (the serving-operations doc page has the full story):
 
@@ -53,12 +66,15 @@ Spark ``mapPartitions``); this is the online half of the framework's
 beyond-parity serving stack.
 """
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from .obs.context import (current_context, new_root, parse_traceparent,
+                          use_context)
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           default_registry, since_baseline)
 from .serving_engine import QueueFullError
@@ -72,7 +88,20 @@ _IDLE_SLEEP = 0.005
 #: "other", so a scanner probing random paths cannot grow label
 #: cardinality past the registry's bound
 _KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/v1/result",
-                 "/v1/generate", "/v1/submit", "/v1/cancel")
+                 "/v1/generate", "/v1/submit", "/v1/cancel",
+                 "/debug/trace/recent", "/v1/requests/:id/trace")
+
+#: per-request flight-recorder route: the id is normalized out of the
+#: metrics label (unbounded domain) but parsed for the lookup
+_TRACE_ROUTE_RE = re.compile(r"^/v1/requests/(\d+)/trace$")
+
+
+def _route_label(path: str) -> str:
+    if path in _KNOWN_ROUTES:
+        return path
+    if _TRACE_ROUTE_RE.match(path):
+        return "/v1/requests/:id/trace"
+    return "other"
 
 
 class _HTTPError(Exception):
@@ -199,7 +228,7 @@ class ServingServer:
 
     # ------------------------------------------------------------ metrics
     def _observe_http(self, path: str, status: int, t0: float):
-        route = path if path in _KNOWN_ROUTES else "other"
+        route = _route_label(path)
         dur = time.perf_counter() - t0
         labels = dict(route=route, status=str(int(status)))
         self._m_http_latency.labels(**labels).observe(dur)
@@ -229,6 +258,14 @@ class ServingServer:
             def log_message(self, *args):      # quiet, like the PS server
                 pass
 
+            def _trace_context(self):
+                """The request's trace context: the client's
+                ``traceparent`` when present and well-formed, a fresh
+                root otherwise — a malformed header silently starts a
+                new trace, never a 4xx/500."""
+                ctx = parse_traceparent(self.headers.get("traceparent"))
+                return ctx if ctx is not None else new_root()
+
             def _reply(self, code: int, body: bytes, content_type: str):
                 # record BEFORE the body goes out: a client must find
                 # its own request already counted if it scrapes /metrics
@@ -239,6 +276,11 @@ class ServingServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                ctx = current_context()
+                if ctx is not None:
+                    # the id the client joins its logs/timelines on —
+                    # echoed for propagated traces, minted for roots
+                    self.send_header("X-Trace-Id", ctx.trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -274,65 +316,94 @@ class ServingServer:
             def do_GET(self):
                 self._t0 = time.perf_counter()
                 url = urlparse(self.path)
-                try:
-                    if url.path == "/metrics":
-                        # Prometheus exposition: engine + server series
-                        # (and the process default registry). Lock-free
-                        # like /health — the registry takes per-family
-                        # locks only.
-                        self._reply(
-                            200, server._metrics_text().encode(),
-                            "text/plain; version=0.0.4; charset=utf-8")
-                    elif url.path == "/health":
-                        # lock-free read: liveness must answer instantly
-                        # even while the engine loop holds the lock
-                        # across a prefill compile (attribute reads are
-                        # atomic)
-                        failure = server._failure
-                        if failure is None:
-                            self._json(200, {"status": "ok"})
-                        else:
-                            self._json(500, {"status": "error",
-                                             "error": failure})
-                    elif url.path == "/ready":
-                        # readiness ≠ liveness: a warming or draining
-                        # server is alive but must not receive new
-                        # traffic. Lock-free, like /health.
-                        failure = server._failure
-                        if failure is not None:
-                            self._json(503, {"status": "failed",
-                                             "error": failure})
-                        elif server._draining or server._stop.is_set():
-                            self._json(503, {"status": "draining"})
-                        elif not server._ready:
-                            self._json(503, {"status": "warming"})
-                        else:
-                            self._json(200, {"status": "ready"})
-                    elif url.path == "/stats":
-                        with server._lock:
-                            stats = dict(server.engine.stats)
-                            stats["requests_drained"] = server._n_drained
-                            stats["draining"] = server._draining
-                        self._json(200, stats)
-                    elif url.path == "/v1/result":
-                        rid = parse_qs(url.query).get("id")
-                        try:
-                            rid = int(rid[0]) if rid else None
-                        except ValueError:
-                            rid = None
-                        if rid is None:
-                            self._json(400,
-                                       {"error": "missing/invalid id"})
-                            return
-                        self._json(200, server._poll(rid))
+                # every route runs under the request's trace context
+                # (inbound traceparent or a fresh root), so responses
+                # carry X-Trace-Id and anything emitted while handling
+                # — events, spans, faults — is stamped with the id
+                with use_context(self._trace_context()):
+                    try:
+                        self._get_routes(url)
+                    except _HTTPError as err:
+                        self._json(err.code, err.payload)
+
+            def _get_routes(self, url):
+                trace_route = _TRACE_ROUTE_RE.match(url.path)
+                if url.path == "/metrics":
+                    # Prometheus exposition: engine + server series
+                    # (and the process default registry). Lock-free
+                    # like /health — the registry takes per-family
+                    # locks only.
+                    self._reply(
+                        200, server._metrics_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif url.path == "/health":
+                    # lock-free read: liveness must answer instantly
+                    # even while the engine loop holds the lock
+                    # across a prefill compile (attribute reads are
+                    # atomic)
+                    failure = server._failure
+                    if failure is None:
+                        self._json(200, {"status": "ok"})
                     else:
-                        self._json(404, {"error": "unknown path"})
-                except _HTTPError as err:
-                    self._json(err.code, err.payload)
+                        self._json(500, {"status": "error",
+                                         "error": failure})
+                elif url.path == "/ready":
+                    # readiness ≠ liveness: a warming or draining
+                    # server is alive but must not receive new
+                    # traffic. Lock-free, like /health.
+                    failure = server._failure
+                    if failure is not None:
+                        self._json(503, {"status": "failed",
+                                         "error": failure})
+                    elif server._draining or server._stop.is_set():
+                        self._json(503, {"status": "draining"})
+                    elif not server._ready:
+                        self._json(503, {"status": "warming"})
+                    else:
+                        self._json(200, {"status": "ready"})
+                elif url.path == "/stats":
+                    with server._lock:
+                        stats = dict(server.engine.stats)
+                        stats["requests_drained"] = server._n_drained
+                        stats["draining"] = server._draining
+                    self._json(200, stats)
+                elif url.path == "/v1/result":
+                    rid = parse_qs(url.query).get("id")
+                    try:
+                        rid = int(rid[0]) if rid else None
+                    except ValueError:
+                        rid = None
+                    if rid is None:
+                        self._json(400,
+                                   {"error": "missing/invalid id"})
+                        return
+                    self._json(200, server._poll(rid))
+                elif trace_route is not None:
+                    # per-request flight recorder: lock-free by design
+                    # (the recorder has its own lock) — a timeline read
+                    # must not queue behind a stepping engine
+                    self._json(200, server._request_trace(
+                        int(trace_route.group(1))))
+                elif url.path == "/debug/trace/recent":
+                    limit = parse_qs(url.query).get("limit")
+                    try:
+                        limit = int(limit[0]) if limit else 32
+                    except ValueError:
+                        limit = 32
+                    self._json(200, server._recent_traces(limit))
+                else:
+                    self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
                 self._t0 = time.perf_counter()
                 url = urlparse(self.path)
+                # same contract as do_GET: the submit below runs with
+                # the context installed, which is where the engine
+                # captures it for the request's whole lifetime
+                with use_context(self._trace_context()):
+                    self._post_routes(url)
+
+            def _post_routes(self, url):
                 try:
                     body = self._body()
                 except _HTTPError as err:      # oversize body -> 413
@@ -350,6 +421,10 @@ class ServingServer:
                             self.send_response(200)
                             self.send_header("Content-Type",
                                              "application/x-ndjson")
+                            ctx = current_context()
+                            if ctx is not None:
+                                self.send_header("X-Trace-Id",
+                                                 ctx.trace_id)
                             self.end_headers()
 
                             def line(payload):
@@ -745,3 +820,28 @@ class ServingServer:
             self._results.pop(rid, None)
             self._cond.notify_all()   # wake a /v1/generate blocked on rid
             return {"cancelled": bool(cancelled)}
+
+    # ------------------------------------------------------------ tracing
+    def _request_trace(self, rid: int) -> Dict:
+        """``GET /v1/requests/<id>/trace``: the engine's flight-recorder
+        timeline for one request. Served WITHOUT the engine lock (the
+        recorder is independently thread-safe): the whole point of the
+        endpoint is answering "what happened to this request" while the
+        engine is busy or wedged."""
+        fn = getattr(self.engine, "request_trace", None)
+        trace = None if fn is None else fn(rid)
+        if trace is None:
+            raise _HTTPError(404, {
+                "status": "unknown",
+                "error": f"no flight-recorder timeline for request id "
+                         f"{rid} (never issued, or evicted from the "
+                         "bounded ring)"})
+        return trace
+
+    def _recent_traces(self, limit: int) -> Dict:
+        """``GET /debug/trace/recent``: the newest request timelines
+        (bounded; ``?limit=`` caps at 256)."""
+        fn = getattr(self.engine, "recent_traces", None)
+        if fn is None:
+            return {"requests": []}
+        return {"requests": fn(max(1, min(int(limit), 256)))}

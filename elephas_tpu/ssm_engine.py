@@ -18,6 +18,7 @@ Prefill rides the shared :func:`~elephas_tpu.models.ssm.ssm_prefill`;
 ``prefill_chunk`` bounds its compile shapes exactly like the
 transformer engine's.
 """
+import time
 from collections import deque
 from functools import partial
 from typing import Dict, List, Optional, Sequence
@@ -27,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .models.ssm import SSMConfig, init_ssm_state, ssm_decode_step, ssm_prefill
+from .obs.context import current_context, use_context
+from .obs.events import FlightRecorder
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
 from .obs.trace import span_if_counted
@@ -55,6 +58,9 @@ class SSMEngine:
         :class:`~elephas_tpu.serving_engine.DecodeEngine`'s; the HTTP
         server's ``GET /metrics`` reads it).
     """
+
+    #: flight-recorder decode sampling, mirroring DecodeEngine's
+    TRACE_STEP_EVERY = 8
 
     def __init__(self, params: Dict, config: SSMConfig,
                  max_slots: int = 8, temperature: float = 0.0,
@@ -87,6 +93,11 @@ class SSMEngine:
         self._done: Dict = {}
         self._fresh: Dict = {}
         self._next_rid = 0
+        # tracing: submit-time context per rid + the flight recorder
+        # (same contract as DecodeEngine's — the HTTP trace routes read
+        # either engine through request_trace/recent_traces)
+        self._trace_ctx: Dict[int, object] = {}
+        self.recorder = FlightRecorder()
         # registry-backed counters (the store behind .stats and /metrics)
         self.registry = reg = (registry if registry is not None
                                else MetricsRegistry())
@@ -221,6 +232,13 @@ class SSMEngine:
             raise ValueError("max_new_tokens must be >= 1")
         rid = self._next_rid
         self._next_rid += 1
+        ctx = current_context()
+        if ctx is not None:
+            self._trace_ctx[rid] = ctx
+        self.recorder.start(rid,
+                            trace_id=None if ctx is None else ctx.trace_id,
+                            prompt_tokens=int(prompt.size),
+                            max_new_tokens=int(max_new_tokens))
         self._queue.append((rid, prompt, int(max_new_tokens),
                             self.temperature if temperature is None
                             else float(temperature),
@@ -235,12 +253,18 @@ class SSMEngine:
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
+                self._trace_ctx.pop(rid, None)
+                self.recorder.record(rid, "cancelled", stage="queued")
                 return True
         for slot, r in enumerate(self._rid):
             if r == rid:
+                tokens = len(self._outputs.get(rid, ()))
                 self._outputs.pop(rid, None)
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
+                self._trace_ctx.pop(rid, None)
+                self.recorder.record(rid, "cancelled", stage="decoding",
+                                     tokens=tokens)
                 return True
         return False
 
@@ -268,16 +292,27 @@ class SSMEngine:
             if not self._queue:
                 return
             rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
-            logits, row = self._row_prefill(prompt)
-            self.state = self._install_fn(self.state, row, slot)
-            if temp > 0:
-                self._key, sub = jax.random.split(self._key)
-                filt = _filter_logits_rows(
-                    logits / temp, jnp.asarray([topk], jnp.int32),
-                    jnp.asarray([topp], jnp.float32))[0]
-                t0 = int(jax.random.categorical(sub, filt))
-            else:
-                t0 = int(jnp.argmax(logits[0]))
+            wait = self.recorder.age(rid)
+            self.recorder.record(
+                rid, "admitted", slot=slot,
+                queue_wait_s=None if wait is None else round(wait, 6))
+            t_pre = time.monotonic()
+            # restore the submitter's context around this request's
+            # prefill, exactly like DecodeEngine._admit
+            with use_context(self._trace_ctx.get(rid)):
+                logits, row = self._row_prefill(prompt)
+                self.state = self._install_fn(self.state, row, slot)
+                if temp > 0:
+                    self._key, sub = jax.random.split(self._key)
+                    filt = _filter_logits_rows(
+                        logits / temp, jnp.asarray([topk], jnp.int32),
+                        jnp.asarray([topp], jnp.float32))[0]
+                    t0 = int(jax.random.categorical(sub, filt))
+                else:
+                    t0 = int(jnp.argmax(logits[0]))
+            self.recorder.record(
+                rid, "prefill", prompt_tokens=int(prompt.size),
+                duration_s=round(time.monotonic() - t_pre, 6))
             self._rid[slot] = rid
             self._outputs[rid] = []
             self._last[slot] = t0
@@ -295,6 +330,9 @@ class SSMEngine:
             return False
         self._outputs[rid].append(tok)
         self._m_emitted.inc()
+        n = len(self._outputs[rid])
+        if n % self.TRACE_STEP_EVERY == 0:
+            self.recorder.record(rid, "step", tokens=n)
         self._budget[slot] -= 1
         if self._budget[slot] <= 0:
             self._finish(slot)
@@ -305,6 +343,11 @@ class SSMEngine:
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
         self._m_finished.inc()
+        self._trace_ctx.pop(rid, None)
+        total = self.recorder.age(rid)
+        self.recorder.record(
+            rid, "finished", tokens=len(self._done[rid]),
+            total_s=None if total is None else round(total, 6))
 
     # ------------------------------------------------------------- step
     @property
@@ -354,6 +397,15 @@ class SSMEngine:
 
     def result(self, rid: int) -> Optional[List[int]]:
         return self._done.pop(rid, None)
+
+    # ---------------------------------------------------------- tracing
+    def request_trace(self, rid: int) -> Optional[Dict]:
+        """Flight-recorder timeline for ``rid`` (same contract as
+        :meth:`DecodeEngine.request_trace`)."""
+        return self.recorder.trace(rid)
+
+    def recent_traces(self, limit: int = 32) -> List[Dict]:
+        return self.recorder.recent(limit)
 
     @property
     def stats(self) -> Dict[str, float]:

@@ -50,6 +50,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..obs.events import emit as emit_event
 from ..obs.metrics import default_registry
 
 #: environment variable holding a plan for spawned processes: either an
@@ -257,6 +258,10 @@ def fault_site(name: str) -> bool:
         "fault-plan events fired, by site and action",
         labels=("site", "action")).labels(
         site=name, action=ev.action).inc()
+    # ...and as a structured event carrying the ACTIVE trace id, so "did
+    # a fault hit *this* request" is answerable after the fact (the
+    # metric, by design, cannot carry per-request identity)
+    emit_event("fault.injected", site=name, action=ev.action)
     if ev.action == "delay":
         time.sleep(ev.delay)
         return False

@@ -10,17 +10,29 @@ The wire frame replaces the reference's 20-byte ASCII length + pickle
 (``elephas/utils/sockets.py:45-71``) with an 8-byte little-endian length
 prefix followed by an ETPU typed-tensor payload (:mod:`.tensor_codec`) — no
 arbitrary code execution on receive, and a format a C++ peer can speak.
+
+Trace-context frame extension: a client carrying an active
+:class:`~elephas_tpu.obs.context.TraceContext` prefixes an RPC with the
+opcode ``b'T'`` plus the fixed-length (55-byte) W3C ``traceparent``
+string; the parameter server applies it to the ONE RPC that follows.
+Backward compatible by construction — old clients never send ``b'T'``
+and the server's opcode loop is unchanged for them; the payload length
+is fixed, so even a malformed traceparent leaves the stream in sync.
 """
 import os
 import socket
 from socket import gethostbyname, gethostname
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.context import TRACEPARENT_LEN, TraceContext, parse_traceparent
 from .tensor_codec import KIND_WEIGHTS, MAX_FRAME_BYTES, decode, encode
 
 LENGTH_BYTES = 8
+
+#: opcode introducing a traceparent frame on the PS socket protocol
+TRACE_OPCODE = b"T"
 
 
 def determine_master(port: int = 4000) -> str:
@@ -97,3 +109,17 @@ def receive_frame(sock: socket.socket):
 def receive(sock: socket.socket) -> List[np.ndarray]:
     """Receive one ETPU frame; returns just the array list."""
     return receive_frame(sock)[0]
+
+
+def send_trace_context(sock: socket.socket, ctx: TraceContext) -> None:
+    """Send the trace-context frame extension (``b'T'`` + 55-byte
+    traceparent) ahead of an RPC's opcode."""
+    sock.sendall(TRACE_OPCODE + ctx.to_traceparent().encode("ascii"))
+
+
+def receive_traceparent(sock: socket.socket) -> Optional[TraceContext]:
+    """Read a ``b'T'`` frame's fixed-length payload (the opcode byte is
+    already consumed); None for a malformed traceparent — the fixed
+    length keeps the stream in sync either way."""
+    raw = _receive_all(sock, TRACEPARENT_LEN)
+    return parse_traceparent(raw.decode("ascii", "replace"))

@@ -219,7 +219,9 @@ def test_serving_server_metrics_consistent_with_stats(model):
         assert len(out["tokens"]) == 4
         stats = json.loads(_get_text(srv.port, "/stats")[0])
         text, ctype = _get_text(srv.port, "/metrics")
-        assert ctype.startswith("text/plain")
+        # scrapers key on the version parameter — the exact exposition
+        # content type, not just any text/plain
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
         samples, types = _parse_prometheus(text)
         # step-latency histogram buckets are present and populated
         assert types["serving_step_latency_seconds"] == "histogram"
@@ -304,7 +306,8 @@ def test_ps_http_server_metrics_endpoint_and_404():
             _get_text(port, "/no-such-route")
         assert err.value.code == 404
         text, ctype = _get_text(port, "/metrics")
-        assert ctype.startswith("text/plain")
+        # aligned with ServingServer's /metrics: the full 0.0.4 type
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8"
         samples, types = _parse_prometheus(text)
         assert types["ps_rpc_latency_seconds"] == "histogram"
         # the log_message replacement: method/path/status series exist

@@ -1,0 +1,365 @@
+"""End-to-end request tracing: W3C ``traceparent`` parsing, contextvar
+propagation, the per-request flight recorder and its HTTP endpoints,
+trace-id forwarding to the parameter servers over BOTH transports
+(old-frame clients still accepted), event-ring bounds under
+concurrency, and trace-id stamps on slow spans and injected faults."""
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.obs import (EventLog, clear_slow_spans, current_context,
+                             current_trace_id, new_root, parse_traceparent,
+                             recent_events, recent_slow_spans, span,
+                             use_context)
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+# ------------------------------------------------------------- context
+
+def test_traceparent_parse_format_round_trip():
+    ctx = parse_traceparent(TP)
+    assert ctx is not None
+    assert ctx.trace_id == "ab" * 16 and ctx.span_id == "cd" * 8
+    assert ctx.flags == 1
+    assert ctx.to_traceparent() == TP
+    # a child hop keeps the trace, renames the span
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+    # fresh roots are valid and unique
+    a, b = new_root(), new_root()
+    assert parse_traceparent(a.to_traceparent()) == a
+    assert a.trace_id != b.trace_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-xyz-abc-01",
+    "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",     # uppercase hex
+    "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",     # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",     # all-zero span id
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",     # forbidden version
+    "00-" + "ab" * 16 + "-" + "cd" * 8,             # missing flags
+])
+def test_malformed_traceparent_parses_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_context_is_scoped_and_thread_local():
+    assert current_context() is None
+    outer, inner = new_root(), new_root()
+    with use_context(outer):
+        assert current_trace_id() == outer.trace_id
+        with use_context(inner):
+            assert current_trace_id() == inner.trace_id
+        assert current_trace_id() == outer.trace_id
+        # a spawned thread does NOT inherit the contextvar
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(current_context()))
+        t.start()
+        t.join()
+        assert seen == [None]
+    assert current_context() is None
+
+
+# ----------------------------------------------------------- event log
+
+def test_event_ring_bounds_under_8_thread_concurrency():
+    log = EventLog(capacity=512)
+    n_threads, per_thread = 8, 1000
+
+    def worker(i):
+        ctx = new_root()
+        with use_context(ctx):
+            for k in range(per_thread):
+                log.emit("unit.test", worker=i, k=k)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = log.recent("unit.test")
+    # the ring holds exactly its capacity, newest events, all stamped
+    assert len(events) == 512
+    assert all(e["trace_id"] and e["at"] > 0 for e in events)
+
+
+def test_event_log_jsonl_sink(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(capacity=8, sink_path=str(path))
+    ctx = new_root()
+    with use_context(ctx):
+        for i in range(10):
+            log.emit("sink.test", i=i)
+    log.close()
+    lines = [json.loads(line) for line in
+             path.read_text().strip().splitlines()]
+    # the sink keeps EVERY event (it is the durable record); the ring
+    # keeps only the newest `capacity`
+    assert len(lines) == 10
+    assert all(e["trace_id"] == ctx.trace_id for e in lines)
+    assert len(log.recent("sink.test")) == 8
+
+
+# ------------------------------------------------------- serving engine
+
+@pytest.fixture(scope="module")
+def model():
+    from elephas_tpu.models.transformer import TransformerConfig, init_params
+
+    config = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                               d_model=16, d_ff=32, max_seq_len=40,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def test_context_restored_across_engine_loop_thread(model):
+    """The context is captured at submit; stepping OUTSIDE any context
+    (as the HTTP server's engine-loop thread does) must still stamp
+    every timeline event with the submit-time trace id."""
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    params, config = model
+    eng = DecodeEngine(params, config, max_slots=1)
+    ctx = new_root()
+    with use_context(ctx):
+        rid = eng.submit([1, 2, 3], 16, admit=False)
+    assert current_context() is None
+    while eng.pending:                  # context-less driver thread
+        eng.step()
+    trace = eng.request_trace(rid)
+    assert trace["trace_id"] == ctx.trace_id
+    names = [e["event"] for e in trace["events"]]
+    for expected in ("queued", "admitted", "prefill", "step", "finished"):
+        assert expected in names, names
+    assert all(e["trace_id"] == ctx.trace_id for e in trace["events"])
+    # per-stage durations ride the timeline
+    [admitted] = [e for e in trace["events"] if e["event"] == "admitted"]
+    assert admitted["queue_wait_s"] >= 0
+    [prefill] = [e for e in trace["events"] if e["event"] == "prefill"]
+    assert prefill["duration_s"] >= 0
+    [fin] = [e for e in trace["events"] if e["event"] == "finished"]
+    assert fin["tokens"] == 16 and fin["total_s"] >= 0
+
+
+def test_ssm_engine_flight_recorder(model):
+    from elephas_tpu.models.ssm import SSMConfig, init_ssm_params
+    from elephas_tpu.ssm_engine import SSMEngine
+
+    config = SSMConfig(vocab_size=64, num_layers=1, d_model=16, d_inner=32)
+    params = init_ssm_params(config, jax.random.PRNGKey(0))
+    eng = SSMEngine(params, config, max_slots=1)
+    ctx = new_root()
+    with use_context(ctx):
+        rid = eng.submit([1, 2, 3], 16, admit=False)
+    while eng.pending:
+        eng.step()
+    trace = eng.request_trace(rid)
+    assert trace["trace_id"] == ctx.trace_id
+    names = [e["event"] for e in trace["events"]]
+    for expected in ("queued", "admitted", "prefill", "step", "finished"):
+        assert expected in names, names
+    assert all(e["trace_id"] == ctx.trace_id for e in trace["events"])
+
+
+def test_flight_recorder_ring_is_bounded(model):
+    from elephas_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(max_requests=4, max_events=3)
+    for rid in range(10):
+        rec.start(rid, trace_id=f"t{rid}")
+        for k in range(5):
+            rec.record(rid, "step", k=k)
+    recent = rec.recent(limit=100)
+    assert [t["id"] for t in recent] == [6, 7, 8, 9]
+    assert rec.recent(limit=0) == []     # not the [-0:] whole-list trap
+    assert rec.trace(0) is None
+    # per-request event cap: queued fell off, the newest 3 remain
+    assert len(rec.trace(9)["events"]) == 3
+
+
+# --------------------------------------------------------- HTTP serving
+
+def _request(port, path, payload=None, headers=None):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers=dict({"Content-Type": "application/json"}, **(headers or {})))
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read()), dict(resp.headers)
+
+
+def test_http_round_trip_with_client_traceparent(model):
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.serving_http import ServingServer
+
+    params, config = model
+    eng = DecodeEngine(params, config, max_slots=2)
+    with ServingServer(eng) as srv:
+        out, hdrs = _request(srv.port, "/v1/submit",
+                             {"prompt": [1, 2, 3], "max_new_tokens": 12},
+                             headers={"traceparent": TP})
+        rid = out["id"]
+        # the response echoes the propagated trace id
+        assert hdrs.get("X-Trace-Id") == "ab" * 16
+        while True:
+            res, _ = _request(srv.port, f"/v1/result?id={rid}")
+            if res["status"] != "pending":
+                break
+        assert res["status"] == "done"
+        # the flight-recorder timeline carries the client's id end to end
+        trace, hdrs = _request(srv.port, f"/v1/requests/{rid}/trace")
+        assert trace["trace_id"] == "ab" * 16
+        names = [e["event"] for e in trace["events"]]
+        for expected in ("queued", "admitted", "prefill", "step",
+                         "finished"):
+            assert expected in names, names
+        assert all(e["trace_id"] == "ab" * 16 for e in trace["events"])
+        # ...and shows up in the recent-timelines debug view
+        recent, _ = _request(srv.port, "/debug/trace/recent")
+        assert any(t["id"] == rid and t["trace_id"] == "ab" * 16
+                   for t in recent["requests"])
+        # unknown id answers 404, not a crash
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _request(srv.port, "/v1/requests/99999/trace")
+        assert err.value.code == 404
+
+
+def test_malformed_traceparent_starts_new_root_not_500(model):
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.serving_http import ServingServer
+
+    params, config = model
+    with ServingServer(DecodeEngine(params, config, max_slots=1)) as srv:
+        out, hdrs = _request(srv.port, "/v1/generate",
+                             {"prompt": [1, 2], "max_new_tokens": 2},
+                             headers={"traceparent": "not-a-traceparent"})
+        assert out["status"] == "done"
+        minted = hdrs.get("X-Trace-Id")
+        # a fresh, valid root — not the garbage echoed back
+        assert minted and len(minted) == 32 and minted != "0" * 32
+        int(minted, 16)
+        # requests WITHOUT a header also get a root (ids always exist)
+        _, hdrs2 = _request(srv.port, "/v1/generate",
+                            {"prompt": [1, 2], "max_new_tokens": 2})
+        assert hdrs2.get("X-Trace-Id") not in (None, minted)
+
+
+# ------------------------------------------------------ parameter plane
+
+def _ps_model():
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.utils.serialization import model_to_dict
+
+    m = Sequential([Dense(4, input_dim=3), Dense(1)])
+    m.compile(SGD(learning_rate=0.1), "mse", seed=1)
+    return model_to_dict(m)
+
+
+def test_ps_http_rpc_carries_trace_id_to_server():
+    from elephas_tpu.parameter import HttpClient, HttpServer
+
+    port = 26902
+    server = HttpServer(_ps_model(), port, "asynchronous")
+    server.start()
+    ctx = new_root()
+    try:
+        client = HttpClient(port)
+        with use_context(ctx):
+            weights = client.get_parameters()
+            client.update_parameters([np.zeros_like(w) for w in weights])
+        client.get_parameters()            # context-less RPC still works
+    finally:
+        server.stop()
+    ops = sorted(e["op"] for e in recent_events("ps.rpc",
+                                                trace_id=ctx.trace_id))
+    assert ops == ["apply_delta", "get_weights"]
+
+
+def test_ps_socket_rpc_carries_trace_id_old_frames_accepted():
+    from elephas_tpu.parameter import SocketClient, SocketServer
+    from elephas_tpu.utils.sockets import receive
+
+    port = 26903
+    server = SocketServer(_ps_model(), port, "asynchronous")
+    server.start()
+    ctx = new_root()
+    try:
+        client = SocketClient(port)
+        with use_context(ctx):
+            weights = client.get_parameters()
+            client.update_parameters([np.zeros_like(w) for w in weights])
+        # same client, no context: no T frame on the wire (old framing)
+        assert len(client.get_parameters()) == len(weights)
+        client.close()
+        # a raw pre-extension client speaking only the old opcodes
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as raw:
+            raw.sendall(b"g")
+            assert len(receive(raw)) == len(weights)
+    finally:
+        server.stop()
+    traced = recent_events("ps.rpc", trace_id=ctx.trace_id)
+    assert sorted(e["op"] for e in traced) == ["apply_delta",
+                                              "get_weights"]
+    assert all(e["transport"] == "socket" for e in traced)
+    # the context applied to exactly the RPCs issued under it: the
+    # follow-up context-less pulls must NOT have inherited the id
+    untraced = [e for e in recent_events("ps.rpc")
+                if e["transport"] == "socket" and e["trace_id"] is None
+                and e["op"] == "get_weights"]
+    assert len(untraced) >= 2
+
+
+# ------------------------------------------------------ spans and faults
+
+def test_slow_span_ring_entries_carry_trace_id():
+    clear_slow_spans()
+    ctx = new_root()
+    from elephas_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    with use_context(ctx):
+        with span("unit.traced", registry=reg, threshold_s=0.0):
+            pass
+    with span("unit.untraced", registry=reg, threshold_s=0.0):
+        pass
+    [traced] = recent_slow_spans("unit.traced")
+    assert traced["trace_id"] == ctx.trace_id
+    [untraced] = recent_slow_spans("unit.untraced")
+    assert untraced["trace_id"] is None
+    clear_slow_spans()
+
+
+@pytest.mark.chaos
+def test_injected_fault_events_carry_trace_id(model):
+    from elephas_tpu.serving_engine import DecodeEngine, QueueFullError
+    from elephas_tpu.utils.faults import FaultPlan, clear_plan, install_plan
+
+    params, config = model
+    ctx = new_root()
+    install_plan(FaultPlan([{"site": "serving.submit", "action": "drop"}]))
+    try:
+        eng = DecodeEngine(params, config, max_slots=1)
+        with use_context(ctx):
+            with pytest.raises(QueueFullError):
+                eng.submit([1, 2, 3], 2)
+    finally:
+        clear_plan()
+    events = recent_events("fault.injected", trace_id=ctx.trace_id)
+    assert len(events) == 1
+    assert events[0]["site"] == "serving.submit"
+    assert events[0]["action"] == "drop"
+    # the shed itself is also an attributable structured event
+    sheds = recent_events("serving.shed", trace_id=ctx.trace_id)
+    assert len(sheds) == 1 and sheds[0]["reason"] == "injected"
