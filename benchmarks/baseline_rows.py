@@ -146,6 +146,34 @@ def measure_async(epochs=3, n=8192, batch_size=64):
                       "plain_loop = reference-parity 2-RPCs-per-batch"}
 
 
+def measure_ps_plane(payload_mb=16.0, shards=4, rounds=6):
+    """Parameter-plane row: get+push MB/s through one server vs a
+    sharded plane vs the pipelined push loop — the BENCH_r* trace of
+    the async-training RPC ceiling (shard servers in separate
+    processes; see benchmarks/ps_rpc_bench.py for the sweep)."""
+    import ps_rpc_bench as bench  # sibling module (script dir on sys.path)
+
+    port = 27351
+    sweep = bench.measure_payload_sweep(
+        port, sizes_mb=(payload_mb,), shard_counts=(1, shards),
+        rounds=rounds)
+    row = sweep["rows"][0]
+    pipeline = bench.measure_pipeline(port + 10, mb=payload_mb,
+                                      rounds=rounds)
+    return {"metric": "ps_plane_mb_per_sec",
+            "value": row[f"shards{shards}_mb_per_sec"],
+            "unit": "MB/s (get+push, socket loopback)",
+            "payload_mb": payload_mb, "rounds": rounds,
+            "single_mb_per_sec": row["shards1_mb_per_sec"],
+            "sharded_mb_per_sec": row[f"shards{shards}_mb_per_sec"],
+            "sharded_speedup": row.get("sharded_speedup"),
+            "pipelined_rounds_per_sec": pipeline["value"],
+            "pipeline_overlap_speedup": pipeline["overlap_speedup"],
+            "config": f"{payload_mb:g} MB payload, {shards} shards in "
+                      "separate processes, persistent sockets, "
+                      "cached-snapshot gets, zero-copy decode"}
+
+
 def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
     """Decode-throughput row: tokens/sec of the jitted KV-cache scan on
     the flagship LM config (serving path), bf16 weights vs weight-only
@@ -633,6 +661,8 @@ if __name__ == "__main__":
         _emit(measure_resnet50())
     if which in ("async", "all"):
         _emit(measure_async())
+    if which in ("ps_plane", "all"):
+        _emit(measure_ps_plane())
     if which in ("decode", "all"):
         _emit(measure_decode())
     if which in ("flash", "all"):

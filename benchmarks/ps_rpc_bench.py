@@ -1,37 +1,62 @@
-"""Parameter-server RPC round-trip: persistent vs per-RPC connections.
+"""Parameter-plane throughput: persistent sockets, payload sweeps,
+sharding, pipelined push.
 
-Host-side measurement (loopback TCP — no TPU involved): the socket
-client's default long-lived connection vs the reference-style fresh
-connection per RPC (``SocketClient(persistent=False)``), over the
-MNIST-MLP weight payload (~470 KB: 784-128-128-10). One "round" is the
-batch-frequency worker's wire work per batch: one ``get_parameters`` +
-one ``update_parameters``.
+Host-side measurement (loopback TCP — no TPU involved), four row
+families, one JSON line each:
 
-Per-RPC percentiles come from the observability layer's
-``ps_client_rpc_latency_seconds`` histogram (each client gets its own
-injected registry, so the A and B sides cannot pollute each other) —
-bench numbers and production ``/metrics`` latency come from the SAME
-instrumented code path in ``BaseParameterClient._with_retry``, not a
-hand-rolled timing list.
+1. ``ps_rpc_rounds_per_sec`` — the historical headline: persistent vs
+   per-RPC connections over the MNIST-MLP payload (~470 KB). One
+   "round" is the batch-frequency worker's wire work per batch: one
+   ``get_parameters`` + one ``update_parameters``. Comparable to the
+   chip row in ``benchmarks/chip_results.jsonl``.
+2. ``ps_plane_payload_sweep`` — synthetic flat weight lists of 1/16/64
+   MB pushed through 1 vs 4 shards, MB/s alongside rounds/s. Shard
+   servers run in SEPARATE PROCESSES (the deployment the sharded plane
+   exists for — in-process shard threads would share one GIL and
+   measure nothing), spawned via this script's ``--serve`` child mode;
+   the payload is derived deterministically from (size, tensors) so
+   nothing crosses the process boundary but the port.
+3. ``ps_pipeline_overlap`` — blocking vs pipelined push loop with a
+   synthetic compute phase per round: how much of the wire time the
+   worker's ``pipeline=True`` mode hides.
+4. Per-op p50/p99 from the observability layer's
+   ``ps_client_rpc_latency_seconds`` histogram (per-side injected
+   registries) — bench numbers and production ``/metrics`` latency come
+   from the SAME instrumented code path in
+   ``BaseParameterClient._with_retry``.
 
-Prints one JSON line:
-  {"metric": "ps_rpc_rounds_per_sec", "value": P, "fresh": F,
-   "speedup": P/F, "latency_ms": {...}, ...}
+``--smoke`` runs every row family with a tiny payload and one or two
+rounds (seconds, CPU-only) so CI exercises the full script and it
+cannot silently rot.
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-from elephas_tpu.models import SGD, Activation, Dense, Sequential
-from elephas_tpu.obs import MetricsRegistry
-from elephas_tpu.parameter.client import SocketClient
-from elephas_tpu.parameter.server import SocketServer
-from elephas_tpu.utils.serialization import model_to_dict
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elephas_tpu.obs import MetricsRegistry                     # noqa: E402
+from elephas_tpu.parameter.client import SocketClient           # noqa: E402
+from elephas_tpu.parameter.server import SocketServer           # noqa: E402
+from elephas_tpu.parameter.sharding import (ShardPlan,          # noqa: E402
+                                            ShardedParameterClient)
+from elephas_tpu.utils.serialization import model_to_dict       # noqa: E402
+
+#: payload sizes (MB) for the sweep; the acceptance row compares 4
+#: shards vs 1 on the >= 16 MB sizes
+SWEEP_MB = (1.0, 16.0, 64.0)
+SWEEP_SHARDS = (1, 4)
+#: tensors per synthetic payload — enough for even 4-way bin-packing
+SWEEP_TENSORS = 32
 
 
-def _server(port: int) -> SocketServer:
+def _mnist_server(port: int) -> SocketServer:
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+
     model = Sequential([Dense(128, input_dim=784), Activation("relu"),
                         Dense(128), Activation("relu"),
                         Dense(10), Activation("softmax")])
@@ -39,6 +64,17 @@ def _server(port: int) -> SocketServer:
     server = SocketServer(model_to_dict(model), port, "asynchronous")
     server.start()
     return server
+
+
+def _payload_model(mb: float, tensors: int = SWEEP_TENSORS) -> dict:
+    """Deterministic synthetic weight list of ~``mb`` MB (float32), the
+    same in every process that derives it — shard children rebuild it
+    from (mb, tensors) instead of receiving it over a pipe."""
+    n = max(1, int(mb * (1 << 20) / 4 / tensors))
+    rng = np.random.default_rng(1234)
+    return {"model": None,
+            "weights": [rng.random(n, dtype=np.float32)
+                        for _ in range(tensors)]}
 
 
 def _rpc_quantiles_ms(registry: MetricsRegistry) -> dict:
@@ -56,38 +92,238 @@ def _rpc_quantiles_ms(registry: MetricsRegistry) -> dict:
     return out
 
 
-def _measure(client: SocketClient, rounds: int):
+def _measure_rounds(client, rounds: int):
     weights = client.get_parameters()  # warm (and the delta template)
     delta = [np.zeros_like(w) for w in weights]
+    client.update_parameters(delta)    # warm the push lane too (TCP
+    # windows + fresh pages) — with few rounds at large payloads a cold
+    # first push otherwise dominates the sample
     start = time.perf_counter()
     for _ in range(rounds):
         client.get_parameters()
         client.update_parameters(delta)
     elapsed = time.perf_counter() - start
-    return rounds / elapsed, _rpc_quantiles_ms(client.registry)
+    return rounds / elapsed
 
 
-def main(port: int = 27311, rounds: int = 200):
-    server = _server(port)
+# --------------------------------------------------------- shard children
+
+def _serve_shard(mb: float, tensors: int, port: int, num_shards: int,
+                 shard: int):
+    """Child-process mode: host ONE shard of the deterministic payload
+    on ``port`` until stdin closes (the parent holds the pipe)."""
+    model = _payload_model(mb, tensors)
+    plan = ShardPlan.plan(model["weights"], num_shards)
+    server = SocketServer(plan.shard_model(model)[shard], port,
+                          "asynchronous", shard=shard)
+    server.start()
+    print("READY", flush=True)
+    sys.stdin.read()  # EOF = parent is done
+    server.stop()
+
+
+def _spawn_shards(mb: float, tensors: int, port: int, num_shards: int):
+    """The shard-server fleet as separate processes; returns the procs
+    after each printed READY (listening). A child that dies before
+    READY fails the spawn — with the already-started siblings torn
+    down, so no orphaned servers squat on the port range."""
+    procs = []
+    try:
+        for i in range(num_shards):
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--serve",
+                 str(mb), str(tensors), str(port + i), str(num_shards),
+                 str(i)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            if "READY" not in line:
+                raise RuntimeError(f"shard server failed to start: {line!r}")
+    except BaseException:
+        _stop_shards(procs)
+        raise
+    return procs
+
+
+def _stop_shards(procs):
+    for p in procs:
+        try:
+            p.stdin.close()
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover — stuck child
+            p.kill()
+            p.wait()
+
+
+def _sharded_client(model, port: int, num_shards: int,
+                    registry=None):
+    plan = ShardPlan.plan(model["weights"], num_shards)
+    subs = [SocketClient(port=port + i, registry=registry)
+            for i in range(num_shards)]
+    if num_shards == 1:
+        return subs[0]
+    return ShardedParameterClient(subs, plan)
+
+
+def measure_payload_sweep(port: int, sizes_mb=SWEEP_MB,
+                          shard_counts=SWEEP_SHARDS, rounds=None,
+                          tensors: int = SWEEP_TENSORS) -> dict:
+    """rounds/s and MB/s per (payload size, shard count); one round =
+    get + push of the full payload (so ~2x the payload crosses the wire
+    per round)."""
+    rows = []
+    for mb in sizes_mb:
+        model = _payload_model(mb, tensors)
+        n_rounds = rounds if rounds else max(6, int(64 / mb))
+        per_size = {"payload_mb": mb, "rounds": n_rounds}
+        for shards in shard_counts:
+            procs = _spawn_shards(mb, tensors, port, shards)
+            try:
+                registry = MetricsRegistry()
+                client = _sharded_client(model, port, shards,
+                                         registry=registry)
+                rps = _measure_rounds(client, n_rounds)
+                client.close()
+            finally:
+                _stop_shards(procs)
+            per_size[f"shards{shards}_rounds_per_sec"] = round(rps, 2)
+            per_size[f"shards{shards}_mb_per_sec"] = round(2 * mb * rps, 1)
+            if shards == min(shard_counts):
+                per_size["latency_ms"] = _rpc_quantiles_ms(registry)
+        lo, hi = min(shard_counts), max(shard_counts)
+        if lo != hi:
+            per_size["sharded_speedup"] = round(
+                per_size[f"shards{hi}_rounds_per_sec"]
+                / per_size[f"shards{lo}_rounds_per_sec"], 3)
+        rows.append(per_size)
+    out = {"metric": "ps_plane_payload_sweep",
+           "unit": "rounds/sec + MB/s (get+push, socket loopback, "
+                   "shard servers in separate processes)",
+           "tensors": tensors, "rows": rows}
+    big = [r["sharded_speedup"] for r in rows
+           if r.get("sharded_speedup") and r["payload_mb"] >= 16]
+    if big:
+        # the acceptance scalar: best shard speedup in the >= 16 MB
+        # class (small payloads are latency-bound; sharding targets the
+        # bandwidth/compute-bound regime)
+        out["value"] = max(big)
+        out["speedup_ge_16mb"] = max(big)
+    return out
+
+
+def measure_pipeline(port: int, mb: float = 16.0, rounds: int = 8,
+                     tensors: int = SWEEP_TENSORS) -> dict:
+    """Blocking vs pipelined push with a synthetic compute phase: the
+    worker's ``pipeline=True`` loop hides the push behind the next
+    round's compute (one in-flight push max, staleness 1)."""
+    from elephas_tpu.worker import _PipelinedPusher
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA
+
+    model = _payload_model(mb, tensors)
+    delta = [np.zeros_like(w) for w in model["weights"]]
+
+    # synthetic compute: cache-resident BLAS (GIL-released, FLOP-bound)
+    # — like a real training step, and unlike elementwise passes over
+    # the payload, it does not fight the push for the host's memory
+    # bandwidth (on a bandwidth-bound host two memory-bound phases
+    # cannot overlap no matter how they are threaded)
+    a = np.random.default_rng(7).random((384, 384), dtype=np.float32)
+    matmuls = max(1, int(40 * mb / 16))
+
+    def compute():
+        acc = a
+        for _ in range(matmuls):
+            acc = a @ a
+        return acc
+
+    procs = _spawn_shards(mb, tensors, port, 1)
+    try:
+        client = SocketClient(port=port, registry=MetricsRegistry())
+        client.get_parameters()     # warm the connection
+
+        compute()
+        client.push_frame(delta, KIND_DELTA)   # warm both phases
+        start = time.perf_counter()
+        for _ in range(rounds):
+            compute()
+            client.push_frame(delta, KIND_DELTA)
+        blocking = rounds / (time.perf_counter() - start)
+
+        pusher = _PipelinedPusher(client)
+        try:
+            start = time.perf_counter()
+            for _ in range(rounds):
+                compute()
+                pusher.submit(delta, KIND_DELTA)
+            pusher.drain()
+            pipelined = rounds / (time.perf_counter() - start)
+        finally:
+            pusher.close()
+        client.close()
+    finally:
+        _stop_shards(procs)
+    return {"metric": "ps_pipeline_overlap",
+            "value": round(pipelined, 2),
+            "unit": "rounds/sec (compute + push, socket loopback)",
+            "payload_mb": mb, "rounds": rounds, "matmuls": matmuls,
+            "blocking_rounds_per_sec": round(blocking, 2),
+            "overlap_speedup": round(pipelined / blocking, 3)}
+
+
+def measure_headline(port: int, rounds: int = 200) -> dict:
+    """The historical persistent-vs-fresh row (MNIST-MLP payload)."""
+    server = _mnist_server(port)
     try:
         client_p = SocketClient(port=port, persistent=True,
                                 registry=MetricsRegistry())
-        persistent, lat_p = _measure(client_p, rounds)
+        persistent = _measure_rounds(client_p, rounds)
+        lat_p = _rpc_quantiles_ms(client_p.registry)
         client_p.close()   # the A side must not linger into the B run
-        fresh, lat_f = _measure(
-            SocketClient(port=port, persistent=False,
-                         registry=MetricsRegistry()), rounds)
+        client_f = SocketClient(port=port, persistent=False,
+                                registry=MetricsRegistry())
+        fresh = _measure_rounds(client_f, rounds)
+        lat_f = _rpc_quantiles_ms(client_f.registry)
     finally:
         server.stop()
-    out = {"metric": "ps_rpc_rounds_per_sec", "value": round(persistent, 1),
-           "unit": "rounds/sec (get+update, MNIST-MLP weights)",
-           "fresh": round(fresh, 1),
-           "speedup": round(persistent / fresh, 3),
-           "latency_ms": lat_p, "fresh_latency_ms": lat_f,
-           "rounds": rounds, "transport": "socket loopback (host-side)"}
-    print(json.dumps(out))
+    return {"metric": "ps_rpc_rounds_per_sec", "value": round(persistent, 1),
+            "unit": "rounds/sec (get+update, MNIST-MLP weights)",
+            "fresh": round(fresh, 1),
+            "speedup": round(persistent / fresh, 3),
+            "latency_ms": lat_p, "fresh_latency_ms": lat_f,
+            "rounds": rounds, "transport": "socket loopback (host-side)"}
+
+
+def main(port: int = 27311, smoke: bool = False):
+    out = []
+    if smoke:
+        # tiny payloads, minimal rounds: every row family and code path
+        # (subprocess shards included) in a few seconds, for CI
+        out.append(measure_headline(port, rounds=3))
+        out.append(measure_payload_sweep(port + 10, sizes_mb=(0.25,),
+                                         shard_counts=(1, 2), rounds=2))
+        out.append(measure_pipeline(port + 20, mb=0.25, rounds=2))
+    else:
+        out.append(measure_headline(port))
+        out.append(measure_payload_sweep(port + 10))
+        out.append(measure_pipeline(port + 20))
+    for row in out:
+        print(json.dumps(row))
     return out
 
 
 if __name__ == "__main__":
-    main(port=int(sys.argv[1]) if len(sys.argv) > 1 else 27311)
+    args = [a for a in sys.argv[1:]]
+    if args and args[0] == "--serve":
+        _serve_shard(float(args[1]), int(args[2]), int(args[3]),
+                     int(args[4]), int(args[5]))
+        sys.exit(0)
+    smoke = "--smoke" in args
+    args = [a for a in args if a != "--smoke"]
+    main(port=int(args[0]) if args else 27311, smoke=smoke)

@@ -40,6 +40,8 @@ PAGES = [
      ["BaseParameterServer", "HttpServer", "SocketServer"]),
     ("Parameter clients", "elephas_tpu.parameter.client",
      ["BaseParameterClient", "HttpClient", "SocketClient"]),
+    ("Parameter-plane sharding", "elephas_tpu.parameter.sharding",
+     ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient"]),
     ("Parallel trainers", "elephas_tpu.parallel.sync_trainer",
      ["SyncAverageTrainer", "SyncStepTrainer", "build_sharded_predict",
       "build_sharded_evaluate"]),

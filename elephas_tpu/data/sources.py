@@ -301,10 +301,19 @@ def _route_read(bounds: np.ndarray, lo: int, hi: int, fetch,
 def _route_take(bounds: np.ndarray, idx: np.ndarray, fetch,
                 row_shape: Tuple[int, ...], dtype) -> np.ndarray:
     """Gather fancy-indexed rows from bounded chunks:
-    ``fetch(chunk, local_idx) -> rows``."""
+    ``fetch(chunk, local_idx) -> rows``.
+
+    Chunks are fetched in order of FIRST APPEARANCE in ``idx``, not
+    sorted chunk order: a shuffled streaming epoch hands consecutive
+    ``take`` calls indices that interleave across a window of adjacent
+    chunks, and stream-order fetching leaves the decode LRU holding the
+    chunks the NEXT call starts with (sorted order could end a
+    straddling batch on its lowest-numbered chunks and evict exactly
+    the ones about to be reused)."""
     out = np.empty((idx.size,) + tuple(row_shape), dtype=dtype)
     owner = np.searchsorted(bounds, idx, side="right") - 1
-    for c in np.unique(owner):
+    chunks, first = np.unique(owner, return_index=True)
+    for c in chunks[np.argsort(first)]:
         mask = owner == c
         out[mask] = fetch(int(c), idx[mask] - int(bounds[c]))
     return out

@@ -35,7 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..models import losses as losses_mod
 from ..models import metrics as metrics_mod
 from ..models.core import BaseModel
-from ..data.sources import ColumnSource
+from ..data.sources import ColumnSource, ParquetSource
 from .mesh import worker_mesh
 
 
@@ -53,6 +53,14 @@ def _take_rows(col, idx: np.ndarray) -> np.ndarray:
     return col.take(idx) if isinstance(col, ColumnSource) else col[idx]
 
 
+#: inner chunks jointly shuffled per window — DERIVED from the sources'
+#: decode LRU so the invariant window <= LRU can't silently break: a
+#: window of W inner chunks touches at most W row groups per column,
+#: all simultaneously resident, so windowed mixing costs zero extra
+#: decodes
+_SHUFFLE_WINDOW = ParquetSource._LRU_SIZE
+
+
 def _epoch_permutation(x, y, n: int, n_pad: int, shuffle: bool,
                        rng) -> np.ndarray:
     """The epoch's sample visit order.
@@ -62,14 +70,20 @@ def _epoch_permutation(x, y, n: int, n_pad: int, shuffle: bool,
     (Parquet row groups, shard files), hierarchically: the coarsest
     chunked column's groups set the outer visit order, the merged
     boundaries of ALL chunked columns cut each outer group into inner
-    chunks, and rows shuffle within each inner chunk. Every inner chunk
-    lies inside one group of every column, and a column's groups stay
-    adjacent at their own level — so a shuffled streaming epoch decodes
-    the coarse column's groups exactly once and a finer column's at
-    most once per outer group it overlaps, instead of once per batch
-    that touches them. (Chunk-level shuffle is the standard out-of-core
-    trade: slightly less mixing for O(data) less decode IO.) Padding
-    rows sort to the end; they are masked, never read.
+    chunks, and rows shuffle jointly across a small WINDOW of inner
+    chunks (:data:`_SHUFFLE_WINDOW`, sized to the decode LRU). Every
+    inner chunk lies inside one group of every column, and a column's
+    groups stay adjacent at their own level — so a shuffled streaming
+    epoch decodes the coarse column's groups exactly once and a finer
+    column's at most once per outer group it overlaps, instead of once
+    per batch that touches them. The window is the within-batch mixing
+    fix: shuffling rows only within one chunk left every global batch
+    drawn from a single row group (highly correlated samples when the
+    file is sorted); interleaving across a window mixes each batch over
+    several row groups while the LRU keeps the decode-once property.
+    (Chunk-level shuffle is the standard out-of-core trade: slightly
+    less mixing for O(data) less decode IO.) Padding rows sort to the
+    end; they are masked, never read.
     """
     if not shuffle:
         return np.arange(n_pad)
@@ -88,11 +102,19 @@ def _epoch_permutation(x, y, n: int, n_pad: int, shuffle: bool,
                   for lo, hi in zip(inner[:-1], inner[1:]) if hi > lo]
         if chunks:
             parts.append(chunks)
-    out = []
+    ordered = []
     for ci in rng.permutation(len(parts)):
         chunks = parts[ci]
         for ii in rng.permutation(len(chunks)):
-            out.append(rng.permutation(chunks[ii]))
+            ordered.append(chunks[ii])
+    # windows deliberately straddle outer-group boundaries: with window
+    # size == LRU size, a window's rows touch at most 2 consecutive
+    # groups of EVERY column (both resident), so each group still
+    # decodes once while batches mix across group boundaries
+    out = []
+    for w in range(0, len(ordered), _SHUFFLE_WINDOW):
+        window = np.concatenate(ordered[w:w + _SHUFFLE_WINDOW])
+        out.append(rng.permutation(window))
     out.append(np.arange(n, n_pad))
     return np.concatenate(out)
 

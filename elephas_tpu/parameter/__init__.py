@@ -1,5 +1,8 @@
 from .client import BaseParameterClient, HttpClient, SocketClient
 from .factory import (ClientServerFactory, HttpFactory, SocketFactory,
-                      Transport, available_transports, get_transport,
-                      register_transport)
+                      Transport, available_transports,
+                      create_sharded_client, create_sharded_server,
+                      get_transport, register_transport)
 from .server import BaseParameterServer, HttpServer, SocketServer
+from .sharding import (ShardedParameterClient, ShardedServerGroup,
+                       ShardPlan)

@@ -20,7 +20,7 @@ from ..obs.context import current_context
 from ..obs.metrics import default_registry
 from ..utils.delta_compression import quantize_delta
 from ..utils.faults import InjectedFault, fault_site
-from ..utils.sockets import (determine_master, receive, send,
+from ..utils.sockets import (determine_master, receive, recv_exact, send,
                              send_trace_context)
 from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
                                   encode)
@@ -217,7 +217,10 @@ class HttpClient(BaseParameterClient):
         return self._with_retry(op, "get_parameters")
 
     def push_frame(self, arrays: List[np.ndarray], kind: int):
-        payload = bytes(encode(arrays, kind))
+        # the encoder's bytearray goes to urllib as-is — bytes-like with
+        # a len() for Content-Length; a bytes() round would re-copy the
+        # whole frame per push
+        payload = encode(arrays, kind)
         # one id per logical update, stable across retries: the server
         # drops duplicates so a lost ack can't double-apply the delta
         update_id = uuid.uuid4().hex
@@ -343,7 +346,13 @@ class SocketClient(BaseParameterClient):
 
             def rpc(sock):
                 sock.sendall(b"g")
-                return receive(sock)
+                # zero-copy pull: the arrays view this message's own
+                # receive buffer (fresh per frame, nothing reuses it),
+                # so a 100MB weight pull costs recv_into + header parse
+                # — no per-tensor materialization. The buffer is a
+                # bytearray, so the views stay writable for callers
+                # that update weights in place.
+                return receive(sock, copy=False)
             return self._run_op(rpc)
         return self._with_retry(op, "get_parameters")
 
@@ -357,7 +366,10 @@ class SocketClient(BaseParameterClient):
             def rpc(sock):
                 sock.sendall(b"U" + update_id)
                 send(sock, arrays, kind=kind)
-                ack = sock.recv(1)  # block until the delta is applied
+                # hardened fixed-length read: a half-closed peer raises
+                # ConnectionError (retried) instead of returning b""
+                # and being misread as a bad ack
+                ack = bytes(recv_exact(sock, 1))  # blocks until applied
                 if ack == b"k" and fault_site("client.push_ack"):
                     # the server applied and acked; eat the ack so the
                     # retry resends the SAME id (idempotency scenario)
@@ -380,6 +392,8 @@ class SocketClient(BaseParameterClient):
         try:
             with self._connect(timeout=5.0) as sock:
                 sock.sendall(b"h")
-                return sock.recv(1) == b"k"
+                # recv_exact: EOF raises (caught below as unhealthy)
+                # rather than comparing b"" and falling through oddly
+                return bytes(recv_exact(sock, 1)) == b"k"
         except _TRANSIENT:
             return False

@@ -53,6 +53,46 @@ register_transport("http", HttpClient, HttpServer)
 register_transport("socket", SocketClient, SocketServer)
 
 
+def create_sharded_server(name: str, model, port: int, mode: str,
+                          num_shards: int, **kwargs):
+    """A parameter plane of ``num_shards`` servers of transport ``name``
+    on consecutive ports ``port .. port+num_shards-1``.
+
+    ``num_shards=1`` returns an ordinary single server (no group
+    wrapper, no behavior change) — callers can pass the configured
+    shard count straight through.
+    """
+    transport = get_transport(name)
+    if int(num_shards) <= 1:
+        return transport.create_server(model, port, mode, **kwargs)
+    from .sharding import ShardedServerGroup
+
+    return ShardedServerGroup(transport, model, port, mode, num_shards,
+                              **kwargs)
+
+
+def create_sharded_client(name: str, port: int, model, num_shards: int,
+                          compression=None, **kwargs):
+    """The matching client: a plain transport client for one shard, a
+    :class:`~elephas_tpu.parameter.sharding.ShardedParameterClient`
+    (per-shard sub-clients, parallel fan-out) otherwise.
+
+    ``model`` supplies the weight list (or shapes) the shard plan is
+    derived from — the plan is deterministic, so client and server
+    agree without exchanging it.
+    """
+    transport = get_transport(name)
+    if int(num_shards) <= 1:
+        return transport.create_client(port, compression=compression,
+                                       **kwargs)
+    from .sharding import ShardedParameterClient, ShardPlan
+
+    plan = ShardPlan.plan(model["weights"], num_shards)
+    clients = [transport.create_client(port + i, **kwargs)
+               for i in range(plan.num_shards)]
+    return ShardedParameterClient(clients, plan, compression=compression)
+
+
 class ClientServerFactory:
     """Back-compat shim over the registry: ``get_factory(name)`` returns the
     :class:`Transport`, which has the same ``create_client``/``create_server``

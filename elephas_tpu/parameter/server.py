@@ -15,6 +15,52 @@ process on the coordinator host (the reference's Flask/raw-socket pair,
 
 Both servers hold the authoritative weights as a flat numpy list — the
 wire currency — so no JAX device state lives on the serving threads.
+
+The hot path is copy-frugal: pushes decode delta frames as zero-copy
+views of the receive buffer (``apply_delta`` only reads them), and pulls
+are served from a **cached encoded snapshot** — the wire payload is
+rebuilt at most once per weight version (every applied delta bumps the
+version) and repeated ``get_parameters`` traffic costs one ``sendall``
+of the same immutable buffer, zero encode work (``encoded_weights``;
+rebuilds are counted in ``encode_count``).
+
+## Sharding the parameter plane
+
+One server caps async scaling at one process's RPC throughput. With
+``ps_shards=N`` (:class:`~elephas_tpu.tpu_model.TPUModel`) the flat
+weight list is partitioned across N server instances on consecutive
+ports ``port .. port+N-1`` by greedy byte-size bin-packing — tensors
+visited largest-first, each placed on the lightest bin, ties broken by
+index so every process derives the identical
+:class:`~elephas_tpu.parameter.sharding.ShardPlan` without exchanging
+it. The matching
+:class:`~elephas_tpu.parameter.sharding.ShardedParameterClient` fans
+pulls/pushes out over per-shard persistent connections on parallel
+threads and reassembles results in plan order, over either transport.
+
+Consistency: each shard applies a worker's delta atomically under its
+own lock, but there is no cross-shard transaction — a concurrent pull
+may observe shard A before a given push and shard B after it, and a
+push whose retries exhaust on one shard after siblings applied lands
+TORN (that shard's slice lost; for async SGD one partial gradient, the
+same class of perturbation as a lost delta — emitted as a
+``ps.sharded_push_torn`` event, and the lagging shard drags the
+group-min ``num_updates`` progress signal). That is the standard
+sharded-PS trade (Li et al., OSDI 2014), and no weaker than the
+staleness asynchronous SGD already tolerates. Supervision is per
+shard: a dead shard is rebuilt from its own snapshot on its own port
+while the survivors keep serving (see the fault-tolerance guide).
+
+## Pipelined async push
+
+``ps_pipeline=True`` double-buffers the reference-parity worker loops:
+the delta push for batch/epoch *k* runs on a background thread over its
+own connection while *k+1* computes. At most ONE push is in flight —
+a pull can miss at most the single racing push (staleness bounded at
+1) — and a push error is parked and re-raised at the worker's next
+sync point, so supervisor crash/restart semantics are unchanged. The
+overlapped device-resident schedule (``async_overlap=True``) already
+pipelines through its communicator thread and subsumes this flag.
 """
 import abc
 import logging
@@ -39,15 +85,19 @@ from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
 from ..utils.sockets import (TRACE_OPCODE, determine_master, receive_frame,
-                             receive_traceparent, send)
+                             receive_traceparent, recv_exact, send_payload)
 from ..utils.delta_compression import dequantize_delta
-from ..utils.tensor_codec import (KIND_DELTA_Q8, decode, decode_weights,
-                                  encode_weights)
+from ..utils.tensor_codec import KIND_DELTA_Q8, decode, encode_weights
 
 
 def _decode_delta(payload: bytes):
-    """Decode a delta push, dequantizing int8-compressed frames."""
-    arrays, kind = decode(payload)
+    """Decode a delta push, dequantizing int8-compressed frames.
+
+    Zero-copy decode: ``apply_delta`` only READS the delta
+    (``subtract_params`` allocates the new weights) and the request body
+    is this call's own buffer, so the views never outlive their frame.
+    """
+    arrays, kind = decode(payload, copy=False)
     if kind == KIND_DELTA_Q8:
         return dequantize_delta(arrays)
     return arrays
@@ -60,12 +110,25 @@ class BaseParameterServer(abc.ABC):
         self.port = port
         self.mode = mode
         self.custom_objects = kwargs.get("custom_objects")
+        #: which shard of a sharded parameter plane this server holds
+        #: ("0" for the unsharded default) — a metric label, so one
+        #: scrape splits RPC traffic per shard
+        self.shard = str(kwargs.get("shard", 0))
         # ``model`` is the model_to_dict payload; the server only needs the
         # weight list (the architecture rides along for parity/save paths).
         self.model_config = model.get("model")
         self.weights: List[np.ndarray] = [np.asarray(w, dtype=np.float32)
                                           for w in model["weights"]]
         self.lock = RWLock()
+        # cached encoded snapshot of the weights: get-heavy sync traffic
+        # serves sendall(cached_bytes) with ZERO encode work. The cache
+        # is invalidated by bumping _weights_version on every mutation
+        # and rebuilt lazily, at most once per version; encode_count
+        # counts actual rebuilds (the no-re-encode test hook).
+        self._weights_version = 0
+        self._enc_lock = threading.Lock()
+        self._enc_cache: Optional[tuple] = None  # (version, payload)
+        self.encode_count = 0
         #: applied-update counter — cheap liveness/progress signal surfaced
         #: through the health endpoints (own lock: hogwild bypasses the
         #: weight RWLock, and a bare += would lose increments across threads)
@@ -93,14 +156,14 @@ class BaseParameterServer(abc.ABC):
         self._m_rpc_latency = reg.histogram(
             "ps_rpc_latency_seconds",
             "parameter-server RPC service time (receive through reply)",
-            labels=("transport", "op"))
+            labels=("transport", "op", "shard"))
         self._m_rpc_total = reg.counter(
             "ps_rpc_total", "parameter-server RPCs served",
-            labels=("transport", "op", "status"))
+            labels=("transport", "op", "status", "shard"))
         self._m_rpc_bytes = reg.counter(
             "ps_rpc_bytes_total",
             "tensor payload bytes moved by PS RPCs",
-            labels=("transport", "direction"))
+            labels=("transport", "direction", "shard"))
         self._m_http_requests = reg.counter(
             "ps_http_requests_total",
             "PS HTTP requests by method, path, and status "
@@ -118,20 +181,20 @@ class BaseParameterServer(abc.ABC):
         context-less callers), joinable against the serving side's
         flight-recorder timelines."""
         duration = time.perf_counter() - t0
-        self._m_rpc_latency.labels(transport=transport, op=op).observe(
-            duration)
+        self._m_rpc_latency.labels(transport=transport, op=op,
+                                   shard=self.shard).observe(duration)
         self._m_rpc_total.labels(transport=transport, op=op,
-                                 status=status).inc()
+                                 status=status, shard=self.shard).inc()
         # the event carries the SAME duration the histogram observed,
         # so joining the two surfaces for one RPC is exact
         emit_event("ps.rpc", transport=transport, op=op, status=status,
                    duration_s=round(duration, 6))
         if bytes_in:
-            self._m_rpc_bytes.labels(transport=transport,
-                                     direction="in").inc(bytes_in)
+            self._m_rpc_bytes.labels(transport=transport, direction="in",
+                                     shard=self.shard).inc(bytes_in)
         if bytes_out:
-            self._m_rpc_bytes.labels(transport=transport,
-                                     direction="out").inc(bytes_out)
+            self._m_rpc_bytes.labels(transport=transport, direction="out",
+                                     shard=self.shard).inc(bytes_out)
 
     def get_weights(self) -> List[np.ndarray]:
         fault_site("ps.get_weights")
@@ -142,6 +205,34 @@ class BaseParameterServer(abc.ABC):
         finally:
             if self.mode == "asynchronous":
                 self.lock.release()
+
+    def encoded_weights(self) -> bytes:
+        """The current weights as one wire-encoded ETPU payload, served
+        from a cached snapshot: invalidated when a delta lands (the
+        version counter moves), rebuilt at most once per version —
+        get-heavy sync traffic costs ``sendall(cached_bytes)`` and zero
+        encode work. Concurrent getters serialize on the rebuild and
+        then share the same immutable payload."""
+        fault_site("ps.get_weights")
+        with self._enc_lock:
+            if self.mode == "asynchronous":
+                self.lock.acquire_read()
+            try:
+                version = self._weights_version
+                if (self._enc_cache is not None
+                        and self._enc_cache[0] == version):
+                    return self._enc_cache[1]
+                # the encoder's bytearray is served as-is (bytes-like for
+                # sendall/HTTP): nothing mutates it after this point —
+                # invalidation REPLACES the cache tuple — and a bytes()
+                # round would re-copy the whole payload per rebuild
+                payload = encode_weights(self.weights)
+                self.encode_count += 1
+            finally:
+                if self.mode == "asynchronous":
+                    self.lock.release()
+            self._enc_cache = (version, payload)
+            return payload
 
     def snapshot(self) -> Dict[str, Any]:
         """Restartable server state: weights, the applied-update counter,
@@ -173,6 +264,8 @@ class BaseParameterServer(abc.ABC):
         try:
             self.weights = [np.asarray(w, dtype=np.float32).copy()
                             for w in snapshot["weights"]]
+            with self._counter_lock:
+                self._weights_version += 1  # drop any cached encoding
         finally:
             if self.mode == "asynchronous":
                 self.lock.release()
@@ -217,6 +310,11 @@ class BaseParameterServer(abc.ABC):
                 self.lock.acquire_write()
             try:
                 self.weights = subtract_params(self.weights, delta)
+                # invalidate the encoded snapshot (under _counter_lock:
+                # hogwild bypasses the RWLock, and a lost increment
+                # would leave the cache serving stale weights forever)
+                with self._counter_lock:
+                    self._weights_version += 1
             finally:
                 if self.mode == "asynchronous":
                     self.lock.release()
@@ -327,7 +425,9 @@ class HttpServer(BaseParameterServer):
                     content_type = ("text/plain; version=0.0.4; "
                                     "charset=utf-8")
                 elif self.path.startswith("/parameters"):
-                    body = encode_weights(server.get_weights())
+                    # cached encoded snapshot: no per-request encode (or
+                    # weight copy) while the version is unchanged
+                    body = server.encoded_weights()
                     server._obs_rpc("http", "get_weights", "ok", t0,
                                     bytes_out=len(body))
                 else:
@@ -527,14 +627,14 @@ class SocketServer(BaseParameterServer):
                     if opcode in (b"u", b"U"):
                         update_id = None
                         if opcode == b"U":
-                            raw = bytearray()
-                            while len(raw) < 32:
-                                chunk = conn.recv(32 - len(raw))
-                                if not chunk:
-                                    return
-                                raw += chunk
-                            update_id = raw.decode("ascii", "replace")
-                        arrays, kind = receive_frame(conn)
+                            update_id = recv_exact(conn, 32).decode(
+                                "ascii", "replace")
+                        # copy=False: the delta arrays view the receive
+                        # buffer — safe here because apply_delta only
+                        # READS them (subtract_params allocates the new
+                        # weights), so the hot push path decodes with
+                        # zero tensor copies
+                        arrays, kind = receive_frame(conn, copy=False)
                         nbytes_in = sum(int(a.nbytes) for a in arrays)
                         delta = (dequantize_delta(arrays)
                                  if kind == KIND_DELTA_Q8 else arrays)
@@ -555,11 +655,13 @@ class SocketServer(BaseParameterServer):
                         self._obs_rpc("socket", "apply_delta", "ok", t0,
                                       bytes_in=nbytes_in)
                     elif opcode == b"g":
-                        weights = self.get_weights()
-                        send(conn, weights)
-                        self._obs_rpc(
-                            "socket", "get_weights", "ok", t0,
-                            bytes_out=sum(int(w.nbytes) for w in weights))
+                        # cached encoded snapshot: repeated gets cost one
+                        # sendall of the same immutable payload — no
+                        # weight copy, no re-encode
+                        payload = self.encoded_weights()
+                        send_payload(conn, payload)
+                        self._obs_rpc("socket", "get_weights", "ok", t0,
+                                      bytes_out=len(payload))
                     elif opcode == b"h":
                         conn.sendall(b"k")  # alive
                         self._obs_rpc("socket", "health", "ok", t0)

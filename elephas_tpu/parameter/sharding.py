@@ -1,0 +1,361 @@
+"""Sharded parameter plane: partition the weight list across N servers.
+
+One parameter server caps async scaling at one process's RPC
+throughput — every worker's pull and push funnels through it. The
+classic fix (Li et al., *Scaling Distributed Machine Learning with the
+Parameter Server*, OSDI 2014) shards the parameters across server
+instances so pulls and pushes fan out and the wire work parallelizes.
+
+Three pieces:
+
+- :class:`ShardPlan` — a deterministic partition of the flat weight
+  list over ``num_shards`` bins by greedy byte-size bin-packing
+  (largest tensor first onto the lightest bin), with ``split``/``merge``
+  to scatter a flat array list into per-shard sublists and gather them
+  back in original order. The plan is a pure function of the weight
+  shapes and the shard count, so every client and server derives the
+  SAME plan independently — nothing about the partition crosses the
+  wire.
+- :class:`ShardedServerGroup` — N ordinary parameter servers (any
+  registered transport) on consecutive ports ``port .. port+N-1``, each
+  holding its shard's weights. Per-shard ``snapshot``/``restore``/
+  ``restart_shard`` keep ``ps_auto_restart`` working: a dead shard is
+  rebuilt from ITS snapshot while the surviving shards keep serving.
+- :class:`ShardedParameterClient` — fans ``get_parameters`` /
+  ``update_parameters`` out over per-shard clients in parallel threads
+  and reassembles results in plan order. Works over both HTTP and
+  socket transports (each sub-client keeps its own persistent
+  connection, retry loop, and metrics).
+
+Consistency/staleness semantics and the operator-facing overview live
+ONCE in :mod:`elephas_tpu.parameter.server`'s module docstring (the
+"Sharding the parameter plane" section of the parameter-servers guide)
+— edit them there, not here.
+
+Exposed as ``ps_shards=N`` on :class:`~elephas_tpu.tpu_model.TPUModel`
+and via :func:`~elephas_tpu.parameter.factory.create_sharded_server` /
+:func:`~elephas_tpu.parameter.factory.create_sharded_client`.
+"""
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .client import BaseParameterClient
+
+__all__ = ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient"]
+
+
+def _nbytes(shape, dtype=np.float32) -> int:
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+class ShardPlan:
+    """A deterministic partition of ``len(sizes)`` tensors over
+    ``num_shards`` bins, balanced by byte size.
+
+    Greedy bin-packing: tensors are visited largest-first (ties broken
+    by index, so the plan is total-order deterministic) and each goes
+    to the currently lightest bin (ties broken by bin index). Within a
+    bin, tensors keep their original relative order — reassembly is a
+    stable scatter/gather, not a sort.
+    """
+
+    def __init__(self, assignments: Sequence[Sequence[int]],
+                 sizes: Sequence[int]):
+        self.assignments: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(a) for a in assignments)
+        self.sizes = tuple(int(s) for s in sizes)
+        seen = sorted(i for part in self.assignments for i in part)
+        if seen != list(range(len(self.sizes))):
+            raise ValueError("assignments must cover every tensor index "
+                             "exactly once")
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.assignments)
+
+    @property
+    def shard_bytes(self) -> Tuple[int, ...]:
+        """Total payload bytes per shard (the balance the packing
+        optimizes)."""
+        return tuple(sum(self.sizes[i] for i in part)
+                     for part in self.assignments)
+
+    @classmethod
+    def plan(cls, weights: Sequence, num_shards: int) -> "ShardPlan":
+        """Plan from a list of arrays (or shape tuples, float32 assumed).
+
+        ``num_shards`` may exceed the tensor count; the excess bins are
+        empty (their servers hold zero weights and answer every pull
+        with an empty list — harmless, but a waste of ports).
+        """
+        num_shards = int(num_shards)
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        sizes = []
+        for w in weights:
+            if hasattr(w, "nbytes"):
+                sizes.append(int(np.asarray(w).nbytes))
+            else:
+                sizes.append(_nbytes(tuple(w)))
+        loads = [0] * num_shards
+        bins: List[List[int]] = [[] for _ in range(num_shards)]
+        # largest first, ties by index — deterministic across processes
+        for idx in sorted(range(len(sizes)),
+                          key=lambda i: (-sizes[i], i)):
+            b = min(range(num_shards), key=lambda j: (loads[j], j))
+            loads[b] += sizes[idx]
+            bins[b].append(idx)
+        return cls([sorted(b) for b in bins], sizes)
+
+    def split(self, arrays: Sequence, group: int = 1) -> List[List]:
+        """Scatter a flat list into per-shard sublists (plan order).
+
+        ``group`` is the per-tensor stride in ``arrays``: 1 for plain
+        weight/delta lists, 2 for ``KIND_DELTA_Q8`` frames where tensor
+        ``i`` owns the interleaved ``(data, scale)`` pair at
+        ``arrays[2i:2i+2]``.
+        """
+        if len(arrays) != group * len(self.sizes):
+            raise ValueError(
+                f"expected {group * len(self.sizes)} arrays "
+                f"(group={group}), got {len(arrays)}")
+        return [[arrays[group * i + k] for i in part for k in range(group)]
+                for part in self.assignments]
+
+    def merge(self, parts: Sequence[Sequence], group: int = 1) -> List:
+        """Gather per-shard sublists back into the flat original order
+        (inverse of :meth:`split`)."""
+        out: List = [None] * (group * len(self.sizes))
+        for part, arrays in zip(self.assignments, parts):
+            if len(arrays) != group * len(part):
+                raise ValueError(
+                    f"shard returned {len(arrays)} arrays, plan expects "
+                    f"{group * len(part)}")
+            for j, i in enumerate(part):
+                for k in range(group):
+                    out[group * i + k] = arrays[group * j + k]
+        return out
+
+    def shard_model(self, model: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Per-shard ``model_to_dict``-style payloads: each carries its
+        shard's weight sublist (the architecture config rides along on
+        every shard — it is small and keeps the save/parity surface of
+        :class:`~elephas_tpu.parameter.server.BaseParameterServer`
+        intact)."""
+        parts = self.split(list(model["weights"]))
+        return [{"model": model.get("model"), "weights": part}
+                for part in parts]
+
+
+class _Fanout:
+    """Run one callable per shard on a PERSISTENT thread pool; collect
+    results in shard order; re-raise the first failure AFTER every call
+    has finished (a straggler RPC must not be abandoned mid-frame on a
+    persistent connection).
+
+    The pool lives as long as its owner: batch-frequency workers fan
+    out twice per round (pull + push) plus health probes, and spawning
+    N fresh threads per RPC is GIL-held overhead repaid on every
+    round."""
+
+    def __init__(self, size: int):
+        self._pool = ThreadPoolExecutor(
+            max_workers=size, thread_name_prefix="elephas-tpu-ps-shard")
+
+    def run(self, fns: Sequence) -> List:
+        if len(fns) == 1:           # no pool tax for the 1-shard case
+            return [fns[0]()]
+        futures = [self._pool.submit(fn) for fn in fns]
+        results: List = [None] * len(fns)
+        first: Optional[BaseException] = None
+        for i, fut in enumerate(futures):  # waits for EVERY call
+            try:
+                results[i] = fut.result()
+            except BaseException as err:  # noqa: BLE001 — re-raised below
+                first = first or err
+        if first is not None:
+            raise first
+        return results
+
+    def close(self):
+        # no wait: close() must not block behind a stuck in-flight RPC
+        self._pool.shutdown(wait=False)
+
+
+class ShardedParameterClient(BaseParameterClient):
+    """Client for a :class:`ShardedServerGroup`: one sub-client per
+    shard, RPCs fanned out on parallel threads, results reassembled in
+    plan order.
+
+    Each sub-client keeps its own transport state (persistent socket,
+    retry/backoff loop, latency metrics), so a slow or restarting shard
+    costs only its own lane. ``compression`` lives HERE, not on the
+    sub-clients: a compressed push quantizes the full delta once and
+    ships each shard its slice of the quantized frame.
+    """
+
+    client_type = "sharded"
+
+    def __init__(self, clients: Sequence[BaseParameterClient],
+                 plan: ShardPlan, compression: Optional[str] = None):
+        if len(clients) != plan.num_shards:
+            raise ValueError(
+                f"{len(clients)} clients for a {plan.num_shards}-shard plan")
+        self.clients = list(clients)
+        self.plan = plan
+        self.compression = self._check_compression(compression)
+        self._fanout = _Fanout(len(self.clients))
+
+    def clone(self) -> "ShardedParameterClient":
+        return ShardedParameterClient([c.clone() for c in self.clients],
+                                      self.plan,
+                                      compression=self.compression)
+
+    def get_parameters(self) -> List[np.ndarray]:
+        parts = self._fanout.run([c.get_parameters for c in self.clients])
+        return self.plan.merge(parts)
+
+    def push_frame(self, arrays: List[np.ndarray], kind: int):
+        """Fan one update out to every shard.
+
+        There is NO cross-shard transaction: if one shard exhausts its
+        sub-client retries after siblings already applied, the update
+        lands torn (some tensors updated, the failed shard's slice
+        lost). For asynchronous SGD that is one partial gradient — the
+        same class of perturbation as a lost delta, which the training
+        mode already tolerates — but it is observable: a partial
+        failure emits a ``ps.sharded_push_torn`` event before the error
+        propagates (and the failed shard's ``num_updates`` lags, which
+        the group-min progress signal surfaces)."""
+        from ..obs.events import emit as emit_event
+        from ..utils.tensor_codec import KIND_DELTA_Q8
+
+        group = 2 if kind == KIND_DELTA_Q8 else 1
+        parts = self.plan.split(list(arrays), group=group)
+        applied = [False] * len(self.clients)
+
+        def push_one(i, c, p):
+            def call():
+                c.push_frame(p, kind)
+                applied[i] = True
+            return call
+
+        try:
+            self._fanout.run([push_one(i, c, p) for i, (c, p)
+                              in enumerate(zip(self.clients, parts))])
+        except BaseException:
+            if any(applied):
+                emit_event("ps.sharded_push_torn",
+                           shards_applied=sum(applied),
+                           shards_total=len(applied))
+            raise
+
+    def health_check(self) -> bool:
+        return all(self._fanout.run([c.health_check
+                                     for c in self.clients]))
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+        self._fanout.close()
+
+
+class ShardedServerGroup:
+    """N parameter servers (one transport) on ports ``port..port+N-1``,
+    each holding one shard of the weight list.
+
+    Presents the single-server admin surface (``start``/``stop``/
+    ``snapshot``/``restore``/``num_updates``) plus the per-shard
+    operations ``ps_auto_restart`` supervision needs: a dead shard is
+    rebuilt from its own snapshot on its own port
+    (:meth:`restart_shard`) while the others keep serving.
+    """
+
+    def __init__(self, transport, model: Dict[str, Any], port: int,
+                 mode: str, num_shards: int, **kwargs):
+        self.transport = transport
+        self.port = int(port)
+        self.mode = mode
+        self.kwargs = dict(kwargs)
+        self.plan = ShardPlan.plan(model["weights"], num_shards)
+        self._shard_models = self.plan.shard_model(model)
+        self.servers = [
+            transport.create_server(self._shard_models[i], self.port + i,
+                                    mode, shard=i, **self.kwargs)
+            for i in range(self.plan.num_shards)]
+
+    @property
+    def num_shards(self) -> int:
+        return self.plan.num_shards
+
+    @property
+    def num_updates(self) -> int:
+        """Progress signal: the MINIMUM applied-update count across
+        shards — every worker push touches every shard, so the slowest
+        shard's counter is the number of fully-landed updates."""
+        return min(s.num_updates for s in self.servers)
+
+    def start(self):
+        started = []
+        try:
+            for s in self.servers:
+                s.start()
+                started.append(s)
+        except BaseException:
+            for s in started:      # no half-started group left behind
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            raise
+
+    def stop(self):
+        first: Optional[BaseException] = None
+        for s in self.servers:
+            try:
+                s.stop()
+            except Exception as err:  # stop every shard before raising
+                first = first or err
+        if first is not None:
+            raise first
+
+    def get_weights(self) -> List[np.ndarray]:
+        """The full reassembled weight list (driver-side convenience —
+        remote callers use :class:`ShardedParameterClient`)."""
+        return self.plan.merge([s.get_weights() for s in self.servers])
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"shards": [s.snapshot() for s in self.servers]}
+
+    def restore(self, snapshot: Dict[str, Any]):
+        shards = snapshot["shards"]
+        if len(shards) != len(self.servers):
+            raise ValueError(
+                f"snapshot has {len(shards)} shards, group has "
+                f"{len(self.servers)}")
+        for s, snap in zip(self.servers, shards):
+            s.restore(snap)
+
+    def snapshot_shard(self, i: int) -> Dict[str, Any]:
+        return self.servers[i].snapshot()
+
+    def restart_shard(self, i: int, snapshot: Dict[str, Any]):
+        """Kill→restart recovery for ONE shard: stop whatever is left of
+        the old server, rebuild it from ``snapshot`` on the same port,
+        start it. Workers reconnect through their sub-clients' retry
+        path; the restored idempotency window keeps in-flight resends
+        deduplicated."""
+        try:
+            self.servers[i].stop()
+        except Exception:
+            pass  # already dead — the port is what matters
+        server = self.transport.create_server(
+            {"model": self._shard_models[i].get("model"),
+             "weights": snapshot["weights"]},
+            self.port + i, self.mode, shard=i, **self.kwargs)
+        server.restore(snapshot)
+        server.start()
+        self.servers[i] = server
+        return server

@@ -184,7 +184,20 @@ class TPUModel:
     :param ps_auto_restart: supervise the parameter server too: snapshot
         it while healthy and restart it from the latest snapshot on the
         same port if it dies mid-fit (probed every
-        ``ps_probe_interval`` seconds); workers reconnect via retry
+        ``ps_probe_interval`` seconds); workers reconnect via retry.
+        A sharded plane is supervised per shard: only the dead shard is
+        rebuilt (from its own snapshot) while the survivors keep serving
+    :param ps_shards: partition the weight list across this many
+        parameter servers on consecutive ports ``port..port+N-1``
+        (greedy byte-size bin-packing), with a fan-out client that
+        pulls/pushes all shards on parallel connections — lifts the
+        single-server RPC ceiling on async training (default 1)
+    :param ps_pipeline: double-buffer delta pushes in the
+        reference-parity worker loops: the push for batch/epoch *k*
+        overlaps computation of *k+1* (one in-flight push max, staleness
+        bounded at 1, errors surfaced at the next sync point). Subsumed
+        by ``async_overlap`` only at batch frequency, where the
+        overlapped communicator runs and already pipelines its RPCs
     """
 
     def __init__(self, model: BaseModel, mode: str = "asynchronous",
@@ -258,18 +271,30 @@ class TPUModel:
                 f"ps_probe_interval must be > 0, got "
                 f"{self.ps_probe_interval}")
         self.max_ps_restarts = max(0, int(kwargs.pop("max_ps_restarts", 5)))
+        # sharded parameter plane: partition the weight list across N
+        # servers on ports port..port+N-1 (greedy byte-size bin-packing)
+        # so pulls/pushes fan out in parallel instead of funneling
+        # through one server's RPC throughput
+        self.ps_shards = int(kwargs.pop("ps_shards", 1))
+        if self.ps_shards < 1:
+            raise ValueError(f"ps_shards must be >= 1, got {self.ps_shards}")
+        # pipelined async push: the delta push for batch/epoch k runs on
+        # a background thread and overlaps computation of k+1 (one
+        # in-flight push max, staleness bounded at 1)
+        self.ps_pipeline = bool(kwargs.pop("ps_pipeline", False))
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)
         self.parameter_server = None
         self.client = None
         if self.mode != "synchronous":
-            transport = get_transport(self.parameter_server_mode)
-            self.parameter_server = transport.create_server(
-                self.serialized_model, self.port, self.mode,
+            from .parameter.factory import create_sharded_server
+
+            self.parameter_server = create_sharded_server(
+                self.parameter_server_mode, self.serialized_model,
+                self.port, self.mode, self.ps_shards,
                 custom_objects=self.custom_objects)
-            self.client = transport.create_client(
-                self.port, compression=self.delta_compression)
+            self.client = self._make_client()
 
         self._replica = None  # lazily-built worker replica for predict/eval
         # trainers cached across fit() calls so their jitted epoch
@@ -307,6 +332,10 @@ class TPUModel:
             config["ps_probe_interval"] = self.ps_probe_interval
         if self.max_ps_restarts != 5:
             config["max_ps_restarts"] = self.max_ps_restarts
+        if self.ps_shards != 1:
+            config["ps_shards"] = self.ps_shards
+        if self.ps_pipeline:
+            config["ps_pipeline"] = True
         config.update(self.kwargs)
         return config
 
@@ -332,13 +361,32 @@ class TPUModel:
     def start_server(self):
         self.parameter_server.start()
 
+    def _make_client(self):
+        """A parameter client matching the configured plane — plain
+        transport client, or a sharded fan-out client derived from the
+        same deterministic shard plan the server group uses."""
+        from .parameter.factory import create_sharded_client
+
+        return create_sharded_client(
+            self.parameter_server_mode, self.port, self.serialized_model,
+            self.ps_shards, compression=self.delta_compression)
+
     def _ps_supervision(self):
         """(probe, restart) hooks for the worker supervisor's parameter-
         server watchdog. The probe snapshots the live server while it is
         healthy; restart rebuilds a server of the same transport on the
         same port from the latest snapshot and starts it — workers
         reconnect through the client retry path, with the idempotency
-        window carried over so in-flight resends stay deduplicated."""
+        window carried over so in-flight resends stay deduplicated.
+
+        A sharded plane supervises per shard: each shard is probed and
+        snapshotted independently, and a restart rebuilds ONLY the dead
+        shard(s) from their own snapshots while the survivors keep
+        serving."""
+        from .parameter.sharding import ShardedServerGroup
+
+        if isinstance(self.parameter_server, ShardedServerGroup):
+            return self._sharded_ps_supervision()
         import time as _time
 
         state = {"snapshot": self.parameter_server.snapshot(),
@@ -378,6 +426,50 @@ class TPUModel:
             server.restore(state["snapshot"])
             server.start()
             self.parameter_server = server
+
+        return probe, restart
+
+    def _sharded_ps_supervision(self):
+        """Per-shard (probe, restart) hooks for a sharded plane. The
+        probe health-checks every shard through its own sub-client and
+        snapshots each healthy shard on the same cadence the
+        single-server path uses; restart rebuilds only the shards whose
+        probe failed, each from ITS latest snapshot on its own port —
+        the surviving shards never stop serving."""
+        import time as _time
+
+        group = self.parameter_server
+        subs = self.client.clients     # one probe lane per shard
+        now = _time.monotonic()
+        state = [{"snapshot": group.snapshot_shard(i), "t": now}
+                 for i in range(group.num_shards)]
+        for st in state:
+            st["at"] = st["snapshot"]["num_updates"]
+        min_spacing = max(5 * self.ps_probe_interval, 2.0)
+
+        def probe() -> bool:
+            ok = True
+            for i, sub in enumerate(subs):
+                if not sub.health_check():
+                    ok = False
+                    continue
+                try:
+                    server = group.servers[i]
+                    t = _time.monotonic()
+                    if (server.num_updates != state[i]["at"]
+                            and t - state[i]["t"] >= min_spacing):
+                        snap = server.snapshot()
+                        state[i] = {"snapshot": snap,
+                                    "at": snap["num_updates"], "t": t}
+                except Exception:
+                    pass  # keep serving the previous snapshot
+            return ok
+
+        def restart():
+            for i, sub in enumerate(subs):
+                if sub.health_check():
+                    continue       # this shard is fine — leave it alone
+                group.restart_shard(i, state[i]["snapshot"])
 
         return probe, restart
 
@@ -678,9 +770,10 @@ class TPUModel:
             # rebuild this process's client against the resolved address
             # (the HTTP client binds its URL at construction)
             coordinator_bind_env(self.port)
-            transport = get_transport(self.parameter_server_mode)
-            self.client = transport.create_client(
-                self.port, compression=self.delta_compression)
+            # _make_client honors ps_shards: the worker processes need
+            # the same fan-out client the coordinator uses, resolved
+            # against the broadcast coordinator address
+            self.client = self._make_client()
         serving = (not multi) or is_coordinator()
 
         # Multi-host discipline: a barrier skipped by ONE process hangs
@@ -805,6 +898,7 @@ class TPUModel:
                         compute_dtype=self.master_compute_dtype,
                         overlap=self.async_overlap,
                         accum_batches=self.async_accum,
+                        pipeline=self.ps_pipeline,
                         epoch_event=(
                             (lambda e, l, _m=shard_idx:
                              aggregator.report(e, l, member=_m))

@@ -154,9 +154,13 @@ def encode_tensors_native(arrays: Sequence[np.ndarray],
     return out  # bytearray: bytes-like for sendall/urllib without a copy
 
 
-def decode_tensors_native(payload) -> Optional[Tuple[List[np.ndarray], int]]:
+def decode_tensors_native(payload,
+                          copy: bool = True
+                          ) -> Optional[Tuple[List[np.ndarray], int]]:
     """Native decode of ``bytes`` or ``bytearray`` (the zero-copy receive
-    path); returns None when the library is unavailable."""
+    path); returns None when the library is unavailable. ``copy=False``
+    returns arrays that VIEW ``payload`` in place (same aliasing
+    contract as :func:`~elephas_tpu.utils.tensor_codec.decode_tensors`)."""
     lib = _load()
     if lib is None:
         return None
@@ -188,10 +192,11 @@ def decode_tensors_native(payload) -> Optional[Tuple[List[np.ndarray], int]]:
         dtype = _CODE_DTYPES[code]
         count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
         start = offsets[i]
-        # one allocation per tensor: frombuffer views the payload in place
+        # frombuffer views the payload in place; copy=True materializes
+        # one owned allocation per tensor, copy=False hands the view out
         arr = np.frombuffer(payload, dtype=dtype, count=count,
-                            offset=start).reshape(shape).copy()
-        arrays.append(arr)
+                            offset=start).reshape(shape)
+        arrays.append(arr.copy() if copy else arr)
     return arrays, kind.value
 
 

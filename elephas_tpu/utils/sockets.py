@@ -50,17 +50,30 @@ def determine_master(port: int = 4000) -> str:
     return host + ":" + str(port)
 
 
-def _receive_all(sock: socket.socket, num_bytes: int) -> bytes:
-    """Read exactly ``num_bytes`` bytes from the socket."""
-    chunks = []
-    remaining = num_bytes
-    while remaining > 0:
-        data = sock.recv(remaining)
-        if not data:
-            raise ConnectionError("socket closed while reading frame")
-        chunks.append(data)
-        remaining -= len(data)
-    return b"".join(chunks)
+def recv_exact(sock: socket.socket, num_bytes: int) -> bytearray:
+    """Read exactly ``num_bytes`` via ``recv_into`` a single preallocated
+    buffer — one allocation per message, no chunk-list join.
+
+    Raises :class:`ConnectionError` when the peer closes mid-read: a
+    half-closed socket returns ``b""`` from ``recv``, and fixed-length
+    protocol reads (1-byte acks, 32-byte update ids, frame bodies) must
+    never misread that as payload. All fixed-length reads in the
+    parameter plane route through here."""
+    buf = bytearray(num_bytes)
+    if num_bytes:
+        with memoryview(buf) as view:
+            got = 0
+            while got < num_bytes:
+                n = sock.recv_into(view[got:])
+                if n == 0:
+                    raise ConnectionError(
+                        "socket closed while reading frame")
+                got += n
+    return buf
+
+
+# back-compat alias (the historical chunk-list reader's name)
+_receive_all = recv_exact
 
 
 def _use_native(sock: socket.socket) -> bool:
@@ -80,6 +93,14 @@ def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEI
     the socket is in blocking mode.
     """
     payload = encode(arrays, kind)
+    send_payload(sock, payload)
+
+
+def send_payload(sock: socket.socket, payload) -> None:
+    """Send one ALREADY-ENCODED ETPU payload as a length-prefixed frame
+    (the cached-snapshot fast path: zero encode work, one or two
+    ``sendall`` syscalls). ``payload`` may be ``bytes`` or the
+    ``bytearray`` the zero-copy encoder returns."""
     if _use_native(sock):
         from . import native
 
@@ -89,8 +110,13 @@ def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEI
     sock.sendall(payload)
 
 
-def receive_frame(sock: socket.socket):
+def receive_frame(sock: socket.socket, copy: bool = True):
     """Receive one length-prefixed ETPU frame; returns ``(arrays, kind)``.
+
+    The frame body lands in ONE preallocated ``bytearray`` via
+    ``recv_into`` (no chunk-list accumulation). ``copy=False`` decodes
+    zero-copy views of that buffer — the arrays alias the receive buffer
+    and keep it alive; treat them as frozen snapshots.
 
     The transport is chosen up front (native or Python) and errors
     propagate: once any bytes of a frame are consumed, falling back to the
@@ -99,16 +125,16 @@ def receive_frame(sock: socket.socket):
     if _use_native(sock):
         from . import native
 
-        return decode(native.recv_frame_native(sock.fileno()))
-    length = int.from_bytes(_receive_all(sock, LENGTH_BYTES), "little")
+        return decode(native.recv_frame_native(sock.fileno()), copy=copy)
+    length = int.from_bytes(recv_exact(sock, LENGTH_BYTES), "little")
     if length > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame length {length} exceeds limit")
-    return decode(_receive_all(sock, length))
+    return decode(recv_exact(sock, length), copy=copy)
 
 
-def receive(sock: socket.socket) -> List[np.ndarray]:
+def receive(sock: socket.socket, copy: bool = True) -> List[np.ndarray]:
     """Receive one ETPU frame; returns just the array list."""
-    return receive_frame(sock)[0]
+    return receive_frame(sock, copy=copy)[0]
 
 
 def send_trace_context(sock: socket.socket, ctx: TraceContext) -> None:

@@ -63,24 +63,61 @@ class CodecError(ValueError):
     pass
 
 
-def encode_tensors(arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS) -> bytes:
-    """Serialize a list of numpy arrays into the ETPU wire format."""
-    parts = [MAGIC, struct.pack("<BBI", VERSION, kind, len(arrays))]
+def _normalize(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Wire-ready views of the inputs: supported dtype, C-contiguous.
+    Arrays that already qualify pass through untouched (zero copies);
+    non-contiguous inputs (Fortran order, strided slices) go through an
+    explicit ``ascontiguousarray`` fallback."""
+    norm = []
     for arr in arrays:
         arr = np.asarray(arr)
-        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
-            arr = np.ascontiguousarray(arr)
         if arr.dtype not in _DTYPE_CODES:
             arr = arr.astype(np.float32)
-        code = _DTYPE_CODES[arr.dtype]
-        parts.append(struct.pack("<BB", code, arr.ndim))
-        parts.append(struct.pack("<%dQ" % arr.ndim, *arr.shape))
-        parts.append(arr.tobytes())
-    return b"".join(parts)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        norm.append(arr)
+    return norm
 
 
-def decode_tensors(payload: bytes) -> tuple:
-    """Deserialize an ETPU payload. Returns ``(arrays, kind)``."""
+def encode_tensors(arrays: Sequence[np.ndarray],
+                   kind: int = KIND_WEIGHTS) -> bytearray:
+    """Serialize a list of numpy arrays into the ETPU wire format.
+
+    Single-allocation encode: the total frame size is computed up front,
+    one ``bytearray`` is allocated, and each tensor's bytes are written
+    straight into it through a ``frombuffer`` view — no per-array
+    ``tobytes()`` intermediate copies. Returns a ``bytearray`` (bytes-like
+    for ``sendall``/HTTP bodies without a further copy)."""
+    norm = _normalize(arrays)
+    total = 10
+    for arr in norm:
+        total += 2 + 8 * arr.ndim + arr.nbytes
+    buf = bytearray(total)
+    buf[0:4] = MAGIC
+    struct.pack_into("<BBI", buf, 4, VERSION, kind, len(norm))
+    offset = 10
+    for arr in norm:
+        struct.pack_into("<BB", buf, offset, _DTYPE_CODES[arr.dtype],
+                         arr.ndim)
+        offset += 2
+        if arr.ndim:
+            struct.pack_into("<%dQ" % arr.ndim, buf, offset, *arr.shape)
+            offset += 8 * arr.ndim
+        if arr.nbytes:
+            np.frombuffer(buf, dtype=arr.dtype, count=arr.size,
+                          offset=offset)[...] = arr.reshape(-1)
+            offset += arr.nbytes
+    return buf
+
+
+def decode_tensors(payload, copy: bool = True) -> tuple:
+    """Deserialize an ETPU payload. Returns ``(arrays, kind)``.
+
+    With ``copy=False`` the returned arrays are zero-copy VIEWS of
+    ``payload`` (they alias its memory and keep it alive): mutating a
+    ``bytearray`` payload mutates the arrays, and views of immutable
+    ``bytes`` are read-only. Callers choosing view mode must treat the
+    arrays as frozen snapshots — the receive-path contract."""
     if len(payload) < 10 or payload[:4] != MAGIC:
         raise CodecError("not an ETPU payload")
     version, kind, count = struct.unpack_from("<BBI", payload, 4)
@@ -108,9 +145,13 @@ def decode_tensors(payload: bytes) -> tuple:
         nbytes = count_elems * dtype.itemsize
         if offset + nbytes > len(payload):
             raise CodecError("truncated tensor body")
-        arr = np.frombuffer(payload[offset:offset + nbytes], dtype=dtype).reshape(dims)
+        if nbytes:
+            arr = np.frombuffer(payload, dtype=dtype, count=count_elems,
+                                offset=offset).reshape(dims)
+        else:
+            arr = np.empty(dims, dtype=dtype)
         offset += nbytes
-        arrays.append(arr.copy())
+        arrays.append(arr.copy() if copy else arr)
     return arrays, kind
 
 
@@ -129,25 +170,30 @@ def encode(arrays: Sequence[np.ndarray], kind: int = KIND_WEIGHTS) -> bytes:
     return encode_tensors(arrays, kind)
 
 
-def decode(payload: bytes) -> tuple:
-    """Decode, preferring the native C++ implementation when built."""
+def decode(payload, copy: bool = True) -> tuple:
+    """Decode, preferring the native C++ implementation when built.
+    ``copy=False`` returns arrays viewing ``payload`` (see
+    :func:`decode_tensors`)."""
     try:
         from . import native
 
-        out = native.decode_tensors_native(payload)
+        out = native.decode_tensors_native(payload, copy=copy)
         if out is not None:
             return out
     except CodecError:
         raise
     except Exception:
         pass
-    return decode_tensors(payload)
+    return decode_tensors(payload, copy=copy)
 
 
 def encode_weights(weights: Sequence[np.ndarray]) -> bytes:
     return encode(weights, KIND_WEIGHTS)
 
 
-def decode_weights(payload: bytes) -> List[np.ndarray]:
-    arrays, _ = decode(payload)
+def decode_weights(payload, copy: bool = True) -> List[np.ndarray]:
+    """``copy=False`` returns views of ``payload`` (writable only when
+    the payload is a mutable buffer — views of ``bytes`` are
+    read-only); see :func:`decode_tensors`."""
+    arrays, _ = decode(payload, copy=copy)
     return arrays

@@ -34,6 +34,7 @@ from .parameter import BaseParameterClient
 from .utils.faults import fault_site
 from .utils.functional_utils import subtract_params
 from .utils.prefetch import prefetch_to_device
+from .utils.tensor_codec import KIND_DELTA as _KIND_DELTA
 from .utils.tensor_codec import KIND_DELTA_Q8 as _KIND_DELTA_Q8
 
 
@@ -181,6 +182,82 @@ class _AsyncCommunicator:
         self._check()
 
 
+class _PipelinedPusher:
+    """One-slot pipelined delta pusher for the reference-parity loops.
+
+    The push for batch/epoch *k* runs on a background thread over its
+    OWN cloned client (own persistent connection), overlapping the pull
+    and gradient computation for *k+1*. ``submit`` first waits for the
+    previous in-flight push — at most ONE push is outstanding, so a
+    pull can miss at most the single racing push (bounded staleness 1,
+    on top of what asynchronous SGD already tolerates). A push error is
+    parked and re-raised at the next sync point (``submit``/``drain``),
+    so the worker fails exactly as the blocking loop would and the
+    supervisor's crash/restart semantics are unchanged.
+    """
+
+    def __init__(self, client: BaseParameterClient):
+        self.client = client.clone()
+        self._owns_client = self.client is not client
+        self._slot: "queue.Queue" = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="elephas-tpu-ps-pipeline")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._slot.get()
+            if item is None:
+                return
+            arrays, kind = item
+            try:
+                try:
+                    self.client.push_frame(arrays, kind)
+                except NotImplementedError:
+                    # in-memory doubles implement only update_parameters;
+                    # an uncompressed frame IS the delta list
+                    self.client.update_parameters(arrays)
+            except BaseException as err:  # noqa: BLE001 — re-raised at sync
+                self._error = err
+            finally:
+                self._idle.set()
+
+    def _sync(self):
+        """Wait for the in-flight push; re-raise its error exactly once."""
+        self._idle.wait()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def submit(self, arrays: List[np.ndarray], kind: int):
+        """Hand a push to the background thread after the previous one
+        lands — the caller blocks only when the wire is slower than
+        compute (the same back-pressure the blocking loop has)."""
+        self._sync()
+        self._idle.clear()
+        self._slot.put((arrays, kind))
+
+    def drain(self):
+        """Block until the wire is quiet (epoch boundaries, final flush)."""
+        self._sync()
+
+    def close(self):
+        """Flush the in-flight push, stop the thread, release the
+        cloned connection. Re-raises a parked error unless a prior sync
+        already surfaced it (a finally-path close must not mask the
+        loop's own exception with a second raise of the same error)."""
+        try:
+            self._sync()
+        finally:
+            self._slot.put(None)
+            self._thread.join()
+            if self._owns_client:
+                self.client.close()
+
+
 class AsyncWorker:
     """Asynchronous worker: exchanges weight deltas with a parameter server
     at epoch or batch frequency (parity: ``elephas/worker.py:52-131``).
@@ -190,6 +267,13 @@ class AsyncWorker:
     :param accum_batches: accumulate the weight delta on device for this
         many steps before pushing (1 = push every batch, as the
         reference does)
+    :param pipeline: double-buffer pushes in the reference-parity loops:
+        the delta push for batch/epoch *k* runs on a background thread
+        (own connection) while *k+1* computes — one in-flight push max,
+        staleness bounded at 1, push errors re-raised at the next sync
+        point. Subsumed by ``overlap``/``accum_batches`` only at BATCH
+        frequency (where the overlapped communicator runs and already
+        pipelines); epoch-frequency fits keep the pusher regardless.
     :param epoch_event: optional ``(epoch_idx, mean_loss_or_None)`` hook
         fired after each local epoch — the driver aggregates these into
         real per-epoch callbacks across workers
@@ -204,6 +288,7 @@ class AsyncWorker:
                  master_optimizer, master_loss, master_metrics,
                  custom_objects: Optional[Dict] = None, port: int = 4000,
                  overlap: bool = False, accum_batches: int = 1,
+                 pipeline: bool = False,
                  epoch_event=None, should_stop=None,
                  compute_dtype: Optional[str] = None, device=None):
         if isinstance(client, BaseParameterClient):
@@ -223,6 +308,8 @@ class AsyncWorker:
         self.compute_dtype = compute_dtype
         self.overlap = overlap
         self.accum_batches = max(1, int(accum_batches))
+        self.pipeline = bool(pipeline)
+        self._pusher: Optional[_PipelinedPusher] = None
         self.epoch_event = epoch_event
         self.should_stop = should_stop or (lambda: False)
         #: the JAX device this worker's compute is pinned to (None =
@@ -243,10 +330,19 @@ class AsyncWorker:
     def _push(self, delta):
         """Push a delta, routing through error feedback when the wire
         quantizes (keeps the server-side sum unbiased). The EF preview
-        frame IS the wire frame — one quantization pass per push."""
+        frame IS the wire frame — one quantization pass per push. With
+        ``pipeline=True`` the frame is handed to the background pusher
+        instead of blocking the loop (EF still quantizes HERE, on the
+        compute thread, so residuals stay ordered)."""
         if self._ef is not None:
             self._ef.apply(delta)
-            self.client.push_frame(self._ef.last_frame, _KIND_DELTA_Q8)
+            if self._pusher is not None:
+                self._pusher.submit(self._ef.last_frame, _KIND_DELTA_Q8)
+            else:
+                self.client.push_frame(self._ef.last_frame, _KIND_DELTA_Q8)
+        elif self._pusher is not None:
+            # uncompressed frame (compression implies EF above)
+            self._pusher.submit(delta, _KIND_DELTA)
         else:
             self.client.update_parameters(delta)
 
@@ -267,6 +363,24 @@ class AsyncWorker:
 
     def _train_pinned(self, x_train: np.ndarray, y_train: np.ndarray):
         fault_site("worker.train")  # chaos hook: crash/stall a worker
+        # the overlapped schedule's communicator already pipelines, but
+        # it only runs for BATCH frequency — epoch-frequency fits keep
+        # the pusher even when overlap/accum flags are set, otherwise
+        # ps_pipeline would be silently dropped there
+        overlapped = (self.frequency == "batch"
+                      and (self.overlap or self.accum_batches > 1))
+        if self.pipeline and not overlapped:
+            # this pusher is the lightweight upgrade for the
+            # reference-parity loops
+            self._pusher = _PipelinedPusher(self.client)
+            try:
+                return self._train_loops(x_train, y_train)
+            finally:
+                pusher, self._pusher = self._pusher, None
+                pusher.close()
+        return self._train_loops(x_train, y_train)
+
+    def _train_loops(self, x_train: np.ndarray, y_train: np.ndarray):
         self.model = model_from_json(self.json, self.custom_objects)
         self.model.compile(optimizer=deserialize_optimizer(self.master_optimizer),
                            loss=self.master_loss, metrics=self.master_metrics,

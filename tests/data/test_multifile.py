@@ -239,6 +239,50 @@ def test_shuffled_streaming_fit_decodes_each_group_once(tmp_path):
     assert p1.tolist() != p2.tolist(), "epochs must differ"
 
 
+def test_shuffle_window_mixes_batches_across_row_groups(tmp_path):
+    """Within-batch mixing: rows interleave across a window of inner
+    chunks (sized to the decode LRU), so a global batch draws from more
+    than one row group (a sorted file would otherwise yield perfectly
+    correlated batches) — while streaming the permuted epoch still
+    decodes each group once."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from elephas_tpu.parallel.sync_trainer import (_SHUFFLE_WINDOW,
+                                                   _epoch_permutation)
+
+    x, _ = _problem(n=256)
+    xp = str(tmp_path / "x.parquet")
+    pq.write_table(pa.table({"features": pa.FixedSizeListArray.from_arrays(
+        pa.array(x.reshape(-1)), x.shape[1])}), xp, row_group_size=32)
+    src = ParquetSource(xp, "features")     # 8 groups of 32
+    bounds = src.chunk_bounds()
+
+    rng = np.random.default_rng(3)
+    perm = _epoch_permutation(src, None, 256, 256, True, rng)
+    assert sorted(perm.tolist()) == list(range(256))
+
+    batch = 32
+    spans = []
+    for lo in range(0, 256, batch):
+        sl = perm[lo:lo + batch]
+        owners = np.unique(np.searchsorted(bounds, sl, side="right") - 1)
+        # a batch's rows come from at most one window of chunks...
+        assert len(owners) <= 2 * _SHUFFLE_WINDOW
+        spans.append(len(owners))
+    # ...and the interleave is real: batches mix across row groups
+    # instead of each sitting inside a single group
+    assert max(spans) >= 2, f"no batch mixed across groups: {spans}"
+    assert float(np.mean(spans)) > 1.5
+
+    # decode-once survives the mixing: stream the epoch's batches
+    d0 = src.chunks_decoded
+    for lo in range(0, 256, batch):
+        src.take(perm[lo:lo + batch])
+    assert src.chunks_decoded - d0 <= len(bounds) - 1, \
+        "windowed shuffle must not thrash the row-group LRU"
+
+
 def test_mixed_granularity_columns_both_decode_once(tmp_path):
     """x and y Parquet columns with DIFFERENT row-group sizes: the epoch
     permutation merges both columns' boundaries, so each keeps the

@@ -320,15 +320,17 @@ def test_ps_http_server_metrics_endpoint_and_404():
         assert samples[
             'ps_http_requests_total{method="GET",path="other",'
             'status="404"}'] >= 1
-        # RPC counters + latency observed for both ops over HTTP
+        # RPC counters + latency observed for both ops over HTTP; the
+        # shard label ("0" for an unsharded server) splits traffic per
+        # shard of a sharded plane on one scrape
         assert samples['ps_rpc_total{transport="http",'
-                       'op="get_weights",status="ok"}'] >= 1
+                       'op="get_weights",status="ok",shard="0"}'] >= 1
         assert samples['ps_rpc_total{transport="http",'
-                       'op="apply_delta",status="ok"}'] >= 1
+                       'op="apply_delta",status="ok",shard="0"}'] >= 1
         assert samples['ps_rpc_latency_seconds_count{transport="http",'
-                       'op="apply_delta"}'] >= 1
+                       'op="apply_delta",shard="0"}'] >= 1
         assert samples['ps_rpc_bytes_total{transport="http",'
-                       'direction="in"}'] > 0
+                       'direction="in",shard="0"}'] > 0
         # client-side series land in the same (default) registry
         assert samples['ps_client_rpc_latency_seconds_count'
                        '{op="get_parameters"}'] >= 1
@@ -340,8 +342,10 @@ def test_socket_server_rpc_metrics():
     from elephas_tpu.parameter import SocketClient, SocketServer
 
     before = default_registry().counter(
-        "ps_rpc_total", labels=("transport", "op", "status")).labels(
-        transport="socket", op="get_weights", status="ok").value
+        "ps_rpc_total",
+        labels=("transport", "op", "status", "shard")).labels(
+        transport="socket", op="get_weights", status="ok",
+        shard="0").value
     port = 26901
     server = SocketServer(_ps_model(), port, "asynchronous")
     server.start()
@@ -351,11 +355,11 @@ def test_socket_server_rpc_metrics():
         client.update_parameters([np.zeros_like(w) for w in weights])
         client.close()
         fam = default_registry().counter(
-            "ps_rpc_total", labels=("transport", "op", "status"))
+            "ps_rpc_total", labels=("transport", "op", "status", "shard"))
         assert fam.labels(transport="socket", op="get_weights",
-                          status="ok").value == before + 1
+                          status="ok", shard="0").value == before + 1
         assert fam.labels(transport="socket", op="apply_delta",
-                          status="ok").value >= 1
+                          status="ok", shard="0").value >= 1
     finally:
         server.stop()
 

@@ -1,0 +1,467 @@
+"""Sharded parameter plane + cached snapshots + pipelined push.
+
+Covers the high-throughput parameter-plane pieces end to end:
+``ShardPlan`` determinism/balance, bit-identical round-trips through
+``ShardedServerGroup``/``ShardedParameterClient`` over BOTH transports,
+the cached encoded snapshot (no re-encode while the version is
+unchanged, asserted via ``encode_count``), per-shard kill →
+``ps_auto_restart`` recovery, and the worker's ``pipeline=True`` push
+mode (order, staleness bound, error-at-sync semantics).
+"""
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.factory import (create_sharded_client,
+                                           create_sharded_server)
+from elephas_tpu.parameter.sharding import (ShardPlan, ShardedParameterClient,
+                                            ShardedServerGroup)
+
+_PORT = itertools.count(27800)
+
+
+def _weights(seed=0, sizes=(300, 7, 120, 120, 64, 1, 2048, 33)):
+    rng = np.random.default_rng(seed)
+    return [rng.random(n).astype(np.float32) * 2 - 1 for n in sizes]
+
+
+def _model_dict(weights=None):
+    return {"model": None, "weights": weights or _weights()}
+
+
+# ----------------------------------------------------------------- ShardPlan
+
+def test_plan_is_deterministic_and_covers_every_tensor():
+    ws = _weights()
+    p1 = ShardPlan.plan(ws, 3)
+    p2 = ShardPlan.plan([w.shape for w in ws], 3)  # shapes-only derivation
+    assert p1.assignments == p2.assignments, \
+        "client and server must derive the SAME plan independently"
+    flat = sorted(i for part in p1.assignments for i in part)
+    assert flat == list(range(len(ws)))
+
+
+def test_plan_balances_bytes():
+    ws = _weights()
+    plan = ShardPlan.plan(ws, 4)
+    loads = plan.shard_bytes
+    assert sum(loads) == sum(w.nbytes for w in ws)
+    # greedy largest-first: no bin exceeds the lightest by more than the
+    # largest single tensor
+    assert max(loads) - min(loads) <= max(w.nbytes for w in ws)
+
+
+def test_plan_more_shards_than_tensors_leaves_empty_bins():
+    plan = ShardPlan.plan(_weights(sizes=(10, 20)), 4)
+    assert plan.num_shards == 4
+    assert sorted(len(p) for p in plan.assignments) == [0, 0, 1, 1]
+
+
+def test_split_merge_roundtrip_identity():
+    ws = _weights()
+    plan = ShardPlan.plan(ws, 3)
+    merged = plan.merge(plan.split(ws))
+    for a, b in zip(ws, merged):
+        assert a is b, "merge must restore original order without copies"
+
+
+def test_split_merge_grouped_frames():
+    """KIND_DELTA_Q8 frames interleave (data, scale) per tensor: the
+    plan scatters/gathers pairs as units."""
+    ws = _weights(sizes=(16, 4, 9))
+    frame = []
+    for w in ws:
+        frame += [w.astype(np.int8), np.float32(w.max())]
+    plan = ShardPlan.plan(ws, 2)
+    parts = plan.split(frame, group=2)
+    assert sum(len(p) for p in parts) == len(frame)
+    back = plan.merge(parts, group=2)
+    for a, b in zip(frame, back):
+        assert a is b
+
+
+def test_plan_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        ShardPlan.plan(_weights(), 0)
+    plan = ShardPlan.plan(_weights(), 2)
+    with pytest.raises(ValueError):
+        plan.split(_weights()[:-1])          # wrong arity
+    with pytest.raises(ValueError):
+        plan.merge([[np.zeros(3)]] * 2)      # wrong per-shard arity
+
+
+# ------------------------------------------------ transport round-trips
+
+@pytest.mark.parametrize("transport", ["socket", "http"])
+def test_sharded_roundtrip_bit_identical(transport):
+    ws = _weights(seed=3)
+    port = next(_PORT) + 10 * (transport == "http")
+    group = create_sharded_server(transport, _model_dict(ws), port,
+                                  "asynchronous", 3)
+    assert isinstance(group, ShardedServerGroup)
+    group.start()
+    try:
+        client = create_sharded_client(transport, port, _model_dict(ws), 3)
+        assert isinstance(client, ShardedParameterClient)
+        got = client.get_parameters()
+        assert len(got) == len(ws)
+        for a, b in zip(ws, got):
+            assert a.dtype == b.dtype
+            assert a.tobytes() == b.tobytes(), \
+                "sharded pull must reassemble BIT-identical weights"
+
+        # a push lands on every shard and the next pull reflects it
+        delta = [np.full_like(w, 0.25) for w in ws]
+        client.update_parameters(delta)
+        after = client.get_parameters()
+        for w, d, b in zip(ws, delta, after):
+            np.testing.assert_array_equal(b, w - d)
+        assert group.num_updates == 1
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_num_shards_one_returns_plain_server_and_client():
+    from elephas_tpu.parameter.client import SocketClient
+    from elephas_tpu.parameter.server import SocketServer
+
+    port = next(_PORT)
+    server = create_sharded_server("socket", _model_dict(), port,
+                                   "asynchronous", 1)
+    assert isinstance(server, SocketServer)
+    client = create_sharded_client("socket", port, _model_dict(), 1)
+    assert isinstance(client, SocketClient)
+
+
+def test_sharded_client_clone_has_own_subclients():
+    port = next(_PORT)
+    client = create_sharded_client("socket", port, _model_dict(), 2)
+    clone = client.clone()
+    assert clone is not client
+    assert all(a is not b for a, b in zip(client.clients, clone.clients))
+    assert clone.plan is client.plan
+
+
+# --------------------------------------------------- cached encoded snapshot
+
+def test_cached_snapshot_serves_repeated_gets_without_reencoding():
+    from elephas_tpu.parameter.client import SocketClient
+    from elephas_tpu.parameter.server import SocketServer
+
+    port = next(_PORT)
+    server = SocketServer(_model_dict(), port, "asynchronous")
+    server.start()
+    try:
+        client = SocketClient(port=port)
+        for _ in range(5):
+            client.get_parameters()
+        assert server.encode_count == 1, \
+            "repeated gets must serve the cached payload, not re-encode"
+
+        client.update_parameters([np.zeros_like(w)
+                                  for w in server.get_weights()])
+        client.get_parameters()
+        client.get_parameters()
+        assert server.encode_count == 2, \
+            "one rebuild per version: invalidated by the update, " \
+            "rebuilt once, then cached again"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_cached_snapshot_invalidated_by_restore():
+    from elephas_tpu.parameter.server import SocketServer
+
+    server = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    snap = server.snapshot()
+    p1 = server.encoded_weights()
+    assert server.encoded_weights() is p1          # cached
+    snap["weights"] = [w + 1 for w in snap["weights"]]
+    server.restore(snap)
+    p2 = server.encoded_weights()
+    assert p2 is not p1
+    from elephas_tpu.utils.tensor_codec import decode_weights
+
+    np.testing.assert_array_equal(decode_weights(bytes(p2))[0],
+                                  snap["weights"][0])
+
+
+def test_concurrent_gets_share_one_rebuild():
+    from elephas_tpu.parameter.server import SocketServer
+
+    server = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    results = []
+
+    def get():
+        results.append(bytes(server.encoded_weights()))
+
+    threads = [threading.Thread(target=get) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert server.encode_count == 1
+    assert len(set(results)) == 1
+
+
+# ------------------------------------------- per-shard kill → restart
+
+def test_per_shard_kill_restart_survivors_keep_serving():
+    """The supervision contract: one dead shard is detected, rebuilt
+    from ITS snapshot on its own port, and the client round-trips
+    bit-identical weights again — the surviving shards are never
+    touched."""
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  seed=0)
+    port = next(_PORT)
+    tpu_model = TPUModel(model, mode="asynchronous",
+                         parameter_server_mode="socket", num_workers=2,
+                         ps_shards=3, ps_auto_restart=True, port=port)
+    group = tpu_model.parameter_server
+    assert isinstance(group, ShardedServerGroup)
+    tpu_model.start_server()
+    try:
+        probe, restart = tpu_model._ps_supervision()
+        assert probe() is True
+        baseline = tpu_model.client.get_parameters()
+
+        victim = group.servers[1]
+        survivors = [group.servers[0], group.servers[2]]
+        victim.stop()                       # murder ONE shard
+        assert probe() is False
+
+        restart()
+        assert probe() is True
+        assert group.servers[1] is not victim, "dead shard rebuilt"
+        assert group.servers[0] is survivors[0], "survivor untouched"
+        assert group.servers[2] is survivors[1], "survivor untouched"
+
+        recovered = tpu_model.client.get_parameters()
+        for a, b in zip(baseline, recovered):
+            assert a.tobytes() == b.tobytes(), \
+                "post-restart pull must be bit-identical (restored " \
+                "from the shard's own snapshot)"
+    finally:
+        tpu_model.stop_server()
+
+
+@pytest.mark.slow
+def test_sharded_async_fit_trains_end_to_end():
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+    from elephas_tpu.utils.dataset_utils import to_dataset
+
+    rng = np.random.default_rng(0)
+    x = rng.random((256, 16), dtype=np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 256)]
+    model = Sequential([Dense(32, input_dim=16), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  seed=0)
+    tpu_model = TPUModel(model, mode="asynchronous",
+                         parameter_server_mode="socket",
+                         frequency="batch", num_workers=2, ps_shards=3,
+                         ps_pipeline=True, port=next(_PORT))
+    before = tpu_model.evaluate(x, y)
+    before = before[0] if isinstance(before, list) else before
+    tpu_model.fit(to_dataset(x, y), epochs=3, batch_size=32, verbose=0,
+                  validation_split=0.0)
+    after = tpu_model.evaluate(x, y)
+    after = after[0] if isinstance(after, list) else after
+    assert np.isfinite(after)
+    assert after < before, "sharded + pipelined async fit must learn"
+    # the sharded config round-trips through get_config (save/load path)
+    cfg = tpu_model.get_config()
+    assert cfg["ps_shards"] == 3 and cfg["ps_pipeline"] is True
+
+
+# ------------------------------------------------------- pipelined push
+
+from elephas_tpu.parameter.client import BaseParameterClient
+
+
+class _RecordingClient(BaseParameterClient):
+    """In-memory client double: records applied frames, optional
+    per-push fault hook, a clone counter (the pusher must clone)."""
+
+    client_type = "recording-double"
+    compression = None
+
+    def __init__(self, fail_on=(), delay=0.0):
+        self.applied = []
+        self.fail_on = set(fail_on)
+        self.delay = delay
+        self.clones = 0
+        self._count = 0
+
+    def clone(self):
+        self.clones += 1
+        return self  # shared state on purpose: asserts see every push
+
+    def update_parameters(self, delta):
+        self._apply(delta)
+
+    def push_frame(self, arrays, kind):
+        self._apply(arrays)
+
+    def _apply(self, arrays):
+        self._count += 1
+        if self.delay:
+            time.sleep(self.delay)
+        if self._count in self.fail_on:
+            raise ConnectionError(f"injected failure on push {self._count}")
+        self.applied.append([np.array(a) for a in arrays])
+
+    def get_parameters(self):
+        return [np.zeros(3, np.float32)]
+
+    def health_check(self):
+        return True
+
+    def close(self):
+        pass
+
+
+def test_pipelined_pusher_preserves_order_and_bounds_staleness():
+    from elephas_tpu.worker import _PipelinedPusher
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA
+
+    client = _RecordingClient(delay=0.01)
+    pusher = _PipelinedPusher(client)
+    try:
+        for i in range(5):
+            pusher.submit([np.full(3, float(i), np.float32)], KIND_DELTA)
+            # one in-flight max: everything before the previous push has
+            # landed by the time a new submit returns
+            assert len(client.applied) >= i - 1
+        pusher.drain()
+        assert [int(a[0][0]) for a in client.applied] == [0, 1, 2, 3, 4]
+        assert client.clones == 1, "the pusher must clone the client"
+    finally:
+        pusher.close()
+
+
+def test_pipelined_pusher_reraises_at_next_sync_point():
+    from elephas_tpu.worker import _PipelinedPusher
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA
+
+    client = _RecordingClient(fail_on={2})
+    pusher = _PipelinedPusher(client)
+    delta = [np.ones(3, np.float32)]
+    pusher.submit(delta, KIND_DELTA)      # push 1: ok
+    pusher.submit(delta, KIND_DELTA)      # push 2: fails in background
+    with pytest.raises(ConnectionError, match="injected failure"):
+        pusher.submit(delta, KIND_DELTA)  # surfaces HERE, the sync point
+    # the error was consumed at the sync point; close() must not
+    # re-raise it (a finally-path close would mask the original)
+    pusher.close()
+
+
+def test_pipelined_pusher_drain_reraises_pending_error():
+    from elephas_tpu.worker import _PipelinedPusher
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA
+
+    client = _RecordingClient(fail_on={1})
+    pusher = _PipelinedPusher(client)
+    pusher.submit([np.ones(3, np.float32)], KIND_DELTA)
+    with pytest.raises(ConnectionError):
+        pusher.drain()
+    pusher.close()
+
+
+def test_async_worker_pipeline_pushes_every_batch():
+    """AsyncWorker(pipeline=True) trains the reference-parity batch loop
+    with background pushes: every batch's delta lands, in order."""
+    from elephas_tpu.models import (SGD, Activation, Dense, Sequential,
+                                    serialize_optimizer)
+    from elephas_tpu.worker import AsyncWorker
+
+    rng = np.random.default_rng(1)
+    x = rng.random((96, 8), dtype=np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 96)]
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  seed=0)
+    client = _RecordingClient()
+    client.get_parameters = lambda: [np.array(w) for w in
+                                     model.get_weights()]
+    worker = AsyncWorker(model.to_json(), model.get_weights(), client,
+                         {"epochs": 2, "batch_size": 32, "verbose": 0},
+                         "batch", serialize_optimizer(SGD(0.1)),
+                         "categorical_crossentropy", [], pipeline=True)
+    worker.train(x, y)
+    assert worker._pusher is None, "pusher torn down after training"
+    # 3 batches x 2 epochs, every one pushed
+    assert len(client.applied) == 6
+    assert any(float(np.abs(a[0]).sum()) > 0 for a in client.applied), \
+        "pushed deltas must be real training deltas"
+
+
+def test_sharded_partial_push_failure_emits_torn_event():
+    """A push that lands on some shards but exhausts retries on another
+    is torn — the error propagates AND a ``ps.sharded_push_torn`` event
+    records the partial application (the documented no-cross-shard-
+    transaction trade)."""
+    from elephas_tpu.obs.events import clear_events, recent_events
+
+    weights = [np.ones(8, np.float32) for _ in range(4)]
+    plan = ShardPlan.plan(weights, 2)
+    good, bad = _RecordingClient(), _RecordingClient(fail_on={1})
+    client = ShardedParameterClient([good, bad], plan)
+    clear_events()
+    with pytest.raises(ConnectionError):
+        client.update_parameters([np.ones(8, np.float32)
+                                  for _ in range(4)])
+    assert good.applied, "the healthy shard applied its slice"
+    torn = recent_events(event="ps.sharded_push_torn")
+    assert torn and torn[-1]["shards_applied"] == 1 \
+        and torn[-1]["shards_total"] == 2
+    client.close()
+
+
+def test_async_worker_pipeline_kept_at_epoch_frequency_with_accum():
+    """accum_batches only routes through the overlapped communicator at
+    BATCH frequency — an epoch-frequency fit must keep the pipelined
+    pusher rather than silently dropping ps_pipeline."""
+    from elephas_tpu.models import (SGD, Activation, Dense, Sequential,
+                                    serialize_optimizer)
+    from elephas_tpu.worker import AsyncWorker
+
+    rng = np.random.default_rng(2)
+    x = rng.random((96, 8), dtype=np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 96)]
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  seed=0)
+    client = _RecordingClient()
+    client.get_parameters = lambda: [np.array(w) for w in
+                                     model.get_weights()]
+    seen_pushers = []
+    orig = AsyncWorker._push
+
+    def spy(self, delta):
+        seen_pushers.append(self._pusher)
+        return orig(self, delta)
+
+    worker = AsyncWorker(model.to_json(), model.get_weights(), client,
+                         {"epochs": 2, "batch_size": 32, "verbose": 0},
+                         "epoch", serialize_optimizer(SGD(0.1)),
+                         "categorical_crossentropy", [], pipeline=True,
+                         accum_batches=4)
+    worker._push = spy.__get__(worker)
+    worker.train(x, y)
+    assert len(client.applied) == 2          # one delta per epoch
+    assert seen_pushers and all(p is not None for p in seen_pushers), \
+        "epoch-frequency pushes must go through the pipelined pusher"

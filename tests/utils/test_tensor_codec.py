@@ -36,3 +36,100 @@ def test_empty_list():
     decoded, kind = tensor_codec.decode_tensors(tensor_codec.encode_weights([]))
     assert decoded == []
     assert kind == tensor_codec.KIND_WEIGHTS
+
+
+# ------------------------------------------------- zero-copy path contracts
+
+def test_copy_false_views_alias_the_payload_buffer():
+    """``copy=False`` must return VIEWS of the payload — zero tensor
+    copies on the decode path (the receive-side contract)."""
+    arrays = [np.random.rand(16, 8).astype(np.float32),
+              np.arange(32, dtype=np.int64)]
+    payload = tensor_codec.encode_tensors(arrays)
+    raw = np.frombuffer(memoryview(payload), dtype=np.uint8)
+
+    views, _ = tensor_codec.decode_tensors(payload, copy=False)
+    for v, orig in zip(views, arrays):
+        assert np.shares_memory(v, raw), "copy=False must not copy"
+        assert np.array_equal(v, orig)
+
+    copies, _ = tensor_codec.decode_tensors(payload, copy=True)
+    for c in copies:
+        assert not np.shares_memory(c, raw), "copy=True must own memory"
+
+
+def test_mutating_payload_mutates_views_the_aliasing_contract():
+    """The documented view-mode contract: the arrays alias the buffer,
+    so mutating a bytearray payload mutates them (and views of
+    immutable ``bytes`` are read-only) — callers must treat view-mode
+    arrays as frozen snapshots."""
+    arr = np.arange(6, dtype=np.float32)
+    payload = tensor_codec.encode_tensors([arr])  # bytearray
+    (view,), _ = tensor_codec.decode_tensors(payload, copy=False)
+    assert view.flags.writeable
+
+    # flip the first float of the tensor body in the raw buffer
+    body_off = len(payload) - arr.nbytes
+    payload[body_off:body_off + 4] = np.float32(99.0).tobytes()
+    assert view[0] == np.float32(99.0), "view must see payload mutation"
+
+    (frozen,), _ = tensor_codec.decode_tensors(bytes(payload), copy=False)
+    assert not frozen.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        frozen[0] = 1.0
+
+
+def test_fortran_order_round_trips_bit_exact():
+    f = np.asfortranarray(np.random.rand(7, 5).astype(np.float32))
+    assert not f.flags["C_CONTIGUOUS"]
+    for copy in (True, False):
+        (back,), _ = tensor_codec.decode_tensors(
+            tensor_codec.encode_tensors([f]), copy=copy)
+        assert back.flags["C_CONTIGUOUS"]
+        assert back.dtype == f.dtype
+        assert np.array_equal(back, f)
+
+
+def test_noncontiguous_slice_round_trips_bit_exact():
+    base = np.random.rand(16, 12).astype(np.float32)
+    sliced = base[::2, 1::3]             # strided view, non-contiguous
+    assert not sliced.flags["C_CONTIGUOUS"]
+    (back,), _ = tensor_codec.decode_tensors(
+        tensor_codec.encode_tensors([sliced]))
+    assert np.array_equal(back, sliced)
+    assert back.tobytes() == np.ascontiguousarray(sliced).tobytes()
+
+
+def test_zero_d_and_empty_arrays_both_copy_modes():
+    arrays = [np.array(2.5, dtype=np.float64),        # 0-d
+              np.zeros((0,), dtype=np.float32),       # empty 1-d
+              np.zeros((3, 0, 2), dtype=np.int64)]    # empty 3-d
+    payload = tensor_codec.encode_tensors(arrays)
+    for copy in (True, False):
+        decoded, _ = tensor_codec.decode_tensors(payload, copy=copy)
+        for orig, back in zip(arrays, decoded):
+            assert back.shape == orig.shape
+            assert back.dtype == orig.dtype
+            assert np.array_equal(back, orig)
+
+
+def test_encode_is_single_allocation_bytes_like():
+    """The encoder writes header + tensor bytes into ONE preallocated
+    buffer and returns it (bytes-like for sendall/HTTP bodies without a
+    further copy)."""
+    arrays = [np.random.rand(64).astype(np.float32),
+              np.arange(5, dtype=np.int32)]
+    payload = tensor_codec.encode_tensors(arrays)
+    assert isinstance(payload, bytearray)
+    # byte-identical to the naive per-array serialization
+    import struct
+
+    parts = [tensor_codec.MAGIC,
+             struct.pack("<BBI", tensor_codec.VERSION,
+                         tensor_codec.KIND_WEIGHTS, len(arrays))]
+    for a in arrays:
+        code = tensor_codec._DTYPE_CODES[a.dtype]
+        parts.append(struct.pack("<BB", code, a.ndim))
+        parts.append(struct.pack("<%dQ" % a.ndim, *a.shape))
+        parts.append(a.tobytes())
+    assert bytes(payload) == b"".join(parts)
