@@ -254,6 +254,86 @@ def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
                       "decoding's verify primitive, draft-free ceiling)"}
 
 
+def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
+                         prefix_len=8, suffix_len=4, max_new_tokens=4,
+                         smoke=False):
+    """Fleet-router row: consistent-hash vs round-robin routing over an
+    in-process ``ReplicaPool`` with lazy per-replica prefix caching —
+    the prefix-cache hit-rate win cache-aware placement buys (and the
+    CPU-measurable proxy-path round trip, so the router bench cannot
+    rot while the chip tunnel is down). A cold prefix registration is a
+    MISS (that head's KV was not resident on the routed-to replica);
+    hit rate is ``1 - misses/requests``."""
+    import json as _json
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.fleet import FleetRouter, ReplicaPool
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        n_groups, n_requests = 3, 12
+    c = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    groups = [[int(t) for t in rng.integers(0, 300, prefix_len)]
+              for _ in range(n_groups)]
+    prompts = [groups[i % n_groups]
+               + [int(t) for t in rng.integers(0, 300, suffix_len)]
+               for i in range(n_requests)]
+    # shuffle: a strict i%G group cycle can ALIAS with round-robin's
+    # i%N replica cycle (G and N sharing a factor gives round-robin
+    # accidental perfect affinity) — real traffic interleaves prefixes
+    rng.shuffle(prompts)
+
+    def run(policy):
+        pool = ReplicaPool(
+            lambda: DecodeEngine(params, c, max_slots=2), n=n_replicas,
+            auto_prefix_tokens=prefix_len).start()
+        try:
+            with FleetRouter(pool.urls, policy=policy,
+                             prefix_tokens=prefix_len,
+                             probe_interval=0.5,
+                             spill_threshold=None) as router:
+                start = time.perf_counter()
+                for p in prompts:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{router.port}/v1/generate",
+                        data=_json.dumps(
+                            {"prompt": p,
+                             "max_new_tokens": max_new_tokens}).encode(),
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        r.read()
+                elapsed = time.perf_counter() - start
+                misses = sum(e.misses for e in pool.engines)
+            return 1 - misses / n_requests, n_requests / elapsed
+        finally:
+            pool.stop()
+
+    rr_rate, rr_rps = run("round_robin")
+    ch_rate, ch_rps = run("prefix_hash")
+    return {"metric": "fleet_router_prefix_hit_rate",
+            "value": round(ch_rate, 4),
+            "unit": "prefix-cache hit rate (consistent-hash routing)",
+            "round_robin_hit_rate": round(rr_rate, 4),
+            "hit_rate_gain": round(ch_rate - rr_rate, 4),
+            "consistent_hash_requests_per_sec": round(ch_rps, 1),
+            "round_robin_requests_per_sec": round(rr_rps, 1),
+            "replicas": n_replicas, "prefix_groups": n_groups,
+            "requests": n_requests,
+            "config": f"{n_replicas} in-process replicas, "
+                      f"{n_groups} shared {prefix_len}-token prefixes, "
+                      f"{n_requests} proxied generates, lazy per-replica "
+                      "prefix registration (miss = cold registration)"}
+
+
 #: candidate (block_q, block_k) pairs for the flash kernel sweep — all
 #: multiples of the MXU-friendly 128 lane tile
 _BLOCK_GRID = ((128, 128), (128, 256), (256, 256), (256, 512),
@@ -654,7 +734,10 @@ def _emit(row):
 
 
 if __name__ == "__main__":
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    args = list(sys.argv[1:])
+    smoke = "--smoke" in args
+    args = [a for a in args if a != "--smoke"]
+    which = args[0] if args else "all"
     if which in ("otto", "all"):
         _emit(measure_otto())
     if which in ("resnet50", "all"):
@@ -669,6 +752,8 @@ if __name__ == "__main__":
         _emit(measure_flash_scaling())
     if which in ("engine", "all"):
         _emit(measure_engine())
+    if which in ("fleet_router", "all"):
+        _emit(measure_fleet_router(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

@@ -107,6 +107,8 @@ PAGES = [
     ("Continuous batching", "elephas_tpu.serving_engine",
      ["DecodeEngine", "QueueFullError", "DeadlineExceededError"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
+    ("Serving fleet API", "elephas_tpu.fleet",
+     ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool"]),
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
@@ -202,6 +204,7 @@ def main(out_dir: str = None):
               "  - Scaling guide: scaling-guide.md",
               "  - Serving guide: serving-guide.md",
               "  - Serving operations: serving-operations.md",
+              "  - Serving fleet: serving-fleet.md",
               "  - Fault tolerance: fault-tolerance.md",
               "  - Observability: observability.md",
               "  - Distributed tracing: tracing.md"]

@@ -1,0 +1,27 @@
+"""Replicated serving fleet: cache-aware router, health-driven
+membership, and an in-process replica pool for tests.
+
+- :class:`~.router.FleetRouter` — HTTP front end proxying the ``/v1/*``
+  serving API over N :class:`~elephas_tpu.serving_http.ServingServer`
+  replicas: consistent-hash routing on the prompt prefix (warm prefix
+  caches keep hitting under scale-out) with load-aware spill,
+  edge-level 429 admission, trace propagation, and re-routing around
+  dead replicas.
+- :class:`~.membership.ReplicaMembership` — periodic ``/ready`` probes
+  with join/evict hysteresis driving the hash ring; ``/stats`` load
+  refresh rides the same pass.
+- :class:`~.hashring.HashRing` — the deterministic consistent-hash
+  ring (only ~1/N of keys move per membership change).
+- :class:`~.pool.ReplicaPool` — N engine+server replicas in one
+  process, with kill/drain verbs and lazy per-replica prefix
+  registration, for tests and the ``fleet_router`` bench row.
+
+``docs/sources/serving-fleet.md`` is the operator guide.
+"""
+from .hashring import HashRing
+from .membership import ReplicaMembership, ReplicaState
+from .pool import ReplicaPool
+from .router import FleetRouter
+
+__all__ = ["FleetRouter", "HashRing", "ReplicaMembership",
+           "ReplicaState", "ReplicaPool"]

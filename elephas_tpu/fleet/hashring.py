@@ -1,0 +1,117 @@
+"""Consistent hash ring for cache-aware request routing.
+
+The router's core property: requests that share a prompt prefix must
+land on the SAME replica, so that replica's prefix cache keeps hitting
+— and when a replica joins or leaves, only ~1/N of the key space may
+move (a modulo hash would reshuffle nearly everything, invalidating
+every replica's warm cache at once). The classic fix (Karger et al.,
+*Consistent Hashing and Random Trees*, STOC 1997) places each node at
+many pseudo-random points on a hash circle and routes a key to the
+first node clockwise of the key's own point.
+
+Deterministic by construction: the ring is a pure function of the node
+set (``blake2b`` of ``node#vnode``), so two routers fronting the same
+pool route identically with no coordination — the same
+derive-the-plan-from-shapes-alone idea as
+:class:`~elephas_tpu.parameter.sharding.ShardPlan`, applied to the
+request plane.
+
+Stdlib-only; thread safety is the caller's concern (the membership
+layer mutates the ring under its own lock).
+"""
+import bisect
+import hashlib
+from typing import Iterable, Iterator, List, Tuple
+
+__all__ = ["HashRing"]
+
+#: ring points per node — enough that each node owns many small arcs
+#: and the per-node share of the key space concentrates near 1/N
+#: (stddev ~ 1/sqrt(vnodes) of the share)
+DEFAULT_VNODES = 64
+
+
+def _hash(data: bytes) -> int:
+    """64-bit position on the ring. blake2b over md5/sha1: fastest
+    stdlib digest at this size, and not a trust boundary (routing bias,
+    not integrity, is the failure mode)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big")
+
+
+class HashRing:
+    """A consistent hash ring over an arbitrary set of node names.
+
+    :param nodes: initial node names (any strings — the router uses
+        replica base URLs).
+    :param vnodes: ring points per node. More points = better balance,
+        linearly more memory and ``log``-factor lookup cost.
+    """
+
+    def __init__(self, nodes: Iterable[str] = (),
+                 vnodes: int = DEFAULT_VNODES):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self._vnodes = int(vnodes)
+        self._points: List[Tuple[int, str]] = []   # sorted (hash, node)
+        self._nodes: set = set()
+        for n in nodes:
+            self.add(n)
+
+    # ------------------------------------------------------------ mutation
+    def add(self, node: str) -> None:
+        """Place ``node`` on the ring (idempotent). Only keys whose arcs
+        the new node's points split move to it — ~1/N of the space."""
+        node = str(node)
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for v in range(self._vnodes):
+            point = (_hash(f"{node}#{v}".encode("utf8")), node)
+            bisect.insort(self._points, point)
+
+    def remove(self, node: str) -> None:
+        """Take ``node`` off the ring (idempotent). Its arcs fall to
+        each arc's clockwise successor — again ~1/N of the space moves,
+        spread over the survivors."""
+        node = str(node)
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [p for p in self._points if p[1] != node]
+
+    # ------------------------------------------------------------- lookup
+    def lookup(self, key: bytes) -> str:
+        """The node owning ``key`` (first ring point clockwise of the
+        key's hash). Raises on an empty ring."""
+        for node in self.successors(key):
+            return node
+        raise LookupError("hash ring is empty")
+
+    def successors(self, key: bytes) -> Iterator[str]:
+        """Nodes in clockwise order from ``key``'s point, each DISTINCT
+        node once — the owner first, then the fallback order a router
+        walks when the owner is excluded (evicted, draining, at
+        capacity). Deterministic per key."""
+        if not self._points:
+            return
+        i = bisect.bisect_right(self._points, (_hash(key), chr(0x10FFFF)))
+        seen = set()
+        n = len(self._points)
+        for off in range(n):
+            node = self._points[(i + off) % n][1]
+            if node not in seen:
+                seen.add(node)
+                yield node
+
+    # ----------------------------------------------------------- inspection
+    @property
+    def nodes(self) -> Tuple[str, ...]:
+        """Current node set, sorted (deterministic for /stats)."""
+        return tuple(sorted(self._nodes))
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return str(node) in self._nodes

@@ -1,0 +1,316 @@
+"""Health-driven replica membership for the serving fleet.
+
+Each replica's OWN readiness signal (``GET /ready`` — 503 while
+warming, draining, or failed; see :class:`~elephas_tpu.serving_http.
+ServingServer`) drives ring membership: a periodic prober walks the
+configured replica URLs, and consecutive-outcome hysteresis decides
+joins and evictions (one flapping probe must not thrash the ring —
+every membership change moves ~1/N of the key space and cools caches).
+
+The same probe pass refreshes each ready replica's load snapshot from
+its ``/stats`` (``queue_depth`` / ``queued_tokens``, the admission-
+control backlog the engines already export), which is what the router's
+load-aware spill decision reads. Between probes, a per-replica
+in-flight counter (requests this router has dispatched and not yet
+completed) keeps the load signal responsive.
+
+Two failure shapes are distinguished because they demand different
+router behavior:
+
+- ``dead`` — the probe (or a proxied request) could not CONNECT: the
+  process is gone, nothing it held will ever finish, and the router
+  may re-route submitted-but-unfinished requests to siblings.
+- ``unready`` — the replica answered, but 503 (warming/draining): it is
+  alive and will finish its in-flight work, so existing requests keep
+  polling it; only NEW work routes away.
+
+Evictions/joins mutate the shared :class:`~.hashring.HashRing`, bump
+the ``fleet_replicas_{joined,evicted}_total`` counters, and emit
+``fleet.replica_joined`` / ``fleet.replica_evicted`` events on the
+process event log (trace-stamped when a request's context triggered
+the eviction via :meth:`ReplicaMembership.mark_down`).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry
+from .hashring import DEFAULT_VNODES, HashRing
+
+__all__ = ["ReplicaMembership", "ReplicaState"]
+
+
+class ReplicaState:
+    """One replica's live view: reachability, readiness streaks, and
+    the last load snapshot. Mutated only under the membership lock."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self.ready = False          # currently in the ring
+        self.reachable = False      # last probe connected at all
+        self.consec_ok = 0
+        self.consec_fail = 0
+        self.queue_depth = 0        # from the replica's /stats
+        self.queued_tokens = 0
+        self.in_flight = 0          # this router's outstanding proxies
+        self.last_probe_at: Optional[float] = None
+
+    @property
+    def load(self) -> float:
+        """The spill comparator: backlog the replica reported plus what
+        this router has dispatched at it since that report."""
+        return float(self.queue_depth + self.in_flight)
+
+    def snapshot(self) -> Dict:
+        return {"ready": self.ready, "reachable": self.reachable,
+                "queue_depth": self.queue_depth,
+                "queued_tokens": self.queued_tokens,
+                "in_flight": self.in_flight}
+
+
+class ReplicaMembership:
+    """Probe-driven membership over a fixed candidate URL set.
+
+    :param urls: replica base URLs (``http://host:port``). The candidate
+        set is static; membership (who is IN the ring) is dynamic.
+    :param probe_interval: seconds between probe passes.
+    :param join_after: consecutive ready probes before a replica (re-)
+        joins the ring. 1 = join on first success (the in-process test
+        pools warm fast); raise it for flappy networks.
+    :param evict_after: consecutive failed probes before eviction.
+        :meth:`mark_down` (a proxied request hit a connect error)
+        bypasses the hysteresis — direct evidence beats sampling.
+    :param probe_timeout: per-probe socket timeout. Keep it well under
+        ``probe_interval``; a wedged replica must not stall the pass.
+    :param registry: the router's metrics registry (joined/evicted
+        counters and the ring-size/ready gauges land here).
+    :param on_evict: ``fn(url, reason)`` called AFTER an eviction,
+        outside the membership lock (the router re-routes orphaned
+        submits from it; reason is ``"dead"`` or ``"unready"``).
+    :param on_join: ``fn(url)`` likewise for joins.
+    """
+
+    def __init__(self, urls, probe_interval: float = 1.0,
+                 join_after: int = 1, evict_after: int = 2,
+                 probe_timeout: float = 1.0,
+                 vnodes: int = DEFAULT_VNODES,
+                 registry: Optional[MetricsRegistry] = None,
+                 on_evict: Optional[Callable[[str, str], None]] = None,
+                 on_join: Optional[Callable[[str], None]] = None):
+        if join_after < 1 or evict_after < 1:
+            raise ValueError("join_after and evict_after must be >= 1")
+        self._urls = [str(u).rstrip("/") for u in urls]
+        if len(set(self._urls)) != len(self._urls):
+            raise ValueError("duplicate replica urls")
+        self.probe_interval = float(probe_interval)
+        self.join_after = int(join_after)
+        self.evict_after = int(evict_after)
+        self.probe_timeout = float(probe_timeout)
+        self._on_evict = on_evict
+        self._on_join = on_join
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaState] = {
+            u: ReplicaState(u) for u in self._urls}
+        self.ring = HashRing(vnodes=vnodes)   # empty until first probe
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # probes run CONCURRENTLY: one wedged replica costs a pass one
+        # probe_timeout, not len(urls) of them — the evict-within-the-
+        # probe-window guarantee must not degrade with fleet size
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=min(len(self._urls), 16),
+            thread_name_prefix="fleet-probe")
+        reg = registry if registry is not None else MetricsRegistry()
+        self._m_joined = reg.counter(
+            "fleet_replicas_joined_total",
+            "replicas (re-)joined into the hash ring").labels()
+        self._m_evicted = reg.counter(
+            "fleet_replicas_evicted_total",
+            "replicas evicted from the hash ring (probe failure or "
+            "connect error)").labels()
+        reg.gauge("fleet_ring_size",
+                  "replicas currently in the hash ring").set_function(
+            lambda: float(len(self.ring)))
+        reg.gauge("fleet_replicas_ready",
+                  "replicas currently routable").set_function(
+            lambda: float(len(self.ready_urls())))
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        """Run one synchronous probe pass (so a router is immediately
+        routable over an already-warm pool), then the periodic prober."""
+        self.probe_once()
+        self._thread = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="fleet-membership-prober")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._probe_pool.shutdown(wait=False)
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_interval):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the prober must survive
+                pass           # anything a dying replica throws at it
+
+    # ------------------------------------------------------------- probing
+    def _probe_one(self, url: str) -> Tuple[bool, bool, Optional[Dict]]:
+        """(reachable, ready, stats) for one replica. ``stats`` is the
+        replica's /stats payload when it answered, or None when the
+        read failed — None means KEEP the previous load snapshot: a
+        replica so busy its /stats times out is the opposite of idle,
+        and overwriting its backlog with zeros would aim the spill
+        logic straight at the most overloaded replica."""
+        try:
+            with urllib.request.urlopen(url + "/ready",
+                                        timeout=self.probe_timeout):
+                pass
+        except urllib.error.HTTPError:
+            return True, False, None   # answered, but 503/500: unready
+        except Exception:  # noqa: BLE001 — URLError, socket, protocol
+            return False, False, None
+        try:
+            with urllib.request.urlopen(url + "/stats",
+                                        timeout=self.probe_timeout) as r:
+                return True, True, json.loads(r.read())
+        except Exception:  # noqa: BLE001 — ready without stats is fine
+            return True, True, None
+
+    def probe_once(self):
+        """One full pass: probe every candidate (concurrently), apply
+        hysteresis, fire join/evict callbacks (outside the lock)."""
+        outcomes = dict(zip(self._urls,
+                            self._probe_pool.map(self._probe_one,
+                                                 self._urls)))
+        joined: List[str] = []
+        evicted: List[Tuple[str, str]] = []
+        now = time.monotonic()
+        with self._lock:
+            for url, (reachable, ready, stats) in outcomes.items():
+                st = self._replicas[url]
+                st.reachable = reachable
+                st.last_probe_at = now
+                if ready:
+                    st.consec_ok += 1
+                    st.consec_fail = 0
+                    if stats is not None:   # failed read keeps the old
+                        st.queue_depth = int(stats.get("queue_depth", 0))
+                        st.queued_tokens = int(
+                            stats.get("queued_tokens", 0))
+                    if (not st.ready
+                            and st.consec_ok >= self.join_after):
+                        st.ready = True
+                        self.ring.add(url)
+                        joined.append(url)
+                else:
+                    st.consec_ok = 0
+                    st.consec_fail += 1
+                    if st.ready and st.consec_fail >= self.evict_after:
+                        st.ready = False
+                        self.ring.remove(url)
+                        evicted.append(
+                            (url, "unready" if reachable else "dead"))
+        for url in joined:
+            self._joined(url)
+        for url, reason in evicted:
+            self._evicted(url, reason)
+
+    def mark_down(self, url: str, reason: str = "dead"):
+        """Immediate eviction on direct evidence — a proxied request
+        could not connect. The prober re-joins the replica if it comes
+        back (``join_after`` successes)."""
+        url = str(url).rstrip("/")
+        with self._lock:
+            st = self._replicas.get(url)
+            if st is None or not st.ready:
+                return
+            st.ready = False
+            st.reachable = reason != "dead"
+            st.consec_ok = 0
+            st.consec_fail = max(st.consec_fail, self.evict_after)
+            self.ring.remove(url)
+        self._evicted(url, reason)
+
+    def _joined(self, url: str):
+        self._m_joined.inc()
+        emit_event("fleet.replica_joined", replica=url)
+        if self._on_join is not None:
+            self._on_join(url)
+
+    def _evicted(self, url: str, reason: str):
+        self._m_evicted.inc()
+        emit_event("fleet.replica_evicted", replica=url, reason=reason)
+        if self._on_evict is not None:
+            self._on_evict(url, reason)
+
+    # -------------------------------------------------------------- queries
+    def route_chain(self, key: bytes) -> List[str]:
+        """The ring's owner-then-fallback order for ``key``,
+        materialized under the lock (the prober mutates the ring
+        concurrently)."""
+        with self._lock:
+            return list(self.ring.successors(key))
+
+    def ring_nodes(self) -> List[str]:
+        """Ring membership, read under the lock — HashRing itself is
+        deliberately unsynchronized (its docstring: thread safety is
+        the caller's concern), and sorted() over a set the prober is
+        mutating raises mid-iteration."""
+        with self._lock:
+            return list(self.ring.nodes)
+
+    def ring_size(self) -> int:
+        with self._lock:
+            return len(self.ring)
+
+    def ready_urls(self, exclude=()) -> List[str]:
+        with self._lock:
+            return [u for u in self._urls
+                    if self._replicas[u].ready and u not in exclude]
+
+    def is_ready(self, url: str) -> bool:
+        with self._lock:
+            st = self._replicas.get(str(url).rstrip("/"))
+            return st is not None and st.ready
+
+    def is_reachable(self, url: str) -> bool:
+        with self._lock:
+            st = self._replicas.get(str(url).rstrip("/"))
+            return st is not None and st.reachable
+
+    def load(self, url: str) -> float:
+        with self._lock:
+            st = self._replicas.get(url)
+            return float("inf") if st is None else st.load
+
+    def least_loaded(self, exclude=()) -> Optional[str]:
+        """The ready replica with the smallest load score (stats backlog
+        + this router's outstanding dispatches); None when none ready."""
+        with self._lock:
+            ready = [(self._replicas[u].load, u) for u in self._urls
+                     if self._replicas[u].ready and u not in exclude]
+        return min(ready)[1] if ready else None
+
+    def record_dispatch(self, url: str, delta: int):
+        """Track this router's outstanding requests at ``url`` — the
+        between-probes half of the load signal."""
+        with self._lock:
+            st = self._replicas.get(url)
+            if st is not None:
+                st.in_flight = max(0, st.in_flight + delta)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Per-replica state for the router's /stats."""
+        with self._lock:
+            return {u: self._replicas[u].snapshot() for u in self._urls}

@@ -1,0 +1,178 @@
+"""In-process replica pool: N engine+server replicas for tests/benches.
+
+A production fleet runs each :class:`~elephas_tpu.serving_http.
+ServingServer` in its own process (or host); CPU tests and the
+``fleet_router`` bench row need the same topology without the process
+choreography. :class:`ReplicaPool` spawns N engines (from one factory)
+each behind its own ``ServingServer`` on a free port, and exposes the
+lifecycle verbs the router's failure-handling tests exercise:
+``kill(i)`` (abrupt stop — connections start failing, the membership
+prober evicts), ``drain(i)`` (graceful — ``/ready`` flips 503, siblings
+absorb new traffic while in-flight work finishes).
+
+``auto_prefix_tokens`` turns on per-replica LAZY prefix registration:
+the first request carrying a given ``prefix_tokens``-long prompt head
+registers it on THAT replica's engine (an admission-time miss — the
+prefill runs once), and every later same-prefix request admitted there
+hits the cached KV state. This is the automatic-prefix-caching analog
+of :meth:`~elephas_tpu.serving_engine.DecodeEngine.register_prefix`'s
+explicit registration, and it is exactly what makes routing policy
+measurable: under consistent-hash routing each prefix warms ONE
+replica and stays hot; under round-robin every replica pays the miss
+for every prefix. ``auto_prefix_capacity`` bounds registrations per
+replica (oldest evicted — each registration pins a device cache row).
+"""
+import threading
+from collections import OrderedDict
+from typing import Callable, List, Optional, Tuple
+
+from ..serving_http import ServingServer
+
+__all__ = ["ReplicaPool"]
+
+
+class _AutoPrefixEngine:
+    """Engine wrapper adding lazy bounded prefix registration at
+    submit time. Delegates everything else to the wrapped engine (the
+    ``ServingServer`` probes ``submit``'s signature, so it is mirrored
+    exactly)."""
+
+    def __init__(self, engine, prefix_tokens: int,
+                 capacity: Optional[int] = None):
+        self._engine = engine
+        self._prefix_tokens = int(prefix_tokens)
+        self._capacity = None if capacity is None else int(capacity)
+        self._known: "OrderedDict[Tuple[int, ...], bool]" = OrderedDict()
+        #: cold registrations — each is a prefix-cache MISS (the head's
+        #: KV state was not resident on THIS replica and had to be
+        #: computed). The routing-policy A/B counts hit rate as
+        #: (requests - misses) / requests: the engine's own
+        #: ``prefix_hits`` counter also counts the registering request
+        #: itself (registration at submit precedes its admission), so
+        #: it cannot distinguish a cold replica from a warm one.
+        self.misses = 0
+
+    def submit(self, prompt, max_new_tokens, temperature=None,
+               top_k=None, top_p=None, admit=True, deadline_ms=None):
+        head = tuple(int(t) for t in prompt[:self._prefix_tokens])
+        # only prompts strictly longer than the head can reuse it (a
+        # prefix must leave room for at least one suffix token)
+        if len(prompt) > len(head) and head and head not in self._known:
+            if (self._capacity is not None
+                    and len(self._known) >= self._capacity):
+                # bounded cache: evict oldest — the engine API has no
+                # single-prefix unregister, so re-register survivors
+                self._known.popitem(last=False)
+                self._engine.clear_prefixes()
+                for kept in self._known:
+                    self._engine.register_prefix(list(kept))
+            self._engine.register_prefix(list(head))
+            self._known[head] = True
+            self.misses += 1
+        return self._engine.submit(prompt, max_new_tokens,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, admit=admit,
+                                   deadline_ms=deadline_ms)
+
+    @property
+    def registered_prefixes(self) -> int:
+        return len(self._known)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+class ReplicaPool:
+    """N in-process serving replicas behind one factory.
+
+    :param engine_factory: zero-arg callable returning a fresh engine
+        per replica (each replica must own its device state — sharing
+        one engine would serialize the pool on one lock and measure
+        nothing).
+    :param n: replica count.
+    :param auto_prefix_tokens: when set, wrap each engine with lazy
+        per-replica prefix registration over this prompt-head length
+        (see the module docstring).
+    :param auto_prefix_capacity: max registered prefixes per replica
+        (None = unbounded).
+    :param tokenizer, server_kwargs: forwarded to every
+        :class:`~elephas_tpu.serving_http.ServingServer`.
+    """
+
+    def __init__(self, engine_factory: Callable[[], object], n: int = 3,
+                 host: str = "127.0.0.1", tokenizer=None,
+                 auto_prefix_tokens: Optional[int] = None,
+                 auto_prefix_capacity: Optional[int] = None,
+                 server_kwargs: Optional[dict] = None):
+        if n < 1:
+            raise ValueError(f"need n >= 1 replicas, got {n}")
+        self._factory = engine_factory
+        self._n = int(n)
+        self._host = host
+        self._tokenizer = tokenizer
+        self._auto_prefix_tokens = auto_prefix_tokens
+        self._auto_prefix_capacity = auto_prefix_capacity
+        self._server_kwargs = dict(server_kwargs or {})
+        self.servers: List[ServingServer] = []
+        self._alive: List[bool] = []
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self):
+        for _ in range(self._n):
+            engine = self._factory()
+            if self._auto_prefix_tokens is not None:
+                engine = _AutoPrefixEngine(
+                    engine, self._auto_prefix_tokens,
+                    capacity=self._auto_prefix_capacity)
+            srv = ServingServer(engine, host=self._host, port=0,
+                                tokenizer=self._tokenizer,
+                                **self._server_kwargs)
+            srv.start()
+            self.servers.append(srv)
+            self._alive.append(True)
+        return self
+
+    def stop(self):
+        with self._lock:
+            live = [i for i, a in enumerate(self._alive) if a]
+            for i in live:
+                self._alive[i] = False
+        for i in live:
+            self.servers[i].stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- chaos
+    def kill(self, i: int):
+        """Abrupt replica death: the HTTP front end stops cold (no
+        drain), connections start failing immediately — the scenario
+        the router's eviction + re-route path exists for."""
+        with self._lock:
+            if not self._alive[i]:
+                return
+            self._alive[i] = False
+        self.servers[i].stop(drain_timeout=0.0)
+
+    def drain(self, i: int):
+        """Graceful: ``/ready`` answers 503 and new submits are
+        rejected while in-flight requests finish; call
+        ``servers[i].stop(...)`` later for the actual shutdown."""
+        self.servers[i].begin_drain()
+
+    # ------------------------------------------------------------ queries
+    @property
+    def urls(self) -> List[str]:
+        return [f"http://{self._host}:{srv.port}" for srv in self.servers]
+
+    @property
+    def engines(self) -> List[object]:
+        return [srv.engine for srv in self.servers]
+
+    def alive(self, i: int) -> bool:
+        with self._lock:
+            return self._alive[i]

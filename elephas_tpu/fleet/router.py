@@ -1,0 +1,782 @@
+"""Cache-aware HTTP router fronting a pool of serving replicas.
+
+Every subsystem below this one hardens a SINGLE
+:class:`~elephas_tpu.serving_http.ServingServer`; this is the first
+multi-replica layer: a :class:`FleetRouter` proxies the ``/v1/*``
+serving API over N engine replicas, so the fleet scales out while
+clients keep speaking to one address.
+
+Routing policy (``policy="prefix_hash"``, the default):
+
+- **Consistent-hash on the prompt prefix.** The key is the first
+  ``prefix_tokens`` tokens (or the leading characters of a ``"text"``
+  request), hashed onto a :class:`~.hashring.HashRing` over the ready
+  replicas. Requests sharing a prompt prefix — the system-prompt
+  pattern the engines' prefix cache exists for — land on the SAME
+  replica, so its cached prefix KV state keeps hitting as the pool
+  scales; a membership change moves only ~1/N of the key space.
+- **Load-aware spill.** When the hash owner's backlog (``queue_depth``
+  from its ``/stats``, refreshed by the membership prober, plus this
+  router's own outstanding dispatches) exceeds the least-loaded ready
+  replica's by ``spill_threshold``, the request spills to the
+  least-loaded replica instead: a hot prefix must not melt one replica
+  while siblings idle. Spills are counted
+  (``fleet_requests_spilled_total``) and emitted as
+  ``fleet.request_spilled`` events — a rising spill rate is the signal
+  that one prefix's traffic outgrew a single replica.
+- ``policy="round_robin"`` is the cache-blind baseline the
+  ``fleet_router`` bench row A/Bs against.
+
+Membership is health-driven (:class:`~.membership.ReplicaMembership`):
+periodic ``/ready`` probes with join/evict hysteresis; a proxied
+request that cannot CONNECT evicts immediately (direct evidence) and
+the request retries on the next candidate. A replica evicted as
+``dead`` gets its submitted-but-unfinished requests re-routed: the
+router keeps each submit's body and resubmits it to a sibling, so a
+replica kill costs recompute, never a failed client request. (A replica
+evicted as ``unready`` — draining — keeps its in-flight work; only new
+submits route away.)
+
+Edge admission reuses the single-server semantics: when every ready
+replica answers 429, the router answers 429 with the largest
+``retry_after_ms`` hint observed (the whole pool is saturated — the
+client should back off at least as long as the most backlogged
+replica asked); when no replica is ready at all, 503.
+
+Tracing: the inbound ``traceparent`` (or a fresh root) is installed for
+the handler and FORWARDED on every proxied request, so one trace id
+spans router -> replica -> parameter server; every router response
+carries ``X-Trace-Id``.
+
+Router surfaces: ``GET /stats`` (per-replica route counts, spills,
+re-routes, evictions, ring size), ``GET /metrics`` (Prometheus
+``fleet_*`` series), ``/health`` / ``/ready`` (the router is ready iff
+at least one replica is), and proxied ``/v1/generate`` (blocking and
+streaming), ``/v1/submit``, ``/v1/result``, ``/v1/cancel``,
+``/v1/requests/<id>/trace``. Request ids returned by ``/v1/submit`` are
+FLEET-level ids (each replica numbers its own requests independently;
+the router keeps the mapping).
+
+``docs/sources/serving-fleet.md`` has the topology, lifecycle, and ops
+runbook.
+"""
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..obs.context import (current_context, new_root, parse_traceparent,
+                           use_context)
+from ..obs.events import emit as emit_event
+from ..obs.metrics import (MetricsRegistry, counter_baseline,
+                           since_baseline)
+from ..serving_http import QuietThreadingHTTPServer
+from .membership import ReplicaMembership
+
+__all__ = ["FleetRouter"]
+
+#: route label domain for the fleet_http_* metrics (unknown paths fold
+#: into "other" so a scanner cannot grow label cardinality)
+_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/v1/result",
+                 "/v1/generate", "/v1/submit", "/v1/cancel",
+                 "/v1/requests/:id/trace")
+
+_TRACE_ROUTE_RE = re.compile(r"^/v1/requests/(\d+)/trace$")
+
+
+def _route_label(path: str) -> str:
+    if path in _KNOWN_ROUTES:
+        return path
+    if _TRACE_ROUTE_RE.match(path):
+        return "/v1/requests/:id/trace"
+    return "other"
+
+
+class _HTTPError(Exception):
+    """A routed outcome with a specific status (the ServingServer
+    convention): raised anywhere under a handler, answered as ``code``
+    + JSON payload."""
+
+    def __init__(self, code: int, payload: Dict):
+        super().__init__(payload.get("error", f"http {code}"))
+        self.code = code
+        self.payload = payload
+
+
+def _error_payload(err: urllib.error.HTTPError) -> Dict:
+    """The replica's JSON error body (best effort — a replica dying
+    mid-response may leave garbage)."""
+    try:
+        return json.loads(err.read())
+    except Exception:  # noqa: BLE001 — half-written body
+        return {"error": f"replica answered {err.code}"}
+
+
+class FleetRouter:
+    """HTTP front end spreading the serving API over N replicas.
+
+    :param replica_urls: base URLs of the candidate
+        :class:`~elephas_tpu.serving_http.ServingServer` replicas
+        (``http://host:port``). The candidate set is fixed; live
+        membership is probe-driven.
+    :param host, port: router bind address (port 0 picks a free port).
+    :param policy: ``"prefix_hash"`` (consistent-hash + load spill, the
+        default) or ``"round_robin"`` (cache-blind baseline).
+    :param prefix_tokens: length of the prompt prefix hashed into the
+        routing key. Match it to the deployed system-prompt length;
+        requests differing only past this many tokens share a replica.
+    :param spill_threshold: backlog difference (owner minus least
+        loaded, in requests) that triggers a spill. Low values spread
+        load aggressively at the cost of cache hits; ``None`` disables
+        spilling (pure hash placement).
+    :param probe_interval, join_after, evict_after, probe_timeout:
+        membership probe cadence and hysteresis (see
+        :class:`~.membership.ReplicaMembership`).
+    :param proxy_timeout: per-proxied-request socket timeout — must
+        comfortably exceed the longest expected generation.
+    :param max_tracked: submitted-but-unfetched request mappings kept
+        before the oldest are evicted (abandoned submits must not leak
+        router memory).
+    :param registry: metrics registry for the ``fleet_*`` series
+        (fresh per-router by default, the engines' convention).
+    """
+
+    def __init__(self, replica_urls, host: str = "127.0.0.1",
+                 port: int = 0, policy: str = "prefix_hash",
+                 prefix_tokens: int = 16,
+                 spill_threshold: Optional[float] = 4.0,
+                 probe_interval: float = 1.0, join_after: int = 1,
+                 evict_after: int = 2, probe_timeout: float = 1.0,
+                 proxy_timeout: float = 120.0, max_tracked: int = 4096,
+                 vnodes: int = 64,
+                 registry: Optional[MetricsRegistry] = None):
+        if policy not in ("prefix_hash", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.policy = policy
+        self.prefix_tokens = int(prefix_tokens)
+        self.spill_threshold = (None if spill_threshold is None
+                                else float(spill_threshold))
+        self.proxy_timeout = float(proxy_timeout)
+        self.max_tracked = int(max_tracked)
+        self._host, self._port = host, int(port)
+        self._urls = [str(u).rstrip("/") for u in replica_urls]
+        if not self._urls:
+            raise ValueError("need at least one replica url")
+        self.registry = reg = (registry if registry is not None
+                               else MetricsRegistry())
+        self.membership = ReplicaMembership(
+            self._urls, probe_interval=probe_interval,
+            join_after=join_after, evict_after=evict_after,
+            probe_timeout=probe_timeout, vnodes=vnodes, registry=reg,
+            on_evict=self._on_evict)
+        self._m_routed = reg.counter(
+            "fleet_requests_routed_total",
+            "requests proxied, by replica and placement decision",
+            labels=("replica", "policy"))
+        self._m_spilled = reg.counter(
+            "fleet_requests_spilled_total",
+            "requests diverted from their hash owner to the "
+            "least-loaded replica").labels()
+        self._m_rerouted = reg.counter(
+            "fleet_requests_rerouted_total",
+            "requests retried on a sibling after a replica failure"
+            ).labels()
+        self._m_http_latency = reg.histogram(
+            "fleet_http_request_duration_seconds",
+            "router-side request wall time by route and status",
+            labels=("route", "status"))
+        # per-router baselines (the ServingServer convention): /stats
+        # reports THIS router's deltas even over an injected registry
+        self._stat_base = counter_baseline(
+            self._m_spilled, self._m_rerouted,
+            self.membership._m_joined, self.membership._m_evicted)
+        # fleet rid -> {"url", "rid", "body", "orphan"}; insertion-
+        # ordered so abandoned submits evict oldest-first
+        self._records: "OrderedDict[int, Dict]" = OrderedDict()
+        self._trace_map: "OrderedDict[int, Tuple[str, int]]" = OrderedDict()
+        self._records_lock = threading.Lock()
+        self._next_fid = 0
+        self._rr = 0                 # round-robin cursor
+        self._rr_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd: Optional[QuietThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self):
+        """Probe the pool once (immediate routability over a warm
+        pool), start the prober and the HTTP front end."""
+        self.membership.start()
+        handler = self._make_handler()
+        self._httpd = QuietThreadingHTTPServer((self._host, self._port),
+                                               handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.membership.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- routing
+    def _route_key(self, body: Dict) -> bytes:
+        """The consistent-hash key: the prompt's first
+        ``prefix_tokens`` tokens (requests sharing a system prompt
+        share a key — and therefore a replica and its warm prefix
+        cache)."""
+        prompt = body.get("prompt")
+        if isinstance(prompt, (list, tuple)):
+            head = ",".join(str(t) for t in prompt[:self.prefix_tokens])
+            return ("t:" + head).encode("utf8", "replace")
+        text = body.get("text")
+        if isinstance(text, str):
+            # ~4 chars per token is close enough for a routing key
+            return ("s:" + text[:4 * self.prefix_tokens]).encode(
+                "utf8", "replace")
+        # malformed body: route it anywhere; the replica answers the 400
+        return b"?"
+
+    def _pick(self, key: bytes, tried) -> Optional[Tuple[str, str]]:
+        """(replica url, placement label) for the next attempt, or None
+        when no ready replica remains outside ``tried``."""
+        ready = self.membership.ready_urls(exclude=tried)
+        if not ready:
+            return None
+        if self.policy == "round_robin":
+            with self._rr_lock:
+                i = self._rr
+                self._rr += 1
+            order = sorted(ready)
+            return order[i % len(order)], "rr"
+        ready_set = set(ready)
+        owner = next((u for u in self.membership.route_chain(key)
+                      if u in ready_set), None)
+        if owner is None:
+            # candidates exist but none is on the ring yet (joins are
+            # hysteresis-delayed): least-loaded beats refusing traffic
+            fallback = self.membership.least_loaded(exclude=tried)
+            return (fallback, "hash") if fallback else None
+        if self.spill_threshold is not None and not tried:
+            # spill is a FIRST-placement decision only: on a retry the
+            # failed candidates are already excluded, and re-emitting
+            # here would count several spills (some never even served)
+            # for one client request — garbage for the spill-rate alert
+            least = self.membership.least_loaded(exclude=tried)
+            if (least is not None and least != owner
+                    and self.membership.load(owner)
+                    - self.membership.load(least)
+                    >= self.spill_threshold):
+                self._m_spilled.inc()
+                emit_event("fleet.request_spilled", owner=owner,
+                           spilled_to=least,
+                           owner_load=self.membership.load(owner),
+                           target_load=self.membership.load(least))
+                return least, "spill"
+        return owner, "hash"
+
+    # -------------------------------------------------------------- proxy
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        ctx = current_context()
+        if ctx is not None:
+            # one trace id spans client -> router -> replica -> PS
+            headers["traceparent"] = ctx.to_traceparent()
+        return headers
+
+    def _post_replica(self, url: str, path: str, body: Dict) -> Dict:
+        req = urllib.request.Request(url + path,
+                                     data=json.dumps(body).encode(),
+                                     headers=self._headers())
+        with urllib.request.urlopen(req,
+                                    timeout=self.proxy_timeout) as resp:
+            return json.loads(resp.read())
+
+    def _get_replica(self, url: str, path: str) -> Dict:
+        req = urllib.request.Request(url + path, headers=self._headers())
+        with urllib.request.urlopen(req,
+                                    timeout=self.proxy_timeout) as resp:
+            return json.loads(resp.read())
+
+    def _replica_alive(self, url: str) -> bool:
+        """Quick readiness recheck after a replica-side error: decides
+        retry-on-sibling (it died / is draining) vs forward-the-error
+        (it is healthy and meant what it said)."""
+        try:
+            with urllib.request.urlopen(
+                    url + "/ready",
+                    timeout=self.membership.probe_timeout):
+                return True
+        except Exception:  # noqa: BLE001 — refused, 503, wedged: not ok
+            return False
+
+    def _foreach_candidate(self, body: Dict, attempt):
+        """The fleet's one retry/error-classification loop, shared by
+        blocking dispatch and stream opening (their failure semantics
+        must never diverge). ``attempt(url, how)`` performs one try
+        against one replica and returns the result; its exceptions are
+        classified here:
+
+        - 429: the replica shed — remember its backoff hint, try the
+          next candidate; only the WHOLE pool saturating surfaces as
+          an edge 429 (with the largest hint observed).
+        - 503-draining: finishing its own work, taking no new submits —
+          route on (the prober will evict it shortly).
+        - other replica-side errors: recheck ``/ready`` — a dead/dying
+          replica (stop-race 400, crash 500) is evicted on direct
+          evidence and the request retries (it never started prefill
+          anywhere else); a HEALTHY replica's 4xx/5xx is forwarded.
+        - connect/reset/timeout: evict and retry.
+        """
+        key = self._route_key(body)
+        tried: set = set()
+        retry_hints: List[int] = []
+        for _ in range(len(self._urls) + 1):
+            pick = self._pick(key, tried)
+            if pick is None:
+                break
+            url, how = pick
+            try:
+                return attempt(url, how)
+            except urllib.error.HTTPError as err:
+                detail = _error_payload(err)
+                if err.code == 429:
+                    retry_hints.append(
+                        int(detail.get("retry_after_ms", 100)))
+                    tried.add(url)
+                    continue
+                if err.code == 503 and detail.get("draining"):
+                    tried.add(url)
+                    continue
+                if not self._replica_alive(url):
+                    self.membership.mark_down(url, "dead")
+                    self._m_rerouted.inc()
+                    tried.add(url)
+                    continue
+                raise _HTTPError(err.code, detail)   # genuine 4xx/5xx
+            except _HTTPError:
+                raise
+            except Exception:  # noqa: BLE001 — refused/reset/timeout
+                self.membership.mark_down(url, "dead")
+                self._m_rerouted.inc()
+                tried.add(url)
+                continue
+        if retry_hints:
+            raise _HTTPError(429, {
+                "error": "every ready replica is at capacity",
+                "retry_after_ms": max(retry_hints)})
+        raise _HTTPError(503, {
+            "error": "no ready replicas in the fleet",
+            "replicas_ready": 0})
+
+    def _dispatch(self, path: str, body: Dict) -> Tuple[str, Dict]:
+        """POST ``body`` to a policy-chosen replica, retrying across the
+        pool on replica failure/saturation. Returns ``(url, payload)``
+        of the successful response; raises :class:`_HTTPError` with the
+        edge-level outcome otherwise."""
+        def attempt(url, how):
+            self.membership.record_dispatch(url, +1)
+            try:
+                payload = self._post_replica(url, path, body)
+            finally:
+                self.membership.record_dispatch(url, -1)
+            self._m_routed.labels(replica=url, policy=how).inc()
+            return url, payload
+
+        return self._foreach_candidate(body, attempt)
+
+    # -------------------------------------------------- submit bookkeeping
+    def _track(self, url: str, backend_rid: int, body: Dict) -> int:
+        with self._records_lock:
+            fid = self._next_fid
+            self._next_fid += 1
+            self._records[fid] = {"url": url, "rid": int(backend_rid),
+                                  "body": body, "orphan": False}
+            while len(self._records) > self.max_tracked:
+                self._records.popitem(last=False)    # abandoned submits
+            self._trace_map[fid] = (url, int(backend_rid))
+            while len(self._trace_map) > self.max_tracked:
+                self._trace_map.popitem(last=False)
+            return fid
+
+    def _on_evict(self, url: str, reason: str):
+        """Membership eviction hook: a DEAD replica's submitted-but-
+        unfinished requests are re-routed to siblings (recompute, not
+        failure). A merely-unready (draining) replica keeps its work —
+        it will finish it. The resubmits run on a BACKGROUND thread:
+        this hook fires inside the membership prober or a client
+        request that tripped over the dead replica, and neither may
+        stall behind up to ``max_tracked`` proxied resubmissions."""
+        if reason != "dead":
+            return
+        with self._records_lock:
+            orphans = []
+            for fid, rec in self._records.items():
+                if rec["url"] == url:
+                    rec["orphan"] = True
+                    orphans.append(fid)
+        if orphans:
+            threading.Thread(target=lambda: [self._reroute(f)
+                                             for f in orphans],
+                             daemon=True,
+                             name="fleet-orphan-reroute").start()
+
+    def _reroute(self, fid: int) -> bool:
+        """Resubmit an orphaned request's stored body to a live
+        replica; returns whether it found a home. The orphan is
+        CLAIMED under the records lock first, so the eviction-time
+        background sweep and concurrent result polls never double-
+        submit one request (a duplicate would burn a sibling's slot
+        decoding a result nobody can fetch)."""
+        with self._records_lock:
+            rec = self._records.get(fid)
+            if (rec is None or not rec["orphan"]
+                    or rec.get("rerouting")):
+                return rec is not None and not rec["orphan"]
+            rec["rerouting"] = True
+            body = rec["body"]
+        try:
+            url, payload = self._dispatch("/v1/submit", body)
+        except _HTTPError:
+            with self._records_lock:
+                rec = self._records.get(fid)
+                if rec is not None:
+                    rec["rerouting"] = False   # still orphaned; a later
+            return False                       # poll retries the claim
+        self._m_rerouted.inc()
+        with self._records_lock:
+            rec = self._records.get(fid)
+            if rec is not None:
+                rec.update(url=url, rid=int(payload["id"]),
+                           orphan=False, rerouting=False)
+            self._trace_map[fid] = (url, int(payload["id"]))
+        return True
+
+    # ------------------------------------------------------------- routes
+    def _do_generate(self, body: Dict) -> Dict:
+        _, payload = self._dispatch("/v1/generate", body)
+        return payload
+
+    def _do_submit(self, body: Dict) -> Dict:
+        url, payload = self._dispatch("/v1/submit", body)
+        return {"id": self._track(url, payload["id"], body)}
+
+    def _do_result(self, fid: int) -> Dict:
+        with self._records_lock:
+            rec = self._records.get(fid)
+            rec = dict(rec) if rec is not None else None
+        if rec is None:
+            raise _HTTPError(404, {
+                "status": "unknown",
+                "error": f"no such request id {fid} (never issued, "
+                         "cancelled, or its result was already "
+                         "fetched)"})
+        if rec["orphan"]:
+            # its replica died and the eviction-time reroute hasn't
+            # re-homed it yet; try (or wait out a concurrent claim)
+            if not self._reroute(fid):
+                return {"status": "pending", "orphaned": True}
+            with self._records_lock:
+                fresh = self._records.get(fid)
+                # the record can vanish in this window (max_tracked
+                # eviction, a concurrent poll completing): report
+                # pending and let the next poll resolve it
+                if fresh is None:
+                    return {"status": "pending", "rerouted": True}
+                rec = dict(fresh)
+        try:
+            payload = self._get_replica(rec["url"],
+                                        f"/v1/result?id={rec['rid']}")
+        except urllib.error.HTTPError as err:
+            detail = _error_payload(err)
+            if err.code in (404, 504):
+                # terminal either way: the result is gone (fetched out
+                # of band / evicted) or the request expired in queue
+                with self._records_lock:
+                    self._records.pop(fid, None)
+                raise _HTTPError(err.code, detail)
+            if not self._replica_alive(rec["url"]):
+                self.membership.mark_down(rec["url"], "dead")
+                self._reroute(fid)
+                return {"status": "pending", "rerouted": True}
+            raise _HTTPError(err.code, detail)
+        except _HTTPError:
+            raise
+        except Exception:  # noqa: BLE001 — the replica is gone; the
+            # stored body re-routes the request instead of failing it
+            self.membership.mark_down(rec["url"], "dead")
+            self._reroute(fid)
+            return {"status": "pending", "rerouted": True}
+        if payload.get("status") != "pending":
+            with self._records_lock:
+                self._records.pop(fid, None)
+        return payload
+
+    def _do_cancel(self, body: Dict) -> Dict:
+        fid = int(body.get("id", -1))
+        with self._records_lock:
+            rec = self._records.pop(fid, None)
+        if rec is None:
+            return {"cancelled": False}
+        try:
+            return self._post_replica(rec["url"], "/v1/cancel",
+                                      {"id": rec["rid"]})
+        except Exception:  # noqa: BLE001 — a dead replica cancelled it
+            return {"cancelled": False}  # the hard way; nothing to stop
+
+    def _do_trace(self, fid: int) -> Dict:
+        with self._records_lock:
+            entry = self._trace_map.get(fid)
+        if entry is None:
+            raise _HTTPError(404, {
+                "status": "unknown",
+                "error": f"no flight-recorder timeline for request id "
+                         f"{fid} (never issued, or evicted)"})
+        url, rid = entry
+        try:
+            return self._get_replica(url, f"/v1/requests/{rid}/trace")
+        except urllib.error.HTTPError as err:
+            raise _HTTPError(err.code, _error_payload(err))
+        except Exception:  # noqa: BLE001
+            raise _HTTPError(404, {
+                "status": "unknown",
+                "error": f"replica {url} holding the timeline for "
+                         f"request id {fid} is unreachable"})
+
+    # -------------------------------------------------------------- stats
+    def _route_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-replica placement counts from the routed counter — the
+        metric IS the store."""
+        out: Dict[str, Dict[str, int]] = {}
+        for (replica, policy), child in self._m_routed.series().items():
+            out.setdefault(replica, {})[policy] = int(child.value)
+        return out
+
+    def stats(self) -> Dict:
+        routes = self._route_counts()
+        replicas = self.membership.snapshot()
+        for url, info in replicas.items():
+            info["routes"] = routes.get(url, {})
+        with self._records_lock:
+            tracked = len(self._records)
+        since = self._stat_base
+        return {
+            "policy": self.policy,
+            # locked reads: the prober mutates the ring concurrently
+            "ring_size": self.membership.ring_size(),
+            "ring_nodes": self.membership.ring_nodes(),
+            "replicas": replicas,
+            "requests_spilled": int(
+                since_baseline(since, self._m_spilled)),
+            "requests_rerouted": int(
+                since_baseline(since, self._m_rerouted)),
+            "replicas_joined": int(
+                since_baseline(since, self.membership._m_joined)),
+            "replicas_evicted": int(
+                since_baseline(since, self.membership._m_evicted)),
+            "requests_tracked": tracked,
+        }
+
+    # ------------------------------------------------------------ handler
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _trace_context(self):
+                ctx = parse_traceparent(self.headers.get("traceparent"))
+                return ctx if ctx is not None else new_root()
+
+            def _reply(self, code: int, body: bytes, content_type: str):
+                route = _route_label(urlparse(self.path).path)
+                dur = time.perf_counter() - getattr(
+                    self, "_t0", time.perf_counter())
+                labels = dict(route=route, status=str(int(code)))
+                router._m_http_latency.labels(**labels).observe(dur)
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                ctx = current_context()
+                if ctx is not None:
+                    self.send_header("X-Trace-Id", ctx.trace_id)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, payload: Dict):
+                self._reply(code, json.dumps(payload).encode(),
+                            "application/json")
+
+            def _body(self) -> Dict:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    raise _HTTPError(400,
+                                     {"error": "invalid Content-Length"})
+                if length <= 0:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                self._t0 = time.perf_counter()
+                url = urlparse(self.path)
+                with use_context(self._trace_context()):
+                    try:
+                        self._get_routes(url)
+                    except _HTTPError as err:
+                        self._json(err.code, err.payload)
+                    except Exception as exc:  # noqa: BLE001 — an
+                        # unexpected router/replica-payload error must
+                        # answer 500, never drop the connection
+                        self._json(500, {"error": str(exc)})
+
+            def _get_routes(self, url):
+                trace_route = _TRACE_ROUTE_RE.match(url.path)
+                if url.path == "/health":
+                    self._json(200, {"status": "ok"})
+                elif url.path == "/ready":
+                    ready = router.membership.ready_urls()
+                    if ready:
+                        self._json(200, {"status": "ready",
+                                         "replicas_ready": len(ready)})
+                    else:
+                        self._json(503, {"status": "no ready replicas",
+                                         "replicas_ready": 0})
+                elif url.path == "/stats":
+                    self._json(200, router.stats())
+                elif url.path == "/metrics":
+                    self._reply(200, router.registry.render().encode(),
+                                "text/plain; version=0.0.4; "
+                                "charset=utf-8")
+                elif url.path == "/v1/result":
+                    rid = parse_qs(url.query).get("id")
+                    try:
+                        rid = int(rid[0]) if rid else None
+                    except ValueError:
+                        rid = None
+                    if rid is None:
+                        self._json(400, {"error": "missing/invalid id"})
+                        return
+                    self._json(200, router._do_result(rid))
+                elif trace_route is not None:
+                    self._json(200, router._do_trace(
+                        int(trace_route.group(1))))
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                self._t0 = time.perf_counter()
+                url = urlparse(self.path)
+                with use_context(self._trace_context()):
+                    try:
+                        body = self._body()
+                    except _HTTPError as err:
+                        self._json(err.code, err.payload)
+                        return
+                    except (ValueError, json.JSONDecodeError):
+                        self._json(400, {"error": "invalid JSON body"})
+                        return
+                    try:
+                        if (url.path == "/v1/generate"
+                                and body.get("stream")):
+                            self._stream(body)
+                        elif url.path == "/v1/generate":
+                            self._json(200, router._do_generate(body))
+                        elif url.path == "/v1/submit":
+                            self._json(200, router._do_submit(body))
+                        elif url.path == "/v1/cancel":
+                            self._json(200, router._do_cancel(body))
+                        else:
+                            self._json(404, {"error": "unknown path"})
+                    except _HTTPError as err:
+                        self._json(err.code, err.payload)
+                    except Exception as exc:  # noqa: BLE001 — a
+                        # malformed-but-valid-JSON body (a list, wrong
+                        # types) or a surprising replica payload
+                        # answers a clean 400, never a dropped
+                        # connection (the ServingServer convention;
+                        # mid-stream failures are handled in _stream,
+                        # whose headers are already on the wire)
+                        self._json(400, {"error": str(exc)})
+
+            def _stream(self, body: Dict):
+                """Proxy a streaming generate: the upstream is opened
+                (status + headers on the wire) BEFORE our 200 goes out,
+                so replica failure before the first token still retries
+                on a sibling; after that, lines forward as they
+                arrive."""
+                url, upstream = router._open_stream(body)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    ctx = current_context()
+                    if ctx is not None:
+                        self.send_header("X-Trace-Id", ctx.trace_id)
+                    self.end_headers()
+                    for raw in upstream:
+                        self.wfile.write(raw)
+                        self.wfile.flush()
+                except Exception:  # noqa: BLE001 — client or replica
+                    pass           # gone mid-stream: close both sides
+                finally:
+                    upstream.close()
+                    # the stream held an in-flight slot on the spill
+                    # signal for its whole life (see _open_stream)
+                    router.membership.record_dispatch(url, -1)
+                    # the 200 went out before the first token; record
+                    # the FULL stream duration (streams bypass _reply,
+                    # which otherwise owns this histogram)
+                    router._m_http_latency.labels(
+                        route="/v1/generate", status="200").observe(
+                        time.perf_counter() - self._t0)
+
+        return Handler
+
+    def _open_stream(self, body: Dict) -> Tuple[str, object]:
+        """Open a streaming generate on a policy-chosen replica —
+        the same :meth:`_foreach_candidate` retry semantics as blocking
+        dispatch (retries are safe until the first token is forwarded,
+        and ``urlopen`` returning means only headers arrived). Returns
+        ``(url, response)``; the in-flight count taken here is the
+        CALLER's to release when the stream ends — a long-lived stream
+        must weigh on the spill signal for its whole life, not just its
+        opening handshake."""
+        def attempt(url, how):
+            req = urllib.request.Request(url + "/v1/generate",
+                                         data=json.dumps(body).encode(),
+                                         headers=self._headers())
+            self.membership.record_dispatch(url, +1)
+            try:
+                resp = urllib.request.urlopen(req,
+                                              timeout=self.proxy_timeout)
+            except BaseException:
+                self.membership.record_dispatch(url, -1)
+                raise
+            self._m_routed.labels(replica=url, policy=how).inc()
+            return url, resp
+
+        return self._foreach_candidate(body, attempt)

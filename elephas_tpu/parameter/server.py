@@ -627,7 +627,7 @@ class SocketServer(BaseParameterServer):
                     if opcode in (b"u", b"U"):
                         update_id = None
                         if opcode == b"U":
-                            update_id = recv_exact(conn, 32).decode(
+                            update_id = bytes(recv_exact(conn, 32)).decode(
                                 "ascii", "replace")
                         # copy=False: the delta arrays view the receive
                         # buffer — safe here because apply_delta only

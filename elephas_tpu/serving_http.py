@@ -84,6 +84,22 @@ __all__ = ["ServingServer"]
 
 _IDLE_SLEEP = 0.005
 
+
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that does not traceback-spam stderr when a
+    client vanishes mid-response (a prober timing out on a busy /stats,
+    a curl ^C mid-stream) — routine peer behavior, not a server error.
+    Every other handler exception still prints. Shared with the fleet
+    router's front end."""
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
 #: the route label domain for http_* metrics — anything else is
 #: "other", so a scanner probing random paths cannot grow label
 #: cardinality past the registry's bound
@@ -470,7 +486,8 @@ class ServingServer:
                     # connection drop (the parameter server's convention)
                     self._json(400, {"error": str(exc)})
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd = QuietThreadingHTTPServer((self._host, self._port),
+                                               Handler)
         self._port = self._httpd.server_address[1]
         self._threads = [
             threading.Thread(target=self._httpd.serve_forever, daemon=True),

@@ -15,7 +15,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .tensor_codec import (_CODE_DTYPES, _DTYPE_CODES, CodecError,
-                           KIND_WEIGHTS, MAX_FRAME_BYTES)
+                           KIND_WEIGHTS, MAX_FRAME_BYTES, alloc_frame)
 
 _LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libetpu.so"
 _lib = None
@@ -134,8 +134,12 @@ def _describe_arrays(arrays: Sequence[np.ndarray]):
 
 
 def encode_tensors_native(arrays: Sequence[np.ndarray],
-                          kind: int = KIND_WEIGHTS) -> Optional[bytes]:
-    """Native encode; returns None when the library is unavailable."""
+                          kind: int = KIND_WEIGHTS
+                          ) -> Optional[memoryview]:
+    """Native encode; returns None when the library is unavailable.
+    The output buffer is allocated UNINITIALIZED (``alloc_frame`` — no
+    memset of bytes ``etpu_encode`` writes in full; the C side
+    documents the same every-byte-written contract)."""
     lib = _load()
     if lib is None:
         return None
@@ -143,15 +147,15 @@ def encode_tensors_native(arrays: Sequence[np.ndarray],
     size = lib.etpu_encoded_size(len(arrays), codes, ndims, dims)
     if size < 0:
         raise CodecError("native encode: bad dtype")
-    out = bytearray(size)
+    out = alloc_frame(size)
     buf = (ctypes.c_char * size).from_buffer(out)
     ptrs = (ctypes.c_void_p * max(len(arrays), 1))()
     for i, arr in enumerate(arrays):
         ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p)
     if lib.etpu_encode(len(arrays), ptrs, codes, ndims, dims, kind, buf) != 0:
         raise CodecError("native encode failed")
-    del buf  # release the exported buffer so the bytearray is usable
-    return out  # bytearray: bytes-like for sendall/urllib without a copy
+    del buf  # release the exported buffer so the memoryview is usable
+    return out  # bytes-like for sendall/urllib without a copy
 
 
 def decode_tensors_native(payload,
@@ -164,9 +168,15 @@ def decode_tensors_native(payload,
     lib = _load()
     if lib is None:
         return None
-    if isinstance(payload, bytearray):
-        # c_char arrays decay to c_char_p params without copying the buffer
-        raw = (ctypes.c_char * len(payload)).from_buffer(payload)
+    if isinstance(payload, (bytearray, memoryview)):
+        # writable buffers (the zero-copy receive path returns
+        # memoryviews): c_char arrays decay to c_char_p params without
+        # copying; read-only memoryviews (rare) fall back to one copy
+        if isinstance(payload, memoryview) and payload.readonly:
+            payload = bytes(payload)
+            raw = payload
+        else:
+            raw = (ctypes.c_char * len(payload)).from_buffer(payload)
     else:
         raw = payload
     count = ctypes.c_int32()
@@ -201,11 +211,14 @@ def decode_tensors_native(payload,
 
 
 def send_frame_native(fd: int, payload) -> bool:
-    """Send one frame; ``payload`` may be bytes or bytearray (zero copy)."""
+    """Send one frame; ``payload`` may be bytes, bytearray, or the
+    writable memoryview the zero-copy encoder returns (all zero
+    copy)."""
     lib = _load()
     if lib is None:
         return False
-    if isinstance(payload, bytearray):
+    if (isinstance(payload, (bytearray, memoryview))
+            and not getattr(payload, "readonly", False)):
         buf = (ctypes.c_char * len(payload)).from_buffer(payload)
         rc = lib.etpu_send_frame(fd, ctypes.cast(buf, ctypes.c_void_p),
                                  len(payload))
@@ -317,7 +330,7 @@ def batch_iterator(columns, order, batch_size: int, copy: bool = True):
         yield tuple(np.asarray(c)[idx] for c in columns)
 
 
-def recv_frame_native(fd: int) -> Optional[bytearray]:
+def recv_frame_native(fd: int) -> Optional[memoryview]:
     lib = _load()
     if lib is None:
         return None
@@ -326,7 +339,10 @@ def recv_frame_native(fd: int) -> Optional[bytearray]:
         raise ConnectionError("socket closed while reading frame")
     if length > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame length {length} exceeds limit")
-    out = bytearray(int(length))
+    # uninitialized (no bytearray memset): etpu_recv_frame_body either
+    # fills every byte or errors, and the error path never returns the
+    # buffer — the shared alloc_frame ownership contract
+    out = alloc_frame(int(length))
     buf = (ctypes.c_char * int(length)).from_buffer(out)
     if lib.etpu_recv_frame_body(fd, buf, length) != 0:
         raise ConnectionError("socket closed while reading frame body")

@@ -27,7 +27,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..obs.context import TRACEPARENT_LEN, TraceContext, parse_traceparent
-from .tensor_codec import KIND_WEIGHTS, MAX_FRAME_BYTES, decode, encode
+from .tensor_codec import (KIND_WEIGHTS, MAX_FRAME_BYTES, alloc_frame,
+                           decode, encode)
 
 LENGTH_BYTES = 8
 
@@ -50,26 +51,28 @@ def determine_master(port: int = 4000) -> str:
     return host + ":" + str(port)
 
 
-def recv_exact(sock: socket.socket, num_bytes: int) -> bytearray:
+def recv_exact(sock: socket.socket, num_bytes: int) -> memoryview:
     """Read exactly ``num_bytes`` via ``recv_into`` a single preallocated
-    buffer — one allocation per message, no chunk-list join.
+    buffer — one allocation per message, no chunk-list join, and no
+    ``bytearray`` zero-fill of bytes the loop below is about to
+    overwrite anyway (:func:`~.tensor_codec.alloc_frame`).
 
     Raises :class:`ConnectionError` when the peer closes mid-read: a
     half-closed socket returns ``b""`` from ``recv``, and fixed-length
     protocol reads (1-byte acks, 32-byte update ids, frame bodies) must
-    never misread that as payload. All fixed-length reads in the
+    never misread that as payload — which is also what upholds the
+    uninitialized-buffer contract: the buffer is returned only once
+    every byte has been received. All fixed-length reads in the
     parameter plane route through here."""
-    buf = bytearray(num_bytes)
-    if num_bytes:
-        with memoryview(buf) as view:
-            got = 0
-            while got < num_bytes:
-                n = sock.recv_into(view[got:])
-                if n == 0:
-                    raise ConnectionError(
-                        "socket closed while reading frame")
-                got += n
-    return buf
+    view = alloc_frame(num_bytes)
+    got = 0
+    while got < num_bytes:
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError(
+                "socket closed while reading frame")
+        got += n
+    return view
 
 
 # back-compat alias (the historical chunk-list reader's name)
@@ -99,8 +102,8 @@ def send(sock: socket.socket, arrays: Sequence[np.ndarray], kind: int = KIND_WEI
 def send_payload(sock: socket.socket, payload) -> None:
     """Send one ALREADY-ENCODED ETPU payload as a length-prefixed frame
     (the cached-snapshot fast path: zero encode work, one or two
-    ``sendall`` syscalls). ``payload`` may be ``bytes`` or the
-    ``bytearray`` the zero-copy encoder returns."""
+    ``sendall`` syscalls). ``payload`` may be ``bytes`` or the writable
+    ``memoryview`` the zero-copy encoder returns."""
     if _use_native(sock):
         from . import native
 
@@ -113,8 +116,8 @@ def send_payload(sock: socket.socket, payload) -> None:
 def receive_frame(sock: socket.socket, copy: bool = True):
     """Receive one length-prefixed ETPU frame; returns ``(arrays, kind)``.
 
-    The frame body lands in ONE preallocated ``bytearray`` via
-    ``recv_into`` (no chunk-list accumulation). ``copy=False`` decodes
+    The frame body lands in ONE preallocated buffer via
+    ``recv_into`` (no chunk-list accumulation, no zero-fill). ``copy=False`` decodes
     zero-copy views of that buffer — the arrays alias the receive buffer
     and keep it alive; treat them as frozen snapshots.
 
@@ -148,4 +151,4 @@ def receive_traceparent(sock: socket.socket) -> Optional[TraceContext]:
     already consumed); None for a malformed traceparent — the fixed
     length keeps the stream in sync either way."""
     raw = _receive_all(sock, TRACEPARENT_LEN)
-    return parse_traceparent(raw.decode("ascii", "replace"))
+    return parse_traceparent(bytes(raw).decode("ascii", "replace"))

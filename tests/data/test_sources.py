@@ -74,6 +74,82 @@ def test_parquet_source_reads_and_row_groups(tmp_path):
         ParquetSource(path, "nope")
 
 
+def test_route_read_empty_range_on_zero_row_group_part(tmp_path):
+    """ADVICE r5 regression: an empty-range ``_read`` on a Parquet part
+    with ZERO row groups (Spark writes such files for empty partitions;
+    ``_bounds == [0]``) must return an explicitly shaped empty array —
+    the old branch fetched chunk 0, which would ``read_row_group(0)``
+    on a file that has none. ``ColumnSource.read`` short-circuits
+    ``hi <= lo`` today, so the landmine only fires for direct ``_read``
+    callers — exercise that path explicitly."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "empty.parquet")
+    schema = pa.schema([("features", pa.list_(pa.float64())),
+                        ("label", pa.int64())])
+    # ParquetWriter closed without a write: a file with ZERO row groups
+    # (pq.write_table of an empty table still emits one empty group)
+    pq.ParquetWriter(path, schema).close()
+    src = ParquetSource(path, "label")
+    assert src.num_rows() == 0
+    assert len(src._bounds) == 1          # zero row groups: bounds [0]
+    out = src._read(0, 0)                 # the direct-caller landmine
+    assert out.shape == (0,)
+    assert out.dtype == src.dtype
+    # the routed public path agrees
+    assert src.read(0, 0).shape == (0,)
+
+
+def test_parquet_ragged_shape_probe_is_thread_safe(tmp_path):
+    """ADVICE r5 regression: the lazy ragged-width probe in
+    ``ParquetSource.shape`` runs under the source lock (double-
+    checked), so concurrent first-``shape`` threads resolve dtype and
+    row shape atomically — one probe decode total, identical answers
+    everywhere, and no interleaved half-assigned state."""
+    import threading
+
+    pa = pytest.importorskip("pyarrow")  # noqa: F841
+    rng = np.random.default_rng(3)
+    x = rng.random((192, 7))
+    path = _write_ragged_parquet(tmp_path, x)
+    src = ParquetSource(path, "features")
+    assert src._row_shape is None, "ragged width must resolve lazily"
+    shapes, dtypes = [], []
+    barrier = threading.Barrier(8)
+
+    def probe():
+        barrier.wait()                    # maximal first-access overlap
+        shapes.append(src.shape)
+        dtypes.append(src.dtype)
+
+    threads = [threading.Thread(target=probe) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert set(shapes) == {(192, 7)}
+    assert len(set(dtypes)) == 1
+    # the probe decoded row group 0 exactly once: the second thread
+    # found the resolution complete under the lock, not a torn probe
+    assert src.chunks_decoded == 1
+
+
+def _write_ragged_parquet(tmp_path, x):
+    """A LIST-typed (not FixedSizeList) column: the schema does not
+    carry the row width, forcing the lazy decode probe."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "ragged.parquet")
+    table = pa.table({
+        "features": pa.array([list(row) for row in x],
+                             type=pa.list_(pa.float64())),
+    })
+    pq.write_table(table, path, row_group_size=64)
+    return path
+
+
 def test_sources_pickle_by_path(tmp_path):
     import pickle
 

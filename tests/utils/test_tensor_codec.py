@@ -115,12 +115,13 @@ def test_zero_d_and_empty_arrays_both_copy_modes():
 
 def test_encode_is_single_allocation_bytes_like():
     """The encoder writes header + tensor bytes into ONE preallocated
-    buffer and returns it (bytes-like for sendall/HTTP bodies without a
-    further copy)."""
+    buffer and returns it — a WRITABLE bytes-like buffer (sendall/HTTP
+    bodies take it without a further copy; view-mode decode hands out
+    writable arrays over it)."""
     arrays = [np.random.rand(64).astype(np.float32),
               np.arange(5, dtype=np.int32)]
     payload = tensor_codec.encode_tensors(arrays)
-    assert isinstance(payload, bytearray)
+    assert isinstance(payload, memoryview) and not payload.readonly
     # byte-identical to the naive per-array serialization
     import struct
 
@@ -133,3 +134,26 @@ def test_encode_is_single_allocation_bytes_like():
         parts.append(struct.pack("<%dQ" % a.ndim, *a.shape))
         parts.append(a.tobytes())
     assert bytes(payload) == b"".join(parts)
+
+
+def test_alloc_frame_contract_buffers_are_fully_written():
+    """The no-memset frame allocator: writable, byte-addressed, sized
+    exactly — and the encoder upholds the every-byte-written contract
+    (byte-identical frames across repeated encodes, no uninitialized
+    residue leaking through gaps)."""
+    buf = tensor_codec.alloc_frame(32)
+    assert isinstance(buf, memoryview)
+    assert not buf.readonly
+    assert len(buf) == 32 and buf.nbytes == 32
+    buf[:4] = b"abcd"                       # writable, sliceable
+    assert bytes(buf[:4]) == b"abcd"
+    assert len(tensor_codec.alloc_frame(0)) == 0
+
+    # an encode's output depends only on its inputs: every byte of the
+    # uninitialized buffer was written (0-d, empty, and multi-tensor
+    # frames cover the header/dims/body layout paths)
+    arrays = [np.arange(7, dtype=np.int64), np.array(1.5, np.float64),
+              np.zeros((2, 0), np.float32)]
+    a = bytes(tensor_codec.encode_tensors(arrays))
+    b = bytes(tensor_codec.encode_tensors(arrays))
+    assert a == b
