@@ -465,6 +465,8 @@ def main():
     backoff = 30.0
     mem = {}  # fresh captures, kept in-memory too (store may be read-only)
     no_tpu_probes = 0
+    down_reported = False   # tunnel-down is reported ONCE, not per pass
+    ever_up = False         # any probe succeeded this run
     while True:
         rows = {**_load_rows(ttl), **mem}
         if not _plan(rows):
@@ -483,6 +485,7 @@ def main():
                 break
         progressed = False
         if verdict == "ok":
+            ever_up = True
             # recompute the plan after every capture so dependent rows
             # (chunked/b32 config choices) unlock within the same pass
             while True:
@@ -502,7 +505,18 @@ def main():
             backoff = 30.0
             continue
         if verdict == "down":
-            print("# backend probe failed (tunnel down)", file=sys.stderr)
+            # probe once, report once: a wedged tunnel used to print
+            # this line on every backoff pass (six times per BENCH_r05
+            # run) and retrying a tunnel that was never up just idles
+            # out the window — one probe, one report, straight to the
+            # stale last-good fallback. A tunnel that WAS up this run
+            # keeps its retry window (it serves short healthy bursts).
+            if not down_reported:
+                print("# backend probe failed (tunnel down)",
+                      file=sys.stderr)
+                down_reported = True
+            if not ever_up:
+                break
         # back off whether the probe failed or a row did — a fast-failing
         # row must not hammer the flaky tunnel for the whole window
         if time.monotonic() + backoff >= deadline:

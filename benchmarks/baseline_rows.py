@@ -334,6 +334,399 @@ def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
                       "prefix registration (miss = cold registration)"}
 
 
+def _disagg_model(max_seq_len: int):
+    """The disagg row's tiny-but-real LM, shared by the parent and the
+    prefill child process (identical seed => identical weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+
+    c = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=max_seq_len,
+                          dtype=jnp.float32)
+    return init_params(c, jax.random.PRNGKey(0)), c
+
+
+def run_disagg_prefill_child(argv):
+    """``--disagg-prefill-child MAX_SEQ_LEN QUANT BLOCK_SIZE`` — host a
+    PrefillWorker in THIS process and serve dispatch over stdin/stdout
+    (one JSON job per line in; ``ready``/``shipped``/``failed`` events
+    out). The prefill tier living in its own process is the production
+    topology (and the measurement point: in-process threads share one
+    GIL and understate the architecture, the ps_rpc_bench lesson)."""
+    import json as _json
+    import threading
+
+    from elephas_tpu.disagg import PrefillWorker
+    from elephas_tpu.obs.context import parse_traceparent
+    from elephas_tpu.disagg.prefill import PrefillJob
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    max_seq_len, quant, block = (int(argv[0]), argv[1] == "1",
+                                 int(argv[2]))
+    params, c = _disagg_model(max_seq_len)
+    out_lock = threading.Lock()
+
+    def emit(ev):
+        with out_lock:
+            print(_json.dumps(ev), flush=True)
+
+    worker = PrefillWorker(DecodeEngine(params, c, max_slots=1),
+                           quant=quant, block_size=block,
+                           name="prefill-child").start()
+    orig_ship = worker.shipper.ship
+
+    def ship(addr, meta, arrays, quant=True, ctx=None):
+        n = orig_ship(addr, meta, arrays, quant=quant, ctx=ctx)
+        emit({"ev": "shipped", "rid": meta["rid"], "bytes": n,
+              "codec": "q8" if quant else "fp"})
+        return n
+
+    worker.shipper.ship = ship
+    emit({"ev": "ready"})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = _json.loads(line)
+        except ValueError:
+            continue               # a torn line must not kill the tier
+        job = PrefillJob(
+            req["rid"], req["prompt"], req["max_new_tokens"],
+            temperature=req.get("temperature"),
+            top_k=req.get("top_k"), top_p=req.get("top_p"),
+            deadline=req.get("deadline"),
+            target=tuple(req["target"]),
+            ctx=parse_traceparent(req.get("traceparent")),
+            on_failed=lambda j, w, e: emit(
+                {"ev": "failed", "rid": j.rid, "error": e}))
+        worker.submit(job)
+    worker.stop()
+
+
+class _ChildPrefillProxy:
+    """Parent-side handle on a prefill-worker child process, quacking
+    like a PrefillWorker as far as DisaggEngine's dispatch needs
+    (submit / backlog / alive / name / stats)."""
+
+    def __init__(self, max_seq_len, quant, block_size):
+        import json as _json
+        import subprocess
+        import threading
+        from collections import deque
+
+        self.name = "prefill-child"
+        self.quant = quant
+        self.wait_window: deque = deque()
+        self.bytes = {"fp": 0, "q8": 0}
+        self._json = _json
+        self._lock = threading.Lock()
+        self._outstanding = {}
+        self._proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--disagg-prefill-child", str(max_seq_len),
+             "1" if quant else "0", str(block_size)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            bufsize=1)
+        # block until the child compiled its imports and is serving
+        line = self._proc.stdout.readline()
+        if _json.loads(line).get("ev") != "ready":
+            raise RuntimeError("prefill child failed to start")
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        for line in self._proc.stdout:
+            try:
+                ev = self._json.loads(line)
+            except ValueError:
+                continue
+            rid = ev.get("rid")
+            with self._lock:
+                job = self._outstanding.pop(rid, None)
+            if ev.get("ev") == "shipped":
+                with self._lock:
+                    self.bytes[ev["codec"]] += int(ev["bytes"])
+            elif ev.get("ev") == "failed" and job is not None:
+                if job.on_failed is not None:
+                    job.on_failed(job, self.name, ev.get("error", "?"))
+
+    @property
+    def alive(self):
+        return self._proc.poll() is None
+
+    def submit(self, job):
+        if not self.alive:
+            raise RuntimeError("prefill child is dead")
+        ctx = job.ctx
+        line = self._json.dumps({
+            "rid": job.rid, "prompt": job.prompt,
+            "max_new_tokens": job.max_new_tokens,
+            "temperature": job.temperature, "top_k": job.top_k,
+            "top_p": job.top_p, "deadline": job.deadline,
+            "target": list(job.target),
+            "traceparent": (None if ctx is None
+                            else ctx.to_traceparent())}) + "\n"
+        with self._lock:
+            # the write happens UNDER the lock: submit is reachable
+            # from the dispatcher AND from the reader thread's failure
+            # callback, and interleaved text-mode writes would corrupt
+            # the child's line protocol
+            self._outstanding[job.rid] = job
+            self._proc.stdin.write(line)
+            self._proc.stdin.flush()
+
+    def backlog(self):
+        with self._lock:
+            return len(self._outstanding)
+
+    def stats(self):
+        return {"name": self.name, "alive": self.alive,
+                "backlog": self.backlog()}
+
+    def stop(self):
+        try:
+            self._proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — wedged child
+            self._proc.kill()
+
+
+def measure_disagg(smoke=False):
+    """Disaggregated prefill/decode row: under a prefill burst, what
+    happens to the DECODE-stage queue-wait tail and combined
+    throughput, colocated vs disaggregated at equal total resources —
+    plus the Q8-vs-fp32 KV wire-bytes ratio. CPU-measurable (the whole
+    topology is in-process servers + loopback sockets), so the disagg
+    perf story stays falsifiable while the chip tunnel is down.
+
+    Topologies (2 workers and the same total decode-slot KV memory
+    each way — the burst is sized so prefill is roughly HALF of each
+    colocated worker's compute, the regime the 1-prefill + 1-decode
+    split is built for; a decode-dominated mix wants more decode
+    workers per prefill worker, which is exactly the independent
+    scaling knob this architecture adds):
+
+    - **colocated**: 2 engines behind ServingServer-shaped driver
+      loops, round-robin submits — every engine runs prefill AND
+      decode on one loop, so a burst of long prompts head-of-line
+      blocks the steady short requests behind their prefills.
+    - **disagg**: 1 ``PrefillWorker`` + 1 ``DisaggEngine`` decode
+      worker — the burst's prefills run on the prefill tier (real KV
+      frames over a loopback socket) while the decode engine's
+      admissions just install shipped KV.
+
+    Workload: ``n_burst`` long prompts submitted at t=0, then
+    ``n_steady`` short latency-bound requests. The headline compares
+    the steady requests' decode-stage queue wait (flight-recorder
+    ``admitted.queue_wait_s`` on the engines that DECODE them) and the
+    combined tokens/s of everything."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs import percentile
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    # slots cover the whole in-flight set (equal total slot rows both
+    # ways): queue wait then measures ADMISSION blocking — prefill
+    # head-of-line on the colocated engines, KV-install wait on the
+    # decode workers — not slot scarcity, which would hit both
+    # topologies alike and dilute the signal this row exists to isolate
+    n_steady, n_burst = (6, 4) if smoke else (10, 14)
+    slots_co = -(-(n_steady + n_burst) // 2)   # per colocated engine
+    slots_dg = n_steady + n_burst              # the one decode worker
+    steady_len, steady_new = 8, (16 if smoke else 32)
+    burst_len, burst_new = (96, 2) if smoke else (240, 4)
+    params, c = _disagg_model(burst_len + 32)
+    rng = np.random.default_rng(0)
+    steady = [[int(t) for t in rng.integers(0, 300, steady_len)]
+              for _ in range(n_steady)]
+    burst = [[int(t) for t in rng.integers(0, 300, burst_len)]
+             for _ in range(n_burst)]
+    total_tokens = n_steady * steady_new + n_burst * burst_new
+
+    import threading as _threading
+
+    class _Driver:
+        """One worker's engine loop, the ServingServer shape without
+        the HTTP layer (handler-thread wake churn on a 2-core box
+        otherwise dominates what this row is trying to measure): a
+        single thread steps the engine and harvests results; submits
+        come from the workload threads under the same lock."""
+
+        def __init__(self, engine):
+            self.engine = engine
+            self.lock = _threading.Lock()
+            self.results = {}
+            self._tracked = set()
+            self._stop = False
+            self._thread = _threading.Thread(target=self._loop,
+                                             daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            while not self._stop:
+                with self.lock:
+                    if self.engine.pending:
+                        self.engine.step()
+                    for rid in list(self._tracked):
+                        info = self.engine.result_info(rid)
+                        if info is not None:
+                            self.results[rid] = info
+                            self._tracked.discard(rid)
+                    idle = not self.engine.pending
+                time.sleep(0.002 if idle else 0)
+
+        def submit(self, prompt, max_new):
+            with self.lock:
+                rid = self.engine.submit(prompt, max_new, admit=False)
+                self._tracked.add(rid)
+            return rid
+
+        def wait(self, rids, timeout=300.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self.lock:
+                    if all(r in self.results for r in rids):
+                        return
+                time.sleep(0.002)
+            raise RuntimeError("requests never finished")
+
+        def stop(self):
+            self._stop = True
+            self._thread.join(timeout=10)
+
+    rounds = 1 if smoke else 8
+
+    def _run(drivers, decode_recorders):
+        """Warmup compiles, then ``rounds`` timed burst-then-steady
+        passes; returns (median elapsed_s, pooled steady queue-wait
+        samples) — the median is the ps_rpc_bench convention (single
+        passes on a shared box carry scheduler noise), applied
+        symmetrically to both topologies; the latency samples pool
+        across every pass."""
+        # warmup: every engine sees both prompt lengths (prefill
+        # compiles) and steps (decode compiles) before the clock
+        warm = []
+        for i, d in enumerate(drivers * 2):
+            warm.append((d, d.submit(steady[i % n_steady], steady_new)))
+            warm.append((d, d.submit(burst[i % n_burst], burst_new)))
+        for d, rid in warm:
+            d.wait([rid])
+        elapsed_rounds, waits = [], []
+        for _ in range(rounds):
+            marks = [len(r.recent(limit=256)) for r in decode_recorders]
+            start = time.perf_counter()
+            # the whole burst lands first (that is what makes it a
+            # burst: every long prompt is queued before the steady
+            # traffic), then the steady requests — submits are cheap
+            # (admit=False), so the burst is fully queued within a
+            # millisecond
+            rids = [(drivers[i % len(drivers)],
+                     drivers[i % len(drivers)].submit(p, burst_new))
+                    for i, p in enumerate(burst)]
+            rids += [(drivers[i % len(drivers)],
+                      drivers[i % len(drivers)].submit(p, steady_new))
+                     for i, p in enumerate(steady)]
+            for d in drivers:
+                d.wait([rid for dd, rid in rids if dd is d])
+            elapsed_rounds.append(time.perf_counter() - start)
+            for rec, mark in zip(decode_recorders, marks):
+                for t in rec.recent(limit=256)[mark:]:
+                    evs = t["events"]
+                    if (not evs
+                            or evs[0].get("prompt_tokens") != steady_len):
+                        continue       # only the steady (short) requests
+                    for e in evs:
+                        if (e["event"] == "admitted"
+                                and e.get("queue_wait_s") is not None):
+                            waits.append(e["queue_wait_s"])
+        return percentile(elapsed_rounds, 0.5), waits
+
+    # ---- colocated baseline: 2 engines, each prefill + decode
+    drivers = [_Driver(DecodeEngine(params, c, max_slots=slots_co))
+               for _ in range(2)]
+    try:
+        co_elapsed, co_waits = _run(
+            drivers, [d.engine.recorder for d in drivers])
+    finally:
+        for d in drivers:
+            d.stop()
+
+    # ---- disaggregated: 1 prefill worker (its OWN process — the
+    # production topology; an in-process worker thread shares the
+    # decode loop's GIL and understates the architecture, exactly the
+    # ps_rpc_bench in-process-shards lesson) + 1 decode worker, twice
+    # (fp then q8) for the wire-bytes A/B
+    def run_disagg(quant):
+        from elephas_tpu.disagg import DisaggEngine
+
+        worker = _ChildPrefillProxy(c.max_seq_len, quant, 16)
+        deng = DisaggEngine(
+            DecodeEngine(params, c, max_slots=slots_dg, tier="decode"),
+            [worker])
+        driver = _Driver(deng)
+        try:
+            elapsed, waits = _run([driver], [deng.decode.recorder])
+            nbytes = worker.bytes["q8" if quant else "fp"]
+            return elapsed, waits, nbytes
+        finally:
+            driver.stop()
+            deng.stop()
+            worker.stop()
+
+    # the topology A/B holds the wire codec CONSTANT (fp): on this
+    # deliberately tiny CPU model the frames are ~60 KB, so Q8's
+    # host-side quantize cost is not amortized by wire savings the way
+    # multi-MB real-model frames amortize it — the q8 run is reported
+    # alongside as the wire-bytes lever it is, not folded into the
+    # topology headline
+    dg_elapsed, dg_waits, fp_bytes = run_disagg(quant=False)
+    q8_elapsed, _, q8_bytes = run_disagg(quant=True)
+
+    co_p50, co_p99 = (percentile(co_waits, 0.5), percentile(co_waits, 0.99))
+    dg_p50, dg_p99 = (percentile(dg_waits, 0.5), percentile(dg_waits, 0.99))
+    co_tps = total_tokens / co_elapsed
+    dg_tps = total_tokens / dg_elapsed
+    # every run shipped identical prompt sets (plus identical warmups),
+    # so the byte counters divide into a clean codec ratio
+    return {"metric": "disagg_decode_queue_wait_p99_cut",
+            "value": round(co_p99 / max(dg_p99, 1e-9), 2),
+            "unit": "x (colocated p99 / disagg p99, steady requests "
+                    "under a prefill burst)",
+            "colocated_queue_wait_p50_s": round(co_p50, 6),
+            "colocated_queue_wait_p99_s": round(co_p99, 6),
+            "disagg_queue_wait_p50_s": round(dg_p50, 6),
+            "disagg_queue_wait_p99_s": round(dg_p99, 6),
+            "colocated_tokens_per_sec": round(co_tps, 1),
+            "disagg_tokens_per_sec": round(dg_tps, 1),
+            "tokens_per_sec_ratio": round(dg_tps / co_tps, 3),
+            "disagg_q8_tokens_per_sec": round(total_tokens / q8_elapsed,
+                                              1),
+            "kv_wire_bytes_fp": int(fp_bytes),
+            "kv_wire_bytes_q8": int(q8_bytes),
+            "q8_wire_ratio": round(q8_bytes / max(fp_bytes, 1), 3),
+            "steady_requests": n_steady, "burst_requests": n_burst,
+            "burst_prompt_tokens": burst_len,
+            "config": f"L2 d32 V300; {n_burst}x{burst_len}-tok burst + "
+                      f"{n_steady}x{steady_len}-tok steady; colocated = "
+                      f"2 engines x {slots_co} slots (prefill+decode "
+                      f"each); disagg = 1 prefill worker + 1 decode "
+                      f"worker x {slots_dg} slots, block 16; headline "
+                      "+ ratio at fp wire, q8 columns = the wire-bytes "
+                      "lever; in-process driver loops, loopback KV "
+                      "sockets"}
+
+
 #: candidate (block_q, block_k) pairs for the flash kernel sweep — all
 #: multiples of the MXU-friendly 128 lane tile
 _BLOCK_GRID = ((128, 128), (128, 256), (256, 256), (256, 512),
@@ -734,6 +1127,9 @@ def _emit(row):
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--disagg-prefill-child":
+        run_disagg_prefill_child(sys.argv[2:])
+        sys.exit(0)
     args = list(sys.argv[1:])
     smoke = "--smoke" in args
     args = [a for a in args if a != "--smoke"]
@@ -754,6 +1150,8 @@ if __name__ == "__main__":
         _emit(measure_engine())
     if which in ("fleet_router", "all"):
         _emit(measure_fleet_router(smoke=smoke))
+    if which in ("disagg", "all"):
+        _emit(measure_disagg(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

@@ -109,9 +109,13 @@ PAGES = [
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Serving fleet API", "elephas_tpu.fleet",
      ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool"]),
+    ("Disaggregated serving API", "elephas_tpu.disagg",
+     ["DisaggEngine", "DisaggPool", "PrefillWorker", "PrefillJob",
+      "KVReceiver", "KVShipper", "encode_kv_frame", "decode_kv_frame"]),
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
-     ["init_paged_pool", "decode_step_paged", "install_row_paged"]),
+     ["init_paged_pool", "decode_step_paged", "install_row_paged",
+      "export_kv_blocks", "import_kv_blocks"]),
     ("SSMModel", "elephas_tpu.models.ssm_model", ["SSMModel"]),
     ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",
      ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
@@ -205,6 +209,7 @@ def main(out_dir: str = None):
               "  - Serving guide: serving-guide.md",
               "  - Serving operations: serving-operations.md",
               "  - Serving fleet: serving-fleet.md",
+              "  - Disaggregated serving: disaggregated-serving.md",
               "  - Fault tolerance: fault-tolerance.md",
               "  - Observability: observability.md",
               "  - Distributed tracing: tracing.md"]

@@ -22,6 +22,7 @@ if (_os.environ.get("JAX_COORDINATOR_ADDRESS")
 
 from . import models, obs, utils
 from .data import Dataset
+from .disagg import DisaggEngine, DisaggPool, PrefillWorker
 from .fleet import FleetRouter, ReplicaPool
 from .serving import TextGenerator
 from .serving_engine import (DeadlineExceededError, DecodeEngine,

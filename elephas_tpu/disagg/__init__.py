@@ -1,0 +1,29 @@
+"""Disaggregated prefill/decode serving.
+
+Prefill is compute-bound and bursty; decode is latency-bound and
+steady. This package splits them into independent tiers — prefill
+workers compute a prompt's KV state and SHIP it (paged KV blocks,
+optionally Q8 on the wire) to a decode worker over the zero-copy
+codec/socket path, where it installs into a slot between decode steps.
+Decode-tier queue wait is then free of prefill head-of-line blocking,
+and the two tiers scale independently — the defining architecture of
+high-QPS LLM serving.
+
+- :mod:`.wire` — the KV frame format + socket shipper/receiver
+- :mod:`.prefill` — prefill workers (the compute tier)
+- :mod:`.engine` — :class:`DisaggEngine`, the decode-worker engine a
+  :class:`~elephas_tpu.serving_http.ServingServer` fronts
+- :mod:`.pool` — :class:`DisaggPool`, the in-process two-tier topology
+  a :class:`~elephas_tpu.fleet.FleetRouter` can front
+
+``docs/sources/disaggregated-serving.md`` has the topology, wire
+format, Q8 trade-offs, and the ops runbook.
+"""
+from .engine import DisaggEngine
+from .pool import DisaggPool
+from .prefill import PrefillJob, PrefillWorker
+from .wire import KVReceiver, KVShipper, decode_kv_frame, encode_kv_frame
+
+__all__ = ["DisaggEngine", "DisaggPool", "PrefillJob", "PrefillWorker",
+           "KVReceiver", "KVShipper", "decode_kv_frame",
+           "encode_kv_frame"]

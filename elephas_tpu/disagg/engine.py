@@ -1,0 +1,581 @@
+"""DisaggEngine: the decode-worker front of disaggregated serving.
+
+Implements the engine API a
+:class:`~elephas_tpu.serving_http.ServingServer` drives (``submit`` /
+``step`` / ``pending`` / ``result_info`` / ``cancel`` / ``stats`` /
+flight-recorder traces), but splits the request lifecycle across two
+tiers:
+
+1. ``submit`` hands the prompt to the least-backlogged live
+   :class:`~.prefill.PrefillWorker` (prefill is compute-bound and
+   bursty — it runs OFF the decode engine's loop).
+2. The worker prefills, packs paged KV blocks, and ships them to this
+   engine's :class:`~.wire.KVReceiver` (Q8 on the wire by default).
+3. ``step`` — called by the server's engine loop, the single driver of
+   the device program — first INSTALLS every received frame into the
+   decode engine between decode steps
+   (:meth:`~elephas_tpu.serving_engine.DecodeEngine.submit_prefilled`:
+   the atomic slot install), then steps the decode batch.
+
+The decode engine never runs a prefill, so its queue-wait series
+(``serving_queue_wait_seconds{tier="decode"}``) is pure decode-stage
+backlog — the p99 the colocated engine's prefill head-of-line blocking
+inflates. Retry policy: a prefill job that fails (killed worker,
+severed transfer, injected fault) re-dispatches to a sibling worker;
+with no live worker it parks and retries as workers return. A replayed
+frame (ack lost mid-kill) deduplicates by request id. One trace id
+spans the whole path: the context captured at submit rides the job, the
+wire's traceparent frame, and the decode engine's own recorder.
+"""
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.context import current_context
+from ..obs.events import FlightRecorder
+from ..obs.events import emit as emit_event
+from ..serving_engine import QueueFullError, validate_sampling_overrides
+from .prefill import PrefillJob, PrefillWorker
+from .wire import KVReceiver
+
+__all__ = ["DisaggEngine"]
+
+
+class DisaggEngine:
+    """Decode worker + prefill-tier dispatcher behind one engine API.
+
+    :param decode_engine: a non-speculative
+        :class:`~elephas_tpu.serving_engine.DecodeEngine` (construct it
+        with ``tier="decode"`` so its queue-wait series lands on the
+        decode-tier label); paged or contiguous both work.
+    :param prefill_workers: the prefill tier — shared freely between
+        several DisaggEngines (that is the independent-scaling point).
+    :param max_queue: bound on requests in the PREFILL stage (queued at
+        workers, parked, or in transfer); breaching it sheds with
+        :class:`~elephas_tpu.serving_engine.QueueFullError` (HTTP 429).
+        The decode engine's own admission bounds still apply beneath.
+    :param host, port: bind address for this engine's KV receiver.
+    """
+
+    def __init__(self, decode_engine, prefill_workers:
+                 Sequence[PrefillWorker],
+                 max_queue: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 clock=time.monotonic):
+        if getattr(decode_engine, "draft_config", None) is not None:
+            raise ValueError("disaggregated serving does not compose "
+                             "with speculative decoding")
+        if not prefill_workers:
+            raise ValueError("need at least one prefill worker")
+        self.decode = decode_engine
+        self.workers = list(prefill_workers)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._clock = clock
+        self.registry = reg = decode_engine.registry
+        self.recorder = FlightRecorder()
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        # rid -> {"state": queued|imported|decoding|done, "job",
+        #         "drid", "deadline", "retries"}
+        self._stage: Dict[int, Dict] = {}
+        self._rid_of_drid: Dict[int, int] = {}
+        # rid -> decode rid kept for trace merging AFTER the result is
+        # fetched (the live _stage entry pops then); bounded like the
+        # recorder ring it serves
+        self._trace_drid: "OrderedDict[int, int]" = OrderedDict()
+        self._imports: deque = deque()   # (meta, arrays, nbytes)
+        self._parked: deque = deque()    # jobs with no live worker
+        self._results: Dict[int, Dict] = {}   # disagg-terminal outcomes
+        self._m_requests = reg.counter(
+            "disagg_requests_total",
+            "requests accepted by the disaggregated front end").labels()
+        self._m_retries = reg.counter(
+            "disagg_prefill_retries_total",
+            "prefill jobs re-dispatched after a worker failure").labels()
+        self._m_frames = reg.counter(
+            "disagg_kv_frames_total",
+            "KV frames received and installed, by codec",
+            labels=("codec",))
+        self._m_kv_bytes = reg.counter(
+            "disagg_kv_bytes_total",
+            "KV payload bytes received, by codec", labels=("codec",))
+        import weakref
+
+        ref = weakref.ref(self)
+        reg.gauge("disagg_prefill_stage_depth",
+                  "requests in the prefill stage (queued at workers, "
+                  "parked, or in transfer)").set_function(
+            lambda: float(e._prefill_stage_depth())
+            if (e := ref()) is not None else 0.0)
+        self.receiver = KVReceiver(self._on_frame, host=host,
+                                   port=int(port)).start()
+
+    # ----------------------------------------------------------- lifecycle
+    def stop(self):
+        """Close the KV receiver. The prefill workers are a shared tier
+        owned by whoever built them (:class:`~.pool.DisaggPool`)."""
+        self.receiver.stop()
+
+    # -------------------------------------------------------------- submit
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               admit: bool = True,
+               deadline_ms: Optional[float] = None) -> int:
+        """Queue a request; the prefill tier computes its KV state and
+        this engine decodes it. Same argument semantics as
+        :meth:`~elephas_tpu.serving_engine.DecodeEngine.submit`
+        (``admit`` is accepted for interface parity; admission is
+        always deferred to the engine loop here — prefill runs
+        off-thread regardless)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # fail fast with the decode engine's own validation messages:
+        # an inadmissible request must 400 at submit, not die on a
+        # worker thread after shipping
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # the decode engine's own permanently-inadmissible rules, run
+        # HERE so they 400 at submit — failing them at KV-install time
+        # would raise inside the server's engine loop and read as
+        # engine death (500s for everyone) instead of one bad request
+        self.decode.check_admissible(int(prompt.size),
+                                     int(max_new_tokens))
+        validate_sampling_overrides(temperature, top_k, top_p)
+        if deadline_ms is not None and not deadline_ms > 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        with self._lock:
+            if (self.max_queue is not None
+                    and self._prefill_depth_locked() >= self.max_queue):
+                emit_event("serving.shed", reason="disagg_max_queue",
+                           queue_depth=self._prefill_depth_locked())
+                raise QueueFullError(
+                    f"prefill stage full: {self._prefill_depth_locked()}"
+                    f" requests in flight (max_queue={self.max_queue})",
+                    self.decode.retry_after_ms())
+            rid = self._next_rid
+            self._next_rid += 1
+        ctx = current_context()
+        deadline = (None if deadline_ms is None
+                    else self._clock() + float(deadline_ms) / 1000.0)
+        self.recorder.start(
+            rid, trace_id=None if ctx is None else ctx.trace_id,
+            prompt_tokens=int(prompt.size),
+            max_new_tokens=int(max_new_tokens))
+        job = PrefillJob(rid, prompt, max_new_tokens,
+                         temperature=temperature, top_k=top_k,
+                         top_p=top_p, deadline=deadline,
+                         target=self.receiver.addr, ctx=ctx,
+                         on_failed=self._job_failed, clock=self._clock)
+        with self._lock:
+            self._stage[rid] = {"state": "queued", "job": job,
+                                "drid": None, "deadline": deadline,
+                                "retries": 0}
+        self._m_requests.inc()
+        self._dispatch(job)
+        return rid
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, job: PrefillJob) -> None:
+        """Least-backlogged live worker, or park until one returns."""
+        candidates = sorted((w for w in self.workers if w.alive),
+                            key=lambda w: w.backlog())
+        for worker in candidates:
+            try:
+                worker.submit(job)
+            except RuntimeError:
+                continue          # died between the check and the submit
+            self.recorder.record(job.rid, "prefill_dispatched",
+                                 worker=worker.name,
+                                 attempt=job.attempts + 1)
+            job.attempts += 1
+            return
+        with self._lock:
+            self._parked.append(job)
+        self.recorder.record(job.rid, "prefill_parked",
+                             reason="no live prefill workers")
+
+    #: retry budget per request: a job failing this many times is
+    #: systemically broken (every worker rejects it, or the receiver is
+    #: unreachable) — it terminates with an ``expired`` outcome instead
+    #: of recomputing the same prefill in a hot loop forever
+    MAX_PREFILL_RETRIES = 8
+
+    def _job_failed(self, job: PrefillJob, worker: str, error: str):
+        """A worker failed a job (its own thread calls this): re-queue
+        on a sibling — the client request is retried, never failed —
+        up to :data:`MAX_PREFILL_RETRIES`, past which it terminates
+        (an unbounded deterministic failure must not spin a core)."""
+        with self._lock:
+            st = self._stage.get(job.rid)
+            if st is None or st["state"] != "queued":
+                return            # cancelled, or a duplicate completion
+            st["retries"] += 1
+            exhausted = st["retries"] >= self.MAX_PREFILL_RETRIES
+            if exhausted:
+                st["state"] = "done"
+                self._results[job.rid] = {"tokens": [], "timeout": True,
+                                          "expired": True,
+                                          "error": error}
+        self._m_retries.inc()
+        emit_event("disagg.prefill_retried", rid=job.rid, worker=worker,
+                   error=error, exhausted=exhausted)
+        self.recorder.record(job.rid, "prefill_retry", worker=worker,
+                             error=error)
+        if exhausted:
+            self.recorder.record(job.rid, "expired",
+                                 stage="prefill_retries_exhausted",
+                                 error=error)
+            return
+        self._dispatch(job)
+
+    # ------------------------------------------------------------ receiver
+    def _on_frame(self, meta: Dict, arrays: List[np.ndarray],
+                  nbytes: int) -> None:
+        """KV frame delivery (receiver connection thread): enqueue for
+        installation by the next ``step``. Duplicates (a replayed frame
+        after a lost ack) and frames for cancelled rids drop here."""
+        rid = int(meta.get("rid", -1))
+        with self._lock:
+            st = self._stage.get(rid)
+            if st is None or st["state"] != "queued":
+                return
+        # reassemble the row HERE, on the receiver thread: the engine
+        # loop then pays only the device install, not the host-side
+        # block unpacking (which would serialize with decode steps)
+        from ..models.paged_decode import import_kv_blocks
+
+        row = import_kv_blocks(arrays, int(meta["prompt_tokens"]),
+                               self.decode.max_len)
+        with self._lock:
+            st = self._stage.get(rid)
+            if st is None or st["state"] != "queued":
+                return
+            st["state"] = "imported"
+            self._imports.append((meta, row, int(nbytes)))
+        self.recorder.record(
+            rid, "kv_transfer", bytes=int(nbytes),
+            worker=meta.get("worker"),
+            prefill_s=meta.get("prefill_s"),
+            prefill_queue_wait_s=meta.get("queue_wait_s"))
+
+    # ---------------------------------------------------------------- step
+    @property
+    def pending(self) -> int:
+        """Work the engine loop can advance by calling :meth:`step`:
+        frames awaiting install, parked jobs awaiting a live worker
+        (counted only while one IS alive — with the whole tier down a
+        parked job cannot progress, and counting it would busy-spin the
+        engine loop at 100% doing nothing), prefill-stage requests
+        whose deadline needs enforcing, and the decode engine's own
+        pending count. Requests merely WAITING on a prefill worker do
+        not count — the loop idles (5 ms cadence) instead of spinning
+        while the network does its thing."""
+        any_alive = any(w.alive for w in self.workers)
+        with self._lock:
+            n = len(self._imports)
+            if any_alive:
+                n += len(self._parked)
+            now = self._clock()
+            n += sum(1 for st in self._stage.values()
+                     if st["state"] == "queued"
+                     and st["deadline"] is not None
+                     and now >= st["deadline"])
+        return n + self.decode.pending
+
+    def step(self) -> Dict[int, List[int]]:
+        """Install received KV frames into the decode engine (between
+        decode steps — the atomic point), retry parked jobs, enforce
+        prefill-stage deadlines, then advance the decode batch. Returns
+        ``{rid: [tokens]}`` keyed by THIS engine's request ids."""
+        self._sweep_deadlines()
+        self._retry_parked()
+        self._install_imports()
+        emitted = self.decode.step() if self.decode.pending else {}
+        if not emitted:
+            return {}
+        with self._lock:
+            return {self._rid_of_drid.get(drid, drid): toks
+                    for drid, toks in emitted.items()}
+
+    def _sweep_deadlines(self):
+        """Expire prefill-stage requests whose deadline passed before
+        their KV ever arrived — the disagg mirror of the decode
+        engine's shed-while-queued (HTTP 504)."""
+        now = self._clock()
+        expired: List[int] = []
+        with self._lock:
+            for rid, st in self._stage.items():
+                if (st["state"] == "queued"
+                        and st["deadline"] is not None
+                        and now >= st["deadline"]):
+                    st["state"] = "done"
+                    if st["job"] is not None:
+                        # a worker still holding this job skips it
+                        st["job"].abandoned = True
+                    self._results[rid] = {"tokens": [], "timeout": True,
+                                          "expired": True}
+                    expired.append(rid)
+            for rid in expired:
+                self._drop_parked_locked(rid)
+        for rid in expired:
+            self.recorder.record(rid, "expired", stage="prefill")
+
+    def _drop_parked_locked(self, rid: int) -> None:
+        self._parked = deque(j for j in self._parked if j.rid != rid)
+
+    def _retry_parked(self):
+        with self._lock:
+            jobs = list(self._parked)
+            self._parked.clear()
+        for job in jobs:
+            self._dispatch(job)   # re-parks itself if still no worker
+
+    def _install_imports(self):
+        with self._lock:
+            batch = list(self._imports)
+            self._imports.clear()
+        for i, (meta, arrays, nbytes) in enumerate(batch):
+            rid = int(meta["rid"])
+            with self._lock:
+                st = self._stage.get(rid)
+                if st is None or st["state"] != "imported":
+                    continue      # cancelled while in the import queue
+                job = st["job"]
+            deadline = meta.get("deadline")
+            remaining_ms = None
+            if deadline is not None:
+                remaining_ms = (float(deadline) - self._clock()) * 1000.0
+                if remaining_ms <= 0:
+                    with self._lock:
+                        st["state"] = "done"
+                        self._results[rid] = {"tokens": [],
+                                              "timeout": True,
+                                              "expired": True}
+                    self.recorder.record(rid, "expired",
+                                         stage="kv_import")
+                    continue
+            # capacity pre-check WITHOUT the engine's shed bookkeeping:
+            # an internal install retry runs every step, and letting it
+            # hit the submit bound would inc the shed counter and emit
+            # a serving.shed event PER ATTEMPT — flooding the overload
+            # signal this metric exists to diagnose. The QueueFullError
+            # handler below stays as the backstop for bounds the peek
+            # cannot see (injected sheds).
+            if self.decode.would_shed(len(meta["prompt"])):
+                with self._lock:
+                    self._imports.extendleft(reversed(batch[i:]))
+                break
+            codec = str(meta.get("codec", "fp"))
+            from ..obs.context import use_context
+
+            try:
+                with use_context(None if job is None else job.ctx):
+                    drid = self.decode.submit_prefilled(
+                        meta["prompt"], int(meta["max_new_tokens"]),
+                        arrays, int(meta["first_token"]),
+                        temperature=meta.get("temperature"),
+                        top_k=meta.get("top_k"), top_p=meta.get("top_p"),
+                        admit=False, deadline_ms=remaining_ms)
+            except QueueFullError:
+                # the decode engine's own admission bound (or an
+                # injected serving.submit shed): TRANSIENT — put this
+                # frame AND the rest of the drained batch back (in
+                # order) and retry after the next step shrinks the
+                # backlog; raising here would kill the engine loop
+                with self._lock:
+                    self._imports.extendleft(reversed(batch[i:]))
+                break
+            except Exception as exc:  # noqa: BLE001 — an inadmissible
+                # request that slipped past submit-time validation is
+                # ONE bad request, never whole-server death: terminate
+                # it with the error attached
+                with self._lock:
+                    st2 = self._stage.get(rid)
+                    if st2 is not None:
+                        st2["state"] = "done"
+                        self._results[rid] = {
+                            "tokens": [], "timeout": True,
+                            "expired": True,
+                            "error": f"{type(exc).__name__}: {exc}"}
+                self.recorder.record(rid, "expired",
+                                     stage="kv_install_rejected",
+                                     error=str(exc))
+                continue
+            self._m_frames.labels(codec=codec).inc()
+            self._m_kv_bytes.labels(codec=codec).inc(nbytes)
+            with self._lock:
+                if self._stage.get(rid) is not st:
+                    # cancelled between the check above and the decode
+                    # submit: don't decode for nobody
+                    self.decode.cancel(drid)
+                    continue
+                st["state"] = "decoding"
+                st["drid"] = drid
+                st["job"] = None          # the KV blocks can free now
+                self._rid_of_drid[drid] = rid
+                self._trace_drid[rid] = drid
+                while len(self._trace_drid) > self.recorder.max_requests:
+                    self._trace_drid.popitem(last=False)
+            self.recorder.record(rid, "decode_submitted", decode_rid=drid)
+
+    def _prefill_depth_locked(self) -> int:
+        return sum(1 for st in self._stage.values()
+                   if st["state"] in ("queued", "imported"))
+
+    def _prefill_stage_depth(self) -> int:
+        with self._lock:
+            return self._prefill_depth_locked()
+
+    # -------------------------------------------------------------- results
+    def result_info(self, rid: int) -> Optional[Dict]:
+        with self._lock:
+            if rid in self._results:
+                self._stage.pop(rid, None)
+                return self._results.pop(rid)
+            st = self._stage.get(rid)
+            drid = None if st is None else st["drid"]
+        if drid is None:
+            return None           # unknown or still in the prefill stage
+        out = self.decode.result_info(drid)
+        if out is not None:
+            with self._lock:
+                self._stage.pop(rid, None)
+                self._rid_of_drid.pop(drid, None)
+        return out
+
+    def result(self, rid: int) -> Optional[List[int]]:
+        info = self.result_info(rid)
+        return None if info is None else info["tokens"]
+
+    def cancel(self, rid: int) -> bool:
+        with self._lock:
+            st = self._stage.get(rid)
+            if st is None:
+                return False
+            if st["state"] == "done":
+                # already terminal in the prefill stage (expired /
+                # retries exhausted): cancel of a finished request is
+                # False by the engine convention — and must NOT fall
+                # through to decode.cancel(drid=None). Drop the parked
+                # result so an expire-then-cancel client cannot leak
+                # an entry per request.
+                self._stage.pop(rid, None)
+                self._results.pop(rid, None)
+                return False
+            if st["state"] in ("queued", "imported"):
+                # the prefill may still complete on its worker; the
+                # late frame (or a replay) drops in _on_frame because
+                # the state is no longer "queued" — and the worker
+                # skips the job outright if it has not started yet
+                if st["job"] is not None:
+                    st["job"].abandoned = True
+                st["state"] = "done"
+                self._stage.pop(rid, None)
+                self._results.pop(rid, None)
+                self._drop_parked_locked(rid)
+                self._imports = deque(
+                    (m, a, b) for m, a, b in self._imports
+                    if int(m.get("rid", -1)) != rid)
+                self.recorder.record(rid, "cancelled", stage="prefill")
+                return True
+            drid = st["drid"]
+        cancelled = self.decode.cancel(drid)
+        if cancelled:
+            with self._lock:
+                self._stage.pop(rid, None)
+                self._rid_of_drid.pop(drid, None)
+        # cancel == False means the decode engine already FINISHED the
+        # request (its result is fetchable) — keep the mapping so the
+        # client's next poll still collects it, matching the engine's
+        # cancel-after-completion contract
+        return cancelled
+
+    # ---------------------------------------------------------------- misc
+    def register_prefix(self, tokens) -> None:
+        """Register a shared prompt prefix on EVERY prefill worker's
+        engine (prefill is where prefix reuse pays). Call before
+        traffic — registration does not synchronize with in-flight
+        prefills."""
+        for worker in self.workers:
+            worker.engine.register_prefix(tokens)
+
+    @property
+    def stats(self) -> Dict:
+        """The decode engine's stats (tier="decode" queue waits and all)
+        plus the prefill tier's: per-worker backlog/waits, parked and
+        in-transfer counts, retry totals, and KV wire accounting — the
+        whole disaggregated story on one ``/stats`` read."""
+        out = dict(self.decode.stats)
+        out["tier"] = "disagg"
+        with self._lock:
+            queued = self._prefill_depth_locked()
+            parked = len(self._parked)
+            imports = len(self._imports)
+        waits: List[float] = []
+        for w in self.workers:
+            sample = getattr(w, "wait_samples", None)
+            waits.extend(sample() if sample is not None
+                         else list(w.wait_window))
+        tier: Dict = {
+            "stage_depth": queued,
+            "parked": parked,
+            "imports_pending": imports,
+            "workers_alive": sum(1 for w in self.workers if w.alive),
+            "workers": [w.stats() for w in self.workers],
+            "prefill_retries": int(self._m_retries.value),
+        }
+        if waits:
+            from ..obs.metrics import percentile
+
+            tier["queue_wait_p50_s"] = round(percentile(waits, 0.5), 6)
+            tier["queue_wait_p99_s"] = round(percentile(waits, 0.99), 6)
+        out["prefill_tier"] = tier
+        out["kv_wire"] = {
+            "frames": {c: int(child.value) for c, child in
+                       self._frames_by_codec().items()},
+            "bytes": {c: int(child.value) for c, child in
+                      self._bytes_by_codec().items()},
+        }
+        return out
+
+    def _frames_by_codec(self):
+        return {labels[0]: child
+                for labels, child in self._m_frames.series().items()}
+
+    def _bytes_by_codec(self):
+        return {labels[0]: child
+                for labels, child in self._m_kv_bytes.series().items()}
+
+    # ---------------------------------------------------------- tracing
+    def request_trace(self, rid: int) -> Optional[Dict]:
+        """The request's merged timeline: this engine's events (queued /
+        dispatched / kv_transfer / decode_submitted) interleaved with
+        the decode engine's (admitted / kv_install / steps / terminal),
+        ordered by wall clock — the KV-transfer stage visible in ONE
+        flight-recorder read."""
+        own = self.recorder.trace(rid)
+        if own is None:
+            return None
+        with self._lock:
+            drid = self._trace_drid.get(rid)
+        if drid is not None:
+            dec = self.decode.request_trace(drid)
+            if dec is not None:
+                merged = own["events"] + [
+                    dict(e, decode_rid=drid) for e in dec["events"]]
+                merged.sort(key=lambda e: e.get("at", 0.0))
+                own["events"] = merged
+        return own
+
+    def recent_traces(self, limit: int = 32) -> List[Dict]:
+        out = []
+        for t in self.recorder.recent(limit):
+            merged = self.request_trace(t["id"])
+            out.append(merged if merged is not None else t)
+        return out

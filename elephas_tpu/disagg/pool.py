@@ -1,0 +1,146 @@
+"""DisaggPool: an in-process prefill-tier + decode-tier topology.
+
+The disaggregated analog of :class:`~elephas_tpu.fleet.ReplicaPool`:
+``n_prefill`` :class:`~.prefill.PrefillWorker` instances form ONE
+shared prefill tier, and ``n_decode`` decode workers — each a
+:class:`~.engine.DisaggEngine` behind its own
+:class:`~elephas_tpu.serving_http.ServingServer` — draw on it. The two
+tiers scale independently: a prompt-heavy deployment adds prefill
+workers, a generation-heavy one adds decode workers, and neither
+resizing touches the other tier.
+
+A :class:`~elephas_tpu.fleet.FleetRouter` fronts the pool unchanged —
+``FleetRouter(pool.urls)`` — because the decode servers speak the full
+serving API; the router's consistent-hash/spill policy, health-driven
+membership, and traceparent forwarding all apply, and the prefill tier
+hides behind the decode tier exactly as the parameter servers do. Chaos
+verbs for the failure tests: ``kill_prefill(i)`` (mid-transfer worker
+death — jobs retry on siblings), ``kill_decode(i)`` / ``drain_decode``
+(the router's eviction/re-route path, as with ``ReplicaPool``).
+"""
+from typing import Callable, List, Optional
+
+from ..serving_http import ServingServer
+from .engine import DisaggEngine
+from .prefill import PrefillWorker
+
+__all__ = ["DisaggPool"]
+
+
+class DisaggPool:
+    """``n_prefill`` prefill workers + ``n_decode`` served decode
+    engines, in-process.
+
+    :param decode_factory: zero-arg callable returning a fresh decode
+        :class:`~elephas_tpu.serving_engine.DecodeEngine` per decode
+        worker — construct with ``tier="decode"`` so the queue-wait
+        split lands on the right label (paged or contiguous both work).
+    :param prefill_factory: likewise for the prefill workers' engines
+        (defaults to ``decode_factory``; ``max_slots=1`` engines keep
+        the prefill tier's cache allocation minimal).
+    :param quant: Q8 KV frames on the wire (vs raw fp).
+    :param block_size: wire block size for the KV export.
+    :param prefixes: shared prompt prefixes registered on every prefill
+        worker's engine BEFORE traffic (registration does not
+        synchronize with in-flight prefills).
+    :param server_kwargs: forwarded to every decode
+        :class:`~elephas_tpu.serving_http.ServingServer`.
+    """
+
+    def __init__(self, decode_factory: Callable[[], object],
+                 n_prefill: int = 1, n_decode: int = 1,
+                 prefill_factory: Optional[Callable[[], object]] = None,
+                 quant: bool = True, block_size: int = 64,
+                 host: str = "127.0.0.1", tokenizer=None,
+                 prefixes=(), max_queue: Optional[int] = None,
+                 server_kwargs: Optional[dict] = None):
+        if n_prefill < 1 or n_decode < 1:
+            raise ValueError("need n_prefill >= 1 and n_decode >= 1")
+        self._decode_factory = decode_factory
+        self._prefill_factory = prefill_factory or decode_factory
+        self._n_prefill = int(n_prefill)
+        self._n_decode = int(n_decode)
+        self._quant = bool(quant)
+        self._block_size = int(block_size)
+        self._host = host
+        self._tokenizer = tokenizer
+        self._prefixes = [list(p) for p in prefixes]
+        self._max_queue = max_queue
+        self._server_kwargs = dict(server_kwargs or {})
+        self.prefill_workers: List[PrefillWorker] = []
+        self.engines: List[DisaggEngine] = []
+        self.servers: List[ServingServer] = []
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "DisaggPool":
+        for i in range(self._n_prefill):
+            engine = self._prefill_factory()
+            for p in self._prefixes:
+                engine.register_prefix(p)
+            # prefill-tier Prometheus series live on each worker's OWN
+            # (engine) registry — NOT the process default: a decode
+            # server's /metrics concatenates its engine registry with
+            # the default registry, and two registries both defining
+            # the serving_queue_wait_seconds family would emit
+            # duplicate HELP/TYPE blocks (invalid exposition). In
+            # production each prefill-worker process scrapes its own
+            # registry; in-process, the decode servers' /stats carries
+            # the prefill tier's waits (DisaggEngine.stats reads the
+            # workers directly).
+            self.prefill_workers.append(
+                PrefillWorker(engine, quant=self._quant,
+                              block_size=self._block_size,
+                              name=f"prefill-{i}").start())
+        for i in range(self._n_decode):
+            deng = DisaggEngine(self._decode_factory(),
+                                self.prefill_workers,
+                                max_queue=self._max_queue,
+                                host=self._host)
+            srv = ServingServer(deng, host=self._host, port=0,
+                                tokenizer=self._tokenizer,
+                                **self._server_kwargs)
+            srv.start()
+            self.engines.append(deng)
+            self.servers.append(srv)
+        return self
+
+    def stop(self):
+        for srv in self.servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — a killed decode server
+                pass
+        for deng in self.engines:
+            deng.stop()
+        for worker in self.prefill_workers:
+            if worker.alive:
+                worker.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- chaos
+    def kill_prefill(self, i: int):
+        """Abrupt prefill-worker death (mid-transfer included): its
+        queued and in-flight jobs fail back to the dispatchers and
+        retry on sibling workers."""
+        self.prefill_workers[i].kill()
+
+    def kill_decode(self, i: int):
+        """Abrupt decode-server death — the fleet router's eviction +
+        re-route scenario."""
+        self.servers[i].stop(drain_timeout=0.0)
+        self.engines[i].stop()
+
+    def drain_decode(self, i: int):
+        """Graceful decode drain: ``/ready`` flips 503, in-flight work
+        finishes."""
+        self.servers[i].begin_drain()
+
+    # ------------------------------------------------------------ queries
+    @property
+    def urls(self) -> List[str]:
+        return [f"http://{self._host}:{srv.port}" for srv in self.servers]

@@ -1,0 +1,363 @@
+"""Prefill workers: the compute-bound tier of disaggregated serving.
+
+Each :class:`PrefillWorker` owns a prefill-capable engine (a
+:class:`~elephas_tpu.serving_engine.DecodeEngine` used ONLY for its
+prefix-aware ``export_prefill`` path — register shared prefixes on it
+exactly as on a colocated engine) plus one worker thread draining a job
+queue: prefill the prompt, pack the resulting paged KV blocks, ship
+them to the submitting decode worker's :class:`~.wire.KVReceiver`
+(Q8-quantized by default). The dispatcher
+(:class:`~.engine.DisaggEngine`) owns retry policy: a job that fails —
+a killed worker, a severed mid-transfer socket, an injected fault —
+fails BACK to it via the job's ``on_failed`` callback and is re-queued
+on a sibling, so a prefill-tier death costs recompute, never a failed
+client request.
+
+Fault sites (:mod:`~elephas_tpu.utils.faults`): ``disagg.prefill``
+(``delay`` = a slow prefill, ``error`` = a prefill crash) and
+``disagg.ship`` (``error`` = a mid-transfer failure) make both retry
+paths deterministic in chaos tests.
+"""
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry
+from ..utils.faults import fault_site
+from .wire import KVShipper
+
+__all__ = ["PrefillJob", "PrefillWorker"]
+
+
+class PrefillJob:
+    """One request's prefill assignment. Plain data plus the
+    dispatcher's failure callback; everything the decode side needs to
+    reconstruct the request rides in :attr:`meta` fields."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "top_p", "deadline", "target", "ctx",
+                 "enqueued_t", "attempts", "on_failed", "abandoned",
+                 "clock")
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int,
+                 temperature=None, top_k=None, top_p=None,
+                 deadline: Optional[float] = None, target=None,
+                 ctx=None,
+                 on_failed: Optional[Callable] = None,
+                 clock=time.monotonic):
+        self.rid = int(rid)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.deadline = deadline          # absolute on ``clock``, or None
+        self.clock = clock                # the DISPATCHER's time source:
+        # ``deadline`` was computed on it, so the worker's expiry guard
+        # must read the same clock (an injected test clock and
+        # time.monotonic share no origin)
+        self.target = target              # (host, port) KVReceiver addr
+        self.ctx = ctx                    # TraceContext captured at submit
+        self.enqueued_t = time.monotonic()
+        self.attempts = 0
+        self.on_failed = on_failed
+        #: set by the dispatcher when the request terminated while this
+        #: job was queued (cancel, deadline sweep): the worker drops it
+        #: without spending prefill compute or wire bandwidth
+        self.abandoned = False
+
+
+class PrefillWorker:
+    """One prefill worker: queue thread + engine + shipper.
+
+    :param engine: the prefill engine (its ``export_prefill`` /
+        ``register_prefix`` are the only paths used; ``max_slots=1``
+        keeps its decode cache allocation minimal).
+    :param quant: ship Q8 (int8 data + f32 scales, ~0.27x the fp32
+        bytes) instead of raw-dtype KV blocks.
+    :param block_size: wire block size
+        (:func:`~elephas_tpu.models.paged_decode.export_kv_blocks`).
+    :param registry: metrics registry; defaults to the engine's, so one
+        scrape covers the worker. The worker observes
+        ``serving_queue_wait_seconds{tier="prefill"}`` (dispatch-to-
+        prefill-start wait — the prefill tier's half of the per-stage
+        queue-wait split) and ``disagg_prefills_total``.
+    :param name: label for events and the dispatcher's bookkeeping.
+    """
+
+    def __init__(self, engine, quant: bool = True, block_size: int = 64,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "prefill-0"):
+        self.engine = engine
+        self.quant = bool(quant)
+        self.block_size = int(block_size)
+        self.name = str(name)
+        self.shipper = KVShipper()
+        reg = (registry if registry is not None
+               else getattr(engine, "registry", None))
+        if reg is None:
+            reg = MetricsRegistry()
+        self.registry = reg
+        self._m_queue_wait = reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit-to-admission wall time per admitted request, by "
+            "serving tier", labels=("tier",)).labels(tier="prefill")
+        self._m_prefills = reg.counter(
+            "disagg_prefills_total",
+            "prefills computed and shipped by this prefill worker"
+            ).labels()
+        self._cond = threading.Condition()
+        self._jobs: deque = deque()
+        self._current: Optional[PrefillJob] = None
+        self._dead = False
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        # one-slot pipelined shipper (the PS plane's _PipelinedPusher
+        # shape): encode+ship of job i overlaps the EXPORT of job i+1
+        # on the worker thread — the wire round trip must not serialize
+        # with prefill compute. At most one ship in flight; the worker
+        # blocks handing over job i+1's frame until job i's ack landed,
+        # so a ship failure still fails back before a second frame
+        # could pass it.
+        self._ship_cond = threading.Condition()
+        self._ship_item = None          # (job, meta, kv_blocks) | None
+        self._worker_done = False       # the drain loop exited
+        self._ship_thread: Optional[threading.Thread] = None
+        #: (queue_wait_s) samples for the /stats percentile surface
+        self.wait_window: deque = deque(maxlen=1024)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "PrefillWorker":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"disagg-{self.name}")
+        self._ship_thread = threading.Thread(
+            target=self._ship_loop, daemon=True,
+            name=f"disagg-{self.name}-ship")
+        self._thread.start()
+        self._ship_thread.start()
+        return self
+
+    def stop(self):
+        """Graceful: finish the current job, fail the rest back to the
+        dispatcher, exit."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        with self._ship_cond:
+            self._ship_cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._ship_thread is not None:
+            self._ship_thread.join(timeout=10)
+        self.shipper.close()
+
+    def kill(self):
+        """Abrupt worker death (the chaos verb): the shipper's sockets
+        close NOW — a ship blocked mid-transfer fails immediately — and
+        every queued job fails back to the dispatcher for retry on a
+        sibling. The worker never accepts work again."""
+        with self._cond:
+            self._dead = True
+            self._cond.notify_all()
+        with self._ship_cond:
+            self._ship_cond.notify_all()
+        self.shipper.close()
+
+    @property
+    def alive(self) -> bool:
+        with self._cond:
+            return not (self._dead or self._stopping)
+
+    # ------------------------------------------------------------ dispatch
+    def submit(self, job: PrefillJob) -> None:
+        """Queue a job. Raises when the worker is dead/stopping — the
+        dispatcher's cue to pick a sibling."""
+        with self._cond:
+            if self._dead or self._stopping:
+                raise RuntimeError(f"prefill worker {self.name} is not "
+                                   "accepting work")
+            self._jobs.append(job)
+            self._cond.notify_all()
+
+    def backlog(self) -> int:
+        """Jobs queued, in prefill, or awaiting their ship ack — the
+        dispatcher's least-loaded placement signal."""
+        with self._cond:
+            n = len(self._jobs) + (1 if self._current is not None
+                                   else 0)
+        with self._ship_cond:
+            return n + (1 if self._ship_item is not None else 0)
+
+    # ---------------------------------------------------------------- loop
+    #: a queued job older than this is served FIFO regardless of size —
+    #: shortest-prompt-first must not starve long prompts forever
+    MAX_SJF_WAIT_S = 0.25
+
+    def _pick_locked(self) -> PrefillJob:
+        """Shortest-prompt-first with aging: a burst of long prompts
+        must not head-of-line block the short steady prefills behind it
+        (prefill cost scales with prompt length, so SJF minimizes mean
+        wait), while the aging cap keeps long prompts from starving
+        under sustained short traffic. Called under ``_cond``."""
+        head = self._jobs[0]
+        if time.monotonic() - head.enqueued_t >= self.MAX_SJF_WAIT_S:
+            self._jobs.popleft()
+            return head
+        best = min(range(len(self._jobs)),
+                   key=lambda i: (len(self._jobs[i].prompt),
+                                  self._jobs[i].enqueued_t))
+        job = self._jobs[best]
+        del self._jobs[best]
+        return job
+
+    def _fail(self, job: PrefillJob, error: str) -> None:
+        if job.on_failed is not None:
+            try:
+                job.on_failed(job, self.name, error)
+            except Exception:  # noqa: BLE001 — a dispatcher bug must
+                pass           # not kill the drain loop mid-handover
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not (self._jobs or self._dead or self._stopping):
+                    self._cond.wait(timeout=0.5)
+                if self._dead or self._stopping:
+                    # the stop() contract: finish the CURRENT job (we
+                    # are between jobs here), fail the queued rest back
+                    # to the dispatcher — draining a deep backlog would
+                    # blow past stop()'s join timeout and yank the
+                    # shipper out from under a live transfer
+                    orphans = list(self._jobs)
+                    self._jobs.clear()
+                    break
+                job = self._pick_locked()
+                self._current = job
+            try:
+                self._run_job(job)
+            except Exception as exc:  # noqa: BLE001 — ANY failure fails
+                # the job back to the dispatcher (killed shipper, engine
+                # error, injected fault); the worker itself survives
+                # unless it was killed
+                self._fail(job, f"{type(exc).__name__}: {exc}")
+            finally:
+                with self._cond:
+                    self._current = None
+        for job in orphans:
+            self._fail(job, "worker killed")
+        with self._ship_cond:
+            self._worker_done = True
+            self._ship_cond.notify_all()
+
+    def _run_job(self, job: PrefillJob) -> None:
+        with self._cond:
+            if self._dead:
+                raise RuntimeError("worker killed")
+        if job.abandoned or (job.deadline is not None
+                             and job.clock() >= job.deadline):
+            # cancelled / expired while queued here: prefilling it
+            # would spend compute and wire bandwidth on a frame the
+            # decode side is guaranteed to drop — exactly when the
+            # tier is most loaded. Silently skip: the dispatcher
+            # already terminated the request (or its deadline sweep
+            # will), so no fail-back either.
+            return
+        wait = time.monotonic() - job.enqueued_t
+        self._m_queue_wait.observe(wait)
+        with self._cond:
+            # appends serialize with wait_samples(): iterating a deque
+            # another thread is appending to raises RuntimeError
+            self.wait_window.append(wait)
+        from ..obs.context import use_context
+
+        with use_context(job.ctx):
+            fault_site("disagg.prefill")
+            out = self.engine.export_prefill(
+                job.prompt, temperature=job.temperature,
+                top_k=job.top_k, top_p=job.top_p,
+                block_size=self.block_size)
+        meta = {"rid": job.rid, "prompt": job.prompt,
+                "max_new_tokens": job.max_new_tokens,
+                "temperature": job.temperature,
+                "top_k": job.top_k, "top_p": job.top_p,
+                "deadline": job.deadline,
+                "first_token": out["first_token"],
+                "prompt_tokens": out["prompt_tokens"],
+                "prefix_tokens": out["prefix_tokens"],
+                "prefill_s": out["prefill_s"],
+                "queue_wait_s": round(wait, 6),
+                "worker": self.name,
+                "codec": "q8" if self.quant else "fp",
+                "block_size": out["block_size"]}
+        self._hand_to_shipper(job, meta, out["kv_blocks"])
+
+    def _hand_to_shipper(self, job: PrefillJob, meta: Dict,
+                         kv_blocks) -> None:
+        """Block until the PREVIOUS ship completed (one in flight),
+        then hand this job's frame to the ship thread — pipelining the
+        wire round trip behind the next job's prefill compute."""
+        with self._ship_cond:
+            while self._ship_item is not None and not self._dead:
+                self._ship_cond.wait(timeout=0.1)
+            if self._dead:
+                raise RuntimeError("worker killed")
+            self._ship_item = (job, meta, kv_blocks)
+            self._ship_cond.notify_all()
+
+    def _ship_loop(self):
+        while True:
+            with self._ship_cond:
+                while (self._ship_item is None
+                       and not (self._dead or self._worker_done)):
+                    self._ship_cond.wait(timeout=0.2)
+                item = self._ship_item
+                if item is None:
+                    if self._dead or self._worker_done:
+                        return
+                    continue
+            job, meta, kv_blocks = item
+            try:
+                if job.abandoned:
+                    continue       # finally still clears the slot
+                from ..obs.context import use_context
+
+                with use_context(job.ctx):
+                    fault_site("disagg.ship")
+                    nbytes = self.shipper.ship(
+                        job.target, meta, kv_blocks, quant=self.quant,
+                        ctx=job.ctx)
+                self._m_prefills.inc()
+                emit_event("disagg.prefill_shipped", rid=job.rid,
+                           worker=self.name, bytes=nbytes,
+                           codec="q8" if self.quant else "fp",
+                           prefill_s=meta.get("prefill_s"))
+            except Exception as exc:  # noqa: BLE001 — ship failures
+                # (killed shipper, dead receiver, injected fault) fail
+                # the job back for retry on a sibling
+                self._fail(job, f"{type(exc).__name__}: {exc}")
+            finally:
+                with self._ship_cond:
+                    self._ship_item = None
+                    self._ship_cond.notify_all()
+
+    # ------------------------------------------------------------- queries
+    def wait_samples(self) -> List[float]:
+        """A consistent snapshot of the queue-wait window (the worker
+        thread appends concurrently — an unlocked iteration would
+        intermittently raise mid-scrape)."""
+        with self._cond:
+            return list(self.wait_window)
+
+    def stats(self) -> Dict:
+        waits: List[float] = self.wait_samples()
+        out: Dict = {"name": self.name, "alive": self.alive,
+                     "backlog": self.backlog(),
+                     "prefills": int(self._m_prefills.value)}
+        if waits:
+            from ..obs.metrics import percentile
+
+            out["queue_wait_p50_s"] = round(percentile(waits, 0.5), 6)
+            out["queue_wait_p99_s"] = round(percentile(waits, 0.99), 6)
+        return out

@@ -1,0 +1,305 @@
+"""KV-transfer wire for disaggregated prefill/decode.
+
+A prefill worker ships one request's prompt KV state to a decode worker
+as a single ETPU frame over the zero-copy socket path (PR 5's frame
+machinery: single-allocation :func:`~elephas_tpu.utils.tensor_codec.
+encode_tensors`, ``recv_into`` exact reads, ``copy=False`` view
+decode). Frame layout::
+
+    [meta]  uint8 tensor — UTF-8 JSON request metadata (rid, prompt,
+            sampling settings, first_token, deadline, ...)
+    [kv]    KIND_KV:    per-layer paged KV block tensors
+                        (:func:`~elephas_tpu.models.paged_decode.
+                        export_kv_blocks` order)
+            KIND_KV_Q8: the same blocks as interleaved (int8 data,
+                        float32 scale) pairs
+                        (:func:`~elephas_tpu.models.quantization.
+                        quantize_kv_frames`) — roughly a quarter of the
+                        fp32 bytes (int8 data + one f32 scale per
+                        ``head_dim`` vector)
+
+Socket protocol (:class:`KVReceiver` serves it, :class:`KVShipper`
+speaks it): an optional ``b'T'`` + 55-byte traceparent frame — the SAME
+trace extension the parameter-server transport uses, so one trace id
+spans client -> router -> prefill -> decode -> PS — then ``b'K'`` + an
+8-byte little-endian length + the frame body, answered with a 1-byte
+ack once the receiver has handed the frame to its import queue. A peer
+vanishing mid-transfer raises on either side (``recv_exact``'s EOF
+contract), which is the shipper's signal to retry the prefill
+elsewhere; a lost ACK may deliver a duplicate frame, which the decode
+side deduplicates by request id.
+"""
+import json
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.quantization import dequantize_kv_frames, quantize_kv_frames
+from ..obs.context import use_context
+from ..utils.sockets import (KV_ACK, KV_OPCODE, LENGTH_BYTES,
+                             TRACE_OPCODE, recv_exact, receive_traceparent,
+                             send_kv_payload, send_trace_context)
+from ..utils.tensor_codec import (KIND_KV, KIND_KV_Q8, MAX_FRAME_BYTES,
+                                  CodecError, decode, encode)
+
+__all__ = ["encode_kv_frame", "decode_kv_frame", "KVReceiver",
+           "KVShipper"]
+
+
+def encode_kv_frame(meta: Dict, arrays: Sequence[np.ndarray],
+                    quant: bool = True):
+    """One wire frame: JSON ``meta`` + the KV block tensors, Q8-packed
+    when ``quant``. Returns the encoder's bytes-like payload (a writable
+    memoryview on the Python path — sendall-ready, no copy)."""
+    meta_arr = np.frombuffer(json.dumps(meta).encode("utf8"), np.uint8)
+    if quant:
+        body: List[np.ndarray] = quantize_kv_frames(arrays)
+        kind = KIND_KV_Q8
+    else:
+        body = [np.asarray(a) for a in arrays]
+        kind = KIND_KV
+    return encode([meta_arr] + body, kind)
+
+
+def decode_kv_frame(payload, copy: bool = False
+                    ) -> Tuple[Dict, List[np.ndarray]]:
+    """Inverse of :func:`encode_kv_frame`: ``(meta, kv_arrays)`` with Q8
+    pairs already dequantized to float32. ``copy=False`` (the receive
+    path's default) decodes zero-copy views of ``payload`` — fp tensors
+    alias the receive buffer straight into the decode engine's install,
+    and Q8 dequantization allocates its float32 output anyway."""
+    arrays, kind = decode(payload, copy=copy)
+    if kind not in (KIND_KV, KIND_KV_Q8):
+        raise CodecError(f"not a KV frame (kind {kind})")
+    if not arrays:
+        raise CodecError("KV frame is missing its metadata tensor")
+    try:
+        meta = json.loads(bytes(arrays[0]).decode("utf8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise CodecError(f"bad KV frame metadata: {exc}")
+    body = arrays[1:]
+    if kind == KIND_KV_Q8:
+        body = dequantize_kv_frames(body)
+    return meta, body
+
+
+class KVReceiver:
+    """Decode-worker-side KV frame server.
+
+    Listens on ``host:port`` (0 = pick free), accepts prefill-worker
+    connections, and for every delivered frame calls ``on_frame(meta,
+    arrays, nbytes)`` under the shipped trace context before answering
+    the 1-byte ack. ``on_frame`` runs on the connection thread and must
+    only enqueue (the decode engine installs between its own steps) —
+    a slow callback backpressures that shipper's connection, nothing
+    else.
+    """
+
+    def __init__(self, on_frame: Callable[[Dict, List[np.ndarray], int],
+                                          None],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._on_frame = on_frame
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(16)
+        self._host = host
+        self._port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return self._host, self._port
+
+    def start(self) -> "KVReceiver":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="kv-receiver")
+        self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:       # closed by stop()
+                return
+            with self._lock:
+                self._conns.append(conn)
+            # daemon threads, never joined: _serve_conn removes its
+            # conn from _conns on exit, so nothing accumulates per
+            # (possibly short-lived) shipper connection
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="kv-receiver-conn").start()
+
+    def _serve_conn(self, conn: socket.socket):
+        """One shipper connection: opcode loop until EOF. A traceparent
+        frame applies to exactly the one KV frame that follows (the PS
+        protocol's convention)."""
+        ctx = None
+        try:
+            while not self._stop.is_set():
+                op = bytes(recv_exact(conn, 1))
+                if op == TRACE_OPCODE:
+                    ctx = receive_traceparent(conn)
+                    continue
+                if op != KV_OPCODE:
+                    return          # protocol violation: drop the conn
+                length = int.from_bytes(recv_exact(conn, LENGTH_BYTES),
+                                        "little")
+                if length > MAX_FRAME_BYTES:
+                    return
+                payload = recv_exact(conn, length)
+                try:
+                    meta, arrays = decode_kv_frame(payload, copy=False)
+                    with use_context(ctx):
+                        self._on_frame(meta, arrays, length)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception:  # noqa: BLE001 — a malformed frame
+                    # (codec skew, bad block shapes in the callback) is
+                    # a PROTOCOL error: close the conn (no ack — the
+                    # shipper's failure signal) instead of letting the
+                    # exception kill this thread with a traceback
+                    return
+                finally:
+                    ctx = None
+                # ack only after the frame reached the import queue: a
+                # shipper killed before this byte retries, and the
+                # decode side dedupes the replay by rid
+                conn.sendall(KV_ACK)
+        except (ConnectionError, OSError):
+            pass                    # peer gone: routine in a kill test
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+
+class KVShipper:
+    """Prefill-worker-side KV frame client: one persistent connection
+    per decode-worker address, byte/frame accounting per codec (the
+    bench row's fp32-vs-Q8 wire-bytes evidence reads these)."""
+
+    def __init__(self, timeout: float = 30.0):
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._socks: Dict[Tuple[str, int], socket.socket] = {}
+        self._closed = False
+        #: frames / payload bytes shipped, by codec ("fp" | "q8")
+        self.frames: Dict[str, int] = {"fp": 0, "q8": 0}
+        self.bytes: Dict[str, int] = {"fp": 0, "q8": 0}
+
+    def _connect(self, addr: Tuple[str, int]) -> socket.socket:
+        sock = socket.create_connection(addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def ship(self, addr: Tuple[str, int], meta: Dict,
+             arrays: Sequence[np.ndarray], quant: bool = True,
+             ctx=None) -> int:
+        """Encode + send one KV frame and wait for the ack; returns the
+        payload byte count. One reconnect attempt on a stale persistent
+        socket (the decode worker restarted); any other failure
+        propagates — the caller's retry-the-prefill-elsewhere signal.
+        ``ctx`` (a TraceContext) rides ahead of the frame when given."""
+        addr = (addr[0], int(addr[1]))
+        payload = encode_kv_frame(meta, arrays, quant=quant)
+        sock, fresh = self._checkout(addr)
+        try:
+            self._send(sock, payload, ctx)
+        except (ConnectionError, OSError):
+            # a stale persistent conn gets ONE fresh retry; a fresh
+            # conn failing (or a closed shipper) is real
+            self._drop(addr)
+            if fresh:
+                raise
+            sock, _ = self._checkout(addr, force_fresh=True)
+            try:
+                self._send(sock, payload, ctx)
+            except (ConnectionError, OSError):
+                self._drop(addr)
+                raise
+        codec = "q8" if quant else "fp"
+        with self._lock:
+            self.frames[codec] += 1
+            self.bytes[codec] += len(payload)
+        return len(payload)
+
+    def _checkout(self, addr, force_fresh: bool = False):
+        """``(socket, was_fresh)`` for ``addr``. The lock guards only
+        the socket map — NEVER the connect or the send/ack round trip,
+        so close() (the kill-mid-transfer path) can always grab it and
+        shut a blocked transfer down from another thread (a blackholed
+        connect must not pin the lock for its whole timeout)."""
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("shipper is closed")
+            sock = None if force_fresh else self._socks.get(addr)
+        if sock is not None:
+            return sock, False
+        sock = self._connect(addr)          # blocking I/O: lock NOT held
+        with self._lock:
+            if self._closed:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise ConnectionError("shipper is closed")
+            self._socks[addr] = sock
+        return sock, True
+
+    @staticmethod
+    def _send(sock: socket.socket, payload, ctx) -> None:
+        if ctx is not None:
+            send_trace_context(sock, ctx)
+        send_kv_payload(sock, payload)
+
+    def _drop(self, addr: Tuple[str, int]) -> None:
+        sock = self._socks.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        """Close every connection. A ``ship`` blocked in a send/ack on
+        another thread fails immediately — the kill-mid-transfer path."""
+        with self._lock:
+            self._closed = True
+            socks = list(self._socks.values())
+            self._socks.clear()
+        for sock in socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -30,17 +30,19 @@ Not supported in paged mode (constructor raises): ``kv_cache_quant``
 layers.
 """
 import math
-from typing import Dict, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
                           _apply_rope, _mlp_apply, _norm,
                           _sinusoidal_table, head_logits)
 
 __all__ = ["init_paged_pool", "decode_step_paged", "install_row_paged",
-           "validate_paged_config"]
+           "validate_paged_config", "export_kv_blocks",
+           "import_kv_blocks"]
 
 
 def validate_paged_config(config: TransformerConfig):
@@ -104,6 +106,89 @@ def _install(pool, row_cache, block_ids, nblocks: int):
 
 _install_jit = jax.jit(_install, static_argnums=(3,),
                        donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
+# Off-engine block transfer — disaggregated prefill/decode.
+#
+# A prefill worker computes a contiguous batch-1 row cache and ships it
+# to a decode worker in fixed ``block_size``-position blocks: the paged
+# pool's native currency, and a bounded shape family (at most
+# ``ceil(max_len / block_size)`` distinct block counts) so the decode
+# side's install jit cannot churn one compile per prompt length. The
+# exports are HOST numpy arrays — they exist to cross a socket
+# (:mod:`elephas_tpu.disagg.wire`), not to stay on device.
+# --------------------------------------------------------------------------
+
+def _layer_names(row_cache: Dict) -> List[str]:
+    """``layer_0..layer_{n-1}`` in index order — the canonical wire
+    order, independent of dict insertion order."""
+    return sorted(row_cache, key=lambda n: int(n.split("_", 1)[1]))
+
+
+def export_kv_blocks(row_cache: Dict, length: int,
+                     block_size: int) -> List[np.ndarray]:
+    """Extract a batch-1 row cache's first ``length`` positions as
+    block-unit host arrays: a flat ``[k_0, v_0, k_1, v_1, ...]`` list
+    (layer index order) of shape ``(nblocks, kv_heads, block_size,
+    head_dim)`` each, ``nblocks = ceil(length / block_size)``. The final
+    block's tail is zero padding (no position past ``length`` is ever
+    read after install — the same contract as
+    :func:`install_row_paged`'s padding)."""
+    length = int(length)
+    bs = int(block_size)
+    if length < 1 or bs < 1:
+        raise ValueError("length and block_size must be >= 1")
+    nb = -(-length // bs)
+    out: List[np.ndarray] = []
+    for name in _layer_names(row_cache):
+        lc = row_cache[name]
+        for part in ("k", "v"):
+            row = np.asarray(lc[part])[0]          # (H, L, D)
+            h, cached, d = row.shape
+            if cached < length:
+                raise ValueError(f"row cache holds {cached} positions, "
+                                 f"cannot export {length}")
+            chunk = np.zeros((h, nb * bs, d), row.dtype)
+            chunk[:, :length] = row[:, :length]
+            out.append(np.ascontiguousarray(
+                chunk.reshape(h, nb, bs, d).swapaxes(0, 1)))
+    return out
+
+
+def import_kv_blocks(arrays: Sequence[np.ndarray], length: int,
+                     max_len: int) -> Dict:
+    """Reassemble :func:`export_kv_blocks` output into a contiguous
+    batch-1 row cache dict (``{"layer_i": {"k", "v"}}``, each ``(1,
+    kv_heads, max_len, head_dim)``) padded with zeros past ``length`` —
+    ready for the decode engine's slot install (contiguous
+    ``_install_fn`` or :func:`install_row_paged`)."""
+    if not arrays or len(arrays) % 2:
+        raise ValueError("KV block export must hold (k, v) pairs per "
+                         f"layer, got {len(arrays)} arrays")
+    length, max_len = int(length), int(max_len)
+    if length > max_len:
+        raise ValueError(f"length {length} exceeds max_len {max_len}")
+    row: Dict = {}
+    for i, (k_blocks, v_blocks) in enumerate(zip(arrays[0::2],
+                                                 arrays[1::2])):
+        parts = {}
+        for part, blocks in (("k", k_blocks), ("v", v_blocks)):
+            blocks = np.asarray(blocks)
+            if blocks.ndim != 4:
+                raise ValueError("KV block tensors must be (nblocks, "
+                                 f"heads, block_size, head_dim), got "
+                                 f"shape {blocks.shape}")
+            nb, h, bs, d = blocks.shape
+            if nb * bs < length:
+                raise ValueError(f"{nb} blocks of {bs} positions cannot "
+                                 f"cover length {length}")
+            flat = blocks.swapaxes(0, 1).reshape(h, nb * bs, d)
+            full = np.zeros((1, h, max_len, d), blocks.dtype)
+            full[0, :, :length] = flat[:, :length]
+            parts[part] = full
+        row[f"layer_{i}"] = parts
+    return row
 
 
 def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,

@@ -26,13 +26,15 @@ decode runs replicated (single chip or dp), which is the serving
 deployment the decode row measures.
 """
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["QTensor", "quantize_weight", "quantize_lm_params",
-           "dequantize_lm_params"]
+           "dequantize_lm_params", "quantize_kv", "dequantize_kv",
+           "quantize_kv_frames", "dequantize_kv_frames", "KV_Q8_EPS"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -134,6 +136,88 @@ def quantize_lm_params(params: Dict) -> Dict:
     if "head" in params and params["head"] is not None:
         out["head"] = quantize_weight(params["head"], (0,))
     return out
+
+
+# --------------------------------------------------------------------------
+# Q8 KV-tensor quantization — the disaggregated-serving wire codec.
+#
+# A prefill worker ships paged KV blocks to a decode worker over the
+# zero-copy socket path (:mod:`elephas_tpu.disagg.wire`); symmetric
+# per-vector int8 roughly quarters the fp32 wire bytes (int8 data +
+# one f32 scale per ``head_dim`` lane vector). Unlike the weight path
+# above this is a HOST-side numpy codec: the tensors are already off
+# the device when they hit the wire, and the decode side dequantizes
+# before installing into its own pool.
+# --------------------------------------------------------------------------
+
+#: absmax floor: an all-zero vector quantizes against this scale (so the
+#: round trip is exact zeros) and the error bound below never divides
+#: by zero
+KV_Q8_EPS = 1e-8
+
+
+def quantize_kv(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-vector int8 for a KV tensor: absmax over the LAST
+    axis (the ``head_dim`` lane vector of one cached position) sets each
+    vector's scale, so the scale overhead is ``4/head_dim`` bytes per
+    element and one outlier position cannot flatten a whole block.
+
+    Returns ``(data int8, scale float32)`` with ``scale`` broadcastable
+    against ``data`` (last axis kept as 1). Guaranteed elementwise error
+    bound of the round trip, asserted in
+    ``tests/models/test_kv_quantization.py``::
+
+        |x - dequantize_kv(*quantize_kv(x))| <= scale / 2
+
+    (``scale = max(absmax, KV_Q8_EPS) / 127``: rounding to the nearest
+    of 255 levels spanning ``[-absmax, absmax]`` is off by at most half
+    a step, and nothing clips because ``|x| <= absmax``.)
+
+    0-d and empty tensors round-trip (a 0-d tensor is its own vector);
+    non-C-contiguous inputs are handled (numpy ufuncs read strides).
+    """
+    a = np.asarray(arr, np.float32)
+    if a.ndim == 0:
+        absmax = np.abs(a)[None]
+        scale = np.maximum(absmax, KV_Q8_EPS) / 127.0
+        q = np.clip(np.rint(a / scale[0]), -127, 127).astype(np.int8)
+        return q, scale.astype(np.float32)
+    absmax = np.max(np.abs(a), axis=-1, keepdims=True, initial=0.0)
+    scale = (np.maximum(absmax, KV_Q8_EPS) / 127.0).astype(np.float32)
+    q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_kv(data: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`quantize_kv` (float32 output). For a 0-d
+    ``data`` the shape-(1,) scale collapses back to 0-d."""
+    data = np.asarray(data)
+    out = data.astype(np.float32) * np.asarray(scale, np.float32)
+    if data.ndim == 0:
+        return np.float32(out.reshape(()))
+    return out
+
+
+def quantize_kv_frames(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Interleaved ``[data_0, scale_0, data_1, scale_1, ...]`` — the
+    grouped (data, scale) frame layout the codec and
+    :meth:`~elephas_tpu.parameter.sharding.ShardPlan.split(group=2)`
+    already speak (same shape as ``KIND_DELTA_Q8``)."""
+    out: List[np.ndarray] = []
+    for a in arrays:
+        q, s = quantize_kv(a)
+        out.append(q)
+        out.append(s)
+    return out
+
+
+def dequantize_kv_frames(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Inverse of :func:`quantize_kv_frames`."""
+    if len(arrays) % 2:
+        raise ValueError("Q8 KV frame must hold (data, scale) pairs, "
+                         f"got {len(arrays)} arrays")
+    return [dequantize_kv(q, s)
+            for q, s in zip(arrays[0::2], arrays[1::2])]
 
 
 def dequantize_lm_params(params: Dict) -> Dict:

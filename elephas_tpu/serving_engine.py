@@ -114,7 +114,23 @@ def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
     p_thr = jnp.where(top_p[:, None] < 1.0, p_kth, -jnp.inf)
     return jnp.where(logits >= p_thr, logits, NEG_INF)
 
-__all__ = ["DecodeEngine", "QueueFullError", "DeadlineExceededError"]
+__all__ = ["DecodeEngine", "QueueFullError", "DeadlineExceededError",
+           "validate_sampling_overrides"]
+
+
+def validate_sampling_overrides(temperature, top_k, top_p) -> None:
+    """THE per-request sampling validation — shared by every submit
+    surface (engine submit, prefill export, the disaggregated front
+    end), so an admission-rule change cannot silently diverge their
+    400-at-submit behavior. ``None`` always means "engine default"."""
+    if temperature is not None:
+        if not (temperature >= 0 and np.isfinite(temperature)):
+            raise ValueError("temperature must be >= 0 and finite, "
+                             f"got {temperature}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
 
 class DecodeEngine:
@@ -178,6 +194,16 @@ class DecodeEngine:
     :param clock: monotonic time source for deadline bookkeeping
         (``time.monotonic``); injectable so chaos tests drive expiry
         deterministically without sleeping.
+    :param tier: the serving tier this engine plays in a disaggregated
+        topology — the ``tier`` label on its
+        ``serving_queue_wait_seconds`` series. ``"colocated"`` (the
+        default) is the classic one-engine-does-both deployment, whose
+        queue wait INCLUDES head-of-line prefill blocking;
+        ``"decode"`` marks a decode worker fed precomputed KV
+        (:meth:`submit_prefilled`), whose queue wait is pure
+        decode-stage backlog. The prefill tier's companion series is
+        observed by :class:`~elephas_tpu.disagg.PrefillWorker` under
+        ``tier="prefill"``.
     :param registry: the :class:`~elephas_tpu.obs.MetricsRegistry` this
         engine's series land in. Defaults to a FRESH per-engine registry
         (not the process default): the registry counters are the single
@@ -207,7 +233,7 @@ class DecodeEngine:
                  paged: Optional[Tuple[int, int]] = None,
                  max_queue: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
-                 clock=time.monotonic,
+                 clock=time.monotonic, tier: str = "colocated",
                  registry: Optional[MetricsRegistry] = None):
         self.params = params
         self.config = config
@@ -291,6 +317,9 @@ class DecodeEngine:
         self._outputs: Dict = {}
         self._done: Dict = {}
         self._fresh: Dict = {}   # admission-time tokens awaiting step()
+        # rid -> (kv_blocks, first_token) for requests whose prefill
+        # happened off-engine (submit_prefilled); consumed at admission
+        self._prefilled_kv: Dict[int, Tuple] = {}
         self._next_rid = 0
         # overload safety: admission bounds + per-request deadlines
         self.max_queue = None if max_queue is None else int(max_queue)
@@ -366,9 +395,16 @@ class DecodeEngine:
             "serving_request_latency_seconds",
             "submit-to-retirement wall time per finished request"
             ).labels()
+        # labeled by serving tier: a disaggregated deployment's headline
+        # claim — decode-tier queue wait free of prefill head-of-line
+        # blocking — must be readable straight off /metrics, next to the
+        # prefill tier's series (PrefillWorker observes tier="prefill"
+        # into the same family)
+        self.tier = str(tier)
         self._m_queue_wait = reg.histogram(
             "serving_queue_wait_seconds",
-            "submit-to-admission wall time per admitted request").labels()
+            "submit-to-admission wall time per admitted request, by "
+            "serving tier", labels=("tier",)).labels(tier=self.tier)
         # per-request wall-clock: submit time per rid + a bounded window
         # of completed (queue_wait_s, total_s) samples for percentiles
         # (kept alongside the histograms: _retry_after_ms needs raw
@@ -731,6 +767,41 @@ class DecodeEngine:
         return logits[0], row
 
     # ------------------------------------------------------------ queue
+    def check_admissible(self, prompt_size: int,
+                         max_new_tokens: int) -> None:
+        """Raise ``ValueError`` when a request is PERMANENTLY
+        inadmissible on this engine — it exceeds ``max_len`` (plus the
+        speculative verify slack), could never fit the paged block
+        pool, or its prompt alone exceeds ``max_queued_tokens``. A
+        retryable :class:`QueueFullError` (429 + backoff) for these
+        would have well-behaved clients retrying forever. THE shared
+        validator: the engine's own submit paths and the disaggregated
+        front end (:class:`~elephas_tpu.disagg.DisaggEngine`) both call
+        it, so an inadmissible request always 400s at submit instead of
+        failing at KV-install time inside an engine loop."""
+        # speculative rounds write verify blocks up to gamma positions
+        # past the last emitted token
+        slack = self.gamma if self.draft_config is not None else 0
+        if prompt_size + max_new_tokens + slack > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_size}) + max_new_tokens "
+                f"({max_new_tokens})"
+                + (f" + gamma ({slack})" if slack else "")
+                + f" exceeds max_len {self.max_len}")
+        if self.paged is not None:
+            needed = -(-(prompt_size + max_new_tokens) // self.paged[1])
+            if needed > self.paged[0] - 1:      # block 0 never allocates
+                raise ValueError(
+                    f"request needs {needed} blocks but the pool only "
+                    f"has {self.paged[0] - 1} allocatable — it could "
+                    "never be admitted")
+        if (self.max_queued_tokens is not None
+                and prompt_size > self.max_queued_tokens):
+            raise ValueError(
+                f"prompt of {prompt_size} tokens exceeds "
+                f"max_queued_tokens={self.max_queued_tokens} — it could "
+                "never be admitted")
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
@@ -757,40 +828,90 @@ class DecodeEngine:
         :class:`QueueFullError` when ``max_queue``/``max_queued_tokens``
         is configured and the backlog is at capacity — overload answers
         immediately instead of queueing unboundedly."""
+        return self._submit_impl(prompt, max_new_tokens, temperature,
+                                 top_k, top_p, admit, deadline_ms, None)
+
+    def submit_prefilled(self, prompt: Sequence[int],
+                         max_new_tokens: int, kv_blocks, first_token: int,
+                         temperature: Optional[float] = None,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
+                         admit: bool = True,
+                         deadline_ms: Optional[float] = None) -> int:
+        """Queue a request whose prefill ALREADY HAPPENED off-engine —
+        the decode half of disaggregated serving. ``kv_blocks`` is the
+        prompt's KV state in wire-block form
+        (:func:`~elephas_tpu.models.paged_decode.export_kv_blocks`, as
+        produced by :meth:`export_prefill` on a prefill worker) and
+        ``first_token`` the token its final-position logits emitted.
+        Admission installs the shipped blocks into the slot's cache row
+        (or paged blocks) between decode steps — the same atomic point
+        where ordinary admissions install their own prefill — so the
+        request's queue wait is pure decode-stage backlog. Everything
+        else (admission bounds, deadlines, sampling overrides for the
+        DECODE steps, cancel, results) behaves exactly like
+        :meth:`submit`. Not supported in speculative mode (the draft
+        model's KV is not shipped)."""
+        if self.draft_config is not None:
+            raise ValueError("submit_prefilled does not compose with "
+                             "speculative mode (no draft KV on the wire)")
+        # shape/coverage validation happens HERE, at submit: a malformed
+        # KV payload failing at admission time would raise inside the
+        # server's engine loop and read as engine death (500s for
+        # everyone) instead of one bad request's 400
+        prompt_size = int(np.asarray(prompt).size)
+        if isinstance(kv_blocks, dict):
+            # prebuilt batch-1 row cache (``import_kv_blocks`` output):
+            # a receiver thread can do the block reassembly OFF the
+            # engine loop and hand the row in directly — admission then
+            # only pays the device install
+            blocks = kv_blocks
+            if len(blocks) != self.config.num_layers:
+                raise ValueError(
+                    f"prebuilt KV row must hold {self.config.num_layers}"
+                    f" layers, got {len(blocks)}")
+            for name, lc in blocks.items():
+                for part in ("k", "v"):
+                    arr = lc[part]
+                    if arr.ndim != 4 or arr.shape[2] < prompt_size:
+                        raise ValueError(
+                            f"prebuilt KV row {name}/{part} must be "
+                            f"(1, heads, >= {prompt_size}, head_dim), "
+                            f"got shape {tuple(arr.shape)}")
+        else:
+            blocks = [np.asarray(b) for b in kv_blocks]
+            expected = 2 * self.config.num_layers
+            if len(blocks) != expected:
+                raise ValueError(f"expected {expected} KV block tensors "
+                                 f"(k, v per layer), got {len(blocks)}")
+            for b in blocks:
+                if b.ndim != 4:
+                    raise ValueError(
+                        "KV block tensors must be (nblocks, heads, "
+                        f"block_size, head_dim), got shape "
+                        f"{tuple(b.shape)}")
+                if b.shape[0] * b.shape[2] < prompt_size:
+                    raise ValueError(
+                        f"{b.shape[0]} blocks of {b.shape[2]} positions"
+                        f" cannot cover the {prompt_size}-token prompt")
+        return self._submit_impl(prompt, max_new_tokens, temperature,
+                                 top_k, top_p, admit, deadline_ms,
+                                 (blocks, int(first_token)))
+
+    def _submit_impl(self, prompt, max_new_tokens, temperature, top_k,
+                     top_p, admit, deadline_ms, prefilled) -> int:
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
                 raise ValueError("per-request sampling settings are not "
                                  "supported in speculative mode")
-        if temperature is not None:
-            if not (temperature >= 0 and np.isfinite(temperature)):
-                raise ValueError("temperature must be >= 0 and finite, "
-                                 f"got {temperature}")
-        if top_k is not None and top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if top_p is not None and not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        validate_sampling_overrides(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        # speculative rounds write verify blocks up to gamma positions
-        # past the last emitted token
-        slack = self.gamma if self.draft_config is not None else 0
-        if prompt.size + max_new_tokens + slack > self.max_len:
-            raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens})"
-                + (f" + gamma ({slack})" if slack else "")
-                + f" exceeds max_len {self.max_len}")
-        if self.paged is not None:
-            needed = -(-(prompt.size + max_new_tokens) // self.paged[1])
-            if needed > self.paged[0] - 1:      # block 0 never allocates
-                raise ValueError(
-                    f"request needs {needed} blocks but the pool only "
-                    f"has {self.paged[0] - 1} allocatable — it could "
-                    "never be admitted")
+        self.check_admissible(int(prompt.size), int(max_new_tokens))
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         # expired backlog entries must not hold capacity against a live
@@ -811,15 +932,6 @@ class DecodeEngine:
             raise QueueFullError(
                 f"queue full: {len(self._queue)} requests backlogged "
                 f"(max_queue={self.max_queue})", self._retry_after_ms())
-        if (self.max_queued_tokens is not None
-                and prompt.size > self.max_queued_tokens):
-            # permanently inadmissible, like the oversized-paged-request
-            # check above: a retryable QueueFullError (429 + backoff)
-            # would have well-behaved clients retrying forever
-            raise ValueError(
-                f"prompt of {prompt.size} tokens exceeds "
-                f"max_queued_tokens={self.max_queued_tokens} — it could "
-                "never be admitted")
         if (self.max_queued_tokens is not None
                 and self._queued_tokens + prompt.size
                 > self.max_queued_tokens):
@@ -844,7 +956,11 @@ class DecodeEngine:
         self.recorder.start(rid,
                             trace_id=None if ctx is None else ctx.trace_id,
                             prompt_tokens=int(prompt.size),
-                            max_new_tokens=int(max_new_tokens))
+                            max_new_tokens=int(max_new_tokens),
+                            **({"prefilled": True} if prefilled is not None
+                               else {}))
+        if prefilled is not None:
+            self._prefilled_kv[rid] = prefilled
         if deadline_ms is not None:
             self._deadline[rid] = self._clock() + deadline_ms / 1000.0
         self._queue.append((rid, prompt, int(max_new_tokens),
@@ -856,6 +972,79 @@ class DecodeEngine:
         if admit:
             self._admit()
         return rid
+
+    def export_prefill(self, prompt: Sequence[int],
+                       temperature: Optional[float] = None,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
+                       block_size: int = 64) -> Dict:
+        """Run this engine's prefix-aware prefill path for ``prompt``
+        and EXPORT the result instead of occupying a slot — the prefill
+        half of disaggregated serving. Rides exactly the machinery an
+        ordinary admission uses (``_prefill``/chunked ``decode_block``
+        extends, registered-prefix reuse, the engine's sampling rule for
+        the first token), so a shipped prefill is token-identical to a
+        colocated one.
+
+        Returns ``{"first_token", "kv_blocks", "block_size",
+        "prompt_tokens", "prefix_tokens", "prefill_s"}`` where
+        ``kv_blocks`` is the host-side block-unit KV export
+        (:func:`~elephas_tpu.models.paged_decode.export_kv_blocks`) a
+        decode worker feeds to :meth:`submit_prefilled` — directly, or
+        over the wire via :mod:`elephas_tpu.disagg`. Not supported in
+        speculative mode (no draft KV export)."""
+        from .models.paged_decode import export_kv_blocks
+
+        if self.draft_config is not None:
+            raise ValueError("export_prefill does not compose with "
+                             "speculative mode (no draft KV export)")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size >= self.max_len:
+            raise ValueError(f"prompt ({prompt.size}) must leave room "
+                             f"below max_len {self.max_len}")
+        validate_sampling_overrides(temperature, top_k, top_p)
+        temp = (self.temperature if temperature is None
+                else float(temperature))
+        topk = 0 if top_k is None else int(top_k)
+        topp = 1.0 if top_p is None else float(top_p)
+        start = time.monotonic()
+        entry = self._match_prefix(prompt)
+        if entry is not None:
+            self._m_prefix_hits.inc()
+            self._m_prefix_tokens.inc(int(entry[0].size))
+        logits, row = self._prefill_with_prefixes(
+            prompt, self._extend_fn, self._extend_owned_fn,
+            self._prefill_fn, self.params, entry, 2, self._fresh_row_fn)
+        t0 = self._sample_first(logits, temp, topk, topp)
+        blocks = export_kv_blocks(row, int(prompt.size), int(block_size))
+        return {"first_token": t0, "kv_blocks": blocks,
+                "block_size": int(block_size),
+                "prompt_tokens": int(prompt.size),
+                "prefix_tokens": (0 if entry is None
+                                  else int(entry[0].size)),
+                "prefill_s": round(time.monotonic() - start, 6)}
+
+    def would_shed(self, prompt_tokens: int) -> bool:
+        """Whether a submit of ``prompt_tokens`` would be shed RIGHT NOW
+        by the admission bounds (``max_queue`` / ``max_queued_tokens``)
+        — the same arithmetic :meth:`submit` applies, exposed so front
+        ends (the disaggregated install retry) can pre-check without
+        the shed bookkeeping a real rejected submit records (counter +
+        event per attempt). Keep in lockstep with ``_submit_impl``'s
+        bound checks."""
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            return True
+        return (self.max_queued_tokens is not None
+                and self._queued_tokens + int(prompt_tokens)
+                > self.max_queued_tokens)
+
+    def retry_after_ms(self) -> int:
+        """Public read of the shed-backoff hint a
+        :class:`QueueFullError` would carry right now."""
+        return self._retry_after_ms()
 
     def _retry_after_ms(self) -> int:
         """Backoff hint for a shed request: roughly how long until the
@@ -882,10 +1071,13 @@ class DecodeEngine:
                 self._submit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
                 self._trace_ctx.pop(rid, None)
+                self._prefilled_kv.pop(rid, None)
                 self.recorder.record(rid, "cancelled", stage="queued")
                 return True
         for slot, r in enumerate(self._rid):
-            if r == rid:
+            # the explicit None guard matters: a caller holding a
+            # None/absent id must not "cancel" a FREE slot (None == None)
+            if r is not None and r == rid:
                 tokens = len(self._outputs.get(rid, ()))
                 self._outputs.pop(rid, None)
                 self._fresh.pop(rid, None)
@@ -917,6 +1109,7 @@ class DecodeEngine:
             if dl is not None and now >= dl:
                 self._queued_tokens -= int(item[1].size)
                 self._deadline.pop(rid, None)
+                self._prefilled_kv.pop(rid, None)
                 t_sub = self._submit_t.pop(rid, None)
                 self._done[rid] = []
                 self._expired.add(rid)
@@ -980,50 +1173,22 @@ class DecodeEngine:
             # thread, but prefill (and any span/fault/event it emits)
             # belongs to the request whose context was captured at
             # submit — None for requests submitted without one
+            pre = self._prefilled_kv.pop(rid, None)
             with use_context(self._trace_ctx.get(rid)):
-                # exact-length prefill: one compile per distinct prompt
-                # length (an online server batches by length bucket
-                # upstream if compile churn matters); a registered-
-                # prefix hit reuses the prefix's cached k/v and
-                # prefills only the suffix
-                entry = self._match_prefix(prompt)
-                if entry is not None:
-                    self._m_prefix_hits.inc()
-                    self._m_prefix_tokens.inc(int(entry[0].size))
-                logits, row_cache = self._prefill_with_prefixes(
-                    prompt, self._extend_fn, self._extend_owned_fn,
-                    self._prefill_fn, self.params, entry, 2,
-                    self._fresh_row_fn)
-                if self.paged is not None:
-                    from .models.paged_decode import install_row_paged
-
-                    nprefill = -(-prompt.size // self.paged[1])
-                    self.pool = install_row_paged(
-                        self.pool, row_cache, self._tables[slot], nprefill)
+                if pre is not None:
+                    # disaggregated admission: the shipped KV blocks
+                    # install straight into the slot (between decode
+                    # steps — this loop IS the atomic point); no
+                    # prefill compute, no prefix lookup
+                    t0 = self._install_prefilled(slot, prompt, pre)
+                    self.recorder.record(
+                        rid, "kv_install",
+                        prompt_tokens=int(prompt.size),
+                        duration_s=round(
+                            time.monotonic() - self._admit_t[rid], 6))
                 else:
-                    self.cache = self._install_fn(self.cache, row_cache,
-                                                  slot)
-                if self.draft_config is not None:
-                    _, d_row = self._prefill_with_prefixes(
-                        prompt, self._extend_draft_fn,
-                        self._extend_draft_owned_fn,
-                        self._prefill_draft_fn, self.draft_params, entry,
-                        3, self._fresh_draft_row_fn)
-                    self.draft_cache = self._install_draft_fn(
-                        self.draft_cache, d_row, slot)
-                if temp > 0:
-                    self._key, sub = jax.random.split(self._key)
-                    filt = _filter_logits_rows(
-                        logits[None] / temp,
-                        jnp.asarray([topk], jnp.int32),
-                        jnp.asarray([topp], jnp.float32))[0]
-                    t0 = int(jax.random.categorical(sub, filt))
-                else:
-                    t0 = int(jnp.argmax(logits))
-            self.recorder.record(
-                rid, "prefill", prompt_tokens=int(prompt.size),
-                prefix_tokens=(0 if entry is None else int(entry[0].size)),
-                duration_s=round(time.monotonic() - self._admit_t[rid], 6))
+                    t0 = self._admit_prefill(rid, slot, prompt, temp,
+                                             topk, topp)
             self._rid[slot] = rid
             self._outputs[rid] = []
             self._pos[slot] = prompt.size - 1
@@ -1034,6 +1199,88 @@ class DecodeEngine:
             self._topp[slot] = topp
             if self._record(slot, t0):
                 self._fresh[rid] = t0    # surfaced by the next step()
+
+    def _admit_prefill(self, rid: int, slot: int, prompt: np.ndarray,
+                       temp: float, topk: int, topp: float) -> int:
+        """The colocated admission body: prefix-aware prefill on THIS
+        engine, slot install, first-token sample. Runs under the
+        request's restored trace context (the caller's ``use_context``)."""
+        # exact-length prefill: one compile per distinct prompt
+        # length (an online server batches by length bucket
+        # upstream if compile churn matters); a registered-
+        # prefix hit reuses the prefix's cached k/v and
+        # prefills only the suffix
+        entry = self._match_prefix(prompt)
+        if entry is not None:
+            self._m_prefix_hits.inc()
+            self._m_prefix_tokens.inc(int(entry[0].size))
+        logits, row_cache = self._prefill_with_prefixes(
+            prompt, self._extend_fn, self._extend_owned_fn,
+            self._prefill_fn, self.params, entry, 2,
+            self._fresh_row_fn)
+        if self.paged is not None:
+            from .models.paged_decode import install_row_paged
+
+            nprefill = -(-prompt.size // self.paged[1])
+            self.pool = install_row_paged(
+                self.pool, row_cache, self._tables[slot], nprefill)
+        else:
+            self.cache = self._install_fn(self.cache, row_cache,
+                                          slot)
+        if self.draft_config is not None:
+            _, d_row = self._prefill_with_prefixes(
+                prompt, self._extend_draft_fn,
+                self._extend_draft_owned_fn,
+                self._prefill_draft_fn, self.draft_params, entry,
+                3, self._fresh_draft_row_fn)
+            self.draft_cache = self._install_draft_fn(
+                self.draft_cache, d_row, slot)
+        t0 = self._sample_first(logits, temp, topk, topp)
+        self.recorder.record(
+            rid, "prefill", prompt_tokens=int(prompt.size),
+            prefix_tokens=(0 if entry is None else int(entry[0].size)),
+            duration_s=round(time.monotonic() - self._admit_t[rid], 6))
+        return t0
+
+    def _sample_first(self, logits, temp: float, topk: int,
+                      topp: float) -> int:
+        """Sample the admission-time first token from final-position
+        prefill logits ``(vocab,)`` — the host-side mirror of the step
+        fns' ``_sample_tok`` (same filter order: temperature scales,
+        then top-k/top-p on the scaled logits)."""
+        if temp > 0:
+            self._key, sub = jax.random.split(self._key)
+            filt = _filter_logits_rows(
+                logits[None] / temp,
+                jnp.asarray([topk], jnp.int32),
+                jnp.asarray([topp], jnp.float32))[0]
+            return int(jax.random.categorical(sub, filt))
+        return int(jnp.argmax(logits))
+
+    def _install_prefilled(self, slot: int, prompt: np.ndarray,
+                           pre: Tuple) -> int:
+        """Install shipped KV blocks into ``slot`` and return the
+        prefill worker's first token. The imported row is cast to the
+        cache dtype, so an fp32-wire transfer installs cleanly into a
+        bf16 decode cache."""
+        from .models.paged_decode import (import_kv_blocks,
+                                          install_row_paged)
+
+        blocks, t0 = pre
+        if isinstance(blocks, dict):
+            row_np = blocks        # prebuilt off-loop by the receiver
+        else:
+            row_np = import_kv_blocks(blocks, int(prompt.size),
+                                      self.max_len)
+        row = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, self.config.dtype), row_np)
+        if self.paged is not None:
+            nprefill = -(-prompt.size // self.paged[1])
+            self.pool = install_row_paged(self.pool, row,
+                                          self._tables[slot], nprefill)
+        else:
+            self.cache = self._install_fn(self.cache, row, slot)
+        return int(t0)
 
     def _record(self, slot: int, tok: int) -> bool:
         """Book one emitted token for the slot's request; retire the
@@ -1129,6 +1376,7 @@ class DecodeEngine:
         if self.paged is not None:
             out["blocks_total"] = self.paged[0] - 1
             out["blocks_free"] = len(self._free_block_ids)
+        out["tier"] = self.tier
         if self._latency_window:
             totals = [t for _, t in self._latency_window]
             waits = [w for w, _ in self._latency_window]
@@ -1137,6 +1385,14 @@ class DecodeEngine:
             out["latency_p99_s"] = round(float(np.quantile(totals, 0.99)),
                                          4)
             out["queue_wait_mean_s"] = round(sum(waits) / len(waits), 4)
+            # the tier-labeled headline, readable off /stats too: this
+            # engine's queue-wait distribution tail (tier="decode" on a
+            # disaggregated decode worker excludes prefill blocking;
+            # the prefill tier's wait rides DisaggEngine's stats)
+            out["queue_wait_p50_s"] = round(
+                float(np.quantile(waits, 0.5)), 6)
+            out["queue_wait_p99_s"] = round(
+                float(np.quantile(waits, 0.99)), 6)
         if self.draft_config is not None:
             proposed = self._since_init(self._m_proposed)
             out["draft_acceptance"] = (

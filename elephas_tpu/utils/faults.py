@@ -39,6 +39,14 @@ Instrumented sites (the stable names tests target):
                                  socket write (``drop`` = line lost on the
                                  wire, ``error`` = mid-stream client
                                  disconnect: the server aborts the request)
+``disagg.prefill``               each prefill-worker job before its prefill
+                                 runs (``delay`` = a slow prefill — the
+                                 burst scenario, ``error`` = a prefill
+                                 crash: the job retries on a sibling)
+``disagg.ship``                  each KV-frame ship attempt before the
+                                 socket write (``error`` = a mid-transfer
+                                 failure: the dispatcher re-queues the
+                                 prefill, never fails the client request)
 ================================ ==============================================
 
 With no plan installed :func:`fault_site` is a near-free attribute check.

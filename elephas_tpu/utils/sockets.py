@@ -35,6 +35,19 @@ LENGTH_BYTES = 8
 #: opcode introducing a traceparent frame on the PS socket protocol
 TRACE_OPCODE = b"T"
 
+#: opcode introducing a KV-transfer frame on the disaggregated-serving
+#: socket (prefill worker -> decode worker): ``b'K'`` + one
+#: length-prefixed ETPU frame of kind ``KIND_KV``/``KIND_KV_Q8``,
+#: acknowledged with :data:`KV_ACK` once the receiver has handed the
+#: frame to its import queue. Rides the same traceparent extension as
+#: the PS protocol — a ``b'T'`` frame ahead of the opcode applies the
+#: context to the one KV frame that follows.
+KV_OPCODE = b"K"
+#: 1-byte acknowledgement for a delivered KV frame (read via
+#: :func:`recv_exact`, so a peer dying mid-transfer raises instead of
+#: being misread as success)
+KV_ACK = b"\x01"
+
 
 def determine_master(port: int = 4000) -> str:
     """Determine ``host:port`` of the master/parameter server.
@@ -138,6 +151,19 @@ def receive_frame(sock: socket.socket, copy: bool = True):
 def receive(sock: socket.socket, copy: bool = True) -> List[np.ndarray]:
     """Receive one ETPU frame; returns just the array list."""
     return receive_frame(sock, copy=copy)[0]
+
+
+def send_kv_payload(sock: socket.socket, payload) -> None:
+    """Send one already-encoded KV frame (``encode_kv_frame``) as
+    ``KV_OPCODE`` + length-prefixed payload, then block for the
+    receiver's :data:`KV_ACK`. Raises :class:`ConnectionError` when the
+    peer vanishes mid-transfer or answers a wrong ack byte — the
+    shipper's retry signal."""
+    sock.sendall(KV_OPCODE)
+    send_payload(sock, payload)
+    ack = bytes(recv_exact(sock, 1))
+    if ack != KV_ACK:
+        raise ConnectionError(f"bad KV ack byte {ack!r}")
 
 
 def send_trace_context(sock: socket.socket, ctx: TraceContext) -> None:

@@ -9,8 +9,11 @@ the network and slow. This module replaces it with a typed tensor protocol:
     per tensor: u8 dtype-code | u8 ndim | u64[ndim] dims | raw little-endian bytes
 
 ``kind`` distinguishes payload semantics (plain weight list, delta list,
-scalar metadata). The codec round-trips a flat list of numpy arrays — the
-currency of the parameter-server layer — without executing any embedded code.
+scalar metadata, Q8-compressed deltas, and the disaggregated-serving KV
+frames — fp or Q8 — of :mod:`elephas_tpu.disagg.wire`). The codec
+round-trips a flat list of numpy arrays — the currency of the
+parameter-server layer and the KV-transfer wire — without executing any
+embedded code.
 
 A C++ implementation of the same format (``native/tensor_codec.cpp``) is used
 when built; this module is the canonical specification and pure-Python
@@ -34,6 +37,14 @@ KIND_SCALARS = 2
 #: int8-quantized delta: interleaved (int8 data, float32 scale) pairs —
 #: see :mod:`elephas_tpu.utils.delta_compression`
 KIND_DELTA_Q8 = 3
+#: KV-transfer frame (disaggregated prefill -> decode): one uint8 JSON
+#: metadata tensor followed by the per-layer paged KV block tensors —
+#: see :mod:`elephas_tpu.disagg.wire`
+KIND_KV = 4
+#: Q8 KV-transfer frame: metadata tensor followed by interleaved
+#: (int8 data, float32 scale) block pairs
+#: (:func:`elephas_tpu.models.quantization.quantize_kv_frames`)
+KIND_KV_Q8 = 5
 
 _DTYPE_CODES = {
     np.dtype("float32"): 0,

@@ -1,0 +1,104 @@
+"""Q8 KV quantization: round-trip bit layout and error bounds.
+
+The disaggregated-serving wire ships prompt KV state as interleaved
+(int8 data, float32 scale) frames; these tests pin the codec's
+guarantees — the documented elementwise error bound, exact zero
+preservation, 0-d/empty/non-contiguous handling, and the frame pairing
+contract — independent of any engine."""
+import numpy as np
+import pytest
+
+from elephas_tpu.models.quantization import (KV_Q8_EPS, dequantize_kv,
+                                             dequantize_kv_frames,
+                                             quantize_kv,
+                                             quantize_kv_frames)
+
+
+def test_round_trip_error_bound_holds_elementwise():
+    """The documented guarantee: |x - dq(q(x))| <= scale/2, with
+    scale = max(absmax, eps)/127 per last-axis vector."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(0.0, 3.0, (4, 6, 32, 8)).astype(np.float32)
+    q, scale = quantize_kv(x)
+    assert q.dtype == np.int8
+    assert scale.dtype == np.float32
+    assert scale.shape == (4, 6, 32, 1)
+    back = dequantize_kv(q, scale)
+    assert back.dtype == np.float32
+    assert np.all(np.abs(back - x) <= scale / 2 + 1e-12)
+    # and the bound is expressed in the data's own magnitude: per
+    # vector, error <= absmax / 254
+    absmax = np.max(np.abs(x), axis=-1, keepdims=True)
+    assert np.all(np.abs(back - x) <= absmax / 254.0 + 1e-12)
+
+
+def test_zeros_round_trip_exactly():
+    x = np.zeros((3, 5), np.float32)
+    q, scale = quantize_kv(x)
+    assert np.all(q == 0)
+    assert np.allclose(scale, KV_Q8_EPS / 127.0)
+    assert np.array_equal(dequantize_kv(q, scale), x)
+
+
+def test_extremes_hit_full_int8_range_without_clipping_error():
+    """+-absmax must map to +-127 exactly (symmetric quantization uses
+    the full range; nothing clips because |x| <= absmax)."""
+    x = np.array([[-2.0, 1.0, 2.0, 0.5]], np.float32)
+    q, scale = quantize_kv(x)
+    assert q.min() == -127 and q.max() == 127
+    back = dequantize_kv(q, scale)
+    assert np.all(np.abs(back - x) <= scale / 2 + 1e-12)
+
+
+def test_scalar_and_empty_tensors():
+    # 0-d: the tensor is its own vector
+    q, scale = quantize_kv(np.float32(1.5))
+    assert q.shape == () and scale.shape == (1,)
+    back = dequantize_kv(q, scale)
+    assert back.shape == ()
+    assert abs(float(back) - 1.5) <= float(scale[0]) / 2
+    # empty: shape survives, nothing to bound
+    q, scale = quantize_kv(np.empty((2, 0, 4), np.float32))
+    assert q.shape == (2, 0, 4)
+    assert dequantize_kv(q, scale).shape == (2, 0, 4)
+
+
+def test_non_contiguous_input_matches_contiguous():
+    """A strided block view (the natural shape of a KV row slice) must
+    quantize identically to its contiguous copy."""
+    rng = np.random.default_rng(1)
+    base = rng.normal(0.0, 1.0, (4, 16, 8)).astype(np.float32)
+    view = base[:, ::2]                    # non-contiguous stride
+    assert not view.flags["C_CONTIGUOUS"]
+    qv, sv = quantize_kv(view)
+    qc, sc = quantize_kv(np.ascontiguousarray(view))
+    assert np.array_equal(qv, qc)
+    assert np.array_equal(sv, sc)
+
+
+def test_frames_interleave_and_invert():
+    rng = np.random.default_rng(2)
+    arrays = [rng.normal(0, 2, (3, 4, 5)).astype(np.float32),
+              rng.normal(0, 0.1, (2, 8)).astype(np.float32)]
+    frames = quantize_kv_frames(arrays)
+    assert len(frames) == 4
+    assert frames[0].dtype == np.int8 and frames[1].dtype == np.float32
+    back = dequantize_kv_frames(frames)
+    for orig, rec in zip(arrays, back):
+        absmax = np.max(np.abs(orig), axis=-1, keepdims=True)
+        assert np.all(np.abs(rec - orig) <= absmax / 254.0 + 1e-12)
+
+
+def test_frames_reject_odd_length():
+    with pytest.raises(ValueError):
+        dequantize_kv_frames([np.zeros(3, np.int8)])
+
+
+def test_q8_halves_wire_bytes_vs_fp32():
+    """The Q8 trade: int8 data + one f32 scale per head_dim vector —
+    for head_dim 8 that is 1.5/4 = 0.375x the fp32 bytes, comfortably
+    under the <= 0.55x acceptance bar."""
+    x = np.random.default_rng(3).normal(0, 1, (6, 64, 8)).astype(np.float32)
+    q, scale = quantize_kv(x)
+    ratio = (q.nbytes + scale.nbytes) / x.nbytes
+    assert ratio <= 0.55, ratio

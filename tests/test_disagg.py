@@ -1,0 +1,453 @@
+"""Disaggregated prefill/decode: KV block export/install bit-exactness,
+with the multi-second chaos/topology tests marked ``slow`` (each
+builds fresh engines = fresh jit compiles; the tier-1 budget run
+filters ``-m "not slow"``, while CI shards and run_suite.sh run
+everything) —
+token parity with the colocated engine (fp wire) on contiguous AND
+paged decode workers, Q8 install error bounds, prefill-worker failure
+-> retried prefill with zero failed client requests (injected faults
+and a real mid-transfer kill), prefill-stage deadlines/cancel/admission
+bounds, the per-tier queue-wait metric split, and one trace id spanning
+client -> router -> prefill worker -> decode worker with the
+KV-transfer stage on the flight-recorder timeline."""
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.disagg import DisaggEngine, DisaggPool, PrefillWorker
+from elephas_tpu.fleet import FleetRouter
+from elephas_tpu.models.paged_decode import (export_kv_blocks,
+                                             import_kv_blocks)
+from elephas_tpu.models.transformer import (TransformerConfig, generate,
+                                            init_params)
+from elephas_tpu.serving_engine import DecodeEngine, QueueFullError
+from elephas_tpu.utils.faults import FaultPlan, clear_plan, install_plan
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                               d_model=32, d_ff=64, max_seq_len=48,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def _prompt(seed, n=10):
+    return [int(t) for t in
+            np.random.default_rng(seed).integers(0, 300, n)]
+
+
+def _ref(params, config, prompt, n):
+    return list(np.asarray(
+        generate(params, jnp.asarray(prompt)[None], n, config))[0])
+
+
+def _drain(deng, rids, timeout=60.0):
+    """Drive a DisaggEngine like the server's engine loop would and
+    collect every rid's outcome."""
+    outs = {}
+    deadline = time.monotonic() + timeout
+    while len(outs) < len(rids) and time.monotonic() < deadline:
+        if deng.pending:
+            deng.step()
+        for rid in rids:
+            if rid not in outs:
+                info = deng.result_info(rid)
+                if info is not None:
+                    outs[rid] = info
+        time.sleep(0.002)
+    assert len(outs) == len(rids), f"drained {len(outs)}/{len(rids)}"
+    return outs
+
+
+def _disagg(params, config, n_workers=1, quant=False, paged=None,
+            max_queue=None, worker_kwargs=None):
+    workers = [PrefillWorker(DecodeEngine(params, config, max_slots=1),
+                             quant=quant, block_size=8,
+                             name=f"prefill-{i}",
+                             **(worker_kwargs or {})).start()
+               for i in range(n_workers)]
+    decode = DecodeEngine(params, config, max_slots=2, tier="decode",
+                          paged=paged)
+    return DisaggEngine(decode, workers, max_queue=max_queue), workers
+
+
+def _teardown(deng, workers):
+    deng.stop()
+    for w in workers:
+        if w.alive:
+            w.stop()
+
+
+# ------------------------------------------------------ block transfer
+
+def test_kv_block_export_import_bit_exact():
+    """export -> import is bit-exact over the covered positions and
+    zero past them, for a length that does NOT divide the block size
+    (the padded-tail path)."""
+    rng = np.random.default_rng(0)
+    row = {f"layer_{i}": {
+        "k": rng.normal(0, 1, (1, 4, 20, 8)).astype(np.float32),
+        "v": rng.normal(0, 1, (1, 4, 20, 8)).astype(np.float32)}
+        for i in range(2)}
+    length, bs, max_len = 13, 8, 20
+    blocks = export_kv_blocks(row, length, bs)
+    assert len(blocks) == 4 and blocks[0].shape == (2, 4, 8, 8)
+    back = import_kv_blocks(blocks, length, max_len)
+    for i in range(2):
+        for part in ("k", "v"):
+            orig = row[f"layer_{i}"][part]
+            rec = back[f"layer_{i}"][part]
+            assert rec.shape == orig.shape
+            assert np.array_equal(rec[0, :, :length], orig[0, :, :length])
+            assert np.all(rec[0, :, length:] == 0)
+    with pytest.raises(ValueError):
+        import_kv_blocks(blocks, 40, max_len)   # blocks cannot cover
+    with pytest.raises(ValueError):
+        export_kv_blocks(row, 25, bs)           # row too short
+
+
+@pytest.mark.slow
+def test_disagg_matches_colocated_fp_contiguous_and_paged(model):
+    """Token-identical to the colocated engine over the fp wire — the
+    shipped-prefill path changes WHERE prefill runs, never what it
+    computes — on both decode-cache layouts."""
+    params, config = model
+    prompts = [_prompt(i, 10) for i in range(4)]
+    oracle = [_ref(params, config, p, 6) for p in prompts]
+    for paged in (None, (9, 8)):
+        deng, workers = _disagg(params, config, quant=False, paged=paged)
+        try:
+            rids = [deng.submit(p, 6) for p in prompts]
+            outs = _drain(deng, rids)
+            for rid, want in zip(rids, oracle):
+                assert outs[rid]["tokens"] == want, (paged, rid)
+        finally:
+            _teardown(deng, workers)
+
+
+def test_q8_install_honors_error_bound(model):
+    """Q8 wire: the KV actually installed in the decode cache matches
+    the prefill worker's row within the quantizer's documented bound
+    (absmax/254 per head_dim vector)."""
+    params, config = model
+    prompt = _prompt(5, 11)
+    pre = DecodeEngine(params, config, max_slots=1)
+    out = pre.export_prefill(prompt, block_size=8)
+    from elephas_tpu.models.quantization import (dequantize_kv_frames,
+                                                 quantize_kv_frames)
+
+    wired = dequantize_kv_frames(quantize_kv_frames(out["kv_blocks"]))
+    dec = DecodeEngine(params, config, max_slots=1, tier="decode")
+    rid = dec.submit_prefilled(prompt, 2, wired, out["first_token"])
+    dec.step()
+    L = len(prompt)
+    for i, (k_blocks, v_blocks) in enumerate(
+            zip(out["kv_blocks"][0::2], out["kv_blocks"][1::2])):
+        for part, blocks in (("k", k_blocks), ("v", v_blocks)):
+            nb, h, bs, d = blocks.shape
+            want = blocks.swapaxes(0, 1).reshape(h, nb * bs, d)[:, :L]
+            got = np.asarray(
+                dec.cache[f"layer_{i}"][part])[0, :, :L]
+            bound = np.max(np.abs(want), axis=-1, keepdims=True) / 254.0
+            assert np.all(np.abs(got - want) <= bound + 1e-6), (i, part)
+    while dec.pending:
+        dec.step()
+    assert len(dec.result(rid)) == 2
+
+
+@pytest.mark.slow
+def test_prefix_cache_aware_prefill_worker(model):
+    """A prefix registered on the prefill engine is reused by
+    export_prefill (the existing prefix-cache path), and the shipped
+    result still decodes token-identically."""
+    params, config = model
+    prefix = _prompt(9, 8)
+    prompt = prefix + _prompt(10, 4)
+    oracle = _ref(params, config, prompt, 5)
+    pre_engine = DecodeEngine(params, config, max_slots=1)
+    pre_engine.register_prefix(prefix)
+    workers = [PrefillWorker(pre_engine, quant=False,
+                             block_size=8).start()]
+    decode = DecodeEngine(params, config, max_slots=2, tier="decode")
+    deng = DisaggEngine(decode, workers)
+    try:
+        rid = deng.submit(prompt, 5)
+        outs = _drain(deng, [rid])
+        assert outs[rid]["tokens"] == oracle
+        assert pre_engine.stats.get("prefix_hits") == 1
+    finally:
+        _teardown(deng, workers)
+
+
+# ------------------------------------------------------- failure paths
+
+@pytest.mark.slow
+def test_injected_ship_failure_retries_on_sibling(model):
+    """A deterministic mid-transfer failure (FaultPlan error at
+    disagg.ship) re-queues the prefill; the client request succeeds."""
+    params, config = model
+    deng, workers = _disagg(params, config, n_workers=2, quant=False)
+    install_plan(FaultPlan([{"site": "disagg.ship", "action": "error",
+                             "after": 0, "times": 1}]))
+    try:
+        prompt = _prompt(3, 10)
+        rid = deng.submit(prompt, 4)
+        outs = _drain(deng, [rid])
+        assert outs[rid]["tokens"] == _ref(params, config, prompt, 4)
+        assert int(deng._m_retries.value) == 1
+        tr = deng.request_trace(rid)
+        events = [e["event"] for e in tr["events"]]
+        assert "prefill_retry" in events
+        assert events.count("kv_transfer") == 1
+    finally:
+        _teardown(deng, workers)
+
+
+@pytest.mark.slow
+def test_prefill_worker_kill_mid_job_never_fails_a_request(model):
+    """The acceptance scenario: kill a prefill worker while jobs are in
+    flight (slow prefills guarantee it dies mid-work) — every request
+    still completes, via retries on the surviving worker."""
+    params, config = model
+    deng, workers = _disagg(params, config, n_workers=2, quant=False)
+    install_plan(FaultPlan([{"site": "disagg.prefill", "action": "delay",
+                             "delay": 0.15, "times": None}]))
+    try:
+        prompts = [_prompt(20 + i, 10) for i in range(4)]
+        rids = [deng.submit(p, 4) for p in prompts]
+        time.sleep(0.05)          # let worker 0 get mid-prefill
+        workers[0].kill()
+        outs = _drain(deng, rids)
+        for rid, p in zip(rids, prompts):
+            assert outs[rid]["tokens"] == _ref(params, config, p, 4)
+        assert not outs[rids[0]].get("expired")
+        assert int(deng._m_retries.value) >= 1
+        assert deng.stats["prefill_tier"]["workers_alive"] == 1
+    finally:
+        _teardown(deng, workers)
+
+
+@pytest.mark.slow
+def test_retry_budget_terminates_systemic_failure(model):
+    """A job that fails on EVERY attempt (the receiver is effectively
+    unreachable) must terminate after MAX_PREFILL_RETRIES with an
+    expired outcome — never spin a core recomputing the same prefill
+    forever."""
+    params, config = model
+    deng, workers = _disagg(params, config, n_workers=2, quant=False)
+    install_plan(FaultPlan([{"site": "disagg.ship", "action": "error",
+                             "after": 0, "times": None}]))
+    try:
+        rid = deng.submit(_prompt(50, 9), 3)
+        outs = _drain(deng, [rid], timeout=30)
+        assert outs[rid]["expired"] and outs[rid]["tokens"] == []
+        assert "error" in outs[rid]
+        assert (int(deng._m_retries.value)
+                == DisaggEngine.MAX_PREFILL_RETRIES)
+    finally:
+        _teardown(deng, workers)
+
+
+@pytest.mark.slow
+def test_all_workers_dead_parks_then_recovers(model):
+    """With NO live prefill worker, requests park (never fail); a
+    fresh worker joining the tier drains the parked backlog."""
+    params, config = model
+    deng, workers = _disagg(params, config, n_workers=1, quant=False)
+    try:
+        workers[0].kill()
+        prompt = _prompt(7, 9)
+        rid = deng.submit(prompt, 3)
+        for _ in range(5):
+            if deng.pending:
+                deng.step()       # dispatch parks: no live worker
+            time.sleep(0.01)
+        assert deng.result_info(rid) is None      # parked, not failed
+        fresh = PrefillWorker(DecodeEngine(params, config, max_slots=1),
+                              quant=False, block_size=8,
+                              name="prefill-revived").start()
+        deng.workers.append(fresh)
+        workers.append(fresh)
+        outs = _drain(deng, [rid])
+        assert outs[rid]["tokens"] == _ref(params, config, prompt, 3)
+    finally:
+        _teardown(deng, workers)
+
+
+def test_prefill_stage_deadline_and_cancel(model):
+    params, config = model
+    deng, workers = _disagg(params, config, quant=False)
+    install_plan(FaultPlan([{"site": "disagg.prefill", "action": "delay",
+                             "delay": 0.3, "times": None}]))
+    try:
+        # deadline passes while the request is still in the prefill
+        # stage -> expired result, no decode work ever happens
+        rid = deng.submit(_prompt(11, 9), 4, deadline_ms=30)
+        outs = _drain(deng, [rid], timeout=20)
+        assert outs[rid]["expired"] and outs[rid]["timeout"]
+        assert outs[rid]["tokens"] == []
+        # cancel of a TERMINAL prefill-stage result (expired, unfetched)
+        # is False and drops the parked result — it must never reach
+        # decode.cancel(None), which would falsely match a free slot
+        rid_exp = deng.submit(_prompt(15, 9), 4, deadline_ms=30)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if deng.pending:
+                deng.step()
+            with deng._lock:
+                if deng._stage.get(rid_exp, {}).get("state") == "done":
+                    break
+            time.sleep(0.01)
+        assert deng.cancel(rid_exp) is False
+        assert deng.result_info(rid_exp) is None   # result dropped too
+        # cancel while in the prefill stage: the late KV frame drops,
+        # nothing decodes
+        rid2 = deng.submit(_prompt(12, 9), 4)
+        assert deng.cancel(rid2) is True
+        assert deng.cancel(rid2) is False
+        time.sleep(0.6)           # let the orphaned frame arrive
+        while deng.pending:
+            deng.step()
+        assert deng.decode.stats["requests_finished"] == 0
+    finally:
+        _teardown(deng, workers)
+
+
+def test_submit_mirrors_decode_inadmissibility(model):
+    """Permanently-inadmissible requests 400 AT SUBMIT on the disagg
+    front end (paged-pool capacity, max_queued_tokens) — an error that
+    only surfaced at KV-install time would raise inside the server's
+    engine loop and read as engine death."""
+    params, config = model
+    deng, workers = _disagg(params, config, paged=(5, 8))
+    try:
+        with pytest.raises(ValueError, match="could never be admitted"):
+            deng.submit(_prompt(61, 10), 38)   # needs 6 of 4 blocks
+    finally:
+        _teardown(deng, workers)
+    decode = DecodeEngine(params, config, max_slots=2, tier="decode",
+                          max_queue=4, max_queued_tokens=16)
+    workers2 = [PrefillWorker(DecodeEngine(params, config, max_slots=1),
+                              quant=False, block_size=8).start()]
+    deng2 = DisaggEngine(decode, workers2)
+    try:
+        with pytest.raises(ValueError, match="could never be admitted"):
+            deng2.submit(_prompt(62, 20), 4)   # prompt > max_queued_tokens
+    finally:
+        _teardown(deng2, workers2)
+
+
+@pytest.mark.slow
+def test_disagg_admission_bound_sheds(model):
+    params, config = model
+    deng, workers = _disagg(params, config, quant=False, max_queue=1)
+    install_plan(FaultPlan([{"site": "disagg.prefill", "action": "delay",
+                             "delay": 0.3, "times": None}]))
+    try:
+        rid = deng.submit(_prompt(13, 9), 3)
+        with pytest.raises(QueueFullError) as exc:
+            deng.submit(_prompt(14, 9), 3)
+        assert exc.value.retry_after_ms >= 50
+        outs = _drain(deng, [rid])
+        assert len(outs[rid]["tokens"]) == 3
+    finally:
+        _teardown(deng, workers)
+
+
+# ------------------------------------------------------- observability
+
+@pytest.mark.slow
+def test_queue_wait_metrics_split_by_tier(model):
+    """The per-stage observability split: the decode engine's queue
+    wait renders under tier="decode", the prefill worker's under
+    tier="prefill", and /stats surfaces both tiers' percentiles."""
+    params, config = model
+    deng, workers = _disagg(params, config, quant=False)
+    try:
+        rids = [deng.submit(_prompt(30 + i, 8), 3) for i in range(3)]
+        _drain(deng, rids)
+        decode_text = deng.decode.registry.render()
+        assert ('serving_queue_wait_seconds_count{tier="decode"}'
+                in decode_text)
+        worker_text = workers[0].registry.render()
+        assert ('serving_queue_wait_seconds_count{tier="prefill"}'
+                in worker_text)
+        st = deng.stats
+        assert st["tier"] == "disagg"
+        assert "queue_wait_p99_s" in st                  # decode tier
+        assert "queue_wait_p99_s" in st["prefill_tier"]  # prefill tier
+        assert st["kv_wire"]["frames"].get("fp") == 3
+        assert st["kv_wire"]["bytes"]["fp"] > 0
+    finally:
+        _teardown(deng, workers)
+
+
+# ----------------------------------------------- full-topology tracing
+
+@pytest.mark.slow
+def test_trace_spans_client_router_prefill_decode(model):
+    """One trace id from the CLIENT's traceparent through the fleet
+    router, the prefill worker's ship, and the decode worker — with the
+    KV-transfer stage visible on the flight-recorder timeline the
+    router serves."""
+    params, config = model
+    pool = DisaggPool(
+        lambda: DecodeEngine(params, config, max_slots=2, tier="decode"),
+        n_prefill=1, n_decode=1,
+        prefill_factory=lambda: DecodeEngine(params, config, max_slots=1),
+        quant=True, block_size=8).start()
+    try:
+        with FleetRouter(pool.urls, probe_interval=0.3,
+                         spill_threshold=None) as router:
+            trace_id = "ab" * 16
+            traceparent = f"00-{trace_id}-{'cd' * 8}-01"
+            sub = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/v1/submit",
+                data=json.dumps({"prompt": _prompt(40, 10),
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": traceparent})
+            with urllib.request.urlopen(sub, timeout=60) as resp:
+                fid = json.loads(resp.read())["id"]
+                assert resp.headers.get("X-Trace-Id") == trace_id
+            deadline = time.monotonic() + 60
+            status = None
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{router.port}/v1/result"
+                        f"?id={fid}", timeout=60) as resp:
+                    body = json.loads(resp.read())
+                if body.get("status") == "done":
+                    status = body
+                    break
+                time.sleep(0.02)
+            assert status is not None and len(status["tokens"]) == 4
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}"
+                    f"/v1/requests/{fid}/trace", timeout=60) as resp:
+                timeline = json.loads(resp.read())
+            assert timeline["trace_id"] == trace_id
+            events = [e["event"] for e in timeline["events"]]
+            assert "prefill_dispatched" in events
+            assert "kv_transfer" in events        # the transfer stage
+            assert "decode_submitted" in events
+            assert "finished" in events           # decode-side, merged
+            assert all(e["trace_id"] == trace_id
+                       for e in timeline["events"])
+    finally:
+        pool.stop()

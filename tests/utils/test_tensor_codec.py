@@ -157,3 +157,94 @@ def test_alloc_frame_contract_buffers_are_fully_written():
     a = bytes(tensor_codec.encode_tensors(arrays))
     b = bytes(tensor_codec.encode_tensors(arrays))
     assert a == b
+
+
+# --------------------------------------------------- KV-transfer frames
+
+def test_kv_frame_round_trip_copy_false_views():
+    """The disagg receive path: encode_kv_frame -> decode(copy=False)
+    -> the fp tensors VIEW the payload buffer (zero-copy all the way to
+    the decode engine's install)."""
+    from elephas_tpu.disagg.wire import decode_kv_frame, encode_kv_frame
+
+    rng = np.random.default_rng(0)
+    blocks = [rng.normal(0, 1, (2, 4, 8, 8)).astype(np.float32)
+              for _ in range(4)]
+    meta = {"rid": 7, "first_token": 42, "prompt": [1, 2, 3]}
+    payload = encode_kv_frame(meta, blocks, quant=False)
+    raw = np.frombuffer(memoryview(payload), dtype=np.uint8)
+    got_meta, got = decode_kv_frame(payload, copy=False)
+    assert got_meta == meta
+    assert len(got) == len(blocks)
+    for orig, back in zip(blocks, got):
+        assert np.shares_memory(back, raw), "fp KV decode must be a view"
+        assert np.array_equal(back, orig)
+
+
+def test_kv_frame_q8_bit_layout_and_error_bound():
+    """quantize -> frame-encode -> decode(copy=False) -> dequantize:
+    the int8 data and f32 scales survive the wire BIT-EXACTLY (pinned
+    against a direct quantize pass), and the decoded output honors the
+    quantizer's documented error bound."""
+    from elephas_tpu.disagg.wire import decode_kv_frame, encode_kv_frame
+    from elephas_tpu.models.quantization import quantize_kv
+
+    rng = np.random.default_rng(1)
+    blocks = [rng.normal(0, 2, (3, 4, 8, 8)).astype(np.float32)
+              for _ in range(2)]
+    payload = encode_kv_frame({"rid": 0}, blocks, quant=True)
+    # bit layout: the raw frame holds the exact interleaved
+    # (int8, float32) pairs a direct quantization produces
+    arrays, kind = tensor_codec.decode(bytes(payload))
+    assert kind == tensor_codec.KIND_KV_Q8
+    body = arrays[1:]
+    assert len(body) == 2 * len(blocks)
+    for i, orig in enumerate(blocks):
+        q, s = quantize_kv(orig)
+        assert body[2 * i].dtype == np.int8
+        assert np.array_equal(body[2 * i], q)
+        assert body[2 * i + 1].dtype == np.float32
+        assert np.array_equal(body[2 * i + 1], s)
+    # and the decode helper dequantizes within the bound
+    _, back = decode_kv_frame(payload, copy=False)
+    for orig, rec in zip(blocks, back):
+        absmax = np.max(np.abs(orig), axis=-1, keepdims=True)
+        assert np.all(np.abs(rec - orig) <= absmax / 254.0 + 1e-12)
+
+
+def test_kv_frame_q8_wire_bytes_ratio():
+    """Q8 frames measure well under the 0.55x fp32 wire-bytes bar (the
+    acceptance criterion's codec half, engine-free)."""
+    from elephas_tpu.disagg.wire import encode_kv_frame
+
+    rng = np.random.default_rng(2)
+    blocks = [rng.normal(0, 1, (4, 4, 16, 8)).astype(np.float32)
+              for _ in range(6)]
+    fp = len(encode_kv_frame({"rid": 1}, blocks, quant=False))
+    q8 = len(encode_kv_frame({"rid": 1}, blocks, quant=True))
+    assert q8 / fp <= 0.55, q8 / fp
+
+
+def test_kv_frame_edge_tensors_and_errors():
+    from elephas_tpu.disagg.wire import decode_kv_frame, encode_kv_frame
+
+    # 0-d / empty / non-contiguous bodies survive the frame round trip
+    base = np.random.default_rng(3).normal(
+        0, 1, (2, 8, 4)).astype(np.float32)
+    arrays = [np.float32(2.5), np.empty((2, 0, 4), np.float32),
+              base[:, ::2]]
+    meta, back = decode_kv_frame(
+        encode_kv_frame({"rid": 2}, arrays, quant=True), copy=False)
+    assert meta == {"rid": 2}
+    assert back[0].shape == () and abs(float(back[0]) - 2.5) < 0.02
+    assert back[1].shape == (2, 0, 4)
+    assert np.all(np.abs(back[2] - base[:, ::2])
+                  <= np.max(np.abs(base[:, ::2]), axis=-1,
+                            keepdims=True) / 254.0 + 1e-12)
+    # a non-KV kind is rejected
+    with pytest.raises(tensor_codec.CodecError):
+        decode_kv_frame(tensor_codec.encode_weights(
+            [np.ones(3, np.float32)]))
+    # a KV frame missing its metadata tensor is rejected
+    with pytest.raises(tensor_codec.CodecError):
+        decode_kv_frame(tensor_codec.encode([], tensor_codec.KIND_KV))
