@@ -112,6 +112,8 @@ PAGES = [
     ("Disaggregated serving API", "elephas_tpu.disagg",
      ["DisaggEngine", "DisaggPool", "PrefillWorker", "PrefillJob",
       "KVReceiver", "KVShipper", "encode_kv_frame", "decode_kv_frame"]),
+    ("Live weight plane API", "elephas_tpu.weightsync",
+     ["WeightSubscriber", "CanaryController"]),
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged",
@@ -210,6 +212,7 @@ def main(out_dir: str = None):
               "  - Serving operations: serving-operations.md",
               "  - Serving fleet: serving-fleet.md",
               "  - Disaggregated serving: disaggregated-serving.md",
+              "  - Live weights: live-weights.md",
               "  - Fault tolerance: fault-tolerance.md",
               "  - Observability: observability.md",
               "  - Distributed tracing: tracing.md"]

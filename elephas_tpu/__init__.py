@@ -30,3 +30,4 @@ from .serving_engine import (DeadlineExceededError, DecodeEngine,
 from .serving_http import ServingServer
 from .ssm_engine import SSMEngine
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model
+from .weightsync import CanaryController, WeightSubscriber

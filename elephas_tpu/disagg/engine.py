@@ -88,6 +88,10 @@ class DisaggEngine:
         self._trace_drid: "OrderedDict[int, int]" = OrderedDict()
         self._imports: deque = deque()   # (meta, arrays, nbytes)
         self._parked: deque = deque()    # jobs with no live worker
+        # (job, not_before) — version-mismatch rejections waiting out
+        # the rollout window before re-dispatching (see the STALE_KV_*
+        # constants at the gate)
+        self._stale_retry: deque = deque()
         self._results: Dict[int, Dict] = {}   # disagg-terminal outcomes
         self._m_requests = reg.counter(
             "disagg_requests_total",
@@ -206,6 +210,18 @@ class DisaggEngine:
     #: of recomputing the same prefill in a hot loop forever
     MAX_PREFILL_RETRIES = 8
 
+    #: spacing for version-mismatch KV re-dispatches: the rollout
+    #: window where the prefill tier lags the decode tier heals on the
+    #: prefill subscribers' poll cadence (default 0.25 s), so retrying
+    #: hotter than this only burns prefill compute and wire bytes on
+    #: frames guaranteed to bounce
+    STALE_KV_RETRY_S = 0.05
+    #: spaced mismatch retries before a job falls through to the
+    #: systemic :data:`MAX_PREFILL_RETRIES` path (>= 10 s of rollout
+    #: window at the default spacing) — a prefill tier that never
+    #: converges is a dead subscriber, not a rollout
+    MAX_STALE_KV_RETRIES = 200
+
     def _job_failed(self, job: PrefillJob, worker: str, error: str):
         """A worker failed a job (its own thread calls this): re-queue
         on a sibling — the client request is retried, never failed —
@@ -282,6 +298,9 @@ class DisaggEngine:
             if any_alive:
                 n += len(self._parked)
             now = self._clock()
+            # stale-KV re-dispatches count only once DUE — while they
+            # wait out their delay the loop idles instead of spinning
+            n += sum(1 for _, at in self._stale_retry if now >= at)
             n += sum(1 for st in self._stage.values()
                      if st["state"] == "queued"
                      and st["deadline"] is not None
@@ -293,7 +312,13 @@ class DisaggEngine:
         decode steps — the atomic point), retry parked jobs, enforce
         prefill-stage deadlines, then advance the decode batch. Returns
         ``{rid: [tokens]}`` keyed by THIS engine's request ids."""
+        # apply any staged live-weight swap BEFORE gating frames: the
+        # version gate below must compare against the version this
+        # step's installs will actually decode under, not one a
+        # decode.step()-internal swap is about to replace
+        self.decode.apply_staged_params()
         self._sweep_deadlines()
+        self._retry_stale()
         self._retry_parked()
         self._install_imports()
         emitted = self.decode.step() if self.decode.pending else {}
@@ -328,6 +353,26 @@ class DisaggEngine:
 
     def _drop_parked_locked(self, rid: int) -> None:
         self._parked = deque(j for j in self._parked if j.rid != rid)
+        self._stale_retry = deque((j, t) for j, t in self._stale_retry
+                                  if j.rid != rid)
+
+    def _retry_stale(self):
+        """Re-dispatch version-mismatch rejections whose delay elapsed
+        (their jobs recompute the prefill — under the worker's by-then
+        hopefully-swapped weights)."""
+        now = self._clock()
+        due: List = []
+        with self._lock:
+            keep: deque = deque()
+            for job, at in self._stale_retry:
+                if now >= at:
+                    due.append(job)
+                else:
+                    keep.append((job, at))
+            self._stale_retry = keep
+        for job in due:
+            if not job.abandoned:
+                self._dispatch(job)
 
     def _retry_parked(self):
         with self._lock:
@@ -347,6 +392,47 @@ class DisaggEngine:
                 if st is None or st["state"] != "imported":
                     continue      # cancelled while in the import queue
                 job = st["job"]
+            # live-weight version gate: KV computed under one weight
+            # version must not install into a decode batch running
+            # another — decoding would be silently WRONG output, not an
+            # error. A mismatch is NORMAL for the length of a rollout
+            # (decode and prefill tiers' subscribers poll
+            # independently), so rejected frames re-dispatch on a
+            # DELAYED schedule with their own generous budget instead
+            # of burning the systemic MAX_PREFILL_RETRIES in a hot
+            # recompute/reject loop — only a tier that never converges
+            # (a dead subscriber) falls through to the systemic path
+            # and terminates the request.
+            wire_v = meta.get("weights_version")
+            engine_v = int(self.decode.weights_version)
+            if wire_v is not None and int(wire_v) != engine_v:
+                delayed = False
+                with self._lock:
+                    st2 = self._stage.get(rid)
+                    if st2 is None or st2["state"] != "imported":
+                        continue
+                    st2["state"] = "queued"   # back to the prefill stage
+                    st2["stale_retries"] = st2.get("stale_retries", 0) + 1
+                    if (job is not None and st2["stale_retries"]
+                            <= self.MAX_STALE_KV_RETRIES):
+                        self._stale_retry.append(
+                            (job, self._clock() + self.STALE_KV_RETRY_S))
+                        delayed = True
+                emit_event("disagg.kv_version_mismatch", rid=rid,
+                           frame_version=int(wire_v),
+                           engine_version=engine_v,
+                           worker=meta.get("worker"))
+                self.recorder.record(rid, "kv_rejected",
+                                     reason="weights_version_mismatch",
+                                     frame_version=int(wire_v),
+                                     engine_version=engine_v)
+                if not delayed and job is not None:
+                    self._job_failed(
+                        job, str(meta.get("worker", "?")),
+                        f"KV weights_version {wire_v} != decode engine "
+                        f"version {engine_v} after "
+                        f"{self.MAX_STALE_KV_RETRIES} spaced retries")
+                continue
             deadline = meta.get("deadline")
             remaining_ms = None
             if deadline is not None:
@@ -376,12 +462,19 @@ class DisaggEngine:
 
             try:
                 with use_context(None if job is None else job.ctx):
+                    # the version stamp rides through: the engine
+                    # re-gates at the actual install (a swap staged
+                    # between OUR gate above and that install falls
+                    # back to a local prefill instead of decoding over
+                    # mismatched KV)
                     drid = self.decode.submit_prefilled(
                         meta["prompt"], int(meta["max_new_tokens"]),
                         arrays, int(meta["first_token"]),
                         temperature=meta.get("temperature"),
                         top_k=meta.get("top_k"), top_p=meta.get("top_p"),
-                        admit=False, deadline_ms=remaining_ms)
+                        admit=False, deadline_ms=remaining_ms,
+                        weights_version=(None if wire_v is None
+                                         else int(wire_v)))
             except QueueFullError:
                 # the decode engine's own admission bound (or an
                 # injected serving.submit shed): TRANSIENT — put this
@@ -495,6 +588,36 @@ class DisaggEngine:
         # client's next poll still collects it, matching the engine's
         # cancel-after-completion contract
         return cancelled
+
+    # -------------------------------------------------------- live weights
+    @property
+    def params(self):
+        """The DECODE engine's live parameter pytree (what a
+        :class:`~elephas_tpu.weightsync.WeightSubscriber`'s default
+        converter derives its tree structure and dtypes from)."""
+        return self.decode.params
+
+    @property
+    def weights_version(self) -> int:
+        """The DECODE engine's live weight version (what `/stats` and
+        the version gate on incoming KV frames read). The prefill
+        tier's engines version independently — subscribe each worker's
+        engine alongside this one and the KV version gate + retry path
+        absorb the rollout window where they briefly differ."""
+        return int(self.decode.weights_version)
+
+    def stage_params(self, params, version: int, trace_id=None) -> None:
+        """Stage new params for the decode engine (swap applied by the
+        engine loop between decode steps, exactly as on a colocated
+        engine). NOTE: this updates the decode half only — roll the
+        prefill workers' engines through their own subscribers."""
+        self.decode.stage_params(params, version, trace_id=trace_id)
+
+    def apply_staged_params(self):
+        """Delegates to the decode engine (the engine loop's step()
+        already applies staged swaps; this exists so loop-less drivers
+        can force one, mirroring DecodeEngine's surface)."""
+        return self.decode.apply_staged_params()
 
     # ---------------------------------------------------------------- misc
     def register_prefix(self, tokens) -> None:

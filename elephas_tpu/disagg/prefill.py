@@ -264,6 +264,14 @@ class PrefillWorker:
             # already terminated the request (or its deadline sweep
             # will), so no fail-back either.
             return
+        # live weight plane: a staged swap lands between jobs — the
+        # prefill engine never runs step(), so THIS is its atomic
+        # point. Applying before the export (not after) means the KV
+        # shipped for this job is computed — and version-stamped —
+        # under the newest staged weights.
+        apply_staged = getattr(self.engine, "apply_staged_params", None)
+        if apply_staged is not None:
+            apply_staged()
         wait = time.monotonic() - job.enqueued_t
         self._m_queue_wait.observe(wait)
         with self._cond:
@@ -287,6 +295,10 @@ class PrefillWorker:
                 "prompt_tokens": out["prompt_tokens"],
                 "prefix_tokens": out["prefix_tokens"],
                 "prefill_s": out["prefill_s"],
+                # the weight version this KV was computed under — the
+                # decode side rejects (and the dispatcher retries) a
+                # frame whose stamp mismatches its live version
+                "weights_version": out.get("weights_version", 0),
                 "queue_wait_s": round(wait, 6),
                 "worker": self.name,
                 "codec": "q8" if self.quant else "fp",

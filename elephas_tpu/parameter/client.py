@@ -7,6 +7,7 @@ probes — the reference has no failure detection at all, SURVEY.md §5.)
 """
 import abc
 import socket
+import struct
 import threading
 import time
 import urllib.error
@@ -160,6 +161,24 @@ class BaseParameterClient(abc.ABC):
     def get_parameters(self) -> List[np.ndarray]:
         """Retrieve the current master weights."""
 
+    def get_version(self) -> int:
+        """The server's weight version — the cheap "changed since v?"
+        poll (no weight payload). Subscribers compare for INEQUALITY:
+        the counter moves on every delta/restore but is not monotonic
+        across a restart-from-snapshot. Transports without the
+        extension raise ``NotImplementedError``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement get_version")
+
+    def get_parameters_versioned(self):
+        """``(version, weights)`` read as one consistent pair — the
+        live-weight subscriber's download path (the version stamps the
+        pulled params so serving replicas, canary decisions, and KV
+        frames all name the same thing)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement "
+            "get_parameters_versioned")
+
     @abc.abstractmethod
     def health_check(self) -> bool:
         """True when the server answers its liveness probe."""
@@ -214,6 +233,32 @@ class HttpClient(BaseParameterClient):
             with urllib.request.urlopen(request,
                                         timeout=self.timeout) as response:
                 return decode_weights(response.read())
+        return self._with_retry(op, "get_parameters")
+
+    def get_version(self) -> int:
+        def op():
+            request = urllib.request.Request(
+                f"http://{self.master_url}/version",
+                headers=self._headers())
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                import json
+
+                return int(json.loads(response.read())["version"])
+        return self._with_retry(op, "get_version")
+
+    def get_parameters_versioned(self):
+        def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
+            request = urllib.request.Request(
+                f"http://{self.master_url}/parameters",
+                headers=self._headers())
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                version = int(response.headers.get(
+                    "X-Weights-Version", -1))
+                return version, decode_weights(response.read())
         return self._with_retry(op, "get_parameters")
 
     def push_frame(self, arrays: List[np.ndarray], kind: int):
@@ -353,6 +398,31 @@ class SocketClient(BaseParameterClient):
                 # bytearray, so the views stay writable for callers
                 # that update weights in place.
                 return receive(sock, copy=False)
+            return self._run_op(rpc)
+        return self._with_retry(op, "get_parameters")
+
+    def get_version(self) -> int:
+        def op():
+            def rpc(sock):
+                sock.sendall(b"v")
+                # recv_exact: a half-closed peer raises (retried)
+                # instead of a short read being misparsed as a version
+                return struct.unpack(">Q", recv_exact(sock, 8))[0]
+            return self._run_op(rpc)
+        return self._with_retry(op, "get_version")
+
+    def get_parameters_versioned(self):
+        def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
+
+            def rpc(sock):
+                # versioned get: the server reads (version, payload)
+                # under one lock, so the pair is consistent; the pull
+                # itself stays the same zero-copy receive as 'g'
+                sock.sendall(b"G")
+                version = struct.unpack(">Q", recv_exact(sock, 8))[0]
+                return version, receive(sock, copy=False)
             return self._run_op(rpc)
         return self._with_retry(op, "get_parameters")
 

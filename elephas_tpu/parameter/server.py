@@ -51,6 +51,19 @@ staleness asynchronous SGD already tolerates. Supervision is per
 shard: a dead shard is rebuilt from its own snapshot on its own port
 while the survivors keep serving (see the fault-tolerance guide).
 
+## Live weight subscribers
+
+Every applied delta (and every restore) bumps the server's
+``weights_version``, exposed as a cheap no-payload poll on both
+transports (``GET /version``; socket opcode ``'v'``) plus a versioned
+pull (``X-Weights-Version`` on ``/parameters``; socket opcode ``'G'``)
+whose (version, payload) pair is read consistently under one lock.
+Serving engines subscribe through
+:class:`~elephas_tpu.weightsync.WeightSubscriber` and hot-swap new
+versions between decode steps — the train-to-serve loop in the
+live-weights guide. Repeated pulls of one version ride the cached
+encoded snapshot: N subscribers cost N ``sendall``s and ONE encode.
+
 ## Pipelined async push
 
 ``ps_pipeline=True`` double-buffers the reference-parity worker loops:
@@ -206,6 +219,18 @@ class BaseParameterServer(abc.ABC):
             if self.mode == "asynchronous":
                 self.lock.release()
 
+    @property
+    def weights_version(self) -> int:
+        """The served weights' version counter: bumped exactly once per
+        applied delta and once per :meth:`restore`. The cheap
+        "anything changed since v?" poll both transports expose — a
+        subscriber compares for INEQUALITY (a restarted-from-snapshot
+        server resumes past its snapshot's version, which can sit below
+        a version the dead server reached after snapshotting), and only
+        re-downloads when the answer moved."""
+        with self._counter_lock:
+            return self._weights_version
+
     def encoded_weights(self) -> bytes:
         """The current weights as one wire-encoded ETPU payload, served
         from a cached snapshot: invalidated when a delta lands (the
@@ -213,6 +238,14 @@ class BaseParameterServer(abc.ABC):
         get-heavy sync traffic costs ``sendall(cached_bytes)`` and zero
         encode work. Concurrent getters serialize on the rebuild and
         then share the same immutable payload."""
+        return self.encoded_weights_versioned()[1]
+
+    def encoded_weights_versioned(self):
+        """``(version, payload)`` — the cached encoded snapshot plus
+        the version it encodes, read under one lock so the pair is
+        CONSISTENT (a live-weight subscriber stamps its pulled params
+        with this version; a racing delta simply shows up as the next
+        poll's version change)."""
         fault_site("ps.get_weights")
         with self._enc_lock:
             if self.mode == "asynchronous":
@@ -221,7 +254,7 @@ class BaseParameterServer(abc.ABC):
                 version = self._weights_version
                 if (self._enc_cache is not None
                         and self._enc_cache[0] == version):
-                    return self._enc_cache[1]
+                    return version, self._enc_cache[1]
                 # the encoder's bytearray is served as-is (bytes-like for
                 # sendall/HTTP): nothing mutates it after this point —
                 # invalidation REPLACES the cache tuple — and a bytes()
@@ -232,7 +265,7 @@ class BaseParameterServer(abc.ABC):
                 if self.mode == "asynchronous":
                     self.lock.release()
             self._enc_cache = (version, payload)
-            return payload
+            return version, payload
 
     def snapshot(self) -> Dict[str, Any]:
         """Restartable server state: weights, the applied-update counter,
@@ -252,9 +285,27 @@ class BaseParameterServer(abc.ABC):
             seen = list(self._seen_ids.items())
         with self._counter_lock:
             num_updates = self.num_updates
+            weights_version = self._weights_version
         weights = self.get_weights()  # honors the mode's locking policy
         return {"weights": weights, "num_updates": num_updates,
-                "seen_ids": seen}
+                "weights_version": weights_version, "seen_ids": seen}
+
+    #: version jump applied by :meth:`restore` when the snapshot's
+    #: version is AT OR ABOVE this server's own — the restart-recovery
+    #: shape, where a fresh process (counter 0) adopts a dead
+    #: predecessor's snapshot. The predecessor's counter kept moving
+    #: after the snapshot was taken (deltas this process never saw), so
+    #: ``snapshot_version + 1`` could land exactly on — or later climb
+    #: through — a version a subscriber already pulled from the dead
+    #: server, silently hiding the restart behind an aliased number.
+    #: Jumping far past any count of post-snapshot deltas a supervision
+    #: window (snapshots ride every healthy probe, seconds apart) could
+    #: physically apply keeps the restored trajectory disjoint from the
+    #: dead one's. An in-place restore on a LIVE server (own counter >
+    #: snapshot's) needs no jump: its own counter already dominates
+    #: everything it ever served, so +1 cannot alias — and stays the
+    #: "exactly one bump per restore" contract tests pin.
+    RESTORE_VERSION_JUMP = 1 << 20
 
     def restore(self, snapshot: Dict[str, Any]):
         """Adopt a :meth:`snapshot` (typically on a fresh server before
@@ -265,7 +316,19 @@ class BaseParameterServer(abc.ABC):
             self.weights = [np.asarray(w, dtype=np.float32).copy()
                             for w in snapshot["weights"]]
             with self._counter_lock:
-                self._weights_version += 1  # drop any cached encoding
+                snap_version = int(snapshot.get("weights_version", 0))
+                if snap_version >= self._weights_version:
+                    # restart recovery: the dead predecessor's counter
+                    # is unknowable past the snapshot — jump clear of
+                    # its whole plausible trajectory (see
+                    # RESTORE_VERSION_JUMP)
+                    self._weights_version = (snap_version
+                                             + self.RESTORE_VERSION_JUMP)
+                else:
+                    # live in-place restore: our own counter dominates
+                    # everything we ever served; one bump (also drops
+                    # the cached encoding)
+                    self._weights_version += 1
         finally:
             if self.mode == "asynchronous":
                 self.lock.release()
@@ -378,7 +441,7 @@ class HttpServer(BaseParameterServer):
                 if self.path.rstrip("/") in ("", "/"):
                     return "/"
                 for known in ("/health", "/metrics", "/parameters",
-                              "/update"):
+                              "/update", "/version"):
                     if self.path.startswith(known):
                         return known
                 return "other"
@@ -408,6 +471,7 @@ class HttpServer(BaseParameterServer):
             def _handle_get(self):
                 t0 = time.perf_counter()
                 content_type = "application/elephas-tpu"
+                extra_headers = ()
                 if self.path.rstrip("/") in ("", "/"):
                     body = b"elephas_tpu"
                 elif self.path.startswith("/health"):
@@ -424,10 +488,23 @@ class HttpServer(BaseParameterServer):
                     body = default_registry().render().encode()
                     content_type = ("text/plain; version=0.0.4; "
                                     "charset=utf-8")
+                elif self.path.startswith("/version"):
+                    # the cheap "weights changed since v?" poll: live-
+                    # weight subscribers hit this every poll interval
+                    # and only download /parameters when it moved
+                    body = (b'{"version": %d, "num_updates": %d}'
+                            % (server.weights_version,
+                               server.num_updates))
+                    content_type = "application/json"
+                    server._obs_rpc("http", "get_version", "ok", t0)
                 elif self.path.startswith("/parameters"):
                     # cached encoded snapshot: no per-request encode (or
-                    # weight copy) while the version is unchanged
-                    body = server.encoded_weights()
+                    # weight copy) while the version is unchanged. The
+                    # version the payload encodes rides a header, so a
+                    # subscriber's (version, weights) pair is consistent
+                    # without a second racing RPC.
+                    version, body = server.encoded_weights_versioned()
+                    extra_headers = (("X-Weights-Version", str(version)),)
                     server._obs_rpc("http", "get_weights", "ok", t0,
                                     bytes_out=len(body))
                 else:
@@ -440,6 +517,8 @@ class HttpServer(BaseParameterServer):
                 self.send_response(200)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in extra_headers:
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -498,9 +577,12 @@ class SocketServer(BaseParameterServer):
     """Raw-TCP parameter server with a 1-byte opcode protocol:
     ``'g'`` = get weights, ``'u'`` = apply update, ``'U'`` = apply update
     with a 32-byte idempotency id (safe to resend), ``'h'`` = health
-    probe, ``'T'`` = trace-context frame (55-byte ``traceparent``
-    applying to the next RPC — a backward-compatible extension old
-    clients simply never send).
+    probe, ``'v'`` = weight-version poll (8-byte big-endian reply — the
+    cheap "changed since v?" probe live-weight subscribers ride),
+    ``'G'`` = get weights WITH their version (8-byte version, then the
+    frame), ``'T'`` = trace-context frame (55-byte ``traceparent``
+    applying to the next RPC). ``'v'``/``'G'``/``'T'`` are
+    backward-compatible extensions old clients simply never send.
 
     (Parity surface: ``elephas/parameter/server.py:140-233``; framing is the
     length-prefixed ETPU format instead of pickled payloads.)
@@ -662,6 +744,23 @@ class SocketServer(BaseParameterServer):
                         send_payload(conn, payload)
                         self._obs_rpc("socket", "get_weights", "ok", t0,
                                       bytes_out=len(payload))
+                    elif opcode == b"G":
+                        # versioned get: the 8-byte version prefixes the
+                        # SAME cached frame 'g' serves, read as one
+                        # consistent pair — the live-weight subscriber's
+                        # download path
+                        version, payload = self.encoded_weights_versioned()
+                        conn.sendall(struct.pack(">Q", version))
+                        send_payload(conn, payload)
+                        self._obs_rpc("socket", "get_weights", "ok", t0,
+                                      bytes_out=len(payload))
+                    elif opcode == b"v":
+                        # version poll: 8 bytes, no weight payload — a
+                        # subscriber polls this every interval and only
+                        # downloads when the answer moved
+                        conn.sendall(struct.pack(
+                            ">Q", self.weights_version))
+                        self._obs_rpc("socket", "get_version", "ok", t0)
                     elif opcode == b"h":
                         conn.sendall(b"k")  # alive
                         self._obs_rpc("socket", "health", "ok", t0)

@@ -217,6 +217,28 @@ class ShardedParameterClient(BaseParameterClient):
         parts = self._fanout.run([c.get_parameters for c in self.clients])
         return self.plan.merge(parts)
 
+    def get_version(self):
+        """Per-shard weight versions as a tuple (plan order), fanned out
+        in parallel like every other RPC. Each shard versions its own
+        slice independently, so the tuple IS the plane's version token:
+        a subscriber compares tuples for inequality (any shard moved =
+        the assembled weights changed) and sums them when it needs one
+        number for a gauge."""
+        return tuple(int(v) for v in self._fanout.run(
+            [c.get_version for c in self.clients]))
+
+    def get_parameters_versioned(self):
+        """``(versions, weights)``: per-shard versioned pulls fanned
+        out over the plan, reassembled in plan order. Consistency is
+        per shard, like :meth:`get_parameters` — a concurrent push can
+        land between shard reads (the documented sharded-PS trade);
+        the racing shard's version shows up changed on the next poll,
+        so a subscriber simply converges one pull later."""
+        pairs = self._fanout.run([c.get_parameters_versioned
+                                  for c in self.clients])
+        versions = tuple(int(v) for v, _ in pairs)
+        return versions, self.plan.merge([w for _, w in pairs])
+
     def push_frame(self, arrays: List[np.ndarray], kind: int):
         """Fan one update out to every shard.
 

@@ -43,6 +43,7 @@ The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
 """
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -425,6 +426,27 @@ class DecodeEngine:
                       ).set_function(
                 lambda: float(len(e._free_block_ids))
                 if (e := ref()) is not None else 0.0)
+        # live weight plane: params staged by a WeightSubscriber (any
+        # thread) swap in atomically between decode steps — the same
+        # point KV installs use. weights_version names what the engine
+        # is CURRENTLY serving (0 = construction-time params; a
+        # subscriber stamps it with the parameter plane's version).
+        self.weights_version = 0
+        self._staged_lock = threading.Lock()
+        self._staged_params: Optional[Tuple] = None
+        reg.gauge("serving_weights_version",
+                  "weight version the engine is currently serving "
+                  "(0 = construction-time params)").set_function(
+            lambda: float(e.weights_version)
+            if (e := ref()) is not None else 0.0)
+        self._m_weight_swaps = reg.counter(
+            "serving_weight_swaps_total",
+            "live weight hot-swaps applied between decode steps"
+            ).labels()
+        self._m_swap_pause = reg.histogram(
+            "serving_weight_swap_seconds",
+            "engine-loop blockage per weight swap (param pointer swap "
+            "+ registered-prefix recompute)").labels()
 
         cfg = config
         temp = self.temperature
@@ -576,7 +598,8 @@ class DecodeEngine:
             self._m_steps, self._m_emitted, self._m_finished,
             self._m_shed, self._m_expired, self._m_timed_out,
             self._m_accepted, self._m_proposed,
-            self._m_prefix_hits, self._m_prefix_tokens)
+            self._m_prefix_hits, self._m_prefix_tokens,
+            self._m_weight_swaps)
 
         if draft_config is not None:
             from .models.speculative import speculative_round
@@ -766,6 +789,68 @@ class DecodeEngine:
                                 jnp.int32(ptoks.size))
         return logits[0], row
 
+    # ------------------------------------------------------- live weights
+    def stage_params(self, params: Dict, version: int,
+                     trace_id: Optional[str] = None) -> None:
+        """Stage a new parameter pytree for an atomic hot-swap. Safe
+        from ANY thread (a :class:`~elephas_tpu.weightsync.
+        WeightSubscriber`'s background puller): the engine applies it
+        between decode steps — the same atomic point KV installs use —
+        on its next :meth:`step` (or via an explicit
+        :meth:`apply_staged_params` on engines that never step, e.g. a
+        prefill worker's). Latest staging wins; in-flight requests
+        finish on whichever version they step under. ``params`` should
+        already be device arrays in the engine's tree structure — the
+        conversion belongs OFF the engine loop, which is why staging
+        and applying are split. ``trace_id`` (the stager's active
+        trace) rides to the ``weights.swapped`` event so a canary
+        rollout's whole story joins on one id.
+
+        Speculative mode swaps only the TARGET params: speculative
+        sampling is exact with respect to the target model, so a stale
+        draft costs acceptance rate, never correctness."""
+        with self._staged_lock:
+            self._staged_params = (params, int(version), trace_id,
+                                   time.monotonic())
+
+    def apply_staged_params(self) -> Optional[int]:
+        """Apply a staged swap NOW, if any; returns the new version (or
+        None). Must be called from whatever context owns the engine's
+        step/prefill serialization — ``step()`` calls it between decode
+        steps, and a :class:`~elephas_tpu.disagg.PrefillWorker` calls
+        it between jobs. Registered prefixes are recomputed under the
+        new params before the swap returns (their cached KV was
+        computed under the old weights — serving it after the swap
+        would hand out stale state the same way an unstamped shipped-KV
+        frame would), so the swap pause scales with the number of
+        pinned prefixes; the ``serving_weight_swap_seconds`` histogram
+        measures exactly this blockage."""
+        with self._staged_lock:
+            staged, self._staged_params = self._staged_params, None
+        if staged is None:
+            return None
+        params, version, trace_id, staged_t = staged
+        t0 = time.monotonic()
+        self.params = params
+        self.weights_version = int(version)
+        if self._prefixes:
+            # re-pin every registered prefix under the new weights;
+            # register_prefix re-sorts, so matching behavior is
+            # unchanged
+            tokens = [entry[0] for entry in self._prefixes]
+            self._prefixes = []
+            for toks in tokens:
+                self.register_prefix(toks)
+        pause = time.monotonic() - t0
+        self._m_weight_swaps.inc()
+        self._m_swap_pause.observe(pause)
+        emit_event("weights.swapped", trace_id=trace_id,
+                   version=int(version), tier=self.tier,
+                   prefixes_recomputed=len(self._prefixes),
+                   staged_for_s=round(t0 - staged_t, 6),
+                   pause_s=round(pause, 6))
+        return int(version)
+
     # ------------------------------------------------------------ queue
     def check_admissible(self, prompt_size: int,
                          max_new_tokens: int) -> None:
@@ -837,7 +922,8 @@ class DecodeEngine:
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None,
                          admit: bool = True,
-                         deadline_ms: Optional[float] = None) -> int:
+                         deadline_ms: Optional[float] = None,
+                         weights_version: Optional[int] = None) -> int:
         """Queue a request whose prefill ALREADY HAPPENED off-engine —
         the decode half of disaggregated serving. ``kv_blocks`` is the
         prompt's KV state in wire-block form
@@ -851,7 +937,17 @@ class DecodeEngine:
         else (admission bounds, deadlines, sampling overrides for the
         DECODE steps, cancel, results) behaves exactly like
         :meth:`submit`. Not supported in speculative mode (the draft
-        model's KV is not shipped)."""
+        model's KV is not shipped).
+
+        ``weights_version`` stamps which LIVE weight version the KV was
+        computed under: admission re-checks it against the engine's
+        current version at the moment of install — the caller's own
+        gate (the disaggregated front end's) necessarily runs earlier,
+        and a hot-swap staged in between would otherwise decode this
+        request's whole output over mismatched state. A stale stamp
+        falls back to a LOCAL prefill of the prompt (correct output,
+        one admission's worth of extra compute on this engine) rather
+        than failing the request; ``None`` skips the check."""
         if self.draft_config is not None:
             raise ValueError("submit_prefilled does not compose with "
                              "speculative mode (no draft KV on the wire)")
@@ -894,9 +990,11 @@ class DecodeEngine:
                     raise ValueError(
                         f"{b.shape[0]} blocks of {b.shape[2]} positions"
                         f" cannot cover the {prompt_size}-token prompt")
-        return self._submit_impl(prompt, max_new_tokens, temperature,
-                                 top_k, top_p, admit, deadline_ms,
-                                 (blocks, int(first_token)))
+        return self._submit_impl(
+            prompt, max_new_tokens, temperature, top_k, top_p, admit,
+            deadline_ms,
+            (blocks, int(first_token),
+             None if weights_version is None else int(weights_version)))
 
     def _submit_impl(self, prompt, max_new_tokens, temperature, top_k,
                      top_p, admit, deadline_ms, prefilled) -> int:
@@ -1024,6 +1122,11 @@ class DecodeEngine:
                 "prompt_tokens": int(prompt.size),
                 "prefix_tokens": (0 if entry is None
                                   else int(entry[0].size)),
+                # the version this KV was computed under: a disagg
+                # decode engine REJECTS a frame whose stamp mismatches
+                # its own live version (decoding new-weight steps over
+                # old-weight KV is silently wrong output, not a crash)
+                "weights_version": int(self.weights_version),
                 "prefill_s": round(time.monotonic() - start, 6)}
 
     def would_shed(self, prompt_tokens: int) -> bool:
@@ -1140,6 +1243,11 @@ class DecodeEngine:
             self._m_timed_out.inc()
 
     def _admit(self):
+        # a staged live-weight swap lands FIRST — admission prefills
+        # must run under the params their requests will decode under
+        # (this covers both entry points: step()'s between-decode-steps
+        # call and an immediate submit(admit=True) admission)
+        self.apply_staged_params()
         self._shed_expired_queued()
         self._enforce_active_deadlines()
         for slot in self._free_slots():
@@ -1167,6 +1275,11 @@ class DecodeEngine:
             t_sub = self._submit_t.get(rid)
             self.recorder.record(
                 rid, "admitted", slot=slot,
+                # the weight version this request will decode under —
+                # the flight-recorder half of "which weights served
+                # this request" (a mid-decode swap shows up as
+                # weights.swapped events between its step events)
+                weights_version=self.weights_version,
                 queue_wait_s=(None if t_sub is None
                               else round(self._admit_t[rid] - t_sub, 6)))
             # per-request context restore: this loop runs on the engine
@@ -1175,6 +1288,24 @@ class DecodeEngine:
             # submit — None for requests submitted without one
             pre = self._prefilled_kv.pop(rid, None)
             with use_context(self._trace_ctx.get(rid)):
+                if (pre is not None and len(pre) > 2
+                        and pre[2] is not None
+                        and int(pre[2]) != int(self.weights_version)):
+                    # the shipped KV's weight-version stamp went stale
+                    # between the caller's gate and THIS install (a
+                    # hot-swap staged in the window): decoding over it
+                    # would be silently wrong output. Fall back to a
+                    # local prefill — correct, never a failed request,
+                    # one admission's worth of extra compute.
+                    self.recorder.record(
+                        rid, "kv_install_stale",
+                        frame_version=int(pre[2]),
+                        engine_version=int(self.weights_version),
+                        fallback="local_prefill")
+                    emit_event("serving.kv_install_stale",
+                               frame_version=int(pre[2]),
+                               engine_version=int(self.weights_version))
+                    pre = None
                 if pre is not None:
                     # disaggregated admission: the shipped KV blocks
                     # install straight into the slot (between decode
@@ -1266,7 +1397,8 @@ class DecodeEngine:
         from .models.paged_decode import (import_kv_blocks,
                                           install_row_paged)
 
-        blocks, t0 = pre
+        blocks, t0 = pre[0], pre[1]   # pre[2] (version stamp) is the
+        # caller's/_admit's concern — checked before this install runs
         if isinstance(blocks, dict):
             row_np = blocks        # prebuilt off-loop by the receiver
         else:
@@ -1368,7 +1500,13 @@ class DecodeEngine:
                "requests_timed_out": int(
                    self._since_init(self._m_timed_out)),
                "queue_depth": len(self._queue),
-               "queued_tokens": self._queued_tokens}
+               "queued_tokens": self._queued_tokens,
+               # live weight plane: what the engine serves NOW and how
+               # many hot-swaps it has applied (gauge + counter on
+               # /metrics; same numbers here so the surfaces agree)
+               "weights_version": int(self.weights_version),
+               "weight_swaps": int(self._since_init(
+                   self._m_weight_swaps))}
         if self._prefixes:
             out["prefix_hits"] = int(self._since_init(self._m_prefix_hits))
             out["prefix_tokens_reused"] = int(
@@ -1412,10 +1550,15 @@ class DecodeEngine:
         tokens not yet surfaced by step() — so the canonical
         ``while eng.pending: eng.step()`` loop always delivers a
         request's tokens even when it retires at admission time
-        (``max_new_tokens=1``)."""
+        (``max_new_tokens=1``). A staged weight swap counts too: an
+        idle server's engine loop must still pick it up within one
+        idle-sleep, not wait for the next request."""
+        with self._staged_lock:
+            staged = self._staged_params is not None
         return (len(self._queue)
                 + sum(r is not None for r in self._rid)
-                + len(self._fresh))
+                + len(self._fresh)
+                + (1 if staged else 0))
 
     def step(self) -> Dict[int, List[int]]:
         """Advance every active slot — by one token (plain mode) or by

@@ -1,0 +1,37 @@
+"""Live weight plane: versioned hot-swap from the parameter servers
+into the serving fleet.
+
+The training side already publishes versioned weights — every applied
+delta bumps the parameter server's ``weights_version`` and its cached
+pre-encoded snapshot — and the serving side already has an atomic
+between-decode-steps point where state installs into a running engine.
+This package closes the loop:
+
+- :class:`~.subscriber.WeightSubscriber` — a background poller per
+  engine: cheap version polls against the (possibly sharded) parameter
+  plane, zero-copy download when the version moved, host→device
+  conversion OFF the engine loop, then
+  :meth:`~elephas_tpu.serving_engine.DecodeEngine.stage_params` for
+  the engine to swap atomically between decode steps with zero dropped
+  requests. Keeps the previous params for :meth:`~.subscriber.
+  WeightSubscriber.rollback`.
+- :class:`~.canary.CanaryController` — fleet rollout policy: the new
+  version goes to ONE canary replica first; its latency and shed-rate
+  deltas over the bake window are compared against the stable cohort's
+  (same metrics registry the engines already export), then the version
+  promotes fleet-wide or auto-rolls back — the stable cohort never
+  takes a version the canary disproved. Every decision rides one trace
+  id through ``weights.rollout_started`` / ``weights.swapped`` /
+  ``weights.promoted`` / ``weights.rolled_back`` events.
+
+Version stamping keeps mixed-version topologies honest: prefix-cache
+entries are recomputed at swap time, and a disaggregated decode engine
+rejects shipped KV whose ``weights_version`` stamp mismatches its own
+(the frame retries through the prefill tier's sibling-retry path).
+
+``docs/sources/live-weights.md`` is the operator guide.
+"""
+from .canary import CanaryController
+from .subscriber import WeightSubscriber
+
+__all__ = ["CanaryController", "WeightSubscriber"]

@@ -1,0 +1,385 @@
+"""CanaryController: fleet-wide weight rollout with auto-rollback.
+
+A new weight version is an unreviewed deploy: online learning can push
+a regression straight out of the training loop. The controller treats
+the fleet's own golden signals as the review gate:
+
+1. **Canary**: one replica's (managed, ``auto=False``)
+   :class:`~.subscriber.WeightSubscriber` pulls the new version; the
+   stable cohort keeps serving the old one.
+2. **Bake**: the controller snapshots every replica's request-latency
+   sum/count and shed/finished counters (the engines' own
+   ``serving_request_latency_seconds`` / ``serving_requests_*``
+   series) before the swap, then waits until the canary has served
+   ``min_requests`` under the new version (or ``bake_timeout_s``
+   passes). Deltas over the window — not absolute values — are
+   compared, so heterogeneous replicas and pre-existing history don't
+   skew the verdict.
+3. **Verdict**: regression = canary mean latency above the stable
+   cohort's pooled mean times ``latency_ratio`` plus
+   ``latency_slack_s``, or canary shed RATE above the cohort's by more
+   than ``shed_slack``. Regressed → the canary rolls back (the
+   subscriber holds the previous params) and the token is vetoed: the
+   stable cohort NEVER takes the bad version. Clean → every stable
+   replica pulls and the version is fleet-wide.
+
+Every rollout runs under one fresh trace context, so
+``weights.rollout_started`` / ``weights.staged`` / ``weights.swapped``
+/ ``weights.promoted`` / ``weights.rolled_back`` events — across
+controller, subscribers, and engines — join on a single trace id in
+the event log, exactly like a request's flight-recorder story.
+"""
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..obs.context import current_context, new_root, use_context
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry
+from .subscriber import WeightSubscriber, numeric_version
+
+__all__ = ["CanaryController"]
+
+
+class CanaryController:
+    """Roll new weight versions: canary first, then promote or roll
+    back on the canary's observed latency/shed deltas.
+
+    :param subscribers: one managed (``auto=False``)
+        :class:`~.subscriber.WeightSubscriber` per replica;
+        ``subscribers[canary]`` is the canary. The controller flips
+        any auto subscriber to managed at construction — a replica
+        that self-updates would defeat the rollout gate.
+    :param canary: index of the canary replica.
+    :param bake_s: minimum bake wall time after the canary swap.
+    :param min_requests: requests the canary must finish under the new
+        version before a verdict (latency means over fewer samples are
+        noise).
+    :param bake_timeout_s: give up waiting for bake traffic after this
+        long; the verdict then falls to ``on_no_traffic`` ("rollback"
+        — the safe default: no evidence is not a pass — or
+        ``"promote"`` for fleets with long idle stretches).
+    :param latency_ratio, latency_slack_s: regression when
+        ``canary_mean > stable_mean * latency_ratio + latency_slack_s``
+        (against the canary's own pre-roll baseline mean when the
+        stable cohort saw no bake traffic).
+    :param shed_slack: regression when the canary's shed rate over the
+        bake window exceeds the stable cohort's by more than this.
+    :param swap_timeout_s: how long to wait for a staged swap to apply
+        (an engine loop must pick it up; a dead replica fails the
+        rollout into a rollback).
+    :param registry: metrics destination for the controller's counters
+        (defaults to the canary subscriber's registry).
+    :param poll_interval: background-mode cadence of
+        :meth:`poll_and_roll`.
+    """
+
+    def __init__(self, subscribers: Sequence[WeightSubscriber],
+                 canary: int = 0, bake_s: float = 0.5,
+                 min_requests: int = 4, bake_timeout_s: float = 30.0,
+                 latency_ratio: float = 2.0,
+                 latency_slack_s: float = 0.05,
+                 shed_slack: float = 0.05, swap_timeout_s: float = 30.0,
+                 on_no_traffic: str = "rollback",
+                 registry: Optional[MetricsRegistry] = None,
+                 poll_interval: float = 0.5):
+        if not subscribers:
+            raise ValueError("need at least one subscriber")
+        if not 0 <= int(canary) < len(subscribers):
+            raise ValueError(f"canary index {canary} out of range")
+        if on_no_traffic not in ("rollback", "promote"):
+            raise ValueError("on_no_traffic must be 'rollback' or "
+                             f"'promote', got {on_no_traffic!r}")
+        self.subscribers = list(subscribers)
+        self.canary_index = int(canary)
+        for sub in self.subscribers:
+            # managed mode: the controller is the only thing that pulls
+            sub.auto = False
+        self.bake_s = float(bake_s)
+        self.min_requests = int(min_requests)
+        self.bake_timeout_s = float(bake_timeout_s)
+        self.latency_ratio = float(latency_ratio)
+        self.latency_slack_s = float(latency_slack_s)
+        self.shed_slack = float(shed_slack)
+        self.swap_timeout_s = float(swap_timeout_s)
+        self.on_no_traffic = on_no_traffic
+        self.poll_interval = float(poll_interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = (registry if registry is not None
+               else self.subscribers[self.canary_index].registry)
+        self.registry = reg
+        self._m_promotions = reg.counter(
+            "canary_promotions_total",
+            "weight versions promoted fleet-wide after a clean bake"
+            ).labels()
+        self._m_rollbacks = reg.counter(
+            "canary_rollbacks_total",
+            "weight versions rolled back off the canary (regression "
+            "or swap failure) — the stable cohort never took them"
+            ).labels()
+        self._vetoed = set()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "CanaryController":
+        """Run :meth:`poll_and_roll` periodically in the background."""
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="weightsync-canary")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_and_roll()
+            except Exception:  # noqa: BLE001 — a flapping PS or dying
+                pass           # replica must not kill the controller
+
+    # ------------------------------------------------------------ rollout
+    @property
+    def canary(self) -> WeightSubscriber:
+        return self.subscribers[self.canary_index]
+
+    def stable(self) -> List[WeightSubscriber]:
+        return [s for i, s in enumerate(self.subscribers)
+                if i != self.canary_index]
+
+    def poll_and_roll(self) -> str:
+        """Check the parameter plane through the canary's client; when
+        a version the fleet is not serving (and has not vetoed) shows
+        up, run one full :meth:`rollout`. Returns the outcome:
+        ``"noop"`` / ``"promoted"`` / ``"rolled_back"``."""
+        token = self.canary.client.get_version()
+        if token in self._vetoed:
+            return "noop"
+        current = self.canary.staged_version
+        reference = (current if current is not None
+                     else self.canary._baseline)
+        if token == reference:
+            return "noop"   # nothing new since the last roll/baseline
+        return self.rollout()
+
+    def rollout(self) -> str:
+        """One full canary cycle for whatever version the plane serves
+        now. Everything — events from the controller, the subscribers'
+        pulls, and the engines' swaps — runs under ONE fresh trace
+        context, so the event log joins the whole story on one id."""
+        with use_context(new_root()):
+            return self._rollout_traced()
+
+    def _rollout_traced(self) -> str:
+        canary = self.canary
+        token = canary.pull()
+        if token is None:
+            return "noop"
+        version = numeric_version(token)
+        emit_event("weights.rollout_started", version=version,
+                   token=str(token), canary=canary.name,
+                   replicas=len(self.subscribers))
+        if not canary.wait_for_version(version,
+                                       timeout=self.swap_timeout_s):
+            return self._rollback(token, version, "swap_timeout", {})
+        # snapshot AFTER the canary swap applied: the bake window must
+        # measure requests served under the new version, not fast
+        # old-version completions that landed during the pull
+        baselines = [self._read(s.engine) for s in self.subscribers]
+        verdict, detail = self._bake(baselines, version)
+        if verdict == "regressed":
+            return self._rollback(token, version,
+                                  detail.pop("reason", "regression"),
+                                  detail)
+        # promote CONCURRENTLY: each stable replica downloads and
+        # converts on its own thread (every subscriber owns its client
+        # and stage_params is thread-safe), so the mixed-version window
+        # is ~one pull, not N of them. The rollout's trace context is
+        # propagated onto each thread so the staged/swapped events
+        # still join the story. The pull is PINNED to the token the
+        # canary baked: if training pushed a newer version mid-bake,
+        # the PS now serves something the canary never vetted — those
+        # replicas stage NOTHING (the next poll_and_roll cycle canaries
+        # the new version) instead of taking an unbaked deploy.
+        ctx = current_context()
+        outcomes = {}
+
+        def promote(sub):
+            with use_context(ctx):
+                try:
+                    outcomes[id(sub)] = sub.pull(expect_token=token)
+                except Exception:  # noqa: BLE001 — one unreachable
+                    # replica must not block the fleet: count it on
+                    # the subscriber's error series (the same one its
+                    # own poll loop uses); its wait is skipped below
+                    outcomes[id(sub)] = None
+                    sub._m_errors.inc()
+
+        threads = [threading.Thread(target=promote, args=(sub,),
+                                    daemon=True)
+                   for sub in self.stable()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        promoted = [sub for sub in self.stable()
+                    if outcomes.get(id(sub)) == token]
+        for sub in promoted:
+            sub.wait_for_version(version, timeout=self.swap_timeout_s)
+        self._m_promotions.inc()
+        emit_event("weights.promoted", version=version,
+                   token=str(token), canary=canary.name,
+                   replicas=len(self.subscribers),
+                   promoted_replicas=1 + len(promoted),
+                   skipped_replicas=len(self.stable()) - len(promoted),
+                   **detail)
+        return "promoted"
+
+    def _rollback(self, token, version: int, reason: str,
+                  detail: Dict) -> str:
+        canary = self.canary
+        restored = canary.rollback()
+        canary.wait_for_version(numeric_version(restored),
+                                timeout=self.swap_timeout_s)
+        self._vetoed.add(token)
+        self._m_rollbacks.inc()
+        emit_event("weights.rolled_back", version=version,
+                   token=str(token), canary=canary.name, reason=reason,
+                   restored_version=numeric_version(restored), **detail)
+        return "rolled_back"
+
+    # --------------------------------------------------------------- bake
+    def _canary_window(self, version: int):
+        """``(finished, latency_sum)`` over canary requests ADMITTED
+        under ``version``, read from the flight recorder (the engine
+        stamps every ``admitted`` event with the live weight version
+        and every terminal event with ``total_s``). This is what makes
+        the verdict honest: requests already in flight when the swap
+        landed finish under the new params but were admitted (and
+        mostly decoded) under the old ones — counting them could reach
+        a "clean" verdict from zero genuinely-new-version requests.
+        Returns None for engines without recorder support (the counter
+        fallback applies)."""
+        recent = getattr(self.canary.engine, "recent_traces", None)
+        if recent is None:
+            return None
+        try:
+            traces = recent(128)
+        except Exception:  # noqa: BLE001 — diagnostics must not fail
+            return None    # the rollout; counters still gate it
+        fin, lat = 0, 0.0
+        for trace in traces:
+            admitted_v, total = None, None
+            for e in trace.get("events", ()):
+                ev = e.get("event")
+                if ev == "admitted":
+                    admitted_v = e.get("weights_version")
+                elif (ev in ("finished", "timed_out")
+                        and e.get("total_s") is not None):
+                    total = e["total_s"]
+            if admitted_v == version and total is not None:
+                fin += 1
+                lat += float(total)
+        return fin, lat
+
+    def _bake(self, baselines, version: int) -> Tuple[str, Dict]:
+        """Wait out the bake window (min wall time AND min canary
+        requests ADMITTED UNDER the new version, bounded by the bake
+        timeout), then compare the canary's new-version window against
+        the stable cohort's pooled deltas.
+        Returns ``("clean"|"regressed", detail)``."""
+        canary_base = baselines[self.canary_index]
+        t0 = time.monotonic()
+        deadline = t0 + self.bake_timeout_s
+        while True:
+            window = self._canary_window(version)
+            if window is not None:
+                done = window[0]
+            else:
+                now_c = self._read(self.canary.engine)
+                done = now_c["finished"] - canary_base["finished"]
+            if (done >= self.min_requests
+                    and time.monotonic() - t0 >= self.bake_s):
+                break
+            if time.monotonic() >= deadline:
+                if self.on_no_traffic == "promote":
+                    return "clean", {"bake_requests": int(done),
+                                     "bake_verdict": "no_traffic"}
+                return "regressed", {"reason": "insufficient_traffic",
+                                     "bake_requests": int(done)}
+            time.sleep(0.01)
+        canary_now = self._read(self.canary.engine)
+        c = self._delta(canary_base, canary_now)
+        if window is not None:
+            # the latency verdict reads ONLY new-version-admitted
+            # requests; the shed verdict stays on the counter deltas
+            # (sheds never reach admission, so they have no version)
+            c["lat_count"] = window[0]
+            c["lat_sum"] = window[1]
+        pooled = {"lat_sum": 0.0, "lat_count": 0, "shed": 0,
+                  "finished": 0}
+        for i, sub in enumerate(self.subscribers):
+            if i == self.canary_index:
+                continue
+            d = self._delta(baselines[i], self._read(sub.engine))
+            for k in pooled:
+                pooled[k] += d[k]
+        canary_mean = (c["lat_sum"] / c["lat_count"]
+                       if c["lat_count"] else 0.0)
+        if pooled["lat_count"]:
+            stable_mean = pooled["lat_sum"] / pooled["lat_count"]
+        else:
+            # no stable-cohort bake traffic (single replica, or an
+            # idle cohort): fall back to the canary's own PRE-ROLL
+            # history as the reference distribution
+            base_count = canary_base["lat_count"]
+            stable_mean = (canary_base["lat_sum"] / base_count
+                           if base_count else canary_mean)
+        lat_regressed = canary_mean > (stable_mean * self.latency_ratio
+                                       + self.latency_slack_s)
+        c_total = c["finished"] + c["shed"]
+        p_total = pooled["finished"] + pooled["shed"]
+        c_shed_rate = c["shed"] / c_total if c_total else 0.0
+        p_shed_rate = pooled["shed"] / p_total if p_total else 0.0
+        shed_regressed = c_shed_rate > p_shed_rate + self.shed_slack
+        detail = {"canary_mean_latency_s": round(canary_mean, 6),
+                  "stable_mean_latency_s": round(stable_mean, 6),
+                  "canary_shed_rate": round(c_shed_rate, 4),
+                  "stable_shed_rate": round(p_shed_rate, 4),
+                  "bake_requests": int(c["finished"])}
+        if lat_regressed or shed_regressed:
+            detail["reason"] = ("latency_regression" if lat_regressed
+                                else "shed_regression")
+            return "regressed", detail
+        return "clean", detail
+
+    @staticmethod
+    def _delta(before: Dict, after: Dict) -> Dict:
+        return {k: after[k] - before[k] for k in before}
+
+    @staticmethod
+    def _read(engine) -> Dict:
+        """One replica's cumulative health counters, straight off its
+        engine's metrics registry (the same series ``/metrics``
+        scrapes): request-latency sum/count plus shed/finished totals.
+        Cumulative reads bracket the bake window, so the comparison is
+        a pure per-window delta."""
+        reg = engine.registry
+        lat = reg.get("serving_request_latency_seconds")
+        shed = reg.get("serving_requests_shed_total")
+        fin = reg.get("serving_requests_finished_total")
+        return {
+            "lat_sum": float(lat.labels().sum) if lat is not None else 0.0,
+            "lat_count": int(lat.labels().count) if lat is not None else 0,
+            "shed": int(shed.labels().value) if shed is not None else 0,
+            "finished": int(fin.labels().value) if fin is not None else 0,
+        }

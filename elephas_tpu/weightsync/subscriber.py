@@ -1,0 +1,337 @@
+"""WeightSubscriber: pull new weight versions off the PS, stage them
+for an atomic engine swap.
+
+The division of labor is deliberate:
+
+- the POLL is cheap (``get_version``: a JSON scalar or 8 wire bytes —
+  no weight payload), so a tight poll interval costs nothing;
+- the DOWNLOAD happens only when the version moved, over the same
+  zero-copy decode path (``copy=False`` views, sharded fan-out) the
+  training plane uses;
+- the host→device CONVERSION runs on the subscriber's thread, never
+  the engine loop;
+- the SWAP itself is the engine's: :meth:`~elephas_tpu.serving_engine.
+  DecodeEngine.stage_params` hands the ready pytree over, and the
+  engine applies it between decode steps — in-flight requests finish
+  on whichever version they step under, and the engine-loop blockage
+  per swap is one pointer assignment plus registered-prefix recompute
+  (measured by ``serving_weight_swap_seconds``).
+
+Version tokens are opaque comparables: an ``int`` for a single server,
+a tuple of per-shard ints for a sharded plane (compared for
+INEQUALITY — a shard restarted from a snapshot may resume below a
+version a subscriber already saw). ``numeric_version`` sums a tuple
+for the gauges/stats surfaces that need one number.
+"""
+import threading
+import time
+from typing import Callable, Optional
+
+from ..obs.context import current_trace_id
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry
+
+__all__ = ["WeightSubscriber", "numeric_version"]
+
+
+def numeric_version(token) -> int:
+    """One number for a version token: the int itself, or the sum of a
+    sharded plane's per-shard versions (each shard's counter only ever
+    grows in place, so the sum moves whenever any shard's weights
+    change — modulo restart-from-snapshot, which pollers handle by
+    comparing tokens, not numerics)."""
+    if token is None:
+        return 0
+    if isinstance(token, (tuple, list)):
+        return int(sum(int(v) for v in token))
+    return int(token)
+
+
+class WeightSubscriber:
+    """Background weight puller for ONE engine.
+
+    :param engine: anything exposing ``stage_params(params, version,
+        trace_id=)`` / ``weights_version`` / ``params`` — a
+        :class:`~elephas_tpu.serving_engine.DecodeEngine` (colocated or
+        a prefill worker's), or a
+        :class:`~elephas_tpu.disagg.DisaggEngine` (stages its decode
+        half).
+    :param client: a parameter-plane client with ``get_version`` /
+        ``get_parameters_versioned`` (both transports, sharded or
+        not). The subscriber owns it (``stop()`` closes it).
+    :param poll_interval: seconds between version polls.
+    :param auto: ``True`` (default) = pull-and-stage as soon as a poll
+        sees a new version — the single-replica "just keep me fresh"
+        mode. ``False`` = managed: polls still record what is
+        available (``available_version``), but nothing stages until
+        :meth:`pull` — the mode a :class:`~.canary.CanaryController`
+        drives.
+    :param convert: ``fn(host_weights) -> params`` building the
+        engine's parameter pytree from the PS's flat weight list. The
+        default unflattens into the engine's CURRENT treedef leaf
+        order with per-leaf dtype casts — exactly right when the
+        training side publishes ``jax.tree_util.tree_leaves(params)``
+        (the transformer engines' layout).
+    :param registry: metrics destination (defaults to the engine's, so
+        one ``/metrics`` scrape covers serving and its subscriber).
+    :param name: label for events.
+    """
+
+    def __init__(self, engine, client, poll_interval: float = 0.25,
+                 auto: bool = True,
+                 convert: Optional[Callable] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "weightsync"):
+        self.engine = engine
+        self.client = client
+        self.poll_interval = float(poll_interval)
+        self.auto = bool(auto)
+        self.name = str(name)
+        self._convert = convert if convert is not None else self._to_params
+        self._lock = threading.Lock()
+        # the last token STAGED (what the engine will serve once its
+        # loop applies it), plus the previous staging for rollback.
+        # At construction the engine's params are "whatever it was
+        # built with" — version token None, numeric engine.weights_version.
+        self._current = (None, getattr(engine, "params", None))
+        self._previous = None
+        # tokens a rollback disproved: auto mode must not re-pull a
+        # version the canary just rolled back (the next PS delta mints
+        # a new token and clears the road)
+        self._vetoed = set()
+        self._seen = None        # last token any poll observed
+        # the token start() baselined (the PS version assumed to match
+        # the engine's construction params); None = never baselined,
+        # so the first successful poll pulls
+        self._baseline = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = (registry if registry is not None
+               else getattr(engine, "registry", None))
+        if reg is None:
+            reg = MetricsRegistry()
+        self.registry = reg
+        self._m_polls = reg.counter(
+            "weightsync_polls_total",
+            "version polls against the parameter plane").labels()
+        self._m_pulls = reg.counter(
+            "weightsync_pulls_total",
+            "full weight downloads (version moved)").labels()
+        self._m_rollbacks = reg.counter(
+            "weightsync_rollbacks_total",
+            "previous-version restorations staged by this subscriber"
+            ).labels()
+        self._m_errors = reg.counter(
+            "weightsync_errors_total",
+            "poll/pull attempts that failed (PS unreachable, decode "
+            "error) — the subscriber keeps polling").labels()
+        self._m_pull_seconds = reg.histogram(
+            "weightsync_pull_seconds",
+            "download + host-to-device conversion wall time per pull "
+            "(off the engine loop by construction)").labels()
+        self._g_available = reg.gauge(
+            "weightsync_available_version",
+            "newest weight version the parameter plane has offered "
+            "this subscriber (numeric; sharded planes sum per-shard "
+            "counters)")
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "WeightSubscriber":
+        """Baseline the PS version WITHOUT pulling (the engine's
+        construction-time params are taken as current — a fresh fleet
+        must not stampede the PS for weights it was just built from;
+        call :meth:`pull` first for an explicit initial sync), then
+        poll in the background."""
+        try:
+            token = self.client.get_version()
+            with self._lock:
+                self._seen = token
+                self._baseline = token
+            self._g_available.set(numeric_version(token))
+        except NotImplementedError:
+            raise
+        except Exception:  # noqa: BLE001 — PS not up yet: first poll syncs
+            self._m_errors.inc()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name=f"weightsync-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.client.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _poll_loop(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — a flapping PS must not
+                self._m_errors.inc()   # kill the subscriber thread
+
+    # -------------------------------------------------------------- polls
+    @property
+    def available_version(self):
+        """The newest token a poll has observed (None before the first
+        successful poll)."""
+        with self._lock:
+            return self._seen
+
+    @property
+    def staged_version(self):
+        """The token most recently staged at the engine (None until the
+        first pull)."""
+        with self._lock:
+            return self._current[0]
+
+    def poll_once(self) -> bool:
+        """One synchronous poll; in auto mode, pulls when the version
+        differs from what this subscriber last staged (or from the
+        start-time baseline before any pull) and is not vetoed.
+        Returns whether a pull was staged — tests drive this directly
+        for determinism. A pull that fails retries on the next poll:
+        the decision compares against STAGED state, not merely-seen
+        state."""
+        token = self.client.get_version()
+        self._m_polls.inc()
+        with self._lock:
+            self._seen = token
+            current = self._current[0]
+            baseline = self._baseline
+            vetoed = token in self._vetoed
+        self._g_available.set(numeric_version(token))
+        reference = current if current is not None else baseline
+        if not self.auto or vetoed or token == reference:
+            return False
+        return self.pull() is not None
+
+    # -------------------------------------------------------------- pulls
+    def pull(self, expect_token=None):
+        """Download the CURRENT (version, weights) pair, convert off
+        the engine loop, stage for the atomic swap. Returns the staged
+        token (or None when the plane still serves what the engine
+        already has). Manual-mode rollouts call this directly — under
+        an active trace context, so the resulting ``weights.staged`` /
+        ``weights.swapped`` events join the rollout's id.
+
+        ``expect_token`` pins WHICH version may stage: when the plane
+        has already moved past it (training pushed again mid-rollout),
+        nothing stages and None returns — the canary controller uses
+        this so a promotion can only ship the exact version the canary
+        baked, never a newer unbaked one that happens to be current.
+
+        A conversion failure (the plane published a layout this
+        engine's params can't adopt) VETOES the token before
+        re-raising: without the veto, auto polling would re-download
+        the full payload every interval forever — the next published
+        version clears the road (and pays one probe download if the
+        layout is still wrong)."""
+        t0 = time.perf_counter()
+        token, weights = self.client.get_parameters_versioned()
+        with self._lock:
+            if token == self._current[0]:
+                return None
+        if expect_token is not None and token != expect_token:
+            emit_event("weights.pull_skipped", subscriber=self.name,
+                       expected=str(expect_token), served=str(token))
+            return None
+        try:
+            params = self._convert(weights)
+        except Exception:
+            with self._lock:
+                self._vetoed.add(token)
+            emit_event("weights.convert_failed", subscriber=self.name,
+                       token=str(token))
+            raise
+        self._m_pulls.inc()
+        self._m_pull_seconds.observe(time.perf_counter() - t0)
+        self._stage(token, params)
+        return token
+
+    def _stage(self, token, params):
+        tid = current_trace_id()
+        with self._lock:
+            self._previous = self._current
+            self._current = (token, params)
+            self._seen = token
+        self.engine.stage_params(params, numeric_version(token),
+                                 trace_id=tid)
+        emit_event("weights.staged", subscriber=self.name,
+                   version=numeric_version(token),
+                   token=str(token))
+
+    def rollback(self):
+        """Re-stage the PREVIOUS params (the subscriber keeps exactly
+        one generation back — device arrays are immutable, so holding
+        them is free until the swap) and VETO the rolled-back token so
+        auto polling cannot immediately re-pull it. Returns the token
+        now staged, or None when there is no previous generation."""
+        with self._lock:
+            if self._previous is None or self._previous[1] is None:
+                # nothing to restore: never pulled, or the engine had
+                # no construction params to remember (custom-convert
+                # setups that never pulled twice)
+                return None
+            bad = self._current
+            self._current, self._previous = self._previous, None
+            token, params = self._current
+            self._vetoed.add(bad[0])
+        self._m_rollbacks.inc()
+        # numeric_version(None) == 0: restoring the construction-time
+        # params restores version 0, the number they were serving as
+        self.engine.stage_params(params, numeric_version(token),
+                                 trace_id=current_trace_id())
+        emit_event("weights.rollback_staged", subscriber=self.name,
+                   bad_token=str(bad[0]), restored_token=str(token))
+        return token
+
+    # ------------------------------------------------------------ helpers
+    def wait_for_version(self, version: int, timeout: float = 30.0,
+                         step=None) -> bool:
+        """Block until the engine SERVES numeric ``version`` (the swap
+        applied, not merely staged). ``step``: optional zero-arg
+        callable invoked each wait tick for engines nobody else is
+        stepping (tests driving a bare engine)."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if int(getattr(self.engine, "weights_version", -1)) == int(
+                    version):
+                return True
+            if step is not None:
+                step()
+            time.sleep(0.005)
+        return False
+
+    def _to_params(self, weights):
+        """Default conversion: unflatten the PS's flat weight list into
+        the engine's current parameter treedef, casting each leaf to
+        the engine leaf's dtype ON THIS THREAD (the device transfer is
+        the expensive half of a swap — it must not run on the engine
+        loop)."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(self.engine.params)
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"parameter plane serves {len(weights)} tensors but the "
+                f"engine's params hold {len(leaves)} leaves — was the "
+                "PS built from jax.tree_util.tree_leaves(params)?")
+        new_leaves = []
+        for w, leaf in zip(weights, leaves):
+            if tuple(w.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"pulled tensor shape {tuple(w.shape)} != engine "
+                    f"leaf shape {tuple(leaf.shape)} (leaf order must "
+                    "match tree_leaves order)")
+            new_leaves.append(jnp.asarray(w, dtype=leaf.dtype))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -42,7 +42,14 @@ def test_lambda_hooks_fire_in_order():
 def test_early_stopping_halts_training():
     x, y = _data()
     model = _model(lr=0.0)  # loss cannot improve
+    # shuffle=False: with lr=0 the weights never change, but per-epoch
+    # SHUFFLING reorders the float summation across batches, so epoch
+    # losses differ in the last ulps and an occasional "improvement"
+    # resets patience (observed as 5 epochs on some machines). A fixed
+    # batch order makes every epoch's loss bit-identical — the
+    # "cannot improve" premise this test is about.
     history = model.fit(x, y, epochs=20, batch_size=64, verbose=0,
+                        shuffle=False,
                         callbacks=[EarlyStopping(monitor="loss", patience=2)])
     # first epoch sets best, then patience=2 non-improving epochs -> stop
     # (Keras semantics: wait >= patience)
@@ -110,7 +117,10 @@ def test_model_checkpoint_save_best_only(tmp_path):
     x, y = _data()
     ckpt_dir = str(tmp_path / "best")
     model = _model(lr=0.0)  # loss never improves after the first epoch
-    model.fit(x, y, epochs=4, batch_size=64, verbose=0,
+    # shuffle=False keeps every epoch's loss bit-identical (see
+    # test_early_stopping_halts_training): with per-epoch shuffling a
+    # last-ulp "improvement" sometimes saved a second checkpoint
+    model.fit(x, y, epochs=4, batch_size=64, verbose=0, shuffle=False,
               callbacks=[ModelCheckpoint(ckpt_dir, monitor="loss",
                                          save_best_only=True)])
     from elephas_tpu.utils.checkpoint import CheckpointManager
@@ -122,11 +132,15 @@ def test_early_stopping_reusable_across_fits():
     x, y = _data()
     es = EarlyStopping(monitor="loss", patience=2)
     m1 = _model(lr=0.0)
-    h1 = m1.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
+    # shuffle=False: bit-identical epoch losses, so "never improves"
+    # holds on every machine (see test_early_stopping_halts_training)
+    h1 = m1.fit(x, y, epochs=20, batch_size=64, verbose=0, shuffle=False,
+                callbacks=[es])
     assert len(h1.history["loss"]) == 3
     # state must reset: a second fit runs its own full patience cycle
     m2 = _model(lr=0.0)
-    h2 = m2.fit(x, y, epochs=20, batch_size=64, verbose=0, callbacks=[es])
+    h2 = m2.fit(x, y, epochs=20, batch_size=64, verbose=0, shuffle=False,
+                callbacks=[es])
     assert len(h2.history["loss"]) == 3
 
 

@@ -106,17 +106,63 @@ def test_fast_path_single_new_token():
 
 def test_fast_path_repetition_penalty_semantics():
     """Rep penalty through the fast path matches the ragged scan: the
-    prompt marks the seen buffer, then each emitted token does."""
+    prompt marks the seen buffer, then each emitted token does.
+
+    The two paths are different XLA programs, so their f32 logits
+    differ in the last ulps and an argmax near-tie can flip between
+    them on a given machine, cascading for the rest of that row (the
+    PR 2/PR 7 machine-numerics class). Comparison is therefore token-
+    exact UP TO a provable near-tie: at a row's first divergence the
+    penalized next-token logits are recomputed from ``forward`` plus
+    the documented CTRL rule, and the top-2 gap must sit below the
+    cross-program noise — a genuine seen-buffer bug (prompt unmarked,
+    emissions unmarked) perturbs penalized logits by a factor of p on
+    O(0.1+) values and still fails decisively."""
+    from elephas_tpu.models.transformer import forward
+
     config = _config()
+    p = 1.4
     params = init_params(config, jax.random.PRNGKey(0))
     prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 6),
                                            0, config.vocab_size))
     fast = np.asarray(generate(params, prompt, 8, config,
-                               repetition_penalty=1.4))
+                               repetition_penalty=p))
     slow = np.asarray(generate(params, prompt, 8, config,
-                               repetition_penalty=1.4,
+                               repetition_penalty=p,
                                prompt_lengths=np.full(2, 6)))
-    np.testing.assert_array_equal(fast, slow)
+
+    def penalized_next_logits(prefix):
+        # reference semantics, recomputed independently: every prefix
+        # token (prompt or emitted) is "seen"; CTRL shrinks seen
+        # tokens' logits toward less-likely on either side of zero
+        logits = np.asarray(
+            forward(params, np.asarray([prefix], np.int32), config)
+            [0, -1], np.float32).copy()
+        seen = sorted(set(int(t) for t in prefix))
+        for tok in seen:
+            logits[tok] = (logits[tok] / p if logits[tok] > 0
+                           else logits[tok] * p)
+        return logits
+
+    for b in range(fast.shape[0]):
+        for t in range(fast.shape[1]):
+            if int(fast[b, t]) == int(slow[b, t]):
+                continue
+            prefix = ([int(x) for x in prompt[b]]
+                      + [int(x) for x in fast[b, :t]])
+            logits = penalized_next_logits(prefix)
+            # BOTH divergent tokens must be the near-tied pair: a
+            # seen-buffer bug emitting an unrelated token fails even
+            # at a step where some other pair happens to tie
+            top = float(logits.max())
+            gap_fast = top - float(logits[int(fast[b, t])])
+            gap_slow = top - float(logits[int(slow[b, t])])
+            assert max(gap_fast, gap_slow) < 1e-3, (
+                f"row {b} diverges at step {t} ({fast[b, t]} vs "
+                f"{slow[b, t]}) and the tokens are NOT a near-tied "
+                f"pair (penalized gaps to max: {gap_fast:.6f} / "
+                f"{gap_slow:.6f}) — a real semantics mismatch")
+            break   # post-tie tokens legitimately diverge
 
 
 @pytest.mark.parametrize("variant", ["base", "gqa", "window", "kvq"])

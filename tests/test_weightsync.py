@@ -1,0 +1,692 @@
+"""Live weight plane: versioned PS polls, engine hot-swap under
+traffic, disaggregated version stamping, and the canary rollout.
+
+Four layers, matching ``elephas_tpu/weightsync/``'s story:
+
+- the PS **version-poll contract** (version bumps exactly once per
+  delta/restore, the cached encoded snapshot still rebuilds at most
+  once per version under concurrent subscribers, a restarted-from-
+  snapshot shard answers a CHANGED version);
+- the **WeightSubscriber** (baseline-without-pull at start, pull on a
+  moved version, rollback restores the previous generation and vetoes
+  the bad token);
+- **hot-swap under traffic**: a served engine (and a disaggregated
+  pool fed by a SHARDED plane) rides through >= 3 live versions with
+  zero failed client requests, post-swap outputs provably from the new
+  weights, and the weight version advancing on ``/stats`` and
+  ``/metrics``;
+- the **CanaryController**: an injected latency regression on the
+  canary replica auto-rolls back (the stable cohort never takes the
+  bad version) while a clean version promotes fleet-wide — each
+  rollout's events joined by one trace id through the event log.
+"""
+import itertools
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import HttpClient, SocketClient
+from elephas_tpu.parameter.factory import (create_sharded_client,
+                                           create_sharded_server)
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+from elephas_tpu.weightsync import CanaryController, WeightSubscriber
+from elephas_tpu.weightsync.subscriber import numeric_version
+
+_PORT = itertools.count(28900)
+
+
+def _weights(seed=0, sizes=(48, 7, 33, 12)):
+    rng = np.random.default_rng(seed)
+    return [rng.random(n).astype(np.float32) * 2 - 1 for n in sizes]
+
+
+def _model_dict(weights=None):
+    return {"model": None,
+            "weights": weights if weights is not None else _weights()}
+
+
+def _post(url, body, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+# ------------------------------------------------ PS version-poll contract
+
+@pytest.mark.parametrize("transport", ["socket", "http"])
+def test_version_bumps_exactly_once_per_delta_and_restore(transport):
+    server_cls = {"socket": SocketServer, "http": HttpServer}[transport]
+    client_cls = {"socket": SocketClient, "http": HttpClient}[transport]
+    port = next(_PORT)
+    server = server_cls(_model_dict(), port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port=port)
+        assert client.get_version() == 0
+        zeros = [np.zeros_like(w) for w in _weights()]
+        client.update_parameters(zeros)
+        assert client.get_version() == 1, \
+            "one delta = exactly one version bump"
+        client.update_parameters(zeros)
+        assert client.get_version() == 2
+        # the versioned pull reads (version, payload) as one pair
+        v, weights = client.get_parameters_versioned()
+        assert v == 2
+        np.testing.assert_array_equal(weights[0], _weights()[0])
+        snap = server.snapshot()
+        assert snap["weights_version"] == 2
+        server.restore(snap)
+        # a restart-shaped restore (snapshot at-or-above the restoring
+        # server's own counter) JUMPS clear of the dead predecessor's
+        # unknowable post-snapshot trajectory instead of bumping once —
+        # +1 could alias a version a subscriber already pulled from the
+        # dead server and silently hide the restart
+        jumped = 2 + server_cls.RESTORE_VERSION_JUMP
+        assert client.get_version() == jumped
+        client.update_parameters(zeros)
+        assert client.get_version() == jumped + 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_duplicate_update_id_bumps_version_once():
+    """The idempotency window and the version counter must agree: a
+    resent delta (lost-ack retry) is applied once, so it bumps the
+    version once."""
+    server = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    delta = [np.ones_like(w) for w in _weights()]
+    server.apply_delta(delta, update_id="abc")
+    server.apply_delta(delta, update_id="abc")   # duplicate resend
+    assert server.weights_version == 1
+    server.apply_delta(delta, update_id="def")
+    assert server.weights_version == 2
+
+
+def test_concurrent_versioned_reads_share_one_rebuild():
+    """``encoded_weights_versioned`` under concurrent subscribers:
+    at most one encode per version (the ``encode_count`` hook), every
+    reader sees the same consistent (version, payload) pair."""
+    server = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    results = []
+    lock = threading.Lock()
+
+    def read():
+        v, payload = server.encoded_weights_versioned()
+        with lock:
+            results.append((v, bytes(payload)))
+
+    threads = [threading.Thread(target=read) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert server.encode_count == 1
+    assert len(set(results)) == 1
+    assert results[0][0] == 0
+    # a delta invalidates once; the next reads rebuild exactly once
+    server.apply_delta([np.zeros_like(w) for w in _weights()])
+    v1, p1 = server.encoded_weights_versioned()
+    v2, p2 = server.encoded_weights_versioned()
+    assert (v1, v2) == (1, 1)
+    assert p1 is p2 and server.encode_count == 2
+
+
+def test_restore_never_aliases_dead_servers_post_snapshot_versions():
+    """The restart-alias regression: the dead server kept applying
+    deltas AFTER the snapshot it was later rebuilt from, so a naive
+    ``snapshot_version + 1`` could land exactly on (or later climb
+    through) a version a subscriber pulled from the dead server — the
+    subscriber would compare equal and silently keep the dead server's
+    weights. The restore jump keeps trajectories disjoint."""
+    delta = [np.zeros_like(w) for w in _weights()]
+    dead = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    dead.apply_delta(delta)             # v1
+    snap = dead.snapshot()              # supervision snapshotted at v1
+    dead.apply_delta(delta)             # v2: a subscriber saw THIS
+    subscriber_saw = dead.weights_version
+    fresh = SocketServer(_model_dict(), next(_PORT), "asynchronous")
+    fresh.restore(snap)
+    assert fresh.weights_version != subscriber_saw
+    assert fresh.weights_version > subscriber_saw, \
+        "the restored trajectory must sit clear ABOVE the dead one, " \
+        "or future deltas would climb through versions already served"
+
+
+def test_restarted_shard_answers_changed_version():
+    """A subscriber polling a sharded plane must detect a shard that
+    was rebuilt from its snapshot: the restarted server resumes PAST
+    the snapshot's version, so the tuple moves even though the weights
+    round-tripped bit-identically."""
+    ws = _weights()
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2)
+        assert client.get_version() == (0, 0)
+        client.update_parameters([np.zeros_like(w) for w in ws])
+        v_before = client.get_version()
+        assert v_before == (1, 1)
+        versions, weights = client.get_parameters_versioned()
+        assert versions == (1, 1)
+        np.testing.assert_array_equal(weights[0], ws[0])
+        snap = group.snapshot_shard(0)
+        group.restart_shard(0, snap)
+        v_after = client.get_version()
+        assert v_after != v_before, \
+            "restart-from-snapshot must answer a CHANGED version"
+        assert v_after[1] == v_before[1]   # the survivor never moved
+        client.close()
+    finally:
+        group.stop()
+
+
+# ------------------------------------------------------- subscriber units
+
+class _FakeEngine:
+    """Engine double for subscriber-policy tests: records stagings."""
+
+    def __init__(self, params):
+        self.params = params
+        self.weights_version = 0
+        self.staged = []
+
+    def stage_params(self, params, version, trace_id=None):
+        self.staged.append((params, int(version), trace_id))
+        self.params = params
+        self.weights_version = int(version)
+
+
+def test_subscriber_baselines_without_pulling_then_pulls_on_change():
+    import jax.numpy as jnp
+
+    ws = _weights()
+    port = next(_PORT)
+    server = SocketServer(_model_dict(ws), port, "asynchronous")
+    server.start()
+    try:
+        engine = _FakeEngine([jnp.asarray(w) for w in ws])
+        sub = WeightSubscriber(engine, SocketClient(port=port),
+                               poll_interval=60)  # poll manually
+        sub.start()
+        assert sub.poll_once() is False, \
+            "the start() baseline is current: no pull before a change"
+        assert engine.staged == []
+        delta = [np.full_like(w, 0.25) for w in ws]
+        server.apply_delta(delta)
+        assert sub.poll_once() is True
+        assert engine.weights_version == 1
+        np.testing.assert_allclose(np.asarray(engine.params[0]),
+                                   ws[0] - 0.25, rtol=1e-6)
+        # rollback restores the previous generation and vetoes the bad
+        # token so auto polling cannot immediately re-stage it
+        sub.rollback()
+        assert engine.weights_version == 0
+        np.testing.assert_array_equal(np.asarray(engine.params[0]), ws[0])
+        assert sub.poll_once() is False, "vetoed token must not re-pull"
+        server.apply_delta(delta)            # a NEW version clears the road
+        assert sub.poll_once() is True
+        assert engine.weights_version == 2
+        sub.stop()
+    finally:
+        server.stop()
+
+
+def test_default_convert_rejects_mismatched_layout():
+    import jax.numpy as jnp
+
+    engine = _FakeEngine({"a": jnp.zeros((2, 3)), "b": jnp.zeros(4)})
+
+    class _Cli:
+        def close(self):
+            pass
+
+    sub = WeightSubscriber(engine, _Cli(), poll_interval=60)
+    with pytest.raises(ValueError, match="leaves"):
+        sub._convert([np.zeros((2, 3), np.float32)])
+    with pytest.raises(ValueError, match="shape"):
+        sub._convert([np.zeros((3, 2), np.float32),
+                      np.zeros(4, np.float32)])
+
+
+def test_pull_pins_expected_token_and_vetoes_convert_failures():
+    import jax.numpy as jnp
+
+    ws = _weights()
+    port = next(_PORT)
+    server = SocketServer(_model_dict(ws), port, "asynchronous")
+    server.start()
+    try:
+        # expect_token: the plane serves v0, the caller baked something
+        # else — nothing may stage (the canary-promotion pin: training
+        # pushing mid-rollout must not ship an unbaked version)
+        engine = _FakeEngine([jnp.asarray(w) for w in ws])
+        sub = WeightSubscriber(engine, SocketClient(port=port),
+                               poll_interval=60)
+        assert sub.pull(expect_token=999) is None
+        assert engine.staged == []
+        sub.client.close()
+
+        # convert failure: the engine's layout cannot adopt the plane's
+        # weights — the token is VETOED so auto polling stops paying a
+        # full download per poll interval for a deterministic failure
+        short = _FakeEngine([jnp.asarray(ws[0])])   # 1 leaf vs 4 served
+        sub2 = WeightSubscriber(short, SocketClient(port=port),
+                                poll_interval=60)
+        with pytest.raises(ValueError, match="leaves"):
+            sub2.pull()
+        assert short.staged == []
+        assert sub2.poll_once() is False, \
+            "the vetoed token must not re-download on the next poll"
+        server.apply_delta([np.zeros_like(w) for w in ws])
+        with pytest.raises(ValueError, match="leaves"):
+            # a NEW version is probed once (the layout might be fixed)
+            sub2.pull()
+        sub2.client.close()
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------- the LM test fixtures
+
+def _lm():
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import TransformerConfig, init_params
+
+    config = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                               d_model=16, d_ff=32, max_seq_len=32,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def _leaves(params):
+    import jax
+
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(params)]
+
+
+def _unflatten_like(params, leaves):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [jnp.asarray(leaf) for leaf in leaves])
+
+
+def _noise(leaves, seed, scale=0.5):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(0, scale, leaf.shape).astype(np.float32)
+            for leaf in leaves]
+
+
+def _oracle(config, params, prompt, n):
+    from elephas_tpu.models.transformer import generate
+
+    return [int(t) for t in
+            np.asarray(generate(params, np.asarray([prompt]), n,
+                                config))[0]]
+
+
+class _Traffic:
+    """Background client hammering ``/v1/generate``; every response
+    must be a clean 200 "done" — one failure fails the test."""
+
+    def __init__(self, url, prompts, max_new_tokens=4):
+        self.url = url
+        self.prompts = prompts
+        self.max_new_tokens = max_new_tokens
+        self.failures = []
+        self.completed = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=60)
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            prompt = self.prompts[i % len(self.prompts)]
+            i += 1
+            try:
+                status, body = _post(
+                    f"{self.url}/v1/generate",
+                    {"prompt": prompt,
+                     "max_new_tokens": self.max_new_tokens})
+                if status != 200 or body.get("status") != "done":
+                    self.failures.append((status, body))
+                else:
+                    self.completed += 1
+            except Exception as exc:  # noqa: BLE001 — any client error
+                self.failures.append(repr(exc))
+
+
+def _wait(predicate, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------- hot swap under live traffic
+
+@pytest.mark.slow
+def test_served_engine_rides_three_live_versions_under_traffic():
+    """The headline loop: a ServingServer's engine subscribes to a PS;
+    three pushed deltas hot-swap with zero dropped/failed requests,
+    the weight version advances on /stats and /metrics, and a post-
+    swap probe's output equals the solo-generate oracle under the NEW
+    weights (f32: engine output is token-identical to ``generate``)."""
+    from elephas_tpu.obs.events import recent_events
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.serving_http import ServingServer
+
+    config, p0 = _lm()
+    leaves0 = _leaves(p0)
+    port = next(_PORT)
+    ps = SocketServer(_model_dict([leaf.copy() for leaf in leaves0]),
+                      port, "asynchronous")
+    ps.start()
+    engine = DecodeEngine(p0, config, max_slots=2)
+    server = ServingServer(engine, port=0).start()
+    sub = WeightSubscriber(engine, SocketClient(port=port),
+                           poll_interval=0.05).start()
+    pusher = SocketClient(port=port)
+    url = f"http://127.0.0.1:{server.port}"
+    rng = np.random.default_rng(7)
+    prompts = [[int(t) for t in rng.integers(0, 64, rng.integers(3, 6))]
+               for _ in range(6)]
+    traffic = _Traffic(url, prompts).start()
+    try:
+        leaves = [leaf.copy() for leaf in leaves0]
+        for version in (1, 2, 3):
+            delta = _noise(leaves0, seed=version)
+            pusher.update_parameters(delta)
+            # subtract_params semantics: new = old - delta (numpy f32,
+            # bit-exact against the oracle below)
+            leaves = [leaf - d for leaf, d in zip(leaves, delta)]
+            _wait(lambda v=version: json.loads(_get(f"{url}/stats"))
+                  ["weights_version"] == v,
+                  msg=f"swap to version {version}")
+        # traffic observed at least something per version window
+        _wait(lambda: traffic.completed >= 6, msg="traffic volume")
+    finally:
+        traffic.stop()
+    try:
+        assert traffic.failures == [], traffic.failures
+        stats = json.loads(_get(f"{url}/stats"))
+        assert stats["weights_version"] == 3
+        assert stats["weight_swaps"] >= 3
+        metrics = _get(f"{url}/metrics").decode()
+        assert "serving_weights_version 3" in metrics
+        assert "serving_weight_swaps_total" in metrics
+        assert "weightsync_pulls_total" in metrics
+        # post-swap outputs provably from the NEW weights: the probe
+        # equals the v3 oracle and differs from the v0 oracle
+        probe = [3, 5, 7, 9]
+        p3 = _unflatten_like(p0, leaves)
+        want = _oracle(config, p3, probe, 6)
+        was = _oracle(config, p0, probe, 6)
+        status, body = _post(f"{url}/v1/generate",
+                             {"prompt": probe, "max_new_tokens": 6})
+        assert status == 200 and body["tokens"] == want
+        assert want != was, "versions must be distinguishable"
+        swaps = [e for e in recent_events(event="weights.swapped")
+                 if e.get("version") in (1, 2, 3)]
+        assert {e["version"] for e in swaps} >= {1, 2, 3}
+        # the flight recorder stamps the version a request decoded under
+        trace = engine.recent_traces(limit=8)[-1]
+        admitted = [e for e in trace["events"]
+                    if e["event"] == "admitted"]
+        assert admitted and admitted[0]["weights_version"] == 3
+    finally:
+        sub.stop()
+        pusher.close()
+        server.stop()
+        ps.stop()
+
+
+@pytest.mark.slow
+def test_disagg_pool_version_stamped_swap_from_sharded_plane():
+    """Disaggregated + sharded: decode and prefill engines subscribe
+    (managed) to a 2-shard plane. Swapping the decode side FIRST makes
+    the next shipped KV frame a version mismatch — rejected and
+    retried through the sibling-retry path, never a failed client
+    request — and once the prefill side pulls, the fleet converges.
+    Three versions total; outputs provably from the final weights."""
+    from elephas_tpu.disagg import DisaggPool
+    from elephas_tpu.obs.events import recent_events
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    config, p0 = _lm()
+    leaves0 = _leaves(p0)
+    port = next(_PORT)
+    group = create_sharded_server(
+        "socket", _model_dict([leaf.copy() for leaf in leaves0]), port,
+        "asynchronous", 2)
+    group.start()
+    pool = DisaggPool(
+        lambda: DecodeEngine(p0, config, max_slots=2, tier="decode"),
+        prefill_factory=lambda: DecodeEngine(p0, config, max_slots=1),
+        n_prefill=1, n_decode=1, quant=False, block_size=8).start()
+
+    def shard_client():
+        return create_sharded_client("socket", port, _model_dict(leaves0),
+                                     2)
+
+    decode_sub = WeightSubscriber(pool.engines[0], shard_client(),
+                                  poll_interval=60, auto=False,
+                                  name="decode-0").start()
+    prefill_sub = WeightSubscriber(pool.prefill_workers[0].engine,
+                                   shard_client(), poll_interval=60,
+                                   auto=False, name="prefill-0").start()
+    pusher = shard_client()
+    url = pool.urls[0]
+    probe = [3, 5, 7, 9]
+    leaves = [leaf.copy() for leaf in leaves0]
+    try:
+        status, body = _post(f"{url}/v1/generate",
+                             {"prompt": probe, "max_new_tokens": 5})
+        assert status == 200 and body["status"] == "done"
+        numeric = 0
+        for round_i in (1, 2, 3):
+            delta = _noise(leaves0, seed=10 + round_i)
+            pusher.update_parameters(delta)
+            leaves = [leaf - d for leaf, d in zip(leaves, delta)]
+            numeric += 2                       # two shards, +1 each
+            # decode side first: the prefill tier is now STALE
+            assert decode_sub.pull() is not None
+            _wait(lambda: pool.engines[0].weights_version == numeric,
+                  msg=f"decode swap to {numeric}")
+            if round_i == 1:
+                # a request submitted NOW ships v0-stamped KV into a
+                # v2 decode engine: rejected + retried, never failed
+                before = len(recent_events(
+                    event="disagg.kv_version_mismatch"))
+                result = {}
+
+                def gen():
+                    result["resp"] = _post(
+                        f"{url}/v1/generate",
+                        {"prompt": probe, "max_new_tokens": 5},
+                        timeout=120)
+
+                t = threading.Thread(target=gen, daemon=True)
+                t.start()
+                _wait(lambda: len(recent_events(
+                    event="disagg.kv_version_mismatch")) > before,
+                    msg="version-mismatch rejection")
+                prefill_sub.pull()
+                t.join(timeout=60)
+                assert not t.is_alive(), "request never completed"
+                status, body = result["resp"]
+                assert status == 200 and body["status"] == "done", body
+            else:
+                prefill_sub.pull()
+            # the prefill engine applies its staged swap at the next
+            # JOB boundary — the generate below forces one, and its
+            # export is already stamped with the new version
+            status, body = _post(f"{url}/v1/generate",
+                                 {"prompt": probe, "max_new_tokens": 5},
+                                 timeout=120)
+            assert status == 200 and body["status"] == "done", body
+        stats = json.loads(_get(f"{url}/stats"))
+        assert stats["weights_version"] == numeric == 6
+        p_final = _unflatten_like(p0, leaves)
+        want = _oracle(config, p_final, probe, 5)
+        assert body["tokens"] == want, (body["tokens"], want)
+        mism = recent_events(event="disagg.kv_version_mismatch")
+        assert mism, "the stale frame must have been version-rejected"
+        assert any(e["event"] == "kv_rejected"
+                   for tr in pool.engines[0].recent_traces(limit=16)
+                   for e in tr["events"]), \
+            "the rejection must be on a flight-recorder timeline"
+    finally:
+        decode_sub.stop()
+        prefill_sub.stop()
+        pusher.close()
+        pool.stop()
+        group.stop()
+
+
+# ----------------------------------------------------------- canary tests
+
+@pytest.mark.slow
+def test_canary_rolls_back_regression_and_promotes_clean_version():
+    """The rollout gate end to end: version 1 makes the CANARY's steps
+    slow (the injected latency regression) → auto-rollback, stable
+    cohort never swaps; version 2 is clean → fleet-wide promote. Both
+    rollouts' events join on one trace id each, and no client request
+    ever fails."""
+    from elephas_tpu.obs.events import recent_events
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.serving_http import ServingServer
+
+    config, p0 = _lm()
+    leaves0 = _leaves(p0)
+    port = next(_PORT)
+    ps = SocketServer(_model_dict([leaf.copy() for leaf in leaves0]),
+                      port, "asynchronous")
+    ps.start()
+
+    class LagsOnVersion(DecodeEngine):
+        """Injected regression: steps crawl while serving BAD_VERSION
+        — only the canary instance gets the attribute set."""
+
+        bad_version = None
+
+        def _step_impl(self):
+            out = super()._step_impl()
+            if (self.bad_version is not None
+                    and self.weights_version == self.bad_version):
+                time.sleep(0.1)
+            return out
+
+    engines = [LagsOnVersion(p0, config, max_slots=2) for _ in range(3)]
+    engines[0].bad_version = 1          # the canary is replica 0
+    servers = [ServingServer(e, port=0).start() for e in engines]
+    subs = [WeightSubscriber(e, SocketClient(port=port), auto=False,
+                             poll_interval=60, name=f"replica-{i}")
+            .start()
+            for i, e in enumerate(engines)]
+    controller = CanaryController(
+        subs, canary=0, bake_s=0.3, min_requests=3, bake_timeout_s=30,
+        latency_ratio=1.5, latency_slack_s=0.05, swap_timeout_s=30)
+    pusher = SocketClient(port=port)
+    rng = np.random.default_rng(3)
+    prompts = [[int(t) for t in rng.integers(0, 64, 4)] for _ in range(4)]
+    traffics = [_Traffic(f"http://127.0.0.1:{s.port}", prompts,
+                         max_new_tokens=3).start() for s in servers]
+    try:
+        assert controller.poll_and_roll() == "noop"
+        # --- version 1: regression on the canary ---
+        pusher.update_parameters(_noise(leaves0, seed=21))
+        outcome = controller.poll_and_roll()
+        assert outcome == "rolled_back", outcome
+        assert engines[0].weights_version == 0, "canary restored"
+        assert all(e.weights_version == 0 for e in engines[1:]), \
+            "the stable cohort must NEVER take the bad version"
+        rolled = recent_events(event="weights.rolled_back")
+        assert rolled and rolled[-1]["version"] == 1
+        assert rolled[-1]["reason"] == "latency_regression"
+        tid = rolled[-1]["trace_id"]
+        assert tid is not None
+        story = {e["event"] for e in recent_events(trace_id=tid)}
+        assert {"weights.rollout_started", "weights.staged",
+                "weights.swapped", "weights.rolled_back"} <= story, story
+        # vetoed: the same version never re-rolls
+        assert controller.poll_and_roll() == "noop"
+        # --- version 2: clean → fleet-wide ---
+        pusher.update_parameters(_noise(leaves0, seed=22))
+        outcome = controller.poll_and_roll()
+        assert outcome == "promoted", outcome
+        assert all(e.weights_version == 2 for e in engines)
+        promoted = recent_events(event="weights.promoted")
+        assert promoted and promoted[-1]["version"] == 2
+        tid2 = promoted[-1]["trace_id"]
+        assert tid2 is not None and tid2 != tid
+        story2 = {e["event"] for e in recent_events(trace_id=tid2)}
+        assert {"weights.rollout_started", "weights.staged",
+                "weights.swapped", "weights.promoted"} <= story2, story2
+        # three swap events under rollout 2's id: canary + two stables
+        swaps2 = [e for e in recent_events(trace_id=tid2)
+                  if e["event"] == "weights.swapped"]
+        assert len(swaps2) == 3, swaps2
+    finally:
+        for t in traffics:
+            t.stop()
+    try:
+        for t in traffics:
+            assert t.failures == [], t.failures
+            assert t.completed > 0
+    finally:
+        for sub in subs:
+            sub.stop()
+        pusher.close()
+        for s in servers:
+            s.stop()
+        ps.stop()
+
+
+def test_canary_controller_validates_arguments():
+    with pytest.raises(ValueError, match="at least one"):
+        CanaryController([])
+    engine = _FakeEngine({})
+
+    class _Cli:
+        def close(self):
+            pass
+
+    sub = WeightSubscriber(engine, _Cli(), poll_interval=60)
+    with pytest.raises(ValueError, match="canary index"):
+        CanaryController([sub], canary=3)
+    with pytest.raises(ValueError, match="on_no_traffic"):
+        CanaryController([sub], on_no_traffic="shrug")
+    # construction flips subscribers to managed mode
+    sub.auto = True
+    CanaryController([sub])
+    assert sub.auto is False
