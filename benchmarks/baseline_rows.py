@@ -261,8 +261,9 @@ def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
     in-process ``ReplicaPool`` with lazy per-replica prefix caching —
     the prefix-cache hit-rate win cache-aware placement buys (and the
     CPU-measurable proxy-path round trip, so the router bench cannot
-    rot while the chip tunnel is down). A cold prefix registration is a
-    MISS (that head's KV was not resident on the routed-to replica);
+    rot while the chip tunnel is down). A cold head is a MISS (no
+    cached block for it was resident on the routed-to replica — the
+    automatic block cache that replaced PR 6's lazy registration);
     hit rate is ``1 - misses/requests``."""
     import json as _json
     import urllib.request
@@ -330,8 +331,9 @@ def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
             "requests": n_requests,
             "config": f"{n_replicas} in-process replicas, "
                       f"{n_groups} shared {prefix_len}-token prefixes, "
-                      f"{n_requests} proxied generates, lazy per-replica "
-                      "prefix registration (miss = cold registration)"}
+                      f"{n_requests} proxied generates, automatic "
+                      "per-replica block cache (miss = no cached block "
+                      "for the routed head)"}
 
 
 def _disagg_model(max_seq_len: int):
@@ -981,6 +983,107 @@ def measure_weight_swap(smoke=False):
                        "re-prefill to the pause)")}
 
 
+def measure_prefix_cache(smoke=False):
+    """Automatic prefix caching row: a shared-prefix serving workload
+    (the system-prompt pattern, UNREGISTERED — nobody curates prefixes
+    at fleet scale) through one paged engine, cache on vs off.
+    Admission cost = the flight recorder's per-request ``prefill``
+    duration (the queue-to-admitted prefill work a hit turns into a
+    pointer install + suffix extend); both engines drain identical
+    traffic twice (pass 1 compiles AND warms the cache — pass 2 is the
+    steady state measured) and per-request outputs are asserted
+    token-identical both ways. The acceptance scalar is
+    ``admission_p50_reduction`` (>= 2x on the dev box)."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs import percentile
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        layers, d_model, d_ff, vocab = 2, 64, 128, 500
+        n_groups, n_requests = 2, 6
+        prefix_len, suffix_len, max_new = 48, 8, 8
+    else:
+        layers, d_model, d_ff, vocab = 4, 256, 1024, 8000
+        n_groups, n_requests = 4, 24
+        prefix_len, suffix_len, max_new = 160, 8, 16
+    block = 16
+    max_slots = 4
+    prompt_len = prefix_len + suffix_len
+    # f32 compute: the token-identical assertion is the row's whole
+    # point, and under bf16 the hit path's extend program vs the full
+    # prefill program round differently (~5e-4 on logits — the module-
+    # docstring cross-program caveat), flipping argmax near-ties
+    c = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                          num_heads=8, d_model=d_model, d_ff=d_ff,
+                          max_seq_len=prompt_len + max_new,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    heads = [list(rng.integers(0, vocab, prefix_len))
+             for _ in range(n_groups)]
+    prompts = [np.asarray(heads[i % n_groups]
+                          + list(rng.integers(0, vocab, suffix_len)))
+               for i in range(n_requests)]
+    rng.shuffle(prompts)
+    per_req = -(-(prompt_len + max_new) // block)
+    # pool: full slot concurrency plus cache headroom for every group's
+    # head (the sizing rule the serving-operations runbook documents)
+    n_blocks = 1 + max_slots * per_req + n_groups * (prefix_len // block)
+
+    def drain(eng):
+        start = time.perf_counter()
+        rids = [eng.submit(p, max_new) for p in prompts]
+        while eng.pending:
+            eng.step()
+        outs = [eng.result(r) for r in rids]
+        elapsed = time.perf_counter() - start
+        prefills = [e["duration_s"]
+                    for t in eng.recorder.recent(limit=n_requests)
+                    for e in t["events"] if e["event"] == "prefill"]
+        return outs, n_requests * max_new / elapsed, prefills
+
+    results = {}
+    for label, cache_on in (("off", False), ("on", True)):
+        eng = DecodeEngine(params, c, max_slots=max_slots,
+                           paged=(n_blocks, block),
+                           prefix_cache=cache_on)
+        drain(eng)                    # compile + (on) warm the cache
+        outs, tps, prefills = drain(eng)
+        results[label] = {"outs": outs, "tps": tps,
+                          "adm_p50": percentile(prefills, 0.5),
+                          "adm_p99": percentile(prefills, 0.99),
+                          "stats": eng.stats}
+    assert results["on"]["outs"] == results["off"]["outs"], \
+        "cache-on outputs diverged from cache-off"
+    on, off = results["on"], results["off"]
+    ks = on["stats"]["kv_cache"]
+    return {"metric": "prefix_cache_admission_p50_ms",
+            "value": round(on["adm_p50"] * 1000, 3),
+            "unit": "ms (admission prefill work, cache on, steady)",
+            "admission_p50_ms_off": round(off["adm_p50"] * 1000, 3),
+            "admission_p99_ms": round(on["adm_p99"] * 1000, 3),
+            "admission_p99_ms_off": round(off["adm_p99"] * 1000, 3),
+            "admission_p50_reduction": round(
+                off["adm_p50"] / max(on["adm_p50"], 1e-9), 2),
+            "tokens_per_sec": round(on["tps"], 1),
+            "tokens_per_sec_off": round(off["tps"], 1),
+            "tokens_per_sec_ratio": round(on["tps"] / off["tps"], 3),
+            "cache_hits": ks["hits"], "cache_misses": ks["misses"],
+            "prefix_tokens_reused": on["stats"]["prefix_tokens_reused"],
+            "outputs_token_identical": True,
+            "config": f"L{layers} d{d_model} ff{d_ff} V{vocab} f32 paged "
+                      f"({n_blocks}x{block}), {n_requests} reqs = "
+                      f"{n_groups} shared {prefix_len}-tok heads + "
+                      f"{suffix_len}-tok suffixes, {max_new} new toks, "
+                      f"{max_slots} slots, automatic (unregistered) "
+                      "block cache, steady-state pass measured"}
+
+
 def _stage_percentiles(recorder, n: int) -> dict:
     """Queue-wait and prefill p50/p99 derived from the newest ``n``
     flight-recorder timelines — the BENCH record's per-stage latency
@@ -1245,6 +1348,8 @@ if __name__ == "__main__":
         _emit(measure_engine())
     if which in ("fleet_router", "all"):
         _emit(measure_fleet_router(smoke=smoke))
+    if which in ("prefix_cache", "all"):
+        _emit(measure_prefix_cache(smoke=smoke))
     if which in ("disagg", "all"):
         _emit(measure_disagg(smoke=smoke))
     if which in ("weight_swap", "all"):

@@ -117,7 +117,9 @@ PAGES = [
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
      ["init_paged_pool", "decode_step_paged", "install_row_paged",
-      "export_kv_blocks", "import_kv_blocks"]),
+      "gather_blocks_to_row", "export_kv_blocks", "import_kv_blocks"]),
+    ("KV block cache", "elephas_tpu.models.block_cache",
+     ["BlockCache", "BlockEntry", "chain_keys"]),
     ("SSMModel", "elephas_tpu.models.ssm_model", ["SSMModel"]),
     ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",
      ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",

@@ -149,7 +149,7 @@ class DisaggEngine:
         # would raise inside the server's engine loop and read as
         # engine death (500s for everyone) instead of one bad request
         self.decode.check_admissible(int(prompt.size),
-                                     int(max_new_tokens))
+                                     int(max_new_tokens), prompt=prompt)
         validate_sampling_overrides(temperature, top_k, top_p)
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")

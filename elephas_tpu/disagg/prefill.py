@@ -79,6 +79,17 @@ class PrefillWorker:
         bytes) instead of raw-dtype KV blocks.
     :param block_size: wire block size
         (:func:`~elephas_tpu.models.paged_decode.export_kv_blocks`).
+    :param prefix_cache: enable the engine's TIER-LOCAL automatic
+        prefix cache (host-array-backed, at the wire block size): a
+        repeat prompt head skips its prefill compute entirely before
+        the KV ever hits the wire. The cached head's positions ship
+        bit-identically; the recomputed remainder agrees to float
+        rounding (a different XLA program than whole-prompt prefill —
+        the same caveat every chunked/prefix-reuse path carries), so
+        decode output parity is unchanged and the decode side needs no
+        changes. Default on; the engine's ``serving_kv_cache_*``
+        series (this worker's registry) measure it.
+        ``register_prefix`` on the engine remains the pinning layer.
     :param registry: metrics registry; defaults to the engine's, so one
         scrape covers the worker. The worker observes
         ``serving_queue_wait_seconds{tier="prefill"}`` (dispatch-to-
@@ -89,11 +100,25 @@ class PrefillWorker:
 
     def __init__(self, engine, quant: bool = True, block_size: int = 64,
                  registry: Optional[MetricsRegistry] = None,
-                 name: str = "prefill-0"):
+                 name: str = "prefill-0", prefix_cache: bool = True):
         self.engine = engine
         self.quant = bool(quant)
         self.block_size = int(block_size)
         self.name = str(name)
+        if prefix_cache and getattr(engine, "paged", None) is None:
+            # tier-local automatic prefix cache at the wire block size
+            # (host-backed — this engine never decodes, its pool is its
+            # export rows); paged export engines keep their own pool
+            # cache for admissions and are left alone here. This is a
+            # DEFAULT-ON path, so ineligible engines (speculative mode,
+            # or max_len at/below the wire block size — both worked
+            # before the cache existed) skip enablement instead of
+            # failing worker construction.
+            enable = getattr(engine, "enable_prefix_cache", None)
+            if (enable is not None
+                    and getattr(engine, "draft_config", None) is None
+                    and self.block_size < getattr(engine, "max_len", 0)):
+                enable(block_size=self.block_size)
         self.shipper = KVShipper()
         reg = (registry if registry is not None
                else getattr(engine, "registry", None))
@@ -294,6 +319,9 @@ class PrefillWorker:
                 "first_token": out["first_token"],
                 "prompt_tokens": out["prompt_tokens"],
                 "prefix_tokens": out["prefix_tokens"],
+                # tokens the tier-local automatic cache served (prefill
+                # compute skipped before the wire) — observability only
+                "cached_tokens": out.get("cached_tokens", 0),
                 "prefill_s": out["prefill_s"],
                 # the weight version this KV was computed under — the
                 # decode side rejects (and the dispatcher retries) a

@@ -10,21 +10,23 @@ lifecycle verbs the router's failure-handling tests exercise:
 prober evicts), ``drain(i)`` (graceful — ``/ready`` flips 503, siblings
 absorb new traffic while in-flight work finishes).
 
-``auto_prefix_tokens`` turns on per-replica LAZY prefix registration:
-the first request carrying a given ``prefix_tokens``-long prompt head
-registers it on THAT replica's engine (an admission-time miss — the
-prefill runs once), and every later same-prefix request admitted there
-hits the cached KV state. This is the automatic-prefix-caching analog
-of :meth:`~elephas_tpu.serving_engine.DecodeEngine.register_prefix`'s
-explicit registration, and it is exactly what makes routing policy
-measurable: under consistent-hash routing each prefix warms ONE
-replica and stays hot; under round-robin every replica pays the miss
-for every prefix. ``auto_prefix_capacity`` bounds registrations per
-replica (oldest evicted — each registration pins a device cache row).
+``auto_prefix_tokens`` turns on the engine's AUTOMATIC content-
+addressed prefix cache per replica
+(:meth:`~elephas_tpu.serving_engine.DecodeEngine.enable_prefix_cache`,
+cached at ``auto_prefix_tokens``-token block granularity so the routed
+prompt head is exactly one cache block): the first request carrying a
+given head on a replica prefills it and INSERTS its blocks (an
+admission-time miss), and every later same-head request admitted there
+installs the cached KV. This replaced PR 6's lazy ``register_prefix``
+shim — the block cache subsumed it — but the measurement it exists for
+is unchanged, and it is exactly what makes routing policy measurable:
+under consistent-hash routing each prefix warms ONE replica and stays
+hot; under round-robin every replica pays the miss for every prefix.
+``auto_prefix_capacity`` bounds cached blocks per replica (LRU past
+it).
 """
 import threading
-from collections import OrderedDict
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional
 
 from ..serving_http import ServingServer
 
@@ -32,51 +34,41 @@ __all__ = ["ReplicaPool"]
 
 
 class _AutoPrefixEngine:
-    """Engine wrapper adding lazy bounded prefix registration at
-    submit time. Delegates everything else to the wrapped engine (the
-    ``ServingServer`` probes ``submit``'s signature, so it is mirrored
-    exactly)."""
+    """Thin shim over the engine's automatic block cache: enables it
+    at the routed-head granularity and exposes the ``misses`` count the
+    routing-policy A/B reads. Everything else — including ``submit``,
+    whose signature the ``ServingServer`` probes — delegates straight
+    to the wrapped engine (``__getattr__`` returns the engine's own
+    bound methods)."""
 
     def __init__(self, engine, prefix_tokens: int,
                  capacity: Optional[int] = None):
         self._engine = engine
         self._prefix_tokens = int(prefix_tokens)
-        self._capacity = None if capacity is None else int(capacity)
-        self._known: "OrderedDict[Tuple[int, ...], bool]" = OrderedDict()
-        #: cold registrations — each is a prefix-cache MISS (the head's
-        #: KV state was not resident on THIS replica and had to be
-        #: computed). The routing-policy A/B counts hit rate as
-        #: (requests - misses) / requests: the engine's own
-        #: ``prefix_hits`` counter also counts the registering request
-        #: itself (registration at submit precedes its admission), so
-        #: it cannot distinguish a cold replica from a warm one.
-        self.misses = 0
+        # paged engines already cache at the pool block size; a
+        # contiguous replica gets the host-backed cache with one block
+        # per routed prompt head
+        if getattr(engine, "_kv_cache", None) is None:
+            engine.enable_prefix_cache(
+                block_size=(None if getattr(engine, "paged", None)
+                            is not None else self._prefix_tokens),
+                capacity=capacity)
 
-    def submit(self, prompt, max_new_tokens, temperature=None,
-               top_k=None, top_p=None, admit=True, deadline_ms=None):
-        head = tuple(int(t) for t in prompt[:self._prefix_tokens])
-        # only prompts strictly longer than the head can reuse it (a
-        # prefix must leave room for at least one suffix token)
-        if len(prompt) > len(head) and head and head not in self._known:
-            if (self._capacity is not None
-                    and len(self._known) >= self._capacity):
-                # bounded cache: evict oldest — the engine API has no
-                # single-prefix unregister, so re-register survivors
-                self._known.popitem(last=False)
-                self._engine.clear_prefixes()
-                for kept in self._known:
-                    self._engine.register_prefix(list(kept))
-            self._engine.register_prefix(list(head))
-            self._known[head] = True
-            self.misses += 1
-        return self._engine.submit(prompt, max_new_tokens,
-                                   temperature=temperature, top_k=top_k,
-                                   top_p=top_p, admit=admit,
-                                   deadline_ms=deadline_ms)
+    @property
+    def misses(self) -> int:
+        """Admissions that found NO cached block for a prompt with at
+        least one full block — the head's KV was not resident on THIS
+        replica and had to be computed. The routing-policy A/B counts
+        hit rate as ``(requests - misses) / requests``; the engine's
+        ``serving_kv_cache_hits_total`` counts the warm admissions
+        directly."""
+        return int(self._engine._kv_cache.misses)
 
     @property
     def registered_prefixes(self) -> int:
-        return len(self._known)
+        """Distinct cached blocks (compat surface for the old lazy-
+        registration shim's reading)."""
+        return len(self._engine._kv_cache)
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -90,11 +82,11 @@ class ReplicaPool:
         one engine would serialize the pool on one lock and measure
         nothing).
     :param n: replica count.
-    :param auto_prefix_tokens: when set, wrap each engine with lazy
-        per-replica prefix registration over this prompt-head length
+    :param auto_prefix_tokens: when set, enable each replica engine's
+        automatic prefix cache at this prompt-head block granularity
         (see the module docstring).
-    :param auto_prefix_capacity: max registered prefixes per replica
-        (None = unbounded).
+    :param auto_prefix_capacity: max cached blocks per replica
+        (host-mode LRU bound; None = the engine default).
     :param tokenizer, server_kwargs: forwarded to every
         :class:`~elephas_tpu.serving_http.ServingServer`.
     """

@@ -25,11 +25,19 @@ is never allocated; freed slots' tables are reset to 0, so an inactive
 slot's garbage decode (the engine's static-batch idiom) can never
 write into a block owned by a live request.
 
+The pool is also the storage layer for AUTOMATIC prefix caching
+(:mod:`~elephas_tpu.models.block_cache`): full prompt blocks are
+content-addressed and shared across requests by table pointers —
+:func:`gather_blocks_to_row` turns a cached chain back into a row head
+for remainder prefill, and :func:`install_row_paged`'s ``start``
+offset writes only the private remainder around shared blocks.
+
 Not supported in paged mode (constructor raises): ``kv_cache_quant``
 (compose the int8 cache with the contiguous engine instead) and MoE
 layers.
 """
 import math
+from functools import partial
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -41,8 +49,8 @@ from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
                           _sinusoidal_table, head_logits)
 
 __all__ = ["init_paged_pool", "decode_step_paged", "install_row_paged",
-           "validate_paged_config", "export_kv_blocks",
-           "import_kv_blocks"]
+           "gather_blocks_to_row", "validate_paged_config",
+           "export_kv_blocks", "import_kv_blocks"]
 
 
 def validate_paged_config(config: TransformerConfig):
@@ -68,24 +76,29 @@ def init_paged_pool(config: TransformerConfig, num_blocks: int,
 
 
 def install_row_paged(pool: Dict, row_cache: Dict, block_ids,
-                      nblocks: int) -> Dict:
+                      nblocks: int, start: int = 0) -> Dict:
     """Scatter a contiguous batch-1 prefill row into pool blocks:
-    positions ``[0, nblocks*block_size)`` of ``row_cache`` land in
-    ``block_ids[:nblocks]``. One jit specialization per ``nblocks``
-    (bounded by the per-slot table width)."""
+    positions ``[start*block_size, nblocks*block_size)`` of
+    ``row_cache`` land in ``block_ids[start:nblocks]``. ``start > 0``
+    is the prefix-cache-hit install: the first ``start`` table entries
+    point at SHARED cached blocks that already hold those positions —
+    writing them again would be wasted HBM traffic over blocks other
+    slots are reading. One jit specialization per ``(start, nblocks)``
+    pair (both bounded by the per-slot table width)."""
     return _install_jit(pool, row_cache, jnp.asarray(block_ids),
-                        nblocks)
+                        nblocks, start)
 
 
-def _install(pool, row_cache, block_ids, nblocks: int):
+def _install(pool, row_cache, block_ids, nblocks: int, start: int = 0):
     out = {}
+    n_write = nblocks - start
     for name, lc in pool.items():
         bs = lc["k"].shape[2]
 
         def to_blocks(row):                      # (H, L, D) -> blocks
             h, length, d = row.shape
             take = min(nblocks * bs, length)
-            chunk = row[:, :take]
+            chunk = row[:, start * bs:take]
             if take < nblocks * bs:
                 # max_len need not divide block_size: the final block's
                 # tail holds zero padding that no position ever reads
@@ -93,19 +106,50 @@ def _install(pool, row_cache, block_ids, nblocks: int):
                 chunk = jnp.pad(chunk,
                                 ((0, 0), (0, nblocks * bs - take),
                                  (0, 0)))
-            return chunk.reshape(h, nblocks, bs, d)
+            return chunk.reshape(h, n_write, bs, d)
 
         chunk_k = to_blocks(row_cache[name]["k"][0])
         chunk_v = to_blocks(row_cache[name]["v"][0])
-        ids = block_ids[:nblocks]
+        ids = block_ids[start:nblocks]
         out[name] = {
             "k": lc["k"].at[ids].set(jnp.swapaxes(chunk_k, 0, 1)),
             "v": lc["v"].at[ids].set(jnp.swapaxes(chunk_v, 0, 1))}
     return out
 
 
-_install_jit = jax.jit(_install, static_argnums=(3,),
+_install_jit = jax.jit(_install, static_argnums=(3, 4),
                        donate_argnums=(0,))
+
+
+def gather_blocks_to_row(pool: Dict, block_ids, max_len: int) -> Dict:
+    """The inverse of :func:`install_row_paged`: read ``block_ids``'
+    pool blocks back into a contiguous batch-1 row cache (``(1,
+    kv_heads, max_len, head_dim)`` per layer k/v, zero past
+    ``len(block_ids) * block_size``). This is how a prefix-cache HIT
+    feeds the remainder prefill: the cached blocks become the row's
+    head and :func:`~elephas_tpu.models.transformer.decode_block`
+    extends past them — no recompute of the cached positions, one
+    O(prefix) device gather instead. One jit specialization per block
+    count (bounded by the per-slot table width)."""
+    return _gather_jit(pool, jnp.asarray(block_ids), int(max_len))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _gather_jit(pool, block_ids, max_len: int):
+    out = {}
+    n = block_ids.shape[0]
+    for name, lc in pool.items():
+        bs = lc["k"].shape[2]
+
+        def to_row(p):                          # blocks -> (1, H, L, D)
+            sel = p[block_ids]                  # (n, H, bs, D)
+            h, d = sel.shape[1], sel.shape[3]
+            flat = jnp.swapaxes(sel, 0, 1).reshape(h, n * bs, d)
+            return jnp.pad(flat, ((0, 0), (0, max_len - n * bs),
+                                  (0, 0)))[None]
+
+        out[name] = {"k": to_row(lc["k"]), "v": to_row(lc["v"])}
+    return out
 
 
 # --------------------------------------------------------------------------

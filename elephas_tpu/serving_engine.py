@@ -32,12 +32,33 @@ speculative decoding compose because both ride the same per-row cache
 positions (rows accept different counts and simply advance
 independently).
 
-``register_prefix`` pins the KV state of a shared prompt prefix (a
-system prompt): requests that start with it prefill only their suffix
-(longest registered match wins), cutting admission cost by the prefix's
-share of the prompt — the prefix-caching half of vLLM's automatic
-prefix sharing, with explicit registration instead of radix-tree
-detection.
+Automatic prefix caching: with ``prefix_cache`` on (the DEFAULT in
+paged mode), the engine content-addresses every FULL ``block_size``
+block of every admitted prompt by the hash chain of its token contents
+and the live ``weights_version``
+(:mod:`~elephas_tpu.models.block_cache`). Admission walks the longest
+chain of cached blocks first and prefills only the remainder — no
+registration, no operator curation: any two requests sharing a prompt
+head share its KV. In paged mode the cached blocks live IN the pool
+and a hit installs table POINTERS (zero copy, zero recompute; entries
+are refcounted while any slot's table points at them and parked on an
+LRU free list when unreferenced, so pool pressure reclaims cold
+prefixes instead of failing admission — correctness needs no
+copy-on-write because decode only ever writes the private blocks past
+the prompt's full-block head). On a contiguous engine (or a
+disaggregated prefill worker) the cache stores host block arrays: a
+hit pays one host-to-device copy instead of the prefix's prefill
+FLOPs. Keying on ``weights_version`` means a live hot-swap (PR 8)
+invalidates the whole cache BY CONSTRUCTION — post-swap chains hash
+differently, no flush pause, and old-version blocks age out of the
+LRU rather than ever being served.
+
+``register_prefix`` survives as the explicit PINNING layer on top of
+the automatic cache: it precomputes a shared prompt head (a system
+prompt) ahead of traffic and pins its full blocks with a refcount
+floor of one — never parked, never evicted — while sub-block tails
+keep riding the registered row (longest registered match wins when it
+covers more than the block chain).
 
 The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
@@ -205,6 +226,21 @@ class DecodeEngine:
         decode-stage backlog. The prefill tier's companion series is
         observed by :class:`~elephas_tpu.disagg.PrefillWorker` under
         ``tier="prefill"``.
+    :param prefix_cache: the AUTOMATIC content-addressed KV block cache
+        (see the module docstring). ``None`` means "on in paged mode,
+        off otherwise"; pass ``False`` to disable (the bench A/B
+        baseline) or ``True`` to enable the host-array-backed cache on
+        a contiguous engine. Does not compose with speculative mode
+        (no draft KV in the cache).
+    :param prefix_cache_block_size: cache granularity in tokens for the
+        HOST-mode cache (contiguous engines; default 64). Paged engines
+        always cache at the pool's ``block_size`` — passing a different
+        value raises.
+    :param prefix_cache_capacity: host-mode bound on cached blocks
+        (LRU-evicted past it; default 1024; pinned registered-prefix
+        blocks are exempt). Ignored in paged mode, where the pool
+        itself is the capacity and reclaim happens under admission
+        pressure.
     :param registry: the :class:`~elephas_tpu.obs.MetricsRegistry` this
         engine's series land in. Defaults to a FRESH per-engine registry
         (not the process default): the registry counters are the single
@@ -235,7 +271,10 @@ class DecodeEngine:
                  max_queue: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
                  clock=time.monotonic, tier: str = "colocated",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_block_size: Optional[int] = None,
+                 prefix_cache_capacity: Optional[int] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -302,6 +341,10 @@ class DecodeEngine:
         else:
             self.cache = init_kv_cache(config, self.max_slots,
                                        self.max_len)
+        # per-slot SHARED prefix-cache entries the slot's table points
+        # at (refcounted; released on retirement) — disjoint from
+        # _slot_blocks, which holds the slot's PRIVATE block ids
+        self._slot_cached: List[List] = [[] for _ in range(self.max_slots)]
         self.draft_cache = (init_kv_cache(draft_config, self.max_slots,
                                           self.max_len)
                             if draft_config is not None else None)
@@ -589,6 +632,21 @@ class DecodeEngine:
             "serving_prefix_tokens_reused_total",
             "prompt tokens whose prefill was skipped via a prefix hit"
             ).labels()
+        # automatic content-addressed KV block cache (module docstring):
+        # default ON in paged mode, opt-in host-backed otherwise
+        self._kv_cache = None
+        self._kv_cache_bs: Optional[int] = None
+        if prefix_cache is None:
+            prefix_cache = self.paged is not None
+        if prefix_cache:
+            self.enable_prefix_cache(
+                block_size=prefix_cache_block_size,
+                capacity=prefix_cache_capacity)
+        elif (prefix_cache_block_size is not None
+                or prefix_cache_capacity is not None):
+            raise ValueError("prefix_cache_block_size/"
+                             "prefix_cache_capacity given with "
+                             "prefix_cache disabled")
         # construction-time baselines: an INJECTED shared registry may
         # already carry a predecessor engine's totals (weight-reload
         # flow) — stats must report THIS engine's deltas, never pooled
@@ -734,10 +792,79 @@ class DecodeEngine:
                     self.draft_params, jnp.asarray(tokens[None]))
         self._prefixes.append((tokens, logits[0], row, d_row))
         self._prefixes.sort(key=lambda e: -e[0].size)
+        if self._kv_cache is not None:
+            self._pin_prefix_blocks(tokens, row)
+
+    def _pin_prefix_blocks(self, tokens: np.ndarray, row) -> None:
+        """The pinning layer over the automatic cache: a registered
+        prefix's FULL blocks enter the block cache with a refcount
+        floor of one (never parked, never evicted), so every matching
+        admission hits them through the ordinary chain walk. The
+        sub-block tail keeps riding the registered row. A pool too
+        full to hold a pin skips it (the row still serves matches) and
+        says so on the event log."""
+        from .models.block_cache import chain_keys
+
+        cache, bs = self._kv_cache, self._kv_cache_bs
+        nfull = tokens.size // bs
+        if nfull == 0:
+            return
+        keys = chain_keys(tokens[:nfull * bs], bs, self.weights_version)
+        if self.paged is not None:
+            from .models.paged_decode import install_row_paged
+
+            # batch consecutive absent keys into ONE install each: a
+            # per-block install would compile one (start, nblocks)
+            # specialization per block — K compiles for a K-block
+            # system prompt, again on every post-hot-swap re-pin
+            pend_start, pend_ids = None, []
+
+            def flush():
+                if not pend_ids:
+                    return
+                n = pend_start + len(pend_ids)
+                ids = np.zeros(n, np.int32)
+                ids[pend_start:] = pend_ids
+                self.pool = install_row_paged(self.pool, row, ids, n,
+                                              start=pend_start)
+
+            for i, key in enumerate(keys):
+                entry = cache.get(key)
+                if entry is None:
+                    if (not self._free_block_ids
+                            and not cache.reclaimable_count()):
+                        flush()
+                        emit_event("serving.prefix_pin_skipped",
+                                   tokens=int(tokens.size),
+                                   pinned_blocks=i)
+                        return
+                    bid = self._alloc_block()
+                    if (pend_start is None
+                            or pend_start + len(pend_ids) != i):
+                        flush()
+                        pend_start, pend_ids = i, []
+                    pend_ids.append(bid)
+                    entry = cache.insert(key, bid, (i + 1) * bs)
+                cache.pin(entry)
+            flush()
+            return
+        missing = [i for i, key in enumerate(keys)
+                   if cache.get(key) is None]
+        payloads = dict(zip(missing,
+                            self._host_cache_payloads(row, missing)))
+        for i, key in enumerate(keys):
+            entry = cache.get(key)
+            if entry is None:
+                entry = cache.insert(key, payloads[i], (i + 1) * bs)
+            cache.pin(entry)
 
     def clear_prefixes(self) -> None:
-        """Drop every registered prefix (frees their device cache rows)."""
+        """Drop every registered prefix (frees their device cache rows
+        and lifts the block cache's pins — unpinned entries park on the
+        LRU reclaim list and age out under pressure)."""
         self._prefixes = []
+        if self._kv_cache is not None:
+            self._kv_cache.unpin_all()
 
     def _match_prefix(self, prompt: np.ndarray):
         for entry in self._prefixes:  # longest first
@@ -789,6 +916,250 @@ class DecodeEngine:
                                 jnp.int32(ptoks.size))
         return logits[0], row
 
+    # ------------------------------------------------- automatic KV cache
+    def enable_prefix_cache(self, block_size: Optional[int] = None,
+                            capacity: Optional[int] = None) -> None:
+        """Turn on the automatic content-addressed KV block cache (see
+        the module docstring) — paged engines have it on by default;
+        contiguous engines (a fleet replica, a disaggregated prefill
+        worker's export engine) call this to get the host-array-backed
+        variant. Call BEFORE traffic: enabling is not synchronized
+        against a running engine loop. No-op when already enabled."""
+        if self._kv_cache is not None:
+            return
+        if self.draft_config is not None:
+            raise ValueError("prefix_cache does not compose with "
+                             "speculative mode (no draft KV in the "
+                             "cache)")
+        from .models.block_cache import BlockCache
+
+        if self.paged is not None:
+            if (block_size is not None
+                    and int(block_size) != self.paged[1]):
+                raise ValueError(
+                    f"paged engines cache at the pool block size "
+                    f"{self.paged[1]}, got prefix_cache_block_size="
+                    f"{block_size}")
+            self._kv_cache_bs = self.paged[1]
+            # pooled mode: the pool IS the capacity; eviction returns
+            # the entry's block to the free list (reclaim-over-shed)
+            self._kv_cache = BlockCache(on_evict=self._on_cache_evict)
+        else:
+            self._kv_cache_bs = int(block_size or 64)
+            if not 1 <= self._kv_cache_bs < self.max_len:
+                raise ValueError(
+                    f"prefix_cache_block_size {self._kv_cache_bs} out "
+                    f"of range [1, max_len={self.max_len})")
+            self._kv_cache = BlockCache(
+                capacity=1024 if capacity is None else int(capacity),
+                on_evict=self._on_cache_evict)
+        self._chain_memo = None   # (rid, version, walk_keys, ins_keys)
+        reg = self.registry
+        self._m_kv_hits = reg.counter(
+            "serving_kv_cache_hits_total",
+            "admissions/exports that reused >= 1 cached KV block"
+            ).labels()
+        self._m_kv_misses = reg.counter(
+            "serving_kv_cache_misses_total",
+            "admissions/exports with >= 1 full block and zero cache "
+            "reuse").labels()
+        self._m_kv_evictions = reg.counter(
+            "serving_kv_cache_evictions_total",
+            "cold cached blocks reclaimed under pool/capacity pressure"
+            ).labels()
+        import weakref
+
+        ref = weakref.ref(self)
+        reg.gauge("serving_kv_cache_blocks",
+                  "KV blocks currently held by the prefix cache"
+                  ).set_function(
+            lambda: float(len(e._kv_cache))
+            if (e := ref()) is not None and e._kv_cache is not None
+            else 0.0)
+        reg.gauge("serving_kv_cache_reclaimable_blocks",
+                  "cached blocks on the LRU free list (zero-ref, "
+                  "unpinned — reclaimable by admission pressure)"
+                  ).set_function(
+            lambda: float(e._kv_cache.reclaimable_count())
+            if (e := ref()) is not None and e._kv_cache is not None
+            else 0.0)
+
+    def _on_cache_evict(self, entry) -> None:
+        if self.paged is not None:
+            self._free_block_ids.append(entry.payload)
+        self._m_kv_evictions.inc()
+
+    def _cache_chain_keys(self, prompt: np.ndarray):
+        """(walk_keys, insert_keys) for ``prompt``: insert keys cover
+        every full block (``size // bs``); the WALK is capped one block
+        earlier when the prompt is block-aligned (``(size-1) // bs``)
+        so the remainder prefill is never empty — it is what produces
+        the final-position logits the first token samples from."""
+        from .models.block_cache import chain_keys
+
+        bs = self._kv_cache_bs
+        nfull = prompt.size // bs
+        ins_keys = chain_keys(prompt[:nfull * bs], bs,
+                              self.weights_version)
+        return ins_keys[:(prompt.size - 1) // bs], ins_keys
+
+    def _chain_keys_for(self, rid: Optional[int], prompt: np.ndarray):
+        """Memoized :meth:`_cache_chain_keys` keyed on (rid, version):
+        one admission consults the chain up to three times (the
+        availability walk, the prefill walk, the insert), and a queue
+        head waiting for capacity re-walks EVERY step — the prompt and
+        version are unchanged throughout, so hash once. ``rid=None``
+        (exports) skips the memo."""
+        if rid is None:
+            return self._cache_chain_keys(prompt)
+        memo = self._chain_memo
+        if (memo is not None and memo[0] == rid
+                and memo[1] == self.weights_version):
+            return memo[2], memo[3]
+        walk, ins = self._cache_chain_keys(prompt)
+        self._chain_memo = (rid, self.weights_version, walk, ins)
+        return walk, ins
+
+    def _alloc_block(self) -> int:
+        """One free block id — reclaiming the coldest parked cache
+        entry when the free list is dry (callers checked availability
+        = free + reclaimable inside the admission math)."""
+        if not self._free_block_ids:
+            self._kv_cache.evict_lru()     # on_evict refills free list
+        return self._free_block_ids.popleft()
+
+    def _insert_full_blocks(self, slot: int, prompt: np.ndarray,
+                            skip: int = 0,
+                            rid: Optional[int] = None) -> None:
+        """Register the slot's freshly prefilled full blocks
+        (``skip..nfull``) in the pooled cache: each absent chain key's
+        block moves from the slot's PRIVATE list to its SHARED list,
+        refcounted by this slot from birth — a same-prefix request
+        admitted one step later already hits."""
+        cache, bs = self._kv_cache, self._kv_cache_bs
+        nfull = prompt.size // bs
+        if nfull <= skip:
+            return
+        _, ins_keys = self._chain_keys_for(rid, prompt)
+        for i in range(skip, nfull):
+            key = ins_keys[i]
+            if cache.get(key) is not None:
+                # an equal-content entry exists elsewhere (another
+                # slot inserted it first, or an orphaned chain tail
+                # survived an eviction): keep ours private
+                continue
+            bid = int(self._tables[slot, i])
+            entry = cache.insert(key, bid, (i + 1) * bs, acquire=True)
+            self._slot_blocks[slot].remove(bid)
+            self._slot_cached[slot].append(entry)
+
+    def _host_cache_payloads(self, row, indices):
+        """Host payloads for blocks ``indices`` of a device row — ONE
+        device-to-host transfer per layer k/v (not per block: a long
+        prompt's miss would otherwise issue 2·layers·blocks small
+        blocking transfers on the prefill hot path), sliced and copied
+        host-side so a payload never pins the whole row."""
+        if not indices:
+            return []
+        bs = self._kv_cache_bs
+        host = {name: (np.asarray(lc["k"][0]), np.asarray(lc["v"][0]))
+                for name, lc in row.items()}
+        return [{name: (k[:, i * bs:(i + 1) * bs].copy(),
+                        v[:, i * bs:(i + 1) * bs].copy())
+                 for name, (k, v) in host.items()}
+                for i in indices]
+
+    def _host_cache_row(self, hits):
+        """Device row whose head positions ``[0, len(hits)*bs)`` are the
+        cached host blocks — the host-mode hit's one copy (vs the
+        prefix's prefill FLOPs)."""
+        from .models.paged_decode import import_kv_blocks
+
+        flat = []
+        names = sorted(hits[0].payload,
+                       key=lambda n: int(n.split("_", 1)[1]))
+        for name in names:
+            flat.append(np.stack([e.payload[name][0] for e in hits]))
+            flat.append(np.stack([e.payload[name][1] for e in hits]))
+        row_np = import_kv_blocks(flat, len(hits) * self._kv_cache_bs,
+                                  self.max_len)
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a, self.config.dtype), row_np)
+
+    def _extend_remainder(self, row, prompt: np.ndarray, pos0: int):
+        """Prefill ``prompt[pos0:]`` on top of a row holding
+        ``[0, pos0)`` — the remainder half of every cache hit. ``row``
+        is always engine-owned here (a fresh gather/import), so the
+        donating extend variants apply. Returns (last-position logits
+        ``(vocab,)``, full row)."""
+        suffix = prompt[pos0:]
+        if self.prefill_chunk is not None:
+            logits, row = self._extend_chunked(
+                self.params, row, suffix, pos0, self._extend_fn,
+                self._extend_owned_fn, owned=True)
+            return logits[0], row
+        logits, row = self._extend_owned_fn(
+            self.params, row, jnp.asarray(suffix[None]),
+            jnp.int32(pos0))
+        return logits[0], row
+
+    def _host_cache_prefill(self, rid: Optional[int],
+                            prompt: np.ndarray):
+        """The host-mode cached prefill shared by contiguous admission
+        and :meth:`export_prefill`: longest cached chain (or the longer
+        registered row) supplies the prompt head, the remainder
+        prefills, and the freshly computed full blocks insert. Returns
+        (last-position logits ``(vocab,)``, row, cache_tokens_reused,
+        registered_tokens_reused) — at most one of the two reuse counts
+        is nonzero (whichever layer covered more served)."""
+        cache, bs = self._kv_cache, self._kv_cache_bs
+        walk_keys, ins_keys = self._chain_keys_for(rid, prompt)
+        hits = cache.match_chain(walk_keys)
+        j = len(hits)
+        entry = self._match_prefix(prompt)
+        reg_len = 0 if entry is None else int(entry[0].size)
+        reg_used = 0
+        if reg_len > j * bs:
+            # the pinned row covers more (a sub-block registered head,
+            # or a cold cache): classic registered-prefix path — the
+            # computed row still warms the cache below
+            if entry is not None:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(reg_len)
+                reg_used = reg_len
+            logits, row = self._prefill_with_prefixes(
+                prompt, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, entry, 2,
+                self._fresh_row_fn)
+            j, reused = 0, 0
+        elif j > 0:
+            for e in hits:
+                cache.touch(e)
+            reused = j * bs
+            self._m_kv_hits.inc()
+            self._m_prefix_tokens.inc(reused)
+            cache.record_walk(j, True)
+            if rid is not None:
+                self.recorder.record(rid, "kv_cache_hit", blocks=j,
+                                     tokens_reused=reused)
+            row = self._host_cache_row(hits)
+            logits, row = self._extend_remainder(row, prompt, reused)
+        else:
+            if walk_keys:
+                self._m_kv_misses.inc()
+            cache.record_walk(0, bool(walk_keys))
+            logits, row = self._prefill_with_prefixes(
+                prompt, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, None, 2,
+                self._fresh_row_fn)
+            reused = 0
+        missing = [i for i in range(j, len(ins_keys))
+                   if cache.get(ins_keys[i]) is None]
+        for i, payload in zip(missing, self._host_cache_payloads(row,
+                                                                 missing)):
+            cache.insert(ins_keys[i], payload, (i + 1) * bs)
+        return logits, row, reused, reg_used
+
     # ------------------------------------------------------- live weights
     def stage_params(self, params: Dict, version: int,
                      trace_id: Optional[str] = None) -> None:
@@ -833,6 +1204,15 @@ class DecodeEngine:
         t0 = time.monotonic()
         self.params = params
         self.weights_version = int(version)
+        if self._kv_cache is not None:
+            # version-keyed invalidation by construction: post-swap
+            # chains hash under the NEW version, so every old entry
+            # simply stops matching — no flush pause. Lifting old pins
+            # here lets old-version pinned blocks park and age out of
+            # the LRU (the recompute below re-pins under the new
+            # version); an in-use old block stays referenced until its
+            # request retires, then parks, never to be served again.
+            self._kv_cache.unpin_all()
         if self._prefixes:
             # re-pin every registered prefix under the new weights;
             # register_prefix re-sorts, so matching behavior is
@@ -853,7 +1233,8 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ queue
     def check_admissible(self, prompt_size: int,
-                         max_new_tokens: int) -> None:
+                         max_new_tokens: int,
+                         prompt: Optional[np.ndarray] = None) -> None:
         """Raise ``ValueError`` when a request is PERMANENTLY
         inadmissible on this engine — it exceeds ``max_len`` (plus the
         speculative verify slack), could never fit the paged block
@@ -875,10 +1256,30 @@ class DecodeEngine:
                 + f" exceeds max_len {self.max_len}")
         if self.paged is not None:
             needed = -(-(prompt_size + max_new_tokens) // self.paged[1])
-            if needed > self.paged[0] - 1:      # block 0 never allocates
+            allocatable = self.paged[0] - 1     # block 0 never allocates
+            if self._kv_cache is not None:
+                # PINNED registered-prefix blocks are never reclaimable
+                # (the refcount floor), so they permanently shrink what
+                # a request can allocate — EXCEPT the leading pinned
+                # blocks the prompt itself would reuse, which need no
+                # allocation (its table points at them). Unpinned cache
+                # entries don't count: admission pressure reclaims them.
+                pinned = self._kv_cache.pinned_count()
+                if pinned and prompt is not None:
+                    walk_keys, _ = self._cache_chain_keys(
+                        np.asarray(prompt, np.int32).reshape(-1))
+                    # only the LEADING RUN of pinned entries is a
+                    # permanent guarantee — a transient entry between
+                    # pinned ones may be evicted, breaking the walk
+                    for e in self._kv_cache.match_chain(walk_keys):
+                        if not e.pinned:
+                            break
+                        needed -= 1
+                allocatable -= pinned
+            if needed > allocatable:
                 raise ValueError(
                     f"request needs {needed} blocks but the pool only "
-                    f"has {self.paged[0] - 1} allocatable — it could "
+                    f"has {allocatable} allocatable — it could "
                     "never be admitted")
         if (self.max_queued_tokens is not None
                 and prompt_size > self.max_queued_tokens):
@@ -1009,7 +1410,8 @@ class DecodeEngine:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        self.check_admissible(int(prompt.size), int(max_new_tokens))
+        self.check_admissible(int(prompt.size), int(max_new_tokens),
+                              prompt=prompt)
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         # expired backlog entries must not hold capacity against a live
@@ -1108,20 +1510,32 @@ class DecodeEngine:
         topk = 0 if top_k is None else int(top_k)
         topp = 1.0 if top_p is None else float(top_p)
         start = time.monotonic()
-        entry = self._match_prefix(prompt)
-        if entry is not None:
-            self._m_prefix_hits.inc()
-            self._m_prefix_tokens.inc(int(entry[0].size))
-        logits, row = self._prefill_with_prefixes(
-            prompt, self._extend_fn, self._extend_owned_fn,
-            self._prefill_fn, self.params, entry, 2, self._fresh_row_fn)
+        cached_tokens = 0
+        if self._kv_cache is not None and self.paged is None:
+            # the prefill TIER's automatic cache: a repeat prefix skips
+            # its prefill compute BEFORE the KV ever hits the wire (the
+            # shipped frame is identical either way — the decode side
+            # cannot tell a cached export from a computed one)
+            logits, row, cached_tokens, reg_used = (
+                self._host_cache_prefill(None, prompt))
+            prefix_tokens = max(cached_tokens, reg_used)
+        else:
+            entry = self._match_prefix(prompt)
+            if entry is not None:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(int(entry[0].size))
+            logits, row = self._prefill_with_prefixes(
+                prompt, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, entry, 2,
+                self._fresh_row_fn)
+            prefix_tokens = 0 if entry is None else int(entry[0].size)
         t0 = self._sample_first(logits, temp, topk, topp)
         blocks = export_kv_blocks(row, int(prompt.size), int(block_size))
         return {"first_token": t0, "kv_blocks": blocks,
                 "block_size": int(block_size),
                 "prompt_tokens": int(prompt.size),
-                "prefix_tokens": (0 if entry is None
-                                  else int(entry[0].size)),
+                "prefix_tokens": int(prefix_tokens),
+                "cached_tokens": int(cached_tokens),
                 # the version this KV was computed under: a disagg
                 # decode engine REJECTS a frame whose stamp mismatches
                 # its own live version (decoding new-weight steps over
@@ -1257,16 +1671,57 @@ class DecodeEngine:
                 # allocate BEFORE popping: when the pool is momentarily
                 # empty the head request simply waits (FIFO — no
                 # smaller-request overtaking, so no starvation)
-                _, nxt_prompt, nxt_max_new = self._queue[0][:3]
+                nxt_rid, nxt_prompt, nxt_max_new = self._queue[0][:3]
                 bsz = self.paged[1]
                 needed = -(-(nxt_prompt.size + nxt_max_new) // bsz)
-                if len(self._free_block_ids) < needed:
+                hits = []
+                if (self._kv_cache is not None
+                        and nxt_rid not in self._prefilled_kv):
+                    # cached full blocks need no allocation: the slot's
+                    # table will POINT at them
+                    walk_keys, _ = self._chain_keys_for(nxt_rid,
+                                                        nxt_prompt)
+                    hits = self._kv_cache.match_chain(walk_keys)
+                    if hits:
+                        # longest registered match still wins: when the
+                        # pinned ROW covers more than the block chain
+                        # (a sub-block tail, or a partially pinned
+                        # prefix), skip the claim and let the classic
+                        # registered path serve the whole head — but
+                        # ONLY when a full private allocation is
+                        # permanently satisfiable. check_admissible
+                        # admitted this request crediting its leading
+                        # pinned run; dropping the claim while pins
+                        # make `needed` private blocks impossible
+                        # would wedge the FIFO head forever for a
+                        # sub-block tail's worth of reuse.
+                        reg = self._match_prefix(nxt_prompt)
+                        if (reg is not None and int(reg[0].size)
+                                > len(hits) * bsz
+                                and needed <= self.paged[0] - 1
+                                - self._kv_cache.pinned_count()):
+                            hits = []
+                avail = len(self._free_block_ids)
+                if self._kv_cache is not None:
+                    # parked (zero-ref) cached blocks are reclaimable —
+                    # minus any this very admission is about to reuse
+                    avail += (self._kv_cache.reclaimable_count()
+                              - sum(1 for e in hits
+                                    if self._kv_cache.is_parked(e)))
+                if avail < needed - len(hits):
                     return
-                blocks = [self._free_block_ids.popleft()
-                          for _ in range(needed)]
+                # claim the hit chain FIRST (refcount++, unpark): the
+                # remainder allocation below may evict LRU entries and
+                # must never reclaim the blocks this request reuses
+                for e in hits:
+                    self._kv_cache.acquire(e)
+                self._slot_cached[slot] = list(hits)
+                blocks = [self._alloc_block()
+                          for _ in range(needed - len(hits))]
                 self._slot_blocks[slot] = blocks
                 self._tables[slot, :] = 0      # unused entries -> scratch
-                self._tables[slot, :needed] = blocks
+                self._tables[slot, :needed] = (
+                    [e.payload for e in hits] + blocks)
             rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
             self._queued_tokens -= int(prompt.size)
             # queue wait ends HERE — prefill compute/compile time below
@@ -1311,6 +1766,13 @@ class DecodeEngine:
                     # install straight into the slot (between decode
                     # steps — this loop IS the atomic point); no
                     # prefill compute, no prefix lookup
+                    # shipped frames deliberately do NOT seed the
+                    # decode-side cache: a pure-disagg decode tier
+                    # never walks it for prefilled requests (dead
+                    # entries would only inflate eviction churn), and
+                    # a Q8 frame's dequantized KV is content-addressed
+                    # by TOKENS — letting a later LOCAL admission hit
+                    # lossy blocks would break its cache-off parity
                     t0 = self._install_prefilled(slot, prompt, pre)
                     self.recorder.record(
                         rid, "kv_install",
@@ -1336,6 +1798,20 @@ class DecodeEngine:
         """The colocated admission body: prefix-aware prefill on THIS
         engine, slot install, first-token sample. Runs under the
         request's restored trace context (the caller's ``use_context``)."""
+        if self._kv_cache is not None:
+            if self.paged is not None:
+                return self._admit_prefill_paged_cached(
+                    rid, slot, prompt, temp, topk, topp)
+            logits, row, reused, reg_used = self._host_cache_prefill(
+                rid, prompt)
+            self.cache = self._install_fn(self.cache, row, slot)
+            t0 = self._sample_first(logits, temp, topk, topp)
+            self.recorder.record(
+                rid, "prefill", prompt_tokens=int(prompt.size),
+                prefix_tokens=max(reused, reg_used),
+                duration_s=round(
+                    time.monotonic() - self._admit_t[rid], 6))
+            return t0
         # exact-length prefill: one compile per distinct prompt
         # length (an online server batches by length bucket
         # upstream if compile churn matters); a registered-
@@ -1370,6 +1846,69 @@ class DecodeEngine:
         self.recorder.record(
             rid, "prefill", prompt_tokens=int(prompt.size),
             prefix_tokens=(0 if entry is None else int(entry[0].size)),
+            duration_s=round(time.monotonic() - self._admit_t[rid], 6))
+        return t0
+
+    def _admit_prefill_paged_cached(self, rid: int, slot: int,
+                                    prompt: np.ndarray, temp: float,
+                                    topk: int, topp: float) -> int:
+        """Paged admission with the automatic block cache: the hit
+        chain (claimed by ``_admit`` — its blocks are ALREADY the head
+        of the slot's table, pure pointer install) is gathered into a
+        row head, only the remainder prefills, and the freshly
+        computed full blocks register in the cache so the next
+        same-head request hits. Zero hits degrades to the classic
+        prefix-aware full prefill (plus the cache insert)."""
+        from .models.paged_decode import (gather_blocks_to_row,
+                                          install_row_paged)
+
+        cache, bs = self._kv_cache, self._kv_cache_bs
+        hits = self._slot_cached[slot]
+        j = len(hits)
+        walk_keys, _ = self._chain_keys_for(rid, prompt)
+        nprefill = -(-prompt.size // bs)
+        if j > 0:
+            reused = j * bs
+            self._m_kv_hits.inc()
+            self._m_prefix_tokens.inc(reused)
+            cache.record_walk(j, True)
+            self.recorder.record(rid, "kv_cache_hit", blocks=j,
+                                 tokens_reused=reused)
+            row = gather_blocks_to_row(
+                self.pool, [e.payload for e in hits], self.max_len)
+            logits, row = self._extend_remainder(row, prompt, reused)
+        else:
+            # classic path, registered row included (longest match
+            # wins — _admit skips the chain claim when the pinned row
+            # covers more than the cached chain)
+            entry = self._match_prefix(prompt)
+            reused = 0
+            if entry is not None:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(int(entry[0].size))
+                reused = int(entry[0].size)
+            elif walk_keys:
+                # a registered-row-served admission is the PINNING
+                # layer's reuse (counted just above), not a cache miss
+                self._m_kv_misses.inc()
+                cache.record_walk(0, True)
+            logits, row = self._prefill_with_prefixes(
+                prompt, self._extend_fn, self._extend_owned_fn,
+                self._prefill_fn, self.params, entry, 2,
+                self._fresh_row_fn)
+        # install ONLY the remainder blocks: positions [j*bs, ...) —
+        # the shared head blocks already hold their positions and other
+        # slots may be reading them this very step
+        self.pool = install_row_paged(self.pool, row,
+                                      self._tables[slot], nprefill,
+                                      start=j)
+        self._insert_full_blocks(slot, prompt, skip=j, rid=rid)
+        t0 = self._sample_first(logits, temp, topk, topp)
+        self.recorder.record(
+            rid, "prefill", prompt_tokens=int(prompt.size),
+            # whichever layer served: the chain's blocks or the
+            # registered row (the classic path stamps the same field)
+            prefix_tokens=int(reused),
             duration_s=round(time.monotonic() - self._admit_t[rid], 6))
         return t0
 
@@ -1438,9 +1977,17 @@ class DecodeEngine:
         return True
 
     def _release_blocks(self, slot: int):
-        if self.paged is not None and self._slot_blocks[slot]:
+        if self.paged is not None and (self._slot_blocks[slot]
+                                       or self._slot_cached[slot]):
             self._free_block_ids.extend(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
+            # shared cached blocks: drop this slot's reference — the
+            # last release PARKS the entry on the LRU reclaim list
+            # (its KV stays resident for future hits) instead of
+            # freeing the block
+            for entry in self._slot_cached[slot]:
+                self._kv_cache.release(entry)
+            self._slot_cached[slot] = []
             self._tables[slot, :] = 0          # back to the scratch sink
 
     def _retire_slot(self, slot: int, outcome: str = "finished") -> int:
@@ -1507,13 +2054,23 @@ class DecodeEngine:
                "weights_version": int(self.weights_version),
                "weight_swaps": int(self._since_init(
                    self._m_weight_swaps))}
-        if self._prefixes:
+        if self._prefixes or self._kv_cache is not None:
             out["prefix_hits"] = int(self._since_init(self._m_prefix_hits))
             out["prefix_tokens_reused"] = int(
                 self._since_init(self._m_prefix_tokens))
         if self.paged is not None:
             out["blocks_total"] = self.paged[0] - 1
-            out["blocks_free"] = len(self._free_block_ids)
+            # "free" = ALLOCATABLE: the raw free list plus parked cache
+            # blocks (zero-ref, unpinned) admission pressure may
+            # reclaim — the number the admission math actually acts on
+            free = len(self._free_block_ids)
+            if self._kv_cache is not None:
+                free += self._kv_cache.reclaimable_count()
+            out["blocks_free"] = free
+        if self._kv_cache is not None:
+            ks = self._kv_cache.stats()
+            ks["block_size"] = self._kv_cache_bs
+            out["kv_cache"] = ks
         out["tier"] = self.tier
         if self._latency_window:
             totals = [t for _, t in self._latency_window]

@@ -14,6 +14,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 _DRIVER = os.path.join(os.path.dirname(__file__), "mh_driver.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -42,6 +43,24 @@ def _launch(mode, nprocs, outdir, jax_port, ps_port, timeout=240):
     return procs, outputs
 
 
+#: environment-bound, same root cause as test_multihost_spawn.py's
+#: marker (verified failing identically on the untouched seed on this
+#: box before PR 10's changes): this jaxlib's CPU runtime raises
+#: 'Multiprocess computations aren't implemented on the CPU backend.'
+#: at the first cross-process collective, so the "crash run" here
+#: fails during TRAINING rather than at the injected kill — no epoch
+#: ever completes, no checkpoint is written, and both tests' premises
+#: (a surviving peer mid-fit; a checkpoint to resume from) never
+#: materialize. Passes on jaxlib builds whose CPU client implements
+#: multi-process collectives, hence non-strict.
+_cpu_multiprocess_xfail = pytest.mark.xfail(
+    strict=False,
+    reason="environment-bound: this jaxlib's CPU backend raises "
+           "'Multiprocess computations aren't implemented' before the "
+           "fault-injection premise can establish (see in-file note)")
+
+
+@_cpu_multiprocess_xfail
 def test_peer_death_surfaces_clear_error_not_hang(tmp_path):
     """Hard-kill process 1 mid-fit: process 0 must exit within the
     barrier deadline with an error naming the barrier."""
@@ -71,6 +90,7 @@ def test_peer_death_surfaces_clear_error_not_hang(tmp_path):
     assert CheckpointManager(tmp_path / "ckpt").latest_step() is not None
 
 
+@_cpu_multiprocess_xfail
 def test_restart_resumes_from_checkpoint(tmp_path):
     """The full recovery story: crash run leaves checkpoints; a fresh
     2-process run restores the latest step, finishes, and both hosts
