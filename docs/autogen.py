@@ -106,6 +106,8 @@ PAGES = [
      ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine",
      ["DecodeEngine", "QueueFullError", "DeadlineExceededError"]),
+    ("Multi-tenant QoS", "elephas_tpu.serving_qos",
+     ["TenantQoS", "FairQueue", "QueuedRequest"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Serving fleet API", "elephas_tpu.fleet",
      ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool"]),

@@ -28,6 +28,7 @@ from .serving import TextGenerator
 from .serving_engine import (DeadlineExceededError, DecodeEngine,
                              QueueFullError)
 from .serving_http import ServingServer
+from .serving_qos import TenantQoS
 from .ssm_engine import SSMEngine
 from .tpu_model import TPUMatrixModel, TPUModel, load_tpu_model
 from .weightsync import CanaryController, WeightSubscriber

@@ -79,8 +79,16 @@ class DisaggEngine:
         self._lock = threading.Lock()
         self._next_rid = 0
         # rid -> {"state": queued|imported|decoding|done, "job",
-        #         "drid", "deadline", "retries"}
+        #         "drid", "deadline", "retries", "tenant", "ptokens"}
         self._stage: Dict[int, Dict] = {}
+        # tenant -> prompt tokens currently staged in the PREFILL tier
+        # (queued at workers, parked, or in transfer): the decode
+        # engine's per-tenant quota only sees its own queue, which a
+        # disagg request enters at KV-install time — counting staged
+        # tokens at submit is what makes the quota bite at THIS front
+        # end instead of letting a tenant pile work into the prefill
+        # stage bounded only by the global max_queue
+        self._tenant_staged: Dict[str, int] = {}
         self._rid_of_drid: Dict[int, int] = {}
         # rid -> decode rid kept for trace merging AFTER the result is
         # fetched (the live _stage entry pops then); bounded like the
@@ -129,13 +137,17 @@ class DisaggEngine:
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                admit: bool = True,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority=None) -> int:
         """Queue a request; the prefill tier computes its KV state and
         this engine decodes it. Same argument semantics as
         :meth:`~elephas_tpu.serving_engine.DecodeEngine.submit`
         (``admit`` is accepted for interface parity; admission is
         always deferred to the engine loop here — prefill runs
-        off-thread regardless)."""
+        off-thread regardless). ``tenant``/``priority`` ride the wire
+        meta to the decode engine, whose QoS policy (fair queueing,
+        quotas, preemption) acts on them at KV-install admission."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # fail fast with the decode engine's own validation messages:
         # an inadmissible request must 400 at submit, not die on a
@@ -149,10 +161,29 @@ class DisaggEngine:
         # would raise inside the server's engine loop and read as
         # engine death (500s for everyone) instead of one bad request
         self.decode.check_admissible(int(prompt.size),
-                                     int(max_new_tokens), prompt=prompt)
+                                     int(max_new_tokens), prompt=prompt,
+                                     tenant=tenant)
         validate_sampling_overrides(temperature, top_k, top_p)
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if tenant is not None:
+            # the per-tenant quota 429, enforced at THIS front end's
+            # submit exactly like the decode engine's own (the shared
+            # validator — a quota-breached tenant sheds identically at
+            # every surface, with the quota-aware backoff hint and the
+            # same counter/event bookkeeping). The tenant's tokens
+            # already staged in the prefill tier count against the
+            # quota too — they haven't reached the decode queue yet,
+            # but they are committed work the quota exists to bound.
+            with self._lock:
+                staged = self._tenant_staged.get(tenant, 0)
+            try:
+                self.decode.check_tenant_admissible(
+                    tenant, int(prompt.size) + staged)
+            except QueueFullError:
+                self.decode.record_shed(tenant, "tenant_quota",
+                                        staged_tokens=staged)
+                raise
         with self._lock:
             if (self.max_queue is not None
                     and self._prefill_depth_locked() >= self.max_queue):
@@ -170,16 +201,25 @@ class DisaggEngine:
         self.recorder.start(
             rid, trace_id=None if ctx is None else ctx.trace_id,
             prompt_tokens=int(prompt.size),
-            max_new_tokens=int(max_new_tokens))
+            max_new_tokens=int(max_new_tokens),
+            **({} if tenant is None else {"tenant": str(tenant)}))
         job = PrefillJob(rid, prompt, max_new_tokens,
                          temperature=temperature, top_k=top_k,
                          top_p=top_p, deadline=deadline,
                          target=self.receiver.addr, ctx=ctx,
-                         on_failed=self._job_failed, clock=self._clock)
+                         on_failed=self._job_failed, clock=self._clock,
+                         tenant=tenant, priority=priority)
         with self._lock:
             self._stage[rid] = {"state": "queued", "job": job,
                                 "drid": None, "deadline": deadline,
-                                "retries": 0}
+                                "retries": 0, "tenant": tenant,
+                                "ptokens": (int(prompt.size)
+                                            if tenant is not None
+                                            else 0)}
+            if tenant is not None:
+                self._tenant_staged[tenant] = (
+                    self._tenant_staged.get(tenant, 0)
+                    + int(prompt.size))
         self._m_requests.inc()
         self._dispatch(job)
         return rid
@@ -235,6 +275,7 @@ class DisaggEngine:
             exhausted = st["retries"] >= self.MAX_PREFILL_RETRIES
             if exhausted:
                 st["state"] = "done"
+                self._release_stage_locked(st)
                 self._results[job.rid] = {"tokens": [], "timeout": True,
                                           "expired": True,
                                           "error": error}
@@ -340,6 +381,7 @@ class DisaggEngine:
                         and st["deadline"] is not None
                         and now >= st["deadline"]):
                     st["state"] = "done"
+                    self._release_stage_locked(st)
                     if st["job"] is not None:
                         # a worker still holding this job skips it
                         st["job"].abandoned = True
@@ -385,6 +427,10 @@ class DisaggEngine:
         with self._lock:
             batch = list(self._imports)
             self._imports.clear()
+        held: List = []   # tenant-quota-blocked frames: re-queued at
+        # the end WITHOUT stopping the loop — one tenant at its quota
+        # must never head-of-line-block other tenants' installs
+        stop: Optional[int] = None
         for i, (meta, arrays, nbytes) in enumerate(batch):
             rid = int(meta["rid"])
             with self._lock:
@@ -440,6 +486,7 @@ class DisaggEngine:
                 if remaining_ms <= 0:
                     with self._lock:
                         st["state"] = "done"
+                        self._release_stage_locked(st)
                         self._results[rid] = {"tokens": [],
                                               "timeout": True,
                                               "expired": True}
@@ -454,9 +501,20 @@ class DisaggEngine:
             # handler below stays as the backstop for bounds the peek
             # cannot see (injected sheds).
             if self.decode.would_shed(len(meta["prompt"])):
-                with self._lock:
-                    self._imports.extendleft(reversed(batch[i:]))
+                # GLOBAL backpressure: no frame can install until the
+                # next step shrinks the backlog — put the rest back
+                stop = i
                 break
+            tenant = meta.get("tenant")
+            if tenant is not None and self.decode.would_shed(
+                    len(meta["prompt"]), tenant=tenant):
+                # THIS tenant's quota: hold only its frame (it waits
+                # for the tenant's own decode backlog to drain,
+                # without the shed bookkeeping a bounced submit would
+                # record) — frames from other tenants behind it keep
+                # installing
+                held.append((meta, arrays, nbytes))
+                continue
             codec = str(meta.get("codec", "fp"))
             from ..obs.context import use_context
 
@@ -474,15 +532,16 @@ class DisaggEngine:
                         top_k=meta.get("top_k"), top_p=meta.get("top_p"),
                         admit=False, deadline_ms=remaining_ms,
                         weights_version=(None if wire_v is None
-                                         else int(wire_v)))
+                                         else int(wire_v)),
+                        tenant=meta.get("tenant"),
+                        priority=meta.get("priority"))
             except QueueFullError:
                 # the decode engine's own admission bound (or an
                 # injected serving.submit shed): TRANSIENT — put this
                 # frame AND the rest of the drained batch back (in
                 # order) and retry after the next step shrinks the
                 # backlog; raising here would kill the engine loop
-                with self._lock:
-                    self._imports.extendleft(reversed(batch[i:]))
+                stop = i
                 break
             except Exception as exc:  # noqa: BLE001 — an inadmissible
                 # request that slipped past submit-time validation is
@@ -492,6 +551,7 @@ class DisaggEngine:
                     st2 = self._stage.get(rid)
                     if st2 is not None:
                         st2["state"] = "done"
+                        self._release_stage_locked(st2)
                         self._results[rid] = {
                             "tokens": [], "timeout": True,
                             "expired": True,
@@ -509,6 +569,7 @@ class DisaggEngine:
                     self.decode.cancel(drid)
                     continue
                 st["state"] = "decoding"
+                self._release_stage_locked(st)
                 st["drid"] = drid
                 st["job"] = None          # the KV blocks can free now
                 self._rid_of_drid[drid] = rid
@@ -516,6 +577,28 @@ class DisaggEngine:
                 while len(self._trace_drid) > self.recorder.max_requests:
                     self._trace_drid.popitem(last=False)
             self.recorder.record(rid, "decode_submitted", decode_rid=drid)
+        if held or stop is not None:
+            # re-queue in ORIGINAL order: held frames arrived before
+            # the globally-stopped tail
+            rest = batch[stop:] if stop is not None else []
+            with self._lock:
+                self._imports.extendleft(reversed(held + rest))
+
+    def _release_stage_locked(self, st: Dict) -> None:
+        """Return a request's prompt tokens to its tenant's staged
+        budget — called (under the lock) at EVERY transition out of
+        the prefill stage: decode handoff, expiry, retry exhaustion,
+        cancel. Idempotent: the entry's ``ptokens`` zeroes on first
+        release."""
+        n, tenant = st.get("ptokens", 0), st.get("tenant")
+        st["ptokens"] = 0
+        if not n or tenant is None:
+            return
+        left = self._tenant_staged.get(tenant, 0) - n
+        if left > 0:
+            self._tenant_staged[tenant] = left
+        else:
+            self._tenant_staged.pop(tenant, None)
 
     def _prefill_depth_locked(self) -> int:
         return sum(1 for st in self._stage.values()
@@ -569,6 +652,7 @@ class DisaggEngine:
                 if st["job"] is not None:
                     st["job"].abandoned = True
                 st["state"] = "done"
+                self._release_stage_locked(st)
                 self._stage.pop(rid, None)
                 self._results.pop(rid, None)
                 self._drop_parked_locked(rid)

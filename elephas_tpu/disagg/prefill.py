@@ -39,14 +39,14 @@ class PrefillJob:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "deadline", "target", "ctx",
                  "enqueued_t", "attempts", "on_failed", "abandoned",
-                 "clock")
+                 "clock", "tenant", "priority")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature=None, top_k=None, top_p=None,
                  deadline: Optional[float] = None, target=None,
                  ctx=None,
                  on_failed: Optional[Callable] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, tenant=None, priority=None):
         self.rid = int(rid)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -63,6 +63,11 @@ class PrefillJob:
         self.enqueued_t = time.monotonic()
         self.attempts = 0
         self.on_failed = on_failed
+        # multi-tenant QoS: the decode side's fair queueing/preemption
+        # act on these — they ride the wire meta with the rest of the
+        # request's reconstruction fields
+        self.tenant = None if tenant is None else str(tenant)
+        self.priority = priority
         #: set by the dispatcher when the request terminated while this
         #: job was queued (cancel, deadline sweep): the worker drops it
         #: without spending prefill compute or wire bandwidth
@@ -315,6 +320,7 @@ class PrefillWorker:
                 "max_new_tokens": job.max_new_tokens,
                 "temperature": job.temperature,
                 "top_k": job.top_k, "top_p": job.top_p,
+                "tenant": job.tenant, "priority": job.priority,
                 "deadline": job.deadline,
                 "first_token": out["first_token"],
                 "prompt_tokens": out["prompt_tokens"],

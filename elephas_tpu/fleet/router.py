@@ -41,7 +41,15 @@ Edge admission reuses the single-server semantics: when every ready
 replica answers 429, the router answers 429 with the largest
 ``retry_after_ms`` hint observed (the whole pool is saturated — the
 client should back off at least as long as the most backlogged
-replica asked); when no replica is ready at all, 503.
+replica asked) and the standard ``Retry-After`` header derived from
+it; when no replica is ready at all, 503.
+
+Multi-tenant QoS rides the body: a request's ``tenant`` (body field,
+or the ``X-Tenant`` header the router merges in — body wins) is
+forwarded on every proxy, sibling retry, and dead-replica
+resubmission, so the replica engines' per-tenant fair queueing,
+quotas, and preemption see the same tenant the client named at the
+edge.
 
 Tracing: the inbound ``traceparent`` (or a fresh root) is installed for
 the handler and FORWARDED on every proxied request, so one trace id
@@ -76,7 +84,7 @@ from ..obs.context import (current_context, new_root, parse_traceparent,
 from ..obs.events import emit as emit_event
 from ..obs.metrics import (MetricsRegistry, counter_baseline,
                            since_baseline)
-from ..serving_http import QuietThreadingHTTPServer
+from ..serving_http import QuietThreadingHTTPServer, retry_after_header
 from .membership import ReplicaMembership
 
 __all__ = ["FleetRouter"]
@@ -101,12 +109,15 @@ def _route_label(path: str) -> str:
 class _HTTPError(Exception):
     """A routed outcome with a specific status (the ServingServer
     convention): raised anywhere under a handler, answered as ``code``
-    + JSON payload."""
+    + JSON payload (+ optional headers — the edge 429's
+    ``Retry-After``)."""
 
-    def __init__(self, code: int, payload: Dict):
+    def __init__(self, code: int, payload: Dict,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(payload.get("error", f"http {code}"))
         self.code = code
         self.payload = payload
+        self.headers = headers or {}
 
 
 def _error_payload(err: urllib.error.HTTPError) -> Dict:
@@ -384,9 +395,13 @@ class FleetRouter:
                 tried.add(url)
                 continue
         if retry_hints:
+            # the pool is saturated: back off at least as long as the
+            # most backlogged replica asked — ms field AND the standard
+            # Retry-After header, like a single replica's own 429
             raise _HTTPError(429, {
                 "error": "every ready replica is at capacity",
-                "retry_after_ms": max(retry_hints)})
+                "retry_after_ms": max(retry_hints)},
+                headers=retry_after_header(max(retry_hints)))
         raise _HTTPError(503, {
             "error": "no ready replicas in the fleet",
             "replicas_ready": 0})
@@ -611,7 +626,8 @@ class FleetRouter:
                 ctx = parse_traceparent(self.headers.get("traceparent"))
                 return ctx if ctx is not None else new_root()
 
-            def _reply(self, code: int, body: bytes, content_type: str):
+            def _reply(self, code: int, body: bytes, content_type: str,
+                       headers: Optional[Dict] = None):
                 route = _route_label(urlparse(self.path).path)
                 dur = time.perf_counter() - getattr(
                     self, "_t0", time.perf_counter())
@@ -620,15 +636,18 @@ class FleetRouter:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 ctx = current_context()
                 if ctx is not None:
                     self.send_header("X-Trace-Id", ctx.trace_id)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, code: int, payload: Dict):
+            def _json(self, code: int, payload: Dict,
+                      headers: Optional[Dict] = None):
                 self._reply(code, json.dumps(payload).encode(),
-                            "application/json")
+                            "application/json", headers=headers)
 
             def _body(self) -> Dict:
                 try:
@@ -647,7 +666,8 @@ class FleetRouter:
                     try:
                         self._get_routes(url)
                     except _HTTPError as err:
-                        self._json(err.code, err.payload)
+                        self._json(err.code, err.payload,
+                                   headers=err.headers)
                     except Exception as exc:  # noqa: BLE001 — an
                         # unexpected router/replica-payload error must
                         # answer 500, never drop the connection
@@ -699,6 +719,14 @@ class FleetRouter:
                     except (ValueError, json.JSONDecodeError):
                         self._json(400, {"error": "invalid JSON body"})
                         return
+                    # X-Tenant merges into the body (body field wins)
+                    # BEFORE any dispatch: the body is what gets
+                    # proxied, retried on siblings, stored for a dead
+                    # replica's resubmission — the tenant survives
+                    # every one of those hops
+                    hdr_tenant = self.headers.get("X-Tenant")
+                    if hdr_tenant and body.get("tenant") is None:
+                        body["tenant"] = hdr_tenant
                     try:
                         if (url.path == "/v1/generate"
                                 and body.get("stream")):
@@ -712,7 +740,8 @@ class FleetRouter:
                         else:
                             self._json(404, {"error": "unknown path"})
                     except _HTTPError as err:
-                        self._json(err.code, err.payload)
+                        self._json(err.code, err.payload,
+                                   headers=err.headers)
                     except Exception as exc:  # noqa: BLE001 — a
                         # malformed-but-valid-JSON body (a list, wrong
                         # types) or a surprising replica payload

@@ -60,6 +60,19 @@ floor of one — never parked, never evicted — while sub-block tails
 keep riding the registered row (longest registered match wins when it
 covers more than the block chain).
 
+Multi-tenant QoS (``qos=``, :mod:`~elephas_tpu.serving_qos`): requests
+carry a ``tenant`` + priority class; admission replaces the FIFO pop
+with token-budget weighted fair queueing across tenants
+(deficit-round-robin over queued tokens), per-tenant quotas shed with
+429 + a quota-aware ``retry_after_ms`` while under-quota tenants keep
+admitting, and — in paged mode with the prefix cache — a
+strictly-higher-priority request under pool pressure PREEMPTS a
+low-priority in-flight decode: the victim's full KV blocks park in the
+block cache (release → LRU), the request re-queues at the front of its
+tenant lane, and on re-admission the chain walk reclaims the parked
+blocks, so resume ≈ a prefix-cache hit plus a short remainder prefill
+— greedy output token-identical to the never-preempted run.
+
 The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
@@ -83,7 +96,9 @@ from .obs.events import emit as emit_event
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
 from .obs.trace import span_if_counted
-from .utils.faults import fault_site
+from .serving_qos import (DEFAULT_TENANT, FairQueue, QueuedRequest,
+                          TenantQoS)
+from .utils.faults import InjectedFault, fault_site
 
 
 class QueueFullError(RuntimeError):
@@ -241,6 +256,12 @@ class DecodeEngine:
         blocks are exempt). Ignored in paged mode, where the pool
         itself is the capacity and reclaim happens under admission
         pressure.
+    :param qos: a :class:`~elephas_tpu.serving_qos.TenantQoS` (or its
+        ctor-kwargs dict) switching admission to per-tenant weighted
+        fair queueing with quotas and priority preemption (see the
+        module docstring). ``None`` (the default) keeps the exact
+        FIFO semantics tenants or not — requests still carry a
+        ``tenant`` for attribution, but no policy acts on it.
     :param registry: the :class:`~elephas_tpu.obs.MetricsRegistry` this
         engine's series land in. Defaults to a FRESH per-engine registry
         (not the process default): the registry counters are the single
@@ -274,7 +295,8 @@ class DecodeEngine:
                  registry: Optional[MetricsRegistry] = None,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_block_size: Optional[int] = None,
-                 prefix_cache_capacity: Optional[int] = None):
+                 prefix_cache_capacity: Optional[int] = None,
+                 qos: Optional[TenantQoS] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -357,10 +379,33 @@ class DecodeEngine:
         self._topk = np.zeros(self.max_slots, np.int32)    # 0 = off
         self._topp = np.ones(self.max_slots, np.float32)   # 1 = off
         self._rid = [None] * self.max_slots
-        self._queue: deque = deque()
+        # multi-tenant QoS: the policy object (None = plain FIFO) and
+        # the admission queue enforcing it; per-slot tenant/priority/
+        # prompt metadata backs preemption and per-tenant accounting
+        self.qos = TenantQoS.coerce(qos)
+        self._queue: FairQueue = FairQueue(self.qos)
+        self._slot_prompt: List[Optional[np.ndarray]] = (
+            [None] * self.max_slots)
+        # output tokens already FOLDED INTO _slot_prompt: a resumed
+        # request's admission prompt is original-prompt + everything
+        # emitted before its preemption, so a SECOND preemption must
+        # only append the tokens emitted since (else they duplicate)
+        self._slot_prior = np.zeros(self.max_slots, np.int64)
+        self._slot_tenant: List[Optional[str]] = [None] * self.max_slots
+        self._slot_priority = np.zeros(self.max_slots, np.int32)
+        # weights_version each slot was ADMITTED under: a preempted
+        # slot's KV only parks when the engine still serves that
+        # version (post-swap chain keys would address old-weight KV)
+        self._slot_wv = np.zeros(self.max_slots, np.int64)
+        # rid -> {"outputs": [...], "preempts": n} for requests
+        # preempted mid-decode and re-queued for resume
+        self._resume: Dict[int, Dict] = {}
         self._outputs: Dict = {}
         self._done: Dict = {}
-        self._fresh: Dict = {}   # admission-time tokens awaiting step()
+        # rid -> [tokens]: admission-time tokens awaiting step() — a
+        # list, because a request preempted before its first step and
+        # resumed owes the stream BOTH admissions' first tokens
+        self._fresh: Dict = {}
         # rid -> (kv_blocks, first_token) for requests whose prefill
         # happened off-engine (submit_prefilled); consumed at admission
         self._prefilled_kv: Dict[int, Tuple] = {}
@@ -490,6 +535,34 @@ class DecodeEngine:
             "serving_weight_swap_seconds",
             "engine-loop blockage per weight swap (param pointer swap "
             "+ registered-prefix recompute)").labels()
+        self._m_preemptions = reg.counter(
+            "serving_preemptions_total",
+            "in-flight decodes preempted by a higher-priority "
+            "admission (KV parked, request re-queued)").labels()
+        if self.qos is not None:
+            # per-tenant series: configured tenants get their own label
+            # (client-chosen names fold into "other" — label domains
+            # must stay bounded); the queued-tokens gauge children are
+            # registered lazily per label with weakref callbacks, the
+            # engines' gauge convention
+            self._m_tenant_queued = reg.gauge(
+                "serving_tenant_queued_tokens",
+                "prompt tokens waiting in the queue, by tenant",
+                labels=("tenant",))
+            self._m_tenant_admitted = reg.counter(
+                "serving_tenant_admitted_total",
+                "requests admitted to a decode slot, by tenant",
+                labels=("tenant",))
+            self._m_tenant_preempt = reg.counter(
+                "serving_tenant_preemptions_total",
+                "in-flight decodes preempted, by (victim) tenant",
+                labels=("tenant",))
+            self._m_tenant_shed = reg.counter(
+                "serving_tenant_sheds_total",
+                "admission rejections by tenant and reason "
+                "(tenant_quota = the per-tenant 429)",
+                labels=("tenant", "reason"))
+            self._tenant_gauge_labels: set = set()
 
         cfg = config
         temp = self.temperature
@@ -1234,7 +1307,8 @@ class DecodeEngine:
     # ------------------------------------------------------------ queue
     def check_admissible(self, prompt_size: int,
                          max_new_tokens: int,
-                         prompt: Optional[np.ndarray] = None) -> None:
+                         prompt: Optional[np.ndarray] = None,
+                         tenant: Optional[str] = None) -> None:
         """Raise ``ValueError`` when a request is PERMANENTLY
         inadmissible on this engine — it exceeds ``max_len`` (plus the
         speculative verify slack), could never fit the paged block
@@ -1287,13 +1361,26 @@ class DecodeEngine:
                 f"prompt of {prompt_size} tokens exceeds "
                 f"max_queued_tokens={self.max_queued_tokens} — it could "
                 "never be admitted")
+        if self.qos is not None and tenant is not None:
+            # per-tenant quota, permanent half: a prompt LARGER than
+            # its tenant's token quota can never be queued — that is a
+            # 400 at submit, not a retryable 429 (the transient half
+            # lives in check_tenant_admissible)
+            _, token_quota = self.qos.quota(tenant)
+            if token_quota is not None and prompt_size > token_quota:
+                raise ValueError(
+                    f"prompt of {prompt_size} tokens exceeds tenant "
+                    f"{tenant!r}'s max_queued_tokens quota "
+                    f"{token_quota} — it could never be admitted")
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                admit: bool = True,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority=None) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
         ``temperature``/``top_k``/``top_p`` override the engine defaults
@@ -1313,9 +1400,18 @@ class DecodeEngine:
         far become the final output (``timeout``). Raises
         :class:`QueueFullError` when ``max_queue``/``max_queued_tokens``
         is configured and the backlog is at capacity — overload answers
-        immediately instead of queueing unboundedly."""
+        immediately instead of queueing unboundedly.
+
+        ``tenant`` names who this request belongs to (``"default"``
+        when omitted) and ``priority`` overrides the tenant's class
+        (a :data:`~elephas_tpu.serving_qos.PRIORITY_CLASSES` name or
+        int) — with a ``qos`` policy configured these drive weighted
+        fair queueing, per-tenant quotas (a breach sheds with the
+        quota-aware 429), and priority preemption; without one they
+        are attribution only."""
         return self._submit_impl(prompt, max_new_tokens, temperature,
-                                 top_k, top_p, admit, deadline_ms, None)
+                                 top_k, top_p, admit, deadline_ms, None,
+                                 tenant, priority)
 
     def submit_prefilled(self, prompt: Sequence[int],
                          max_new_tokens: int, kv_blocks, first_token: int,
@@ -1324,7 +1420,9 @@ class DecodeEngine:
                          top_p: Optional[float] = None,
                          admit: bool = True,
                          deadline_ms: Optional[float] = None,
-                         weights_version: Optional[int] = None) -> int:
+                         weights_version: Optional[int] = None,
+                         tenant: Optional[str] = None,
+                         priority=None) -> int:
         """Queue a request whose prefill ALREADY HAPPENED off-engine —
         the decode half of disaggregated serving. ``kv_blocks`` is the
         prompt's KV state in wire-block form
@@ -1395,10 +1493,12 @@ class DecodeEngine:
             prompt, max_new_tokens, temperature, top_k, top_p, admit,
             deadline_ms,
             (blocks, int(first_token),
-             None if weights_version is None else int(weights_version)))
+             None if weights_version is None else int(weights_version)),
+            tenant, priority)
 
     def _submit_impl(self, prompt, max_new_tokens, temperature, top_k,
-                     top_p, admit, deadline_ms, prefilled) -> int:
+                     top_p, admit, deadline_ms, prefilled,
+                     tenant=None, priority=None) -> int:
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
@@ -1410,8 +1510,13 @@ class DecodeEngine:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        prio = (self.qos.priority(tenant, priority)
+                if self.qos is not None
+                else TenantQoS._parse_class(
+                    "normal" if priority is None else priority))
         self.check_admissible(int(prompt.size), int(max_new_tokens),
-                              prompt=prompt)
+                              prompt=prompt, tenant=tenant)
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         # expired backlog entries must not hold capacity against a live
@@ -1420,29 +1525,36 @@ class DecodeEngine:
         if fault_site("serving.submit"):
             # a plan 'drop' here is a deterministic shed: the request is
             # rejected exactly as if the queue were at capacity
-            self._m_shed.inc()
-            emit_event("serving.shed", reason="injected")
+            self.record_shed(tenant, "injected")
             raise QueueFullError("admission rejected (injected shed)",
                                  self._retry_after_ms())
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
-            self._m_shed.inc()
-            emit_event("serving.shed", reason="max_queue",
-                       queue_depth=len(self._queue))
+            self.record_shed(tenant, "max_queue",
+                             queue_depth=len(self._queue))
             raise QueueFullError(
                 f"queue full: {len(self._queue)} requests backlogged "
                 f"(max_queue={self.max_queue})", self._retry_after_ms())
         if (self.max_queued_tokens is not None
                 and self._queued_tokens + prompt.size
                 > self.max_queued_tokens):
-            self._m_shed.inc()
-            emit_event("serving.shed", reason="max_queued_tokens",
-                       queued_tokens=self._queued_tokens)
+            self.record_shed(tenant, "max_queued_tokens",
+                             queued_tokens=self._queued_tokens)
             raise QueueFullError(
                 f"queue full: {self._queued_tokens} prompt tokens "
                 f"backlogged + {prompt.size} would exceed "
                 f"max_queued_tokens={self.max_queued_tokens}",
                 self._retry_after_ms())
+        try:
+            self.check_tenant_admissible(tenant, int(prompt.size))
+        except QueueFullError:
+            # the per-tenant quota 429: the offender sheds while
+            # under-quota tenants keep admitting through the very same
+            # submit path
+            self.record_shed(tenant, "tenant_quota",
+                             tenant_queued_tokens=self._queue
+                             .tenant_queued_tokens(tenant))
+            raise
         rid = self._next_rid
         self._next_rid += 1
         self._submit_t[rid] = time.monotonic()
@@ -1457,21 +1569,57 @@ class DecodeEngine:
                             trace_id=None if ctx is None else ctx.trace_id,
                             prompt_tokens=int(prompt.size),
                             max_new_tokens=int(max_new_tokens),
+                            tenant=tenant, priority=prio,
                             **({"prefilled": True} if prefilled is not None
                                else {}))
         if prefilled is not None:
             self._prefilled_kv[rid] = prefilled
         if deadline_ms is not None:
             self._deadline[rid] = self._clock() + deadline_ms / 1000.0
-        self._queue.append((rid, prompt, int(max_new_tokens),
-                            self.temperature if temperature is None
-                            else float(temperature),
-                            0 if top_k is None else int(top_k),
-                            1.0 if top_p is None else float(top_p)))
+        self._queue.append(QueuedRequest(
+            rid, prompt, int(max_new_tokens),
+            self.temperature if temperature is None
+            else float(temperature),
+            0 if top_k is None else int(top_k),
+            1.0 if top_p is None else float(top_p), tenant, prio))
         self._queued_tokens += int(prompt.size)
+        self._tenant_gauge(tenant)
         if admit:
             self._admit()
         return rid
+
+    def record_shed(self, tenant: str, reason: str,
+                    **event_attrs) -> None:
+        """Admission-rejection bookkeeping: the global shed counter,
+        the per-tenant labeled counter (QoS only), and the
+        tenant-stamped ``serving.shed`` event — one helper so every
+        shed path tells the same story. Public because front ends that
+        enforce this engine's tenant quotas at their own submit (the
+        disaggregated engine) owe the same bookkeeping."""
+        self._m_shed.inc()
+        if self.qos is not None:
+            self._m_tenant_shed.labels(
+                tenant=self.qos.label(tenant), reason=reason).inc()
+        emit_event("serving.shed", reason=reason, tenant=tenant,
+                   **event_attrs)
+
+    def _tenant_gauge(self, tenant: str) -> None:
+        """Lazily register the ``serving_tenant_queued_tokens`` gauge
+        child for ``tenant``'s label (weakref callback over the fair
+        queue, the engines' gauge convention). No-op without QoS."""
+        if self.qos is None:
+            return
+        label = self.qos.label(tenant)
+        if label in self._tenant_gauge_labels:
+            return
+        self._tenant_gauge_labels.add(label)
+        import weakref
+
+        ref = weakref.ref(self)
+        self._m_tenant_queued.labels(tenant=label).set_function(
+            lambda label=label: float(
+                e._queue.tokens_for_label(label, e.qos))
+            if (e := ref()) is not None else 0.0)
 
     def export_prefill(self, prompt: Sequence[int],
                        temperature: Optional[float] = None,
@@ -1543,35 +1691,80 @@ class DecodeEngine:
                 "weights_version": int(self.weights_version),
                 "prefill_s": round(time.monotonic() - start, 6)}
 
-    def would_shed(self, prompt_tokens: int) -> bool:
+    def would_shed(self, prompt_tokens: int,
+                   tenant: Optional[str] = None) -> bool:
         """Whether a submit of ``prompt_tokens`` would be shed RIGHT NOW
-        by the admission bounds (``max_queue`` / ``max_queued_tokens``)
-        — the same arithmetic :meth:`submit` applies, exposed so front
-        ends (the disaggregated install retry) can pre-check without
-        the shed bookkeeping a real rejected submit records (counter +
-        event per attempt). Keep in lockstep with ``_submit_impl``'s
-        bound checks."""
+        by the admission bounds (``max_queue`` / ``max_queued_tokens``,
+        plus ``tenant``'s per-tenant quotas when given and QoS is
+        configured) — the same arithmetic :meth:`submit` applies,
+        exposed so front ends (the disaggregated install retry) can
+        pre-check without the shed bookkeeping a real rejected submit
+        records (counter + event per attempt). Keep in lockstep with
+        ``_submit_impl``'s bound checks."""
         if (self.max_queue is not None
                 and len(self._queue) >= self.max_queue):
             return True
-        return (self.max_queued_tokens is not None
+        if (self.max_queued_tokens is not None
                 and self._queued_tokens + int(prompt_tokens)
-                > self.max_queued_tokens)
+                > self.max_queued_tokens):
+            return True
+        if self.qos is not None and tenant is not None:
+            try:
+                self.check_tenant_admissible(tenant, int(prompt_tokens))
+            except QueueFullError:
+                return True
+        return False
 
-    def retry_after_ms(self) -> int:
+    def check_tenant_admissible(self, tenant: str,
+                                prompt_tokens: int) -> None:
+        """Raise :class:`QueueFullError` (the HTTP 429) when queueing
+        ``prompt_tokens`` for ``tenant`` would breach its per-tenant
+        quota — THE shared transient-quota validator: the engine's own
+        submit paths and the disaggregated front end both call it, so
+        a quota-breached tenant sheds identically at every surface
+        while under-quota tenants keep admitting. No-op without a QoS
+        config. Callers own the shed bookkeeping (counter + event);
+        this only decides."""
+        if self.qos is None:
+            return
+        depth_quota, token_quota = self.qos.quota(tenant)
+        if (depth_quota is not None
+                and self._queue.tenant_depth(tenant) >= depth_quota):
+            raise QueueFullError(
+                f"tenant {tenant!r} quota: "
+                f"{self._queue.tenant_depth(tenant)} requests "
+                f"backlogged (max_queue={depth_quota})",
+                self._retry_after_ms(tenant))
+        if token_quota is not None:
+            queued = self._queue.tenant_queued_tokens(tenant)
+            if queued + int(prompt_tokens) > token_quota:
+                raise QueueFullError(
+                    f"tenant {tenant!r} quota: {queued} prompt tokens "
+                    f"backlogged + {int(prompt_tokens)} would exceed "
+                    f"max_queued_tokens={token_quota}",
+                    self._retry_after_ms(tenant))
+
+    def retry_after_ms(self, tenant: Optional[str] = None) -> int:
         """Public read of the shed-backoff hint a
-        :class:`QueueFullError` would carry right now."""
-        return self._retry_after_ms()
+        :class:`QueueFullError` would carry right now (quota-aware
+        when ``tenant`` is given — see :meth:`_retry_after_ms`)."""
+        return self._retry_after_ms(tenant)
 
-    def _retry_after_ms(self) -> int:
+    def _retry_after_ms(self, tenant: Optional[str] = None) -> int:
         """Backoff hint for a shed request: roughly how long until the
         backlog drains enough to retry, from the median observed request
         latency scaled by the queue's depth relative to slot capacity
-        (clamped to a sane window; 100ms before any sample exists)."""
+        (clamped to a sane window; 100ms before any sample exists).
+        With ``tenant`` and a QoS config the depth is the OFFENDING
+        tenant's own backlog — a quota 429's hint scales with how far
+        over its share that tenant is, not with the global queue."""
+        depth = len(self._queue)
+        if tenant is not None and self.qos is not None:
+            depth = self._queue.tenant_depth(tenant)
         if self._latency_window:
             med = float(np.quantile([t for _, t in self._latency_window],
                                     0.5))
-            est = 1000.0 * med * max(1, len(self._queue)) / self.max_slots
+            est = 1000.0 * med * max(1, depth) / self.max_slots
         else:
             est = 100.0
         return int(min(10000.0, max(50.0, est)))
@@ -1581,16 +1774,20 @@ class DecodeEngine:
         discard its partial output. Returns whether anything was
         cancelled (False for unknown or already-finished ids —
         :meth:`result` still serves finished ones)."""
-        for i, item in enumerate(self._queue):
-            if item[0] == rid:
-                del self._queue[i]
-                self._queued_tokens -= int(item[1].size)
-                self._submit_t.pop(rid, None)
-                self._deadline.pop(rid, None)
-                self._trace_ctx.pop(rid, None)
-                self._prefilled_kv.pop(rid, None)
-                self.recorder.record(rid, "cancelled", stage="queued")
-                return True
+        item = self._queue.remove_rid(rid)
+        if item is not None:
+            self._queued_tokens -= int(item.prompt.size)
+            self._submit_t.pop(rid, None)
+            self._deadline.pop(rid, None)
+            self._trace_ctx.pop(rid, None)
+            self._prefilled_kv.pop(rid, None)
+            self._resume.pop(rid, None)
+            # a preempted-then-re-queued request may still hold an
+            # un-surfaced admission token: the next step() must not
+            # report tokens for a cancelled rid
+            self._fresh.pop(rid, None)
+            self.recorder.record(rid, "cancelled", stage="queued")
+            return True
         for slot, r in enumerate(self._rid):
             # the explicit None guard matters: a caller holding a
             # None/absent id must not "cancel" a FREE slot (None == None)
@@ -1600,6 +1797,7 @@ class DecodeEngine:
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
                 self._release_blocks(slot)
+                self._clear_slot_meta(slot)
                 self._submit_t.pop(rid, None)
                 self._admit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
@@ -1616,29 +1814,40 @@ class DecodeEngine:
         """Drop every queued request whose deadline already passed —
         BEFORE it ever reaches prefill. Each becomes a finished result
         with no tokens, marked ``expired`` (the HTTP layer's 504)."""
-        if not self._deadline or not self._queue:
+        if not self._deadline or not len(self._queue):
             return
         now = self._clock()
-        keep: deque = deque()
-        for item in self._queue:
-            rid = item[0]
-            dl = self._deadline.get(rid)
-            if dl is not None and now >= dl:
-                self._queued_tokens -= int(item[1].size)
-                self._deadline.pop(rid, None)
-                self._prefilled_kv.pop(rid, None)
-                t_sub = self._submit_t.pop(rid, None)
+        dropped = self._queue.remove_if(
+            lambda item: (dl := self._deadline.get(item.rid)) is not None
+            and now >= dl)
+        for item in dropped:
+            rid = item.rid
+            self._queued_tokens -= int(item.prompt.size)
+            self._deadline.pop(rid, None)
+            self._prefilled_kv.pop(rid, None)
+            t_sub = self._submit_t.pop(rid, None)
+            saved = self._resume.pop(rid, None)
+            self._trace_ctx.pop(rid, None)
+            if saved is not None:
+                # preempted mid-decode and the deadline passed while
+                # re-queued: the tokens already emitted are the final
+                # (partial) output — a mid-decode timeout, not an
+                # expired-before-prefill shed
+                self._done[rid] = saved["outputs"]
+                self._timed_out.add(rid)
+                self._m_timed_out.inc()
+                self.recorder.record(
+                    rid, "timed_out", stage="preempted_queued",
+                    tokens=len(saved["outputs"]))
+            else:
                 self._done[rid] = []
                 self._expired.add(rid)
                 self._m_expired.inc()
-                self._trace_ctx.pop(rid, None)
                 self.recorder.record(
                     rid, "expired",
                     queue_wait_s=(None if t_sub is None
-                                  else round(time.monotonic() - t_sub, 6)))
-            else:
-                keep.append(item)
-        self._queue = keep
+                                  else round(time.monotonic() - t_sub,
+                                             6)))
 
     def _enforce_active_deadlines(self):
         """Retire every ACTIVE slot whose request deadline passed: the
@@ -1664,14 +1873,25 @@ class DecodeEngine:
         self.apply_staged_params()
         self._shed_expired_queued()
         self._enforce_active_deadlines()
-        for slot in self._free_slots():
-            if not self._queue:
-                return
+        while len(self._queue):
+            slots = self._free_slots()
+            if not slots:
+                # every slot busy: a strictly-higher-priority candidate
+                # may preempt a lower-priority in-flight decode (QoS
+                # with the paged cache only) — otherwise admission
+                # waits for a retirement exactly as before
+                if not self._maybe_preempt_for(self._queue.peek()):
+                    return
+                continue
+            slot = slots[0]
             if self.paged is not None:
                 # allocate BEFORE popping: when the pool is momentarily
-                # empty the head request simply waits (FIFO — no
-                # smaller-request overtaking, so no starvation)
-                nxt_rid, nxt_prompt, nxt_max_new = self._queue[0][:3]
+                # empty the scheduled candidate simply waits (no
+                # overtaking past the fair-queue choice, so no
+                # starvation)
+                cand = self._queue.peek()
+                nxt_rid, nxt_prompt, nxt_max_new = (cand.rid, cand.prompt,
+                                                    cand.max_new)
                 bsz = self.paged[1]
                 needed = -(-(nxt_prompt.size + nxt_max_new) // bsz)
                 hits = []
@@ -1709,7 +1929,13 @@ class DecodeEngine:
                               - sum(1 for e in hits
                                     if self._kv_cache.is_parked(e)))
                 if avail < needed - len(hits):
-                    return
+                    # pool pressure: a higher-priority candidate may
+                    # preempt a lower-priority decode (its blocks park
+                    # or free, and the loop re-evaluates availability);
+                    # otherwise the candidate keeps its turn and waits
+                    if not self._maybe_preempt_for(cand):
+                        return
+                    continue
                 # claim the hit chain FIRST (refcount++, unpark): the
                 # remainder allocation below may evict LRU entries and
                 # must never reclaim the blocks this request reuses
@@ -1722,14 +1948,17 @@ class DecodeEngine:
                 self._tables[slot, :] = 0      # unused entries -> scratch
                 self._tables[slot, :needed] = (
                     [e.payload for e in hits] + blocks)
-            rid, prompt, max_new, temp, topk, topp = self._queue.popleft()
+            item = self._queue.pop()
+            rid, prompt, max_new = item.rid, item.prompt, item.max_new
+            temp, topk, topp = item.temperature, item.top_k, item.top_p
             self._queued_tokens -= int(prompt.size)
+            resume = self._resume.pop(rid, None)
             # queue wait ends HERE — prefill compute/compile time below
             # belongs to total latency, not to time-spent-queued
             self._admit_t[rid] = time.monotonic()
             t_sub = self._submit_t.get(rid)
             self.recorder.record(
-                rid, "admitted", slot=slot,
+                rid, "admitted", slot=slot, tenant=item.tenant,
                 # the weight version this request will decode under —
                 # the flight-recorder half of "which weights served
                 # this request" (a mid-decode swap shows up as
@@ -1783,15 +2012,180 @@ class DecodeEngine:
                     t0 = self._admit_prefill(rid, slot, prompt, temp,
                                              topk, topp)
             self._rid[slot] = rid
-            self._outputs[rid] = []
+            # a RESUMED request keeps the tokens it emitted before its
+            # preemption — the new first token (sampled from the full
+            # resubmitted sequence's final-position logits) is exactly
+            # the next token the never-preempted decode would emit
+            self._outputs[rid] = ([] if resume is None
+                                  else resume["outputs"])
+            self._slot_prompt[slot] = prompt
+            self._slot_prior[slot] = len(self._outputs[rid])
+            self._slot_tenant[slot] = item.tenant
+            self._slot_priority[slot] = item.priority
+            self._slot_wv[slot] = self.weights_version
             self._pos[slot] = prompt.size - 1
             self._last[slot] = t0
             self._budget[slot] = max_new
             self._temp[slot] = temp
             self._topk[slot] = topk
             self._topp[slot] = topp
+            if self.qos is not None:
+                self._m_tenant_admitted.labels(
+                    tenant=self.qos.label(item.tenant)).inc()
+            if resume is not None:
+                self.recorder.record(
+                    rid, "resumed", tokens_so_far=len(self._outputs[rid]),
+                    remaining_tokens=int(max_new),
+                    preemptions=resume["preempts"])
             if self._record(slot, t0):
-                self._fresh[rid] = t0    # surfaced by the next step()
+                # surfaced by the next step(); append — a preempted-
+                # and-resumed request may still owe its PREVIOUS
+                # admission's un-surfaced first token
+                self._fresh.setdefault(rid, []).append(t0)
+
+    # --------------------------------------------------------- preemption
+    @property
+    def _preempt_enabled(self) -> bool:
+        """Preemption needs somewhere cheap to PARK the victim's KV:
+        the paged pool + block cache (park = release to LRU, resume =
+        chain-walk reclaim). QoS on other engine shapes still gets
+        fair queueing and quotas, never preemption."""
+        return (self.qos is not None and self.qos.preempt
+                and self.paged is not None
+                and self._kv_cache is not None)
+
+    def _maybe_preempt_for(self, cand) -> bool:
+        """Preempt ONE in-flight decode of strictly lower priority
+        than queued candidate ``cand`` (lowest class first; among
+        equals the slot with the fewest emitted tokens — the cheapest
+        resume). Returns whether a victim was preempted; the admission
+        loop re-evaluates capacity after each one."""
+        if cand is None or not self._preempt_enabled:
+            return False
+        victim = None
+        for slot, rid in enumerate(self._rid):
+            if rid is None:
+                continue
+            prio = int(self._slot_priority[slot])
+            if prio >= int(cand.priority):
+                continue
+            key = (prio, len(self._outputs.get(rid, ())))
+            if victim is None or key < victim[0]:
+                victim = (key, slot)
+        if victim is None:
+            return False
+        self._preempt_slot(victim[1])
+        return True
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict the slot's request mid-decode, parking its KV: every
+        full block of the sequence decoded so far enters the block
+        cache (release → LRU — resident but reclaimable, exactly like
+        a retired request's shared prefix), and the request re-queues
+        at the FRONT of its tenant lane with prompt = original prompt
+        + tokens emitted so far and budget = what remains. On
+        re-admission the chain walk reclaims the parked blocks, so
+        resume costs a short remainder prefill, not a recompute — and
+        greedy output is token-identical to the never-preempted run.
+
+        ``serving.preempt`` fault site: ``delay`` = a slow park,
+        ``drop``/``error`` = the parking path failing — the blocks
+        free instead of parking and the request still re-queues
+        (resume recomputes; a preemption fault may cost compute, never
+        the request)."""
+        rid = self._rid[slot]
+        tenant = self._slot_tenant[slot] or DEFAULT_TENANT
+        priority = int(self._slot_priority[slot])
+        prompt = self._slot_prompt[slot]
+        outputs = self._outputs.pop(rid)
+        remaining = int(self._budget[slot])
+        # only the tokens emitted SINCE this slot's admission extend
+        # the prompt — a resumed request's prompt already folds in its
+        # pre-preemption output (_slot_prior), and re-appending it
+        # would corrupt the sequence on a second preemption
+        seq = np.concatenate(
+            [prompt, np.asarray(outputs[int(self._slot_prior[slot]):],
+                                np.int32)])
+        parked = 0
+        try:
+            if fault_site("serving.preempt"):
+                raise InjectedFault("injected preempt-park drop")
+            # KV through position _pos[slot] is on device: park its
+            # full blocks (the pending last token was never processed,
+            # so the parked chain covers seq[:-1])
+            parked = self._park_slot_blocks(
+                slot, seq[:int(self._pos[slot]) + 1])
+        except InjectedFault:
+            parked = 0     # park failed: blocks free below instead —
+            # the resume recomputes the prefix, the request survives
+        resume = self._resume.get(rid)
+        preempts = 1 + (0 if resume is None else resume["preempts"])
+        self._rid[slot] = None
+        self._release_blocks(slot)
+        self._clear_slot_meta(slot)
+        self._admit_t.pop(rid, None)
+        if self._chain_memo is not None and self._chain_memo[0] == rid:
+            # the resume prompt differs from the one this rid's memo
+            # hashed — a stale memo would walk the wrong chain
+            self._chain_memo = None
+        self._resume[rid] = {"outputs": outputs, "preempts": preempts}
+        self._queue.appendleft(QueuedRequest(
+            rid, seq, remaining, float(self._temp[slot]),
+            int(self._topk[slot]), float(self._topp[slot]), tenant,
+            priority))
+        self._queued_tokens += int(seq.size)
+        self._m_preemptions.inc()
+        if self.qos is not None:
+            self._m_tenant_preempt.labels(
+                tenant=self.qos.label(tenant)).inc()
+        self.recorder.record(rid, "preempted", tokens=len(outputs),
+                             parked_blocks=parked,
+                             remaining_tokens=remaining)
+        emit_event("serving.preempted", rid=rid, tenant=tenant,
+                   tokens=len(outputs), parked_blocks=parked)
+
+    def _park_slot_blocks(self, slot: int, seq_kv: np.ndarray) -> int:
+        """Move the slot's PRIVATE full blocks over ``seq_kv`` (the
+        tokens whose KV the slot holds) into the block cache, keyed by
+        the sequence's chain — un-referenced, so they park on the LRU
+        immediately: resident for the resume's walk, reclaimable under
+        pool pressure like any cold prefix. Blocks whose chain key is
+        already cached (admission-time hits/inserts) stay where they
+        are — :meth:`_release_blocks` parks those via their refcounts.
+        Returns how many blocks parked here."""
+        if int(self._slot_wv[slot]) != int(self.weights_version):
+            # a hot-swap landed mid-decode: this KV was (partly)
+            # computed under other weights — parking it under the
+            # CURRENT version's chain keys would serve stale state to
+            # a post-swap admission. Free instead of park.
+            return 0
+        from .models.block_cache import chain_keys
+
+        bs = self._kv_cache_bs
+        nfull = seq_kv.size // bs
+        if nfull == 0:
+            return 0
+        keys = chain_keys(seq_kv[:nfull * bs], bs, self.weights_version)
+        private = set(self._slot_blocks[slot])
+        parked = 0
+        for i, key in enumerate(keys):
+            if self._kv_cache.get(key) is not None:
+                continue
+            bid = int(self._tables[slot, i])
+            if bid not in private:
+                continue           # shared under a different key: leave
+            self._kv_cache.insert(key, bid, (i + 1) * bs)
+            self._slot_blocks[slot].remove(bid)
+            private.discard(bid)
+            parked += 1
+        return parked
+
+    def _clear_slot_meta(self, slot: int) -> None:
+        self._slot_prompt[slot] = None
+        self._slot_prior[slot] = 0
+        self._slot_tenant[slot] = None
+        self._slot_priority[slot] = 0
+        self._slot_wv[slot] = 0
 
     def _admit_prefill(self, rid: int, slot: int, prompt: np.ndarray,
                        temp: float, topk: int, topp: float) -> int:
@@ -2001,6 +2395,7 @@ class DecodeEngine:
         self._done[rid] = self._outputs.pop(rid)
         self._rid[slot] = None
         self._release_blocks(slot)
+        self._clear_slot_meta(slot)
         self._deadline.pop(rid, None)
         now = time.monotonic()
         t_sub = self._submit_t.pop(rid, None)
@@ -2071,6 +2466,32 @@ class DecodeEngine:
             ks = self._kv_cache.stats()
             ks["block_size"] = self._kv_cache_bs
             out["kv_cache"] = ks
+        if self.qos is not None:
+            out["preemptions"] = int(
+                self._since_init(self._m_preemptions))
+            # per-tenant story on one read: live queue numbers plus
+            # the labeled counters (the metric IS the store)
+            tenants: Dict[str, Dict] = {}
+            for t in self._queue.live_tenants():
+                label = self.qos.label(t)
+                entry = tenants.setdefault(
+                    label, {"queue_depth": 0, "queued_tokens": 0})
+                entry["queue_depth"] += self._queue.tenant_depth(t)
+                entry["queued_tokens"] += (
+                    self._queue.tenant_queued_tokens(t))
+            for metric, key in ((self._m_tenant_admitted, "admitted"),
+                                (self._m_tenant_preempt, "preempted")):
+                for labels, child in metric.series().items():
+                    entry = tenants.setdefault(
+                        labels[0], {"queue_depth": 0,
+                                    "queued_tokens": 0})
+                    entry[key] = int(child.value)
+            for labels, child in self._m_tenant_shed.series().items():
+                entry = tenants.setdefault(
+                    labels[0], {"queue_depth": 0, "queued_tokens": 0})
+                entry.setdefault("sheds", {})[labels[1]] = int(
+                    child.value)
+            out["tenants"] = tenants
         out["tier"] = self.tier
         if self._latency_window:
             totals = [t for _, t in self._latency_window]
@@ -2136,7 +2557,7 @@ class DecodeEngine:
         # records it and /health turns red), 'delay' = a slow step
         fault_site("serving.step")
         self._admit()
-        emitted = {rid: [tok] for rid, tok in self._fresh.items()}
+        emitted = {rid: list(toks) for rid, toks in self._fresh.items()}
         self._fresh = {}
         active = np.asarray([r is not None for r in self._rid])
         if not active.any():

@@ -49,7 +49,13 @@ Overload safety (the serving-operations doc page has the full story):
 
 - Admission control: construct the engine with ``max_queue`` /
   ``max_queued_tokens`` and an over-capacity submit answers **429**
-  with a ``retry_after_ms`` backoff hint instead of queueing forever.
+  with a ``retry_after_ms`` backoff hint (and the standard
+  ``Retry-After`` header derived from it) instead of queueing forever.
+- Multi-tenant QoS: requests may carry ``tenant`` (body field or
+  ``X-Tenant`` header — body wins) and ``priority``; with a
+  :class:`~elephas_tpu.serving_qos.TenantQoS` on the engine these
+  drive fair queueing, per-tenant quota 429s, and preemption, and the
+  ``http_request_*`` series carry a ``tenant`` label.
 - Deadlines: requests may carry ``deadline_ms`` (or inherit the
   server's ``default_deadline_ms``). Expired-while-queued answers
   **504** (shed before prefill); expired mid-decode returns the partial
@@ -124,12 +130,24 @@ class _HTTPError(Exception):
     """A route outcome with a specific status code: raised anywhere
     under a handler's dispatch, answered as ``code`` + JSON payload
     (the generic handler fallback answers 400, which overload responses
-    like 429/503/504 must not collapse into)."""
+    like 429/503/504 must not collapse into). ``headers`` ride onto the
+    response — the 429 path's standard ``Retry-After``."""
 
-    def __init__(self, code: int, payload: Dict):
+    def __init__(self, code: int, payload: Dict,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(payload.get("error", f"http {code}"))
         self.code = code
         self.payload = payload
+        self.headers = headers or {}
+
+
+def retry_after_header(retry_after_ms: int) -> Dict[str, str]:
+    """The standard ``Retry-After`` header (integer seconds, >= 1)
+    derived from a ``retry_after_ms`` backoff hint — shed responses
+    carry BOTH: the JSON field keeps millisecond precision for aware
+    clients, the header serves every off-the-shelf HTTP client and
+    proxy. Shared with the fleet router's edge 429."""
+    return {"Retry-After": str(max(1, -(-int(retry_after_ms) // 1000)))}
 
 
 class ServingServer:
@@ -181,11 +199,15 @@ class ServingServer:
         import inspect
 
         try:
-            self._engine_has_deadline = ("deadline_ms" in inspect
-                                         .signature(engine.submit)
-                                         .parameters)
+            submit_params = inspect.signature(engine.submit).parameters
+            self._engine_has_deadline = "deadline_ms" in submit_params
+            # same contract for multi-tenant QoS fields: an explicit
+            # tenant/priority on an engine without them must fail
+            # loudly, never be silently dropped
+            self._engine_has_tenant = "tenant" in submit_params
         except (TypeError, ValueError):
             self._engine_has_deadline = True   # assume the full engine
+            self._engine_has_tenant = True
         self._host, self._port = host, int(port)
         self._lock = threading.Lock()          # guards every engine call
         self._cond = threading.Condition(self._lock)
@@ -210,13 +232,18 @@ class ServingServer:
         self.registry = reg = (registry
                                or getattr(engine, "registry", None)
                                or MetricsRegistry())
+        # tenant rides the http families so one query answers "what is
+        # tenant X experiencing at the edge" — "" for routes without a
+        # request body; unconfigured tenant names fold into "other"
+        # (the label domain is client-chosen and must stay bounded)
         self._m_http_latency = reg.histogram(
             "http_request_duration_seconds",
-            "request wall time by route and status",
-            labels=("route", "status"))
+            "request wall time by route, status, and tenant",
+            labels=("route", "status", "tenant"))
         self._m_http_requests = reg.counter(
-            "http_requests_total", "requests served by route and status",
-            labels=("route", "status"))
+            "http_requests_total",
+            "requests served by route, status, and tenant",
+            labels=("route", "status", "tenant"))
         self._m_drained = reg.counter(
             "serving_requests_drained_total",
             "in-flight requests cancelled at the drain deadline").labels()
@@ -243,12 +270,24 @@ class ServingServer:
         return int(since_baseline(self._drained_base, self._m_drained))
 
     # ------------------------------------------------------------ metrics
-    def _observe_http(self, path: str, status: int, t0: float):
+    def _observe_http(self, path: str, status: int, t0: float,
+                      tenant: Optional[str] = None):
         route = _route_label(path)
         dur = time.perf_counter() - t0
-        labels = dict(route=route, status=str(int(status)))
+        labels = dict(route=route, status=str(int(status)),
+                      tenant=self._tenant_label(tenant))
         self._m_http_latency.labels(**labels).observe(dur)
         self._m_http_requests.labels(**labels).inc()
+
+    def _tenant_label(self, tenant: Optional[str]) -> str:
+        """Bounded metrics label for a client-supplied tenant name:
+        tenants the engine's QoS config knows keep their name, anything
+        else folds to ``"other"`` (and requests without a tenant to
+        ``""``) — client strings must never grow a label domain."""
+        if not tenant:
+            return ""
+        qos = getattr(self.engine, "qos", None)
+        return qos.label(tenant) if qos is not None else "other"
 
     def _metrics_text(self) -> str:
         """Prometheus exposition for ``GET /metrics``: the server
@@ -282,16 +321,21 @@ class ServingServer:
                 ctx = parse_traceparent(self.headers.get("traceparent"))
                 return ctx if ctx is not None else new_root()
 
-            def _reply(self, code: int, body: bytes, content_type: str):
+            def _reply(self, code: int, body: bytes, content_type: str,
+                       headers: Optional[Dict] = None):
                 # record BEFORE the body goes out: a client must find
                 # its own request already counted if it scrapes /metrics
                 # right after reading this response
                 server._observe_http(urlparse(self.path).path, code,
                                      getattr(self, "_t0", None)
-                                     or time.perf_counter())
+                                     or time.perf_counter(),
+                                     tenant=getattr(self, "_tenant",
+                                                    None))
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 ctx = current_context()
                 if ctx is not None:
                     # the id the client joins its logs/timelines on —
@@ -300,9 +344,10 @@ class ServingServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, code: int, payload: Dict):
+            def _json(self, code: int, payload: Dict,
+                      headers: Optional[Dict] = None):
                 self._reply(code, json.dumps(payload).encode(),
-                            "application/json")
+                            "application/json", headers=headers)
 
             def _body(self) -> Dict:
                 try:
@@ -340,7 +385,8 @@ class ServingServer:
                     try:
                         self._get_routes(url)
                     except _HTTPError as err:
-                        self._json(err.code, err.payload)
+                        self._json(err.code, err.payload,
+                                   headers=err.headers)
 
             def _get_routes(self, url):
                 trace_route = _TRACE_ROUTE_RE.match(url.path)
@@ -428,6 +474,14 @@ class ServingServer:
                 except (ValueError, json.JSONDecodeError):
                     self._json(400, {"error": "invalid JSON body"})
                     return
+                # the X-Tenant header is the body field's equal: merge
+                # it in (body wins) so every downstream consumer —
+                # engine QoS, metrics labels, a proxied replica — sees
+                # ONE tenant regardless of how the client sent it
+                hdr_tenant = self.headers.get("X-Tenant")
+                if hdr_tenant and body.get("tenant") is None:
+                    body["tenant"] = hdr_tenant
+                self._tenant = body.get("tenant")
                 try:
                     if url.path == "/v1/generate" and body.get("stream"):
                         # submit FIRST: validation errors still answer a
@@ -465,8 +519,9 @@ class ServingServer:
                             # the 200 went out before the first token;
                             # the latency recorded here is the full
                             # stream duration
-                            server._observe_http("/v1/generate", 200,
-                                                 self._t0)
+                            server._observe_http(
+                                "/v1/generate", 200, self._t0,
+                                tenant=getattr(self, "_tenant", None))
                         return
                     if url.path == "/v1/generate":
                         self._json(200, server._generate(body))
@@ -479,7 +534,8 @@ class ServingServer:
                 except _HTTPError as err:
                     # overload/drain outcomes carry their own status:
                     # 429 shed, 503 draining, 504 expired, 413 oversize
-                    self._json(err.code, err.payload)
+                    self._json(err.code, err.payload,
+                               headers=err.headers)
                 except Exception as exc:  # noqa: BLE001 — malformed-but-
                     # valid-JSON payloads (wrong types/shapes) and engine
                     # validation errors all answer a clean 400, never a
@@ -679,6 +735,14 @@ class ServingServer:
         elif (self.default_deadline_ms is not None
                 and self._engine_has_deadline):
             kwargs["deadline_ms"] = self.default_deadline_ms
+        for field in ("tenant", "priority"):
+            if body.get(field) is not None:
+                if not self._engine_has_tenant:
+                    # the deadline convention: an explicit QoS field on
+                    # an engine without tenant support fails loudly
+                    raise ValueError(f"this engine does not support "
+                                     f"per-request {field}")
+                kwargs[field] = body[field]
         with self._cond:
             if self._draining or self._stop.is_set():
                 raise _HTTPError(503, {"error": "server is draining; "
@@ -697,9 +761,12 @@ class ServingServer:
             except QueueFullError as exc:
                 # overload answers NOW, with a backoff hint — the whole
                 # point of admission control is never to queue forever
+                # (standard Retry-After header + the ms-precision JSON
+                # field; a per-tenant quota breach sheds here too)
                 raise _HTTPError(429, {
                     "error": str(exc),
-                    "retry_after_ms": exc.retry_after_ms})
+                    "retry_after_ms": exc.retry_after_ms},
+                    headers=retry_after_header(exc.retry_after_ms))
             self._tracked.add(rid)
             if stream:
                 # registered under the SAME lock as submit, so the very

@@ -1,0 +1,365 @@
+"""Multi-tenant QoS: priority classes, weighted fair queueing, quotas.
+
+The serving stack's isolation layer. Everything below this module is
+GLOBAL — admission bounds (``max_queue`` / ``max_queued_tokens``) and
+the fleet edge's 429s protect the ENGINE, not any one tenant, so a
+single heavy tenant flooding the queue degrades every tenant equally.
+:class:`TenantQoS` + :class:`FairQueue` make overload degrade
+*selectively* instead:
+
+- **Tenants.** Every request carries a ``tenant`` name (``"default"``
+  when the client sends none). The engine schedules, meters, and
+  sheds per tenant.
+- **Priority classes.** ``"low"`` / ``"normal"`` / ``"high"``
+  (:data:`PRIORITY_CLASSES`), per tenant with a per-request override.
+  A strictly-higher class is admitted first, and — in a paged engine
+  with the automatic prefix cache — may PREEMPT a lower class's
+  in-flight decode under pool pressure (see
+  :meth:`~elephas_tpu.serving_engine.DecodeEngine._preempt_slot`: the
+  victim's full KV blocks park in the
+  :class:`~elephas_tpu.models.block_cache.BlockCache` and resume as a
+  prefix-cache hit, so preemption costs a short remainder prefill, not
+  a recompute).
+- **Weighted fair queueing.** Admission replaces the FIFO pop with
+  deficit-round-robin over QUEUED TOKENS (not request counts — a
+  tenant submitting 4x-longer prompts gets 1/4 the admissions at equal
+  weight, which is what "fair share of prefill capacity" means).
+  Within one priority class, each tenant's long-run admitted-token
+  share converges to ``weight / sum(weights of backlogged tenants)``.
+- **Quotas.** Per-tenant ``max_queue`` / ``max_queued_tokens`` bounds:
+  a breaching submit sheds with a 429 + a quota-aware
+  ``retry_after_ms`` (scaled by the OFFENDING tenant's own backlog)
+  while under-quota tenants keep admitting — the isolation the global
+  bounds cannot give.
+
+``docs/sources/serving-operations.md`` ("Multi-tenant isolation") has
+the runbook; the ``tenant_qos`` row in ``benchmarks/baseline_rows.py``
+is the measured claim (a flooding heavy tenant vs a light interactive
+tenant, QoS on vs off).
+"""
+import math
+from collections import OrderedDict, deque
+from typing import Dict, Iterator, List, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["TenantQoS", "FairQueue", "QueuedRequest", "PRIORITY_CLASSES",
+           "DEFAULT_TENANT"]
+
+#: the named priority classes requests/tenants may carry (larger =
+#: more important); integers are also accepted anywhere a class name is
+PRIORITY_CLASSES = {"low": 0, "normal": 1, "high": 2}
+
+#: the tenant every request without an explicit ``tenant`` belongs to
+DEFAULT_TENANT = "default"
+
+#: metrics label for tenants absent from the QoS config: label domains
+#: must stay bounded (clients choose tenant names; the registry caps
+#: label sets), so only CONFIGURED tenants get their own label
+OTHER_LABEL = "other"
+
+
+class QueuedRequest(NamedTuple):
+    """One queued (not yet admitted) engine request. ``prompt`` is the
+    tokens admission will prefill — for a preempted request re-queued
+    for resume, that is the ORIGINAL prompt plus every token emitted so
+    far (the chain walk then reclaims its parked KV blocks, so resume
+    admits like a prefix-cache hit)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    tenant: str
+    priority: int
+
+
+class TenantQoS:
+    """The per-tenant serving policy a
+    :class:`~elephas_tpu.serving_engine.DecodeEngine` enforces.
+
+    :param tenants: ``{name: spec}`` where spec may hold ``weight``
+        (fair-queueing share, > 0), ``priority`` (default class for the
+        tenant's requests — a :data:`PRIORITY_CLASSES` name or int),
+        ``max_queue`` (quota on the tenant's queued requests) and
+        ``max_queued_tokens`` (quota on the tenant's queued prompt
+        tokens). Unlisted tenants get the defaults below and fold into
+        the ``"other"`` metrics label.
+    :param default_weight: weight for unlisted tenants.
+    :param default_priority: class for requests that carry none.
+    :param preempt: allow a strictly-higher-priority queued request to
+        preempt a lower-priority in-flight decode under pool pressure
+        (paged engines with the prefix cache only — parking needs the
+        block cache; other engines ignore the flag).
+    :param quantum_tokens: deficit-round-robin quantum — tokens of
+        admission credit a backlogged tenant accrues per scheduling
+        round, scaled by its weight.
+    """
+
+    def __init__(self, tenants: Optional[Dict[str, Dict]] = None,
+                 default_weight: float = 1.0,
+                 default_priority="normal", preempt: bool = True,
+                 quantum_tokens: int = 64):
+        self.tenants: Dict[str, Dict] = {}
+        for name, spec in (tenants or {}).items():
+            spec = dict(spec or {})
+            unknown = set(spec) - {"weight", "priority", "max_queue",
+                                   "max_queued_tokens"}
+            if unknown:
+                raise ValueError(f"unknown tenant spec keys for "
+                                 f"{name!r}: {sorted(unknown)}")
+            if "weight" in spec and not float(spec["weight"]) > 0:
+                raise ValueError(f"tenant {name!r} weight must be > 0")
+            if "priority" in spec:
+                spec["priority"] = self._parse_class(spec["priority"])
+            for bound in ("max_queue", "max_queued_tokens"):
+                if spec.get(bound) is not None and int(spec[bound]) < 1:
+                    raise ValueError(
+                        f"tenant {name!r} {bound} must be >= 1")
+            self.tenants[str(name)] = spec
+        self.default_weight = float(default_weight)
+        if not self.default_weight > 0:
+            raise ValueError("default_weight must be > 0")
+        self.default_priority = self._parse_class(default_priority)
+        self.preempt = bool(preempt)
+        self.quantum_tokens = int(quantum_tokens)
+        if self.quantum_tokens < 1:
+            raise ValueError("quantum_tokens must be >= 1")
+
+    @staticmethod
+    def _parse_class(value) -> int:
+        if isinstance(value, str):
+            try:
+                return PRIORITY_CLASSES[value]
+            except KeyError:
+                raise ValueError(
+                    f"unknown priority class {value!r} (one of "
+                    f"{sorted(PRIORITY_CLASSES)}, or an int)") from None
+        return int(value)
+
+    @classmethod
+    def coerce(cls, value) -> Optional["TenantQoS"]:
+        """``None`` | :class:`TenantQoS` | ctor-kwargs dict — the
+        engine's ``qos=`` parameter accepts all three."""
+        if value is None or isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(f"qos must be a TenantQoS or a kwargs dict, "
+                        f"got {type(value).__name__}")
+
+    # ------------------------------------------------------------ policy
+    def weight(self, tenant: str) -> float:
+        return float(self.tenants.get(tenant, {}).get(
+            "weight", self.default_weight))
+
+    def priority(self, tenant: str, override=None) -> int:
+        """The request's effective priority class: the tenant's
+        configured class, which a per-request override (name or int)
+        may only LOWER — priority is an operator-granted property of
+        the tenant, and an uncapped override would let any client
+        self-escalate past the isolation the policy exists to enforce
+        (outranking and even preempting higher-paying tenants)."""
+        ceiling = int(self.tenants.get(tenant, {}).get(
+            "priority", self.default_priority))
+        if override is None:
+            return ceiling
+        return min(self._parse_class(override), ceiling)
+
+    def quota(self, tenant: str):
+        """``(max_queue, max_queued_tokens)`` for ``tenant`` (each
+        ``None`` = unbounded)."""
+        spec = self.tenants.get(tenant, {})
+        mq = spec.get("max_queue")
+        mt = spec.get("max_queued_tokens")
+        return (None if mq is None else int(mq),
+                None if mt is None else int(mt))
+
+    def label(self, tenant: Optional[str]) -> str:
+        """The metrics label for ``tenant``: configured tenants (and
+        the default tenant) keep their name; everything else folds to
+        ``"other"`` so client-chosen names cannot grow a label domain
+        past the registry's cardinality bound."""
+        if not tenant:
+            return DEFAULT_TENANT
+        if tenant in self.tenants or tenant == DEFAULT_TENANT:
+            return str(tenant)
+        return OTHER_LABEL
+
+
+class FairQueue:
+    """The engine's admission queue: plain FIFO without a policy,
+    token-budget deficit-round-robin across tenants (within the
+    highest backlogged priority class) with one.
+
+    Scheduling rule with a :class:`TenantQoS`:
+
+    1. Requests are FIFO *within* a tenant (one deque per tenant).
+    2. Only tenants whose HEAD request is in the highest priority class
+       present are candidates — strict priority across classes.
+    3. Among candidates, deficit round robin over tokens: each tenant
+       carries a deficit counter; every scheduling round adds
+       ``quantum_tokens * weight`` and the first tenant (in rotation
+       order) whose deficit covers its head request's prompt tokens is
+       served, paying the prompt size down from its deficit. A tenant
+       whose queue empties forfeits its deficit (no hoarding credit
+       while idle — classic DRR). :meth:`peek` computes the same choice
+       :meth:`pop` commits, side-effect free, so a paged engine can
+       hold the chosen candidate waiting for pool capacity exactly
+       like the old FIFO head (no overtaking — no starvation).
+    """
+
+    def __init__(self, qos: Optional[TenantQoS] = None):
+        self._qos = qos
+        self._fifo: deque = deque()            # qos is None
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._rr: List[str] = []               # backlogged, rotation order
+        self._deficit: Dict[str, float] = {}
+        self._tokens: Dict[str, int] = {}      # queued tokens per tenant
+        self._len = 0
+
+    # ---------------------------------------------------------- plumbing
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self) -> Iterator[QueuedRequest]:
+        if self._qos is None:
+            return iter(list(self._fifo))
+        return iter([item for t in self._queues
+                     for item in self._queues[t]])
+
+    def append(self, item: QueuedRequest) -> None:
+        self._push(item, left=False)
+
+    def appendleft(self, item: QueuedRequest) -> None:
+        """Queue at the FRONT of the item's tenant lane — a preempted
+        request resumes before anything its tenant queued after it."""
+        self._push(item, left=True)
+
+    def _push(self, item: QueuedRequest, left: bool) -> None:
+        self._len += 1
+        if self._qos is None:
+            (self._fifo.appendleft if left else self._fifo.append)(item)
+            return
+        t = item.tenant
+        lane = self._queues.get(t)
+        if lane is None:
+            lane = self._queues[t] = deque()
+        if not lane:
+            self._rr.append(t)                 # (re)joins the rotation
+        (lane.appendleft if left else lane.append)(item)
+        self._tokens[t] = self._tokens.get(t, 0) + int(item.prompt.size)
+
+    # --------------------------------------------------------- scheduling
+    def _choose(self):
+        """(rounds, candidate tenants, winner) of the next DRR grant —
+        a pure function of the queue state, so peek() and pop() agree."""
+        heads = {t: self._queues[t][0] for t in self._rr}
+        top = max(h.priority for h in heads.values())
+        cands = [t for t in self._rr if heads[t].priority == top]
+        best = None
+        for idx, t in enumerate(cands):
+            need = int(heads[t].prompt.size)
+            d = self._deficit.get(t, 0.0)
+            qw = self._qos.quantum_tokens * self._qos.weight(t)
+            k = 0 if d >= need else math.ceil((need - d) / qw)
+            if best is None or k < best[0]:
+                best = (k, idx, t)
+        return best[0], cands, best[2]
+
+    def peek(self) -> Optional[QueuedRequest]:
+        if self._qos is None:
+            return self._fifo[0] if self._fifo else None
+        if not self._rr:
+            return None
+        return self._queues[self._choose()[2]][0]
+
+    def pop(self) -> QueuedRequest:
+        if self._qos is None:
+            self._len -= 1
+            return self._fifo.popleft()
+        rounds, cands, winner = self._choose()
+        if rounds:
+            q = self._qos.quantum_tokens
+            for t in cands:
+                self._deficit[t] = (self._deficit.get(t, 0.0)
+                                    + rounds * q * self._qos.weight(t))
+        item = self._queues[winner].popleft()
+        self._len -= 1
+        self._tokens[winner] -= int(item.prompt.size)
+        self._deficit[winner] = (self._deficit.get(winner, 0.0)
+                                 - int(item.prompt.size))
+        self._rr.remove(winner)
+        if self._queues[winner]:
+            self._rr.append(winner)            # rotate to the back
+        else:
+            del self._queues[winner]           # idle: forfeit the credit
+            self._deficit.pop(winner, None)
+            self._tokens.pop(winner, None)
+        return item
+
+    # ----------------------------------------------------------- removal
+    def remove_if(self, pred) -> List[QueuedRequest]:
+        """Drop (and return) every queued item matching ``pred`` — the
+        expired-deadline sweep and cancel path."""
+        if self._qos is None:
+            return self._remove_fifo(pred)
+        dropped: List[QueuedRequest] = []
+        for t in list(self._queues):
+            lane = self._queues[t]
+            keep = deque()
+            for item in lane:
+                if pred(item):
+                    dropped.append(item)
+                    self._tokens[t] -= int(item.prompt.size)
+                else:
+                    keep.append(item)
+            if len(keep) != len(lane):
+                self._queues[t] = keep
+                if not keep:
+                    del self._queues[t]
+                    self._rr.remove(t)
+                    self._deficit.pop(t, None)
+                    self._tokens.pop(t, None)
+        self._len -= len(dropped)
+        return dropped
+
+    def _remove_fifo(self, pred) -> List[QueuedRequest]:
+        dropped, keep = [], deque()
+        for item in self._fifo:
+            (dropped.append if pred(item) else keep.append)(item)
+        self._fifo = keep
+        self._len -= len(dropped)
+        return dropped
+
+    def remove_rid(self, rid: int) -> Optional[QueuedRequest]:
+        out = self.remove_if(lambda item: item.rid == rid)
+        return out[0] if out else None
+
+    # ----------------------------------------------------------- queries
+    def tenant_depth(self, tenant: str) -> int:
+        if self._qos is None:
+            return sum(1 for item in self._fifo if item.tenant == tenant)
+        lane = self._queues.get(tenant)
+        return 0 if lane is None else len(lane)
+
+    def tenant_queued_tokens(self, tenant: str) -> int:
+        if self._qos is None:
+            return sum(int(item.prompt.size) for item in self._fifo
+                       if item.tenant == tenant)
+        return int(self._tokens.get(tenant, 0))
+
+    def tokens_for_label(self, label: str, qos: TenantQoS) -> int:
+        """Queued tokens across every tenant folding into metrics
+        ``label`` (the ``serving_tenant_queued_tokens`` gauge callback
+        — ``"other"`` aggregates all unconfigured tenants)."""
+        return sum(n for t, n in self._tokens.items()
+                   if qos.label(t) == label)
+
+    def live_tenants(self) -> List[str]:
+        """Tenants with queued work right now (stats surface)."""
+        if self._qos is None:
+            return sorted({item.tenant for item in self._fifo})
+        return list(self._queues)

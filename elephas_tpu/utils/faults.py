@@ -39,6 +39,13 @@ Instrumented sites (the stable names tests target):
                                  socket write (``drop`` = line lost on the
                                  wire, ``error`` = mid-stream client
                                  disconnect: the server aborts the request)
+``serving.preempt``              each QoS preemption before the victim's
+                                 KV parks (``delay`` = a slow park,
+                                 ``drop``/``error`` = the parking path
+                                 failing: the blocks free instead of
+                                 parking and the request still re-queues —
+                                 resume recomputes, the client request is
+                                 never lost)
 ``disagg.prefill``               each prefill-worker job before its prefill
                                  runs (``delay`` = a slow prefill — the
                                  burst scenario, ``error`` = a prefill

@@ -238,9 +238,11 @@ def test_serving_server_metrics_consistent_with_stats(model):
                 ("serving_tokens_emitted_total", "tokens_emitted"),
                 ("serving_requests_finished_total", "requests_finished")):
             assert samples[series] == stats[key], series
-        # the HTTP layer's own route/status series are in the same scrape
+        # the HTTP layer's own route/status series are in the same
+        # scrape (tenant="" — the request carried no tenant field)
         assert samples[
-            'http_requests_total{route="/v1/generate",status="200"}'] >= 1
+            'http_requests_total{route="/v1/generate",status="200",'
+            'tenant=""}'] >= 1
 
 
 def test_engine_shed_lands_in_registry(model):
