@@ -336,6 +336,278 @@ def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
                       "for the routed head)"}
 
 
+class _UniformSlowStep:
+    """Engine shim: every step() stalls a fixed amount — scales one
+    replica's capacity DOWN so a tiny CPU model saturates under a few
+    closed-loop clients and the autoscaler has something to scale."""
+
+    def __init__(self, engine, delay_s):
+        self._engine = engine
+        self._delay_s = float(delay_s)
+
+    def step(self):
+        time.sleep(self._delay_s)
+        return self._engine.step()
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+class _IntermittentSlowStep:
+    """Engine shim for the hedging A/B: every ``every``-th submitted
+    request is CURSED — steps stall while it is in flight — an
+    intermittently degraded replica (GC-pause / noisy-neighbor shape),
+    the tail hedged retries exist to cut. The stall is strictly
+    per-request: cancelling the cursed request (the hedge's
+    loser-cancel path) or fetching its result lifts it, so one curse
+    slows exactly one request, hedging on or off."""
+
+    def __init__(self, engine, delay_s, every=4):
+        self._engine = engine
+        self._delay_s = float(delay_s)
+        self._every = int(every)
+        self._n_submits = 0
+        self._cursed: set = set()
+
+    def submit(self, *args, **kwargs):
+        rid = self._engine.submit(*args, **kwargs)
+        self._n_submits += 1
+        if self._n_submits % self._every == 0:
+            self._cursed.add(rid)
+        return rid
+
+    def step(self):
+        if self._cursed:
+            time.sleep(self._delay_s)
+        return self._engine.step()
+
+    def result_info(self, rid):
+        out = self._engine.result_info(rid)
+        if out is not None:
+            self._cursed.discard(rid)
+        return out
+
+    def cancel(self, rid):
+        self._cursed.discard(rid)
+        return self._engine.cancel(rid)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def measure_autoscaler(smoke=False):
+    """Autoscaler + hedging row, all CPU-measurable (the control loop
+    must stay falsifiable while the chip tunnel is down):
+
+    - **Load step up**: closed-loop clients triple against a 1-replica
+      fleet; the row reports how many probe windows the autoscaler
+      needs to reach the new replica count and the steady-state client
+      p99 after convergence vs the pre-step baseline.
+    - **Load step down**: the burst ends; the fleet drains back to the
+      floor gracefully while a light client keeps running — the row
+      reports the drained scale-down and the failed-request count
+      (MUST be zero; drain, never kill).
+    - **Hedging A/B**: one replica of three intermittently stalled;
+      same request sequence with hedging off vs on — end-to-end p99
+      cut and the hedged-duplicate fraction vs the 10% cap.
+    """
+    import threading as _threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.fleet import (FleetAutoscaler, FleetRouter,
+                                   ReplicaPool, ReplicaPoolTier,
+                                   TierPolicy)
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs.metrics import percentile
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    c = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=48,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    max_new = 8
+
+    def _gen(port, prompt, timeout=120):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps({"prompt": prompt,
+                             "max_new_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+
+    # ------------------------------------------------ load step up/down
+    probe_w = 0.3
+    pre_s, step_s = (2.0, 5.0) if smoke else (4.0, 12.0)
+    pool = ReplicaPool(
+        lambda: _UniformSlowStep(
+            DecodeEngine(params, c, max_slots=2), 0.02),
+        n=1).start()
+    router = FleetRouter(pool.urls, probe_interval=0.15, join_after=1,
+                         evict_after=2, hedge=False).start()
+    tier = ReplicaPoolTier(
+        router, pool,
+        TierPolicy(min_replicas=1, max_replicas=2, high_depth=1.5,
+                   low_depth=0.8, up_after=1, down_after=3),
+        drain_timeout=30.0)
+    scaler = FleetAutoscaler([tier], probe_interval=probe_w).start()
+    lock = _threading.Lock()
+    lats: list = []
+    failures = [0]
+    stop_light = _threading.Event()
+    stop_heavy = _threading.Event()
+
+    def client(stop_evt):
+        lrng = np.random.default_rng(_threading.get_ident() % 2**31)
+        while not stop_evt.is_set():
+            p = [int(t) for t in lrng.integers(0, 300, 6)]
+            t0 = time.perf_counter()
+            try:
+                _gen(router.port, p)
+            except Exception:  # noqa: BLE001 — ANY client-visible error
+                with lock:     # is a failed request; the row reports it
+                    failures[0] += 1
+                continue
+            with lock:
+                lats.append(time.perf_counter() - t0)
+
+    try:
+        _gen(router.port, [1, 2, 3])   # warm replica 0's compile
+        light = _threading.Thread(target=client, args=(stop_light,),
+                                  daemon=True)
+        light.start()
+        time.sleep(pre_s)
+        with lock:
+            # guard the empty sample (an overloaded runner can starve
+            # the light client out of the whole pre window): the row
+            # then reports None instead of the step dying
+            pre_p99 = percentile(lats, 0.99) if lats else None
+            lats.clear()
+        # 3x load step: two more closed-loop clients
+        t_step = time.monotonic()
+        heavies = [_threading.Thread(target=client, args=(stop_heavy,),
+                                     daemon=True) for _ in range(2)]
+        for t in heavies:
+            t.start()
+        up_windows = None
+        while time.monotonic() - t_step < step_s:
+            if up_windows is None and tier.count() >= 2:
+                up_windows = (time.monotonic() - t_step) / probe_w
+            time.sleep(0.02)
+        with lock:
+            tail = lats[len(lats) // 2:]   # post-convergence steady state
+            step_p99 = percentile(tail, 0.99) if tail else None
+            lats.clear()
+        # load step down: burst ends, the light client keeps running
+        # THROUGH the drain — zero failures is the acceptance bar
+        stop_heavy.set()
+        for t in heavies:
+            t.join(timeout=30)
+        t_down = time.monotonic()
+        down_windows = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (tier.count() == 1 and tier.draining() == 0
+                    and len(router.membership.candidate_urls()) == 1):
+                down_windows = (time.monotonic() - t_down) / probe_w
+                break
+            time.sleep(0.05)
+        time.sleep(0.5)                 # light traffic over the shrunk fleet
+        stop_light.set()
+        light.join(timeout=30)
+    finally:
+        stop_light.set()
+        stop_heavy.set()
+        scaler.stop()
+        router.stop()
+        pool.stop()
+    n_failed = failures[0]
+
+    # ------------------------------------------------------- hedging A/B
+    n_warm, n_meas = (16, 36) if smoke else (30, 60)
+    hedge_cap = 0.10
+    builds: list = []
+
+    def hedge_factory():
+        eng = DecodeEngine(params, c, max_slots=2)
+        if not builds:   # replica 0 is the intermittently slow one
+            eng = _IntermittentSlowStep(eng, 0.1, every=6)
+        builds.append(eng)
+        return eng
+
+    hpool = ReplicaPool(hedge_factory, n=3).start()
+    prompts = [[int(t) for t in rng.integers(0, 300, 6)]
+               for _ in range(n_warm + n_meas)]
+    hedge_results = {}
+    try:
+        for mode, kwargs in (("off", dict(hedge=False)),
+                             ("on", dict(hedge=True, hedge_quantile=0.9,
+                                         hedge_min_s=0.15,
+                                         hedge_max_fraction=hedge_cap,
+                                         hedge_min_samples=16,
+                                         hedge_poll_s=0.005))):
+            with FleetRouter(hpool.urls, probe_interval=0.15,
+                             join_after=1, **kwargs) as hrouter:
+                deadline = time.monotonic() + 15
+                while hrouter.membership.ring_size() < 3:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("replicas never joined")
+                    time.sleep(0.02)
+                mlats = []
+                for i, p in enumerate(prompts):
+                    t0 = time.perf_counter()
+                    _gen(hrouter.port, p)
+                    if i >= n_warm:   # warm segment arms the window
+                        mlats.append(time.perf_counter() - t0)
+                stats = hrouter.stats()
+                hedge_results[mode] = {
+                    "p99": percentile(mlats, 0.99),
+                    "p50": percentile(mlats, 0.5),
+                    "hedged": stats["hedge"]["requests_hedged"],
+                }
+    finally:
+        hpool.stop()
+    off, on = hedge_results["off"], hedge_results["on"]
+    hedged_fraction = on["hedged"] / len(prompts)
+
+    return {"metric": "autoscaler_scale_up_probe_windows",
+            "value": (round(up_windows, 2) if up_windows is not None
+                      else None),
+            "unit": "probe windows from load step to target replicas",
+            "scale_down_probe_windows": (round(down_windows, 2)
+                                         if down_windows is not None
+                                         else None),
+            "pre_step_p99_s": (round(pre_p99, 4)
+                               if pre_p99 is not None else None),
+            "post_step_steady_p99_s": (round(step_p99, 4)
+                                       if step_p99 is not None
+                                       else None),
+            "steady_p99_vs_pre": (round(step_p99 / pre_p99, 3)
+                                  if pre_p99 and step_p99 is not None
+                                  else None),
+            "failed_requests": n_failed,
+            "hedge_off_p99_s": round(off["p99"], 4),
+            "hedge_on_p99_s": round(on["p99"], 4),
+            "hedge_p99_cut": round(off["p99"] / on["p99"], 3),
+            "hedge_off_p50_s": round(off["p50"], 4),
+            "hedge_on_p50_s": round(on["p50"], 4),
+            "hedged_requests": on["hedged"],
+            "hedged_fraction": round(hedged_fraction, 4),
+            "hedge_cap": hedge_cap,
+            "probe_window_s": probe_w,
+            "config": "1->2 replica autoscale under a 3x closed-loop "
+                      "load step (drain-only scale-down, zero-failure "
+                      "bar), then hedging A/B over 3 replicas with "
+                      "replica 0 intermittently stalled (every 6th "
+                      "submit, 0.1s/step): same prompt sequence, "
+                      "hedge off vs on"}
+
+
 def _disagg_model(max_seq_len: int):
     """The disagg row's tiny-but-real LM, shared by the parent and the
     prefill child process (identical seed => identical weights)."""
@@ -1532,6 +1804,8 @@ if __name__ == "__main__":
         _emit(measure_weight_swap(smoke=smoke))
     if which in ("tenant_qos", "all"):
         _emit(measure_tenant_qos(smoke=smoke))
+    if which in ("autoscaler", "all"):
+        _emit(measure_autoscaler(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

@@ -110,7 +110,9 @@ PAGES = [
      ["TenantQoS", "FairQueue", "QueuedRequest"]),
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Serving fleet API", "elephas_tpu.fleet",
-     ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool"]),
+     ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool",
+      "FleetAutoscaler", "TierPolicy", "ReplicaPoolTier",
+      "DisaggDecodeTier", "DisaggPrefillTier"]),
     ("Disaggregated serving API", "elephas_tpu.disagg",
      ["DisaggEngine", "DisaggPool", "PrefillWorker", "PrefillJob",
       "KVReceiver", "KVShipper", "encode_kv_frame", "decode_kv_frame"]),

@@ -224,6 +224,15 @@ class DisaggEngine:
         self._dispatch(job)
         return rid
 
+    def add_worker(self, worker: PrefillWorker) -> None:
+        """Register a prefill worker added after construction (the
+        autoscaler growing the tier): the next dispatch — including
+        parked jobs retried by the engine loop — considers it like any
+        sibling. Idempotent."""
+        with self._lock:
+            if worker not in self.workers:
+                self.workers.append(worker)
+
     # ------------------------------------------------------------ dispatch
     def _dispatch(self, job: PrefillJob) -> None:
         """Least-backlogged live worker, or park until one returns."""

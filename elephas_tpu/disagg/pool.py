@@ -17,6 +17,12 @@ hides behind the decode tier exactly as the parameter servers do. Chaos
 verbs for the failure tests: ``kill_prefill(i)`` (mid-transfer worker
 death — jobs retry on siblings), ``kill_decode(i)`` / ``drain_decode``
 (the router's eviction/re-route path, as with ``ReplicaPool``).
+
+Both tiers scale at runtime (the fleet autoscaler's verbs):
+``add_prefill()`` / ``add_decode()`` grow a tier, ``drain_prefill(i)``
+retires a prefill worker gracefully (queued jobs re-dispatch to
+siblings), and ``decommission_decode(i)`` drains a decode replica to
+completion before stopping it — scale-down is never a kill.
 """
 from typing import Callable, List, Optional
 
@@ -70,39 +76,61 @@ class DisaggPool:
         self.prefill_workers: List[PrefillWorker] = []
         self.engines: List[DisaggEngine] = []
         self.servers: List[ServingServer] = []
+        self._next_prefill = 0   # monotonic worker naming across scale
+        self._decode_alive: List[bool] = []
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "DisaggPool":
-        for i in range(self._n_prefill):
-            engine = self._prefill_factory()
-            for p in self._prefixes:
-                engine.register_prefix(p)
-            # prefill-tier Prometheus series live on each worker's OWN
-            # (engine) registry — NOT the process default: a decode
-            # server's /metrics concatenates its engine registry with
-            # the default registry, and two registries both defining
-            # the serving_queue_wait_seconds family would emit
-            # duplicate HELP/TYPE blocks (invalid exposition). In
-            # production each prefill-worker process scrapes its own
-            # registry; in-process, the decode servers' /stats carries
-            # the prefill tier's waits (DisaggEngine.stats reads the
-            # workers directly).
-            self.prefill_workers.append(
-                PrefillWorker(engine, quant=self._quant,
-                              block_size=self._block_size,
-                              name=f"prefill-{i}").start())
-        for i in range(self._n_decode):
-            deng = DisaggEngine(self._decode_factory(),
-                                self.prefill_workers,
-                                max_queue=self._max_queue,
-                                host=self._host)
-            srv = ServingServer(deng, host=self._host, port=0,
-                                tokenizer=self._tokenizer,
-                                **self._server_kwargs)
-            srv.start()
-            self.engines.append(deng)
-            self.servers.append(srv)
+        for _ in range(self._n_prefill):
+            self.add_prefill()
+        for _ in range(self._n_decode):
+            self.add_decode()
         return self
+
+    def add_prefill(self) -> PrefillWorker:
+        """Spawn one more prefill worker (the autoscaler's prefill
+        scale-up verb — also what :meth:`start` loops over) and
+        register it with every live decode front end, which starts
+        dispatching to it immediately."""
+        engine = self._prefill_factory()
+        for p in self._prefixes:
+            engine.register_prefix(p)
+        # prefill-tier Prometheus series live on each worker's OWN
+        # (engine) registry — NOT the process default: a decode
+        # server's /metrics concatenates its engine registry with
+        # the default registry, and two registries both defining
+        # the serving_queue_wait_seconds family would emit
+        # duplicate HELP/TYPE blocks (invalid exposition). In
+        # production each prefill-worker process scrapes its own
+        # registry; in-process, the decode servers' /stats carries
+        # the prefill tier's waits (DisaggEngine.stats reads the
+        # workers directly).
+        worker = PrefillWorker(
+            engine, quant=self._quant, block_size=self._block_size,
+            name=f"prefill-{self._next_prefill}").start()
+        self._next_prefill += 1
+        self.prefill_workers.append(worker)
+        for deng in self.engines:
+            deng.add_worker(worker)
+        return worker
+
+    def add_decode(self) -> str:
+        """Spawn one more served decode worker drawing on the CURRENT
+        prefill tier (workers added later propagate via
+        :meth:`~.engine.DisaggEngine.add_worker`). Returns its base
+        URL for :meth:`~elephas_tpu.fleet.FleetRouter.add_replica`."""
+        deng = DisaggEngine(self._decode_factory(),
+                            self.prefill_workers,
+                            max_queue=self._max_queue,
+                            host=self._host)
+        srv = ServingServer(deng, host=self._host, port=0,
+                            tokenizer=self._tokenizer,
+                            **self._server_kwargs)
+        srv.start()
+        self.engines.append(deng)
+        self.servers.append(srv)
+        self._decode_alive.append(True)
+        return f"http://{self._host}:{srv.port}"
 
     def stop(self):
         for srv in self.servers:
@@ -129,9 +157,19 @@ class DisaggPool:
         retry on sibling workers."""
         self.prefill_workers[i].kill()
 
+    def drain_prefill(self, i: int):
+        """Graceful prefill scale-down — the counterpart
+        :meth:`kill_prefill` never was: the worker finishes its
+        CURRENT job, fails its queued jobs back to their dispatchers
+        (which re-dispatch to sibling workers — recompute, never a
+        failed client request), and exits. BLOCKS until the worker's
+        threads joined."""
+        self.prefill_workers[i].stop()
+
     def kill_decode(self, i: int):
         """Abrupt decode-server death — the fleet router's eviction +
         re-route scenario."""
+        self._decode_alive[i] = False
         self.servers[i].stop(drain_timeout=0.0)
         self.engines[i].stop()
 
@@ -140,7 +178,26 @@ class DisaggPool:
         finishes."""
         self.servers[i].begin_drain()
 
+    def decommission_decode(self, i: int, drain_timeout: float = 30.0):
+        """Graceful decode scale-down: drain to completion (bounded by
+        ``drain_timeout``), then stop the server and its engine's KV
+        receiver. BLOCKS for the drain — the autoscaler runs it on a
+        background thread; chaos-kill-safe like
+        :meth:`~elephas_tpu.fleet.ReplicaPool.decommission`."""
+        try:
+            self.servers[i].stop(drain_timeout=float(drain_timeout))
+        except Exception:  # noqa: BLE001 — killed mid-drain: already down
+            pass
+        self.engines[i].stop()
+        self._decode_alive[i] = False
+
     # ------------------------------------------------------------ queries
     @property
     def urls(self) -> List[str]:
         return [f"http://{self._host}:{srv.port}" for srv in self.servers]
+
+    def alive_decode_indexes(self) -> List[int]:
+        """Decode replicas not killed/decommissioned — the autoscaler
+        adapter's capacity count (a chaos-killed server must not keep
+        counting as capacity and block scale-up at the ceiling)."""
+        return [i for i, a in enumerate(self._decode_alive) if a]

@@ -13,15 +13,26 @@ membership, and an in-process replica pool for tests.
 - :class:`~.hashring.HashRing` — the deterministic consistent-hash
   ring (only ~1/N of keys move per membership change).
 - :class:`~.pool.ReplicaPool` — N engine+server replicas in one
-  process, with kill/drain verbs and lazy per-replica prefix
+  process, with kill/drain/scale verbs and lazy per-replica prefix
   registration, for tests and the ``fleet_router`` bench row.
+- :class:`~.autoscaler.FleetAutoscaler` — the demand-driven control
+  loop over it all: reads the per-tier queue-wait/shed/backlog signals
+  off the membership prober, scales decode replicas and prefill
+  workers independently with join/evict-style hysteresis, drains (never
+  kills) on the way down, and emits every decision as a traced
+  ``fleet.scaled_up`` / ``fleet.scaled_down`` event.
 
-``docs/sources/serving-fleet.md`` is the operator guide.
+``docs/sources/serving-fleet.md`` is the operator guide;
+``docs/sources/serving-operations.md`` has the autoscaling runbook.
 """
+from .autoscaler import (DisaggDecodeTier, DisaggPrefillTier,
+                         FleetAutoscaler, ReplicaPoolTier, TierPolicy)
 from .hashring import HashRing
 from .membership import ReplicaMembership, ReplicaState
 from .pool import ReplicaPool
 from .router import FleetRouter
 
 __all__ = ["FleetRouter", "HashRing", "ReplicaMembership",
-           "ReplicaState", "ReplicaPool"]
+           "ReplicaState", "ReplicaPool", "FleetAutoscaler",
+           "TierPolicy", "ReplicaPoolTier", "DisaggDecodeTier",
+           "DisaggPrefillTier"]

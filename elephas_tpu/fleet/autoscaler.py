@@ -1,0 +1,544 @@
+"""Demand-driven fleet autoscaler: the control loop that decides N.
+
+Every layer below this one assumes a HUMAN picked the replica counts:
+the router spreads traffic over whatever exists, disaggregation lets
+prefill and decode scale independently, and the pools expose the verbs
+(``add_replica`` / ``decommission``, ``add_prefill`` / ``add_decode``
+/ ``drain_prefill`` / ``decommission_decode``). This module closes the
+loop the way :class:`~elephas_tpu.weightsync.CanaryController` already
+closes it for weights: a controller thread reads the fleet's OWN
+registries — queue depth, queued tokens, per-tier
+``serving_queue_wait_seconds{tier}`` p99, shed rate, all captured by
+the membership prober's ``/stats`` pass
+(:meth:`~.membership.ReplicaMembership.tier_signals`) — and scales
+each tier toward demand.
+
+Design rules, in order of importance:
+
+- **Scale-down is ALWAYS a graceful drain, never a kill.** A victim's
+  ``/ready`` flips 503 the moment the drain begins, so the router
+  routes new work away while in-flight requests finish; only then is
+  the replica stopped and removed from the candidate set. A chaos kill
+  landing mid-drain degrades to the router's existing dead-replica
+  path (orphaned submits resubmitted to siblings) — either way, zero
+  failed client requests.
+- **Join/evict-style hysteresis** (borrowed from
+  :class:`~.membership.ReplicaMembership`): a tier scales up only
+  after ``up_after`` CONSECUTIVE pressured probe windows and down only
+  after ``down_after`` consecutive idle ones, and any action resets
+  both streaks — a bursty minute cannot flap the fleet, because every
+  membership change moves ~1/N of the key space and cools caches.
+- **Tiers scale independently** — disaggregation's whole point. Each
+  tier's pressure reads ITS OWN queue-wait tail (``tier="decode"`` vs
+  the prefill workers' ``tier="prefill"`` series), so the
+  prefill/decode ratio follows the measured per-tier waits: a
+  prompt-heavy shift grows the prefill tier while decode holds, and
+  vice versa.
+- **Up-pressure is wait/shed-driven, down-pressure is backlog-driven.**
+  The engines' queue-wait windows hold the last N *completed*
+  requests, so after a burst ends the p99 stays high until new fast
+  samples flush it — a stale tail must neither scale an idle fleet up
+  nor block its scale-down. The wait-tail signal therefore only counts
+  alongside live backlog, and idle is judged on live backlog alone
+  (queue depth + in-flight), which an idle fleet actually zeroes.
+- **Every decision is a traced event**: ``fleet.scaled_up`` /
+  ``fleet.scaled_down`` carry the tier, the counts, the reason, and
+  the signal snapshot under a fresh trace id (the canary-rollout
+  convention), so capacity history is queryable from the event log;
+  ``fleet_autoscale_*`` series land on the router registry.
+
+The tier adapters bind the controller to the in-process pools
+(:class:`~.pool.ReplicaPool`, :class:`~elephas_tpu.disagg.DisaggPool`);
+a production deployment implements the same four-method surface
+(``count`` / ``signals`` / ``scale_up`` / ``scale_down``) over its
+orchestrator. ``docs/sources/serving-operations.md`` has the runbook
+(thresholds, hysteresis, the hedge-rate trade-off).
+"""
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..obs.context import new_root, use_context
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry, percentile
+
+__all__ = ["TierPolicy", "FleetAutoscaler", "ReplicaPoolTier",
+           "DisaggDecodeTier", "DisaggPrefillTier"]
+
+
+class TierPolicy:
+    """Scaling thresholds + hysteresis for one tier.
+
+    :param min_replicas, max_replicas: hard bounds on the tier size.
+        The controller never drains below the floor or spawns past the
+        ceiling, whatever the signals say.
+    :param high_wait_s: queue-wait p99 above this is up-pressure — the
+        latency SLO proxy. Match it to the deployment's target (the
+        default suits the CPU test fleets; production decode tiers run
+        tighter).
+    :param high_depth: backlog (queue depth + router in-flight) PER
+        replica above this is up-pressure even before waits degrade.
+    :param low_depth: backlog per replica below this (with zero sheds
+        in the window) is down-pressure. Keep a wide dead band between
+        ``low_depth`` and ``high_depth`` — the band IS the flap guard.
+    :param up_after / down_after: consecutive pressured / idle probe
+        windows before acting. Down should be several times up:
+        adding capacity late costs latency, removing it early costs a
+        re-warm AND latency.
+    :param step: replicas added per scale-up decision (scale-down
+        always drains exactly one — draining is the slow, cautious
+        direction by design).
+    """
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 high_wait_s: float = 0.25, high_depth: float = 4.0,
+                 low_depth: float = 0.5, up_after: int = 2,
+                 down_after: int = 5, step: int = 1):
+        if not 1 <= int(min_replicas) <= int(max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}")
+        if up_after < 1 or down_after < 1:
+            raise ValueError("up_after and down_after must be >= 1")
+        if not float(low_depth) < float(high_depth):
+            raise ValueError("low_depth must be < high_depth (the dead "
+                             "band between them is the flap guard)")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.high_wait_s = float(high_wait_s)
+        self.high_depth = float(high_depth)
+        self.low_depth = float(low_depth)
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.step = max(1, int(step))
+
+
+# --------------------------------------------------------------- adapters
+class _DrainingMixin:
+    """Shared bookkeeping for adapters whose scale-down runs a blocking
+    drain on a background thread: a replica mid-drain must count
+    neither as capacity (it takes no new work) nor as a scale-down
+    candidate (one drain at a time per victim)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._draining: set = set()
+        self._retired: set = set()
+
+    def _begin_drain(self, key) -> bool:
+        with self._lock:
+            if key in self._draining or key in self._retired:
+                return False
+            self._draining.add(key)
+            return True
+
+    def _finish_drain(self, key) -> None:
+        with self._lock:
+            self._draining.discard(key)
+            self._retired.add(key)
+
+    def _excluded(self) -> set:
+        with self._lock:
+            return self._draining | self._retired
+
+    def draining(self) -> int:
+        with self._lock:
+            return len(self._draining)
+
+
+class ReplicaPoolTier(_DrainingMixin):
+    """Decode tier over a :class:`~.pool.ReplicaPool` fronted by a
+    :class:`~.router.FleetRouter`: scale-up spawns a factory replica
+    and registers it with the router (it joins via the normal
+    ``/ready`` probe path); scale-down decommissions the least-loaded
+    replica — graceful drain, then removal from the candidate set.
+    Subclasses rebind the three pool hooks (:meth:`_alive_indexes` /
+    :meth:`_spawn` / :meth:`_decommission`) to other pool APIs."""
+
+    name = "decode"
+
+    def __init__(self, router, pool, policy: Optional[TierPolicy] = None,
+                 drain_timeout: float = 30.0):
+        super().__init__()
+        self.router = router
+        self.pool = pool
+        self.policy = policy if policy is not None else TierPolicy()
+        self.drain_timeout = float(drain_timeout)
+
+    # ------------------------------------------------------ pool hooks
+    def _alive_indexes(self) -> List[int]:
+        return self.pool.alive_indexes()
+
+    def _spawn(self) -> str:
+        return self.pool.add_replica()
+
+    def _decommission(self, i: int) -> None:
+        self.pool.decommission(i, drain_timeout=self.drain_timeout)
+
+    # -------------------------------------------------------- contract
+    def count(self) -> int:
+        excluded = self._excluded()
+        return sum(1 for i in self._alive_indexes()
+                   if i not in excluded)
+
+    def signals(self) -> Dict:
+        sig = dict(self.router.membership.tier_signals()["decode"])
+        sig["depth"] = sig["queue_depth"] + sig["in_flight"]
+        sig["wait_p99_s"] = sig.get("queue_wait_p99_s", 0.0)
+        return sig
+
+    def scale_up(self) -> Optional[str]:
+        url = self._spawn()
+        self.router.add_replica(url)
+        return url
+
+    def scale_down(self) -> Optional[str]:
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        i, url = victim
+        if not self._begin_drain(i):
+            return None
+
+        def drain():
+            try:
+                self._decommission(i)
+                self.router.remove_replica(url)
+            finally:
+                self._finish_drain(i)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"fleet-scaledown-{self.name}-{i}").start()
+        return url
+
+    def _pick_victim(self):
+        """Least-loaded eligible replica (its drain finishes fastest
+        and its cached keyspace is the coolest); highest index breaks
+        ties so repeated scale-downs retire the newest spawns first."""
+        excluded = self._excluded()
+        urls = self.pool.urls
+        best = None
+        for i in self._alive_indexes():
+            if i in excluded or i >= len(urls):
+                continue
+            load = self.router.membership.load(urls[i])
+            if best is None or (load, -i) < (best[0], -best[1]):
+                best = (load, i, urls[i])
+        return None if best is None else (best[1], best[2])
+
+
+class DisaggDecodeTier(ReplicaPoolTier):
+    """Decode tier of a :class:`~elephas_tpu.disagg.DisaggPool`: the
+    :class:`ReplicaPoolTier` contract with the disagg pool's verbs
+    rebound (``add_decode`` / ``decommission_decode`` /
+    ``alive_decode_indexes``)."""
+
+    def _alive_indexes(self) -> List[int]:
+        return self.pool.alive_decode_indexes()
+
+    def _spawn(self) -> str:
+        return self.pool.add_decode()
+
+    def _decommission(self, i: int) -> None:
+        self.pool.decommission_decode(i, drain_timeout=self.drain_timeout)
+
+
+class DisaggPrefillTier(_DrainingMixin):
+    """Prefill tier of a :class:`~elephas_tpu.disagg.DisaggPool`. Reads
+    the workers directly (they are in-process); a production adapter
+    would read the same numbers off the decode replicas' ``/stats``
+    ``prefill_tier`` block (:meth:`~.membership.ReplicaMembership.
+    tier_signals` already aggregates it). Scale-down picks the
+    least-backlogged live worker and drains it — its queued jobs
+    re-dispatch to siblings through the dispatcher's normal retry
+    path."""
+
+    name = "prefill"
+
+    def __init__(self, pool, policy: Optional[TierPolicy] = None):
+        super().__init__()
+        self.pool = pool
+        self.policy = policy if policy is not None else TierPolicy()
+
+    def _live(self) -> List[int]:
+        return [i for i, w in enumerate(self.pool.prefill_workers)
+                if w.alive and i not in self._excluded()]
+
+    def count(self) -> int:
+        return len(self._live())
+
+    def signals(self) -> Dict:
+        live = [self.pool.prefill_workers[i] for i in self._live()]
+        stats = [w.stats() for w in live]   # the workers' public read
+        depth = sum(s["backlog"] for s in stats)
+        waits: List[float] = []
+        for w in live:
+            waits.extend(w.wait_samples()[-128:])
+        sig: Dict = {"replicas": len(live), "depth": float(depth),
+                     "queue_depth": depth, "in_flight": 0,
+                     "queued_tokens": 0, "requests_shed": 0,
+                     "requests_finished": sum(s["prefills"]
+                                              for s in stats)}
+        sig["wait_p99_s"] = (percentile(waits, 0.99) if waits else 0.0)
+        return sig
+
+    def scale_up(self) -> Optional[str]:
+        return self.pool.add_prefill().name
+
+    def scale_down(self) -> Optional[str]:
+        live = self._live()
+        if not live:
+            return None
+        i = min(live, key=lambda j:
+                (self.pool.prefill_workers[j].backlog(), -j))
+        if not self._begin_drain(i):
+            return None
+        worker = self.pool.prefill_workers[i]
+
+        def drain():
+            try:
+                self.pool.drain_prefill(i)
+            finally:
+                self._finish_drain(i)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"fleet-scaledown-{worker.name}").start()
+        return worker.name
+
+
+# -------------------------------------------------------------- controller
+class _TierState:
+    __slots__ = ("tier", "up_streak", "down_streak", "last_shed",
+                 "last_ready", "last_signals", "last_action",
+                 "last_action_at")
+
+    def __init__(self, tier):
+        self.tier = tier
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_shed: Optional[int] = None
+        self.last_ready: Optional[tuple] = None
+        self.last_signals: Dict = {}
+        self.last_action: Optional[str] = None
+        self.last_action_at: Optional[float] = None
+
+
+class FleetAutoscaler:
+    """Scale each tier toward demand with drain-only scale-down and
+    join/evict-style hysteresis.
+
+    :param tiers: tier adapters (:class:`ReplicaPoolTier`,
+        :class:`DisaggDecodeTier`, :class:`DisaggPrefillTier`, or
+        anything with ``name`` / ``policy`` / ``count()`` /
+        ``signals()`` / ``scale_up()`` / ``scale_down()``). Tier names
+        must be unique — they label the metrics and events.
+    :param probe_interval: seconds between decision windows. Every
+        hysteresis count is in units of THIS window; keep it a small
+        multiple of the router's membership probe interval, which
+        refreshes the signals the decisions read.
+    :param registry: destination for the ``fleet_autoscale_*`` series
+        (defaults to the first tier's router registry — the issue of
+        record for fleet metrics — or a fresh registry without one).
+    """
+
+    def __init__(self, tiers: Sequence, probe_interval: float = 1.0,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.tiers = list(tiers)
+        if not self.tiers:
+            raise ValueError("need at least one tier adapter")
+        names = [t.name for t in self.tiers]
+        if len(set(names)) != len(names):
+            raise ValueError(f"tier names must be unique, got {names}")
+        self.probe_interval = float(probe_interval)
+        self._clock = clock
+        if registry is None:
+            for t in self.tiers:
+                router = getattr(t, "router", None)
+                if router is not None:
+                    registry = router.registry
+                    break
+        self.registry = reg = (registry if registry is not None
+                               else MetricsRegistry())
+        self._m_up = reg.counter(
+            "fleet_autoscale_up_total",
+            "scale-up decisions, by tier", labels=("tier",))
+        self._m_down = reg.counter(
+            "fleet_autoscale_down_total",
+            "graceful scale-down decisions, by tier", labels=("tier",))
+        self._m_errors = reg.counter(
+            "fleet_autoscale_errors_total",
+            "decision windows that raised (adapter or scale failure) "
+            "— also fleet.autoscale_error events; a climbing rate "
+            "means the controller is flying blind").labels()
+        gauge = reg.gauge(
+            "fleet_autoscale_replicas",
+            "replicas the autoscaler currently counts, by tier "
+            "(mid-drain replicas excluded)", labels=("tier",))
+        for t in self.tiers:
+            gauge.labels(tier=t.name).set_function(
+                lambda t=t: float(t.count()))
+        self._states = {t.name: _TierState(t) for t in self.tiers}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _loop(self):
+        while not self._stop.wait(self.probe_interval):
+            try:
+                self.poll_once()
+            except Exception as exc:  # noqa: BLE001 — a dying
+                # replica's junk /stats (or a failing pool factory)
+                # must not kill the controller, but it must not be
+                # INVISIBLE either: a persistently failing scale-up
+                # with no trace is a fleet that silently stops scaling
+                self._m_errors.inc()
+                emit_event("fleet.autoscale_error",
+                           error=f"{type(exc).__name__}: {exc}")
+
+    # ------------------------------------------------------------ decision
+    def poll_once(self) -> Dict[str, Optional[str]]:
+        """One decision window over every tier. Returns
+        ``{tier: "up"|"down"|None}`` (handy for tests driving the
+        controller synchronously with the thread off)."""
+        return {t.name: self._decide(self._states[t.name])
+                for t in self.tiers}
+
+    def _decide(self, st: _TierState) -> Optional[str]:
+        tier, policy = st.tier, st.tier.policy
+        sig = tier.signals()
+        live = tier.count()
+        count = max(1, live)
+        shed_total = int(sig.get("requests_shed", 0))
+        shed_delta = (0 if st.last_shed is None
+                      else max(0, shed_total - st.last_shed))
+        st.last_shed = shed_total
+        # the cumulative-shed delta is only meaningful over a STABLE
+        # ready set: an evicted replica leaving drops its history from
+        # the sum and its rejoin re-adds it — a whole-history fake
+        # spike that must not read as fresh overload
+        ready = sig.get("ready_urls")
+        if ready is not None:
+            ready = tuple(ready)
+            if st.last_ready is not None and ready != st.last_ready:
+                shed_delta = 0
+            st.last_ready = ready
+        # a tier BELOW its floor (replica crash, chaos kill) restores
+        # immediately — hysteresis exists to stop demand-driven
+        # flapping, and the floor is a hard bound, not a demand signal
+        if live < policy.min_replicas and tier.draining() == 0:
+            return self._act(st, "up", ["below_floor"], sig)
+        depth_per = float(sig.get("depth", 0.0)) / count
+        wait_p99 = float(sig.get("wait_p99_s", 0.0))
+        # up-pressure: the tier is visibly behind (tail wait over the
+        # SLO proxy, per-replica backlog, or it SHED — the one signal
+        # that means a client already felt it). The wait tail only
+        # counts alongside LIVE backlog: the engines' wait windows hold
+        # completed requests, so after a burst ends the p99 stays high
+        # until new samples flush it — on its own it would hold
+        # up-pressure (and block every scale-down) on an idle fleet.
+        reasons = []
+        if shed_delta > 0:
+            reasons.append("shed")
+        if wait_p99 > policy.high_wait_s and depth_per > policy.low_depth:
+            reasons.append("queue_wait_p99")
+        if depth_per > policy.high_depth:
+            reasons.append("queue_depth")
+        # down-pressure reads live backlog only (completed-request wait
+        # windows go stale on an idle fleet — module docstring)
+        idle = shed_delta == 0 and depth_per < policy.low_depth
+        st.last_signals = dict(sig, shed_delta=shed_delta,
+                               depth_per_replica=round(depth_per, 3))
+        if reasons:
+            st.up_streak += 1
+            st.down_streak = 0
+        elif idle:
+            st.down_streak += 1
+            st.up_streak = 0
+        else:
+            st.up_streak = st.down_streak = 0   # dead band: hold
+        if (st.up_streak >= policy.up_after
+                and tier.count() < policy.max_replicas):
+            return self._act(st, "up", reasons, sig)
+        if (st.down_streak >= policy.down_after
+                and tier.count() > policy.min_replicas
+                and tier.draining() == 0):   # one drain at a time
+            return self._act(st, "down", ["idle"], sig)
+        return None
+
+    def _act(self, st: _TierState, direction: str, reasons: List[str],
+             sig: Dict) -> Optional[str]:
+        """Execute one scaling decision under a fresh trace context so
+        the event log joins the whole story — the decision here, the
+        membership join/evict it causes — on one queryable id."""
+        tier, policy = st.tier, st.tier.policy
+        with use_context(new_root()):
+            before = tier.count()
+            moved: List[str] = []
+            if direction == "up":
+                room = policy.max_replicas - before
+                for _ in range(min(policy.step, room)):
+                    target = tier.scale_up()
+                    if target is None:
+                        break
+                    moved.append(str(target))
+                event, metric = "fleet.scaled_up", self._m_up
+            else:
+                target = tier.scale_down()
+                if target is not None:
+                    moved.append(str(target))
+                event, metric = "fleet.scaled_down", self._m_down
+            if not moved:
+                return None
+            st.up_streak = st.down_streak = 0
+            st.last_action = direction
+            st.last_action_at = self._clock()
+            metric.labels(tier=tier.name).inc(len(moved))
+            emit_event(event, tier=tier.name, reason=",".join(reasons),
+                       replicas_before=before,
+                       replicas_after=tier.count(),
+                       targets=moved, mode=("drain" if direction == "down"
+                                            else "spawn"),
+                       queue_depth=sig.get("queue_depth"),
+                       queued_tokens=sig.get("queued_tokens"),
+                       queue_wait_p99_s=sig.get("wait_p99_s"),
+                       shed_delta=st.last_signals.get("shed_delta"))
+            return direction
+
+    # -------------------------------------------------------------- status
+    def status(self) -> Dict:
+        """Operator snapshot: per tier, the live count, streaks, policy
+        bounds, and the last decision — the autoscaling half of "is
+        the fleet keeping up"."""
+        out: Dict = {}
+        for name, st in self._states.items():
+            p = st.tier.policy
+            out[name] = {
+                "replicas": st.tier.count(),
+                "draining": st.tier.draining(),
+                "min_replicas": p.min_replicas,
+                "max_replicas": p.max_replicas,
+                "up_streak": st.up_streak,
+                "down_streak": st.down_streak,
+                "last_action": st.last_action,
+                "signals": dict(st.last_signals),
+            }
+        return out

@@ -29,6 +29,17 @@ the ``fleet_replicas_{joined,evicted}_total`` counters, and emit
 ``fleet.replica_joined`` / ``fleet.replica_evicted`` events on the
 process event log (trace-stamped when a request's context triggered
 the eviction via :meth:`ReplicaMembership.mark_down`).
+
+The candidate set is DYNAMIC since the fleet autoscaler landed:
+:meth:`ReplicaMembership.add_candidate` registers a freshly spawned
+replica (it joins the ring through the normal ``/ready`` probe
+hysteresis — a scale-up is indistinguishable from a replica recovering)
+and :meth:`ReplicaMembership.remove_candidate` retires a decommissioned
+one. The probe pass also captures each ready replica's per-tier
+queue-wait percentiles and shed/finished totals off the same ``/stats``
+read, aggregated by :meth:`ReplicaMembership.tier_signals` — the one
+fleet-keeping-up summary both the router's ``/stats`` and the
+autoscaler's control loop read.
 """
 import json
 import threading
@@ -59,6 +70,17 @@ class ReplicaState:
         self.queued_tokens = 0
         self.in_flight = 0          # this router's outstanding proxies
         self.last_probe_at: Optional[float] = None
+        # per-tier health off the same /stats read: the engine's own
+        # queue-wait percentiles and cumulative shed/finished totals
+        # (the autoscaler's demand signal, and the /stats aggregation
+        # operators scrape). A disaggregated decode replica also
+        # reports its SHARED prefill tier (every decode server sees
+        # the same workers, so tier_signals() takes the max, not sum).
+        self.queue_wait_p50_s: Optional[float] = None
+        self.queue_wait_p99_s: Optional[float] = None
+        self.requests_shed = 0
+        self.requests_finished = 0
+        self.prefill: Optional[Dict] = None   # the prefill_tier block
 
     @property
     def load(self) -> float:
@@ -67,10 +89,17 @@ class ReplicaState:
         return float(self.queue_depth + self.in_flight)
 
     def snapshot(self) -> Dict:
-        return {"ready": self.ready, "reachable": self.reachable,
-                "queue_depth": self.queue_depth,
-                "queued_tokens": self.queued_tokens,
-                "in_flight": self.in_flight}
+        out = {"ready": self.ready, "reachable": self.reachable,
+               "queue_depth": self.queue_depth,
+               "queued_tokens": self.queued_tokens,
+               "in_flight": self.in_flight,
+               "load": self.load,
+               "requests_shed": self.requests_shed,
+               "requests_finished": self.requests_finished}
+        if self.queue_wait_p99_s is not None:
+            out["queue_wait_p50_s"] = self.queue_wait_p50_s
+            out["queue_wait_p99_s"] = self.queue_wait_p99_s
+        return out
 
 
 class ReplicaMembership:
@@ -121,10 +150,13 @@ class ReplicaMembership:
         self._thread: Optional[threading.Thread] = None
         # probes run CONCURRENTLY: one wedged replica costs a pass one
         # probe_timeout, not len(urls) of them — the evict-within-the-
-        # probe-window guarantee must not degrade with fleet size
+        # probe-window guarantee must not degrade with fleet size.
+        # Sized for the cap (not the construction-time URL count): the
+        # autoscaler grows the candidate set at runtime, and a pool
+        # sized for the 1-replica seed would serialize a 16-replica
+        # fleet's probes
         self._probe_pool = ThreadPoolExecutor(
-            max_workers=min(len(self._urls), 16),
-            thread_name_prefix="fleet-probe")
+            max_workers=16, thread_name_prefix="fleet-probe")
         reg = registry if registry is not None else MetricsRegistry()
         self._m_joined = reg.counter(
             "fleet_replicas_joined_total",
@@ -190,15 +222,18 @@ class ReplicaMembership:
     def probe_once(self):
         """One full pass: probe every candidate (concurrently), apply
         hysteresis, fire join/evict callbacks (outside the lock)."""
-        outcomes = dict(zip(self._urls,
-                            self._probe_pool.map(self._probe_one,
-                                                 self._urls)))
+        with self._lock:
+            urls = list(self._urls)   # the autoscaler mutates the set
+        outcomes = dict(zip(urls,
+                            self._probe_pool.map(self._probe_one, urls)))
         joined: List[str] = []
         evicted: List[Tuple[str, str]] = []
         now = time.monotonic()
         with self._lock:
             for url, (reachable, ready, stats) in outcomes.items():
-                st = self._replicas[url]
+                st = self._replicas.get(url)
+                if st is None:
+                    continue    # removed while this pass was probing it
                 st.reachable = reachable
                 st.last_probe_at = now
                 if ready:
@@ -208,6 +243,7 @@ class ReplicaMembership:
                         st.queue_depth = int(stats.get("queue_depth", 0))
                         st.queued_tokens = int(
                             stats.get("queued_tokens", 0))
+                        self._capture_health_locked(st, stats)
                     if (not st.ready
                             and st.consec_ok >= self.join_after):
                         st.ready = True
@@ -226,21 +262,78 @@ class ReplicaMembership:
         for url, reason in evicted:
             self._evicted(url, reason)
 
-    def mark_down(self, url: str, reason: str = "dead"):
+    @staticmethod
+    def _capture_health_locked(st: ReplicaState, stats: Dict) -> None:
+        """Stash the autoscaler-relevant slice of a ready replica's
+        /stats payload (best-effort: engines without a latency window
+        yet simply leave the percentile fields None)."""
+        try:
+            if stats.get("queue_wait_p99_s") is not None:
+                st.queue_wait_p50_s = float(
+                    stats.get("queue_wait_p50_s", 0.0))
+                st.queue_wait_p99_s = float(stats["queue_wait_p99_s"])
+            st.requests_shed = int(stats.get("requests_shed", 0))
+            st.requests_finished = int(stats.get("requests_finished", 0))
+            prefill = stats.get("prefill_tier")
+            st.prefill = dict(prefill) if isinstance(prefill, dict) \
+                else None
+        except (TypeError, ValueError):
+            pass   # a malformed /stats field must not kill the prober
+
+    # ---------------------------------------------------- candidate set
+    def add_candidate(self, url: str) -> None:
+        """Register a new replica URL (the autoscaler's scale-up hook).
+        The replica joins the ring through the NORMAL probe path —
+        ``join_after`` consecutive ready probes — so a scale-up replica
+        takes traffic exactly when a recovering replica would."""
+        url = str(url).rstrip("/")
+        with self._lock:
+            if url in self._replicas:
+                return
+            self._urls.append(url)
+            self._replicas[url] = ReplicaState(url)
+
+    def remove_candidate(self, url: str) -> None:
+        """Forget a replica URL (the autoscaler's decommission hook —
+        call AFTER the graceful drain finished; removing a ready
+        replica evicts it immediately with reason ``"removed"``, which
+        deliberately does NOT trigger the dead-replica resubmission
+        path: a drained replica finished its work)."""
+        url = str(url).rstrip("/")
+        evict = False
+        with self._lock:
+            st = self._replicas.pop(url, None)
+            if st is None:
+                return
+            self._urls.remove(url)
+            if st.ready:
+                self.ring.remove(url)
+                evict = True
+        if evict:
+            self._evicted(url, "removed")
+
+    def candidate_urls(self) -> List[str]:
+        with self._lock:
+            return list(self._urls)
+
+    def mark_down(self, url: str, reason: str = "dead") -> bool:
         """Immediate eviction on direct evidence — a proxied request
         could not connect. The prober re-joins the replica if it comes
-        back (``join_after`` successes)."""
+        back (``join_after`` successes). Returns whether this call
+        evicted (and therefore fired the eviction callback); False for
+        an unknown or already-evicted replica."""
         url = str(url).rstrip("/")
         with self._lock:
             st = self._replicas.get(url)
             if st is None or not st.ready:
-                return
+                return False
             st.ready = False
             st.reachable = reason != "dead"
             st.consec_ok = 0
             st.consec_fail = max(st.consec_fail, self.evict_after)
             self.ring.remove(url)
         self._evicted(url, reason)
+        return True
 
     def _joined(self, url: str):
         self._m_joined.inc()
@@ -314,3 +407,72 @@ class ReplicaMembership:
         """Per-replica state for the router's /stats."""
         with self._lock:
             return {u: self._replicas[u].snapshot() for u in self._urls}
+
+    def tier_signals(self) -> Dict[str, Dict]:
+        """Aggregate fleet health by serving tier, from the last probe
+        pass — the one read that answers "is the fleet keeping up", and
+        exactly what the autoscaler's control loop consumes.
+
+        ``decode``: summed backlog (``queue_depth`` / ``queued_tokens``
+        / this router's ``in_flight``) and cumulative shed/finished
+        totals over the READY replicas, with the worst (max) per-replica
+        queue-wait p50/p99 — a fleet is as slow as its slowest member,
+        and averaging would hide exactly the replica that needs help.
+        ``shed_rate`` is cumulative ``shed / (shed + finished)``;
+        windowed rates are the consumer's derivative to take.
+
+        ``prefill`` (disaggregated fleets only): the shared prefill
+        tier as the decode replicas report it. ``stage_depth`` /
+        ``parked`` are per-dispatcher counts (each decode front end
+        stages its own requests) and SUM; ``workers_alive`` and the
+        worker queue-wait percentiles describe the same shared workers
+        from every reporter and take the max — summing them would count
+        one tier once per decode replica.
+        """
+        with self._lock:
+            ready = [self._replicas[u] for u in self._urls
+                     if self._replicas[u].ready]
+            decode: Dict = {
+                "replicas": len(ready),
+                "queue_depth": sum(s.queue_depth for s in ready),
+                "queued_tokens": sum(s.queued_tokens for s in ready),
+                "in_flight": sum(s.in_flight for s in ready),
+                "requests_shed": sum(s.requests_shed for s in ready),
+                "requests_finished": sum(s.requests_finished
+                                         for s in ready),
+            }
+            waits50 = [s.queue_wait_p50_s for s in ready
+                       if s.queue_wait_p50_s is not None]
+            waits99 = [s.queue_wait_p99_s for s in ready
+                       if s.queue_wait_p99_s is not None]
+            if waits99:
+                decode["queue_wait_p50_s"] = max(waits50) if waits50 \
+                    else 0.0
+                decode["queue_wait_p99_s"] = max(waits99)
+            total = decode["requests_shed"] + decode["requests_finished"]
+            decode["shed_rate"] = (decode["requests_shed"] / total
+                                   if total else 0.0)
+            # the set the sums ran over: consumers taking DELTAS of the
+            # cumulative counters must discard a window whose ready set
+            # changed (an evict-then-rejoin re-adds a replica's whole
+            # history as one fake spike)
+            decode["ready_urls"] = sorted(s.url for s in ready)
+            out = {"decode": decode}
+            reports = [s.prefill for s in ready if s.prefill]
+        if reports:
+            prefill: Dict = {
+                "workers_alive": max(int(r.get("workers_alive", 0))
+                                     for r in reports),
+                "stage_depth": sum(int(r.get("stage_depth", 0))
+                                   for r in reports),
+                "parked": sum(int(r.get("parked", 0)) for r in reports),
+            }
+            p50 = [r["queue_wait_p50_s"] for r in reports
+                   if r.get("queue_wait_p50_s") is not None]
+            p99 = [r["queue_wait_p99_s"] for r in reports
+                   if r.get("queue_wait_p99_s") is not None]
+            if p99:
+                prefill["queue_wait_p50_s"] = max(p50) if p50 else 0.0
+                prefill["queue_wait_p99_s"] = max(p99)
+            out["prefill"] = prefill
+        return out

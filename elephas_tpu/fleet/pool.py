@@ -8,7 +8,10 @@ each behind its own ``ServingServer`` on a free port, and exposes the
 lifecycle verbs the router's failure-handling tests exercise:
 ``kill(i)`` (abrupt stop — connections start failing, the membership
 prober evicts), ``drain(i)`` (graceful — ``/ready`` flips 503, siblings
-absorb new traffic while in-flight work finishes).
+absorb new traffic while in-flight work finishes), plus the
+autoscaler's scale verbs: ``add_replica()`` (a fresh factory replica;
+returns its URL for the router) and ``decommission(i)`` (drain to
+completion, then stop — scale-down is never a kill).
 
 ``auto_prefix_tokens`` turns on the engine's AUTOMATIC content-
 addressed prefix cache per replica
@@ -112,18 +115,28 @@ class ReplicaPool:
     # ----------------------------------------------------------- lifecycle
     def start(self):
         for _ in range(self._n):
-            engine = self._factory()
-            if self._auto_prefix_tokens is not None:
-                engine = _AutoPrefixEngine(
-                    engine, self._auto_prefix_tokens,
-                    capacity=self._auto_prefix_capacity)
-            srv = ServingServer(engine, host=self._host, port=0,
-                                tokenizer=self._tokenizer,
-                                **self._server_kwargs)
-            srv.start()
+            self.add_replica()
+        return self
+
+    def add_replica(self) -> str:
+        """Spawn one more replica from the factory (the autoscaler's
+        scale-up verb — also what :meth:`start` loops over). Returns
+        the new replica's base URL; hand it to
+        :meth:`~elephas_tpu.fleet.FleetRouter.add_replica` and it joins
+        the ring via the normal ``/ready`` probe path."""
+        engine = self._factory()
+        if self._auto_prefix_tokens is not None:
+            engine = _AutoPrefixEngine(
+                engine, self._auto_prefix_tokens,
+                capacity=self._auto_prefix_capacity)
+        srv = ServingServer(engine, host=self._host, port=0,
+                            tokenizer=self._tokenizer,
+                            **self._server_kwargs)
+        srv.start()
+        with self._lock:
             self.servers.append(srv)
             self._alive.append(True)
-        return self
+        return f"http://{self._host}:{srv.port}"
 
     def stop(self):
         with self._lock:
@@ -156,6 +169,24 @@ class ReplicaPool:
         ``servers[i].stop(...)`` later for the actual shutdown."""
         self.servers[i].begin_drain()
 
+    def decommission(self, i: int, drain_timeout: float = 30.0):
+        """Graceful scale-down of one replica: drain (``/ready`` flips
+        503 immediately, so the router's prober routes new work away),
+        let in-flight requests finish up to ``drain_timeout``, then
+        stop. BLOCKS for the drain — the autoscaler runs it on a
+        background thread. Safe against a chaos ``kill(i)`` landing
+        mid-drain (the second stop is a no-op on dead threads)."""
+        with self._lock:
+            if not (0 <= i < len(self._alive)) or not self._alive[i]:
+                return
+        srv = self.servers[i]
+        try:
+            srv.stop(drain_timeout=float(drain_timeout))
+        except Exception:  # noqa: BLE001 — a replica killed mid-drain
+            pass           # is already down; nothing left to stop
+        with self._lock:
+            self._alive[i] = False
+
     # ------------------------------------------------------------ queries
     @property
     def urls(self) -> List[str]:
@@ -168,3 +199,7 @@ class ReplicaPool:
     def alive(self, i: int) -> bool:
         with self._lock:
             return self._alive[i]
+
+    def alive_indexes(self) -> List[int]:
+        with self._lock:
+            return [i for i, a in enumerate(self._alive) if a]

@@ -51,6 +51,27 @@ resubmission, so the replica engines' per-tenant fair queueing,
 quotas, and preemption see the same tenant the client named at the
 edge.
 
+Hedged tail retries (``hedge=True``, the default): a blocking
+``/v1/generate`` runs as submit+poll against its policy-chosen replica,
+and when it is still unfinished past the ROLLING tail threshold —
+``max(percentile(recent latencies, hedge_quantile), hedge_min_s)`` —
+the request is duplicated to a second ready replica. First answer
+wins; the loser is cancelled through the replicas' existing
+``/v1/cancel`` path (its one-shot result is consumed if the cancel
+lost the race), so no slot keeps decoding for nobody and no result
+entry leaks. A hedge-rate cap (``hedge_max_fraction``, default 10% of
+recent generates) bounds the duplicate traffic: under a fleet-wide
+overload EVERY request crosses the threshold, and uncapped hedging
+would double exactly the load that caused the slowness. Hedges are
+counted (``fleet_hedged_requests_total``,
+``fleet_hedge_wins_total{arm}``) and emitted as
+``fleet.request_hedged`` events under the request's trace id.
+
+The candidate replica set is dynamic: :meth:`FleetRouter.add_replica`
+/ :meth:`FleetRouter.remove_replica` are the fleet autoscaler's hooks
+(``fleet/autoscaler.py``); a new replica joins through the normal
+``/ready`` probe hysteresis.
+
 Tracing: the inbound ``traceparent`` (or a fresh root) is installed for
 the handler and FORWARDED on every proxied request, so one trace id
 spans router -> replica -> parameter server; every router response
@@ -69,12 +90,13 @@ the router keeps the mapping).
 runbook.
 """
 import json
+import queue
 import re
 import threading
 import time
 import urllib.error
 import urllib.request
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -82,7 +104,7 @@ from urllib.parse import parse_qs, urlparse
 from ..obs.context import (current_context, new_root, parse_traceparent,
                            use_context)
 from ..obs.events import emit as emit_event
-from ..obs.metrics import (MetricsRegistry, counter_baseline,
+from ..obs.metrics import (MetricsRegistry, counter_baseline, percentile,
                            since_baseline)
 from ..serving_http import QuietThreadingHTTPServer, retry_after_header
 from .membership import ReplicaMembership
@@ -154,6 +176,26 @@ class FleetRouter:
     :param max_tracked: submitted-but-unfetched request mappings kept
         before the oldest are evicted (abandoned submits must not leak
         router memory).
+    :param hedge: duplicate a blocking generate stuck past the rolling
+        tail threshold to a second replica (first answer wins, loser
+        cancelled). Streaming generates never hedge — their first
+        token may already be on the client's wire.
+    :param hedge_quantile: the rolling-latency quantile that arms a
+        hedge. Must sit ABOVE the healthy fraction of traffic: with a
+        whole replica slow, 1/N of completions are slow and a quantile
+        above ``1 - 1/N`` learns the *slow* latency as "normal" —
+        hedges would fire only after waiting it out, winning nothing.
+    :param hedge_min_s: floor under the threshold so micro-benchmark
+        fast traffic (sub-ms percentiles) cannot arm hedges on noise.
+    :param hedge_max_fraction: cap on hedged duplicates as a fraction
+        of recent generates — the overload-amplification guard.
+    :param hedge_min_samples: completed generates required in the
+        rolling window before any hedge arms (percentiles over fewer
+        samples are noise).
+    :param hedge_poll_s: initial result-poll cadence of the hedged
+        path; each arm backs its polls off 1.25x per round toward a
+        50 ms ceiling, so a long generate does not hold a fast poll
+        loop for its whole life.
     :param registry: metrics registry for the ``fleet_*`` series
         (fresh per-router by default, the engines' convention).
     """
@@ -165,7 +207,12 @@ class FleetRouter:
                  probe_interval: float = 1.0, join_after: int = 1,
                  evict_after: int = 2, probe_timeout: float = 1.0,
                  proxy_timeout: float = 120.0, max_tracked: int = 4096,
-                 vnodes: int = 64,
+                 vnodes: int = 64, hedge: bool = True,
+                 hedge_quantile: float = 0.95,
+                 hedge_min_s: float = 0.05,
+                 hedge_max_fraction: float = 0.10,
+                 hedge_min_samples: int = 20,
+                 hedge_poll_s: float = 0.01,
                  registry: Optional[MetricsRegistry] = None):
         if policy not in ("prefix_hash", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
@@ -202,10 +249,37 @@ class FleetRouter:
             "fleet_http_request_duration_seconds",
             "router-side request wall time by route and status",
             labels=("route", "status"))
+        # hedged tail retries
+        self.hedge = bool(hedge)
+        if not 0.0 < float(hedge_quantile) < 1.0:
+            raise ValueError("hedge_quantile must be in (0, 1), got "
+                             f"{hedge_quantile}")
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_max_fraction = float(hedge_max_fraction)
+        self.hedge_min_samples = max(1, int(hedge_min_samples))
+        self.hedge_poll_s = float(hedge_poll_s)
+        self._m_hedged = reg.counter(
+            "fleet_hedged_requests_total",
+            "generates duplicated to a second replica after crossing "
+            "the rolling tail-latency threshold").labels()
+        self._m_hedge_wins = reg.counter(
+            "fleet_hedge_wins_total",
+            "hedged generates by which arm answered first",
+            labels=("arm",))
+        # rolling (latency_s, was_hedged) window of completed blocking
+        # generates: the threshold AND the hedge-rate cap read it. The
+        # in-flight hedge count rides the same lock — the cap must see
+        # hedges LAUNCHED, not just completed, or a fleet-wide stall
+        # (30 requests stuck at once, none finished) would approve
+        # every one of them before the first completion lands
+        self._hedge_lock = threading.Lock()
+        self._hedge_window: deque = deque(maxlen=512)
+        self._hedges_in_flight = 0
         # per-router baselines (the ServingServer convention): /stats
         # reports THIS router's deltas even over an injected registry
         self._stat_base = counter_baseline(
-            self._m_spilled, self._m_rerouted,
+            self._m_spilled, self._m_rerouted, self._m_hedged,
             self.membership._m_joined, self.membership._m_evicted)
         # fleet rid -> {"url", "rid", "body", "orphan"}; insertion-
         # ordered so abandoned submits evict oldest-first
@@ -251,6 +325,29 @@ class FleetRouter:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # ---------------------------------------------------------- fleet size
+    def add_replica(self, url: str) -> None:
+        """Register a freshly spawned replica (the autoscaler's
+        scale-up hook). It starts taking traffic once the membership
+        prober has seen it ready ``join_after`` times — the same path
+        a recovering replica takes."""
+        url = str(url).rstrip("/")
+        self.membership.add_candidate(url)
+        if url not in self._urls:
+            self._urls.append(url)
+
+    def remove_replica(self, url: str) -> None:
+        """Forget a decommissioned replica (call after its graceful
+        drain finished — an abrupt removal of a replica still holding
+        work is what :meth:`~.membership.ReplicaMembership.mark_down`
+        is for, not this)."""
+        url = str(url).rstrip("/")
+        self.membership.remove_candidate(url)
+        try:
+            self._urls.remove(url)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------- routing
     def _route_key(self, body: Dict) -> bytes:
@@ -331,6 +428,20 @@ class FleetRouter:
                                     timeout=self.proxy_timeout) as resp:
             return json.loads(resp.read())
 
+    def _replica_dead(self, url: str) -> None:
+        """Direct evidence a replica is GONE (a proxied call could not
+        connect): evict it and orphan its tracked submits for
+        re-homing. ``mark_down`` alone is not enough — for a replica
+        already evicted as ``unready`` (draining) it is a no-op, so a
+        chaos kill landing MID-DRAIN would otherwise leave the dead
+        replica's submitted-but-unfinished requests pending forever
+        (the eviction-time orphan sweep only fires on a ready->dead
+        transition). When ``mark_down`` itself evicted, its callback
+        already ran the sweep — run it here only for the
+        already-evicted case, not twice."""
+        if not self.membership.mark_down(url, "dead"):
+            self._on_evict(url, "dead")
+
     def _replica_alive(self, url: str) -> bool:
         """Quick readiness recheck after a replica-side error: decides
         retry-on-sibling (it died / is draining) vs forward-the-error
@@ -343,7 +454,7 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 — refused, 503, wedged: not ok
             return False
 
-    def _foreach_candidate(self, body: Dict, attempt):
+    def _foreach_candidate(self, body: Dict, attempt, exclude=()):
         """The fleet's one retry/error-classification loop, shared by
         blocking dispatch and stream opening (their failure semantics
         must never diverge). ``attempt(url, how)`` performs one try
@@ -362,8 +473,8 @@ class FleetRouter:
         - connect/reset/timeout: evict and retry.
         """
         key = self._route_key(body)
-        tried: set = set()
-        retry_hints: List[int] = []
+        tried: set = set(exclude)   # a hedge must not double up on the
+        retry_hints: List[int] = []  # arm it exists to outrun
         for _ in range(len(self._urls) + 1):
             pick = self._pick(key, tried)
             if pick is None:
@@ -382,7 +493,7 @@ class FleetRouter:
                     tried.add(url)
                     continue
                 if not self._replica_alive(url):
-                    self.membership.mark_down(url, "dead")
+                    self._replica_dead(url)
                     self._m_rerouted.inc()
                     tried.add(url)
                     continue
@@ -390,7 +501,7 @@ class FleetRouter:
             except _HTTPError:
                 raise
             except Exception:  # noqa: BLE001 — refused/reset/timeout
-                self.membership.mark_down(url, "dead")
+                self._replica_dead(url)
                 self._m_rerouted.inc()
                 tried.add(url)
                 continue
@@ -406,7 +517,8 @@ class FleetRouter:
             "error": "no ready replicas in the fleet",
             "replicas_ready": 0})
 
-    def _dispatch(self, path: str, body: Dict) -> Tuple[str, Dict]:
+    def _dispatch(self, path: str, body: Dict,
+                  exclude=()) -> Tuple[str, Dict]:
         """POST ``body`` to a policy-chosen replica, retrying across the
         pool on replica failure/saturation. Returns ``(url, payload)``
         of the successful response; raises :class:`_HTTPError` with the
@@ -420,7 +532,7 @@ class FleetRouter:
             self._m_routed.labels(replica=url, policy=how).inc()
             return url, payload
 
-        return self._foreach_candidate(body, attempt)
+        return self._foreach_candidate(body, attempt, exclude=exclude)
 
     # -------------------------------------------------- submit bookkeeping
     def _track(self, url: str, backend_rid: int, body: Dict) -> int:
@@ -489,8 +601,283 @@ class FleetRouter:
             self._trace_map[fid] = (url, int(payload["id"]))
         return True
 
+    # ----------------------------------------------------- hedged generate
+    def _hedge_threshold_s(self) -> Optional[float]:
+        """The rolling tail threshold that arms a hedge, or None while
+        the window is too small to trust."""
+        with self._hedge_lock:
+            lats = [lat for lat, _ in self._hedge_window]
+        if len(lats) < self.hedge_min_samples:
+            return None
+        return max(percentile(lats, self.hedge_quantile),
+                   self.hedge_min_s)
+
+    def _hedge_allowed(self) -> bool:
+        """The rate cap: hedged duplicates — completed AND still in
+        flight — over the rolling window must stay under
+        ``hedge_max_fraction``. During a fleet-wide overload EVERY
+        request crosses the threshold, and doubling that traffic would
+        amplify exactly the problem; counting launches (not just
+        completions) is what keeps concurrent stuck requests from all
+        approving themselves at once. Atomically CLAIMS an in-flight
+        slot when it allows — the caller must launch the hedge (or the
+        window over-reserves until its request completes)."""
+        with self._hedge_lock:
+            total = len(self._hedge_window) + self._hedges_in_flight
+            hedged = (sum(1 for _, h in self._hedge_window if h)
+                      + self._hedges_in_flight)
+            allowed = (hedged + 1) <= self.hedge_max_fraction * max(
+                total + 1, self.hedge_min_samples)
+            if allowed:
+                self._hedges_in_flight += 1
+            return allowed
+
+    def _hedge_unclaim(self) -> None:
+        """Return an in-flight hedge slot claimed by
+        :meth:`_hedge_allowed` (the hedged request completed, or the
+        hedge submit found no second replica)."""
+        with self._hedge_lock:
+            self._hedges_in_flight = max(0, self._hedges_in_flight - 1)
+
+    def _record_generate(self, latency_s: float, hedged: bool) -> None:
+        with self._hedge_lock:
+            self._hedge_window.append((float(latency_s), bool(hedged)))
+
+    def _hedge_submit(self, body: Dict, exclude=(),
+                      is_hedge: bool = False) -> Dict:
+        url, payload = self._dispatch("/v1/submit", body,
+                                      exclude=exclude)
+        # the arm owns one unit of in-flight load on its replica for
+        # its WHOLE life, exactly as the blocking proxy held it: the
+        # spill decision and the autoscaler's depth signal must see a
+        # long-running generate, not just its submit handshake.
+        # Released exactly once via _arm_release (the "held" field is
+        # the claim). The arm's own lock serializes its dead-replica
+        # resubmission against the loser-cancel path: without it the
+        # cancel could read the DEAD replica's url while the resubmit
+        # re-homes the request — leaving the re-homed copy decoding
+        # for a result nobody will ever fetch.
+        self.membership.record_dispatch(url, +1)
+        return {"url": url, "rid": int(payload["id"]),
+                "is_hedge": is_hedge, "cancelled": False, "held": url,
+                "lock": threading.Lock()}
+
+    def _arm_release(self, arm: Dict) -> None:
+        """Release the arm's in-flight unit (idempotent: the ``held``
+        claim pops once — terminal-error arms are also cancelled at
+        race end, and that must not double-decrement)."""
+        with arm["lock"]:
+            held = arm.get("held")
+            arm["held"] = None
+        if held is not None:
+            self.membership.record_dispatch(held, -1)
+
+    def _poll_arm(self, arm: Dict, body: Dict, others=()):
+        """One result poll for one arm. Returns ``("done", payload)``,
+        ``("pending", None)``, or ``("error", out)`` for a terminal
+        failure on this arm — ``out`` is an :class:`_HTTPError`
+        (expired, result evicted, or its replica died and the
+        resubmission found no home) or the replica's 200
+        engine-failure payload. A dead replica's arm is resubmitted to
+        a sibling in place — the single-arm mirror of
+        :meth:`_do_result`'s re-route."""
+        url, rid = arm["url"], arm["rid"]
+        try:
+            payload = self._get_replica(url, f"/v1/result?id={rid}")
+        except urllib.error.HTTPError as err:
+            detail = _error_payload(err)
+            if err.code in (404, 504):
+                return "error", _HTTPError(err.code, detail)
+            if self._replica_alive(url):
+                return "error", _HTTPError(err.code, detail)
+            self._replica_dead(url)
+            return self._resubmit_arm(arm, body, others)
+        except _HTTPError as err:
+            return "error", err
+        except Exception:  # noqa: BLE001 — refused/reset/timeout
+            self._replica_dead(url)
+            return self._resubmit_arm(arm, body, others)
+        status = payload.get("status")
+        if status == "pending":
+            return "pending", None
+        if status == "error":
+            # the replica's ENGINE died under this arm (its server
+            # answers 200 with an error payload, the single-server
+            # convention): that is this arm FAILING, never a win — a
+            # failed primary must not beat and cancel a healthy hedge.
+            # Only when every arm ends this way does the payload reach
+            # the client, matching the plain proxy path.
+            return "error", payload
+        return "done", payload
+
+    def _resubmit_arm(self, arm: Dict, body: Dict, others=()):
+        """Re-home an arm whose replica died (its stored body is this
+        very ``body``): submit to a sibling, excluding the other arm's
+        replica — a hedge pair on one replica measures nothing. Runs
+        under the arm's lock so a concurrent loser-cancel either
+        prevents the resubmission or sees its result."""
+        with arm["lock"]:
+            if arm["cancelled"]:
+                return "error", _HTTPError(499, {
+                    "error": "arm cancelled while re-homing"})
+            try:
+                url, payload = self._dispatch("/v1/submit", body,
+                                              exclude=set(others))
+            except _HTTPError as err:
+                return "error", err
+            # transfer the in-flight claim to the new replica
+            if arm.get("held") is not None:
+                self.membership.record_dispatch(arm["held"], -1)
+            self.membership.record_dispatch(url, +1)
+            arm["held"] = url
+            arm["url"], arm["rid"] = url, int(payload["id"])
+        self._m_rerouted.inc()
+        return "pending", None
+
+    def _cancel_arm_async(self, arm: Dict) -> None:
+        """Cancel a losing arm through the replica's existing cancel
+        path; if the cancel lost the race to completion, consume the
+        one-shot result so the replica's store drops it. Runs on a
+        background thread — a wedged loser must not delay the winner's
+        response."""
+        def run():
+            with arm["lock"]:
+                # claim the arm: a resubmission in flight finishes
+                # first (we then cancel the re-homed copy), a future
+                # one is prevented by the flag
+                arm["cancelled"] = True
+                url, rid = arm["url"], arm["rid"]
+            try:
+                out = self._post_replica(url, "/v1/cancel", {"id": rid})
+                if not out.get("cancelled"):
+                    self._get_replica(url, f"/v1/result?id={rid}")
+            except Exception:  # noqa: BLE001 — loser's replica died:
+                pass           # nothing left to clean
+            finally:
+                self._arm_release(arm)
+        threading.Thread(target=run, daemon=True,
+                         name="fleet-hedge-cancel").start()
+
+    def _generate_hedged(self, body: Dict) -> Dict:
+        """Blocking generate with hedged tail retry: submit+poll on the
+        policy-chosen replica; stuck past the rolling threshold, a
+        duplicate races on a second replica — first answer wins, the
+        loser is cancelled. Each arm polls on its OWN thread: a poll of
+        the slow arm can block for seconds behind its replica's busy
+        serving lock — exactly the degraded replica hedging exists to
+        outrun — and must not delay noticing the healthy arm's answer.
+        Failure semantics match the plain dispatch path (429/503
+        edges, dead-replica re-route) because every submit goes
+        through :meth:`_dispatch`."""
+        t0 = time.perf_counter()
+        threshold = self._hedge_threshold_s()
+        outcomes: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+        arms: List[Dict] = []
+
+        def run_arm(arm):
+            # cadence backs off toward a 50 ms ceiling: a long
+            # generate must not hold a 100 Hz poll loop (each replica
+            # poll takes the serving lock) for its whole life — the
+            # fine cadence only matters around the finish line
+            interval = self.hedge_poll_s
+            while not stop.is_set():
+                others = [a["url"] for a in arms if a is not arm]
+                status, out = self._poll_arm(arm, body, others)
+                if status != "pending":
+                    outcomes.put((arm, status, out))
+                    return
+                if stop.wait(interval):
+                    return
+                interval = min(interval * 1.25,
+                               max(self.hedge_poll_s, 0.05))
+
+        def launch(arm):
+            arms.append(arm)
+            threading.Thread(target=run_arm, args=(arm,), daemon=True,
+                             name="fleet-hedge-arm").start()
+
+        launch(self._hedge_submit(body))
+        hedged = False
+        failed = 0
+        try:
+            while True:
+                elapsed = time.perf_counter() - t0
+                remaining = self.proxy_timeout - elapsed
+                if remaining <= 0:
+                    for arm in arms:
+                        self._cancel_arm_async(arm)
+                    raise _HTTPError(504, {
+                        "error": "generate exceeded the router's "
+                                 f"proxy_timeout ({self.proxy_timeout}s)",
+                        "status": "expired"})
+                if not hedged and threshold is not None:
+                    # wake exactly at the hedge point, not poll-quantized
+                    wait_for = min(remaining,
+                                   max(threshold - elapsed, 0.001))
+                else:
+                    wait_for = remaining
+                try:
+                    arm, status, out = outcomes.get(timeout=wait_for)
+                except queue.Empty:
+                    if (hedged or threshold is None
+                            or time.perf_counter() - t0 < threshold):
+                        continue
+                    if not self._hedge_allowed():
+                        threshold = None     # capped: stop asking
+                        continue
+                    try:
+                        other = self._hedge_submit(
+                            body, exclude={arms[0]["url"]},
+                            is_hedge=True)
+                    except _HTTPError:
+                        threshold = None     # no second ready replica
+                        self._hedge_unclaim()   # claim never launched
+                        continue
+                    hedged = True
+                    self._m_hedged.inc()
+                    emit_event("fleet.request_hedged",
+                               primary=arms[0]["url"],
+                               hedge=other["url"],
+                               elapsed_ms=round(
+                                   (time.perf_counter() - t0) * 1e3, 3),
+                               threshold_ms=round(threshold * 1e3, 3))
+                    launch(other)
+                    continue
+                if status == "done":
+                    if hedged:
+                        self._m_hedge_wins.labels(
+                            arm="hedge" if arm["is_hedge"]
+                            else "primary").inc()
+                    self._arm_release(arm)   # its request completed
+                    for loser in arms:
+                        if loser is not arm:
+                            self._cancel_arm_async(loser)
+                    self._record_generate(time.perf_counter() - t0,
+                                          hedged)
+                    return out
+                self._arm_release(arm)       # terminal failure
+                failed += 1
+                if failed >= len(arms):   # every arm ended terminal
+                    self._record_generate(time.perf_counter() - t0,
+                                          hedged)
+                    if isinstance(out, _HTTPError):
+                        raise out
+                    return out   # engine-failure payload: 200 + error
+                                 # body, the plain proxy's semantics
+        finally:
+            stop.set()
+            if hedged:
+                self._hedge_unclaim()   # this hedge is no longer live
+
     # ------------------------------------------------------------- routes
     def _do_generate(self, body: Dict) -> Dict:
+        # a 1-replica fleet has nobody to hedge to: skip the
+        # submit+poll machinery (its poll cadence both costs replica
+        # lock acquisitions and detects completion up to one interval
+        # late) and proxy the old blocking way
+        if self.hedge and len(self.membership.ready_urls()) >= 2:
+            return self._generate_hedged(body)
         _, payload = self._dispatch("/v1/generate", body)
         return payload
 
@@ -533,7 +920,7 @@ class FleetRouter:
                     self._records.pop(fid, None)
                 raise _HTTPError(err.code, detail)
             if not self._replica_alive(rec["url"]):
-                self.membership.mark_down(rec["url"], "dead")
+                self._replica_dead(rec["url"])
                 self._reroute(fid)
                 return {"status": "pending", "rerouted": True}
             raise _HTTPError(err.code, detail)
@@ -541,7 +928,7 @@ class FleetRouter:
             raise
         except Exception:  # noqa: BLE001 — the replica is gone; the
             # stored body re-routes the request instead of failing it
-            self.membership.mark_down(rec["url"], "dead")
+            self._replica_dead(rec["url"])
             self._reroute(fid)
             return {"status": "pending", "rerouted": True}
         if payload.get("status") != "pending":
@@ -597,12 +984,29 @@ class FleetRouter:
         with self._records_lock:
             tracked = len(self._records)
         since = self._stat_base
+        with self._hedge_lock:
+            window = list(self._hedge_window)
+        hedge: Dict = {
+            "enabled": self.hedge,
+            "requests_hedged": int(
+                since_baseline(since, self._m_hedged)),
+            "window_samples": len(window),
+        }
+        threshold = self._hedge_threshold_s()   # the ARMING value —
+        if threshold is not None:               # never a re-derivation
+            hedge["threshold_s"] = round(threshold, 6)
+            hedge["hedged_fraction"] = round(
+                sum(1 for _, h in window if h) / len(window), 4)
         return {
             "policy": self.policy,
             # locked reads: the prober mutates the ring concurrently
             "ring_size": self.membership.ring_size(),
             "ring_nodes": self.membership.ring_nodes(),
             "replicas": replicas,
+            # per-tier aggregation: the numbers the autoscaler reads,
+            # exposed so ONE scrape answers "is the fleet keeping up"
+            "tiers": self.membership.tier_signals(),
+            "hedge": hedge,
             "requests_spilled": int(
                 since_baseline(since, self._m_spilled)),
             "requests_rerouted": int(

@@ -1,0 +1,599 @@
+"""Demand-driven fleet autoscaler + hedged tail retries: hysteresis
+and bounds on a fake tier (fast), then the real control loop over
+in-process pools — scale-up under a load step, graceful drained
+scale-down with zero failed client requests (including a chaos kill
+landing mid-drain), hedged retries outrunning an injected-slow replica
+with the loser cancelled and nothing leaked, the router ``/stats``
+per-tier aggregation, and graceful prefill-tier scale-down through
+``DisaggPool.drain_prefill``. The multi-second pool tests are marked
+``slow`` (fresh engines = fresh jit compiles; tier-1 filters them, CI
+shards run everything)."""
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.disagg import DisaggPool
+from elephas_tpu.fleet import (DisaggPrefillTier, FleetAutoscaler,
+                               FleetRouter, ReplicaPool, ReplicaPoolTier,
+                               TierPolicy)
+from elephas_tpu.models.transformer import (TransformerConfig, generate,
+                                            init_params)
+from elephas_tpu.obs.events import recent_events
+from elephas_tpu.serving_engine import DecodeEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                               d_model=32, d_ff=64, max_seq_len=64,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def _ref(params, config, prompt, n):
+    return list(np.asarray(
+        generate(params, jnp.asarray(prompt)[None], n, config))[0])
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _poll_all(port, fids, timeout=120.0):
+    """Poll every fleet rid to completion; any 404/terminal error is a
+    FAILED client request and fails the test."""
+    outs = {}
+    deadline = time.monotonic() + timeout
+    while len(outs) < len(fids):
+        assert time.monotonic() < deadline, (
+            f"only {len(outs)}/{len(fids)} requests completed")
+        for fid in fids:
+            if fid in outs:
+                continue
+            payload = _get(port, f"/v1/result?id={fid}")
+            if payload.get("status") not in ("pending",):
+                assert payload.get("status") == "done", payload
+                outs[fid] = payload
+        time.sleep(0.05)
+    return outs
+
+
+class _SlowStep:
+    """Engine shim for a degraded replica: every step() stalls, so any
+    request it serves runs slow — the tail the hedging path exists to
+    cut. Everything else delegates to the wrapped engine."""
+
+    def __init__(self, engine, delay_s: float):
+        self._engine = engine
+        self._delay_s = float(delay_s)
+
+    def step(self):
+        time.sleep(self._delay_s)
+        return self._engine.step()
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+# ------------------------------------------------------------ fast units
+class _FakeTier:
+    name = "fake-decode"   # distinct from the pool tests' events
+
+    def __init__(self, policy, count=1):
+        self.policy = policy
+        self._count = count
+        self.sig = {"queue_depth": 0, "queued_tokens": 0, "in_flight": 0,
+                    "depth": 0.0, "wait_p99_s": 0.0, "requests_shed": 0}
+        self.ups = 0
+        self.downs = 0
+
+    def count(self):
+        return self._count
+
+    def draining(self):
+        return 0
+
+    def signals(self):
+        return dict(self.sig)
+
+    def scale_up(self):
+        self._count += 1
+        self.ups += 1
+        return f"replica-{self._count}"
+
+    def scale_down(self):
+        self._count -= 1
+        self.downs += 1
+        return f"replica-{self._count + 1}"
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        TierPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        TierPolicy(up_after=0)
+    with pytest.raises(ValueError):
+        TierPolicy(low_depth=4.0, high_depth=4.0)
+    with pytest.raises(ValueError):
+        FleetAutoscaler([])
+    t = _FakeTier(TierPolicy())
+    with pytest.raises(ValueError):
+        FleetAutoscaler([t, _FakeTier(TierPolicy())])  # duplicate name
+
+
+def test_hysteresis_bounds_and_traced_events():
+    """The decision core, driven synchronously: up only after
+    ``up_after`` CONSECUTIVE pressured windows, down only after
+    ``down_after`` idle ones, dead-band windows reset both streaks,
+    bounds are hard, and every action is a traced event."""
+    tier = _FakeTier(TierPolicy(min_replicas=1, max_replicas=3,
+                                high_wait_s=0.1, high_depth=2.0,
+                                low_depth=0.5, up_after=2, down_after=3))
+    scaler = FleetAutoscaler([tier], probe_interval=0.1)
+
+    # one pressured window is not enough (hysteresis)
+    tier.sig.update(depth=10.0, queue_depth=10)
+    assert scaler.poll_once() == {"fake-decode": None}
+    # a dead-band window resets the streak: pressure must be CONSECUTIVE
+    tier.sig.update(depth=1.0, queue_depth=1)
+    assert scaler.poll_once() == {"fake-decode": None}
+    tier.sig.update(depth=10.0, queue_depth=10)
+    assert scaler.poll_once() == {"fake-decode": None}
+    assert scaler.poll_once() == {"fake-decode": "up"}
+    assert tier.ups == 1 and tier.count() == 2
+
+    # wait-tail pressure scales too when live backlog corroborates it
+    # (depth in the dead band, p99 over the SLO proxy); a stale wait
+    # tail with NO backlog is not pressure — completed-request windows
+    # outlive the burst that filled them
+    tier.sig.update(depth=0.0, queue_depth=0, wait_p99_s=0.5)
+    assert scaler.poll_once() == {"fake-decode": None}
+    assert scaler.poll_once() == {"fake-decode": None}
+    tier.sig.update(depth=2.0, queue_depth=2)   # per-replica: dead band
+    assert scaler.poll_once() == {"fake-decode": None}   # streak was reset
+    assert scaler.poll_once() == {"fake-decode": "up"}
+    assert tier.count() == 3
+
+    # at max_replicas further pressure does nothing
+    scaler.poll_once()
+    assert scaler.poll_once() == {"fake-decode": None}
+    assert tier.count() == 3
+
+    # a shed is up-pressure even with depth and waits clean — but the
+    # ceiling still holds
+    tier.sig.update(wait_p99_s=0.0, requests_shed=5)
+    assert scaler.poll_once() == {"fake-decode": None}
+    tier.sig.update(requests_shed=9)
+    assert scaler.poll_once() == {"fake-decode": None}   # capped at max
+
+    # idle: down after down_after consecutive windows, one at a time,
+    # never below min_replicas
+    tier.sig.update(requests_shed=9, depth=0.0, queue_depth=0)
+    for _ in range(2):
+        assert scaler.poll_once() == {"fake-decode": None}
+    assert scaler.poll_once() == {"fake-decode": "down"}
+    assert tier.count() == 2
+    for _ in range(2):
+        assert scaler.poll_once() == {"fake-decode": None}
+    assert scaler.poll_once() == {"fake-decode": "down"}
+    assert tier.count() == 1
+    for _ in range(6):
+        scaler.poll_once()
+    assert tier.count() == 1    # floor
+
+    ups = [e for e in recent_events("fleet.scaled_up")
+           if e.get("tier") == "fake-decode"]
+    downs = [e for e in recent_events("fleet.scaled_down")
+             if e.get("tier") == "fake-decode"]
+    assert len(ups) >= 2 and len(downs) >= 2
+    for e in ups + downs:
+        assert e["trace_id"], "scaling decisions must be traced events"
+    assert all(e["mode"] == "drain" for e in downs)
+    assert ups[0]["replicas_after"] == ups[0]["replicas_before"] + 1
+
+    status = scaler.status()["fake-decode"]
+    assert status["replicas"] == 1
+    assert status["min_replicas"] == 1 and status["max_replicas"] == 3
+
+
+def test_below_floor_restores_immediately():
+    """A tier dropped below its floor (replica crash) restores on the
+    next window WITHOUT waiting out the demand hysteresis — the floor
+    is a hard bound, not a demand signal — then normal rules resume."""
+    tier = _FakeTier(TierPolicy(min_replicas=2, max_replicas=3,
+                                up_after=5, down_after=5))
+    tier._count = 1         # a chaos kill dropped the tier below floor
+    scaler = FleetAutoscaler([tier], probe_interval=0.1)
+    assert scaler.poll_once() == {"fake-decode": "up"}
+    assert tier.count() == 2
+    assert scaler.poll_once() == {"fake-decode": None}
+    events = [e for e in recent_events("fleet.scaled_up")
+              if e.get("tier") == "fake-decode"
+              and e.get("reason") == "below_floor"]
+    assert events and events[-1]["trace_id"]
+
+
+def test_shed_delta_ignored_across_membership_churn():
+    """Cumulative shed totals are summed over the READY set, so an
+    evict-then-rejoin re-adds a replica's whole history in one window
+    — that spike must not read as fresh overload; a real shed on a
+    stable set still does."""
+    tier = _FakeTier(TierPolicy(min_replicas=1, max_replicas=3,
+                                high_depth=2.0, low_depth=0.5,
+                                up_after=1, down_after=99))
+    tier.sig.update(requests_shed=50, ready_urls=["a", "b"])
+    scaler = FleetAutoscaler([tier], probe_interval=0.1)
+    assert scaler.poll_once() == {"fake-decode": None}  # baseline window
+    # replica b evicted: the sum drops (delta clamps at 0 anyway)
+    tier.sig.update(requests_shed=20, ready_urls=["a"])
+    assert scaler.poll_once() == {"fake-decode": None}
+    # b rejoins: +30 whole-history spike on a CHANGED set — not overload
+    tier.sig.update(requests_shed=50, ready_urls=["a", "b"])
+    assert scaler.poll_once() == {"fake-decode": None}
+    # one genuine shed on a stable set IS up-pressure (up_after=1)
+    tier.sig.update(requests_shed=51)
+    assert scaler.poll_once() == {"fake-decode": "up"}
+
+
+def test_hedge_threshold_and_rate_cap(model):
+    """The rolling threshold arms only past ``hedge_min_samples`` and
+    floors at ``hedge_min_s``; the rate cap blocks hedging once the
+    window's hedged fraction hits ``hedge_max_fraction``."""
+    router = FleetRouter(["http://127.0.0.1:9"], hedge=True,
+                         hedge_quantile=0.9, hedge_min_s=0.05,
+                         hedge_max_fraction=0.10, hedge_min_samples=10)
+    assert router._hedge_threshold_s() is None      # window too small
+    for _ in range(9):
+        router._record_generate(0.01, False)
+    assert router._hedge_threshold_s() is None
+    router._record_generate(0.01, False)
+    assert router._hedge_threshold_s() == pytest.approx(0.05)  # floored
+    router._record_generate(1.0, False)
+    router._record_generate(1.0, False)
+    # 2 slow of 12: the nearest-rank p90 lands in the slow tail
+    assert router._hedge_threshold_s() == pytest.approx(1.0)
+
+    # allowing CLAIMS an in-flight slot: a second concurrent stuck
+    # request must see the first's launched (not yet completed) hedge
+    # — or a fleet-wide stall would approve every duplicate at once
+    assert router._hedge_allowed()
+    assert not router._hedge_allowed()
+    router._hedge_unclaim()
+    # drive the hedged fraction to the cap: 2 hedged of 14 > 10%
+    router._record_generate(0.01, True)
+    router._record_generate(0.01, True)
+    assert not router._hedge_allowed()
+    with pytest.raises(ValueError):
+        FleetRouter(["http://127.0.0.1:9"], hedge_quantile=1.5)
+
+
+# -------------------------------------------------------- pool integration
+@pytest.mark.slow
+def test_load_step_scales_up_then_drains_back_to_floor(model):
+    """The acceptance loop: a queue-depth step on a 1-replica fleet
+    scales decode up within the hysteresis windows; when the burst
+    drains, the fleet shrinks back to the floor via graceful drain —
+    and every client request completes."""
+    params, config = model
+    pool = ReplicaPool(
+        lambda: _SlowStep(DecodeEngine(params, config, max_slots=2),
+                          0.03),
+        n=1).start()
+    router = FleetRouter(pool.urls, probe_interval=0.1, join_after=1,
+                         evict_after=2, hedge=False).start()
+    tier = ReplicaPoolTier(router, pool,
+                           TierPolicy(min_replicas=1, max_replicas=2,
+                                      high_depth=2.0, low_depth=0.5,
+                                      up_after=2, down_after=3),
+                           drain_timeout=30.0)
+    scaler = FleetAutoscaler([tier], probe_interval=0.15).start()
+    rng = np.random.default_rng(3)
+    try:
+        fids = []
+        for _ in range(12):
+            prompt = [int(t) for t in rng.integers(0, 300, 6)]
+            fids.append(_post(router.port, "/v1/submit",
+                              {"prompt": prompt,
+                               "max_new_tokens": 8})["id"])
+        # the queue-depth step must trigger a scale-up within the
+        # hysteresis windows (2 windows x 0.15s, plus probe latency)
+        deadline = time.monotonic() + 20
+        while tier.count() < 2:
+            assert time.monotonic() < deadline, "no scale-up happened"
+            time.sleep(0.05)
+        assert len(router.membership.candidate_urls()) == 2
+        _poll_all(router.port, fids)    # ZERO failed client requests
+
+        # burst over: idle windows drain the fleet back to the floor
+        deadline = time.monotonic() + 30
+        while (tier.count() > 1 or tier.draining()
+               or len(router.membership.candidate_urls()) > 1):
+            assert time.monotonic() < deadline, "no scale-down happened"
+            time.sleep(0.05)
+        assert pool.alive_indexes() == [0] or len(
+            pool.alive_indexes()) == 1
+        ups = [e for e in recent_events("fleet.scaled_up")
+               if e.get("tier") == "decode" and e.get("mode") == "spawn"]
+        downs = [e for e in recent_events("fleet.scaled_down")
+                 if e.get("tier") == "decode"
+                 and e.get("mode") == "drain"]
+        assert ups and downs
+        assert all(e["trace_id"] for e in ups + downs)
+        # the fleet still serves after the resize choreography
+        out = _post(router.port, "/v1/generate",
+                    {"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert len(out["tokens"]) == 4
+    finally:
+        scaler.stop()
+        router.stop()
+        pool.stop()
+
+
+@pytest.mark.slow
+def test_chaos_kill_mid_drain_converges_with_zero_failures(model):
+    """A replica killed WHILE the autoscaler is draining it must not
+    fail a single client request (the dead replica's submitted work
+    re-homes through the router's stored-body resubmission) and the
+    autoscaler must keep converging to its floor."""
+    params, config = model
+    pool = ReplicaPool(
+        lambda: _SlowStep(DecodeEngine(params, config, max_slots=2),
+                          0.05),
+        n=3).start()
+    router = FleetRouter(pool.urls, probe_interval=0.1, join_after=1,
+                         evict_after=2, hedge=False).start()
+    tier = ReplicaPoolTier(router, pool,
+                           TierPolicy(min_replicas=1, max_replicas=3,
+                                      high_depth=50.0, low_depth=40.0,
+                                      up_after=99, down_after=1),
+                           drain_timeout=30.0)
+    rng = np.random.default_rng(11)
+    scaler = FleetAutoscaler([tier], probe_interval=0.2)
+    try:
+        fids = []
+        for _ in range(9):
+            prompt = [int(t) for t in rng.integers(0, 300, 6)]
+            fids.append(_post(router.port, "/v1/submit",
+                              {"prompt": prompt,
+                               "max_new_tokens": 16})["id"])
+        scaler.start()
+        # wait for the first drain to begin, then KILL that replica
+        deadline = time.monotonic() + 15
+        victim = None
+        while victim is None:
+            assert time.monotonic() < deadline, "no drain began"
+            for i in pool.alive_indexes():
+                if pool.servers[i]._draining:
+                    victim = i
+                    break
+            time.sleep(0.02)
+        pool.kill(victim)
+        # every request still completes — the chaos acceptance bar
+        _poll_all(router.port, fids)
+        # and the fleet keeps shrinking to the floor despite the kill
+        deadline = time.monotonic() + 40
+        while (tier.count() > 1 or tier.draining()
+               or len(router.membership.candidate_urls()) > 1):
+            assert time.monotonic() < deadline, (
+                f"fleet did not converge: count={tier.count()} "
+                f"draining={tier.draining()} "
+                f"candidates={router.membership.candidate_urls()}")
+            time.sleep(0.05)
+        assert len(pool.alive_indexes()) == 1
+    finally:
+        scaler.stop()
+        router.stop()
+        pool.stop()
+
+
+@pytest.mark.slow
+def test_hedged_retry_outruns_slow_replica_and_cancels_loser(model):
+    """A request stuck past the rolling threshold on an injected-slow
+    replica is duplicated to a sibling; the duplicate wins well under
+    the slow path's latency, tokens match the reference greedy output,
+    and the losing arm is cancelled with no orphaned slot, no stranded
+    result, and no leaked router record."""
+    params, config = model
+    slow_delay, builds = 0.15, []
+
+    def factory():
+        eng = DecodeEngine(params, config, max_slots=2)
+        if not builds:        # replica 0 is the degraded one
+            eng = _SlowStep(eng, slow_delay)
+        builds.append(eng)
+        return eng
+
+    pool = ReplicaPool(factory, n=2).start()
+    router = FleetRouter(pool.urls, probe_interval=0.2, join_after=1,
+                         hedge=True, hedge_quantile=0.5,
+                         hedge_min_s=0.3, hedge_min_samples=4,
+                         hedge_max_fraction=1.0,
+                         hedge_poll_s=0.005).start()
+    try:
+        slow_url, fast_url = pool.urls[0], pool.urls[1]
+        deadline = time.monotonic() + 15
+        while router.membership.ring_size() < 2:
+            assert time.monotonic() < deadline, "replicas never joined"
+            time.sleep(0.02)
+
+        def owner_of(prompt):
+            chain = router.membership.route_chain(
+                router._route_key({"prompt": prompt}))
+            return chain[0] if chain else None
+
+        rng = np.random.default_rng(5)
+
+        def prompts_owned_by(url, n):
+            out = []
+            while len(out) < n:
+                p = [int(t) for t in rng.integers(0, 300, 6)]
+                if owner_of(p) == url:
+                    out.append(p)
+            return out
+
+        # warm the rolling window on the healthy replica only: the
+        # threshold must learn the HEALTHY latency distribution
+        for p in prompts_owned_by(fast_url, 4):
+            _post(router.port, "/v1/generate",
+                  {"prompt": p, "max_new_tokens": 4})
+        assert router._hedge_threshold_s() is not None
+
+        victim_prompt = prompts_owned_by(slow_url, 1)[0]
+        ref = _ref(params, config, victim_prompt, 6)
+        t0 = time.monotonic()
+        out = _post(router.port, "/v1/generate",
+                    {"prompt": victim_prompt, "max_new_tokens": 6})
+        elapsed = time.monotonic() - t0
+        # slow path: 6 steps x 0.15s stall >= 0.9s; the hedge answers
+        # at ~threshold (0.3s) + one fast generate
+        assert out["tokens"] == ref
+        assert elapsed < 0.8 * 6 * slow_delay, elapsed
+
+        stats = router.stats()
+        assert stats["hedge"]["requests_hedged"] == 1
+        hedges = [e for e in recent_events("fleet.request_hedged")
+                  if e.get("primary") == slow_url]
+        assert hedges and hedges[-1]["trace_id"]
+        assert hedges[-1]["hedge"] == fast_url
+        wins = {labels[0]: int(c.value) for labels, c in
+                router._m_hedge_wins.series().items()}
+        assert wins.get("hedge") == 1
+
+        # loser cleanup: the slow arm is cancelled (or its result
+        # consumed), nothing orphaned anywhere
+        deadline = time.monotonic() + 15
+        slow_srv = pool.servers[0]
+        while True:
+            with slow_srv._lock:
+                clean = (not slow_srv._tracked and not slow_srv._results
+                         and not slow_srv._streams)
+            if clean and slow_srv.engine.pending == 0:
+                break
+            assert time.monotonic() < deadline, "loser leaked state"
+            time.sleep(0.05)
+        assert not router._records, "hedge must not leak rid mappings"
+    finally:
+        router.stop()
+        pool.stop()
+
+
+@pytest.mark.slow
+def test_router_stats_aggregates_tiers_and_prefill_drain(model):
+    """One /stats scrape answers "is the fleet keeping up": per-tier
+    queue-wait percentiles, shed rate, and per-replica load — over a
+    DISAGGREGATED pool, whose prefill tier then scales down gracefully
+    through ``DisaggPool.drain_prefill`` with zero failed requests."""
+    params, config = model
+    pool = DisaggPool(
+        lambda: DecodeEngine(params, config, max_slots=2,
+                             tier="decode"),
+        n_prefill=2, n_decode=1,
+        prefill_factory=lambda: DecodeEngine(params, config,
+                                             max_slots=1),
+        quant=False, block_size=8).start()
+    router = FleetRouter(pool.urls, probe_interval=0.1, join_after=1,
+                         hedge=False).start()
+    rng = np.random.default_rng(7)
+    try:
+        fids = []
+        for _ in range(6):
+            prompt = [int(t) for t in rng.integers(0, 300, 10)]
+            fids.append(_post(router.port, "/v1/submit",
+                              {"prompt": prompt,
+                               "max_new_tokens": 6})["id"])
+        _poll_all(router.port, fids)
+        time.sleep(0.3)             # let a probe pass capture /stats
+        stats = _get(router.port, "/stats")
+        decode = stats["tiers"]["decode"]
+        assert decode["replicas"] == 1
+        assert decode["requests_finished"] >= 6
+        assert decode["shed_rate"] == 0.0
+        assert "queue_wait_p99_s" in decode
+        prefill = stats["tiers"]["prefill"]
+        assert prefill["workers_alive"] == 2
+        assert "queue_wait_p99_s" in prefill
+        for info in stats["replicas"].values():
+            assert "load" in info and "requests_finished" in info
+        assert stats["hedge"]["enabled"] is False
+
+        # graceful prefill scale-down mid-traffic: worker 0 drains,
+        # later requests prefill on the sibling, nothing fails
+        fids = []
+        for _ in range(4):
+            prompt = [int(t) for t in rng.integers(0, 300, 10)]
+            fids.append(_post(router.port, "/v1/submit",
+                              {"prompt": prompt,
+                               "max_new_tokens": 6})["id"])
+        pool.drain_prefill(0)
+        assert not pool.prefill_workers[0].alive
+        more = [int(t) for t in rng.integers(0, 300, 10)]
+        fids.append(_post(router.port, "/v1/submit",
+                          {"prompt": more, "max_new_tokens": 6})["id"])
+        _poll_all(router.port, fids)    # zero failed client requests
+        assert pool.prefill_workers[1].alive
+        time.sleep(0.3)
+        stats = _get(router.port, "/stats")
+        assert stats["tiers"]["prefill"]["workers_alive"] == 1
+    finally:
+        router.stop()
+        pool.stop()
+
+
+@pytest.mark.slow
+def test_disagg_prefill_tier_scales_through_adapter(model):
+    """The prefill tier's adapter end to end: scale_up spawns a worker
+    every live DisaggEngine starts using; scale_down drains (never
+    kills) and the dispatcher re-homes queued jobs."""
+    params, config = model
+    pool = DisaggPool(
+        lambda: DecodeEngine(params, config, max_slots=2,
+                             tier="decode"),
+        n_prefill=1, n_decode=1,
+        prefill_factory=lambda: DecodeEngine(params, config,
+                                             max_slots=1),
+        quant=False, block_size=8).start()
+    router = FleetRouter(pool.urls, probe_interval=0.1, join_after=1,
+                         hedge=False).start()
+    tier = DisaggPrefillTier(pool, TierPolicy(min_replicas=1,
+                                              max_replicas=2))
+    try:
+        assert tier.count() == 1
+        name = tier.scale_up()
+        assert name == "prefill-1" and tier.count() == 2
+        assert len(pool.prefill_workers) == 2
+        # the live engine dispatches to the new worker
+        assert pool.engines[0].workers[-1] is pool.prefill_workers[1]
+        rng = np.random.default_rng(13)
+        fids = [_post(router.port, "/v1/submit",
+                      {"prompt": [int(t) for t in
+                                  rng.integers(0, 300, 10)],
+                       "max_new_tokens": 5})["id"] for _ in range(6)]
+        assert tier.scale_down() is not None
+        _poll_all(router.port, fids)
+        deadline = time.monotonic() + 15
+        while tier.draining():
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert tier.count() == 1
+        alive = [w for w in pool.prefill_workers if w.alive]
+        assert len(alive) == 1
+    finally:
+        router.stop()
+        pool.stop()
