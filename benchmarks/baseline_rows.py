@@ -174,6 +174,128 @@ def measure_ps_plane(payload_mb=16.0, shards=4, rounds=6):
                       "cached-snapshot gets, zero-copy decode"}
 
 
+def measure_ps_failover(smoke=False):
+    """Fault-tolerant-parameter-plane row: hot-standby failover wall
+    time (primary killed mid-push-stream -> standby promoted -> next
+    push lands), the zero-lost-updates invariant checked against a
+    never-killed oracle, and 2PC push rounds/s with replication on vs
+    off (the cost of the standby's synchronous applied-delta stream).
+
+    In-process servers by design: promotion IS an in-process control
+    action (`promote_shard`), and the replication on/off comparison
+    biases both lanes identically — the row's story is failover latency
+    and replication overhead, not absolute RPC ceilings (ps_plane's
+    subprocess sweep owns those)."""
+    import threading
+
+    from elephas_tpu.parameter.factory import (create_sharded_client,
+                                               create_sharded_server)
+
+    rng = np.random.default_rng(0)
+    n_elem = 4_000 if smoke else 250_000     # ~1 MB fp32 plane full-size
+    sizes = (n_elem, n_elem // 2, n_elem // 4, n_elem // 8)
+    ws = [rng.random(n).astype(np.float32) for n in sizes]
+    rounds = 4 if smoke else 40
+    port = 27460
+
+    def push_rounds(standby):
+        group = create_sharded_server(
+            "socket", {"model": None, "weights": ws}, port,
+            "asynchronous", 2, standby=standby)
+        group.start()
+        try:
+            client = create_sharded_client(
+                "socket", port, {"model": None, "weights": ws}, 2,
+                timeout=10.0, backoff=0.05)
+            delta = [np.full_like(w, 0.001) for w in ws]
+            client.update_parameters(delta)          # warm both lanes
+            start = time.perf_counter()
+            for _ in range(rounds):
+                client.update_parameters(delta)
+            elapsed = time.perf_counter() - start
+            client.close()
+            return rounds / elapsed
+        finally:
+            group.stop()
+
+    rps_replicated = push_rounds(standby=True)
+    rps_plain = push_rounds(standby=False)
+
+    # failover: kill primary 0 mid-stream; a monitor promotes; measure
+    # kill -> next push acked (the client-visible outage window)
+    group = create_sharded_server(
+        "socket", {"model": None, "weights": ws}, port + 8,
+        "asynchronous", 2, standby=True)
+    group.start()
+    client = create_sharded_client(
+        "socket", port + 8, {"model": None, "weights": ws}, 2,
+        timeout=10.0, backoff=0.02)
+    n_before, n_after = (2, 2) if smoke else (6, 6)
+    value = np.float32(0.001)
+    applied = 0
+    try:
+        from elephas_tpu.parameter.sharding import CommitAbortedError
+
+        def push_once():
+            for _ in range(80):
+                try:
+                    client.update_parameters(
+                        [np.full_like(w, value) for w in ws])
+                    return
+                except CommitAbortedError:
+                    time.sleep(0.02)
+            raise RuntimeError("push never landed through the failover")
+
+        for _ in range(n_before):
+            push_once()
+            applied += 1
+
+        promoted = threading.Event()
+
+        def monitor():
+            while not group.promote_shard(0):
+                time.sleep(0.01)
+            promoted.set()
+
+        t0 = time.perf_counter()
+        # SIGKILL-shaped death: close the socket out from under the
+        # server, no graceful handler joins (stop() would spend ~0.5s
+        # of bookkeeping that a real process kill never performs —
+        # promote_shard does the corpse cleanup off the timed path)
+        group.servers[0].runs = False
+        group.servers[0].socket.close()
+        threading.Thread(target=monitor, daemon=True).start()
+        push_once()                              # blocks through outage
+        applied += 1
+        failover_ms = (time.perf_counter() - t0) * 1e3
+        promoted.wait(timeout=10)
+        for _ in range(n_after - 1):
+            push_once()
+            applied += 1
+
+        oracle = [w - applied * value for w in ws]
+        final = client.get_parameters()
+        zero_lost = all(
+            np.allclose(f, o, rtol=1e-5, atol=1e-7)
+            for f, o in zip(final, oracle))
+        client.close()
+    finally:
+        group.stop()
+
+    return {"metric": "ps_failover_ms", "value": round(failover_ms, 2),
+            "unit": "ms (primary killed mid-stream -> next push acked)",
+            "zero_lost_updates": bool(zero_lost),
+            "pushes_through_failover": applied,
+            "rounds_per_sec_replicated": round(rps_replicated, 2),
+            "rounds_per_sec_unreplicated": round(rps_plain, 2),
+            "replication_overhead": round(rps_plain / rps_replicated, 3)
+            if rps_replicated else None,
+            "config": f"2 socket shards + hot standbys, ~{4 * sum(sizes) / 1e6:.1f} MB "
+                      f"fp32 plane, {rounds} 2PC push rounds/lane, "
+                      "in-process servers (control-plane row; see "
+                      "ps_plane for subprocess RPC ceilings)"}
+
+
 def measure_decode(batch=8, prompt_len=16, max_new_tokens=128):
     """Decode-throughput row: tokens/sec of the jitted KV-cache scan on
     the flagship LM config (serving path), bf16 weights vs weight-only
@@ -1788,6 +1910,8 @@ if __name__ == "__main__":
         _emit(measure_async())
     if which in ("ps_plane", "all"):
         _emit(measure_ps_plane())
+    if which in ("ps_failover", "all"):
+        _emit(measure_ps_failover(smoke=smoke))
     if which in ("decode", "all"):
         _emit(measure_decode())
     if which in ("flash", "all"):

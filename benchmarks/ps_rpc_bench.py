@@ -169,7 +169,12 @@ def _sharded_client(model, port: int, num_shards: int,
             for i in range(num_shards)]
     if num_shards == 1:
         return subs[0]
-    return ShardedParameterClient(subs, plan)
+    # two_phase=False deliberately: this sweep's historical meaning is
+    # the RAW sharded wire ceiling (one RPC per shard per push),
+    # comparable across BENCH_r* runs. The default 2PC push costs a
+    # prepare+commit pair; its overhead is measured where it belongs,
+    # in the baseline_rows ps_failover row (replication on vs off).
+    return ShardedParameterClient(subs, plan, two_phase=False)
 
 
 def measure_payload_sweep(port: int, sizes_mb=SWEEP_MB,

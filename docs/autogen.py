@@ -41,7 +41,10 @@ PAGES = [
     ("Parameter clients", "elephas_tpu.parameter.client",
      ["BaseParameterClient", "HttpClient", "SocketClient"]),
     ("Parameter-plane sharding", "elephas_tpu.parameter.sharding",
-     ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient"]),
+     ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient",
+      "TornPushError", "CommitAbortedError", "GenerationMismatchError"]),
+    ("Parameter-plane replication", "elephas_tpu.parameter.replication",
+     ["ShardReplicator", "ShardStandby"]),
     ("Parallel trainers", "elephas_tpu.parallel.sync_trainer",
      ["SyncAverageTrainer", "SyncStepTrainer", "build_sharded_predict",
       "build_sharded_evaluate"]),

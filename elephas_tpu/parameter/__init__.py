@@ -1,8 +1,11 @@
-from .client import BaseParameterClient, HttpClient, SocketClient
+from .client import (BaseParameterClient, FencedEpochError, HttpClient,
+                     SocketClient, UnknownTxnError)
 from .factory import (ClientServerFactory, HttpFactory, SocketFactory,
                       Transport, available_transports,
                       create_sharded_client, create_sharded_server,
                       get_transport, register_transport)
+from .replication import ShardReplicator, ShardStandby
 from .server import BaseParameterServer, HttpServer, SocketServer
-from .sharding import (ShardedParameterClient, ShardedServerGroup,
-                       ShardPlan)
+from .sharding import (CommitAbortedError, GenerationMismatchError,
+                       ShardedParameterClient, ShardedServerGroup,
+                       ShardPlan, TornPushError)

@@ -6,6 +6,7 @@ timeouts, transient-failure retry with exponential backoff, and health
 probes — the reference has no failure detection at all, SURVEY.md §5.)
 """
 import abc
+import random
 import socket
 import struct
 import threading
@@ -13,7 +14,7 @@ import time
 import urllib.error
 import urllib.request
 import uuid
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -21,8 +22,11 @@ from ..obs.context import current_context
 from ..obs.metrics import default_registry
 from ..utils.delta_compression import quantize_delta
 from ..utils.faults import InjectedFault, fault_site
-from ..utils.sockets import (determine_master, receive, recv_exact, send,
-                             send_trace_context)
+from ..utils.sockets import (PS_ABORT_OPCODE, PS_COMMIT_OPCODE,
+                             PS_GEN_POLL_OPCODE, PS_GEN_PULL_OPCODE,
+                             PS_PREPARE_OPCODE, PS_REPLICATE_OPCODE,
+                             determine_master, receive, recv_exact, recv_u64,
+                             send, send_trace_context)
 from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
                                   encode)
 
@@ -30,12 +34,48 @@ from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
 #: as an error in the training loop, not a hang
 DEFAULT_TIMEOUT = 120.0
 
-#: transient-failure policy: attempts = 1 + MAX_RETRIES, sleeping
-#: BACKOFF * 2**attempt between tries
+#: transient-failure policy: attempts = 1 + MAX_RETRIES, sleeping a
+#: decorrelated-jittered pause between tries (see :func:`_retry_pause`)
 MAX_RETRIES = 3
 BACKOFF = 0.2
 
+#: ceiling on any single retry pause (seconds): jitter may triple the
+#: previous pause, so without a cap a long retry budget could sleep
+#: arbitrarily far past the point the server came back
+BACKOFF_CAP = 5.0
+
+#: process-wide RNG for retry jitter — deliberately NOT seeded, and
+#: shared so even same-process subscribers draw different pauses
+_JITTER_RNG = random.Random()
+
 _TRANSIENT = (ConnectionError, socket.timeout, urllib.error.URLError, OSError)
+
+
+def _retry_pause(prev: float, base: float, cap: float = BACKOFF_CAP,
+                 rng: random.Random = _JITTER_RNG) -> float:
+    """Decorrelated-jitter backoff (the AWS architecture-blog variant):
+    ``min(cap, uniform(base, prev * 3))``. Grows roughly exponentially
+    in expectation but every draw is independent — a FLEET of subscribers
+    whose shared parameter shard died does not retry in lockstep and
+    stampede the freshly promoted standby the way the old deterministic
+    ``base * 2**attempt`` schedule did."""
+    return min(float(cap), rng.uniform(base, max(base, prev * 3.0)))
+
+
+class UnknownTxnError(RuntimeError):
+    """A two-phase ``commit`` named a transaction the server has neither
+    staged nor applied — the prepare landed on a server that has since
+    died (and its promoted standby never saw the staged delta). The
+    sharded client recovers by RE-PREPARING that shard's slice and
+    committing again; the error is NOT transient, so it propagates out
+    of the retry loop immediately."""
+
+
+class FencedEpochError(RuntimeError):
+    """A replication push carried a fencing epoch older than the
+    receiver's — the sender is a ZOMBIE primary that was declared dead
+    and failed over, but kept running. Its late traffic must never be
+    applied; the replicator treats this as a terminal stop signal."""
 
 
 class BaseParameterClient(abc.ABC):
@@ -76,6 +116,7 @@ class BaseParameterClient(abc.ABC):
         latency, retries, failures = self._rpc_metrics(describe)
         deadline = time.monotonic() + (
             self.deadline if self.deadline is not None else 2 * self.timeout)
+        pause = self.backoff
         for attempt in range(self.max_retries + 1):
             t0 = time.perf_counter()
             try:
@@ -85,7 +126,10 @@ class BaseParameterClient(abc.ABC):
                 if (isinstance(err, urllib.error.HTTPError)
                         and err.code < 500):
                     raise
-                pause = self.backoff * (2 ** attempt)
+                # decorrelated jitter, not base * 2**attempt: a fleet of
+                # subscribers that all lost the same shard must not
+                # retry in lockstep and stampede the promoted standby
+                pause = _retry_pause(pause, self.backoff)
                 if (attempt == self.max_retries
                         or time.monotonic() + pause > deadline):
                     failures.inc()
@@ -147,13 +191,17 @@ class BaseParameterClient(abc.ABC):
         arrays, kind = self._delta_frame(delta)
         return self.push_frame(arrays, kind)
 
-    def push_frame(self, arrays: List[np.ndarray], kind: int):
+    def push_frame(self, arrays: List[np.ndarray], kind: int,
+                   update_id: Optional[str] = None):
         """Send an already-built update frame (``KIND_DELTA`` or
         ``KIND_DELTA_Q8`` arrays). Workers carrying error feedback call
         this with the frame :class:`ErrorFeedback` already built, so a
-        compressed push quantizes exactly once. Not abstract: custom
-        clients that only override ``update_parameters`` (e.g. in-memory
-        test doubles without compression) never need it."""
+        compressed push quantizes exactly once. ``update_id`` lets a
+        coordinator name the push (the sharded client's legacy path
+        sends ONE id to every shard so the per-shard generation digests
+        stay equal); ``None`` mints a fresh id per call. Not abstract:
+        custom clients that only override ``update_parameters`` (e.g.
+        in-memory test doubles without compression) never need it."""
         raise NotImplementedError(
             f"{type(self).__name__} does not implement push_frame")
 
@@ -178,6 +226,58 @@ class BaseParameterClient(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not implement "
             "get_parameters_versioned")
+
+    # ------------------------------------------ two-phase commit extension
+    def prepare_frame(self, arrays: List[np.ndarray], kind: int,
+                      txn_id: str):
+        """Phase one of an atomic cross-shard push: the server STAGES
+        the delta under ``txn_id`` (validated, copied, TTL-bounded) but
+        does not apply it. Transports without the extension raise
+        ``NotImplementedError`` — the sharded client falls back to the
+        legacy single-phase push."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement prepare_frame")
+
+    def commit_txn(self, txn_id: str):
+        """Phase two: apply the staged delta. Returns ``(generation,
+        version)`` after the apply. Idempotent — committing an
+        already-committed id re-acks with the current counters.
+        Raises :class:`UnknownTxnError` when the server has never seen
+        the id (a failed-over shard: re-prepare and commit again)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement commit_txn")
+
+    def abort_txn(self, txn_id: str):
+        """Drop a staged delta (no-op for unknown ids — abort is the
+        best-effort cleanup fan-out after a prepare failure)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement abort_txn")
+
+    # ----------------------------------------- replication / generation
+    def replicate_frame(self, arrays: List[np.ndarray], kind: int,
+                        update_id: str, epoch: int):
+        """Forward one APPLIED delta to a standby (the primary's
+        replication stream). Deduplicated by ``update_id`` like any
+        retried push; ``epoch`` is the sender's fencing epoch — a
+        receiver that has failed over past it raises
+        :class:`FencedEpochError` (terminal, never retried)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement replicate_frame")
+
+    def get_generation(self):
+        """``(generation, digest)`` — the count of applied updates and
+        the order-independent digest of their ids. Equal pairs across
+        shards certify the same SET of updates landed everywhere."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement get_generation")
+
+    def get_parameters_generational(self):
+        """``((generation, digest), version, weights)`` read as one
+        consistent triple — the generation-coherent pull live-weight
+        subscribers use against sharded planes."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement "
+            "get_parameters_generational")
 
     @abc.abstractmethod
     def health_check(self) -> bool:
@@ -261,14 +361,16 @@ class HttpClient(BaseParameterClient):
                 return version, decode_weights(response.read())
         return self._with_retry(op, "get_parameters")
 
-    def push_frame(self, arrays: List[np.ndarray], kind: int):
+    def push_frame(self, arrays: List[np.ndarray], kind: int,
+                   update_id: Optional[str] = None):
         # the encoder's bytearray goes to urllib as-is — bytes-like with
         # a len() for Content-Length; a bytes() round would re-copy the
         # whole frame per push
         payload = encode(arrays, kind)
         # one id per logical update, stable across retries: the server
         # drops duplicates so a lost ack can't double-apply the delta
-        update_id = uuid.uuid4().hex
+        if update_id is None:
+            update_id = uuid.uuid4().hex
 
         def op():
             if fault_site("client.update_parameters"):
@@ -286,6 +388,108 @@ class HttpClient(BaseParameterClient):
                 raise InjectedFault("push ack dropped")
             return body
         return self._with_retry(op, "update_parameters")
+
+    def prepare_frame(self, arrays: List[np.ndarray], kind: int,
+                      txn_id: str):
+        payload = encode(arrays, kind)
+
+        def op():
+            if fault_site("client.prepare"):
+                raise InjectedFault("prepare request dropped")
+            headers = dict(self._headers(), **{"X-Txn-Id": txn_id})
+            request = urllib.request.Request(
+                f"http://{self.master_url}/prepare", payload,
+                headers=headers)
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                return response.read()
+        return self._with_retry(op, "prepare")
+
+    def commit_txn(self, txn_id: str):
+        def op():
+            if fault_site("client.commit"):
+                raise InjectedFault("commit request dropped")
+            headers = dict(self._headers(), **{"X-Txn-Id": txn_id})
+            request = urllib.request.Request(
+                f"http://{self.master_url}/commit", b"", headers=headers)
+            try:
+                with urllib.request.urlopen(
+                        request, timeout=self.timeout) as response:
+                    import json
+
+                    body = json.loads(response.read())
+                    return int(body["generation"]), int(body["version"])
+            except urllib.error.HTTPError as err:
+                if err.code == 404:
+                    # the route exists; 404 here means the txn id —
+                    # staged on a server that has since failed over —
+                    # is unknown. Typed so the sharded client can
+                    # re-prepare instead of retrying a lost cause.
+                    raise UnknownTxnError(txn_id) from err
+                raise
+        return self._with_retry(op, "commit")
+
+    def abort_txn(self, txn_id: str):
+        def op():
+            headers = dict(self._headers(), **{"X-Txn-Id": txn_id})
+            request = urllib.request.Request(
+                f"http://{self.master_url}/abort", b"", headers=headers)
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                return response.read()
+        return self._with_retry(op, "abort")
+
+    def replicate_frame(self, arrays: List[np.ndarray], kind: int,
+                        update_id: str, epoch: int):
+        payload = encode(arrays, kind)
+
+        def op():
+            headers = dict(self._headers(),
+                           **{"X-Update-Id": update_id,
+                              "X-Replication-Epoch": str(int(epoch))})
+            request = urllib.request.Request(
+                f"http://{self.master_url}/replicate", payload,
+                headers=headers)
+            try:
+                with urllib.request.urlopen(
+                        request, timeout=self.timeout) as response:
+                    return response.read()
+            except urllib.error.HTTPError as err:
+                if err.code == 409:
+                    raise FencedEpochError(
+                        f"epoch {epoch} fenced by the standby") from err
+                raise
+        return self._with_retry(op, "replicate")
+
+    def get_generation(self):
+        def op():
+            request = urllib.request.Request(
+                f"http://{self.master_url}/version",
+                headers=self._headers())
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                import json
+
+                body = json.loads(response.read())
+                return int(body["generation"]), int(body["digest"])
+        return self._with_retry(op, "get_generation")
+
+    def get_parameters_generational(self):
+        def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
+            request = urllib.request.Request(
+                f"http://{self.master_url}/parameters",
+                headers=self._headers())
+            with urllib.request.urlopen(request,
+                                        timeout=self.timeout) as response:
+                version = int(response.headers.get(
+                    "X-Weights-Version", -1))
+                gen = int(response.headers.get("X-Weights-Generation", -1))
+                digest = int(response.headers.get("X-Weights-Digest", 0))
+                return (gen, digest), version, decode_weights(
+                    response.read())
+        return self._with_retry(op, "get_parameters")
 
     def health_check(self) -> bool:
         try:
@@ -426,15 +630,18 @@ class SocketClient(BaseParameterClient):
             return self._run_op(rpc)
         return self._with_retry(op, "get_parameters")
 
-    def push_frame(self, arrays: List[np.ndarray], kind: int):
-        update_id = uuid.uuid4().hex.encode("ascii")  # stable across retries
+    def push_frame(self, arrays: List[np.ndarray], kind: int,
+                   update_id: Optional[str] = None):
+        # stable across retries (and, when the sharded client supplies
+        # it, identical across shards so generation digests stay equal)
+        uid = (update_id or uuid.uuid4().hex).encode("ascii")
 
         def op():
             if fault_site("client.update_parameters"):
                 raise InjectedFault("push request dropped")
 
             def rpc(sock):
-                sock.sendall(b"U" + update_id)
+                sock.sendall(b"U" + uid)
                 send(sock, arrays, kind=kind)
                 # hardened fixed-length read: a half-closed peer raises
                 # ConnectionError (retried) instead of returning b""
@@ -455,6 +662,103 @@ class SocketClient(BaseParameterClient):
                                           "acknowledge the update")
             return self._run_op(rpc)
         return self._with_retry(op, "update_parameters")
+
+    @staticmethod
+    def _check_ack(ack: bytes, what: str):
+        if ack == b"e":
+            raise ValueError(f"parameter server rejected the {what} "
+                             "(mismatched array count or shapes)")
+        if ack != b"k":
+            raise ConnectionError(
+                f"parameter server did not acknowledge the {what}")
+
+    def prepare_frame(self, arrays: List[np.ndarray], kind: int,
+                      txn_id: str):
+        txn = txn_id.encode("ascii")
+
+        def op():
+            if fault_site("client.prepare"):
+                raise InjectedFault("prepare request dropped")
+
+            def rpc(sock):
+                sock.sendall(PS_PREPARE_OPCODE + txn)
+                send(sock, arrays, kind=kind)
+                self._check_ack(bytes(recv_exact(sock, 1)), "prepare")
+            return self._run_op(rpc)
+        return self._with_retry(op, "prepare")
+
+    def commit_txn(self, txn_id: str):
+        txn = txn_id.encode("ascii")
+
+        def op():
+            if fault_site("client.commit"):
+                raise InjectedFault("commit request dropped")
+
+            def rpc(sock):
+                sock.sendall(PS_COMMIT_OPCODE + txn)
+                status = bytes(recv_exact(sock, 1))
+                if status == b"n":
+                    # typed, not retried: the staged delta died with
+                    # the old primary — re-prepare against the standby
+                    raise UnknownTxnError(txn_id)
+                self._check_ack(status, "commit")
+                generation = recv_u64(sock)
+                recv_u64(sock)          # digest rides for parity; the
+                version = recv_u64(sock)  # commit caller needs gen+version
+                return generation, version
+            return self._run_op(rpc)
+        return self._with_retry(op, "commit")
+
+    def abort_txn(self, txn_id: str):
+        txn = txn_id.encode("ascii")
+
+        def op():
+            def rpc(sock):
+                sock.sendall(PS_ABORT_OPCODE + txn)
+                self._check_ack(bytes(recv_exact(sock, 1)), "abort")
+            return self._run_op(rpc)
+        return self._with_retry(op, "abort")
+
+    def replicate_frame(self, arrays: List[np.ndarray], kind: int,
+                        update_id: str, epoch: int):
+        uid = update_id.encode("ascii")
+
+        def op():
+            def rpc(sock):
+                sock.sendall(PS_REPLICATE_OPCODE
+                             + int(epoch).to_bytes(8, "big") + uid)
+                send(sock, arrays, kind=kind)
+                ack = bytes(recv_exact(sock, 1))
+                if ack == b"f":
+                    raise FencedEpochError(
+                        f"epoch {epoch} fenced by the standby")
+                self._check_ack(ack, "replicated delta")
+            return self._run_op(rpc)
+        return self._with_retry(op, "replicate")
+
+    def get_generation(self):
+        def op():
+            def rpc(sock):
+                sock.sendall(PS_GEN_POLL_OPCODE)
+                return recv_u64(sock), recv_u64(sock)
+            return self._run_op(rpc)
+        return self._with_retry(op, "get_generation")
+
+    def get_parameters_generational(self):
+        def op():
+            if fault_site("client.get_parameters"):
+                raise InjectedFault("pull request dropped")
+
+            def rpc(sock):
+                # the server reads (generation, digest, version,
+                # payload) under one lock — a consistent quadruple
+                sock.sendall(PS_GEN_PULL_OPCODE)
+                gen = recv_u64(sock)
+                digest = recv_u64(sock)
+                version = recv_u64(sock)
+                return (gen, digest), version, receive(sock, copy=False)
+            return self._run_op(rpc)
+        return self._with_retry(op, "get_parameters")
 
     def health_check(self) -> bool:
         # deliberately a fresh short-timeout connection: the probe must

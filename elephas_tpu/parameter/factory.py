@@ -54,13 +54,21 @@ register_transport("socket", SocketClient, SocketServer)
 
 
 def create_sharded_server(name: str, model, port: int, mode: str,
-                          num_shards: int, **kwargs):
+                          num_shards: int, standby: bool = False,
+                          **kwargs):
     """A parameter plane of ``num_shards`` servers of transport ``name``
     on consecutive ports ``port .. port+num_shards-1``.
 
+    ``standby=True`` arms one warm standby per shard (ports
+    ``port+N .. port+2N-1``, fed by the primary's applied-delta stream)
+    so supervision can fail over with zero applied-update loss instead
+    of restarting from a snapshot — see
+    :mod:`~elephas_tpu.parameter.replication`.
+
     ``num_shards=1`` returns an ordinary single server (no group
-    wrapper, no behavior change) — callers can pass the configured
-    shard count straight through.
+    wrapper, no behavior change; ``standby`` needs the group's
+    supervision hooks, so it requires ``num_shards >= 2``) — callers
+    can pass the configured shard count straight through.
     """
     transport = get_transport(name)
     if int(num_shards) <= 1:
@@ -68,18 +76,22 @@ def create_sharded_server(name: str, model, port: int, mode: str,
     from .sharding import ShardedServerGroup
 
     return ShardedServerGroup(transport, model, port, mode, num_shards,
-                              **kwargs)
+                              standby=standby, **kwargs)
 
 
 def create_sharded_client(name: str, port: int, model, num_shards: int,
-                          compression=None, **kwargs):
+                          compression=None, two_phase: bool = True,
+                          **kwargs):
     """The matching client: a plain transport client for one shard, a
     :class:`~elephas_tpu.parameter.sharding.ShardedParameterClient`
     (per-shard sub-clients, parallel fan-out) otherwise.
 
     ``model`` supplies the weight list (or shapes) the shard plan is
     derived from — the plan is deterministic, so client and server
-    agree without exchanging it.
+    agree without exchanging it. ``two_phase=False`` opts a sharded
+    client out of atomic cross-shard commits (the legacy single-phase
+    push and its documented torn trade); ignored for one shard, where
+    a push is trivially atomic.
     """
     transport = get_transport(name)
     if int(num_shards) <= 1:
@@ -90,7 +102,8 @@ def create_sharded_client(name: str, port: int, model, num_shards: int,
     plan = ShardPlan.plan(model["weights"], num_shards)
     clients = [transport.create_client(port + i, **kwargs)
                for i in range(plan.num_shards)]
-    return ShardedParameterClient(clients, plan, compression=compression)
+    return ShardedParameterClient(clients, plan, compression=compression,
+                                  two_phase=two_phase)
 
 
 class ClientServerFactory:

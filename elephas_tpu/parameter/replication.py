@@ -1,0 +1,302 @@
+"""Hot-standby replication and failover for the parameter plane.
+
+Snapshot-restart recovery (:meth:`~elephas_tpu.tpu_model.TPUModel.
+_ps_supervision`) silently loses every delta applied since the last
+snapshot — the one remaining single-point-of-data-loss in the
+train-to-serve loop. This module closes it:
+
+- :class:`ShardReplicator` rides a primary server's applied-delta hook
+  and forwards EVERY applied delta to a warm standby over the ordinary
+  transport (new ``replicate`` RPC), deduplicated by the same 32-byte
+  update ids client retries use. Replication is synchronous while the
+  standby is healthy — an acked push is already on the standby when the
+  ack leaves — and degrades to a bounded catch-up backlog when the
+  standby flaps (``ps_replication_lag_updates`` is the backlog depth).
+- :class:`ShardStandby` owns one shard's standby server (built from the
+  primary's snapshot, so counters and the idempotency window carry
+  over) plus the replicator feeding it, and implements
+  :meth:`ShardStandby.promote`: rebuild the standby's CURRENT state as
+  a new primary on the dead primary's port — zero applied-update loss —
+  with the shard's **fencing epoch** bumped, so late replication
+  traffic from a zombie predecessor (declared dead, still running) is
+  rejected (:class:`~elephas_tpu.parameter.client.FencedEpochError`)
+  instead of corrupting the new timeline.
+
+Orchestration (which shard gets a standby, when to promote, re-arming a
+fresh standby behind the promoted primary) lives in
+:class:`~elephas_tpu.parameter.sharding.ShardedServerGroup` /
+``_sharded_ps_supervision``; this module is the per-shard machinery.
+"""
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs.events import emit as emit_event
+from ..obs.metrics import default_registry
+from ..utils.tensor_codec import KIND_DELTA
+from .client import BaseParameterClient, FencedEpochError
+
+_LOG = logging.getLogger(__name__)
+
+__all__ = ["ShardReplicator", "ShardStandby"]
+
+
+class ShardReplicator:
+    """Forwards a primary's applied deltas to its standby.
+
+    Attaches to ``primary.set_applied_hook``; each hook call tries a
+    SYNCHRONOUS ``replicate_frame`` first (sub-millisecond on loopback,
+    and the reason a promoted standby is bit-identical: the ack the
+    pusher saw implies the standby holds the delta). On failure the
+    delta is COPIED onto a bounded backlog and a background thread
+    retries in order — resends carry the original update ids, so the
+    standby's idempotency window makes catch-up safe. A
+    :class:`FencedEpochError` from the standby means THIS primary has
+    been failed over (it is the zombie): the replicator stops
+    permanently and drops its backlog.
+    """
+
+    #: backlog bound: a standby that stays dark longer than this many
+    #: parked deltas stops accumulating (oldest kept — they are the
+    #: ones the standby is missing first) and the shard is flagged
+    #: degraded, steering promotion back to the snapshot fallback
+    MAX_BACKLOG = 256
+
+    def __init__(self, primary, standby_client: BaseParameterClient,
+                 shard: str = "0"):
+        self.primary = primary
+        self.client = standby_client
+        self.shard = str(shard)
+        self.fenced = False
+        self.degraded = False
+        self._backlog: List[tuple] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        reg = default_registry()
+        self._g_lag = reg.gauge(
+            "ps_replication_lag_updates",
+            "applied deltas acked by the primary but not yet on its "
+            "standby (catch-up backlog depth)",
+            labels=("shard",)).labels(shard=self.shard)
+        self._m_pushes = reg.counter(
+            "ps_replication_pushes_total",
+            "deltas forwarded primary -> standby, by outcome",
+            labels=("shard", "status"))
+        self._thread = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name=f"elephas-tpu-ps-replica-{self.shard}")
+        self._thread.start()
+        primary.set_applied_hook(self._on_applied)
+
+    # ------------------------------------------------------------- hook
+    def _on_applied(self, update_id: str, delta):
+        if self.fenced or self._stop.is_set():
+            return
+        with self._lock:
+            backlogged = bool(self._backlog)
+        if not backlogged:
+            try:
+                self.client.replicate_frame(delta, KIND_DELTA, update_id,
+                                            self.primary.epoch)
+                self._m_pushes.labels(shard=self.shard,
+                                      status="ok").inc()
+                return
+            except FencedEpochError:
+                self._fence()
+                return
+            except Exception:  # noqa: BLE001 — park and catch up
+                pass
+        # the hook's delta arrays are views of the request's receive
+        # buffer — copy before the frame dies
+        with self._lock:
+            if len(self._backlog) < self.MAX_BACKLOG:
+                self._backlog.append(
+                    (update_id, [np.array(d, dtype=np.float32, copy=True)
+                                 for d in delta]))
+                self._m_pushes.labels(shard=self.shard,
+                                      status="parked").inc()
+            else:
+                self.degraded = True  # standby can no longer catch up
+                self._m_pushes.labels(shard=self.shard,
+                                      status="dropped").inc()
+            self._g_lag.set(float(len(self._backlog)))
+        self._wake.set()
+
+    def kick(self):
+        """Nudge the catch-up thread (the standby just came up)."""
+        self._wake.set()
+
+    def _fence(self):
+        self.fenced = True
+        with self._lock:
+            self._backlog.clear()
+            self._g_lag.set(0.0)
+        self._m_pushes.labels(shard=self.shard, status="fenced").inc()
+        _LOG.warning("replicator for shard %s fenced: this primary was "
+                     "failed over", self.shard)
+
+    # ------------------------------------------------------- catch-up
+    def _drain_loop(self):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.25)
+            self._wake.clear()
+            while not (self._stop.is_set() or self.fenced):
+                with self._lock:
+                    if not self._backlog:
+                        break
+                    update_id, delta = self._backlog[0]
+                try:
+                    self.client.replicate_frame(delta, KIND_DELTA,
+                                                update_id,
+                                                self.primary.epoch)
+                except FencedEpochError:
+                    self._fence()
+                    return
+                except Exception:  # noqa: BLE001 — standby still down
+                    time.sleep(0.1)
+                    continue
+                with self._lock:
+                    # head unchanged by construction: this thread is the
+                    # only consumer and _on_applied only appends
+                    self._backlog.pop(0)
+                    self._g_lag.set(float(len(self._backlog)))
+                self._m_pushes.labels(shard=self.shard,
+                                      status="caught_up").inc()
+
+    # ------------------------------------------------------------ admin
+    @property
+    def lag(self) -> int:
+        with self._lock:
+            return len(self._backlog)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until the backlog drains (True) or ``timeout`` passes
+        (False) — promotion calls this so a flapped-then-recovered
+        standby is fully caught up before it takes over."""
+        deadline = time.monotonic() + timeout
+        self._wake.set()
+        while time.monotonic() < deadline:
+            if self.fenced:
+                return False
+            with self._lock:
+                if not self._backlog:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self):
+        """Detach from the primary and stop the catch-up thread (the
+        client is the caller's to close — ShardStandby owns it)."""
+        try:
+            if self.primary._applied_hook == self._on_applied:
+                self.primary.set_applied_hook(None)
+        except Exception:  # noqa: BLE001 — primary may be half-dead
+            pass
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+
+class ShardStandby:
+    """One shard's warm standby: a full parameter server (same
+    transport, its own port) primed from the primary's snapshot, fed by
+    a :class:`ShardReplicator`, promotable in place of a dead primary.
+    """
+
+    def __init__(self, transport, primary, port: int, mode: str,
+                 shard_index: int, shard_model: Dict[str, Any],
+                 **kwargs):
+        self.transport = transport
+        self.port = int(port)
+        self.mode = mode
+        self.shard_index = int(shard_index)
+        self.shard_model = shard_model
+        self.kwargs = dict(kwargs)
+        # ORDER MATTERS when the primary is live (re-arming behind a
+        # promoted/restarted server): the replicator attaches FIRST, so
+        # a delta applied while the standby is still being built parks
+        # on the backlog (its sync send finds nothing listening yet)
+        # instead of vanishing into the snapshot/hook gap; then the
+        # snapshot is taken and the standby built from it. A delta
+        # captured by BOTH (in the snapshot and on the backlog) is
+        # deduplicated by the standby's idempotency window, which rides
+        # the snapshot — so the pair cannot diverge in either
+        # direction. Fail-fast client (no retries): replication must
+        # park and catch up, not stall the primary's push ack behind a
+        # retry ladder.
+        client = transport.create_client(self.port, timeout=5.0,
+                                         max_retries=0, deadline=5.0)
+        self.replicator = ShardReplicator(primary, client,
+                                          shard=str(shard_index))
+        snapshot = primary.snapshot()
+        self.server = transport.create_server(
+            {"model": shard_model.get("model"),
+             "weights": snapshot["weights"]},
+            self.port, mode, shard=shard_index, **self.kwargs)
+        self.server.restore(snapshot)
+        self.server.start()
+        self.replicator.kick()     # drain anything parked while building
+
+    def healthy(self) -> bool:
+        """Promotable: the standby answers its probe, the replicator
+        never overflowed (``degraded`` means acked deltas were dropped
+        — a snapshot restart is no worse then), and it was not fenced
+        off by a newer timeline."""
+        if self.replicator.fenced or self.replicator.degraded:
+            return False
+        return self.replicator.client.health_check()
+
+    def promote(self, primary_port: int):
+        """Zero-loss failover: drain the catch-up backlog, then rebuild
+        the standby's CURRENT state as a new primary on
+        ``primary_port`` with the fencing epoch bumped. Returns the new
+        primary server (started), or ``None`` when the backlog would
+        not drain — promoting with acked deltas still parked would
+        silently break the zero-loss claim AND leave this shard's
+        generation digest diverged from its siblings forever, whereas
+        the snapshot fallback realigns generations explicitly. The
+        standby server itself is stopped — its port hosts the NEXT
+        standby the group re-arms."""
+        # drain FIRST (the catch-up thread is still alive), then detach
+        # from the (dead or zombie) primary
+        if not self.replicator.flush(timeout=5.0):
+            self.replicator.degraded = True
+            emit_event("ps.promotion_declined", shard=self.shard_index,
+                       backlog=self.replicator.lag,
+                       fenced=self.replicator.fenced)
+            _LOG.warning(
+                "shard %d standby declined promotion: %d acked deltas "
+                "still parked after the flush window (falling back to "
+                "snapshot restart)", self.shard_index,
+                self.replicator.lag)
+            return None
+        self.replicator.stop()
+        snapshot = self.server.snapshot()
+        new_epoch = int(snapshot.get("epoch", 0)) + 1
+        server = self.transport.create_server(
+            {"model": self.shard_model.get("model"),
+             "weights": snapshot["weights"]},
+            int(primary_port), self.mode, shard=self.shard_index,
+            epoch=new_epoch, **self.kwargs)
+        server.restore(snapshot)
+        with server._counter_lock:
+            server.epoch = new_epoch    # restore only ratchets; pin it
+        server.start()
+        self.stop(stop_replicator=False)
+        return server
+
+    def stop(self, stop_replicator: bool = True):
+        if stop_replicator:
+            self.replicator.stop()
+        try:
+            self.replicator.client.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.server.stop()
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass

@@ -39,17 +39,51 @@ pulls/pushes out over per-shard persistent connections on parallel
 threads and reassembles results in plan order, over either transport.
 
 Consistency: each shard applies a worker's delta atomically under its
-own lock, but there is no cross-shard transaction — a concurrent pull
-may observe shard A before a given push and shard B after it, and a
-push whose retries exhaust on one shard after siblings applied lands
-TORN (that shard's slice lost; for async SGD one partial gradient, the
-same class of perturbation as a lost delta — emitted as a
-``ps.sharded_push_torn`` event, and the lagging shard drags the
-group-min ``num_updates`` progress signal). That is the standard
-sharded-PS trade (Li et al., OSDI 2014), and no weaker than the
-staleness asynchronous SGD already tolerates. Supervision is per
-shard: a dead shard is rebuilt from its own snapshot on its own port
+own lock, and a sharded push is a **two-phase cross-shard commit** by
+default: every shard first STAGES the delta (``prepare``, validated
+but not applied), and only when every shard has staged does the client
+fan out ``commit`` — any prepare failure aborts all shards, so a push
+either lands everywhere or nowhere (``ps.commit_aborted`` event +
+``ps_commit_aborts_total``; the pre-2PC torn-push failure mode —
+``ps.sharded_push_torn`` — cannot occur on this path). Each committed
+push advances a monotonically increasing **generation id** (count of
+committed updates, paired with an order-independent digest of their
+ids), returned to the pusher alongside the per-shard version tuple;
+equal (generation, digest) across shards certifies that every shard
+holds the same SET of committed updates, which is what live-weight
+subscribers check before staging a pull (generation coherence — see
+the live-weights guide). A concurrent pull may still observe shard A
+before a given push and shard B after it (the generation pair differs
+and the puller re-pulls the lagging shard), and the legacy
+single-phase path (``two_phase=False``, or sub-clients without the
+prepare extension) keeps the documented torn-push trade, now surfaced
+as a typed :class:`~elephas_tpu.parameter.sharding.TornPushError`
+carrying per-shard outcomes. Supervision is per shard: a dead shard
+promotes its hot standby when one is configured (zero applied-update
+loss), and is otherwise rebuilt from its own snapshot on its own port
 while the survivors keep serving (see the fault-tolerance guide).
+
+## Hot-standby replication and failover
+
+With ``ps_standby=True`` each shard runs a WARM STANDBY server
+(ports ``port+N .. port+2N-1``) that subscribes to its primary's
+applied-delta stream: every delta the primary applies is forwarded —
+synchronously when the standby is healthy, else parked on a bounded
+catch-up backlog (``ps_replication_lag_updates``) — and deduplicated
+by the same 32-byte update ids client retries use, so the standby's
+weights, generation, and update counters track the primary's exactly.
+On primary death, supervision PROMOTES the standby onto the primary's
+port instead of restarting from a snapshot: no applied update is lost,
+in-flight two-phase pushes re-prepare against the promoted server, and
+a fresh standby is re-armed behind the new primary. Every promotion
+bumps the shard's **fencing epoch**; replication traffic carrying an
+older epoch (a zombie primary that was declared dead but kept running)
+is rejected, so late writes from the old generation of the shard can
+never corrupt the new one. Snapshot-restart remains the fallback when
+no (healthy) standby exists — it loses post-snapshot deltas, so the
+restarted shard's generation marker is realigned to the surviving
+shards' (``ps.generation_realigned``) to keep the plane pullable; the
+loss is the documented pre-standby behavior.
 
 ## Live weight subscribers
 
@@ -76,15 +110,17 @@ overlapped device-resident schedule (``async_overlap=True``) already
 pipelines through its communicator thread and subsumes this flag.
 """
 import abc
+import hashlib
 import logging
 import selectors
 import socket
 import struct
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -97,10 +133,30 @@ from ..obs.metrics import default_registry
 from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
-from ..utils.sockets import (TRACE_OPCODE, determine_master, receive_frame,
-                             receive_traceparent, recv_exact, send_payload)
+from ..utils.sockets import (PS_ABORT_OPCODE, PS_COMMIT_OPCODE,
+                             PS_GEN_POLL_OPCODE, PS_GEN_PULL_OPCODE,
+                             PS_ID_BYTES, PS_PREPARE_OPCODE,
+                             PS_REPLICATE_OPCODE, TRACE_OPCODE,
+                             determine_master, receive_frame,
+                             receive_traceparent, recv_exact, recv_u64,
+                             send_payload)
 from ..utils.delta_compression import dequantize_delta
 from ..utils.tensor_codec import KIND_DELTA_Q8, decode, encode_weights
+from .client import FencedEpochError, UnknownTxnError
+
+
+def _id_digest(update_id: str) -> int:
+    """8-byte blake2b of an update id as an int. Per-server generation
+    digests SUM these mod 2**64 — addition commutes, so two shards that
+    applied the same SET of updates in different interleavings still
+    agree, and a missing/extra update disagrees with overwhelming
+    probability."""
+    return int.from_bytes(
+        hashlib.blake2b(update_id.encode("ascii", "replace"),
+                        digest_size=8).digest(), "big")
+
+
+_DIGEST_MOD = 1 << 64
 
 
 def _decode_delta(payload: bytes):
@@ -161,6 +217,31 @@ class BaseParameterServer(abc.ABC):
         # waits on the latch instead of racing past the _seen_ids check
         # and double-applying the delta
         self._in_flight: Dict[str, threading.Event] = {}
+        # -------- fault-tolerant-plane state (2PC / replication) --------
+        #: generation id: committed/applied update count. Monotonic on a
+        #: live server and carried across standby promotion; equal
+        #: across shards exactly when every push landed everywhere.
+        self.generation = 0
+        #: order-independent companion to ``generation``: sum (mod 2^64)
+        #: of the applied update ids' 8-byte digests. Two shards whose
+        #: (generation, digest) pairs match hold the same SET of
+        #: updates, regardless of apply interleaving.
+        self.gen_digest = 0
+        #: fencing epoch: bumped by every standby promotion. Replication
+        #: traffic from an older epoch (a zombie primary) is rejected.
+        self.epoch = int(kwargs.get("epoch", 0))
+        # two-phase-commit staging area: txn id -> (delta copies,
+        # staged-at monotonic time). Prepared deltas that never commit
+        # (a dead coordinator) are swept after STAGE_TTL.
+        self._staged: "OrderedDict[str, tuple]" = OrderedDict()
+        self._staged_lock = threading.Lock()
+        #: applied-delta hook — a :class:`~elephas_tpu.parameter.
+        #: replication.ShardReplicator` attaches here; called as
+        #: ``hook(update_id, delta)`` AFTER a successful apply, outside
+        #: the weight lock, while the delta arrays are still valid
+        #: (the hook must copy or ship before returning). Exceptions
+        #: are the hook's problem — they must never fail the ack.
+        self._applied_hook: Optional[Callable] = None
         # parameter-plane RPC metrics live in the PROCESS default
         # registry (labeled by transport/op): every PS in the process
         # pools into one scrape surface, exposed via the HTTP server's
@@ -246,15 +327,26 @@ class BaseParameterServer(abc.ABC):
         CONSISTENT (a live-weight subscriber stamps its pulled params
         with this version; a racing delta simply shows up as the next
         poll's version change)."""
+        gen, digest, version, payload = self.encoded_weights_generational()
+        return version, payload
+
+    def encoded_weights_generational(self):
+        """``(generation, digest, version, payload)`` — the generation
+        pair rides the same consistent read the versioned pull uses, so
+        a cross-shard coherence check compares states that actually
+        correspond to the served payloads."""
         fault_site("ps.get_weights")
         with self._enc_lock:
             if self.mode == "asynchronous":
                 self.lock.acquire_read()
             try:
-                version = self._weights_version
+                with self._counter_lock:
+                    version = self._weights_version
+                    gen = self.generation
+                    digest = self.gen_digest
                 if (self._enc_cache is not None
                         and self._enc_cache[0] == version):
-                    return version, self._enc_cache[1]
+                    return gen, digest, version, self._enc_cache[1]
                 # the encoder's bytearray is served as-is (bytes-like for
                 # sendall/HTTP): nothing mutates it after this point —
                 # invalidation REPLACES the cache tuple — and a bytes()
@@ -265,7 +357,7 @@ class BaseParameterServer(abc.ABC):
                 if self.mode == "asynchronous":
                     self.lock.release()
             self._enc_cache = (version, payload)
-            return version, payload
+            return gen, digest, version, payload
 
     def snapshot(self) -> Dict[str, Any]:
         """Restartable server state: weights, the applied-update counter,
@@ -286,9 +378,14 @@ class BaseParameterServer(abc.ABC):
         with self._counter_lock:
             num_updates = self.num_updates
             weights_version = self._weights_version
+            generation = self.generation
+            gen_digest = self.gen_digest
+            epoch = self.epoch
         weights = self.get_weights()  # honors the mode's locking policy
         return {"weights": weights, "num_updates": num_updates,
-                "weights_version": weights_version, "seen_ids": seen}
+                "weights_version": weights_version, "seen_ids": seen,
+                "generation": generation, "gen_digest": gen_digest,
+                "epoch": epoch}
 
     #: version jump applied by :meth:`restore` when the snapshot's
     #: version is AT OR ABOVE this server's own — the restart-recovery
@@ -329,6 +426,15 @@ class BaseParameterServer(abc.ABC):
                     # everything we ever served; one bump (also drops
                     # the cached encoding)
                     self._weights_version += 1
+                # the generation marker travels WITH the weights it
+                # describes (no jump: cross-shard coherence compares
+                # these, and a promoted standby must continue its dead
+                # primary's trajectory exactly); the fencing epoch only
+                # ever ratchets up
+                self.generation = int(snapshot.get("generation", 0))
+                self.gen_digest = int(snapshot.get("gen_digest", 0))
+                self.epoch = max(self.epoch,
+                                 int(snapshot.get("epoch", 0)))
         finally:
             if self.mode == "asynchronous":
                 self.lock.release()
@@ -337,13 +443,11 @@ class BaseParameterServer(abc.ABC):
         with self._seen_lock:
             self._seen_ids = OrderedDict(snapshot.get("seen_ids", ()))
 
-    def apply_delta(self, delta: List[np.ndarray],
-                    update_id: Optional[str] = None):
-        if fault_site("ps.apply_delta"):
-            return  # drop: the delta is silently lost (still acked)
-        # validate BEFORE applying: subtract_params zips the lists, so a
-        # short or mis-shaped delta would silently truncate/corrupt the
-        # served weights for every client until restart
+    def _validate_delta(self, delta: List[np.ndarray]):
+        """Arity/shape gate shared by apply and prepare: subtract_params
+        zips the lists, so a short or mis-shaped delta would silently
+        truncate/corrupt the served weights for every client until
+        restart — validate BEFORE touching anything."""
         if len(delta) != len(self.weights):
             raise ValueError(
                 f"delta has {len(delta)} arrays, model has "
@@ -353,21 +457,33 @@ class BaseParameterServer(abc.ABC):
                 raise ValueError(
                     f"delta[{i}] shape {np.shape(d)} != weight shape "
                     f"{np.shape(w)}")
-        if update_id is not None:
-            # claim the id before applying. A duplicate of a completed
-            # apply returns immediately; a duplicate of an IN-FLIGHT apply
-            # waits on its latch and re-checks — it must neither double-
-            # apply nor ack before the first apply has actually landed.
-            while True:
-                with self._seen_lock:
-                    if update_id in self._seen_ids:
-                        return  # duplicate resend from a client retry
-                    latch = self._in_flight.get(update_id)
-                    if latch is None:
-                        latch = threading.Event()
-                        self._in_flight[update_id] = latch
-                        break  # we own the apply for this id
-                latch.wait(timeout=60.0)
+
+    def apply_delta(self, delta: List[np.ndarray],
+                    update_id: Optional[str] = None):
+        if fault_site("ps.apply_delta"):
+            return  # drop: the delta is silently lost (still acked)
+        self._validate_delta(delta)
+        if update_id is None:
+            # mint one: the generation digest and the replication stream
+            # both need a stable identity for EVERY applied delta, so an
+            # anonymous (legacy 'u'/no-header) push gets a server-side id
+            # — dedup semantics for the client are unchanged (it never
+            # knows the id, so it can never resend it)
+            update_id = uuid.uuid4().hex
+        # claim the id before applying. A duplicate of a completed
+        # apply returns immediately; a duplicate of an IN-FLIGHT apply
+        # waits on its latch and re-checks — it must neither double-
+        # apply nor ack before the first apply has actually landed.
+        while True:
+            with self._seen_lock:
+                if update_id in self._seen_ids:
+                    return  # duplicate resend from a client retry
+                latch = self._in_flight.get(update_id)
+                if latch is None:
+                    latch = threading.Event()
+                    self._in_flight[update_id] = latch
+                    break  # we own the apply for this id
+            latch.wait(timeout=60.0)
         try:
             if self.mode == "asynchronous":
                 self.lock.acquire_write()
@@ -378,31 +494,132 @@ class BaseParameterServer(abc.ABC):
                 # would leave the cache serving stale weights forever)
                 with self._counter_lock:
                     self._weights_version += 1
+                    self.generation += 1
+                    self.gen_digest = (self.gen_digest
+                                       + _id_digest(update_id)) % _DIGEST_MOD
             finally:
                 if self.mode == "asynchronous":
                     self.lock.release()
         except BaseException:
-            if update_id is not None:
-                # failed apply: release the claim WITHOUT recording the id,
-                # so the client's resend retries the apply instead of being
-                # acked for a delta that never landed
-                with self._seen_lock:
-                    self._in_flight.pop(update_id, None)
-                latch.set()
-            raise
-        if update_id is not None:
-            now = time.monotonic()
+            # failed apply: release the claim WITHOUT recording the id,
+            # so the client's resend retries the apply instead of being
+            # acked for a delta that never landed
             with self._seen_lock:
-                self._seen_ids[update_id] = now
                 self._in_flight.pop(update_id, None)
-                while self._seen_ids and (
-                        len(self._seen_ids) > self._seen_cap
-                        or next(iter(self._seen_ids.values()))
-                        < now - self._seen_ttl):
-                    self._seen_ids.popitem(last=False)
             latch.set()
+            raise
+        now = time.monotonic()
+        with self._seen_lock:
+            self._seen_ids[update_id] = now
+            self._in_flight.pop(update_id, None)
+            while self._seen_ids and (
+                    len(self._seen_ids) > self._seen_cap
+                    or next(iter(self._seen_ids.values()))
+                    < now - self._seen_ttl):
+                self._seen_ids.popitem(last=False)
+        latch.set()
         with self._counter_lock:
             self.num_updates += 1
+        hook = self._applied_hook
+        if hook is not None:
+            # outside every lock: the replicator may do wire I/O. The
+            # delta views are still valid (we are inside the handler's
+            # frame); hook failures must never fail the client's ack.
+            try:
+                hook(update_id, delta)
+            except Exception:  # noqa: BLE001 — replication is best-effort
+                _LOG.warning("applied-delta hook failed", exc_info=True)
+
+    # ------------------------------------------------ two-phase commit
+    #: staged-but-never-committed transactions are swept after this many
+    #: seconds (a coordinator that died between prepare and commit must
+    #: not leak its delta copies forever). Comfortably above the
+    #: client's worst-case retry horizon, so a slow commit cannot find
+    #: its stage swept.
+    STAGE_TTL = 600.0
+
+    def prepare_delta(self, delta: List[np.ndarray], txn_id: str):
+        """Phase one: validate and STAGE ``delta`` under ``txn_id``
+        without applying. The copies are deliberate — the caller's
+        arrays are zero-copy views of a receive buffer that dies with
+        the request, and the stage must survive until commit."""
+        self._validate_delta(delta)
+        staged = [np.array(d, dtype=np.float32, copy=True) for d in delta]
+        now = time.monotonic()
+        with self._staged_lock:
+            self._staged[txn_id] = (staged, now)
+            self._staged.move_to_end(txn_id)
+            while self._staged:
+                oldest = next(iter(self._staged))
+                if self._staged[oldest][1] >= now - self.STAGE_TTL:
+                    break
+                self._staged.popitem(last=False)
+
+    def commit_delta(self, txn_id: str):
+        """Phase two: apply the staged delta. Returns ``(generation,
+        digest, version)`` read after the apply. Idempotent: a retried
+        commit whose first attempt's ack was lost finds ``txn_id`` in
+        the idempotency window and re-acks with the current counters;
+        an id this server has NEVER seen (prepare landed on a dead
+        predecessor) raises :class:`UnknownTxnError` so the coordinator
+        re-prepares."""
+        with self._staged_lock:
+            staged = self._staged.pop(txn_id, None)
+        if staged is None:
+            with self._seen_lock:
+                known = txn_id in self._seen_ids
+            if not known:
+                raise UnknownTxnError(txn_id)
+        else:
+            self.apply_delta(staged[0], update_id=txn_id)
+        with self._counter_lock:
+            return self.generation, self.gen_digest, self._weights_version
+
+    def abort_delta(self, txn_id: str):
+        """Drop a staged delta. Unknown ids are a no-op: abort is the
+        best-effort cleanup fan-out after a prepare failure, and some
+        shards never staged anything."""
+        with self._staged_lock:
+            self._staged.pop(txn_id, None)
+
+    # ------------------------------------------ replication / fencing
+    def apply_replicated(self, delta: List[np.ndarray], update_id: str,
+                         epoch: int):
+        """Apply one delta from a primary's replication stream, fenced
+        by epoch: older-epoch traffic (a zombie primary that was failed
+        over) raises :class:`FencedEpochError`; a newer epoch is
+        adopted. Dedup by ``update_id`` rides the ordinary idempotency
+        window, so a catch-up resend after a reconnect is safe."""
+        epoch = int(epoch)
+        with self._counter_lock:
+            if epoch < self.epoch:
+                raise FencedEpochError(
+                    f"replication epoch {epoch} < fence {self.epoch}")
+            if epoch > self.epoch:
+                self.epoch = epoch
+        self.apply_delta(delta, update_id=update_id)
+
+    def set_applied_hook(self, hook: Optional[Callable]):
+        """Attach (or detach, with ``None``) the applied-delta hook the
+        replicator rides. One hook at a time — the parameter plane has
+        exactly one standby per shard."""
+        self._applied_hook = hook
+
+    def generation_info(self):
+        """``(generation, digest)`` under one lock — the coherent pair
+        cross-shard checks compare."""
+        with self._counter_lock:
+            return self.generation, self.gen_digest
+
+    def adopt_generation(self, generation: int, digest: int):
+        """Overwrite the generation marker — the snapshot-restart
+        fallback's realignment (the restarted shard LOST post-snapshot
+        deltas; adopting the surviving shards' marker keeps the plane
+        pullable, trading the documented lossy-restart semantics for a
+        coherence check that would otherwise veto pulls forever)."""
+        with self._counter_lock:
+            self.generation = int(generation)
+            self.gen_digest = int(digest)
 
     @abc.abstractmethod
     def start(self):
@@ -441,7 +658,8 @@ class HttpServer(BaseParameterServer):
                 if self.path.rstrip("/") in ("", "/"):
                     return "/"
                 for known in ("/health", "/metrics", "/parameters",
-                              "/update", "/version"):
+                              "/update", "/version", "/prepare",
+                              "/commit", "/abort", "/replicate"):
                     if self.path.startswith(known):
                         return known
                 return "other"
@@ -491,20 +709,31 @@ class HttpServer(BaseParameterServer):
                 elif self.path.startswith("/version"):
                     # the cheap "weights changed since v?" poll: live-
                     # weight subscribers hit this every poll interval
-                    # and only download /parameters when it moved
-                    body = (b'{"version": %d, "num_updates": %d}'
+                    # and only download /parameters when it moved; the
+                    # generation pair and fencing epoch ride along for
+                    # coherence checks and failover diagnostics
+                    gen, digest = server.generation_info()
+                    body = (b'{"version": %d, "num_updates": %d, '
+                            b'"generation": %d, "digest": %d, '
+                            b'"epoch": %d}'
                             % (server.weights_version,
-                               server.num_updates))
+                               server.num_updates, gen, digest,
+                               server.epoch))
                     content_type = "application/json"
                     server._obs_rpc("http", "get_version", "ok", t0)
                 elif self.path.startswith("/parameters"):
                     # cached encoded snapshot: no per-request encode (or
                     # weight copy) while the version is unchanged. The
-                    # version the payload encodes rides a header, so a
-                    # subscriber's (version, weights) pair is consistent
-                    # without a second racing RPC.
-                    version, body = server.encoded_weights_versioned()
-                    extra_headers = (("X-Weights-Version", str(version)),)
+                    # version AND generation the payload encodes ride
+                    # headers, so a subscriber's (generation, version,
+                    # weights) triple is consistent without a second
+                    # racing RPC.
+                    (gen, digest, version,
+                     body) = server.encoded_weights_generational()
+                    extra_headers = (
+                        ("X-Weights-Version", str(version)),
+                        ("X-Weights-Generation", str(gen)),
+                        ("X-Weights-Digest", str(digest)))
                     server._obs_rpc("http", "get_weights", "ok", t0,
                                     bytes_out=len(body))
                 else:
@@ -527,35 +756,115 @@ class HttpServer(BaseParameterServer):
                         self.headers.get("traceparent"))):
                     self._handle_post()
 
-            def _handle_post(self):
-                t0 = time.perf_counter()
-                if not self.path.startswith("/update"):
-                    self._empty(404)
-                    return
-                length = int(self.headers.get("Content-Length", "0"))
-                try:
-                    delta = _decode_delta(self.rfile.read(length))
-                except Exception:  # malformed payload -> clean 400, not a 500
-                    server._obs_rpc("http", "apply_delta", "bad_frame", t0)
-                    self._empty(400)
-                    return
-                try:
-                    server.apply_delta(
-                        delta, update_id=self.headers.get("X-Update-Id"))
-                except ValueError as err:  # wrong arity/shapes -> 400
-                    _LOG.warning("rejected delta: %s", err)
-                    server._obs_rpc("http", "apply_delta", "rejected", t0,
-                                    bytes_in=length)
-                    self._empty(400)
-                    return
-                server._obs_rpc("http", "apply_delta", "ok", t0,
-                                bytes_in=length)
+            def _reply(self, body: bytes,
+                       content_type: str = "text/plain"):
                 self._record(200)    # before the reply, like do_GET
-                body = b"Update done"
                 self.send_response(200)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _read_delta(self, op: str, t0: float):
+                """Decode the request body as a delta frame; answers the
+                400 itself and returns None on a malformed payload."""
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    return _decode_delta(self.rfile.read(length)), length
+                except Exception:  # malformed -> clean 400, not a 500
+                    server._obs_rpc("http", op, "bad_frame", t0)
+                    self._empty(400)
+                    return None
+
+            def _handle_post(self):
+                t0 = time.perf_counter()
+                if self.path.startswith("/update"):
+                    decoded = self._read_delta("apply_delta", t0)
+                    if decoded is None:
+                        return
+                    delta, length = decoded
+                    try:
+                        server.apply_delta(
+                            delta,
+                            update_id=self.headers.get("X-Update-Id"))
+                    except ValueError as err:  # wrong arity/shapes -> 400
+                        _LOG.warning("rejected delta: %s", err)
+                        server._obs_rpc("http", "apply_delta", "rejected",
+                                        t0, bytes_in=length)
+                        self._empty(400)
+                        return
+                    server._obs_rpc("http", "apply_delta", "ok", t0,
+                                    bytes_in=length)
+                    self._reply(b"Update done")
+                elif self.path.startswith("/prepare"):
+                    txn_id = self.headers.get("X-Txn-Id", "")
+                    decoded = self._read_delta("prepare", t0)
+                    if decoded is None:
+                        return
+                    delta, length = decoded
+                    try:
+                        server.prepare_delta(delta, txn_id)
+                    except ValueError as err:
+                        _LOG.warning("rejected prepare: %s", err)
+                        server._obs_rpc("http", "prepare", "rejected", t0,
+                                        bytes_in=length)
+                        self._empty(400)
+                        return
+                    server._obs_rpc("http", "prepare", "ok", t0,
+                                    bytes_in=length)
+                    self._reply(b"Staged")
+                elif self.path.startswith("/commit"):
+                    txn_id = self.headers.get("X-Txn-Id", "")
+                    try:
+                        gen, digest, version = server.commit_delta(txn_id)
+                    except UnknownTxnError:
+                        # 404 on the /commit route = unknown txn (the
+                        # typed re-prepare signal, not retried)
+                        server._obs_rpc("http", "commit", "unknown_txn",
+                                        t0)
+                        self._empty(404)
+                        return
+                    except ValueError as err:
+                        _LOG.warning("rejected commit: %s", err)
+                        server._obs_rpc("http", "commit", "rejected", t0)
+                        self._empty(400)
+                        return
+                    server._obs_rpc("http", "commit", "ok", t0)
+                    self._reply(b'{"generation": %d, "digest": %d, '
+                                b'"version": %d}' % (gen, digest, version),
+                                content_type="application/json")
+                elif self.path.startswith("/abort"):
+                    server.abort_delta(self.headers.get("X-Txn-Id", ""))
+                    server._obs_rpc("http", "abort", "ok", t0)
+                    self._reply(b"Aborted")
+                elif self.path.startswith("/replicate"):
+                    update_id = self.headers.get("X-Update-Id", "")
+                    epoch = int(self.headers.get(
+                        "X-Replication-Epoch", "0"))
+                    decoded = self._read_delta("replicate", t0)
+                    if decoded is None:
+                        return
+                    delta, length = decoded
+                    try:
+                        server.apply_replicated(delta, update_id, epoch)
+                    except FencedEpochError:
+                        # 409: the sender is a zombie primary from a
+                        # fenced-off epoch — terminal, never retried
+                        server._obs_rpc("http", "replicate", "fenced",
+                                        t0, bytes_in=length)
+                        self._empty(409)
+                        return
+                    except ValueError as err:
+                        _LOG.warning("rejected replicated delta: %s", err)
+                        server._obs_rpc("http", "replicate", "rejected",
+                                        t0, bytes_in=length)
+                        self._empty(400)
+                        return
+                    server._obs_rpc("http", "replicate", "ok", t0,
+                                    bytes_in=length)
+                    self._reply(b"Replicated")
+                else:
+                    self._empty(404)
 
         host = determine_master(self.port).split(":")[0]
         self._httpd = ThreadingHTTPServer((host, self.port), Handler)
@@ -761,6 +1070,98 @@ class SocketServer(BaseParameterServer):
                         conn.sendall(struct.pack(
                             ">Q", self.weights_version))
                         self._obs_rpc("socket", "get_version", "ok", t0)
+                    elif opcode == PS_GEN_POLL_OPCODE:
+                        gen, digest = self.generation_info()
+                        conn.sendall(struct.pack(">QQ", gen, digest))
+                        self._obs_rpc("socket", "get_generation", "ok", t0)
+                    elif opcode == PS_GEN_PULL_OPCODE:
+                        # generational pull: (generation, digest,
+                        # version) prefix the SAME cached frame 'g'
+                        # serves, read as one consistent quadruple —
+                        # the coherence-checked subscriber pull
+                        (gen, digest, version,
+                         payload) = self.encoded_weights_generational()
+                        conn.sendall(struct.pack(">QQQ", gen, digest,
+                                                 version))
+                        send_payload(conn, payload)
+                        self._obs_rpc("socket", "get_weights", "ok", t0,
+                                      bytes_out=len(payload))
+                    elif opcode == PS_PREPARE_OPCODE:
+                        txn_id = bytes(recv_exact(
+                            conn, PS_ID_BYTES)).decode("ascii", "replace")
+                        arrays, kind = receive_frame(conn, copy=False)
+                        nbytes_in = sum(int(a.nbytes) for a in arrays)
+                        delta = (dequantize_delta(arrays)
+                                 if kind == KIND_DELTA_Q8 else arrays)
+                        try:
+                            # prepare copies the delta (the views die
+                            # with this frame) — stage, don't apply
+                            self.prepare_delta(delta, txn_id)
+                        except ValueError as err:
+                            _LOG.warning("rejected prepare: %s", err)
+                            conn.sendall(b"e")
+                            self._obs_rpc("socket", "prepare", "rejected",
+                                          t0, bytes_in=nbytes_in)
+                            continue
+                        conn.sendall(b"k")
+                        self._obs_rpc("socket", "prepare", "ok", t0,
+                                      bytes_in=nbytes_in)
+                    elif opcode == PS_COMMIT_OPCODE:
+                        txn_id = bytes(recv_exact(
+                            conn, PS_ID_BYTES)).decode("ascii", "replace")
+                        try:
+                            gen, digest, version = self.commit_delta(
+                                txn_id)
+                        except UnknownTxnError:
+                            # 'n': typed re-prepare signal — the staged
+                            # delta died with a failed-over predecessor
+                            conn.sendall(b"n")
+                            self._obs_rpc("socket", "commit",
+                                          "unknown_txn", t0)
+                            continue
+                        except ValueError as err:
+                            _LOG.warning("rejected commit: %s", err)
+                            conn.sendall(b"e")
+                            self._obs_rpc("socket", "commit", "rejected",
+                                          t0)
+                            continue
+                        conn.sendall(b"k" + struct.pack(">QQQ", gen,
+                                                        digest, version))
+                        self._obs_rpc("socket", "commit", "ok", t0)
+                    elif opcode == PS_ABORT_OPCODE:
+                        txn_id = bytes(recv_exact(
+                            conn, PS_ID_BYTES)).decode("ascii", "replace")
+                        self.abort_delta(txn_id)
+                        conn.sendall(b"k")
+                        self._obs_rpc("socket", "abort", "ok", t0)
+                    elif opcode == PS_REPLICATE_OPCODE:
+                        epoch = recv_u64(conn)
+                        update_id = bytes(recv_exact(
+                            conn, PS_ID_BYTES)).decode("ascii", "replace")
+                        arrays, kind = receive_frame(conn, copy=False)
+                        nbytes_in = sum(int(a.nbytes) for a in arrays)
+                        delta = (dequantize_delta(arrays)
+                                 if kind == KIND_DELTA_Q8 else arrays)
+                        try:
+                            self.apply_replicated(delta, update_id, epoch)
+                        except FencedEpochError:
+                            # 'f': zombie primary from a fenced-off
+                            # epoch — terminal for the sender
+                            conn.sendall(b"f")
+                            self._obs_rpc("socket", "replicate", "fenced",
+                                          t0, bytes_in=nbytes_in)
+                            continue
+                        except ValueError as err:
+                            _LOG.warning("rejected replicated delta: %s",
+                                         err)
+                            conn.sendall(b"e")
+                            self._obs_rpc("socket", "replicate",
+                                          "rejected", t0,
+                                          bytes_in=nbytes_in)
+                            continue
+                        conn.sendall(b"k")
+                        self._obs_rpc("socket", "replicate", "ok", t0,
+                                      bytes_in=nbytes_in)
                     elif opcode == b"h":
                         conn.sendall(b"k")  # alive
                         self._obs_rpc("socket", "health", "ok", t0)

@@ -36,14 +36,56 @@ Exposed as ``ps_shards=N`` on :class:`~elephas_tpu.tpu_model.TPUModel`
 and via :func:`~elephas_tpu.parameter.factory.create_sharded_server` /
 :func:`~elephas_tpu.parameter.factory.create_sharded_client`.
 """
+import urllib.error
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .client import BaseParameterClient
+from .client import _TRANSIENT, BaseParameterClient, UnknownTxnError
 
-__all__ = ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient"]
+__all__ = ["ShardPlan", "ShardedServerGroup", "ShardedParameterClient",
+           "TornPushError", "CommitAbortedError",
+           "GenerationMismatchError"]
+
+
+class TornPushError(ConnectionError):
+    """A sharded push landed on some shards but exhausted retries on
+    another — the plane is TORN (the failed shard's slice lost). Typed
+    so callers can distinguish torn from never-applied: a plain
+    :class:`ConnectionError` from the sharded client means NO shard
+    applied anything. ``per_shard`` holds one outcome string per shard
+    in plan order (``"applied"`` / ``"failed: ..."``)."""
+
+    def __init__(self, message: str, per_shard: Sequence[str]):
+        super().__init__(message)
+        self.per_shard = list(per_shard)
+
+
+class CommitAbortedError(ConnectionError):
+    """A two-phase push failed TRANSIENTLY in the PREPARE phase and was
+    aborted on every shard — nothing was applied anywhere (the
+    atomic-commit guarantee). Safe to retry the whole push. Permanent
+    rejections (mis-shaped delta: ``ValueError`` from the socket
+    transport, HTTP 4xx) also abort every shard but propagate typed —
+    retrying them can never succeed."""
+
+
+class GenerationMismatchError(RuntimeError):
+    """A generation-coherent pull could not assemble a consistent
+    weight set: the shards kept disagreeing on (generation, digest)
+    past the bounded re-pull budget — the plane is mid-push, torn, or a
+    shard restarted lossily. ``versions`` (the per-shard version tuple
+    observed — the token a subscriber vetoes) and ``generations`` ride
+    along for the veto and the event log."""
+
+    def __init__(self, generations, versions):
+        super().__init__(
+            f"shards disagree on generation after re-pulls: "
+            f"{generations}")
+        self.generations = tuple(generations)
+        self.versions = tuple(versions)
 
 
 def _nbytes(shape, dtype=np.float32) -> int:
@@ -198,20 +240,40 @@ class ShardedParameterClient(BaseParameterClient):
 
     client_type = "sharded"
 
+    #: re-pull rounds a generation-coherent pull spends converging on a
+    #: consistent cut before raising :class:`GenerationMismatchError`
+    MAX_COHERENCE_REPULLS = 4
+
     def __init__(self, clients: Sequence[BaseParameterClient],
-                 plan: ShardPlan, compression: Optional[str] = None):
+                 plan: ShardPlan, compression: Optional[str] = None,
+                 two_phase: bool = True):
         if len(clients) != plan.num_shards:
             raise ValueError(
                 f"{len(clients)} clients for a {plan.num_shards}-shard plan")
         self.clients = list(clients)
         self.plan = plan
         self.compression = self._check_compression(compression)
+        self.two_phase = bool(two_phase)
+        # effective only when EVERY sub-client implements the prepare
+        # extension: a transport (or in-memory double) without it falls
+        # back to the legacy single-phase push rather than failing
+        # half-prepared
+        self._use_2pc = self.two_phase and all(
+            type(c).prepare_frame is not BaseParameterClient.prepare_frame
+            for c in self.clients)
         self._fanout = _Fanout(len(self.clients))
+        from ..obs.metrics import default_registry
+
+        self._m_commit_aborts = default_registry().counter(
+            "ps_commit_aborts_total",
+            "two-phase sharded pushes aborted in the prepare phase "
+            "(nothing applied on any shard)").labels()
 
     def clone(self) -> "ShardedParameterClient":
         return ShardedParameterClient([c.clone() for c in self.clients],
                                       self.plan,
-                                      compression=self.compression)
+                                      compression=self.compression,
+                                      two_phase=self.two_phase)
 
     def get_parameters(self) -> List[np.ndarray]:
         parts = self._fanout.run([c.get_parameters for c in self.clients])
@@ -239,39 +301,203 @@ class ShardedParameterClient(BaseParameterClient):
         versions = tuple(int(v) for v, _ in pairs)
         return versions, self.plan.merge([w for _, w in pairs])
 
-    def push_frame(self, arrays: List[np.ndarray], kind: int):
+    def get_generation(self):
+        """Per-shard ``(generation, digest)`` pairs as a tuple (plan
+        order). Equal pairs across shards certify the same set of
+        committed updates landed everywhere."""
+        return tuple(self._fanout.run([c.get_generation
+                                       for c in self.clients]))
+
+    def get_parameters_generational(self):
+        """A generation-COHERENT pull: every shard's
+        ``((gen, digest), version, weights)`` triple is fetched in
+        parallel, and shards whose generation pair disagrees with the
+        most-advanced shard are re-pulled (they are mid-commit — a
+        racing push lands between shard reads) up to
+        :attr:`MAX_COHERENCE_REPULLS` rounds. Returns
+        ``(generation_pair, version_tuple, merged_weights)`` once all
+        shards agree; raises :class:`GenerationMismatchError` when they
+        never converge (constant churn, a torn legacy push, or a
+        lossily restarted shard) — the weight set that WOULD have been
+        assembled is exactly the mixed-generation frankenstein state a
+        subscriber must never stage."""
+        triples = list(self._fanout.run(
+            [c.get_parameters_generational for c in self.clients]))
+        # N re-pull rounds = N+1 consistency checks: the LAST re-pull's
+        # results are checked too, not fetched-and-discarded
+        for attempt in range(self.MAX_COHERENCE_REPULLS + 1):
+            pairs = [t[0] for t in triples]
+            if len(set(pairs)) == 1:
+                versions = tuple(int(t[1]) for t in triples)
+                merged = self.plan.merge([t[2] for t in triples])
+                return pairs[0], versions, merged
+            if attempt == self.MAX_COHERENCE_REPULLS:
+                break
+            # re-pull the LAGGING shards (generation below the max —
+            # their missing commit is in flight and lands shortly); a
+            # same-count digest split means two different update sets,
+            # so re-pull every minority shard and let the stream settle
+            target = max(pairs)
+            lagging = [i for i, p in enumerate(pairs) if p != target]
+            repulled = self._fanout.run(
+                [self.clients[i].get_parameters_generational
+                 for i in lagging])
+            for j, i in enumerate(lagging):
+                triples[i] = repulled[j]
+        raise GenerationMismatchError(
+            generations=[t[0] for t in triples],
+            versions=[int(t[1]) for t in triples])
+
+    def push_frame(self, arrays: List[np.ndarray], kind: int,
+                   update_id: Optional[str] = None):
         """Fan one update out to every shard.
 
-        There is NO cross-shard transaction: if one shard exhausts its
-        sub-client retries after siblings already applied, the update
-        lands torn (some tensors updated, the failed shard's slice
-        lost). For asynchronous SGD that is one partial gradient — the
-        same class of perturbation as a lost delta, which the training
-        mode already tolerates — but it is observable: a partial
-        failure emits a ``ps.sharded_push_torn`` event before the error
-        propagates (and the failed shard's ``num_updates`` lags, which
-        the group-min progress signal surfaces)."""
-        from ..obs.events import emit as emit_event
+        With ``two_phase=True`` (the default, when every sub-client
+        speaks the prepare extension) the push is an ATOMIC cross-shard
+        commit: every shard stages the delta first, any prepare failure
+        aborts all shards — nothing applied anywhere; transient
+        failures surface as the retryable :class:`CommitAbortedError`,
+        permanent validation rejections propagate typed — and only
+        then does the commit fan out.
+        Returns the push's **generation id** (the max post-commit
+        per-shard generation — monotonically increasing across
+        committed pushes). A shard that failed over between prepare and
+        commit answers the commit with unknown-txn; the coordinator
+        re-prepares that shard's slice against the promoted standby and
+        commits again, so a mid-push primary death costs a retry, not a
+        torn plane.
+
+        The legacy single-phase path (``two_phase=False``) keeps the
+        documented no-cross-shard-transaction trade: a push whose
+        retries exhaust on one shard after siblings applied lands torn
+        — raised as :class:`TornPushError` carrying per-shard outcomes
+        (a plain ``ConnectionError`` means nothing applied), plus the
+        ``ps.sharded_push_torn`` event."""
         from ..utils.tensor_codec import KIND_DELTA_Q8
 
         group = 2 if kind == KIND_DELTA_Q8 else 1
         parts = self.plan.split(list(arrays), group=group)
+        # ONE id per logical push, shared by every shard on BOTH paths:
+        # the per-shard generation digests sum the ids of applied
+        # updates, so per-shard minting would diverge the digests on the
+        # very first push and the coherence check would veto every
+        # generational pull forever
+        update_id = update_id or uuid.uuid4().hex
+        if self._use_2pc:
+            return self._push_frame_2pc(parts, kind, update_id)
+        return self._push_frame_legacy(parts, kind, update_id)
+
+    def _push_frame_2pc(self, parts, kind: int, txn_id: str):
+        from ..obs.events import emit as emit_event
+
+        prepared = [False] * len(self.clients)
+
+        def prepare_one(i, c, p):
+            def call():
+                c.prepare_frame(p, kind, txn_id)
+                prepared[i] = True
+            return call
+
+        try:
+            self._fanout.run([prepare_one(i, c, p) for i, (c, p)
+                              in enumerate(zip(self.clients, parts))])
+        except BaseException as err:
+            # prepare failed somewhere: nothing has been APPLIED
+            # anywhere — abort the shards that DID stage (best-effort;
+            # a shard whose prepare failed has nothing to drop, and
+            # retrying an abort against a dead shard would stall the
+            # error for its whole retry ladder — its stage, if any,
+            # died with it or ages out via STAGE_TTL) and surface the
+            # atomic abort
+            for ok, c in zip(prepared, self.clients):
+                if not ok:
+                    continue
+                try:
+                    c.abort_txn(txn_id)
+                except Exception:  # noqa: BLE001 — cleanup is best-effort
+                    pass
+            self._m_commit_aborts.inc()
+            emit_event("ps.commit_aborted", txn_id=txn_id,
+                       shards_total=len(self.clients),
+                       reason=str(err))
+            # CommitAbortedError means "safe to retry the whole push" —
+            # only wrap errors that ARE transient (connection-shaped).
+            # A validation rejection (wrong arity/shapes: ValueError
+            # from the socket transport, HTTP 4xx) can never succeed on
+            # a resend; wrapping it would send callers into a retry
+            # spin, so it propagates typed after the abort fan-out.
+            if isinstance(err, _TRANSIENT) and not (
+                    isinstance(err, urllib.error.HTTPError)
+                    and err.code < 500):
+                raise CommitAbortedError(
+                    f"sharded push aborted in prepare phase: {err}"
+                ) from err
+            raise
+
+        def commit_one(c, p):
+            def call():
+                try:
+                    return c.commit_txn(txn_id)
+                except UnknownTxnError:
+                    # the shard failed over between prepare and commit:
+                    # the staged delta died with the old primary —
+                    # re-prepare this shard's slice and commit again
+                    c.prepare_frame(p, kind, txn_id)
+                    return c.commit_txn(txn_id)
+            return call
+
+        outcomes = [None] * len(self.clients)
+
+        def record(i, fn):
+            def call():
+                outcomes[i] = fn()
+            return call
+
+        try:
+            self._fanout.run([record(i, commit_one(c, p)) for i, (c, p)
+                              in enumerate(zip(self.clients, parts))])
+        except BaseException as err:
+            # commit-phase exhaustion after every shard prepared: the
+            # committed shards hold the update, the failed one may not
+            # — torn, but VISIBLY so (its generation lags, which the
+            # coherence check vetoes). Distinct from the legacy event:
+            # ps.sharded_push_torn never fires on the 2PC path.
+            raise TornPushError(
+                f"commit phase failed after all shards prepared: {err}",
+                ["applied" if o is not None else f"failed: {err}"
+                 for o in outcomes]) from err
+        return max(gen for gen, _version in outcomes)
+
+    def _push_frame_legacy(self, parts, kind: int, update_id: str):
+        from ..obs.events import emit as emit_event
+
         applied = [False] * len(self.clients)
+        errors: Dict[int, BaseException] = {}
 
         def push_one(i, c, p):
             def call():
-                c.push_frame(p, kind)
+                try:
+                    c.push_frame(p, kind, update_id=update_id)
+                except BaseException as err:
+                    errors[i] = err
+                    raise
                 applied[i] = True
             return call
 
         try:
             self._fanout.run([push_one(i, c, p) for i, (c, p)
                               in enumerate(zip(self.clients, parts))])
-        except BaseException:
+        except BaseException as err:
             if any(applied):
                 emit_event("ps.sharded_push_torn",
                            shards_applied=sum(applied),
                            shards_total=len(applied))
+                raise TornPushError(
+                    f"sharded push torn: {sum(applied)}/{len(applied)} "
+                    f"shards applied before {err}",
+                    ["applied" if ok else
+                     f"failed: {errors.get(i, err)}"
+                     for i, ok in enumerate(applied)]) from err
             raise
 
     def health_check(self) -> bool:
@@ -296,7 +522,8 @@ class ShardedServerGroup:
     """
 
     def __init__(self, transport, model: Dict[str, Any], port: int,
-                 mode: str, num_shards: int, **kwargs):
+                 mode: str, num_shards: int, standby: bool = False,
+                 **kwargs):
         self.transport = transport
         self.port = int(port)
         self.mode = mode
@@ -307,6 +534,19 @@ class ShardedServerGroup:
             transport.create_server(self._shard_models[i], self.port + i,
                                     mode, shard=i, **self.kwargs)
             for i in range(self.plan.num_shards)]
+        #: hot-standby failover: one warm standby per shard on ports
+        #: ``port+N .. port+2N-1``, fed by the primary's applied-delta
+        #: stream; armed lazily in :meth:`start` (the standby primes
+        #: itself from the primary's snapshot, so arming before the
+        #: primaries serve keeps the pair trivially in sync)
+        self.standby = bool(standby)
+        self.standbys: List[Optional[Any]] = [None] * self.plan.num_shards
+        from ..obs.metrics import default_registry
+
+        self._m_failovers = default_registry().counter(
+            "ps_failovers_total",
+            "standby promotions onto a dead primary's port",
+            labels=("shard",))
 
     @property
     def num_shards(self) -> int:
@@ -319,13 +559,32 @@ class ShardedServerGroup:
         shard's counter is the number of fully-landed updates."""
         return min(s.num_updates for s in self.servers)
 
+    def standby_port(self, i: int) -> int:
+        """The shard-``i`` standby's port (primaries occupy
+        ``port..port+N-1``, standbys the next N ports)."""
+        return self.port + self.plan.num_shards + int(i)
+
+    def _arm_standby(self, i: int):
+        from .replication import ShardStandby
+
+        self.standbys[i] = ShardStandby(
+            self.transport, self.servers[i], self.standby_port(i),
+            self.mode, i, self._shard_models[i], **self.kwargs)
+
     def start(self):
         started = []
         try:
             for s in self.servers:
                 s.start()
                 started.append(s)
+            if self.standby:
+                for i in range(self.plan.num_shards):
+                    self._arm_standby(i)
         except BaseException:
+            for sb in self.standbys:
+                if sb is not None:
+                    sb.stop()
+            self.standbys = [None] * self.plan.num_shards
             for s in started:      # no half-started group left behind
                 try:
                     s.stop()
@@ -335,6 +594,13 @@ class ShardedServerGroup:
 
     def stop(self):
         first: Optional[BaseException] = None
+        for sb in self.standbys:
+            if sb is not None:
+                try:
+                    sb.stop()
+                except Exception as err:  # noqa: BLE001
+                    first = first or err
+        self.standbys = [None] * self.plan.num_shards
         for s in self.servers:
             try:
                 s.stop()
@@ -363,12 +629,60 @@ class ShardedServerGroup:
     def snapshot_shard(self, i: int) -> Dict[str, Any]:
         return self.servers[i].snapshot()
 
+    def promote_shard(self, i: int):
+        """Hot-standby failover for ONE shard: promote the standby's
+        CURRENT state onto the dead primary's port (zero applied-update
+        loss — every acked delta is already on the standby), bump the
+        fencing epoch so the dead primary's late traffic is rejected if
+        it turns out to be a zombie, and re-arm a FRESH standby behind
+        the promoted server. Returns the new primary, or ``None`` when
+        no healthy standby exists (the caller falls back to
+        :meth:`restart_shard`)."""
+        from ..obs.events import emit as emit_event
+
+        standby = self.standbys[i]
+        if standby is None or not standby.healthy():
+            return None
+        old = self.servers[i]
+        old_epoch = getattr(old, "epoch", 0)
+        lag = standby.replicator.lag
+        try:
+            old.stop()          # fence the corpse off its port
+        except Exception:  # noqa: BLE001 — already dead is the point
+            pass
+        server = standby.promote(self.port + i)
+        if server is None:
+            # the standby declined (undrained backlog): retire it and
+            # let the caller take the snapshot-restart fallback, which
+            # realigns the generation marker and re-arms a fresh standby
+            standby.stop()
+            self.standbys[i] = None
+            return None
+        self.servers[i] = server
+        self.standbys[i] = None
+        self._arm_standby(i)
+        self._m_failovers.labels(shard=str(i)).inc()
+        emit_event("ps.failover", shard=i, old_epoch=int(old_epoch),
+                   new_epoch=int(server.epoch), lag_at_promotion=lag,
+                   generation=int(server.generation))
+        return server
+
     def restart_shard(self, i: int, snapshot: Dict[str, Any]):
-        """Kill→restart recovery for ONE shard: stop whatever is left of
-        the old server, rebuild it from ``snapshot`` on the same port,
-        start it. Workers reconnect through their sub-clients' retry
-        path; the restored idempotency window keeps in-flight resends
-        deduplicated."""
+        """Kill→restart recovery for ONE shard — the NO-STANDBY
+        fallback: stop whatever is left of the old server, rebuild it
+        from ``snapshot`` on the same port, start it. Workers reconnect
+        through their sub-clients' retry path; the restored idempotency
+        window keeps in-flight resends deduplicated.
+
+        Post-snapshot deltas are LOST (the documented lossy trade the
+        hot standby exists to close), so the restarted shard's
+        generation marker is REALIGNED to the most-advanced surviving
+        shard's — without it the generation-coherence check would veto
+        every pull forever; with it the loss stays exactly the
+        pre-standby semantics (one stale slice until new pushes land),
+        surfaced as a ``ps.generation_realigned`` event."""
+        from ..obs.events import emit as emit_event
+
         try:
             self.servers[i].stop()
         except Exception:
@@ -378,6 +692,24 @@ class ShardedServerGroup:
              "weights": snapshot["weights"]},
             self.port + i, self.mode, shard=i, **self.kwargs)
         server.restore(snapshot)
+        survivors = [s.generation_info() for j, s in
+                     enumerate(self.servers)
+                     if j != i and hasattr(s, "generation_info")]
+        if survivors:
+            target = max(survivors)
+            if target != server.generation_info():
+                emit_event("ps.generation_realigned", shard=i,
+                           from_generation=int(server.generation),
+                           to_generation=int(target[0]))
+                server.adopt_generation(*target)
         server.start()
         self.servers[i] = server
+        # a standby for the dead primary tracked a timeline that no
+        # longer exists — retire it and re-arm against the restarted
+        # server so the shard is covered again
+        if self.standby:
+            old_sb = self.standbys[i]
+            if old_sb is not None:
+                old_sb.stop()
+            self._arm_standby(i)
         return server

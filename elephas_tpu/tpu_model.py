@@ -198,6 +198,13 @@ class TPUModel:
         bounded at 1, errors surfaced at the next sync point). Subsumed
         by ``async_overlap`` only at batch frequency, where the
         overlapped communicator runs and already pipelines its RPCs
+    :param ps_standby: arm one warm STANDBY server per shard (ports
+        ``port+N..port+2N-1``), fed by its primary's applied-delta
+        stream; ``ps_auto_restart`` supervision then PROMOTES the
+        standby on primary death — zero applied-update loss, epoch-
+        fenced against zombie primaries — and only falls back to
+        snapshot-restart when no healthy standby exists. Requires
+        ``ps_shards >= 2``
     """
 
     def __init__(self, model: BaseModel, mode: str = "asynchronous",
@@ -282,6 +289,15 @@ class TPUModel:
         # a background thread and overlaps computation of k+1 (one
         # in-flight push max, staleness bounded at 1)
         self.ps_pipeline = bool(kwargs.pop("ps_pipeline", False))
+        # hot-standby failover (sharded plane): one warm standby per
+        # shard fed by the primary's applied-delta stream; supervision
+        # PROMOTES it on primary death (zero applied-update loss)
+        # instead of restarting from a snapshot
+        self.ps_standby = bool(kwargs.pop("ps_standby", False))
+        if self.ps_standby and self.ps_shards < 2:
+            raise ValueError(
+                "ps_standby requires a sharded plane (ps_shards >= 2); "
+                "single-server recovery is snapshot-restart")
         self.kwargs = kwargs
 
         self.serialized_model = model_to_dict(model)
@@ -293,6 +309,7 @@ class TPUModel:
             self.parameter_server = create_sharded_server(
                 self.parameter_server_mode, self.serialized_model,
                 self.port, self.mode, self.ps_shards,
+                standby=self.ps_standby,
                 custom_objects=self.custom_objects)
             self.client = self._make_client()
 
@@ -336,6 +353,8 @@ class TPUModel:
             config["ps_shards"] = self.ps_shards
         if self.ps_pipeline:
             config["ps_pipeline"] = True
+        if self.ps_standby:
+            config["ps_standby"] = True
         config.update(self.kwargs)
         return config
 
@@ -469,6 +488,14 @@ class TPUModel:
             for i, sub in enumerate(subs):
                 if sub.health_check():
                     continue       # this shard is fine — leave it alone
+                # hot-standby first: promotion loses ZERO applied
+                # updates (every acked delta is already on the standby)
+                # and fences the dead primary's epoch; snapshot-restart
+                # is the no-standby (or unhealthy-standby) fallback,
+                # which loses post-snapshot deltas — the documented
+                # lossy trade
+                if group.promote_shard(i) is not None:
+                    continue
                 group.restart_shard(i, state[i]["snapshot"])
 
         return probe, restart

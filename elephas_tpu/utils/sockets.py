@@ -35,6 +35,28 @@ LENGTH_BYTES = 8
 #: opcode introducing a traceparent frame on the PS socket protocol
 TRACE_OPCODE = b"T"
 
+# -- two-phase-commit / replication opcode family (PS socket protocol) --
+# All are backward-compatible extensions: old clients never send them
+# and the server's opcode loop is unchanged for them. Shared constants
+# so client and server cannot drift on the wire bytes.
+#: prepare: 32-byte txn id + delta frame, staged but NOT applied
+PS_PREPARE_OPCODE = b"P"
+#: commit: 32-byte txn id; applies the staged delta, replies status byte
+#: + (generation, version) on success
+PS_COMMIT_OPCODE = b"C"
+#: abort: 32-byte txn id; drops the staged delta
+PS_ABORT_OPCODE = b"A"
+#: replicate: 8-byte epoch + 32-byte update id + delta frame — the
+#: primary->standby applied-delta stream (epoch-fenced)
+PS_REPLICATE_OPCODE = b"R"
+#: generational pull: 8-byte generation + 8-byte digest + 8-byte
+#: version, then the weight frame — read as ONE consistent tuple
+PS_GEN_PULL_OPCODE = b"W"
+#: generation poll: 8-byte generation + 8-byte digest, no payload
+PS_GEN_POLL_OPCODE = b"w"
+#: 32-hex-char transaction / update id length on the wire
+PS_ID_BYTES = 32
+
 #: opcode introducing a KV-transfer frame on the disaggregated-serving
 #: socket (prefill worker -> decode worker): ``b'K'`` + one
 #: length-prefixed ETPU frame of kind ``KIND_KV``/``KIND_KV_Q8``,
@@ -90,6 +112,13 @@ def recv_exact(sock: socket.socket, num_bytes: int) -> memoryview:
 
 # back-compat alias (the historical chunk-list reader's name)
 _receive_all = recv_exact
+
+
+def recv_u64(sock: socket.socket) -> int:
+    """Read one unsigned 64-bit big-endian integer via
+    :func:`recv_exact` — a half-closed peer raises instead of a short
+    read being misparsed as a scalar."""
+    return int.from_bytes(recv_exact(sock, 8), "big")
 
 
 def _use_native(sock: socket.socket) -> bool:

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 from ..obs.context import current_trace_id
 from ..obs.events import emit as emit_event
 from ..obs.metrics import MetricsRegistry
+from ..parameter.sharding import GenerationMismatchError
 
 __all__ = ["WeightSubscriber", "numeric_version"]
 
@@ -134,6 +135,11 @@ class WeightSubscriber:
             "newest weight version the parameter plane has offered "
             "this subscriber (numeric; sharded planes sum per-shard "
             "counters)")
+        self._m_generation_vetoes = reg.counter(
+            "weightsync_generation_vetoes_total",
+            "pulls refused because the plane's shards disagreed on "
+            "generation past the bounded re-pull budget (a mixed-"
+            "generation weight set was never staged)").labels()
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "WeightSubscriber":
@@ -234,9 +240,29 @@ class WeightSubscriber:
         re-raising: without the veto, auto polling would re-download
         the full payload every interval forever — the next published
         version clears the road (and pays one probe download if the
-        layout is still wrong)."""
+        layout is still wrong).
+
+        Against a sharded plane the download is GENERATION-COHERENT:
+        shards that disagree on (generation, digest) — a push landing
+        between shard reads, a torn legacy push, a lossily restarted
+        shard — are re-pulled (bounded) and a set that never converges
+        is VETOED instead of staged, so a serving engine can never
+        decode under a mixed-generation frankenstein weight set. The
+        veto clears itself: the lagging shard's commit moves its
+        version, the token changes, the next poll pulls fresh."""
         t0 = time.perf_counter()
-        token, weights = self.client.get_parameters_versioned()
+        try:
+            token, weights = self._download()
+        except GenerationMismatchError as err:
+            token = tuple(err.versions)
+            with self._lock:
+                self._vetoed.add(token)
+            self._m_generation_vetoes.inc()
+            self._m_errors.inc()
+            emit_event("weights.generation_veto", subscriber=self.name,
+                       token=str(token),
+                       generations=str(err.generations))
+            return None
         with self._lock:
             if token == self._current[0]:
                 return None
@@ -256,6 +282,27 @@ class WeightSubscriber:
         self._m_pull_seconds.observe(time.perf_counter() - t0)
         self._stage(token, params)
         return token
+
+    def _download(self):
+        """``(token, weights)`` via the generation-coherent pull when
+        the client speaks it (both transports and the sharded fan-out
+        do), falling back to the plain versioned pull for custom/legacy
+        clients. The token is the version (tuple), exactly what
+        :meth:`poll_once` compares — the generation pair only gates
+        coherence, it never becomes the token."""
+        # capability check, NOT try/except AttributeError around the
+        # call: an AttributeError raised INSIDE a generational pull is a
+        # bug, and silently downgrading it to the non-coherent pull
+        # would stage exactly the mixed-generation state this gate
+        # exists to keep out of serving engines
+        pull = getattr(self.client, "get_parameters_generational", None)
+        if pull is None:
+            return self.client.get_parameters_versioned()
+        try:
+            _gen, token, weights = pull()
+            return token, weights
+        except NotImplementedError:
+            return self.client.get_parameters_versioned()
 
     def _stage(self, token, params):
         tid = current_trace_id()

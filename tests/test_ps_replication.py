@@ -1,0 +1,954 @@
+"""Fault-tolerant parameter plane: atomic cross-shard commits,
+hot-standby failover, generation-coherent weight pulls.
+
+Covers the three coordinated layers end to end:
+
+- two-phase sharded pushes (prepare/commit/abort on both transports,
+  idempotent commits, atomic abort on any prepare failure, monotonic
+  generation ids) and the typed legacy failures (``TornPushError``);
+- per-shard hot standbys riding the primary's applied-delta stream
+  (bit-identical tracking, zero-applied-update-loss promotion, epoch
+  fencing against zombie primaries, supervision integration);
+- generation coherence (``get_parameters_generational`` bounded
+  re-pulls, ``GenerationMismatchError``, the ``WeightSubscriber`` veto
+  that keeps mixed-generation weight sets out of serving engines);
+
+plus the kill-a-primary-mid-push chaos story with the must-never-fire
+``ps.sharded_push_torn`` invariant and one trace id joining the whole
+failover.
+"""
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu.obs.context import new_root, use_context
+from elephas_tpu.obs.events import clear_events, recent_events
+from elephas_tpu.obs.metrics import default_registry
+from elephas_tpu.parameter.client import (FencedEpochError, HttpClient,
+                                          SocketClient, UnknownTxnError,
+                                          _retry_pause)
+from elephas_tpu.parameter.factory import (create_sharded_client,
+                                           create_sharded_server)
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+from elephas_tpu.parameter.sharding import (CommitAbortedError,
+                                            GenerationMismatchError,
+                                            ShardedParameterClient,
+                                            ShardPlan, TornPushError)
+
+# 3 shards + 3 standbys per group at most — stride keeps tests apart
+_PORT = itertools.count(28600, 24)
+
+
+def _weights(seed=0, sizes=(48, 7, 96, 33)):
+    rng = np.random.default_rng(seed)
+    return [rng.random(n).astype(np.float32) * 2 - 1 for n in sizes]
+
+
+def _model_dict(weights=None):
+    return {"model": None, "weights": weights or _weights()}
+
+
+def _delta(value, like):
+    return [np.full_like(w, value) for w in like]
+
+
+def _standby_group(port, ws=None, n=2, transport="socket"):
+    group = create_sharded_server(transport, _model_dict(ws), port,
+                                  "asynchronous", n, standby=True)
+    group.start()
+    client = create_sharded_client(transport, port,
+                                   _model_dict(ws or _weights()), n,
+                                   timeout=5.0, backoff=0.05)
+    return group, client
+
+
+# ------------------------------------------------- two-phase commit (server)
+
+@pytest.mark.parametrize("server_cls,client_cls",
+                         [(SocketServer, SocketClient),
+                          (HttpServer, HttpClient)])
+def test_prepare_stages_commit_applies(server_cls, client_cls):
+    ws = _weights(seed=1)
+    port = next(_PORT)
+    server = server_cls(_model_dict(ws), port, "asynchronous")
+    server.start()
+    try:
+        client = client_cls(port=port, timeout=5.0, backoff=0.05)
+        delta = _delta(0.5, ws)
+        client.prepare_frame(delta, _KIND_DELTA(), "a" * 32)
+        # staged, NOT applied: weights, version, generation unchanged
+        for w, got in zip(ws, client.get_parameters()):
+            np.testing.assert_array_equal(got, w)
+        assert server.generation_info() == (0, 0)
+        assert server.num_updates == 0
+
+        gen, version = client.commit_txn("a" * 32)
+        assert gen == 1 and version >= 1
+        for w, d, got in zip(ws, delta, client.get_parameters()):
+            np.testing.assert_array_equal(got, w - d)
+        assert server.num_updates == 1
+
+        # idempotent: a retried commit re-acks without double-applying
+        gen2, _ = client.commit_txn("a" * 32)
+        assert gen2 == 1
+        for w, d, got in zip(ws, delta, client.get_parameters()):
+            np.testing.assert_array_equal(got, w - d)
+
+        # unknown txn is TYPED (the re-prepare signal), never retried
+        # as transient
+        with pytest.raises(UnknownTxnError):
+            client.commit_txn("b" * 32)
+
+        # abort drops the stage; the commit then reports unknown
+        client.prepare_frame(delta, _KIND_DELTA(), "c" * 32)
+        client.abort_txn("c" * 32)
+        with pytest.raises(UnknownTxnError):
+            client.commit_txn("c" * 32)
+        assert server.num_updates == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def _KIND_DELTA():
+    from elephas_tpu.utils.tensor_codec import KIND_DELTA
+
+    return KIND_DELTA
+
+
+def test_prepare_rejects_bad_shapes_without_staging():
+    ws = _weights()
+    port = next(_PORT)
+    server = SocketServer(_model_dict(ws), port, "asynchronous")
+    server.start()
+    try:
+        client = SocketClient(port=port, timeout=5.0, backoff=0.05)
+        with pytest.raises(ValueError):
+            client.prepare_frame([np.zeros(3, np.float32)], _KIND_DELTA(),
+                                 "d" * 32)
+        with pytest.raises(UnknownTxnError):
+            client.commit_txn("d" * 32)
+        client.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------ two-phase commit (sharded plane)
+
+def test_sharded_2pc_push_applies_everywhere_and_returns_generation():
+    ws = _weights(seed=2)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        assert client._use_2pc, "real transports must negotiate 2PC"
+        gens = [client.update_parameters(_delta(0.1 * (k + 1), ws))
+                for k in range(3)]
+        assert gens == [1, 2, 3], \
+            "each committed push must return a monotonically " \
+            "increasing generation id"
+        # every shard agrees on (generation, digest): the same SET of
+        # updates landed everywhere
+        infos = {s.generation_info() for s in group.servers}
+        assert len(infos) == 1 and infos.pop()[0] == 3
+        expect = [w - sum(0.1 * (k + 1) for k in range(3)) for w in ws]
+        for e, got in zip(expect, client.get_parameters()):
+            np.testing.assert_allclose(got, e, rtol=1e-6)
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_2pc_prepare_failure_aborts_all_shards_nothing_applied():
+    """The atomic-commit guarantee: one dead shard fails the PREPARE
+    phase, the push aborts everywhere, and the surviving shard's
+    weights are untouched — with ``ps.commit_aborted`` emitted and the
+    legacy torn event ABSENT."""
+    ws = _weights(seed=3)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    aborts = default_registry().counter(
+        "ps_commit_aborts_total",
+        "two-phase sharded pushes aborted in the prepare phase "
+        "(nothing applied on any shard)").labels()
+    before = aborts.value
+    try:
+        client = create_sharded_client(
+            "socket", port, _model_dict(ws), 2,
+            timeout=2.0, max_retries=1, backoff=0.02, deadline=2.0)
+        group.servers[1].stop()          # murder one shard pre-push
+        clear_events()
+        with pytest.raises(CommitAbortedError):
+            client.update_parameters(_delta(1.0, ws))
+        # NOTHING applied anywhere — the surviving shard included
+        survivor_ws = group.servers[0].get_weights()
+        original = group.plan.split(ws)[0]
+        for a, b in zip(original, survivor_ws):
+            np.testing.assert_array_equal(a, b)
+        assert group.servers[0].generation_info() == (0, 0)
+        assert recent_events(event="ps.commit_aborted"), \
+            "abort must be observable"
+        assert not recent_events(event="ps.sharded_push_torn"), \
+            "the torn event must NEVER fire on the 2PC path"
+        assert aborts.value == before + 1
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_commit_against_failed_over_shard_reprepares():
+    """The mid-push failover lane: a commit that answers unknown-txn
+    (the stage died with the old primary) re-prepares that shard's
+    slice and commits again — the push lands, not torn."""
+    ws = _weights(seed=4)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        delta = _delta(0.25, ws)
+        # simulate the failover window: shard 1's stage vanishes
+        # between the prepare fan-out and the commit fan-out (exactly
+        # what a promoted standby answers)
+        orig_commit = client.clients[1].commit_txn
+        dropped = {}
+
+        def drop_stage_once(txn_id):
+            if not dropped:
+                dropped["txn"] = txn_id
+                group.servers[1].abort_delta(txn_id)
+            return orig_commit(txn_id)
+
+        client.clients[1].commit_txn = drop_stage_once
+        gen = client.update_parameters(delta)
+        assert gen == 1
+        for w, d, got in zip(ws, delta, client.get_parameters()):
+            np.testing.assert_array_equal(got, w - d)
+        assert not recent_events(event="ps.sharded_push_torn")
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_legacy_single_phase_push_raises_typed_torn_error():
+    """two_phase=False (or sub-clients without the prepare extension)
+    keeps the documented torn trade — but typed: callers can now
+    distinguish torn (some shards applied) from never-applied."""
+    from tests.test_ps_sharding import _RecordingClient
+
+    weights = [np.ones(8, np.float32) for _ in range(4)]
+    plan = ShardPlan.plan(weights, 2)
+    good, bad = _RecordingClient(), _RecordingClient(fail_on={1})
+    client = ShardedParameterClient([good, bad], plan, two_phase=False)
+    clear_events()
+    with pytest.raises(TornPushError) as err:
+        client.update_parameters([np.ones(8, np.float32)
+                                  for _ in range(4)])
+    assert isinstance(err.value, ConnectionError), \
+        "TornPushError must stay catchable as the old ConnectionError"
+    assert sorted(o.split(":")[0] for o in err.value.per_shard) == \
+        ["applied", "failed"]
+    assert recent_events(event="ps.sharded_push_torn")
+    # doubles without the prepare extension fall back to legacy even
+    # with two_phase left at its default
+    auto = ShardedParameterClient([_RecordingClient(),
+                                   _RecordingClient()], plan)
+    assert not auto._use_2pc
+    client.close()
+    auto.close()
+
+
+def test_retry_backoff_uses_decorrelated_jitter():
+    """A fleet polling a dead shard must not retry in lockstep: pauses
+    are random draws in [base, min(cap, 3*prev)], not the deterministic
+    base * 2**attempt ladder."""
+    import random
+
+    rng = random.Random(7)
+    base, prev = 0.2, 0.2
+    draws = []
+    for _ in range(64):
+        prev = _retry_pause(prev, base, cap=5.0, rng=rng)
+        draws.append(prev)
+        assert base <= prev <= 5.0
+    assert len({round(d, 9) for d in draws}) > 32, \
+        "pauses must be jittered draws, not a fixed schedule"
+    # two independent clients draw DIFFERENT schedules
+    other = [_retry_pause(0.2, base, cap=5.0, rng=random.Random(11))
+             for _ in range(8)]
+    mine = [_retry_pause(0.2, base, cap=5.0, rng=random.Random(7))
+            for _ in range(8)]
+    assert other != mine
+
+
+# ----------------------------------------------- replication + failover
+
+def test_standby_tracks_primary_bit_identical():
+    ws = _weights(seed=5)
+    port = next(_PORT)
+    group, client = _standby_group(port, ws)
+    try:
+        for k in range(4):
+            client.update_parameters(_delta(0.05 * (k + 1), ws))
+        for i, primary in enumerate(group.servers):
+            standby = group.standbys[i]
+            assert standby is not None
+            assert standby.replicator.flush(timeout=5.0)
+            p, s = primary.get_weights(), standby.server.get_weights()
+            for a, b in zip(p, s):
+                assert a.tobytes() == b.tobytes(), \
+                    "standby weights must track the primary BIT-identically"
+            assert standby.server.generation_info() == \
+                primary.generation_info()
+            assert standby.replicator.lag == 0
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_promotion_loses_zero_applied_updates():
+    """The reason standbys exist: deltas applied AFTER the last
+    snapshot survive a primary death. Snapshot-restart would lose
+    them; promotion must not."""
+    ws = _weights(seed=6)
+    port = next(_PORT)
+    group, client = _standby_group(port, ws)
+    failovers = default_registry().counter(
+        "ps_failovers_total",
+        "standby promotions onto a dead primary's port",
+        labels=("shard",)).labels(shard="0")
+    before = failovers.value
+    try:
+        deltas = [0.125, 0.25, 0.5]
+        for v in deltas:
+            client.update_parameters(_delta(v, ws))
+        clear_events()
+        group.servers[0].stop()          # primary 0 dies abruptly
+        promoted = group.promote_shard(0)
+        assert promoted is not None
+        assert promoted.epoch == 1, "promotion must bump the fencing epoch"
+        assert group.standbys[0] is not None, \
+            "a fresh standby must be re-armed behind the new primary"
+        # oracle: every acked delta present — nothing rolled back
+        expect = [w - sum(deltas) for w in ws]
+        got = client.get_parameters()
+        for e, g in zip(expect, got):
+            np.testing.assert_allclose(g, e, rtol=1e-6)
+        ev = recent_events(event="ps.failover")
+        assert ev and ev[-1]["shard"] == 0 and ev[-1]["new_epoch"] == 1
+        assert failovers.value == before + 1
+        # the plane keeps taking commits after failover
+        assert client.update_parameters(_delta(0.1, ws)) == len(deltas) + 1
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_rearmed_standby_misses_no_deltas_applied_while_arming():
+    """Re-arming a standby behind a LIVE primary (the post-promotion
+    path) must not lose deltas applied during the arming window: the
+    replicator attaches BEFORE the snapshot (parked sends + the
+    snapshot's idempotency window dedup the overlap), so a SECOND
+    promotion is still zero-loss."""
+    ws = _weights(seed=12)
+    port = next(_PORT)
+    group, client = _standby_group(port, ws)
+    n_pushes = 12
+    done = threading.Event()
+    errors = []
+
+    def pusher():
+        try:
+            for k in range(n_pushes):
+                for _ in range(40):
+                    try:
+                        client.update_parameters(_delta(0.01, ws))
+                        break
+                    except CommitAbortedError:
+                        time.sleep(0.02)
+                time.sleep(0.005)
+        except BaseException as err:  # noqa: BLE001
+            errors.append(err)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=pusher)
+    t.start()
+    try:
+        # first failover mid-stream: promotion RE-ARMS a fresh standby
+        # while the pusher keeps applying — the arming window under fire
+        time.sleep(0.05)
+        group.servers[0].runs = False
+        group.servers[0].socket.close()
+        deadline = time.monotonic() + 10
+        while (group.promote_shard(0) is None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert done.wait(timeout=60) and errors == []
+        t.join(timeout=10)
+        # second failover: whatever the re-armed standby holds becomes
+        # the shard — any delta lost in the arming window would show up
+        # as a wrong final plane here
+        assert group.standbys[0].replicator.flush(timeout=5.0)
+        group.servers[0].runs = False
+        group.servers[0].socket.close()
+        deadline = time.monotonic() + 10
+        promoted = None
+        while promoted is None and time.monotonic() < deadline:
+            promoted = group.promote_shard(0)
+            time.sleep(0.02)
+        assert promoted is not None and promoted.epoch == 2
+        expect = [w - n_pushes * np.float32(0.01) for w in ws]
+        for e, g in zip(expect, client.get_parameters()):
+            np.testing.assert_allclose(g, e, rtol=1e-5)
+        client.close()
+    finally:
+        done.wait(timeout=60)
+        t.join(timeout=10)
+        group.stop()
+
+
+def test_epoch_fencing_rejects_zombie_primary_traffic():
+    """A primary that was declared dead and failed over — but kept
+    running — must not be able to corrupt the new timeline: its
+    replication stream carries the OLD epoch and is rejected."""
+    ws = _weights(seed=7)
+    port = next(_PORT)
+    server = SocketServer(_model_dict(ws), port, "asynchronous", epoch=1)
+    server.start()
+    try:
+        zombie = SocketClient(port=port, timeout=5.0, max_retries=0,
+                              backoff=0.02)
+        with pytest.raises(FencedEpochError):
+            zombie.replicate_frame(_delta(9.0, ws), _KIND_DELTA(),
+                                   "e" * 32, epoch=0)
+        for w, got in zip(ws, server.get_weights()):
+            # fenced traffic must never be applied
+            np.testing.assert_array_equal(w, got)
+        # current-epoch replication still lands, deduped by id
+        zombie.replicate_frame(_delta(1.0, ws), _KIND_DELTA(),
+                               "f" * 32, epoch=1)
+        zombie.replicate_frame(_delta(1.0, ws), _KIND_DELTA(),
+                               "f" * 32, epoch=1)   # resend: deduped
+        assert server.num_updates == 1
+        zombie.close()
+    finally:
+        server.stop()
+
+
+def test_supervision_promotes_standby_with_post_snapshot_deltas():
+    """The TPUModel supervision path end to end: probe detects the dead
+    shard, restart() PROMOTES the standby (snapshot-restart would lose
+    the post-snapshot delta), and the restored plane serves every
+    acked update."""
+    from elephas_tpu.models import SGD, Activation, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+
+    model = Sequential([Dense(16, input_dim=8), Activation("relu"),
+                        Dense(4), Activation("softmax")])
+    model.compile(SGD(learning_rate=0.1), "categorical_crossentropy",
+                  seed=0)
+    port = next(_PORT)
+    tpu_model = TPUModel(model, mode="asynchronous",
+                         parameter_server_mode="socket", num_workers=2,
+                         ps_shards=2, ps_auto_restart=True,
+                         ps_standby=True, port=port)
+    group = tpu_model.parameter_server
+    tpu_model.start_server()
+    try:
+        probe, restart = tpu_model._ps_supervision()
+        assert probe() is True       # also takes the baseline snapshots
+        baseline = tpu_model.client.get_parameters()
+        # a delta lands AFTER the supervision snapshot — exactly what
+        # snapshot-restart recovery would silently lose
+        delta = [np.full_like(np.asarray(w), 0.25) for w in baseline]
+        tpu_model.client.update_parameters(delta)
+
+        victim = group.servers[0]
+        victim.stop()
+        assert probe() is False
+        restart()
+        assert probe() is True
+        assert group.servers[0] is not victim
+        assert group.servers[0].epoch == 1, \
+            "supervision must PROMOTE (epoch fenced), not snapshot-restart"
+        recovered = tpu_model.client.get_parameters()
+        for b, d, r in zip(baseline, delta, recovered):
+            # the post-snapshot delta must survive the failover
+            np.testing.assert_allclose(r, np.asarray(b) - d, rtol=1e-6)
+        # config round-trips for save/load
+        assert tpu_model.get_config()["ps_standby"] is True
+    finally:
+        tpu_model.stop_server()
+
+
+def test_config_rejects_standby_without_shards():
+    from elephas_tpu.models import SGD, Dense, Sequential
+    from elephas_tpu.tpu_model import TPUModel
+
+    model = Sequential([Dense(4, input_dim=3), Dense(1)])
+    model.compile(SGD(learning_rate=0.1), "mse", seed=0)
+    with pytest.raises(ValueError, match="ps_standby"):
+        TPUModel(model, mode="asynchronous", ps_standby=True,
+                 port=next(_PORT))
+
+
+# ------------------------------------------------- generation coherence
+
+def _split_generations(group, client, ws):
+    """Drive the plane into a cross-shard generation split: a commit
+    that landed on shard 0 only (the torn/mid-push shape)."""
+    txn = "9" * 32
+    parts = group.plan.split(_delta(0.5, ws))
+    client.clients[0].prepare_frame(parts[0], _KIND_DELTA(), txn)
+    client.clients[0].commit_txn(txn)
+    return txn, parts
+
+
+def test_generational_pull_refuses_mixed_generations_then_converges():
+    ws = _weights(seed=8)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        # coherent plane: pull succeeds and stamps the generation pair
+        pair, versions, weights = client.get_parameters_generational()
+        assert pair == (0, 0) and len(versions) == 2
+        txn, parts = _split_generations(group, client, ws)
+        with pytest.raises(GenerationMismatchError) as err:
+            client.get_parameters_generational()
+        assert tuple(err.value.versions), "veto token must ride the error"
+        # the lagging shard commits; the plane converges and the next
+        # pull assembles a consistent cut
+        client.clients[1].prepare_frame(parts[1], _KIND_DELTA(), txn)
+        client.clients[1].commit_txn(txn)
+        pair, versions, weights = client.get_parameters_generational()
+        assert pair[0] == 1
+        for w, got in zip(ws, weights):
+            np.testing.assert_array_equal(got, w - 0.5)
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_generational_pull_heals_racing_commit_by_repulling():
+    """The benign (and common) mismatch: a commit lands between shard
+    reads. The bounded re-pull converges without an error."""
+    ws = _weights(seed=9)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        client.update_parameters(_delta(0.25, ws))
+        # shard 1 is one commit behind for the FIRST read only, then
+        # catches up — the re-pull must assemble generation 2 cleanly
+        txn = "8" * 32
+        parts = group.plan.split(_delta(0.25, ws))
+        client.clients[0].prepare_frame(parts[0], _KIND_DELTA(), txn)
+        client.clients[0].commit_txn(txn)
+        orig = client.clients[1].get_parameters_generational
+        raced = {}
+
+        def catch_up_on_first_read():
+            if not raced:
+                raced["hit"] = True
+                out = orig()           # the stale read (generation 1)
+                client.clients[1].prepare_frame(parts[1], _KIND_DELTA(),
+                                                txn)
+                client.clients[1].commit_txn(txn)
+                return out
+            return orig()
+
+        client.clients[1].get_parameters_generational = \
+            catch_up_on_first_read
+        pair, versions, weights = client.get_parameters_generational()
+        assert raced, "the stale first read must have happened"
+        assert pair[0] == 2
+        for w, got in zip(ws, weights):
+            np.testing.assert_array_equal(got, w - 0.5)
+        client.close()
+    finally:
+        group.stop()
+
+
+class _StagingEngine:
+    """Engine double recording every staged (version, params) — the
+    mixed-generation assertion surface."""
+
+    def __init__(self):
+        self.params = None
+        self.weights_version = 0
+        self.staged = []
+        self._lock = threading.Lock()
+
+    def stage_params(self, params, version, trace_id=None):
+        with self._lock:
+            self.staged.append((version, params))
+            self.weights_version = version
+
+
+def test_subscriber_vetoes_mixed_generation_pull():
+    from elephas_tpu.weightsync import WeightSubscriber
+
+    ws = _weights(seed=10)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        engine = _StagingEngine()
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        sub = WeightSubscriber(engine, client, poll_interval=60,
+                               convert=lambda w: w)
+        txn, parts = _split_generations(group, client, ws)
+        clear_events()
+        assert sub.poll_once() is False, \
+            "a mixed-generation plane must stage NOTHING"
+        assert engine.staged == []
+        assert recent_events(event="weights.generation_veto")
+        vetoed_token = sub.client.get_version()
+        assert sub.poll_once() is False, "the token stays vetoed"
+        assert engine.staged == []
+        # the lagging shard commits: versions move, the veto clears
+        # itself, and the next poll stages a COHERENT set
+        client.clients[1].prepare_frame(parts[1], _KIND_DELTA(), txn)
+        client.clients[1].commit_txn(txn)
+        assert sub.client.get_version() != vetoed_token
+        assert sub.poll_once() is True
+        assert len(engine.staged) == 1
+        version, params = engine.staged[0]
+        for w, got in zip(ws, params):
+            np.testing.assert_array_equal(got, w - 0.5)
+        sub.stop()
+    finally:
+        group.stop()
+
+
+# --------------------------------------------------------------- chaos
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serving_engine_admits_only_coherent_generations_through_failover():
+    """The acceptance invariant at the ENGINE: a real DecodeEngine
+    serving requests while its sharded plane rolls through pushes AND a
+    primary failover must stamp every ``admitted`` flight-recorder
+    event with a weights_version the subscriber staged from a COHERENT
+    pull — never a mixed-generation set (which, by construction, the
+    subscriber refuses to stage at all)."""
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.weightsync import WeightSubscriber
+
+    config = TransformerConfig(vocab_size=64, num_layers=1, num_heads=2,
+                               d_model=16, d_ff=32, max_seq_len=32,
+                               dtype=jnp.float32)
+    p0 = init_params(config, jax.random.PRNGKey(0))
+    leaves0 = [np.asarray(leaf) for leaf in
+               jax.tree_util.tree_leaves(p0)]
+    port = next(_PORT)
+    group, pusher = _standby_group(port, [leaf.copy() for leaf in leaves0])
+    engine = DecodeEngine(p0, config, max_slots=2)
+    staged_versions = {0}          # construction params serve as v0
+    orig_stage = engine.stage_params
+
+    def recording_stage(params, version, trace_id=None):
+        staged_versions.add(int(version))
+        return orig_stage(params, version, trace_id=trace_id)
+
+    engine.stage_params = recording_stage
+    sub_client = create_sharded_client(
+        "socket", port, _model_dict(leaves0), 2, timeout=5.0,
+        backoff=0.05)
+    sub = WeightSubscriber(engine, sub_client, poll_interval=0.01)
+    sub.start()
+
+    stop = threading.Event()
+    # the engine API is serialized by its caller (the ServingServer
+    # pattern: ONE lock guards every engine call; submit(admit=False)
+    # defers admission to the stepping thread)
+    elock = threading.Lock()
+
+    def step_loop():
+        while not stop.is_set():
+            with elock:
+                engine.step()
+            time.sleep(0.001)
+
+    stepper = threading.Thread(target=step_loop, daemon=True)
+    stepper.start()
+
+    rng = np.random.default_rng(3)
+    rids = []
+    try:
+        for k in range(10):
+            with elock:
+                rids.append(engine.submit(
+                    rng.integers(1, 64, 6).tolist(), max_new_tokens=4,
+                    admit=False))
+            delta = [rng.normal(0, 0.05, leaf.shape).astype(np.float32)
+                     for leaf in leaves0]
+            for attempt in range(40):
+                try:
+                    pusher.update_parameters(delta)
+                    break
+                except CommitAbortedError:
+                    time.sleep(0.05)
+            if k == 4:
+                # abrupt primary death mid-rollout, then promotion
+                group.servers[0].runs = False
+                group.servers[0].socket.close()
+                deadline = time.monotonic() + 10
+                while (group.promote_shard(0) is None
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+            time.sleep(0.02)
+        deadline = time.monotonic() + 60
+        finished = {}            # result() is one-shot: collect once
+        while len(finished) < len(rids) and time.monotonic() < deadline:
+            with elock:
+                for r in rids:
+                    if r not in finished:
+                        out = engine.result(r)
+                        if out is not None:
+                            finished[r] = out
+            time.sleep(0.02)
+        assert sorted(finished) == sorted(rids), \
+            "every request must finish through the failover"
+        # every admitted event decodes under a STAGED (coherent)
+        # version — the version-stamped flight-recorder assertion
+        admitted = []
+        for r in rids:
+            trace = engine.request_trace(r)
+            assert trace is not None
+            admitted += [e for e in trace["events"]
+                         if e.get("event") == "admitted"]
+        assert len(admitted) == len(rids)
+        for e in admitted:
+            assert e["weights_version"] in staged_versions, \
+                f"admitted under unstaged version {e['weights_version']}"
+        assert len(staged_versions) > 1, \
+            "the rollout must actually have staged new versions"
+    finally:
+        stop.set()
+        stepper.join(timeout=10)
+        sub.stop()
+        pusher.close()
+        group.stop()
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_primary_mid_push_stream_promotes_with_zero_loss():
+    """The whole failover story under load: a primary shard dies
+    abruptly in the middle of a continuous 2PC push stream while a
+    live-weight subscriber keeps pulling. The standby promotes; the
+    pusher finishes every push with zero terminal failures; the final
+    plane is BIT-identical to a never-killed oracle; the subscriber
+    only ever staged prefix-consistent (never mixed-generation) weight
+    sets; ``ps.sharded_push_torn`` never fired; and the failover events
+    join on ONE trace id."""
+    from elephas_tpu.weightsync import WeightSubscriber
+
+    ws = _weights(seed=11, sizes=(64, 9, 128, 40))
+    port = next(_PORT)
+    group, client = _standby_group(port, ws)
+    n_pushes = 24
+    kill_at = 8
+    deltas = [0.01 * (k + 1) for k in range(n_pushes)]
+    # prefix oracle: after k pushes the plane must equal prefix[k] —
+    # the same sequential float subtractions the servers perform, so
+    # comparisons are exact, not approximate
+    prefix = [ws]
+    for v in deltas:
+        prefix.append([w - np.float32(v) for w in prefix[-1]])
+    prefix_bytes = [tuple(w.tobytes() for w in p) for p in prefix]
+
+    engine = _StagingEngine()
+    sub_client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+    sub = WeightSubscriber(engine, sub_client, poll_interval=0.01,
+                           convert=lambda w: [np.array(x) for x in w])
+    sub.start()
+
+    clear_events()
+    push_errors = []
+    pushed = threading.Event()
+
+    def pusher():
+        for k, v in enumerate(deltas):
+            if k == kill_at:
+                pushed.set()         # signal the killer, then keep going
+            for attempt in range(40):
+                try:
+                    client.update_parameters(_delta(v, ws))
+                    break
+                except CommitAbortedError:
+                    # nothing applied anywhere: the whole push retries
+                    time.sleep(0.05)
+            else:
+                push_errors.append((k, "retries exhausted"))
+                return
+
+    ctx = new_root()
+    t = threading.Thread(target=pusher)
+    t.start()
+    try:
+        assert pushed.wait(timeout=30)
+        # SIGKILL-shaped death: the primary's socket closes out from
+        # under it mid-stream — no graceful drain, in-flight RPCs die
+        group.servers[0].runs = False
+        group.servers[0].socket.close()
+        # the supervision reaction, under ONE trace context so the
+        # whole failover story joins on its id
+        with use_context(ctx):
+            deadline = time.monotonic() + 10
+            promoted = None
+            while promoted is None and time.monotonic() < deadline:
+                promoted = group.promote_shard(0)
+                if promoted is None:
+                    time.sleep(0.05)
+        assert promoted is not None, "standby must promote"
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert push_errors == [], \
+            "zero failed client pushes through the failover"
+
+        # zero applied-update loss: final plane == the never-killed
+        # oracle, bit for bit
+        final = client.get_parameters()
+        assert tuple(w.tobytes() for w in final) == prefix_bytes[-1]
+
+        # the must-never-fire invariant: no torn pushes with 2PC
+        assert recent_events(event="ps.sharded_push_torn") == []
+
+        # the subscriber never staged a mixed-generation set: every
+        # staged weight set is EXACTLY some prefix state
+        sub.stop()
+        assert engine.staged, "the subscriber must have pulled under load"
+        for _version, params in engine.staged:
+            staged_bytes = tuple(np.asarray(p).tobytes() for p in params)
+            assert staged_bytes in prefix_bytes, \
+                "staged weights are not any prefix-consistent state — " \
+                "a frankenstein mixed-generation set reached the engine"
+
+        # one trace id joins the failover story
+        ev = recent_events(event="ps.failover", trace_id=ctx.trace_id)
+        assert len(ev) == 1 and ev[0]["shard"] == 0
+        client.close()
+    finally:
+        try:
+            sub.stop()
+        except Exception:
+            pass
+        group.stop()
+
+
+# --------------------------------------------- review-hardening regressions
+
+def test_legacy_sharded_push_keeps_generation_digests_coherent():
+    """The legacy single-phase path sends ONE update id to every shard:
+    per-shard minting would diverge the (order-independent, cumulative)
+    generation digests on the very first push, after which the
+    coherence check vetoes every generational pull forever."""
+    ws = _weights(seed=9)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05,
+                                       two_phase=False)
+        assert not client._use_2pc
+        for _ in range(2):
+            client.update_parameters(_delta(0.25, ws))
+        pairs = {s.generation_info() for s in group.servers}
+        assert len(pairs) == 1, \
+            f"legacy push diverged the shard generation digests: {pairs}"
+        # and the generational pull stays serviceable
+        (gen, _digest), _token, got = client.get_parameters_generational()
+        assert gen == 2
+        for w, b in zip(ws, got):
+            np.testing.assert_array_equal(b, w - np.float32(0.5))
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_prepare_validation_error_propagates_typed_not_aborted():
+    """A permanent rejection (mis-shaped delta) must NOT surface as
+    CommitAbortedError — that class is a ConnectionError documented
+    'safe to retry the whole push', and a retry loop around a frame
+    that can never validate would spin forever."""
+    ws = _weights(seed=11)
+    port = next(_PORT)
+    group = create_sharded_server("socket", _model_dict(ws), port,
+                                  "asynchronous", 2)
+    group.start()
+    try:
+        client = create_sharded_client("socket", port, _model_dict(ws), 2,
+                                       timeout=5.0, backoff=0.05)
+        assert client._use_2pc
+        bad = [np.zeros(w.size + 1, np.float32) for w in ws]  # wrong shapes
+        with pytest.raises(ValueError):
+            client.update_parameters(bad)
+        # nothing applied anywhere, and the plane still works
+        for w, b in zip(ws, client.get_parameters()):
+            np.testing.assert_array_equal(b, w)
+        assert client.update_parameters(_delta(0.5, ws)) == 1
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_promotion_declined_on_undrained_backlog_falls_back():
+    """promote() must check flush()'s verdict: promoting with acked
+    deltas still parked silently breaks the zero-loss claim and leaves
+    the shard's generation digest diverged forever. A failed drain
+    declines the promotion so supervision takes the (honest, documented)
+    snapshot-restart fallback, which realigns generations."""
+    ws = _weights(seed=13)
+    port = next(_PORT)
+    group, client = _standby_group(port, ws)
+    try:
+        client.update_parameters(_delta(0.125, ws))
+        snap = group.snapshot_shard(0)
+        sb = group.standbys[0]
+        sb.replicator.flush = lambda timeout=5.0: False  # undrainable
+        clear_events()
+        group.servers[0].stop()
+        assert group.promote_shard(0) is None, \
+            "an undrained backlog must decline promotion"
+        assert group.standbys[0] is None
+        ev = recent_events(event="ps.promotion_declined")
+        assert len(ev) == 1 and ev[0]["shard"] == 0
+        assert recent_events(event="ps.failover") == []
+        # the documented fallback still recovers the shard (and re-arms
+        # a fresh standby behind it)
+        group.restart_shard(0, snap)
+        for w, b in zip(ws, client.get_parameters()):
+            np.testing.assert_array_equal(b, w - np.float32(0.125))
+        assert group.standbys[0] is not None
+        client.close()
+    finally:
+        group.stop()

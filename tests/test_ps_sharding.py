@@ -311,7 +311,7 @@ class _RecordingClient(BaseParameterClient):
     def update_parameters(self, delta):
         self._apply(delta)
 
-    def push_frame(self, arrays, kind):
+    def push_frame(self, arrays, kind, update_id=None):
         self._apply(arrays)
 
     def _apply(self, arrays):
