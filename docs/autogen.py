@@ -104,7 +104,8 @@ PAGES = [
      ["QTensor", "quantize_weight", "quantize_lm_params",
       "dequantize_lm_params"]),
     ("Speculative decoding", "elephas_tpu.models.speculative",
-     ["speculative_generate"]),
+     ["speculative_generate", "speculative_round",
+      "speculative_round_paged"]),
     ("Draft distillation", "elephas_tpu.models.distill",
      ["distill_loss", "make_distill_step"]),
     ("Continuous batching", "elephas_tpu.serving_engine",
@@ -123,8 +124,9 @@ PAGES = [
      ["WeightSubscriber", "CanaryController"]),
     ("SSM serving", "elephas_tpu.ssm_engine", ["SSMEngine"]),
     ("Paged KV cache", "elephas_tpu.models.paged_decode",
-     ["init_paged_pool", "decode_step_paged", "install_row_paged",
-      "gather_blocks_to_row", "export_kv_blocks", "import_kv_blocks"]),
+     ["init_paged_pool", "decode_step_paged", "decode_block_paged",
+      "install_row_paged", "gather_blocks_to_row", "export_kv_blocks",
+      "import_kv_blocks"]),
     ("KV block cache", "elephas_tpu.models.block_cache",
      ["BlockCache", "BlockEntry", "chain_keys"]),
     ("SSMModel", "elephas_tpu.models.ssm_model", ["SSMModel"]),
@@ -222,6 +224,7 @@ def main(out_dir: str = None):
               "  - Serving fleet: serving-fleet.md",
               "  - Disaggregated serving: disaggregated-serving.md",
               "  - Live weights: live-weights.md",
+              "  - Speculative serving: speculative-serving.md",
               "  - Fault tolerance: fault-tolerance.md",
               "  - Observability: observability.md",
               "  - Distributed tracing: tracing.md"]

@@ -47,10 +47,15 @@ __all__ = ["DisaggEngine"]
 class DisaggEngine:
     """Decode worker + prefill-tier dispatcher behind one engine API.
 
-    :param decode_engine: a non-speculative
+    :param decode_engine: a
         :class:`~elephas_tpu.serving_engine.DecodeEngine` (construct it
         with ``tier="decode"`` so its queue-wait series lands on the
-        decode-tier label); paged or contiguous both work.
+        decode-tier label); paged or contiguous both work, and so does
+        SPECULATIVE mode — the shipped frames are the TARGET model's
+        KV, which the engine installs before its first draft round
+        (draft KV is recomputed locally at admission, never shipped).
+        The PREFILL tier stays target-only either way: give its
+        workers plain engines built from the same target params.
     :param prefill_workers: the prefill tier — shared freely between
         several DisaggEngines (that is the independent-scaling point).
     :param max_queue: bound on requests in the PREFILL stage (queued at
@@ -65,9 +70,6 @@ class DisaggEngine:
                  max_queue: Optional[int] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  clock=time.monotonic):
-        if getattr(decode_engine, "draft_config", None) is not None:
-            raise ValueError("disaggregated serving does not compose "
-                             "with speculative decoding")
         if not prefill_workers:
             raise ValueError("need at least one prefill worker")
         self.decode = decode_engine
@@ -164,6 +166,15 @@ class DisaggEngine:
                                      int(max_new_tokens), prompt=prompt,
                                      tenant=tenant)
         validate_sampling_overrides(temperature, top_k, top_p)
+        if (getattr(self.decode, "draft_config", None) is not None
+                and (temperature is not None or top_k is not None
+                     or top_p is not None)):
+            # mirror the decode engine's own submit rule so the 400
+            # lands HERE instead of at KV-install time inside the
+            # engine loop (which would terminate the request late,
+            # after a prefill and a wire round trip)
+            raise ValueError("per-request sampling settings are not "
+                             "supported in speculative mode")
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         if tenant is not None:
@@ -705,6 +716,33 @@ class DisaggEngine:
         engine). NOTE: this updates the decode half only — roll the
         prefill workers' engines through their own subscribers."""
         self.decode.stage_params(params, version, trace_id=trace_id)
+
+    @property
+    def draft_config(self):
+        """The decode engine's draft config (None on non-speculative
+        decode workers) — what a draft-channel
+        :class:`~elephas_tpu.weightsync.WeightSubscriber` probes for."""
+        return getattr(self.decode, "draft_config", None)
+
+    @property
+    def draft_params(self):
+        """The decode engine's live DRAFT parameter pytree (speculative
+        decode workers; the draft subscriber channel's treedef/dtype
+        source)."""
+        return getattr(self.decode, "draft_params", None)
+
+    @property
+    def draft_weights_version(self) -> int:
+        return int(getattr(self.decode, "draft_weights_version", 0))
+
+    def stage_draft_params(self, draft_params, version: int,
+                           trace_id=None) -> None:
+        """Stage new DRAFT params for a speculative decode engine (the
+        draft freshness channel — applied at the same between-steps
+        point as target swaps; a stale draft costs acceptance rate,
+        never correctness, so no KV gate is needed on this channel)."""
+        self.decode.stage_draft_params(draft_params, version,
+                                       trace_id=trace_id)
 
     def apply_staged_params(self):
         """Delegates to the decode engine (the engine loop's step()

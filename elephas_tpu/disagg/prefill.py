@@ -79,7 +79,11 @@ class PrefillWorker:
 
     :param engine: the prefill engine (its ``export_prefill`` /
         ``register_prefix`` are the only paths used; ``max_slots=1``
-        keeps its decode cache allocation minimal).
+        keeps its decode cache allocation minimal). The prefill tier
+        is TARGET-only: a speculative (draft-carrying) engine's
+        ``export_prefill`` raises at job time — draft KV never ships;
+        speculative belongs on the DECODE workers, which recompute
+        draft KV at admission from the shipped target frames.
     :param quant: ship Q8 (int8 data + f32 scales, ~0.27x the fp32
         bytes) instead of raw-dtype KV blocks.
     :param block_size: wire block size

@@ -81,6 +81,12 @@ class ReplicaState:
         self.requests_shed = 0
         self.requests_finished = 0
         self.prefill: Optional[Dict] = None   # the prefill_tier block
+        # speculative serving health off the same /stats read: the
+        # engine's draft acceptance rate and per-request decode rate —
+        # the pair that says what speculation buys on THIS replica
+        # (None on non-speculative replicas / before any request)
+        self.draft_acceptance: Optional[float] = None
+        self.request_tokens_per_s_p50: Optional[float] = None
 
     @property
     def load(self) -> float:
@@ -99,6 +105,10 @@ class ReplicaState:
         if self.queue_wait_p99_s is not None:
             out["queue_wait_p50_s"] = self.queue_wait_p50_s
             out["queue_wait_p99_s"] = self.queue_wait_p99_s
+        if self.draft_acceptance is not None:
+            out["draft_acceptance"] = self.draft_acceptance
+        if self.request_tokens_per_s_p50 is not None:
+            out["request_tokens_per_s_p50"] = self.request_tokens_per_s_p50
         return out
 
 
@@ -274,6 +284,11 @@ class ReplicaMembership:
                 st.queue_wait_p99_s = float(stats["queue_wait_p99_s"])
             st.requests_shed = int(stats.get("requests_shed", 0))
             st.requests_finished = int(stats.get("requests_finished", 0))
+            if stats.get("draft_acceptance") is not None:
+                st.draft_acceptance = float(stats["draft_acceptance"])
+            if stats.get("request_tokens_per_s_p50") is not None:
+                st.request_tokens_per_s_p50 = float(
+                    stats["request_tokens_per_s_p50"])
             prefill = stats.get("prefill_tier")
             st.prefill = dict(prefill) if isinstance(prefill, dict) \
                 else None
@@ -449,6 +464,15 @@ class ReplicaMembership:
                 decode["queue_wait_p50_s"] = max(waits50) if waits50 \
                     else 0.0
                 decode["queue_wait_p99_s"] = max(waits99)
+            # speculative fleets: min acceptance is the actionable
+            # number — a replica whose draft went stale (subscriber
+            # dead, rollout skipped it) IS the min, and averaging
+            # would hide it exactly like averaging queue waits would
+            accs = [s.draft_acceptance for s in ready
+                    if s.draft_acceptance is not None]
+            if accs:
+                decode["draft_acceptance_min"] = min(accs)
+                decode["draft_acceptance_mean"] = sum(accs) / len(accs)
             total = decode["requests_shed"] + decode["requests_finished"]
             decode["shed_rate"] = (decode["requests_shed"] / total
                                    if total else 0.0)

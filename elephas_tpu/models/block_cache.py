@@ -30,7 +30,13 @@ Only FULL blocks are ever cached: the partial tail block of a prompt —
 and every block past it — is written by decode, so it is private to its
 request; full prompt blocks are read-only after prefill (decode's first
 write lands at position ``prompt_len``, past every full block), which
-is why sharing them needs no copy-on-write.
+is why sharing them needs no copy-on-write. The same argument covers
+SPECULATIVE serving: the verify pass's writes (including rejected
+positions, up to ``gamma`` past the emitted sequence) all land at or
+beyond ``prompt_len``, so the TARGET model's KV is cached exactly as
+in plain mode — draft KV is never cached at all (it is proposer-
+private, recomputed at admission), so no key ever involves the draft
+or its version.
 
 ``pinned`` entries (:meth:`pin`) have a refcount floor of one: they are
 never parked and never evicted —

@@ -32,6 +32,14 @@ content-addressed and shared across requests by table pointers —
 for remainder prefill, and :func:`install_row_paged`'s ``start``
 offset writes only the private remainder around shared blocks.
 
+:func:`decode_block_paged` is the multi-position mirror of
+:func:`decode_step_paged` — the target-verify pass of PAGED
+speculative decoding: one forward scores ``S`` positions per row,
+scattering their k/v into each row's own block table. Shared
+prefix-cache blocks stay read-only under it for the same reason they
+do under plain decode: every verify write lands at a position at or
+past the prompt length, past every shared full block.
+
 Not supported in paged mode (constructor raises): ``kv_cache_quant``
 (compose the int8 cache with the contiguous engine instead) and MoE
 layers.
@@ -48,9 +56,10 @@ from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
                           _apply_rope, _mlp_apply, _norm,
                           _sinusoidal_table, head_logits)
 
-__all__ = ["init_paged_pool", "decode_step_paged", "install_row_paged",
-           "gather_blocks_to_row", "validate_paged_config",
-           "export_kv_blocks", "import_kv_blocks"]
+__all__ = ["init_paged_pool", "decode_step_paged", "decode_block_paged",
+           "install_row_paged", "gather_blocks_to_row",
+           "validate_paged_config", "export_kv_blocks",
+           "import_kv_blocks"]
 
 
 def validate_paged_config(config: TransformerConfig):
@@ -318,5 +327,104 @@ def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,
                            layer["attn"]["wo"].astype(c.dtype))
         x = _mlp_apply(layer, x, c)
     logits = head_logits(params["embed"], params["final_ln"], x[:, 0],
+                         head=params.get("head"), norm=c.norm)
+    return logits, new_pool
+
+
+def decode_block_paged(params: Dict, pool: Dict, tables: jnp.ndarray,
+                       tokens: jnp.ndarray, pos0,
+                       config: TransformerConfig) -> Tuple[jnp.ndarray,
+                                                           Dict]:
+    """Multi-token cached decode over the block pool: process ``(B, S)``
+    tokens sitting at per-row positions ``pos0 .. pos0+S-1``, scattering
+    each position's k/v into the owning block of that row's table, and
+    return (logits ``(B, S, vocab)``, updated pool).
+
+    The paged mirror of
+    :func:`~elephas_tpu.models.transformer.decode_block` (vector-``pos0``
+    form) and the ``S > 1`` generalization of :func:`decode_step_paged` —
+    the verify pass of paged speculative decoding. Math matches
+    ``decode_block`` exactly (norms, RoPE convention, GQA grouping,
+    window/ALiBi masks); within the block each query attends causally to
+    cache positions ``<= pos0 + j`` (all S positions' k/v are written
+    before attention, so intra-block attention sees the new keys).
+    Writes are confined to the row's own table — a row's rejected
+    (stale) tail positions are masked until later rounds overwrite them
+    and can never corrupt another row's blocks."""
+    c = config
+    b, s = tokens.shape
+    first = next(iter(pool.values()))["k"]
+    bs = first.shape[2]
+    mb = tables.shape[1]
+    length = mb * bs                               # gathered view length
+    pos0 = jnp.asarray(pos0)
+    blockpos = pos0[:, None] + jnp.arange(s)[None, :]        # (B, S)
+    blk = jnp.take_along_axis(tables, blockpos // bs, axis=1)  # (B, S)
+    off = blockpos % bs
+
+    x = params["embed"]["tokens"][tokens]          # (B, S, D)
+    if c.positional == "learned":
+        x = x + params["embed"]["pos"][blockpos]
+    elif c.positional == "sinusoidal":
+        x = x + _sinusoidal_table(blockpos, c.d_model)
+    x = x.astype(c.dtype)
+
+    kpos = jnp.arange(length)
+    mask = kpos[None, None, :] <= blockpos[:, :, None]       # (B, S, L)
+    if c.attention_window is not None:
+        mask = mask & (kpos[None, None, :]
+                       > blockpos[:, :, None] - c.attention_window)
+    scale = 1.0 / math.sqrt(c.head_dim)
+    rp = blockpos[:, None, :]                      # (B, 1, S) rope angles
+    groups = c.num_heads // c.kv_heads
+    hidx = jnp.arange(c.kv_heads)
+    # scatter target per (b, s): (block, head, offset) — broadcast to
+    # (B, S, H). Distinct rows own disjoint tables; within a row the S
+    # positions are distinct (block, offset) pairs; only inactive rows
+    # (tables all zero) collide, and they collide on the scratch sink
+    widx = (blk[:, :, None], hidx[None, None, :], off[:, :, None])
+    new_pool: Dict = {}
+    for i in range(c.num_layers):
+        layer = params[f"layer_{i}"]
+        h = _norm(x, layer["ln1"], c).astype(c.dtype)
+        q = jnp.einsum("bsd,dhk->bhsk", h,
+                       layer["attn"]["wq"].astype(c.dtype))
+        k_new = jnp.einsum("bsd,dhk->bhsk", h,
+                           layer["attn"]["wk"].astype(c.dtype))
+        v_new = jnp.einsum("bsd,dhk->bhsk", h,
+                           layer["attn"]["wv"].astype(c.dtype))
+        if c.positional == "rope":
+            q = _apply_rope(q, rp, c)
+            k_new = _apply_rope(k_new, rp, c)
+
+        lc = pool[f"layer_{i}"]
+        # (B, H, S, D) -> (B, S, H, D) to line up with the (B, S, H)
+        # scatter index
+        pk = lc["k"].at[widx].set(jnp.swapaxes(k_new, 1, 2))
+        pv = lc["v"].at[widx].set(jnp.swapaxes(v_new, 1, 2))
+        new_pool[f"layer_{i}"] = {"k": pk, "v": pv}
+
+        ck = jnp.swapaxes(pk[tables], 1, 2).reshape(
+            b, c.kv_heads, length, c.head_dim)
+        cv = jnp.swapaxes(pv[tables], 1, 2).reshape(
+            b, c.kv_heads, length, c.head_dim)
+
+        qg = q.reshape(b, c.kv_heads, groups, s, c.head_dim)
+        scores = jnp.einsum("bngsk,bntk->bngst", qg, ck) * scale
+        if c.positional == "alibi":
+            dist = (blockpos[:, :, None] - kpos[None, None, :]).astype(
+                jnp.float32)                       # (B, S, L)
+            ab = (-_alibi_slopes(c.num_heads)[None, :, None, None]
+                  * dist[:, None]).reshape(b, c.kv_heads, groups, s,
+                                           length)
+            scores = scores + ab
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bngst,bntk->bngsk", weights, cv)
+        o = o.reshape(b, c.num_heads, s, c.head_dim)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o,
+                           layer["attn"]["wo"].astype(c.dtype))
+        x = _mlp_apply(layer, x, c)
+    logits = head_logits(params["embed"], params["final_ln"], x,
                          head=params.get("head"), norm=c.norm)
     return logits, new_pool

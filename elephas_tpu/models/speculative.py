@@ -22,6 +22,16 @@ positions ride the vector-``pos`` support in ``decode_step`` /
 cache rollback (stale entries beyond a row's position are masked by
 the causal length mask and overwritten before they can be attended).
 
+:func:`speculative_round_paged` is the same round over a PAGED target
+cache (:mod:`.paged_decode`): the verify pass scatters its ``gamma+1``
+positions into the slot's own block table, so the "rollback" story is
+identical — rejected positions land in blocks the table already owns,
+masked until overwritten, and can never touch another slot's blocks
+(tables are disjoint by construction; the serving engine budgets the
+``gamma`` positions of verify slack into each slot's allocation). The
+draft model's cache stays contiguous in both variants: draft KV is
+small, private to the proposer, and never cached, shipped, or paged.
+
 The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 speculative decoding is a beyond-parity serving feature.
@@ -32,10 +42,12 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
+from .paged_decode import decode_block_paged
 from .transformer import (TransformerConfig, decode_block, decode_step,
                           prefill_cache)
 
-__all__ = ["speculative_generate", "speculative_round"]
+__all__ = ["speculative_generate", "speculative_round",
+           "speculative_round_paged"]
 
 
 def _pick(logits, key, temperature, greedy: bool):
@@ -44,6 +56,27 @@ def _pick(logits, key, temperature, greedy: bool):
     key, sub = jax.random.split(key)
     return jax.random.categorical(sub, logits / temperature,
                                   axis=-1).astype(jnp.int32), key
+
+
+def _draft_propose(draft_params, d_cache, last, p, gamma: int,
+                   draft_config: TransformerConfig, temperature, key,
+                   greedy: bool):
+    """The draft half of a round: propose ``gamma`` tokens
+    autoregressively on the draft's own rolling (contiguous) cache.
+    Returns ``(d (B, gamma), d_logits list, d_cache, key)``."""
+    dc = draft_config
+    tok, d_toks, d_logits = last, [], []
+    for j in range(gamma):
+        lg, d_cache = decode_step(draft_params, d_cache, tok, p + j, dc)
+        tok, key = _pick(lg, key, temperature, greedy)
+        d_toks.append(tok)
+        d_logits.append(lg)
+    # cache-advance: process the last proposal too, so a fully accepted
+    # round leaves no k/v hole at the next round's start (rejected
+    # rounds leave stale tail entries, which the causal mask hides
+    # until the next rounds overwrite them)
+    _, d_cache = decode_step(draft_params, d_cache, tok, p + gamma, dc)
+    return jnp.stack(d_toks, axis=1), d_logits, d_cache, key
 
 
 def speculative_round(params, draft_params, t_cache, d_cache, last, p,
@@ -63,26 +96,55 @@ def speculative_round(params, draft_params, t_cache, d_cache, last, p,
 
     Shared by :func:`speculative_generate`'s fused while_loop and the
     continuous-batching engine's per-step speculative mode (where the
-    host admits/retires requests between rounds).
+    host admits/retires requests between rounds); the accept/resample
+    math is shared with :func:`speculative_round_paged` so the two
+    cache layouts cannot drift.
     """
-    c, dc = config, draft_config
-    b = last.shape[0]
-    # ---- draft proposes gamma tokens (its own rolling cache)
-    tok, d_toks, d_logits = last, [], []
-    for j in range(gamma):
-        lg, d_cache = decode_step(draft_params, d_cache, tok, p + j, dc)
-        tok, key = _pick(lg, key, temperature, greedy)
-        d_toks.append(tok)
-        d_logits.append(lg)
-    # cache-advance: process the last proposal too, so a fully accepted
-    # round leaves no k/v hole at the next round's start (rejected
-    # rounds leave stale tail entries, which the causal mask hides
-    # until the next rounds overwrite them)
-    _, d_cache = decode_step(draft_params, d_cache, tok, p + gamma, dc)
-    d = jnp.stack(d_toks, axis=1)                    # (B, gamma)
+    c = config
+    d, d_logits, d_cache, key = _draft_propose(
+        draft_params, d_cache, last, p, gamma, draft_config, temperature,
+        key, greedy)
     # ---- target verifies the whole block in one forward
     block = jnp.concatenate([last[:, None], d], axis=1)
     t_logits, t_cache = decode_block(params, t_cache, block, p, c)
+    emit, a, nxt, key = _verify_emit(t_logits, d, d_logits, gamma,
+                                     temperature, key, greedy)
+    return emit, a, nxt, t_cache, d_cache, key
+
+
+def speculative_round_paged(params, draft_params, pool, tables, d_cache,
+                            last, p, gamma: int,
+                            config: TransformerConfig,
+                            draft_config: TransformerConfig, temperature,
+                            key, greedy: bool):
+    """:func:`speculative_round` over a PAGED target cache: the verify
+    pass runs :func:`~elephas_tpu.models.paged_decode.decode_block_paged`
+    against each row's block table, writing the round's ``gamma + 1``
+    positions into the row's OWN blocks (the verify slack the serving
+    engine budgets per slot). Returns ``(emit, a, nxt, pool, d_cache,
+    key)`` — the exact contract of the contiguous round with the pool
+    in the target cache's place. Rejected positions need no rollback:
+    they sit past the row's accepted position, are masked by the causal
+    length mask, and are overwritten by later rounds — and they can
+    never land in another slot's blocks (or a shared prefix-cache
+    block, which only ever covers positions below the prompt's
+    full-block head) because the scatter targets only the row's table."""
+    c = config
+    d, d_logits, d_cache, key = _draft_propose(
+        draft_params, d_cache, last, p, gamma, draft_config, temperature,
+        key, greedy)
+    block = jnp.concatenate([last[:, None], d], axis=1)
+    t_logits, pool = decode_block_paged(params, pool, tables, block, p, c)
+    emit, a, nxt, key = _verify_emit(t_logits, d, d_logits, gamma,
+                                     temperature, key, greedy)
+    return emit, a, nxt, pool, d_cache, key
+
+
+def _verify_emit(t_logits, d, d_logits, gamma: int, temperature, key,
+                 greedy: bool):
+    """The accept/resample rule on the target's verify logits —
+    layout-independent, shared by the contiguous and paged rounds."""
+    b = d.shape[0]
     if greedy:
         tgt = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
         match = (tgt[:, :gamma] == d).astype(jnp.int32)
@@ -119,7 +181,7 @@ def speculative_round(params, draft_params, t_cache, d_cache, last, p,
     slots = jnp.arange(gamma + 1)[None, :]
     d_pad = jnp.concatenate([d, jnp.zeros_like(nxt[:, None])], axis=1)
     emit = jnp.where(slots == a[:, None], nxt[:, None], d_pad)
-    return emit, a, nxt, t_cache, d_cache, key
+    return emit, a, nxt, key
 
 
 @partial(jax.jit, static_argnames=("prompt_len", "max_new_tokens", "gamma",

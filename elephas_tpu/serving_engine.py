@@ -30,7 +30,19 @@ draft-propose / target-verify round per slot, so a slot advances by
 ``1 + accepted`` tokens per host round trip — continuous batching and
 speculative decoding compose because both ride the same per-row cache
 positions (rows accept different counts and simply advance
-independently).
+independently). Speculative mode is a first-class SERVING mode: it
+composes with the paged pool (the verify pass scatters into the slot's
+own blocks — admission budgets ``gamma`` positions of verify slack per
+slot, and rejected positions are masked in the slot's own allocation,
+never a neighbor's), with the automatic prefix cache (the TARGET
+model's KV is the cacheable state — chain keys, admission, parking all
+unchanged; draft KV is recomputed at admission and never cached), and
+with disaggregated decode (``submit_prefilled`` installs shipped
+TARGET KV, then prefills the draft locally before the first round).
+Draft params hot-swap through their own channel
+(:meth:`stage_draft_params`) so a continuously re-distilled draft
+stays fresh: a stale draft costs acceptance rate — the verify pass is
+exact with respect to the target — never output correctness.
 
 Automatic prefix caching: with ``prefix_cache`` on (the DEFAULT in
 paged mode), the engine content-addresses every FULL ``block_size``
@@ -216,8 +228,9 @@ class DecodeEngine:
         a CAPACITY lever for oversubscribed serving (each step pays one
         extra gather pass over the cache; see
         :mod:`~elephas_tpu.models.paged_decode`). Composes with prefix
-        caching, chunked prefill, and multi-step; not with speculative
-        mode, ``kv_cache_quant``, or MoE.
+        caching, chunked prefill, multi-step, and speculative mode
+        (each slot's allocation budgets ``gamma`` extra positions of
+        verify slack); not with ``kv_cache_quant`` or MoE.
     :param max_queue: admission bound on the backlog of queued
         (not-yet-admitted) requests; a :meth:`submit` that would push the
         backlog past it raises :class:`QueueFullError` instead of
@@ -245,8 +258,11 @@ class DecodeEngine:
         (see the module docstring). ``None`` means "on in paged mode,
         off otherwise"; pass ``False`` to disable (the bench A/B
         baseline) or ``True`` to enable the host-array-backed cache on
-        a contiguous engine. Does not compose with speculative mode
-        (no draft KV in the cache).
+        a contiguous engine. Composes with speculative mode: the
+        TARGET model's KV is what gets cached (draft KV is recomputed
+        at admission, never cached), so chain keys stay seeded by the
+        target's ``weights_version`` and a draft swap invalidates
+        nothing.
     :param prefix_cache_block_size: cache granularity in tokens for the
         HOST-mode cache (contiguous engines; default 64). Paged engines
         always cache at the pool's ``block_size`` — passing a different
@@ -322,6 +338,11 @@ class DecodeEngine:
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = int(gamma)
+        # verify slack: a speculative round writes up to gamma positions
+        # past the last emitted token, so every capacity rule (the
+        # max_len bound AND the paged per-slot block budget) reserves
+        # gamma extra positions per slot
+        self._slack = self.gamma if draft_config is not None else 0
         self.steps_per_sync = int(steps_per_sync)
         if self.steps_per_sync < 1:
             raise ValueError("steps_per_sync must be >= 1")
@@ -334,9 +355,6 @@ class DecodeEngine:
             from .models.paged_decode import validate_paged_config
 
             num_blocks, block_size = int(paged[0]), int(paged[1])
-            if draft_config is not None:
-                raise ValueError("paged KV mode does not compose with "
-                                 "speculative stepping")
             validate_paged_config(config)
             if block_size < 1 or num_blocks < 2:
                 raise ValueError("paged needs block_size >= 1 and "
@@ -508,6 +526,31 @@ class DecodeEngine:
         self._m_proposed = reg.counter(
             "serving_draft_tokens_proposed_total",
             "speculative draft tokens proposed").labels()
+        if draft_config is not None:
+            self._m_spec_rounds = reg.counter(
+                "serving_speculative_rounds_total",
+                "draft-propose/target-verify rounds run (one per "
+                "active slot per step)").labels()
+            # the registry half of per-engine acceptance: the live
+            # accepted/proposed ratio as a scrapeable gauge (the same
+            # number stats/the fleet prober read — baselined like
+            # stats, so an injected shared registry's predecessor
+            # counts never pool in). NaN (not 0.0) before any
+            # proposal, mirroring stats' None: an idle replica must
+            # not trip a stale-draft (low-acceptance) alert
+            reg.gauge(
+                "serving_speculative_acceptance",
+                "draft acceptance rate (accepted / proposed draft "
+                "tokens, engine lifetime; NaN before any proposal)"
+                ).set_function(
+                lambda: (e._since_init(e._m_accepted) / p
+                         if (e := ref()) is not None
+                         and (p := e._since_init(e._m_proposed))
+                         else float("nan")))
+        # rid -> [accepted, proposed] draft-token counts for the
+        # request's flight-recorder terminal event (per-request
+        # acceptance observability; survives preemption — keyed by rid)
+        self._accept: Dict[int, List[int]] = {}
         if self.paged is not None:
             reg.gauge("serving_paged_blocks_free",
                       "allocatable KV blocks currently free"
@@ -522,6 +565,21 @@ class DecodeEngine:
         self.weights_version = 0
         self._staged_lock = threading.Lock()
         self._staged_params: Optional[Tuple] = None
+        # the DRAFT's own staging channel (speculative mode): a second
+        # WeightSubscriber keeps a continuously re-distilled draft
+        # fresh. Versioned independently of the target — draft chain
+        # keys never exist (draft KV is not cached), so a draft swap
+        # invalidates nothing and costs only the registered prefixes'
+        # draft-row recompute.
+        self.draft_weights_version = 0
+        self._staged_draft: Optional[Tuple] = None
+        if draft_config is not None:
+            reg.gauge("serving_draft_weights_version",
+                      "draft-model weight version currently proposing "
+                      "(0 = construction-time draft params)"
+                      ).set_function(
+                lambda: float(e.draft_weights_version)
+                if (e := ref()) is not None else 0.0)
         reg.gauge("serving_weights_version",
                   "weight version the engine is currently serving "
                   "(0 = construction-time params)").set_function(
@@ -730,7 +788,9 @@ class DecodeEngine:
             self._m_shed, self._m_expired, self._m_timed_out,
             self._m_accepted, self._m_proposed,
             self._m_prefix_hits, self._m_prefix_tokens,
-            self._m_weight_swaps)
+            self._m_weight_swaps,
+            *([self._m_spec_rounds] if draft_config is not None
+              else []))
 
         if draft_config is not None:
             from .models.speculative import speculative_round
@@ -759,6 +819,24 @@ class DecodeEngine:
             self._extend_draft_owned_fn = _make_extend(dcfg, donate=True)
             self._fresh_draft_row_fn = lambda: init_kv_cache(dcfg, 1,
                                                              max_len)
+            if self.paged is not None:
+                from .models.speculative import speculative_round_paged
+
+                @partial(jax.jit, donate_argnums=(2, 3))
+                def _spec_step_paged(params, draft_params, pool, d_cache,
+                                     tables, last, pos, key):
+                    # paged speculative round: the target verifies into
+                    # the slots' own block tables (verify slack budgeted
+                    # at admission); the draft cache stays contiguous
+                    emit, a, nxt, pool, d_cache, key = (
+                        speculative_round_paged(
+                            params, draft_params, pool, tables, d_cache,
+                            last, pos, g, cfg, dcfg,
+                            jnp.float32(temp if temp > 0 else 1.0), key,
+                            not temp > 0))
+                    return emit, a, nxt, pool, d_cache, key
+
+                self._spec_step_paged_fn = _spec_step_paged
 
     # ------------------------------------------------------------ warmup
     def warmup(self, prompt_lengths: Sequence[int] = ()):
@@ -783,7 +861,13 @@ class DecodeEngine:
         # engine's OWN cache (idle: every slot free, paged writes land
         # on scratch block 0) costs zero extra device memory — an
         # engine sized to fill the chip can still warm up
-        if self.paged is not None:
+        if self.paged is not None and self.draft_config is not None:
+            out = self._spec_step_paged_fn(
+                self.params, self.draft_params, self.pool,
+                self.draft_cache, jnp.asarray(self._tables),
+                dummy["last"], dummy["pos"], dummy["key"])
+            self.pool, self.draft_cache = out[3], out[4]
+        elif self.paged is not None:
             fn = (self._multi_step_paged_fn if self.steps_per_sync > 1
                   else self._step_paged_fn)
             _, self.pool, _ = fn(
@@ -1000,10 +1084,6 @@ class DecodeEngine:
         against a running engine loop. No-op when already enabled."""
         if self._kv_cache is not None:
             return
-        if self.draft_config is not None:
-            raise ValueError("prefix_cache does not compose with "
-                             "speculative mode (no draft KV in the "
-                             "cache)")
         from .models.block_cache import BlockCache
 
         if self.paged is not None:
@@ -1257,6 +1337,27 @@ class DecodeEngine:
             self._staged_params = (params, int(version), trace_id,
                                    time.monotonic())
 
+    def stage_draft_params(self, draft_params: Dict, version: int,
+                           trace_id: Optional[str] = None) -> None:
+        """Stage new DRAFT-model params for the same atomic
+        between-decode-steps swap as :meth:`stage_params` — the second
+        :class:`~elephas_tpu.weightsync.WeightSubscriber` channel that
+        keeps a continuously re-distilled draft
+        (:mod:`~elephas_tpu.models.distill`) fresh alongside the
+        target. Versioned independently (``draft_weights_version``);
+        safe from any thread, latest staging wins. A draft swap can
+        never change output: speculative sampling is exact with respect
+        to the TARGET model, so draft freshness buys acceptance rate
+        (tokens per round) and nothing else — which is also why draft
+        KV is never cached and no chain key ever hashes the draft
+        version."""
+        if self.draft_config is None:
+            raise ValueError("stage_draft_params needs a speculative "
+                             "engine (draft_params/draft_config)")
+        with self._staged_lock:
+            self._staged_draft = (draft_params, int(version), trace_id,
+                                  time.monotonic())
+
     def apply_staged_params(self) -> Optional[int]:
         """Apply a staged swap NOW, if any; returns the new version (or
         None). Must be called from whatever context owns the engine's
@@ -1271,6 +1372,9 @@ class DecodeEngine:
         measures exactly this blockage."""
         with self._staged_lock:
             staged, self._staged_params = self._staged_params, None
+            staged_draft, self._staged_draft = self._staged_draft, None
+        if staged_draft is not None:
+            self._apply_staged_draft(staged_draft)
         if staged is None:
             return None
         params, version, trace_id, staged_t = staged
@@ -1304,6 +1408,40 @@ class DecodeEngine:
                    pause_s=round(pause, 6))
         return int(version)
 
+    def _apply_staged_draft(self, staged: Tuple) -> None:
+        """Swap the draft params in (between decode steps — the caller
+        is :meth:`apply_staged_params`). In-flight requests keep their
+        draft KV computed under the OLD draft: mixed draft state skews
+        what the draft proposes, which only moves the acceptance rate —
+        the target's verify pass makes output exact regardless, so
+        unlike a target swap nothing needs recomputing for correctness.
+        Registered prefixes' draft rows ARE refreshed (one batch-1
+        draft prefill per pin) so steady-state acceptance doesn't decay
+        for pinned heads."""
+        draft_params, version, trace_id, staged_t = staged
+        t0 = time.monotonic()
+        self.draft_params = draft_params
+        self.draft_weights_version = int(version)
+        if self._prefixes:
+            fresh = []
+            for entry in self._prefixes:
+                toks = entry[0]
+                if self.prefill_chunk is not None:
+                    _, d_row = self._extend_chunked(
+                        self.draft_params, self._fresh_draft_row_fn(),
+                        toks, 0, self._extend_draft_fn,
+                        self._extend_draft_owned_fn, owned=True)
+                else:
+                    _, d_row = self._prefill_draft_fn(
+                        self.draft_params, jnp.asarray(toks[None]))
+                fresh.append((entry[0], entry[1], entry[2], d_row))
+            self._prefixes = fresh
+        emit_event("weights.draft_swapped", trace_id=trace_id,
+                   version=int(version), tier=self.tier,
+                   prefixes_recomputed=len(self._prefixes),
+                   staged_for_s=round(t0 - staged_t, 6),
+                   pause_s=round(time.monotonic() - t0, 6))
+
     # ------------------------------------------------------------ queue
     def check_admissible(self, prompt_size: int,
                          max_new_tokens: int,
@@ -1321,7 +1459,7 @@ class DecodeEngine:
         failing at KV-install time inside an engine loop."""
         # speculative rounds write verify blocks up to gamma positions
         # past the last emitted token
-        slack = self.gamma if self.draft_config is not None else 0
+        slack = self._slack
         if prompt_size + max_new_tokens + slack > self.max_len:
             raise ValueError(
                 f"prompt ({prompt_size}) + max_new_tokens "
@@ -1329,7 +1467,11 @@ class DecodeEngine:
                 + (f" + gamma ({slack})" if slack else "")
                 + f" exceeds max_len {self.max_len}")
         if self.paged is not None:
-            needed = -(-(prompt_size + max_new_tokens) // self.paged[1])
+            # the same slack bounds the paged budget: verify writes land
+            # up to gamma positions past the budgeted output, so the
+            # slot's table must own those blocks too
+            needed = -(-(prompt_size + max_new_tokens + slack)
+                       // self.paged[1])
             allocatable = self.paged[0] - 1     # block 0 never allocates
             if self._kv_cache is not None:
                 # PINNED registered-prefix blocks are never reclaimable
@@ -1435,8 +1577,10 @@ class DecodeEngine:
         request's queue wait is pure decode-stage backlog. Everything
         else (admission bounds, deadlines, sampling overrides for the
         DECODE steps, cancel, results) behaves exactly like
-        :meth:`submit`. Not supported in speculative mode (the draft
-        model's KV is not shipped).
+        :meth:`submit`. On a SPECULATIVE engine the shipped blocks are
+        the TARGET model's KV (the prefill tier runs target-only);
+        admission prefills the draft locally before the first round —
+        draft KV never crosses the wire.
 
         ``weights_version`` stamps which LIVE weight version the KV was
         computed under: admission re-checks it against the engine's
@@ -1447,9 +1591,6 @@ class DecodeEngine:
         falls back to a LOCAL prefill of the prompt (correct output,
         one admission's worth of extra compute on this engine) rather
         than failing the request; ``None`` skips the check."""
-        if self.draft_config is not None:
-            raise ValueError("submit_prefilled does not compose with "
-                             "speculative mode (no draft KV on the wire)")
         # shape/coverage validation happens HERE, at submit: a malformed
         # KV payload failing at admission time would raise inside the
         # server's engine loop and read as engine death (500s for
@@ -1639,13 +1780,19 @@ class DecodeEngine:
         ``kv_blocks`` is the host-side block-unit KV export
         (:func:`~elephas_tpu.models.paged_decode.export_kv_blocks`) a
         decode worker feeds to :meth:`submit_prefilled` — directly, or
-        over the wire via :mod:`elephas_tpu.disagg`. Not supported in
-        speculative mode (no draft KV export)."""
+        over the wire via :mod:`elephas_tpu.disagg`. Not supported on a
+        SPECULATIVE engine: draft KV never ships — run the prefill tier
+        on plain target-only engines and give the DECODE workers the
+        draft (they recompute draft KV at admission)."""
         from .models.paged_decode import export_kv_blocks
 
         if self.draft_config is not None:
-            raise ValueError("export_prefill does not compose with "
-                             "speculative mode (no draft KV export)")
+            raise ValueError(
+                "export_prefill does not compose with speculative mode:"
+                " draft KV never ships — run the prefill tier on plain "
+                "(target-only) engines; speculative DECODE workers "
+                "accept shipped target KV via submit_prefilled and "
+                "recompute draft KV at admission")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -1762,8 +1909,8 @@ class DecodeEngine:
         if tenant is not None and self.qos is not None:
             depth = self._queue.tenant_depth(tenant)
         if self._latency_window:
-            med = float(np.quantile([t for _, t in self._latency_window],
-                                    0.5))
+            med = float(np.quantile(
+                [t for _, t, _ in self._latency_window], 0.5))
             est = 1000.0 * med * max(1, depth) / self.max_slots
         else:
             est = 100.0
@@ -1786,6 +1933,7 @@ class DecodeEngine:
             # un-surfaced admission token: the next step() must not
             # report tokens for a cancelled rid
             self._fresh.pop(rid, None)
+            self._accept.pop(rid, None)
             self.recorder.record(rid, "cancelled", stage="queued")
             return True
         for slot, r in enumerate(self._rid):
@@ -1795,6 +1943,7 @@ class DecodeEngine:
                 tokens = len(self._outputs.get(rid, ()))
                 self._outputs.pop(rid, None)
                 self._fresh.pop(rid, None)
+                self._accept.pop(rid, None)
                 self._rid[slot] = None
                 self._release_blocks(slot)
                 self._clear_slot_meta(slot)
@@ -1836,9 +1985,13 @@ class DecodeEngine:
                 self._done[rid] = saved["outputs"]
                 self._timed_out.add(rid)
                 self._m_timed_out.inc()
+                a_p = self._accept.pop(rid, None)
                 self.recorder.record(
                     rid, "timed_out", stage="preempted_queued",
-                    tokens=len(saved["outputs"]))
+                    tokens=len(saved["outputs"]),
+                    **({} if a_p is None
+                       else {"draft_accepted": a_p[0],
+                             "draft_proposed": a_p[1]}))
             else:
                 self._done[rid] = []
                 self._expired.add(rid)
@@ -1893,7 +2046,11 @@ class DecodeEngine:
                 nxt_rid, nxt_prompt, nxt_max_new = (cand.rid, cand.prompt,
                                                     cand.max_new)
                 bsz = self.paged[1]
-                needed = -(-(nxt_prompt.size + nxt_max_new) // bsz)
+                # verify slack rides every paged allocation in
+                # speculative mode (zero otherwise) — the blocks the
+                # rejected-tail writes are confined to
+                needed = -(-(nxt_prompt.size + nxt_max_new
+                             + self._slack) // bsz)
                 hits = []
                 if (self._kv_cache is not None
                         and nxt_rid not in self._prefilled_kv):
@@ -2199,6 +2356,10 @@ class DecodeEngine:
             logits, row, reused, reg_used = self._host_cache_prefill(
                 rid, prompt)
             self.cache = self._install_fn(self.cache, row, slot)
+            if self.draft_config is not None:
+                # the cache served (some of) the TARGET's prefill; the
+                # draft's KV is never cached and recomputes in full
+                self._install_draft_row(slot, prompt)
             t0 = self._sample_first(logits, temp, topk, topp)
             self.recorder.record(
                 rid, "prefill", prompt_tokens=int(prompt.size),
@@ -2229,13 +2390,7 @@ class DecodeEngine:
             self.cache = self._install_fn(self.cache, row_cache,
                                           slot)
         if self.draft_config is not None:
-            _, d_row = self._prefill_with_prefixes(
-                prompt, self._extend_draft_fn,
-                self._extend_draft_owned_fn,
-                self._prefill_draft_fn, self.draft_params, entry,
-                3, self._fresh_draft_row_fn)
-            self.draft_cache = self._install_draft_fn(
-                self.draft_cache, d_row, slot)
+            self._install_draft_row(slot, prompt, entry=entry)
         t0 = self._sample_first(logits, temp, topk, topp)
         self.recorder.record(
             rid, "prefill", prompt_tokens=int(prompt.size),
@@ -2297,6 +2452,11 @@ class DecodeEngine:
                                       self._tables[slot], nprefill,
                                       start=j)
         self._insert_full_blocks(slot, prompt, skip=j, rid=rid)
+        if self.draft_config is not None:
+            # speculative paged admission: the chain hit (or miss) above
+            # served the TARGET cache only — the draft recomputes its
+            # whole-prompt KV into its contiguous cache
+            self._install_draft_row(slot, prompt)
         t0 = self._sample_first(logits, temp, topk, topp)
         self.recorder.record(
             rid, "prefill", prompt_tokens=int(prompt.size),
@@ -2305,6 +2465,29 @@ class DecodeEngine:
             prefix_tokens=int(reused),
             duration_s=round(time.monotonic() - self._admit_t[rid], 6))
         return t0
+
+    def _install_draft_row(self, slot: int, prompt: np.ndarray,
+                           entry=...) -> None:
+        """Prefill the DRAFT model's KV for ``prompt`` and install it
+        into the slot's contiguous draft cache — the admission step
+        speculative mode adds on every admission path: the classic
+        prefill (which passes its already-matched ``entry``) and every
+        path where the TARGET's prefill was (partly) served from
+        elsewhere: a prefix-cache hit, a shipped disaggregated frame.
+        Draft KV is proposer-private state — never cached, shipped, or
+        paged — so it is recomputed here under the CURRENT draft
+        params, which also means no admission can ever decode over
+        draft state from an older draft version. A registered prefix's
+        draft row still serves as the head (``entry`` is the
+        ``_match_prefix`` result; ``...`` = look it up here)."""
+        if entry is ...:
+            entry = self._match_prefix(prompt)
+        _, d_row = self._prefill_with_prefixes(
+            prompt, self._extend_draft_fn, self._extend_draft_owned_fn,
+            self._prefill_draft_fn, self.draft_params, entry, 3,
+            self._fresh_draft_row_fn)
+        self.draft_cache = self._install_draft_fn(self.draft_cache,
+                                                  d_row, slot)
 
     def _sample_first(self, logits, temp: float, topk: int,
                       topp: float) -> int:
@@ -2345,6 +2528,11 @@ class DecodeEngine:
                                           self._tables[slot], nprefill)
         else:
             self.cache = self._install_fn(self.cache, row, slot)
+        if self.draft_config is not None:
+            # disaggregated speculative decode: the shipped frame holds
+            # TARGET KV only — prefill the draft locally BEFORE the
+            # first draft round (draft KV never crosses the wire)
+            self._install_draft_row(slot, prompt)
         return int(t0)
 
     def _record(self, slot: int, tok: int) -> bool:
@@ -2401,15 +2589,24 @@ class DecodeEngine:
         t_sub = self._submit_t.pop(rid, None)
         t_adm = self._admit_t.pop(rid, now)
         if t_sub is not None:
-            self._latency_window.append((t_adm - t_sub, now - t_sub))
+            self._latency_window.append((t_adm - t_sub, now - t_sub,
+                                         len(self._done[rid])))
             self._m_queue_wait.observe(t_adm - t_sub)
             self._m_request_latency.observe(now - t_sub)
         self._trace_ctx.pop(rid, None)
+        extra = {}
+        a_p = self._accept.pop(rid, None)
+        if a_p is not None:
+            # per-request speculative acceptance on the terminal event:
+            # the counters answer "how is the engine doing", this
+            # answers "how did THIS request's draft do"
+            extra = {"draft_accepted": a_p[0], "draft_proposed": a_p[1]}
         self.recorder.record(
             rid, outcome, tokens=len(self._done[rid]),
             queue_wait_s=(None if t_sub is None
                           else round(t_adm - t_sub, 6)),
-            total_s=(None if t_sub is None else round(now - t_sub, 6)))
+            total_s=(None if t_sub is None else round(now - t_sub, 6)),
+            **extra)
         return rid
 
     def _finish(self, slot: int):
@@ -2494,8 +2691,17 @@ class DecodeEngine:
             out["tenants"] = tenants
         out["tier"] = self.tier
         if self._latency_window:
-            totals = [t for _, t in self._latency_window]
-            waits = [w for w, _ in self._latency_window]
+            totals = [t for _, t, _ in self._latency_window]
+            waits = [w for w, _, _ in self._latency_window]
+            # per-request decode rate: tokens delivered per second of a
+            # request's wall time — with the acceptance rate, THE pair
+            # of numbers that says what speculation is buying (surfaced
+            # per replica on the fleet router's /stats)
+            rates = [n / t for _, t, n in self._latency_window
+                     if t > 0 and n > 0]
+            if rates:
+                out["request_tokens_per_s_p50"] = round(
+                    float(np.quantile(rates, 0.5)), 3)
             out["latency_p50_s"] = round(float(np.quantile(totals, 0.5)),
                                          4)
             out["latency_p99_s"] = round(float(np.quantile(totals, 0.99)),
@@ -2511,9 +2717,16 @@ class DecodeEngine:
                 float(np.quantile(waits, 0.99)), 6)
         if self.draft_config is not None:
             proposed = self._since_init(self._m_proposed)
+            # None (not 0.0) before any proposal: an idle or freshly
+            # scaled-up replica must not read as a zero-acceptance
+            # (stale-draft) signal — the fleet prober's
+            # draft_acceptance_min skips None
             out["draft_acceptance"] = (
                 self._since_init(self._m_accepted) / proposed
-                if proposed else 0.0)
+                if proposed else None)
+            out["speculative_rounds"] = int(
+                self._since_init(self._m_spec_rounds))
+            out["draft_weights_version"] = int(self.draft_weights_version)
         return out
 
     def _since_init(self, metric) -> float:
@@ -2532,7 +2745,8 @@ class DecodeEngine:
         idle server's engine loop must still pick it up within one
         idle-sleep, not wait for the next request."""
         with self._staged_lock:
-            staged = self._staged_params is not None
+            staged = (self._staged_params is not None
+                      or self._staged_draft is not None)
         return (len(self._queue)
                 + sum(r is not None for r in self._rid)
                 + len(self._fresh)
@@ -2570,17 +2784,31 @@ class DecodeEngine:
         if self.draft_config is not None:
             # speculative round: every active slot advances by its own
             # 1 + accepted tokens in one dispatch
-            emit, acc, nxt, self.cache, self.draft_cache, self._key = (
-                self._spec_step_fn(self.params, self.draft_params,
-                                   self.cache, self.draft_cache,
-                                   jnp.asarray(self._last),
-                                   jnp.asarray(pos), self._key))
+            if self.paged is not None:
+                (emit, acc, nxt, self.pool, self.draft_cache,
+                 self._key) = self._spec_step_paged_fn(
+                    self.params, self.draft_params, self.pool,
+                    self.draft_cache, jnp.asarray(self._tables),
+                    jnp.asarray(self._last), jnp.asarray(pos),
+                    self._key)
+            else:
+                emit, acc, nxt, self.cache, self.draft_cache, self._key \
+                    = self._spec_step_fn(
+                        self.params, self.draft_params, self.cache,
+                        self.draft_cache, jnp.asarray(self._last),
+                        jnp.asarray(pos), self._key)
             emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
                               np.asarray(nxt))
             self._m_accepted.inc(int(acc[active].sum()))
             self._m_proposed.inc(self.gamma * int(active.sum()))
+            self._m_spec_rounds.inc(int(active.sum()))
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
+                # per-request acceptance for the flight recorder's
+                # terminal event (engine counters above are pooled)
+                a_p = self._accept.setdefault(rid, [0, 0])
+                a_p[0] += int(acc[slot])
+                a_p[1] += self.gamma
                 self._pos[slot] += 1 + acc[slot]
                 self._last[slot] = nxt[slot]
                 for tok in emit[slot, :acc[slot] + 1]:

@@ -76,14 +76,37 @@ class WeightSubscriber:
     :param registry: metrics destination (defaults to the engine's, so
         one ``/metrics`` scrape covers serving and its subscriber).
     :param name: label for events.
+    :param channel: ``"target"`` (default) stages through
+        ``engine.stage_params`` — the classic serving-weights channel.
+        ``"draft"`` stages through ``engine.stage_draft_params``: the
+        SECOND subscription a speculative engine runs so a continuously
+        re-distilled draft model (:mod:`~elephas_tpu.models.distill`)
+        retrains alongside the target and rolls out like any other
+        version. The default converter then derives its treedef/dtypes
+        from ``engine.draft_params``, and :meth:`wait_for_version`
+        watches ``draft_weights_version``. A draft rollout needs no KV
+        gating anywhere: a stale (or mid-bake) draft moves the
+        acceptance rate only — the target's verify pass keeps output
+        exact — which is also what makes the draft channel safe to
+        canary with the same :class:`~.canary.CanaryController`
+        machinery (its health verdicts read request latency/shed
+        deltas, which is exactly where a bad draft shows up).
     """
 
     def __init__(self, engine, client, poll_interval: float = 0.25,
                  auto: bool = True,
                  convert: Optional[Callable] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 name: str = "weightsync"):
+                 name: str = "weightsync", channel: str = "target"):
+        if channel not in ("target", "draft"):
+            raise ValueError(f"channel must be 'target' or 'draft', "
+                             f"got {channel!r}")
+        if (channel == "draft"
+                and getattr(engine, "draft_params", None) is None):
+            raise ValueError("channel='draft' needs a speculative "
+                             "engine (draft_params/draft_config)")
         self.engine = engine
+        self.channel = channel
         self.client = client
         self.poll_interval = float(poll_interval)
         self.auto = bool(auto)
@@ -94,7 +117,7 @@ class WeightSubscriber:
         # loop applies it), plus the previous staging for rollback.
         # At construction the engine's params are "whatever it was
         # built with" — version token None, numeric engine.weights_version.
-        self._current = (None, getattr(engine, "params", None))
+        self._current = (None, self._engine_params())
         self._previous = None
         # tokens a rollback disproved: auto mode must not re-pull a
         # version the canary just rolled back (the next PS delta mints
@@ -304,15 +327,28 @@ class WeightSubscriber:
         except NotImplementedError:
             return self.client.get_parameters_versioned()
 
+    def _engine_params(self):
+        """The engine pytree this subscriber's channel manages — the
+        treedef/dtype source for the default converter and the
+        construction-time rollback generation."""
+        if self.channel == "draft":
+            return getattr(self.engine, "draft_params", None)
+        return getattr(self.engine, "params", None)
+
+    def _stage_fn(self):
+        return (self.engine.stage_draft_params
+                if self.channel == "draft"
+                else self.engine.stage_params)
+
     def _stage(self, token, params):
         tid = current_trace_id()
         with self._lock:
             self._previous = self._current
             self._current = (token, params)
             self._seen = token
-        self.engine.stage_params(params, numeric_version(token),
-                                 trace_id=tid)
+        self._stage_fn()(params, numeric_version(token), trace_id=tid)
         emit_event("weights.staged", subscriber=self.name,
+                   channel=self.channel,
                    version=numeric_version(token),
                    token=str(token))
 
@@ -335,9 +371,10 @@ class WeightSubscriber:
         self._m_rollbacks.inc()
         # numeric_version(None) == 0: restoring the construction-time
         # params restores version 0, the number they were serving as
-        self.engine.stage_params(params, numeric_version(token),
-                                 trace_id=current_trace_id())
+        self._stage_fn()(params, numeric_version(token),
+                         trace_id=current_trace_id())
         emit_event("weights.rollback_staged", subscriber=self.name,
+                   channel=self.channel,
                    bad_token=str(bad[0]), restored_token=str(token))
         return token
 
@@ -347,11 +384,13 @@ class WeightSubscriber:
         """Block until the engine SERVES numeric ``version`` (the swap
         applied, not merely staged). ``step``: optional zero-arg
         callable invoked each wait tick for engines nobody else is
-        stepping (tests driving a bare engine)."""
+        stepping (tests driving a bare engine). Draft-channel
+        subscribers watch ``draft_weights_version``."""
+        attr = ("draft_weights_version" if self.channel == "draft"
+                else "weights_version")
         deadline = time.monotonic() + float(timeout)
         while time.monotonic() < deadline:
-            if int(getattr(self.engine, "weights_version", -1)) == int(
-                    version):
+            if int(getattr(self.engine, attr, -1)) == int(version):
                 return True
             if step is not None:
                 step()
@@ -360,14 +399,16 @@ class WeightSubscriber:
 
     def _to_params(self, weights):
         """Default conversion: unflatten the PS's flat weight list into
-        the engine's current parameter treedef, casting each leaf to
-        the engine leaf's dtype ON THIS THREAD (the device transfer is
-        the expensive half of a swap — it must not run on the engine
-        loop)."""
+        the CHANNEL's current parameter treedef (``engine.params``, or
+        ``engine.draft_params`` for the draft channel), casting each
+        leaf to the engine leaf's dtype ON THIS THREAD (the device
+        transfer is the expensive half of a swap — it must not run on
+        the engine loop)."""
         import jax
         import jax.numpy as jnp
 
-        leaves, treedef = jax.tree_util.tree_flatten(self.engine.params)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            self._engine_params())
         if len(weights) != len(leaves):
             raise ValueError(
                 f"parameter plane serves {len(weights)} tensors but the "

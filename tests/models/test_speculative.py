@@ -227,3 +227,57 @@ def test_model_surface():
     ref = model.generate(prompt, 8)
     spec = model.speculative_generate(draft, prompt, 8, gamma=3)
     np.testing.assert_array_equal(ref, spec)
+
+
+# paged mode excludes kvq (no int8 pool) and moe (validate_paged_config)
+PAGED_VARIANTS = {k: v for k, v in VARIANTS.items()
+                  if k not in ("kvq", "moe")}
+
+
+@pytest.mark.parametrize("variant", sorted(PAGED_VARIANTS))
+def test_decode_block_paged_matches_decode_block(variant):
+    """The paged verify primitive == the contiguous one on every
+    paged-compatible config variant (GQA grouping, window mask, ALiBi
+    and sinusoidal position math), at RAGGED per-row positions: same
+    logits, and the written pool positions gather back to the same
+    cache contents. The engine-level speculative tests drive only the
+    default variant, so this is where the variant branches are pinned."""
+    from elephas_tpu.models.paged_decode import (decode_block_paged,
+                                                 gather_blocks_to_row,
+                                                 init_paged_pool,
+                                                 install_row_paged)
+
+    config = _config(**PAGED_VARIANTS[variant])
+    params = init_params(config, jax.random.PRNGKey(0))
+    bs, max_len, s = 8, 32, 4
+    lens = [3, 6, 9]                               # ragged rows
+    nb = max_len // bs
+    pool = init_paged_pool(config, 1 + len(lens) * nb, bs)
+    tables, row_caches = [], []
+    for r, n in enumerate(lens):
+        prompt = jax.random.randint(jax.random.PRNGKey(10 + r), (1, n),
+                                    0, config.vocab_size)
+        _, row = prefill_cache(params, prompt, config, max_len)
+        row_caches.append(row)
+        ids = [1 + r * nb + j for j in range(nb)]
+        pool = install_row_paged(pool, row, ids, nb)
+        tables.append(ids)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (len(lens), s), 0,
+                              config.vocab_size)
+
+    paged_logits, pool = decode_block_paged(
+        params, pool, jnp.asarray(tables), toks,
+        jnp.asarray(lens, jnp.int32), config)
+
+    for r, n in enumerate(lens):
+        ref_logits, ref_cache = decode_block(params, row_caches[r],
+                                             toks[r:r + 1], n, config)
+        np.testing.assert_allclose(np.asarray(paged_logits[r]),
+                                   np.asarray(ref_logits[0]), atol=2e-5)
+        got = gather_blocks_to_row(pool, jnp.asarray(tables[r]), max_len)
+        for name in ref_cache:
+            for kk in ("k", "v"):
+                np.testing.assert_allclose(
+                    np.asarray(got[name][kk][0, :, :n + s]),
+                    np.asarray(ref_cache[name][kk][0, :, :n + s]),
+                    atol=1e-5)

@@ -463,8 +463,13 @@ def test_metrics_and_stats_surfaces(model):
     assert ks["hits"] == 2 and ks["misses"] == 1
 
 
-def test_speculative_mode_rejects_prefix_cache(model):
+def test_speculative_mode_composes_with_prefix_cache(model):
+    """Speculative mode caches the TARGET model's KV like any other
+    engine (draft KV is recomputed at admission, never cached) — the
+    former constructor rejection is gone; the full hit/parity story is
+    pinned in tests/test_speculative_serving.py."""
     params, config = model
-    with pytest.raises(ValueError, match="speculative"):
-        DecodeEngine(params, config, draft_params=params,
-                     draft_config=config, prefix_cache=True)
+    eng = DecodeEngine(params, config, draft_params=params,
+                       draft_config=config, prefix_cache=True,
+                       prefix_cache_block_size=8)
+    assert eng._kv_cache is not None and eng.draft_config is not None
