@@ -1775,6 +1775,178 @@ def measure_tenant_qos(smoke=False):
                        "passes (warm pass compiles first)")}
 
 
+def measure_slo_plane(smoke=False):
+    """SLO-plane row: the observability layer's own cost and efficacy.
+    Three claims measured: (1) the engine-loop continuous profiler
+    costs <=2% tokens/s — verdict from the DETERMINISTIC form
+    (per-iteration instrumentation cost, micro-timed, over this run's
+    median step latency; ~10-20us vs a >=1ms step), with the
+    interleaved on/off tokens/s A/B reported as corroboration (CPU
+    step jitter is +-3-5% over seconds, wider than the effect, so the
+    wall-clock ratio alone cannot carry the verdict); (2) the TTFT /
+    inter-token decomposition is populated (p50/p95 reported, plus the
+    loop-utilization split and jit-compile count off the same run);
+    (3) a forced latency regression drives the TTFT burn rate over
+    threshold — exactly one ``slo.burn_rate_exceeded`` fires — and the
+    alert recovers once the regression clears (the tracker's clock is
+    injected, so the window arithmetic is deterministic; the TTFT
+    samples are real)."""
+    import jax
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs import SLOObjective, SLOTracker, default_event_log
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        dims = dict(vocab_size=300, num_layers=2, num_heads=4,
+                    d_model=32, d_ff=64)
+        n_requests, prompt_len, max_new, slots = 24, 8, 32, 2
+    else:
+        dims = dict(vocab_size=2000, num_layers=2, num_heads=8,
+                    d_model=128, d_ff=512)
+        n_requests, prompt_len, max_new, slots = 24, 16, 48, 4
+    c = TransformerConfig(**dims, max_seq_len=prompt_len + max_new)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [np.asarray(rng.integers(0, c.vocab_size, prompt_len))
+               for _ in range(n_requests)]
+    total = n_requests * max_new
+
+    def drain_tps(eng):
+        start = time.perf_counter()
+        eng.run(prompts, max_new)
+        return total / (time.perf_counter() - start)
+
+    off = DecodeEngine(params, c, max_slots=slots, profiler=False)
+    on = DecodeEngine(params, c, max_slots=slots)
+    for eng in (off, on):
+        # warmup() first: admission compiles must land neither in the
+        # measured drains nor in the TTFT quantile window this row
+        # reports (a compile storm is the JIT series' story, not the
+        # steady-state decomposition's)
+        eng.warmup(prompt_lengths=[prompt_len])
+        drain_tps(eng)                      # shape warm
+    # INTERLEAVED rounds (off, on, off, on, ...): each round's pair
+    # runs back to back so the per-round ratio cancels process-level
+    # drift, and the median rejects scheduler-noise rounds. Even so,
+    # CPU step time wanders ±3-5% over seconds (XLA/scheduler jitter —
+    # an off-vs-off null shows the same spread), which SWAMPS a ~1%
+    # effect: the ratio is reported as corroboration, while the
+    # overhead VERDICT uses the deterministic form below — the
+    # per-iteration instrumentation sequence micro-timed in isolation,
+    # as a fraction of the run's own median step latency.
+    rounds = 9
+    samples = {id(off): [], id(on): []}
+    for _ in range(rounds):
+        for eng in (off, on):
+            samples[id(eng)].append(drain_tps(eng))
+    per_round = sorted(b / a for a, b in zip(samples[id(off)],
+                                             samples[id(on)]))
+    ratio = per_round[rounds // 2]
+    off_tps = sorted(samples[id(off)])[rounds // 2]
+    on_tps = sorted(samples[id(on)])[rounds // 2]
+    stats = on.stats
+    loop = stats["loop"]
+
+    # deterministic overhead: cost of one iteration's worth of
+    # instrumentation (tick + the steady-state decode/emit sections)
+    # over the median engine step this very run measured
+    from elephas_tpu.obs import LoopProfiler, MetricsRegistry
+
+    mprof = LoopProfiler(MetricsRegistry(), track_jit=False)
+    mprof.tick()
+    m = 2000
+    t0 = time.perf_counter()
+    for _ in range(m):
+        mprof.tick()
+        with mprof.section("decode"):
+            pass
+        with mprof.section("emit"):
+            pass
+    cost_s = (time.perf_counter() - t0) / m
+    step_p50 = on.registry.get(
+        "serving_step_latency_seconds").labels().quantile(0.5)
+    overhead_frac = cost_s / step_p50 if step_p50 else 0.0
+
+    # forced burn-rate alert on the profiled engine's own registry:
+    # clean baseline -> a slow-step regression breaches the TTFT bound
+    # -> fires once -> clearing the regression recovers it
+    clk = [0.0]
+    tracker = SLOTracker(
+        [SLOObjective.latency("ttft_p95", "serving_ttft_seconds",
+                              bound_s=max(0.05, 4 * stats["ttft_p95_s"]),
+                              target=0.5)],
+        on.registry, fast_window_s=10.0, slow_window_s=30.0,
+        burn_threshold=1.5, clock=lambda: clk[0], name="slo_bench")
+    tracker.evaluate()                       # baseline sample
+
+    class _SlowStep:                         # the regression injector
+        def __init__(self, eng, delay_s):
+            self.eng, self.delay_s = eng, delay_s
+
+        def run(self, reqs, new):
+            # admit=False: admission (and the first token) happens in
+            # step(), AFTER the injected stall — TTFT breaches
+            rids = [self.eng.submit(p, new, admit=False) for p in reqs]
+            while self.eng.pending:
+                time.sleep(self.delay_s)
+                self.eng.step()
+            return [self.eng.result(r) for r in rids]
+
+    bound = tracker.objectives[0].detail["bound_s"]
+    _SlowStep(on, 2 * bound).run(prompts[:slots], 2)
+    clk[0] += 11.0
+    fired = tracker.evaluate()["objectives"]["ttft_p95"]["state"]
+    on.run(prompts, max_new)                 # regression cleared: fast,
+    clk[0] += 11.0                           # breaching samples age out
+    recovered = tracker.evaluate()["objectives"]["ttft_p95"]["state"]
+    alerts = [e for e in default_event_log().recent(
+        "slo.burn_rate_exceeded") if e.get("source") == "slo_bench"]
+    # the deterministic invariants HARD-ASSERT (the speculative row's
+    # token-identity convention): the CI smoke step exists so this row
+    # cannot rot, which requires a broken alert pipeline or a blown
+    # overhead budget to FAIL the step, not print a sad JSON field
+    assert fired == "firing", \
+        f"forced TTFT regression did not fire the alert (state={fired})"
+    assert recovered == "ok", \
+        f"alert did not recover after the regression cleared " \
+        f"(state={recovered})"
+    assert len(alerts) == 1, \
+        f"expected exactly one slo.burn_rate_exceeded, got {len(alerts)}"
+    assert overhead_frac <= 0.02, \
+        f"profiler instrumentation cost {cost_s * 1e6:.1f}us/iter is " \
+        f"{overhead_frac:.1%} of the {step_p50 * 1e3:.2f}ms median " \
+        f"step (budget 2%)"
+    return {"metric": "slo_plane_profiler_overhead_frac",
+            "value": round(overhead_frac, 5),
+            "unit": ("instrumentation cost per iteration / median "
+                     "step wall time (claim <= 0.02)"),
+            "profiler_overhead_ok": overhead_frac <= 0.02,
+            "profiler_cost_us_per_iter": round(cost_s * 1e6, 2),
+            "step_p50_ms": round(step_p50 * 1e3, 3),
+            "tps_ratio_on_off": round(ratio, 4),
+            "tokens_per_sec_profiler_off": round(off_tps, 1),
+            "tokens_per_sec_profiler_on": round(on_tps, 1),
+            "ttft_p50_s": stats.get("ttft_p50_s"),
+            "ttft_p95_s": stats.get("ttft_p95_s"),
+            "inter_token_p50_s": stats.get("inter_token_p50_s"),
+            "loop_utilization": loop["utilization"],
+            "jit_compiles": loop["jit_compiles"],
+            "alert_fired": fired == "firing",
+            "alert_recovered": recovered == "ok",
+            "alerts_emitted": len(alerts),
+            "slo_plane_ok": (fired == "firing" and recovered == "ok"
+                             and len(alerts) == 1),
+            "config": (f"L{c.num_layers} d{c.d_model} ff{c.d_ff} "
+                       f"V{c.vocab_size} {slots} slots, {n_requests} "
+                       f"reqs x {prompt_len}tok/{max_new}new, greedy; "
+                       "tps ratio = median of 9 per-round paired drains; tps per "
+                       "engine; alert = TTFT-p95 objective, injected "
+                       "slow-step regression, fake-clock windows "
+                       "(fast 10s / slow 30s, threshold 1.5)")}
+
+
 def _stage_percentiles(recorder, n: int) -> dict:
     """Queue-wait and prefill p50/p99 derived from the newest ``n``
     flight-recorder timelines — the BENCH record's per-stage latency
@@ -2053,6 +2225,8 @@ if __name__ == "__main__":
         _emit(measure_tenant_qos(smoke=smoke))
     if which in ("autoscaler", "all"):
         _emit(measure_autoscaler(smoke=smoke))
+    if which in ("slo_plane", "all"):
+        _emit(measure_slo_plane(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

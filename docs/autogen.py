@@ -157,6 +157,10 @@ PAGES = [
     ("Event log API", "elephas_tpu.obs.events",
      ["EventLog", "FlightRecorder", "default_event_log", "emit",
       "recent_events", "clear_events"]),
+    ("Loop profiler API", "elephas_tpu.obs.profiler",
+     ["LoopProfiler"]),
+    ("SLO plane API", "elephas_tpu.obs.slo",
+     ["SLOObjective", "SLOTracker"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
      ["encode_tensors", "decode_tensors", "encode", "decode"]),
     ("Delta compression", "elephas_tpu.utils.delta_compression",

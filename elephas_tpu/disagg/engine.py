@@ -224,6 +224,11 @@ class DisaggEngine:
             self._stage[rid] = {"state": "queued", "job": job,
                                 "drid": None, "deadline": deadline,
                                 "retries": 0, "tenant": tenant,
+                                # the CLIENT-submit stamp, passed to
+                                # submit_prefilled at KV install so
+                                # the decode engine's TTFT includes
+                                # the prefill tier's queue+ship time
+                                "submit_mono": time.monotonic(),
                                 "ptokens": (int(prompt.size)
                                             if tenant is not None
                                             else 0)}
@@ -554,7 +559,12 @@ class DisaggEngine:
                         weights_version=(None if wire_v is None
                                          else int(wire_v)),
                         tenant=meta.get("tenant"),
-                        priority=meta.get("priority"))
+                        priority=meta.get("priority"),
+                        # TTFT measures from the CLIENT's submit: the
+                        # prefill tier's queue wait, compute, and KV
+                        # ship all land inside it (queue-wait series
+                        # stay pure decode-stage, by design)
+                        submitted_at=st.get("submit_mono"))
             except QueueFullError:
                 # the decode engine's own admission bound (or an
                 # injected serving.submit shed): TRANSIENT — put this

@@ -462,9 +462,18 @@ class FleetAutoscaler:
             reasons.append("queue_wait_p99")
         if depth_per > policy.high_depth:
             reasons.append("queue_depth")
+        # SLO plane (obs/slo.py): a replica with a FIRING burn-rate
+        # alert means clients are already over budget — up-pressure
+        # like a shed, read off the same tier_signals() aggregation
+        # instead of a private re-derivation from raw counters
+        if int(sig.get("slo_firing", 0) or 0) > 0:
+            reasons.append("slo_burn")
         # down-pressure reads live backlog only (completed-request wait
-        # windows go stale on an idle fleet — module docstring)
-        idle = shed_delta == 0 and depth_per < policy.low_depth
+        # windows go stale on an idle fleet — module docstring); a
+        # firing SLO alert vetoes it outright — never drain a fleet
+        # that is visibly over budget
+        idle = (shed_delta == 0 and depth_per < policy.low_depth
+                and not int(sig.get("slo_firing", 0) or 0))
         st.last_signals = dict(sig, shed_delta=shed_delta,
                                depth_per_replica=round(depth_per, 3))
         if reasons:

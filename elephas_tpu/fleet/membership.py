@@ -87,6 +87,11 @@ class ReplicaState:
         # (None on non-speculative replicas / before any request)
         self.draft_acceptance: Optional[float] = None
         self.request_tokens_per_s_p50: Optional[float] = None
+        # the replica's SLO snapshot (obs/slo.py tracker output) off
+        # the same /stats read — what slo_summary() aggregates into
+        # the router's fleet GET /slo (None when the replica runs no
+        # tracker)
+        self.slo: Optional[Dict] = None
 
     @property
     def load(self) -> float:
@@ -109,6 +114,10 @@ class ReplicaState:
             out["draft_acceptance"] = self.draft_acceptance
         if self.request_tokens_per_s_p50 is not None:
             out["request_tokens_per_s_p50"] = self.request_tokens_per_s_p50
+        if self.slo is not None:
+            # the per-replica /stats snapshot keeps just the verdict;
+            # the full objective detail lives on the router's /slo
+            out["slo_firing"] = list(self.slo.get("firing", ()))
         return out
 
 
@@ -292,6 +301,8 @@ class ReplicaMembership:
             prefill = stats.get("prefill_tier")
             st.prefill = dict(prefill) if isinstance(prefill, dict) \
                 else None
+            slo = stats.get("slo")
+            st.slo = dict(slo) if isinstance(slo, dict) else None
         except (TypeError, ValueError):
             pass   # a malformed /stats field must not kill the prober
 
@@ -423,6 +434,61 @@ class ReplicaMembership:
         with self._lock:
             return {u: self._replicas[u].snapshot() for u in self._urls}
 
+    def slo_summary(self) -> Dict:
+        """Fleet-level SLO aggregation from the per-replica snapshots
+        the probe pass lifted off ``/stats`` — the router's ``GET
+        /slo`` payload. Per objective: fleet state = firing if ANY
+        ready replica fires (a fleet meets an objective only when
+        every member does — averaging would hide exactly the replica
+        that needs help, the queue-wait-max convention), worst-replica
+        attribution by fast-window burn rate, and the per-replica
+        burn/state table an operator drills into."""
+        with self._lock:
+            reps = [(u, self._replicas[u].slo) for u in self._urls
+                    if self._replicas[u].ready
+                    and self._replicas[u].slo is not None]
+        objectives: Dict[str, Dict] = {}
+        ranks: Dict[str, tuple] = {}
+        for url, snap in reps:
+            for name, obj in (snap.get("objectives") or {}).items():
+                entry = objectives.setdefault(name, {
+                    "kind": obj.get("kind"),
+                    "target": obj.get("target"),
+                    "state": "ok",
+                    "worst_replica": None,
+                    "worst_burn_fast": None,
+                    "firing_replicas": [],
+                    "replicas": {},
+                })
+                burn = obj.get("burn_fast")
+                entry["replicas"][url] = {
+                    "state": obj.get("state"),
+                    "burn_fast": burn,
+                    "burn_slow": obj.get("burn_slow"),
+                    "alerts": obj.get("alerts"),
+                }
+                firing = obj.get("state") == "firing"
+                if firing:
+                    entry["state"] = "firing"
+                    entry["firing_replicas"].append(url)
+                # worst = firing beats ok, then highest fast burn with
+                # the slow burn as the fallback (a FIRING replica whose
+                # current fast window happens to be empty — burn None —
+                # must still be attributable)
+                slow = obj.get("burn_slow")
+                rank = (1 if firing else 0,
+                        burn if burn is not None
+                        else (slow if slow is not None else 0.0))
+                if (entry["worst_replica"] is None
+                        or rank > ranks[name]):
+                    ranks[name] = rank
+                    entry["worst_burn_fast"] = burn
+                    entry["worst_replica"] = url
+        return {"replicas_reporting": len(reps),
+                "firing": sorted(n for n, e in objectives.items()
+                                 if e["state"] == "firing"),
+                "objectives": objectives}
+
     def tier_signals(self) -> Dict[str, Dict]:
         """Aggregate fleet health by serving tier, from the last probe
         pass — the one read that answers "is the fleet keeping up", and
@@ -473,6 +539,12 @@ class ReplicaMembership:
             if accs:
                 decode["draft_acceptance_min"] = min(accs)
                 decode["draft_acceptance_mean"] = sum(accs) / len(accs)
+            # replicas with a firing burn-rate alert: the autoscaler's
+            # SLO-driven up-pressure signal (a client is already
+            # feeling it — the one signal that outranks backlog math)
+            decode["slo_firing"] = sum(
+                1 for s in ready
+                if s.slo is not None and s.slo.get("firing"))
             total = decode["requests_shed"] + decode["requests_finished"]
             decode["shed_rate"] = (decode["requests_shed"] / total
                                    if total else 0.0)

@@ -78,13 +78,17 @@ spans router -> replica -> parameter server; every router response
 carries ``X-Trace-Id``.
 
 Router surfaces: ``GET /stats`` (per-replica route counts, spills,
-re-routes, evictions, ring size), ``GET /metrics`` (Prometheus
-``fleet_*`` series), ``/health`` / ``/ready`` (the router is ready iff
-at least one replica is), and proxied ``/v1/generate`` (blocking and
-streaming), ``/v1/submit``, ``/v1/result``, ``/v1/cancel``,
-``/v1/requests/<id>/trace``. Request ids returned by ``/v1/submit`` are
-FLEET-level ids (each replica numbers its own requests independently;
-the router keeps the mapping).
+re-routes, evictions, ring size), ``GET /slo`` (fleet-aggregated SLO
+objective status with worst-replica attribution, from the per-replica
+snapshots the membership prober lifts off each ``/stats`` —
+``docs/sources/observability.md`` has the runbook), ``GET /metrics``
+(Prometheus ``fleet_*`` series, including client-observed streaming
+TTFT on ``fleet_stream_ttft_seconds``), ``/health`` / ``/ready`` (the
+router is ready iff at least one replica is), and proxied
+``/v1/generate`` (blocking and streaming), ``/v1/submit``,
+``/v1/result``, ``/v1/cancel``, ``/v1/requests/<id>/trace``. Request
+ids returned by ``/v1/submit`` are FLEET-level ids (each replica
+numbers its own requests independently; the router keeps the mapping).
 
 ``docs/sources/serving-fleet.md`` has the topology, lifecycle, and ops
 runbook.
@@ -104,8 +108,8 @@ from urllib.parse import parse_qs, urlparse
 from ..obs.context import (current_context, new_root, parse_traceparent,
                            use_context)
 from ..obs.events import emit as emit_event
-from ..obs.metrics import (MetricsRegistry, counter_baseline, percentile,
-                           since_baseline)
+from ..obs.metrics import (MetricsRegistry, counter_baseline,
+                           observe_scrape, percentile, since_baseline)
 from ..serving_http import QuietThreadingHTTPServer, retry_after_header
 from .membership import ReplicaMembership
 
@@ -113,9 +117,9 @@ __all__ = ["FleetRouter"]
 
 #: route label domain for the fleet_http_* metrics (unknown paths fold
 #: into "other" so a scanner cannot grow label cardinality)
-_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/v1/result",
-                 "/v1/generate", "/v1/submit", "/v1/cancel",
-                 "/v1/requests/:id/trace")
+_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/slo",
+                 "/v1/result", "/v1/generate", "/v1/submit",
+                 "/v1/cancel", "/v1/requests/:id/trace")
 
 _TRACE_ROUTE_RE = re.compile(r"^/v1/requests/(\d+)/trace$")
 
@@ -249,6 +253,15 @@ class FleetRouter:
             "fleet_http_request_duration_seconds",
             "router-side request wall time by route and status",
             labels=("route", "status"))
+        # CLIENT-observed streaming TTFT: request arrival at the edge
+        # to the first token line forwarded onto the client's wire —
+        # the engines' serving_ttft_seconds plus routing, proxying,
+        # and the replica's HTTP hop, which is the number the user
+        # actually feels
+        self._m_stream_ttft = reg.histogram(
+            "fleet_stream_ttft_seconds",
+            "router-edge time to first streamed token line (client-"
+            "observed TTFT for streaming generates)").labels()
         # hedged tail retries
         self.hedge = bool(hedge)
         if not 0.0 < float(hedge_quantile) < 1.0:
@@ -1091,8 +1104,19 @@ class FleetRouter:
                                          "replicas_ready": 0})
                 elif url.path == "/stats":
                     self._json(200, router.stats())
+                elif url.path == "/slo":
+                    # fleet-aggregated objective status with worst-
+                    # replica attribution, from the per-replica SLO
+                    # snapshots the membership prober lifted — the one
+                    # surface the autoscaler, the canary controller,
+                    # and an operator all read
+                    self._json(200, router.membership.slo_summary())
                 elif url.path == "/metrics":
-                    self._reply(200, router.registry.render().encode(),
+                    t0 = time.perf_counter()
+                    body = router.registry.render().encode()
+                    observe_scrape(router.registry, "router",
+                                   time.perf_counter() - t0, len(body))
+                    self._reply(200, body,
                                 "text/plain; version=0.0.4; "
                                 "charset=utf-8")
                 elif url.path == "/v1/result":
@@ -1170,9 +1194,16 @@ class FleetRouter:
                     if ctx is not None:
                         self.send_header("X-Trace-Id", ctx.trace_id)
                     self.end_headers()
+                    first_tokens = True
                     for raw in upstream:
                         self.wfile.write(raw)
                         self.wfile.flush()
+                        if first_tokens and b'"tokens"' in raw:
+                            # client-observed TTFT: the first token
+                            # line just left on the client's wire
+                            first_tokens = False
+                            router._m_stream_ttft.observe(
+                                time.perf_counter() - self._t0)
                 except Exception:  # noqa: BLE001 — client or replica
                     pass           # gone mid-stream: close both sides
                 finally:

@@ -1,22 +1,28 @@
 """Unified observability: metrics registry, Prometheus exposition,
-trace spans, distributed trace context, and structured events (see
-:mod:`.metrics`, :mod:`.trace`, :mod:`.context`, :mod:`.events`; the
-metric catalog lives in ``docs/sources/observability.md`` and the
-tracing story in ``docs/sources/tracing.md``)."""
+trace spans, distributed trace context, structured events, the
+engine-loop continuous profiler, and the SLO/burn-rate plane (see
+:mod:`.metrics`, :mod:`.trace`, :mod:`.context`, :mod:`.events`,
+:mod:`.profiler`, :mod:`.slo`; the metric catalog lives in
+``docs/sources/observability.md`` and the tracing story in
+``docs/sources/tracing.md``)."""
 from .context import (TRACEPARENT_LEN, TraceContext, current_context,
                       current_trace_id, new_root, parse_traceparent,
                       reset_context, set_context, use_context)
 from .events import (EVENT_RING_SIZE, EventLog, FlightRecorder,
                      clear_events, default_event_log, emit, recent_events)
-from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS, Counter, Gauge,
-                      Histogram, MetricsRegistry, default_registry,
+from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS,
+                      SCRAPE_SIZE_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry, default_registry, observe_scrape,
                       percentile)
+from .profiler import PHASES, LoopProfiler
+from .slo import SLOObjective, SLOTracker
 from .trace import (RING_SIZE, SPAN_METRIC, clear_slow_spans,
                     recent_slow_spans, record_span,
                     set_slow_span_threshold, span, span_if_counted)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry", "percentile", "DEFAULT_BUCKETS",
+           "default_registry", "percentile", "observe_scrape",
+           "DEFAULT_BUCKETS", "SCRAPE_SIZE_BUCKETS",
            "MAX_LABEL_SETS", "span", "span_if_counted", "record_span",
            "recent_slow_spans", "clear_slow_spans",
            "set_slow_span_threshold", "SPAN_METRIC", "RING_SIZE",
@@ -24,4 +30,5 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "set_context", "reset_context", "use_context", "new_root",
            "parse_traceparent", "TRACEPARENT_LEN", "EventLog",
            "FlightRecorder", "default_event_log", "emit",
-           "recent_events", "clear_events", "EVENT_RING_SIZE"]
+           "recent_events", "clear_events", "EVENT_RING_SIZE",
+           "LoopProfiler", "PHASES", "SLOObjective", "SLOTracker"]

@@ -50,15 +50,30 @@ class EventLog:
     :param sink_path: when set, every event is also appended to this
         file as one JSON line (best-effort: a full disk or revoked
         permission disables the sink rather than failing emitters).
+    :param sink_max_bytes: byte budget for the sink file. When an
+        append would push it past the budget, the file rolls over ONCE
+        to ``<sink_path>.1`` (replacing any previous rollover) and a
+        fresh file starts — under sustained traffic disk usage is
+        bounded by ~2x the budget instead of growing forever. ``None``
+        (the default) keeps the old unbounded behavior. Rotation
+        failures follow the sink contract: disable, never fail the
+        emitter.
     """
 
     def __init__(self, capacity: int = EVENT_RING_SIZE,
-                 sink_path: Optional[str] = None):
+                 sink_path: Optional[str] = None,
+                 sink_max_bytes: Optional[int] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if sink_max_bytes is not None and sink_max_bytes < 1:
+            raise ValueError(f"sink_max_bytes must be None or >= 1, "
+                             f"got {sink_max_bytes}")
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(capacity))
         self._sink_path = sink_path
+        self._sink_max_bytes = (None if sink_max_bytes is None
+                                else int(sink_max_bytes))
+        self._sink_bytes = 0      # bytes written to the CURRENT file
         self._sink = None
 
     def emit(self, event: str, **attrs) -> Dict:
@@ -94,7 +109,17 @@ class EventLog:
             if self._sink is None:
                 self._sink = open(self._sink_path, "a",
                                   encoding="utf8", buffering=1)
-            self._sink.write(line + "\n")
+                # resuming an existing file: respect what it already
+                # holds, or the budget resets on every process restart
+                self._sink_bytes = self._sink.tell()
+            data = line + "\n"
+            nbytes = len(data.encode("utf8"))
+            if (self._sink_max_bytes is not None and self._sink_bytes
+                    and self._sink_bytes + nbytes
+                    > self._sink_max_bytes):
+                self._rotate_sink_locked()
+            self._sink.write(data)
+            self._sink_bytes += nbytes
         except OSError:
             self._sink_path = None
             try:
@@ -103,6 +128,22 @@ class EventLog:
             except OSError:
                 pass
             self._sink = None
+
+    def _rotate_sink_locked(self) -> None:
+        """Single ``.1`` rollover: the full file becomes
+        ``<sink_path>.1`` (clobbering the previous rollover — one
+        generation of history is the budget's contract) and a fresh
+        file opens. Raises OSError to the caller's disable path on
+        failure; the current-file byte count only resets once the
+        fresh file is actually open."""
+        import os
+
+        self._sink.close()
+        self._sink = None
+        os.replace(self._sink_path, self._sink_path + ".1")
+        self._sink = open(self._sink_path, "a", encoding="utf8",
+                          buffering=1)
+        self._sink_bytes = 0
 
     def recent(self, event: Optional[str] = None,
                trace_id: Optional[str] = None) -> List[Dict]:

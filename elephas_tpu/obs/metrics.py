@@ -44,12 +44,18 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "percentile", "counter_baseline",
-           "since_baseline", "DEFAULT_BUCKETS", "MAX_LABEL_SETS"]
+           "since_baseline", "observe_scrape", "DEFAULT_BUCKETS",
+           "SCRAPE_SIZE_BUCKETS", "MAX_LABEL_SETS"]
 
 #: latency-oriented default bucket boundaries (seconds) — spans a fast
 #: decode step (~1ms) through a multi-second prefill compile
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: exposition-size bucket boundaries (bytes) for the scrape
+#: self-observation histograms — 1 KiB through 4 MiB
+SCRAPE_SIZE_BUCKETS = (1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
+                       1 << 20, 4 << 20)
 
 #: hard bound on distinct label sets per metric family — a label value
 #: drawn from an unbounded domain (request id, raw URL) must fail fast,
@@ -88,6 +94,28 @@ def since_baseline(baseline: Dict[int, float], metric) -> float:
     """The metric's growth since :func:`counter_baseline` captured it
     (its full value if it was not in the baseline)."""
     return metric.value - baseline.get(id(metric), 0.0)
+
+
+def observe_scrape(registry: "MetricsRegistry", site: str,
+                   duration_s: float, size_bytes: int) -> None:
+    """Self-observation for a ``/metrics`` render call site: exposition
+    cost (wall time + text size) recorded into the SAME registry the
+    scrape served, labeled by ``site`` so co-resident surfaces
+    (serving server, PS front-end, fleet router) stay distinct series.
+    A sample naturally lands one scrape late — the render it measures
+    already left the building — which is exactly right: the question it
+    answers is "is exposition itself getting expensive at this
+    cardinality", a trend, not a per-scrape receipt."""
+    registry.histogram(
+        "obs_scrape_duration_seconds",
+        "wall time of one /metrics exposition render, by call site",
+        labels=("site",)).labels(site=site).observe(float(duration_s))
+    registry.histogram(
+        "obs_scrape_size_bytes",
+        "exposition text bytes produced per /metrics render, by call "
+        "site", labels=("site",),
+        buckets=SCRAPE_SIZE_BUCKETS).labels(site=site).observe(
+        float(size_bytes))
 
 
 def _fmt(value: float) -> str:
@@ -209,12 +237,20 @@ class Histogram:
     bounded window of recent raw samples for :meth:`quantile` snapshots
     (nearest-rank over the window — an estimate of the *recent*
     distribution, which is what a dashboard or a bench wants; the
-    buckets carry the full history for real Prometheus quantiles)."""
+    buckets carry the full history for real Prometheus quantiles).
+
+    With ``exemplars=True``, each observation made under an active
+    trace context (or with an explicit ``trace_id=``) remembers the
+    LAST trace id per bucket — a p99 outlier becomes one click from its
+    flight-recorder timeline. Exemplars are exposed in
+    :meth:`_snapshot` always, and rendered in OpenMetrics exemplar
+    syntax only when the caller opts in (``render(exemplars=True)``):
+    classic 0.0.4 scrapers must never see the suffix."""
 
     kind = "histogram"
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS,
-                 window: int = 1024):
+                 window: int = 1024, exemplars: bool = False):
         uppers = sorted(float(b) for b in buckets)
         if not uppers:
             raise ValueError("histogram needs at least one bucket bound")
@@ -227,9 +263,20 @@ class Histogram:
         self._count = 0
         self._window: Optional[deque] = (deque(maxlen=int(window))
                                          if window else None)
+        # bucket index -> {"trace_id", "value", "at"} (last writer wins)
+        self._exemplars: Optional[Dict[int, Dict]] = (
+            {} if exemplars else None)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
         value = float(value)
+        if self._exemplars is not None and trace_id is None:
+            # imported lazily-at-call? No: module-level import would be
+            # fine (stdlib-only), but the late lookup keeps the hot
+            # path of exemplar-less histograms completely untouched
+            from .context import current_trace_id
+
+            trace_id = current_trace_id()
         with self._lock:
             i = 0
             for i, upper in enumerate(self._uppers):
@@ -242,6 +289,9 @@ class Histogram:
             self._count += 1
             if self._window is not None:
                 self._window.append(value)
+            if self._exemplars is not None and trace_id is not None:
+                self._exemplars[i] = {"trace_id": str(trace_id),
+                                      "value": value, "at": time.time()}
 
     @contextmanager
     def time(self):
@@ -272,21 +322,55 @@ class Histogram:
             return None
         return percentile(window, q)
 
-    def _render(self, name, labelnames, labelvalues, lines):
+    def count_le(self, bound: float) -> Tuple[int, int]:
+        """``(observations <= bound, total observations)`` read under
+        ONE lock — the atomic pair a latency SLO needs (a racing read
+        of count then buckets could see more totals than bucketed
+        samples and report phantom breaches). ``bound`` should sit on
+        a bucket boundary; an off-boundary bound is rounded UP to the
+        next one (bucketed data cannot resolve finer, and rounding
+        down would silently tighten the objective — over-reporting
+        violations)."""
+        bound = float(bound)
+        with self._lock:
+            # cumulative count through the FIRST bucket whose upper
+            # covers the bound; a bound above the top finite bucket
+            # counts every finite bucket (+Inf samples exceed any
+            # finite bound by definition)
+            good = 0
+            for upper, n in zip(self._uppers, self._bucket_counts):
+                good += n
+                if upper >= bound - 1e-12:
+                    break
+            return good, self._count
+
+    def _render(self, name, labelnames, labelvalues, lines,
+                exemplars: bool = False):
         with self._lock:
             counts = list(self._bucket_counts)
             total, sum_ = self._count, self._sum
+            ex = (dict(self._exemplars)
+                  if exemplars and self._exemplars else {})
         cum = 0
-        for upper, n in zip(self._uppers, counts):
+        for i, (upper, n) in enumerate(zip(self._uppers, counts)):
             cum += n
-            lines.append(
-                f"{name}_bucket"
-                f"{_labels_text(labelnames, labelvalues, ('le', _fmt(upper)))}"
-                f" {cum}")
-        lines.append(
-            f"{name}_bucket"
-            f"{_labels_text(labelnames, labelvalues, ('le', '+Inf'))}"
-            f" {total}")
+            line = (f"{name}_bucket"
+                    f"{_labels_text(labelnames, labelvalues, ('le', _fmt(upper)))}"
+                    f" {cum}")
+            e = ex.get(i)
+            if e is not None:
+                # OpenMetrics exemplar syntax (opt-in — see class doc)
+                line += (f' # {{trace_id="{_escape_label(e["trace_id"])}"'
+                         f'}} {_fmt(e["value"])} {repr(e["at"])}')
+            lines.append(line)
+        line = (f"{name}_bucket"
+                f"{_labels_text(labelnames, labelvalues, ('le', '+Inf'))}"
+                f" {total}")
+        e = ex.get(len(self._uppers))
+        if e is not None:
+            line += (f' # {{trace_id="{_escape_label(e["trace_id"])}"'
+                     f'}} {_fmt(e["value"])} {repr(e["at"])}')
+        lines.append(line)
         base = _labels_text(labelnames, labelvalues)
         lines.append(f"{name}_sum{base} {_fmt(sum_)}")
         lines.append(f"{name}_count{base} {total}")
@@ -295,10 +379,15 @@ class Histogram:
         with self._lock:
             counts = list(self._bucket_counts)
             total, sum_ = self._count, self._sum
+            ex = dict(self._exemplars) if self._exemplars else None
         out = {"count": total, "sum": sum_,
                "buckets": {_fmt(u): c
                            for u, c in zip(self._uppers, counts)},
                "buckets_inf": counts[-1]}
+        if ex:
+            uppers = self._uppers + [math.inf]
+            out["exemplars"] = {_fmt(uppers[i]): e
+                                for i, e in sorted(ex.items())}
         p50, p99 = self.quantile(0.5), self.quantile(0.99)
         if p50 is not None:
             out["p50"] = p50
@@ -374,8 +463,8 @@ class MetricFamily:
         self._default().set_function(fn)
         return self
 
-    def observe(self, value: float):
-        return self._default().observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None):
+        return self._default().observe(value, trace_id=trace_id)
 
     def time(self):
         return self._default().time()
@@ -400,11 +489,15 @@ class MetricFamily:
         with self._lock:
             return dict(self._children)
 
-    def _render(self, lines: List[str]):
+    def _render(self, lines: List[str], exemplars: bool = False):
         lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
         lines.append(f"# TYPE {self.name} {self.kind}")
         for key, child in sorted(self.series().items()):
-            child._render(self.name, self.labelnames, key, lines)
+            if self.kind == "histogram":
+                child._render(self.name, self.labelnames, key, lines,
+                              exemplars=exemplars)
+            else:
+                child._render(self.name, self.labelnames, key, lines)
 
 
 class MetricsRegistry:
@@ -429,11 +522,14 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "",
                   labels: Sequence[str] = (),
                   buckets: Sequence[float] = DEFAULT_BUCKETS,
-                  window: int = 1024) -> MetricFamily:
-        spec = (tuple(float(b) for b in buckets), int(window))
+                  window: int = 1024,
+                  exemplars: bool = False) -> MetricFamily:
+        spec = (tuple(float(b) for b in buckets), int(window),
+                bool(exemplars))
         return self._register(
             name, help, labels,
-            lambda: Histogram(buckets=buckets, window=window), "histogram",
+            lambda: Histogram(buckets=buckets, window=window,
+                              exemplars=exemplars), "histogram",
             spec=spec)
 
     def _register(self, name, help_text, labelnames, factory, kind,
@@ -480,12 +576,15 @@ class MetricsRegistry:
             return [self._families[n] for n in sorted(self._families)]
 
     # --------------------------------------------------------- exposition
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (format 0.0.4) of every family,
-        name-sorted for deterministic scrapes/diffs."""
+        name-sorted for deterministic scrapes/diffs. ``exemplars=True``
+        appends OpenMetrics exemplar suffixes on buckets of histograms
+        registered with ``exemplars=True`` — opt-in because the suffix
+        is not part of the classic 0.0.4 grammar."""
         lines: List[str] = []
         for fam in self.families():
-            fam._render(lines)
+            fam._render(lines, exemplars=exemplars)
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict[str, Dict]:

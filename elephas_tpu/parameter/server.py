@@ -129,7 +129,7 @@ _LOG = logging.getLogger(__name__)
 from ..obs.context import (parse_traceparent, reset_context, set_context,
                            use_context)
 from ..obs.events import emit as emit_event
-from ..obs.metrics import default_registry
+from ..obs.metrics import default_registry, observe_scrape
 from ..utils.faults import fault_site
 from ..utils.functional_utils import subtract_params
 from ..utils.rwlock import RWLock
@@ -702,8 +702,13 @@ class HttpServer(BaseParameterServer):
                 elif self.path.startswith("/metrics"):
                     # Prometheus exposition of the process default
                     # registry: PS RPC counters, fault injections, and
-                    # any training telemetry co-resident in this process
+                    # any training telemetry co-resident in this
+                    # process. The render's own cost lands on
+                    # obs_scrape_* (site="ps") — exposition at high
+                    # cardinality must itself be visible.
                     body = default_registry().render().encode()
+                    observe_scrape(default_registry(), "ps",
+                                   time.perf_counter() - t0, len(body))
                     content_type = ("text/plain; version=0.0.4; "
                                     "charset=utf-8")
                 elif self.path.startswith("/version"):

@@ -89,11 +89,12 @@ The reference has no serving path at all (inference is Spark
 ``mapPartitions`` batch prediction, ``elephas/spark_model.py:235-272``);
 continuous batching is a beyond-parity serving feature.
 """
+import contextlib
 import threading
 import time
 from collections import deque
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +108,7 @@ from .obs.events import FlightRecorder
 from .obs.events import emit as emit_event
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
+from .obs.profiler import LoopProfiler
 from .obs.trace import span_if_counted
 from .serving_qos import (DEFAULT_TENANT, FairQueue, QueuedRequest,
                           TenantQoS)
@@ -164,7 +166,17 @@ def _filter_logits_rows(logits: jnp.ndarray, top_k: jnp.ndarray,
     return jnp.where(logits >= p_thr, logits, NEG_INF)
 
 __all__ = ["DecodeEngine", "QueueFullError", "DeadlineExceededError",
-           "validate_sampling_overrides"]
+           "validate_sampling_overrides", "INTER_TOKEN_BUCKETS"]
+
+#: bucket bounds for ``serving_inter_token_seconds`` — finer at the
+#: bottom than the latency defaults (a healthy decode step is
+#: sub-millisecond on-chip; chunked emission's intra-chunk gaps are ~0)
+INTER_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5)
+
+#: reusable no-op context for profiler-less engines (nullcontext is
+#: stateless, so one instance serves every section site)
+_NULL_SECTION = contextlib.nullcontext()
 
 
 def validate_sampling_overrides(temperature, top_k, top_p) -> None:
@@ -290,6 +302,15 @@ class DecodeEngine:
         queue gauges win); keep simultaneous engines on their default
         fresh registries. The HTTP server merges this registry with the
         process default registry on its ``GET /metrics`` route.
+    :param profiler: the engine-loop continuous profiler
+        (:class:`~elephas_tpu.obs.LoopProfiler`): per-iteration phase
+        accounting (swap/admit/prefill/decode/emit + idle) published
+        as ``serving_loop_utilization{phase}`` gauges, with jit
+        compiles tracked separately. ``None`` (the default) creates
+        one on this engine's registry — measured overhead is <2%
+        tokens/s (the ``slo_plane`` bench row), cheap enough to be
+        always-on. Pass ``False`` to disable (the bench A/B baseline)
+        or an instance to share one across wrappers.
     """
 
     #: flight-recorder decode sampling: one ``step`` timeline event per
@@ -312,7 +333,8 @@ class DecodeEngine:
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_block_size: Optional[int] = None,
                  prefix_cache_capacity: Optional[int] = None,
-                 qos: Optional[TenantQoS] = None):
+                 qos: Optional[TenantQoS] = None,
+                 profiler: Union[None, bool, LoopProfiler] = None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -519,6 +541,40 @@ class DecodeEngine:
         self._submit_t: Dict[int, float] = {}
         self._admit_t: Dict[int, float] = {}
         self._latency_window: deque = deque(maxlen=1024)
+        # user-experienced latency decomposition: time-to-first-token
+        # (submit -> first output token; exemplar-enabled so a p99
+        # outlier links to its flight-recorder timeline) and the gap
+        # between consecutive tokens of one request. These observe off
+        # HOST dicts keyed by rid — never the bounded flight-recorder
+        # ring, whose eviction must not cost a histogram sample.
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "submit-to-first-token wall time per request (disagg "
+            "front ends pass their submit stamp through, so the "
+            "prefill tier's queue+ship time lands inside)",
+            exemplars=True).labels()
+        self._m_inter_token = reg.histogram(
+            "serving_inter_token_seconds",
+            "wall time between consecutive output tokens of one "
+            "request (chunked/speculative emission: intra-chunk gaps "
+            "are ~0 with one chunk-interval sample — exactly what a "
+            "non-streaming client experiences)",
+            buckets=INTER_TOKEN_BUCKETS).labels()
+        # rid -> monotonic stamp of the FRONT-END submit, when it
+        # precedes this engine's own (submit_prefilled's submitted_at);
+        # rid -> last token emission stamp; rid -> observed ttft for
+        # the terminal flight-recorder event
+        self._ttft_origin: Dict[int, float] = {}
+        self._last_tok_t: Dict[int, float] = {}
+        self._ttft_val: Dict[int, float] = {}
+        # engine-loop continuous profiler (see the ctor docstring):
+        # False disables, None builds one on this registry
+        if profiler is False:
+            self.profiler: Optional[LoopProfiler] = None
+        elif profiler is None or profiler is True:
+            self.profiler = LoopProfiler(reg)
+        else:
+            self.profiler = profiler
         self._m_accepted = reg.counter(
             "serving_draft_tokens_accepted_total",
             "speculative draft tokens accepted by the target model"
@@ -1564,7 +1620,8 @@ class DecodeEngine:
                          deadline_ms: Optional[float] = None,
                          weights_version: Optional[int] = None,
                          tenant: Optional[str] = None,
-                         priority=None) -> int:
+                         priority=None,
+                         submitted_at: Optional[float] = None) -> int:
         """Queue a request whose prefill ALREADY HAPPENED off-engine —
         the decode half of disaggregated serving. ``kv_blocks`` is the
         prompt's KV state in wire-block form
@@ -1590,7 +1647,16 @@ class DecodeEngine:
         request's whole output over mismatched state. A stale stamp
         falls back to a LOCAL prefill of the prompt (correct output,
         one admission's worth of extra compute on this engine) rather
-        than failing the request; ``None`` skips the check."""
+        than failing the request; ``None`` skips the check.
+
+        ``submitted_at`` is the FRONT END's ``time.monotonic()`` stamp
+        of the original client submit: when given, this engine's
+        ``serving_ttft_seconds`` measures first-token latency from
+        THAT moment — so the prefill tier's queue wait, compute, and
+        KV ship time land inside TTFT, where the user experienced
+        them — while queue-wait/request-latency series keep measuring
+        this engine's own decode stage (the disaggregation headline
+        those series exist to isolate)."""
         # shape/coverage validation happens HERE, at submit: a malformed
         # KV payload failing at admission time would raise inside the
         # server's engine loop and read as engine death (500s for
@@ -1635,11 +1701,12 @@ class DecodeEngine:
             deadline_ms,
             (blocks, int(first_token),
              None if weights_version is None else int(weights_version)),
-            tenant, priority)
+            tenant, priority, submitted_at=submitted_at)
 
     def _submit_impl(self, prompt, max_new_tokens, temperature, top_k,
                      top_p, admit, deadline_ms, prefilled,
-                     tenant=None, priority=None) -> int:
+                     tenant=None, priority=None,
+                     submitted_at=None) -> int:
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
@@ -1699,6 +1766,11 @@ class DecodeEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._submit_t[rid] = time.monotonic()
+        if submitted_at is not None:
+            # the front end's own submit stamp: TTFT measures from the
+            # moment the CLIENT's request entered the serving stack,
+            # not from this engine's (later) decode-stage submit
+            self._ttft_origin[rid] = float(submitted_at)
         # capture the submitter's trace context HERE: the engine loop
         # thread that admits/steps/retires this request later runs
         # without it, so the flight recorder stamps every event with
@@ -1934,6 +2006,11 @@ class DecodeEngine:
             # report tokens for a cancelled rid
             self._fresh.pop(rid, None)
             self._accept.pop(rid, None)
+            # a preempted-then-re-queued rid still carries token-time
+            # stamps from its first life
+            self._ttft_origin.pop(rid, None)
+            self._last_tok_t.pop(rid, None)
+            self._ttft_val.pop(rid, None)
             self.recorder.record(rid, "cancelled", stage="queued")
             return True
         for slot, r in enumerate(self._rid):
@@ -1951,6 +2028,9 @@ class DecodeEngine:
                 self._admit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
                 self._trace_ctx.pop(rid, None)
+                self._ttft_origin.pop(rid, None)
+                self._last_tok_t.pop(rid, None)
+                self._ttft_val.pop(rid, None)
                 self.recorder.record(rid, "cancelled", stage="decoding",
                                      tokens=tokens)
                 return True
@@ -1977,6 +2057,9 @@ class DecodeEngine:
             t_sub = self._submit_t.pop(rid, None)
             saved = self._resume.pop(rid, None)
             self._trace_ctx.pop(rid, None)
+            self._ttft_origin.pop(rid, None)
+            self._last_tok_t.pop(rid, None)
+            self._ttft_val.pop(rid, None)
             if saved is not None:
                 # preempted mid-decode and the deadline passed while
                 # re-queued: the tokens already emitted are the final
@@ -2019,11 +2102,33 @@ class DecodeEngine:
             self._m_timed_out.inc()
 
     def _admit(self):
+        # profiled as one "admit" section whose nested prefill/swap
+        # children are EXCLUDED (the profiler's exclusive accounting),
+        # so admission scheduling cost and prefill compute are separate
+        # answers on serving_loop_utilization. The steady-decode case —
+        # empty queue, nothing staged — skips the sections entirely:
+        # _admit runs twice per step, and timing its ~µs no-op as
+        # "admit" would double the profiler's per-step cost to
+        # attribute time that belongs in idle anyway.
+        if self.profiler is None or (not len(self._queue)
+                                     and self._staged_params is None
+                                     and self._staged_draft is None):
+            return self._admit_impl()
+        with self.profiler.section("admit"):
+            self._admit_impl()
+
+    def _admit_impl(self):
         # a staged live-weight swap lands FIRST — admission prefills
         # must run under the params their requests will decode under
         # (this covers both entry points: step()'s between-decode-steps
         # call and an immediate submit(admit=True) admission)
-        self.apply_staged_params()
+        if self._staged_params is None and self._staged_draft is None:
+            # unlocked peek is safe: a staging racing this read lands
+            # on the next step — exactly the contract stage_params has
+            self.apply_staged_params()
+        else:
+            with self._psec("swap"):
+                self.apply_staged_params()
         self._shed_expired_queued()
         self._enforce_active_deadlines()
         while len(self._queue):
@@ -2159,15 +2264,17 @@ class DecodeEngine:
                     # a Q8 frame's dequantized KV is content-addressed
                     # by TOKENS — letting a later LOCAL admission hit
                     # lossy blocks would break its cache-off parity
-                    t0 = self._install_prefilled(slot, prompt, pre)
+                    with self._psec("prefill"):
+                        t0 = self._install_prefilled(slot, prompt, pre)
                     self.recorder.record(
                         rid, "kv_install",
                         prompt_tokens=int(prompt.size),
                         duration_s=round(
                             time.monotonic() - self._admit_t[rid], 6))
                 else:
-                    t0 = self._admit_prefill(rid, slot, prompt, temp,
-                                             topk, topp)
+                    with self._psec("prefill"):
+                        t0 = self._admit_prefill(rid, slot, prompt,
+                                                 temp, topk, topp)
             self._rid[slot] = rid
             # a RESUMED request keeps the tokens it emitted before its
             # preemption — the new first token (sampled from the full
@@ -2546,6 +2653,30 @@ class DecodeEngine:
             return False
         self._outputs[rid].append(tok)
         self._m_emitted.inc()
+        # latency decomposition, off HOST state only (a flight-recorder
+        # eviction must never cost a histogram sample): the request's
+        # FIRST token stamps TTFT — against the front-end submit time
+        # when one was passed through (disagg) — and every later token
+        # stamps the gap since its predecessor. A resumed preempted
+        # request keeps its stamp history (rid-keyed), so its
+        # preemption gap lands in the inter-token tail, which is
+        # exactly what its client experienced.
+        now_tok = time.monotonic()
+        last_tok = self._last_tok_t.get(rid)
+        if last_tok is None:
+            origin = self._ttft_origin.get(rid)
+            if origin is None:
+                origin = self._submit_t.get(rid)
+            if origin is not None:
+                ctx = self._trace_ctx.get(rid)
+                ttft = now_tok - origin
+                self._m_ttft.observe(
+                    ttft, trace_id=None if ctx is None
+                    else ctx.trace_id)
+                self._ttft_val[rid] = ttft
+        else:
+            self._m_inter_token.observe(now_tok - last_tok)
+        self._last_tok_t[rid] = now_tok
         n = len(self._outputs[rid])
         if n % self.TRACE_STEP_EVERY == 0:
             # sampled decode progress on the flight recorder: enough to
@@ -2601,6 +2732,13 @@ class DecodeEngine:
             # the counters answer "how is the engine doing", this
             # answers "how did THIS request's draft do"
             extra = {"draft_accepted": a_p[0], "draft_proposed": a_p[1]}
+        # the latency decomposition's terminal stamp (+ host-dict
+        # cleanup — these are rid-keyed and must not outlive retirement)
+        ttft = self._ttft_val.pop(rid, None)
+        self._last_tok_t.pop(rid, None)
+        self._ttft_origin.pop(rid, None)
+        if ttft is not None:
+            extra["ttft_s"] = round(ttft, 6)
         self.recorder.record(
             rid, outcome, tokens=len(self._done[rid]),
             queue_wait_s=(None if t_sub is None
@@ -2690,6 +2828,20 @@ class DecodeEngine:
                     child.value)
             out["tenants"] = tenants
         out["tier"] = self.tier
+        # latency decomposition + loop profile: the same numbers the
+        # scraped serving_ttft_seconds / serving_inter_token_seconds /
+        # serving_loop_utilization series carry, on the JSON surface
+        ttft_p50 = self._m_ttft.quantile(0.5)
+        if ttft_p50 is not None:
+            out["ttft_p50_s"] = round(ttft_p50, 6)
+            out["ttft_p95_s"] = round(self._m_ttft.quantile(0.95), 6)
+        itl_p50 = self._m_inter_token.quantile(0.5)
+        if itl_p50 is not None:
+            out["inter_token_p50_s"] = round(itl_p50, 6)
+            out["inter_token_p99_s"] = round(
+                self._m_inter_token.quantile(0.99), 6)
+        if self.profiler is not None:
+            out["loop"] = self.profiler.snapshot()
         if self._latency_window:
             totals = [t for _, t, _ in self._latency_window]
             waits = [w for w, _, _ in self._latency_window]
@@ -2752,6 +2904,12 @@ class DecodeEngine:
                 + len(self._fresh)
                 + (1 if staged else 0))
 
+    def _psec(self, phase: str):
+        """The profiler section for ``phase`` (a shared no-op context
+        when profiling is off — the hot path pays one attribute read)."""
+        prof = self.profiler
+        return _NULL_SECTION if prof is None else prof.section(phase)
+
     def step(self) -> Dict[int, List[int]]:
         """Advance every active slot — by one token (plain mode) or by
         ``1 + accepted`` tokens (speculative mode, up to ``gamma+1``);
@@ -2760,6 +2918,12 @@ class DecodeEngine:
         retire and queued ones join automatically; expired queued
         requests are shed before prefill and over-deadline active slots
         are freed (their partial output finishes as a ``timeout``)."""
+        if self.profiler is not None:
+            # iteration boundary: wall time since the LAST tick —
+            # including the server loop's idle sleep — closes into the
+            # rolling window, so utilization reads as a share of real
+            # wall time, not of busy time
+            self.profiler.tick()
         # slow steps (a prefill-compile-heavy one) also land on the
         # slow-span ring by name
         with span_if_counted("serving.step", self._m_steps,
@@ -2784,84 +2948,93 @@ class DecodeEngine:
         if self.draft_config is not None:
             # speculative round: every active slot advances by its own
             # 1 + accepted tokens in one dispatch
-            if self.paged is not None:
-                (emit, acc, nxt, self.pool, self.draft_cache,
-                 self._key) = self._spec_step_paged_fn(
-                    self.params, self.draft_params, self.pool,
-                    self.draft_cache, jnp.asarray(self._tables),
-                    jnp.asarray(self._last), jnp.asarray(pos),
-                    self._key)
-            else:
-                emit, acc, nxt, self.cache, self.draft_cache, self._key \
-                    = self._spec_step_fn(
+            with self._psec("decode"):
+                if self.paged is not None:
+                    (emit, acc, nxt, self.pool, self.draft_cache,
+                     self._key) = self._spec_step_paged_fn(
+                        self.params, self.draft_params, self.pool,
+                        self.draft_cache, jnp.asarray(self._tables),
+                        jnp.asarray(self._last), jnp.asarray(pos),
+                        self._key)
+                else:
+                    (emit, acc, nxt, self.cache, self.draft_cache,
+                     self._key) = self._spec_step_fn(
                         self.params, self.draft_params, self.cache,
                         self.draft_cache, jnp.asarray(self._last),
                         jnp.asarray(pos), self._key)
-            emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
-                              np.asarray(nxt))
+                emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
+                                  np.asarray(nxt))
             self._m_accepted.inc(int(acc[active].sum()))
             self._m_proposed.inc(self.gamma * int(active.sum()))
             self._m_spec_rounds.inc(int(active.sum()))
-            for slot in np.nonzero(active)[0]:
-                rid = self._rid[slot]
-                # per-request acceptance for the flight recorder's
-                # terminal event (engine counters above are pooled)
-                a_p = self._accept.setdefault(rid, [0, 0])
-                a_p[0] += int(acc[slot])
-                a_p[1] += self.gamma
-                self._pos[slot] += 1 + acc[slot]
-                self._last[slot] = nxt[slot]
-                for tok in emit[slot, :acc[slot] + 1]:
-                    if self._rid[slot] is None:
-                        break   # retired mid-chunk (eos or budget)
-                    if self._record(slot, int(tok)):
-                        emitted.setdefault(rid, []).append(int(tok))
+            with self._psec("emit"):
+                for slot in np.nonzero(active)[0]:
+                    rid = self._rid[slot]
+                    # per-request acceptance for the flight recorder's
+                    # terminal event (engine counters above are pooled)
+                    a_p = self._accept.setdefault(rid, [0, 0])
+                    a_p[0] += int(acc[slot])
+                    a_p[1] += self.gamma
+                    self._pos[slot] += 1 + acc[slot]
+                    self._last[slot] = nxt[slot]
+                    for tok in emit[slot, :acc[slot] + 1]:
+                        if self._rid[slot] is None:
+                            break   # retired mid-chunk (eos or budget)
+                        if self._record(slot, int(tok)):
+                            emitted.setdefault(rid, []).append(int(tok))
             self._admit()
             return emitted
         if self.steps_per_sync > 1:
+            with self._psec("decode"):
+                if self.paged is not None:
+                    toks, self.pool, self._key = \
+                        self._multi_step_paged_fn(
+                            self.params, self.pool,
+                            jnp.asarray(self._tables),
+                            jnp.asarray(self._last), jnp.asarray(pos),
+                            jnp.asarray(self._temp),
+                            jnp.asarray(self._topk),
+                            jnp.asarray(self._topp), self._key)
+                else:
+                    toks, self.cache, self._key = self._multi_step_fn(
+                        self.params, self.cache, jnp.asarray(self._last),
+                        jnp.asarray(pos), jnp.asarray(self._temp),
+                        jnp.asarray(self._topk), jnp.asarray(self._topp),
+                        self._key)
+                toks = np.asarray(toks)                   # (B, K)
+            with self._psec("emit"):
+                for slot in np.nonzero(active)[0]:
+                    rid = self._rid[slot]
+                    for tok in toks[slot]:
+                        if self._rid[slot] is None:
+                            break   # retired mid-chunk — surplus dropped
+                        self._pos[slot] += 1
+                        self._last[slot] = tok
+                        if self._record(slot, int(tok)):
+                            emitted.setdefault(rid, []).append(int(tok))
+            self._admit()
+            return emitted
+        with self._psec("decode"):
             if self.paged is not None:
-                toks, self.pool, self._key = self._multi_step_paged_fn(
+                toks, self.pool, self._key = self._step_paged_fn(
                     self.params, self.pool, jnp.asarray(self._tables),
                     jnp.asarray(self._last), jnp.asarray(pos),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), self._key)
             else:
-                toks, self.cache, self._key = self._multi_step_fn(
+                toks, self.cache, self._key = self._step_fn(
                     self.params, self.cache, jnp.asarray(self._last),
                     jnp.asarray(pos), jnp.asarray(self._temp),
                     jnp.asarray(self._topk), jnp.asarray(self._topp),
                     self._key)
-            toks = np.asarray(toks)                       # (B, K)
+            toks = np.asarray(toks)
+        with self._psec("emit"):
             for slot in np.nonzero(active)[0]:
                 rid = self._rid[slot]
-                for tok in toks[slot]:
-                    if self._rid[slot] is None:
-                        break       # retired mid-chunk — surplus dropped
-                    self._pos[slot] += 1
-                    self._last[slot] = tok
-                    if self._record(slot, int(tok)):
-                        emitted.setdefault(rid, []).append(int(tok))
-            self._admit()
-            return emitted
-        if self.paged is not None:
-            toks, self.pool, self._key = self._step_paged_fn(
-                self.params, self.pool, jnp.asarray(self._tables),
-                jnp.asarray(self._last), jnp.asarray(pos),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp), self._key)
-        else:
-            toks, self.cache, self._key = self._step_fn(
-                self.params, self.cache, jnp.asarray(self._last),
-                jnp.asarray(pos), jnp.asarray(self._temp),
-                jnp.asarray(self._topk), jnp.asarray(self._topp),
-                self._key)
-        toks = np.asarray(toks)
-        for slot in np.nonzero(active)[0]:
-            rid = self._rid[slot]
-            self._pos[slot] += 1
-            self._last[slot] = toks[slot]
-            if self._record(slot, int(toks[slot])):
-                emitted.setdefault(rid, []).append(int(toks[slot]))
+                self._pos[slot] += 1
+                self._last[slot] = toks[slot]
+                if self._record(slot, int(toks[slot])):
+                    emitted.setdefault(rid, []).append(int(toks[slot]))
         self._admit()
         return emitted
 

@@ -82,7 +82,8 @@ from urllib.parse import parse_qs, urlparse
 from .obs.context import (current_context, new_root, parse_traceparent,
                           use_context)
 from .obs.metrics import (MetricsRegistry, counter_baseline,
-                          default_registry, since_baseline)
+                          default_registry, observe_scrape,
+                          since_baseline)
 from .serving_engine import QueueFullError
 from .utils.faults import fault_site
 
@@ -109,9 +110,10 @@ class QuietThreadingHTTPServer(ThreadingHTTPServer):
 #: the route label domain for http_* metrics — anything else is
 #: "other", so a scanner probing random paths cannot grow label
 #: cardinality past the registry's bound
-_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/v1/result",
-                 "/v1/generate", "/v1/submit", "/v1/cancel",
-                 "/debug/trace/recent", "/v1/requests/:id/trace")
+_KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/slo",
+                 "/v1/result", "/v1/generate", "/v1/submit",
+                 "/v1/cancel", "/debug/trace/recent",
+                 "/v1/requests/:id/trace")
 
 #: per-request flight-recorder route: the id is normalized out of the
 #: metrics label (unbounded domain) but parsed for the lookup
@@ -178,6 +180,12 @@ class ServingServer:
         server series from one store; the route also appends the
         process default registry (fault injections, parameter-plane
         clients, training timers living on the same host).
+    :param slo: optional :class:`~elephas_tpu.obs.SLOTracker` over the
+        engine's registry. The engine loop calls its
+        ``maybe_evaluate`` once per iteration (a clock check when not
+        due), ``GET /slo`` serves its snapshot, and ``/stats`` carries
+        it as the ``slo`` block — which is what the fleet membership
+        prober lifts for the router's fleet-level ``GET /slo``.
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
@@ -185,9 +193,15 @@ class ServingServer:
                  max_stored_results: int = 1024,
                  default_deadline_ms: Optional[float] = None,
                  max_body_bytes: int = 1 << 20,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 slo=None):
         self.engine = engine
         self.tokenizer = tokenizer
+        # optional SLO tracker (obs/slo.py) over the engine's registry:
+        # the engine loop drives its evaluation cadence, GET /slo and
+        # the "slo" block in /stats serve its snapshot (which the
+        # fleet membership prober lifts for router-level aggregation)
+        self.slo = slo
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.max_stored_results = int(max_stored_results)
         self.default_deadline_ms = (None if default_deadline_ms is None
@@ -290,20 +304,27 @@ class ServingServer:
         qos = getattr(self.engine, "qos", None)
         return qos.label(tenant) if qos is not None else "other"
 
-    def _metrics_text(self) -> str:
+    def _metrics_text(self, exemplars: bool = False) -> str:
         """Prometheus exposition for ``GET /metrics``: the server
         registry, the engine's registry, and the process default
         registry (each rendered once — they are usually the same
         object), so one scrape covers serving AND the cross-cutting
         series (fault injections, PS clients, training step times) of
-        this process regardless of which registry was injected where."""
+        this process regardless of which registry was injected where.
+        The render's own cost lands on ``obs_scrape_*`` (one scrape
+        late by construction — self-observation is a trend signal);
+        ``exemplars`` opts into OpenMetrics exemplar suffixes
+        (``?exemplars=1`` on the route)."""
+        t0 = time.perf_counter()
         seen, text = [], ""
         for reg in (self.registry, getattr(self.engine, "registry", None),
                     default_registry()):
             if reg is None or any(reg is s for s in seen):
                 continue
             seen.append(reg)
-            text += reg.render()
+            text += reg.render(exemplars=exemplars)
+        observe_scrape(self.registry, "serving",
+                       time.perf_counter() - t0, len(text))
         return text
 
     def start(self):
@@ -395,9 +416,14 @@ class ServingServer:
                     # Prometheus exposition: engine + server series
                     # (and the process default registry). Lock-free
                     # like /health — the registry takes per-family
-                    # locks only.
+                    # locks only. ?exemplars=1 opts into OpenMetrics
+                    # exemplar suffixes (not part of the 0.0.4
+                    # grammar, so never on by default).
+                    want_ex = parse_qs(url.query).get(
+                        "exemplars", ["0"])[0] in ("1", "true")
                     self._reply(
-                        200, server._metrics_text().encode(),
+                        200,
+                        server._metrics_text(exemplars=want_ex).encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif url.path == "/health":
                     # lock-free read: liveness must answer instantly
@@ -429,7 +455,24 @@ class ServingServer:
                         stats = dict(server.engine.stats)
                         stats["requests_drained"] = server._n_drained
                         stats["draining"] = server._draining
+                    if server.slo is not None:
+                        # outside the lock: the tracker serves its
+                        # last snapshot under its own lock, and the
+                        # membership prober lifts this block onto the
+                        # router's fleet /slo aggregation
+                        stats["slo"] = server.slo.status()
                     self._json(200, stats)
+                elif url.path == "/slo":
+                    # the per-replica SLO surface: objective states +
+                    # fast/slow burn rates. Lock-free like /health —
+                    # an operator diagnosing a firing alert must not
+                    # queue behind a busy engine loop.
+                    if server.slo is None:
+                        self._json(404, {
+                            "error": "no SLO tracker configured on "
+                                     "this server"})
+                    else:
+                        self._json(200, server.slo.status())
                 elif url.path == "/v1/result":
                     rid = parse_qs(url.query).get("id")
                     try:
@@ -681,6 +724,16 @@ class ServingServer:
                         self._cond.notify_all()
                     self._check_drain_locked()
                     idle = not self.engine.pending
+                if self.slo is not None:
+                    # outside the serving lock (the tracker reads the
+                    # registry under per-metric locks): one clock
+                    # check per iteration, a real evaluation only when
+                    # the tracker's interval elapsed. Best-effort: a
+                    # broken objective must never read as engine death
+                    try:
+                        self.slo.maybe_evaluate()
+                    except Exception:  # noqa: BLE001
+                        pass
                 if not first_pass_done:
                     # ready only after a FULL first iteration — a loop
                     # whose very first step will crash must never show

@@ -33,7 +33,7 @@ from .obs.events import FlightRecorder
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
 from .obs.trace import span_if_counted
-from .serving_engine import _filter_logits_rows
+from .serving_engine import INTER_TOKEN_BUCKETS, _filter_logits_rows
 
 __all__ = ["SSMEngine"]
 
@@ -124,6 +124,20 @@ class SSMEngine:
             "serving_step_latency_seconds",
             "wall time of one engine step (admission + device dispatch)"
             ).labels()
+        # user-experienced latency decomposition, mirroring
+        # DecodeEngine's: TTFT + inter-token gaps, observed off HOST
+        # dicts (never the bounded flight-recorder ring)
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds",
+            "submit-to-first-token wall time per request",
+            exemplars=True).labels()
+        self._m_inter_token = reg.histogram(
+            "serving_inter_token_seconds",
+            "wall time between consecutive output tokens of one "
+            "request", buckets=INTER_TOKEN_BUCKETS).labels()
+        self._submit_mono: Dict[int, float] = {}
+        self._last_tok_t: Dict[int, float] = {}
+        self._ttft_val: Dict[int, float] = {}
         # per-engine baselines, like DecodeEngine's: a shared injected
         # registry may carry a predecessor's totals; stats reports
         # this engine's deltas (zero baselines for the default fresh
@@ -232,6 +246,7 @@ class SSMEngine:
             raise ValueError("max_new_tokens must be >= 1")
         rid = self._next_rid
         self._next_rid += 1
+        self._submit_mono[rid] = time.monotonic()
         ctx = current_context()
         if ctx is not None:
             self._trace_ctx[rid] = ctx
@@ -254,6 +269,7 @@ class SSMEngine:
             if item[0] == rid:
                 del self._queue[i]
                 self._trace_ctx.pop(rid, None)
+                self._submit_mono.pop(rid, None)
                 self.recorder.record(rid, "cancelled", stage="queued")
                 return True
         for slot, r in enumerate(self._rid):
@@ -263,6 +279,9 @@ class SSMEngine:
                 self._fresh.pop(rid, None)
                 self._rid[slot] = None
                 self._trace_ctx.pop(rid, None)
+                self._submit_mono.pop(rid, None)
+                self._last_tok_t.pop(rid, None)
+                self._ttft_val.pop(rid, None)
                 self.recorder.record(rid, "cancelled", stage="decoding",
                                      tokens=tokens)
                 return True
@@ -330,6 +349,22 @@ class SSMEngine:
             return False
         self._outputs[rid].append(tok)
         self._m_emitted.inc()
+        # TTFT / inter-token stamps off host dicts (the DecodeEngine
+        # contract: histogram samples never depend on the trace ring)
+        now_tok = time.monotonic()
+        last_tok = self._last_tok_t.get(rid)
+        if last_tok is None:
+            t_sub = self._submit_mono.get(rid)
+            if t_sub is not None:
+                ctx = self._trace_ctx.get(rid)
+                ttft = now_tok - t_sub
+                self._m_ttft.observe(
+                    ttft, trace_id=None if ctx is None
+                    else ctx.trace_id)
+                self._ttft_val[rid] = ttft
+        else:
+            self._m_inter_token.observe(now_tok - last_tok)
+        self._last_tok_t[rid] = now_tok
         n = len(self._outputs[rid])
         if n % self.TRACE_STEP_EVERY == 0:
             self.recorder.record(rid, "step", tokens=n)
@@ -344,10 +379,14 @@ class SSMEngine:
         self._rid[slot] = None
         self._m_finished.inc()
         self._trace_ctx.pop(rid, None)
+        self._submit_mono.pop(rid, None)
+        self._last_tok_t.pop(rid, None)
+        ttft = self._ttft_val.pop(rid, None)
         total = self.recorder.age(rid)
         self.recorder.record(
             rid, "finished", tokens=len(self._done[rid]),
-            total_s=None if total is None else round(total, 6))
+            total_s=None if total is None else round(total, 6),
+            **({} if ttft is None else {"ttft_s": round(ttft, 6)}))
 
     # ------------------------------------------------------------- step
     @property
@@ -411,9 +450,17 @@ class SSMEngine:
     def stats(self) -> Dict[str, float]:
         steps = int(since_baseline(self._stat_base, self._m_steps))
         emitted = int(since_baseline(self._stat_base, self._m_emitted))
-        return {"steps": steps,
-                "tokens_emitted": emitted,
-                "requests_finished": int(
-                    since_baseline(self._stat_base, self._m_finished)),
-                "tokens_per_step": (emitted / steps if steps else 0.0),
-                "queue_depth": len(self._queue)}
+        out = {"steps": steps,
+               "tokens_emitted": emitted,
+               "requests_finished": int(
+                   since_baseline(self._stat_base, self._m_finished)),
+               "tokens_per_step": (emitted / steps if steps else 0.0),
+               "queue_depth": len(self._queue)}
+        ttft_p50 = self._m_ttft.quantile(0.5)
+        if ttft_p50 is not None:
+            out["ttft_p50_s"] = round(ttft_p50, 6)
+            out["ttft_p95_s"] = round(self._m_ttft.quantile(0.95), 6)
+        itl_p50 = self._m_inter_token.quantile(0.5)
+        if itl_p50 is not None:
+            out["inter_token_p50_s"] = round(itl_p50, 6)
+        return out

@@ -72,6 +72,15 @@ class CanaryController:
         (defaults to the canary subscriber's registry).
     :param poll_interval: background-mode cadence of
         :meth:`poll_and_roll`.
+    :param slo: optional :class:`~elephas_tpu.obs.SLOTracker` over the
+        CANARY replica's registry — the same objective definitions the
+        fleet ``GET /slo`` reads, instead of a third private health
+        derivation. When given, the bake verdict consults it after the
+        latency/shed comparison: any objective whose burn-rate alert
+        is firing at verdict time regresses the rollout
+        (``reason="slo_burn_rate"``). The delta comparisons stay — the
+        SLO gate catches budget-level damage the cohort comparison's
+        slack would wave through, and vice versa.
     """
 
     def __init__(self, subscribers: Sequence[WeightSubscriber],
@@ -82,7 +91,7 @@ class CanaryController:
                  shed_slack: float = 0.05, swap_timeout_s: float = 30.0,
                  on_no_traffic: str = "rollback",
                  registry: Optional[MetricsRegistry] = None,
-                 poll_interval: float = 0.5):
+                 poll_interval: float = 0.5, slo=None):
         if not subscribers:
             raise ValueError("need at least one subscriber")
         if not 0 <= int(canary) < len(subscribers):
@@ -104,6 +113,7 @@ class CanaryController:
         self.swap_timeout_s = float(swap_timeout_s)
         self.on_no_traffic = on_no_traffic
         self.poll_interval = float(poll_interval)
+        self.slo = slo
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         reg = (registry if registry is not None
@@ -360,6 +370,20 @@ class CanaryController:
             detail["reason"] = ("latency_regression" if lat_regressed
                                 else "shed_regression")
             return "regressed", detail
+        if self.slo is not None:
+            # the shared SLO derivation as a final gate: evaluate NOW
+            # (the bake traffic is in the registries) and regress on
+            # any firing burn-rate alert — the same objectives the
+            # fleet /slo and the autoscaler read, not a private one
+            try:
+                self.slo.evaluate()
+                firing = self.slo.firing()
+            except Exception:  # noqa: BLE001 — a broken tracker must
+                firing = []    # not veto a rollout the deltas cleared
+            if firing:
+                detail["reason"] = "slo_burn_rate"
+                detail["slo_firing"] = list(firing)
+                return "regressed", detail
         return "clean", detail
 
     @staticmethod
