@@ -458,6 +458,136 @@ def measure_fleet_router(n_replicas=3, n_groups=6, n_requests=60,
                       "for the routed head)"}
 
 
+def measure_crash_resume(n_replicas=3, max_new_tokens=24,
+                         step_delay_s=0.04, kill_after=6, iters=3,
+                         smoke=False):
+    """Crash-resume row: kill the replica serving a live greedy stream
+    and measure the CLIENT-observed continuation gap — the largest
+    inter-token arrival gap after the kill (the dying replica's
+    already-buffered tokens arrive instantly, so kill->next-token
+    would flatter both modes; the resume stall is what dominates the
+    worst inter-arrival gap) — for the router's two resume modes.
+    ``prefix`` resubmits prompt+journaled tokens as a forced prefix
+    (the sibling decodes only NEW tokens, often over a prefix-cache
+    chain hit), ``recompute`` replays the request from scratch and
+    relies on the router's index dedupe, so its gap grows with the
+    tokens already streamed — the gap ratio is the headline. Both
+    modes must stay token-identical to a never-killed oracle (the
+    ``token_identical`` guard), or the row is measuring a bug."""
+    import json as _json
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.fleet import FleetRouter, ReplicaPool
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                generate, init_params)
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        iters, max_new_tokens = 1, 16
+    c = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    prompt = [2, 7, 1, 8, 2, 8]
+    oracle = [int(t) for t in np.asarray(generate(
+        params, jnp.asarray(prompt)[None], max_new_tokens, c))[0]]
+
+    class _Slow(DecodeEngine):
+        # paces decode so the kill reliably lands mid-stream and the
+        # continuation gap is dominated by resume work, not step jitter
+        def step(self):
+            out = super().step()
+            time.sleep(step_delay_s)
+            return out
+
+    def _warm(url):
+        # engines compile prefill per distinct prompt length; warm the
+        # initial length (max_new=2 also compiles the decode step) and
+        # the lengths a prefix resume can land on, so the measured gap
+        # is resume work, not first-touch XLA compiles
+        lens = [len(prompt)] + list(range(len(prompt) + kill_after,
+                                          len(prompt) + kill_after + 4))
+        for i, length in enumerate(lens):
+            wreq = urllib.request.Request(
+                f"{url}/v1/generate",
+                data=_json.dumps({"prompt": [1] * length,
+                                  "max_new_tokens": 2 if i == 0
+                                  else 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(wreq, timeout=120).read()
+
+    def run(mode):
+        from concurrent.futures import ThreadPoolExecutor
+
+        gaps, identical = [], True
+        for _ in range(iters):
+            pool = ReplicaPool(lambda: _Slow(params, c, max_slots=2),
+                               n=n_replicas).start()
+            try:
+                with ThreadPoolExecutor(n_replicas) as ex:
+                    list(ex.map(_warm, pool.urls))
+                with FleetRouter(pool.urls, probe_interval=0.2,
+                                 evict_after=2,
+                                 stream_resume=mode) as router:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{router.port}/v1/generate",
+                        data=_json.dumps(
+                            {"prompt": prompt, "stream": True,
+                             "max_new_tokens": max_new_tokens}).encode(),
+                        headers={"Content-Type": "application/json"})
+                    streamed = []
+                    killed_at, worst_gap, prev = None, 0.0, None
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        for raw in r:
+                            line = _json.loads(raw)
+                            if "status" in line:
+                                continue
+                            now = time.perf_counter()
+                            if killed_at is not None and prev is not None:
+                                worst_gap = max(worst_gap,
+                                                now - max(prev, killed_at))
+                            prev = now
+                            streamed.extend(line["tokens"])
+                            if (killed_at is None
+                                    and len(streamed) >= kill_after):
+                                with urllib.request.urlopen(
+                                        f"http://127.0.0.1:"
+                                        f"{router.port}/stats",
+                                        timeout=30) as s:
+                                    stats = _json.loads(s.read())
+                                victim = next(
+                                    u for u, info in
+                                    stats["replicas"].items()
+                                    if info["in_flight"] > 0)
+                                pool.kill(pool.urls.index(victim))
+                                killed_at = time.perf_counter()
+                    identical &= streamed == oracle
+                    gaps.append(worst_gap)
+            finally:
+                pool.stop()
+        return sorted(gaps)[len(gaps) // 2], identical
+
+    prefix_gap, p_ok = run("prefix")
+    recompute_gap, r_ok = run("recompute")
+    return {"metric": "crash_resume_continuation_gap_s",
+            "value": round(prefix_gap, 4),
+            "unit": "s worst client inter-token gap after replica "
+                    "kill (prefix resume, median)",
+            "recompute_gap_s": round(recompute_gap, 4),
+            "resume_speedup": round(recompute_gap / prefix_gap, 2),
+            "token_identical": bool(p_ok and r_ok),
+            "replicas": n_replicas, "kill_after_tokens": kill_after,
+            "max_new_tokens": max_new_tokens, "iters": iters,
+            "config": f"{n_replicas} in-process replicas, "
+                      f"{step_delay_s * 1000:.0f} ms/step pacing, "
+                      f"replica killed after {kill_after} streamed "
+                      "tokens; gap = worst post-kill inter-token "
+                      "arrival gap"}
+
+
 class _UniformSlowStep:
     """Engine shim: every step() stalls a fixed amount — scales one
     replica's capacity DOWN so a tiny CPU model saturates under a few
@@ -2227,6 +2357,8 @@ if __name__ == "__main__":
         _emit(measure_autoscaler(smoke=smoke))
     if which in ("slo_plane", "all"):
         _emit(measure_slo_plane(smoke=smoke))
+    if which in ("crash_resume", "all"):
+        _emit(measure_crash_resume(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

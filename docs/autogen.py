@@ -115,6 +115,7 @@ PAGES = [
     ("HTTP serving", "elephas_tpu.serving_http", ["ServingServer"]),
     ("Serving fleet API", "elephas_tpu.fleet",
      ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool",
+      "ReplicaSupervisor", "RestartPolicy",
       "FleetAutoscaler", "TierPolicy", "ReplicaPoolTier",
       "DisaggDecodeTier", "DisaggPrefillTier"]),
     ("Disaggregated serving API", "elephas_tpu.disagg",
@@ -161,6 +162,8 @@ PAGES = [
      ["LoopProfiler"]),
     ("SLO plane API", "elephas_tpu.obs.slo",
      ["SLOObjective", "SLOTracker"]),
+    ("Engine watchdog API", "elephas_tpu.obs.watchdog",
+     ["EngineWatchdog"]),
     ("Wire codec", "elephas_tpu.utils.tensor_codec",
      ["encode_tensors", "decode_tensors", "encode", "decode"]),
     ("Delta compression", "elephas_tpu.utils.delta_compression",

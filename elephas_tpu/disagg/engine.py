@@ -141,7 +141,9 @@ class DisaggEngine:
                admit: bool = True,
                deadline_ms: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority=None) -> int:
+               priority=None,
+               seed: Optional[int] = None,
+               resume_from: int = 0) -> int:
         """Queue a request; the prefill tier computes its KV state and
         this engine decodes it. Same argument semantics as
         :meth:`~elephas_tpu.serving_engine.DecodeEngine.submit`
@@ -149,7 +151,13 @@ class DisaggEngine:
         always deferred to the engine loop here — prefill runs
         off-thread regardless). ``tenant``/``priority`` ride the wire
         meta to the decode engine, whose QoS policy (fair queueing,
-        quotas, preemption) acts on them at KV-install admission."""
+        quotas, preemption) acts on them at KV-install admission.
+        ``seed``/``resume_from`` compose the same way: the seed keys
+        the prefill worker's first-token sample and every decode step
+        (position-deterministic), and ``resume_from`` rides the wire
+        meta to the decode engine's forced-prefix admission — so a
+        dead decode worker's requests resume on a sibling exactly like
+        the aggregated fleet's, shipped-frame path unchanged."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         # fail fast with the decode engine's own validation messages:
         # an inadmissible request must 400 at submit, not die on a
@@ -177,6 +185,21 @@ class DisaggEngine:
                              "supported in speculative mode")
         if deadline_ms is not None and not deadline_ms > 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        # the decode engine's own seed/resume rules, enforced at THIS
+        # submit so they 400 here instead of dying at KV-install time
+        if seed is not None:
+            if getattr(self.decode, "draft_config", None) is not None:
+                raise ValueError("per-request seeds are not supported "
+                                 "in speculative mode")
+            seed = int(seed)
+            if not 0 <= seed < 2 ** 31:
+                raise ValueError(
+                    f"seed must be in [0, 2**31), got {seed}")
+        resume_from = int(resume_from)
+        if resume_from and not 0 < resume_from < prompt.size:
+            raise ValueError(
+                f"resume_from ({resume_from}) must leave at least one "
+                f"real prompt token (prompt has {prompt.size})")
         if tenant is not None:
             # the per-tenant quota 429, enforced at THIS front end's
             # submit exactly like the decode engine's own (the shared
@@ -219,7 +242,8 @@ class DisaggEngine:
                          top_p=top_p, deadline=deadline,
                          target=self.receiver.addr, ctx=ctx,
                          on_failed=self._job_failed, clock=self._clock,
-                         tenant=tenant, priority=priority)
+                         tenant=tenant, priority=priority,
+                         seed=seed, resume_from=resume_from)
         with self._lock:
             self._stage[rid] = {"state": "queued", "job": job,
                                 "drid": None, "deadline": deadline,
@@ -560,6 +584,8 @@ class DisaggEngine:
                                          else int(wire_v)),
                         tenant=meta.get("tenant"),
                         priority=meta.get("priority"),
+                        seed=meta.get("seed"),
+                        resume_from=int(meta.get("resume_from") or 0),
                         # TTFT measures from the CLIENT's submit: the
                         # prefill tier's queue wait, compute, and KV
                         # ship all land inside it (queue-wait series

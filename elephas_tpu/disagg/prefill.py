@@ -39,14 +39,15 @@ class PrefillJob:
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "deadline", "target", "ctx",
                  "enqueued_t", "attempts", "on_failed", "abandoned",
-                 "clock", "tenant", "priority")
+                 "clock", "tenant", "priority", "seed", "resume_from")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  temperature=None, top_k=None, top_p=None,
                  deadline: Optional[float] = None, target=None,
                  ctx=None,
                  on_failed: Optional[Callable] = None,
-                 clock=time.monotonic, tenant=None, priority=None):
+                 clock=time.monotonic, tenant=None, priority=None,
+                 seed=None, resume_from: int = 0):
         self.rid = int(rid)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -68,6 +69,12 @@ class PrefillJob:
         # request's reconstruction fields
         self.tenant = None if tenant is None else str(tenant)
         self.priority = priority
+        # crash-safe serving fields: the per-request RNG seed keys the
+        # worker's first-token sample (position-deterministic, so a
+        # resumed request re-samples identically), and resume_from
+        # rides to the decode engine's forced-prefix admission
+        self.seed = None if seed is None else int(seed)
+        self.resume_from = int(resume_from)
         #: set by the dispatcher when the request terminated while this
         #: job was queued (cancel, deadline sweep): the worker drops it
         #: without spending prefill compute or wire bandwidth
@@ -319,12 +326,13 @@ class PrefillWorker:
             out = self.engine.export_prefill(
                 job.prompt, temperature=job.temperature,
                 top_k=job.top_k, top_p=job.top_p,
-                block_size=self.block_size)
+                block_size=self.block_size, seed=job.seed)
         meta = {"rid": job.rid, "prompt": job.prompt,
                 "max_new_tokens": job.max_new_tokens,
                 "temperature": job.temperature,
                 "top_k": job.top_k, "top_p": job.top_p,
                 "tenant": job.tenant, "priority": job.priority,
+                "seed": job.seed, "resume_from": job.resume_from,
                 "deadline": job.deadline,
                 "first_token": out["first_token"],
                 "prompt_tokens": out["prompt_tokens"],

@@ -13,8 +13,12 @@ membership, and an in-process replica pool for tests.
 - :class:`~.hashring.HashRing` — the deterministic consistent-hash
   ring (only ~1/N of keys move per membership change).
 - :class:`~.pool.ReplicaPool` — N engine+server replicas in one
-  process, with kill/drain/scale verbs and lazy per-replica prefix
-  registration, for tests and the ``fleet_router`` bench row.
+  process, with kill/drain/scale/restart verbs and lazy per-replica
+  prefix registration, for tests and the ``fleet_router`` bench row.
+- :class:`~.pool.ReplicaSupervisor` — crash-only supervision over the
+  pool: dead-evicted replicas respawn after :class:`~.pool.
+  RestartPolicy` exponential backoff; crash-loopers are quarantined
+  (``fleet.replica_crashlooping``) and the autoscaler replaces them.
 - :class:`~.autoscaler.FleetAutoscaler` — the demand-driven control
   loop over it all: reads the per-tier queue-wait/shed/backlog signals
   off the membership prober, scales decode replicas and prefill
@@ -29,10 +33,10 @@ from .autoscaler import (DisaggDecodeTier, DisaggPrefillTier,
                          FleetAutoscaler, ReplicaPoolTier, TierPolicy)
 from .hashring import HashRing
 from .membership import ReplicaMembership, ReplicaState
-from .pool import ReplicaPool
+from .pool import ReplicaPool, ReplicaSupervisor, RestartPolicy
 from .router import FleetRouter
 
 __all__ = ["FleetRouter", "HashRing", "ReplicaMembership",
-           "ReplicaState", "ReplicaPool", "FleetAutoscaler",
-           "TierPolicy", "ReplicaPoolTier", "DisaggDecodeTier",
-           "DisaggPrefillTier"]
+           "ReplicaState", "ReplicaPool", "ReplicaSupervisor",
+           "RestartPolicy", "FleetAutoscaler", "TierPolicy",
+           "ReplicaPoolTier", "DisaggDecodeTier", "DisaggPrefillTier"]

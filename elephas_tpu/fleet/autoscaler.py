@@ -158,12 +158,17 @@ class ReplicaPoolTier(_DrainingMixin):
     name = "decode"
 
     def __init__(self, router, pool, policy: Optional[TierPolicy] = None,
-                 drain_timeout: float = 30.0):
+                 drain_timeout: float = 30.0, supervisor=None):
         super().__init__()
         self.router = router
         self.pool = pool
         self.policy = policy if policy is not None else TierPolicy()
         self.drain_timeout = float(drain_timeout)
+        # the pool's ReplicaSupervisor, when one runs: its pending
+        # restarts count as capacity (see count()), so the below-floor
+        # rule only replaces what the supervisor GAVE UP on
+        # (quarantined crash-loopers), never a replica mid-backoff
+        self.supervisor = supervisor
 
     # ------------------------------------------------------ pool hooks
     def _alive_indexes(self) -> List[int]:
@@ -178,8 +183,11 @@ class ReplicaPoolTier(_DrainingMixin):
     # -------------------------------------------------------- contract
     def count(self) -> int:
         excluded = self._excluded()
-        return sum(1 for i in self._alive_indexes()
+        live = sum(1 for i in self._alive_indexes()
                    if i not in excluded)
+        if self.supervisor is not None:
+            live += self.supervisor.pending_restarts()
+        return live
 
     def signals(self) -> Dict:
         sig = dict(self.router.membership.tier_signals()["decode"])

@@ -161,6 +161,10 @@ class ReplicaMembership:
         self.probe_timeout = float(probe_timeout)
         self._on_evict = on_evict
         self._on_join = on_join
+        # extra eviction subscribers beyond the router's own hook (the
+        # replica supervisor rides here); fired after _on_evict, outside
+        # the membership lock, each guarded — see add_evict_listener
+        self._evict_listeners: List[Callable[[str, str], None]] = []
         self._lock = threading.Lock()
         self._replicas: Dict[str, ReplicaState] = {
             u: ReplicaState(u) for u in self._urls}
@@ -361,17 +365,56 @@ class ReplicaMembership:
         self._evicted(url, reason)
         return True
 
+    def note_death(self, url: str) -> None:
+        """Direct death evidence for a replica that is ALREADY out of
+        the ring (``mark_down`` returned False): no eviction happens —
+        there is nothing left to evict — but the eviction LISTENERS
+        still hear ``(url, "dead")``. The case that needs this is a
+        crash-looping replica dying between its restart and its first
+        ready probe: it never re-joined, so there is no up->down
+        transition to observe, yet the supervisor must count the death
+        or the crash-loop quarantine never trips. Listeners dedupe
+        per-URL themselves (this path, unlike an eviction, can fire
+        repeatedly — once per client request that trips over the
+        corpse)."""
+        url = str(url).rstrip("/")
+        with self._lock:
+            st = self._replicas.get(url)
+            if st is None or st.ready:
+                return          # unknown, or alive: mark_down's job
+            st.reachable = False
+        for fn in list(self._evict_listeners):
+            try:
+                fn(url, "dead")
+            except Exception:  # noqa: BLE001
+                pass
+
     def _joined(self, url: str):
         self._m_joined.inc()
         emit_event("fleet.replica_joined", replica=url)
         if self._on_join is not None:
             self._on_join(url)
 
+    def add_evict_listener(self,
+                           fn: Callable[[str, str], None]) -> None:
+        """Subscribe an ADDITIONAL ``fn(url, reason)`` eviction hook
+        (the ctor's ``on_evict`` stays the router's orphan-resubmit
+        path; the replica supervisor subscribes here without displacing
+        it). Fired after ``on_evict``, outside the membership lock;
+        exceptions are swallowed — one broken subscriber must not
+        starve the others or the prober."""
+        self._evict_listeners.append(fn)
+
     def _evicted(self, url: str, reason: str):
         self._m_evicted.inc()
         emit_event("fleet.replica_evicted", replica=url, reason=reason)
         if self._on_evict is not None:
             self._on_evict(url, reason)
+        for fn in list(self._evict_listeners):
+            try:
+                fn(url, reason)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -------------------------------------------------------------- queries
     def route_chain(self, key: bytes) -> List[str]:

@@ -29,11 +29,14 @@ hot; under round-robin every replica pays the miss for every prefix.
 it).
 """
 import threading
-from typing import Callable, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Set
 
+from ..obs.context import new_root, use_context
+from ..obs.events import emit as emit_event
 from ..serving_http import ServingServer
 
-__all__ = ["ReplicaPool"]
+__all__ = ["ReplicaPool", "ReplicaSupervisor", "RestartPolicy"]
 
 
 class _AutoPrefixEngine:
@@ -187,6 +190,34 @@ class ReplicaPool:
         with self._lock:
             self._alive[i] = False
 
+    def restart(self, i: int) -> str:
+        """Replace a DEAD replica in place: a fresh factory engine
+        behind a fresh :class:`ServingServer` on a new port, at the
+        same pool index (so per-index death accounting — the
+        supervisor's crash-loop window — survives the URL change).
+        Returns the new base URL; hand it to the router's
+        ``add_replica`` and it joins through the normal probe path."""
+        with self._lock:
+            if not (0 <= i < len(self.servers)):
+                raise IndexError(f"no replica {i}")
+            if self._alive[i]:
+                raise RuntimeError(
+                    f"replica {i} is still alive; kill or decommission "
+                    "it before restarting")
+        engine = self._factory()
+        if self._auto_prefix_tokens is not None:
+            engine = _AutoPrefixEngine(
+                engine, self._auto_prefix_tokens,
+                capacity=self._auto_prefix_capacity)
+        srv = ServingServer(engine, host=self._host, port=0,
+                            tokenizer=self._tokenizer,
+                            **self._server_kwargs)
+        srv.start()
+        with self._lock:
+            self.servers[i] = srv
+            self._alive[i] = True
+        return f"http://{self._host}:{srv.port}"
+
     # ------------------------------------------------------------ queries
     @property
     def urls(self) -> List[str]:
@@ -203,3 +234,246 @@ class ReplicaPool:
     def alive_indexes(self) -> List[int]:
         with self._lock:
             return [i for i, a in enumerate(self._alive) if a]
+
+
+class RestartPolicy:
+    """When and how the supervisor restarts a dead replica.
+
+    :param backoff_base_s: delay before the FIRST restart of a window;
+        each further death in the window doubles it (exponential
+        backoff — a replica dying to a bad weight file must not burn
+        CPU respawning at line rate).
+    :param backoff_max_s: backoff ceiling.
+    :param crashloop_window_s: sliding window for death counting. A
+        death older than this is forgotten — a replica that crashed
+        twice last week is not crash-looping.
+    :param crashloop_threshold: deaths inside the window (the fatal one
+        included) at which the supervisor STOPS restarting: the replica
+        is quarantined — left evicted, ``fleet.replica_crashlooping``
+        emitted — and replacing the lost capacity becomes the
+        autoscaler's job (its below-floor rule), which spawns a FRESH
+        factory replica instead of resurrecting a poisoned one.
+    """
+
+    def __init__(self, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 crashloop_window_s: float = 60.0,
+                 crashloop_threshold: int = 3):
+        if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
+            raise ValueError(
+                f"need 0 < backoff_base_s <= backoff_max_s, got "
+                f"{backoff_base_s}/{backoff_max_s}")
+        if crashloop_threshold < 1:
+            raise ValueError(
+                f"crashloop_threshold must be >= 1, got "
+                f"{crashloop_threshold}")
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.crashloop_window_s = float(crashloop_window_s)
+        self.crashloop_threshold = int(crashloop_threshold)
+
+    def backoff_s(self, deaths_in_window: int) -> float:
+        """Backoff before the restart following death number
+        ``deaths_in_window`` of the current window."""
+        k = max(1, int(deaths_in_window))
+        return min(self.backoff_max_s,
+                   self.backoff_base_s * (2.0 ** (k - 1)))
+
+
+class ReplicaSupervisor:
+    """Process supervision for a :class:`ReplicaPool` behind a
+    :class:`~.router.FleetRouter` — the fleet-side half of crash-only
+    serving (the replica-side half is the engine watchdog's abort).
+
+    Subscribes to the router membership's eviction feed
+    (:meth:`~.membership.ReplicaMembership.add_evict_listener`, so the
+    router's own orphan-resubmit hook is undisturbed) and, on a
+    ``"dead"`` eviction of a replica the pool confirms dead, schedules
+    :meth:`ReplicaPool.restart` after the policy's exponential backoff,
+    then swaps the router's candidate set old URL -> new URL (the
+    restarted replica joins through the normal probe path, exactly like
+    a scale-up). Deaths are counted per POOL INDEX in a sliding window;
+    at ``crashloop_threshold`` the replica is quarantined instead —
+    ``fleet.replica_crashlooping`` + ``fleet_replicas_crashlooping_
+    total`` — and the autoscaler's below-floor rule replaces the
+    capacity with a fresh spawn. :meth:`pending_restarts` feeds the
+    :class:`~.autoscaler.ReplicaPoolTier` count so a replica mid-backoff
+    is not double-replaced.
+
+    Restarts run on background threads (an eviction listener fires
+    inside the prober or a client request — neither may sleep out a
+    backoff). ``clock`` is injectable for deterministic window tests.
+    """
+
+    def __init__(self, pool: ReplicaPool, router,
+                 policy: Optional[RestartPolicy] = None,
+                 registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.pool = pool
+        self.router = router
+        self.policy = policy if policy is not None else RestartPolicy()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._deaths: Dict[int, List[float]] = {}
+        self._seen_dead: Set[str] = set()
+        self._quarantined: Set[int] = set()
+        self._pending = 0
+        self._stop = threading.Event()
+        reg = registry if registry is not None else router.registry
+        self._m_restarts = reg.counter(
+            "fleet_replica_restarts_total",
+            "dead replicas respawned by the supervisor").labels()
+        self._m_crashloop = reg.counter(
+            "fleet_replicas_crashlooping_total",
+            "replicas quarantined for dying crashloop_threshold times "
+            "inside the crash-loop window").labels()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "ReplicaSupervisor":
+        self.router.membership.add_evict_listener(self._on_evict)
+        return self
+
+    def stop(self) -> None:
+        """Stop scheduling/performing restarts (the subscription stays;
+        it checks this flag — pending backoff sleeps wake and exit)."""
+        self._stop.set()
+
+    # ------------------------------------------------------------ the loop
+    def _on_evict(self, url: str, reason: str) -> None:
+        if reason != "dead" or self._stop.is_set():
+            return
+        try:
+            i = self.pool.urls.index(url)
+        except ValueError:
+            return            # not this pool's replica (or already
+        if self.pool.alive(i):  # swapped out by a finished restart)
+            # the pool says it is running: a transient connect failure,
+            # not a death — the prober re-joins it on its own
+            return
+        self._handle_death(i, url)
+
+    def _handle_death(self, i: int, url: str) -> None:
+        now = self._clock()
+        with self._lock:
+            if url in self._seen_dead:
+                # each URL dies at most once (every restart mints a new
+                # one) — but note_death fires per client request that
+                # trips over the corpse, and an eviction for the same
+                # URL may race it
+                return
+            self._seen_dead.add(url)
+            if len(self._seen_dead) > 4096:
+                self._seen_dead.pop()
+            if i in self._quarantined:
+                return
+            d = self._deaths.setdefault(i, [])
+            d.append(now)
+            cutoff = now - self.policy.crashloop_window_s
+            d[:] = [t for t in d if t >= cutoff]
+            k = len(d)
+            quarantine = k >= self.policy.crashloop_threshold
+            if quarantine:
+                self._quarantined.add(i)
+            else:
+                self._pending += 1
+        if quarantine:
+            self._m_crashloop.inc()
+            with use_context(new_root()):
+                emit_event("fleet.replica_crashlooping", replica=url,
+                           index=i, deaths_in_window=k,
+                           window_s=self.policy.crashloop_window_s,
+                           action="quarantined")
+            # leave it dead; drop it from the candidate set so the
+            # prober stops polling a corpse. The fleet is now under its
+            # floor — the autoscaler's below_floor rule spawns a FRESH
+            # replica (never this one again)
+            self.router.remove_replica(url)
+            return
+        threading.Thread(
+            target=self._restart_later,
+            args=(i, url, self.policy.backoff_s(k), k), daemon=True,
+            name=f"fleet-replica-restart-{i}").start()
+
+    def _restart_later(self, i: int, old_url: str, backoff: float,
+                       deaths: int) -> None:
+        new_url = None
+        try:
+            if self._stop.wait(backoff):
+                return
+            try:
+                new_url = self.pool.restart(i)
+            except Exception:  # noqa: BLE001 — the factory itself
+                # failed (bad weights, OOM): that IS another death;
+                # the finally below releases THIS attempt's pending
+                # slot after _handle_death takes the next one (un-see
+                # the URL first — this death is new evidence, not the
+                # client-poke echo the dedupe exists to drop)
+                with self._lock:
+                    self._seen_dead.discard(old_url)
+                self._handle_death(i, old_url)
+                return
+            # swap the candidate set old -> new; the restarted replica
+            # takes traffic only after join_after ready probes, exactly
+            # like an autoscaler spawn
+            self.router.remove_replica(old_url)
+            self.router.add_replica(new_url)
+            self._m_restarts.inc()
+            with use_context(new_root()):
+                emit_event("fleet.replica_restarted", replica=new_url,
+                           replaced=old_url, index=i,
+                           backoff_s=round(backoff, 6),
+                           deaths_in_window=deaths)
+        finally:
+            with self._lock:
+                if self._pending > 0:
+                    self._pending -= 1
+        if new_url is not None:
+            self._watch_restart(i, new_url)
+
+    def _watch_restart(self, i: int, url: str) -> None:
+        """Babysit a respawn until the prober confirms it ready.
+
+        A replica that dies BEFORE its first ready probe is invisible
+        to every other death signal: the data path never routes to an
+        unready replica (so ``_replica_dead``/``note_death`` never
+        fire) and the prober has no up->down transition to evict. That
+        silent window is exactly where a fast crash-loop lives, so the
+        supervisor — which, like any supervisor, watches the child it
+        just spawned — polls the pool's liveness until membership
+        reports the replica ready, and books a pre-ready death itself.
+        Bounded by the crash-loop window: past it the death would have
+        started a fresh window anyway.
+        """
+        deadline = self._clock() + self.policy.crashloop_window_s
+        poll = min(0.05, self.policy.backoff_base_s)
+        while not self._stop.is_set() and self._clock() < deadline:
+            with self._lock:
+                if i in self._quarantined:
+                    return
+            if self.pool.urls[i] != url:
+                return        # a newer restart took the slot over
+            if not self.pool.alive(i):
+                self._handle_death(i, url)
+                return
+            if self.router.membership.is_ready(url):
+                return        # confirmed up: normal signals take over
+            time.sleep(poll)
+
+    # ------------------------------------------------------------- queries
+    def pending_restarts(self) -> int:
+        """Replicas currently waiting out a backoff or mid-respawn —
+        capacity that is COMING BACK, which the autoscaler tier adds to
+        its count so it does not double-replace it."""
+        with self._lock:
+            return self._pending
+
+    def quarantined(self) -> List[int]:
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {"pending_restarts": self._pending,
+                    "quarantined": sorted(self._quarantined),
+                    "deaths": {i: len(d) for i, d in
+                               self._deaths.items() if d}}

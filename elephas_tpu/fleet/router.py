@@ -200,6 +200,21 @@ class FleetRouter:
         path; each arm backs its polls off 1.25x per round toward a
         50 ms ceiling, so a long generate does not hold a fast poll
         loop for its whole life.
+    :param stream_resume: what happens to a live stream whose replica
+        dies mid-generation. ``"prefix"`` (the default) resumes it on
+        a sibling by resubmitting prompt + journaled emitted tokens as
+        a forced prefix (``resume_from=N`` — greedy continuations are
+        token-identical to the uninterrupted stream, and the sibling's
+        prefix cache often makes the re-prefill a chain hit);
+        ``"recompute"`` resubmits the original body from scratch and
+        relies on the router's token-index dedupe to keep client
+        delivery exactly-once (identical under greedy decoding, the
+        ``crash_resume`` bench baseline); ``"off"`` fails the stream
+        with a terminal error line (pre-resume behavior, minus the
+        silent connection drop).
+    :param stream_max_resumes: resume attempts per stream before the
+        router gives up with a terminal error — the crash-loop guard
+        for a request whose every host dies.
     :param registry: metrics registry for the ``fleet_*`` series
         (fresh per-router by default, the engines' convention).
     """
@@ -217,9 +232,15 @@ class FleetRouter:
                  hedge_max_fraction: float = 0.10,
                  hedge_min_samples: int = 20,
                  hedge_poll_s: float = 0.01,
+                 stream_resume: str = "prefix",
+                 stream_max_resumes: int = 4,
                  registry: Optional[MetricsRegistry] = None):
         if policy not in ("prefix_hash", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
+        if stream_resume not in ("prefix", "recompute", "off"):
+            raise ValueError(f"unknown stream_resume {stream_resume!r}")
+        self.stream_resume = stream_resume
+        self.stream_max_resumes = max(0, int(stream_max_resumes))
         self.policy = policy
         self.prefix_tokens = int(prefix_tokens)
         self.spill_threshold = (None if spill_threshold is None
@@ -262,6 +283,17 @@ class FleetRouter:
             "fleet_stream_ttft_seconds",
             "router-edge time to first streamed token line (client-"
             "observed TTFT for streaming generates)").labels()
+        # crash-safe streaming: interruptions (the PR 6 gap — a stream
+        # failing AFTER its first token used to surface only as a
+        # broken client connection) and the resumes that heal them
+        self._m_stream_interrupted = reg.counter(
+            "fleet_streams_interrupted_total",
+            "live streams whose upstream replica failed after the "
+            "response headers went out").labels()
+        self._m_stream_resumed = reg.counter(
+            "fleet_streams_resumed_total",
+            "interrupted streams continued on a sibling replica (the "
+            "mode rides the fleet.stream_resumed event)").labels()
         # hedged tail retries
         self.hedge = bool(hedge)
         if not 0.0 < float(hedge_quantile) < 1.0:
@@ -293,6 +325,7 @@ class FleetRouter:
         # reports THIS router's deltas even over an injected registry
         self._stat_base = counter_baseline(
             self._m_spilled, self._m_rerouted, self._m_hedged,
+            self._m_stream_interrupted, self._m_stream_resumed,
             self.membership._m_joined, self.membership._m_evicted)
         # fleet rid -> {"url", "rid", "body", "orphan"}; insertion-
         # ordered so abandoned submits evict oldest-first
@@ -300,6 +333,13 @@ class FleetRouter:
         self._trace_map: "OrderedDict[int, Tuple[str, int]]" = OrderedDict()
         self._records_lock = threading.Lock()
         self._next_fid = 0
+        # generation journal: fleet id -> every token this router has
+        # forwarded for a LIVE stream, in order. The stream handler
+        # appends as lines arrive and resumes off it when the upstream
+        # dies; bounded like _records so abandoned handlers cannot
+        # leak (a journal evicted mid-stream only downgrades that
+        # stream's resume to "recompute")
+        self._journal: "OrderedDict[int, Dict]" = OrderedDict()
         self._rr = 0                 # round-robin cursor
         self._rr_lock = threading.Lock()
         self._stop = threading.Event()
@@ -454,6 +494,10 @@ class FleetRouter:
         already-evicted case, not twice."""
         if not self.membership.mark_down(url, "dead"):
             self._on_evict(url, "dead")
+            # already out of the ring (e.g. died before its first
+            # ready probe): the supervisor still needs the death
+            # evidence, or a fast crash-loop is invisible to it
+            self.membership.note_death(url)
 
     def _replica_alive(self, url: str) -> bool:
         """Quick readiness recheck after a replica-side error: decides
@@ -1029,6 +1073,12 @@ class FleetRouter:
             "replicas_evicted": int(
                 since_baseline(since, self.membership._m_evicted)),
             "requests_tracked": tracked,
+            "stream_resume": self.stream_resume,
+            "streams_interrupted": int(
+                since_baseline(since, self._m_stream_interrupted)),
+            "streams_resumed": int(
+                since_baseline(since, self._m_stream_resumed)),
+            "streams_journaled": len(self._journal),
         }
 
     # ------------------------------------------------------------ handler
@@ -1183,34 +1233,158 @@ class FleetRouter:
                 """Proxy a streaming generate: the upstream is opened
                 (status + headers on the wire) BEFORE our 200 goes out,
                 so replica failure before the first token still retries
-                on a sibling; after that, lines forward as they
-                arrive."""
+                on a sibling. After that, every token line is parsed,
+                JOURNALED, and forwarded by global token index — so
+                when the upstream dies mid-generation (socket failure,
+                EOF without a terminal line, a terminal engine error,
+                or the "cancelled" a killed replica's shutdown path
+                writes) the stream resumes on a sibling and the client
+                sees each token index exactly once, with no visible
+                seam beyond the resume's re-prefill latency."""
                 url, upstream = router._open_stream(body)
+                fid = router._journal_open(url, body)
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                ctx = current_context()
+                if ctx is not None:
+                    self.send_header("X-Trace-Id", ctx.trace_id)
+                self.end_headers()
+                sent = 0      # token indices already on the client wire
+                base = 0      # global index of the CURRENT upstream's
+                got = 0       # first emission, and tokens seen from it
+                resumes = 0
+                first_tokens = True
                 try:
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/x-ndjson")
-                    ctx = current_context()
-                    if ctx is not None:
-                        self.send_header("X-Trace-Id", ctx.trace_id)
-                    self.end_headers()
-                    first_tokens = True
-                    for raw in upstream:
-                        self.wfile.write(raw)
-                        self.wfile.flush()
-                        if first_tokens and b'"tokens"' in raw:
-                            # client-observed TTFT: the first token
-                            # line just left on the client's wire
-                            first_tokens = False
-                            router._m_stream_ttft.observe(
-                                time.perf_counter() - self._t0)
-                except Exception:  # noqa: BLE001 — client or replica
-                    pass           # gone mid-stream: close both sides
+                    while True:
+                        client_gone = False
+                        terminal = None
+                        try:
+                            for raw in upstream:
+                                try:
+                                    line = json.loads(raw)
+                                except ValueError:
+                                    line = None
+                                if not isinstance(line, dict):
+                                    continue       # half-written line
+                                toks = line.get("tokens")
+                                if (isinstance(toks, list)
+                                        and "status" not in line):
+                                    # dedupe by GLOBAL token index: a
+                                    # "recompute" resume re-emits from
+                                    # index 0 and only indices the
+                                    # client has not seen forward
+                                    fresh = []
+                                    for t in toks:
+                                        idx = base + got
+                                        got += 1
+                                        router._journal_token(
+                                            fid, idx, int(t))
+                                        if idx >= sent:
+                                            fresh.append(int(t))
+                                    if not fresh:
+                                        continue
+                                    try:
+                                        self.wfile.write(
+                                            (json.dumps(
+                                                {"tokens": fresh})
+                                             + "\n").encode())
+                                        self.wfile.flush()
+                                    except Exception:  # noqa: BLE001
+                                        client_gone = True
+                                        break
+                                    sent = base + got
+                                    if first_tokens:
+                                        # client-observed TTFT: the
+                                        # first token line just left
+                                        # on the client's wire
+                                        first_tokens = False
+                                        router._m_stream_ttft.observe(
+                                            time.perf_counter()
+                                            - self._t0)
+                                    continue
+                                if "status" in line:
+                                    terminal = line
+                                    break
+                        except Exception:  # noqa: BLE001 — upstream
+                            pass  # read failed mid-stream: resume below
+                        if client_gone:
+                            return   # client hung up: nobody to resume for
+                        status = (None if terminal is None
+                                  else terminal.get("status"))
+                        if status is not None and status not in (
+                                "error", "cancelled"):
+                            # clean end (done / expired / timeout):
+                            # forward the terminal verbatim.
+                            # "cancelled" is NOT clean here: routed
+                            # streams never expose a cancellable id, so
+                            # it can only be the upstream's shutdown
+                            # path — a dying replica, resumable.
+                            try:
+                                self.wfile.write(
+                                    (json.dumps(terminal)
+                                     + "\n").encode())
+                                self.wfile.flush()
+                            except Exception:  # noqa: BLE001
+                                pass
+                            return
+                        # the upstream died mid-generation
+                        router._m_stream_interrupted.inc()
+                        emit_event("fleet.stream_interrupted",
+                                   replica=url, fid=fid,
+                                   tokens_streamed=sent,
+                                   terminal_status=status)
+                        if not router._replica_alive(url):
+                            router._replica_dead(url)
+                        resumes += 1
+                        nxt = False
+                        if (router.stream_resume != "off"
+                                and resumes
+                                <= router.stream_max_resumes):
+                            try:
+                                nxt = router._resume_stream(
+                                    body,
+                                    router._journal_tokens(fid),
+                                    exclude=(url,))
+                            except Exception:  # noqa: BLE001 — no
+                                nxt = False    # sibling could take it
+                        # release the dead upstream's dispatch slot
+                        # BEFORE switching (the finally below releases
+                        # whichever upstream is current at exit)
+                        try:
+                            upstream.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        router.membership.record_dispatch(url, -1)
+                        if nxt is None:
+                            # every budgeted token was already
+                            # delivered — only the terminal was lost
+                            url = None
+                            self._stream_terminal({"status": "done"})
+                            return
+                        if nxt is False:
+                            url = None
+                            self._stream_terminal({
+                                "status": "error",
+                                "error": "replica failed mid-stream "
+                                         "and the stream could not "
+                                         "be resumed"})
+                            return
+                        url, upstream, base, mode = nxt
+                        got = 0
+                        router._journal_retarget(fid, url)
+                        router._m_stream_resumed.inc()
+                        emit_event("fleet.stream_resumed",
+                                   replica=url, fid=fid, mode=mode,
+                                   resume_from=base, tokens_sent=sent)
                 finally:
-                    upstream.close()
-                    # the stream held an in-flight slot on the spill
-                    # signal for its whole life (see _open_stream)
-                    router.membership.record_dispatch(url, -1)
+                    router._journal_close(fid)
+                    if url is not None:
+                        upstream.close()
+                        # the stream held an in-flight slot on the
+                        # spill signal for its whole life (see
+                        # _open_stream)
+                        router.membership.record_dispatch(url, -1)
                     # the 200 went out before the first token; record
                     # the FULL stream duration (streams bypass _reply,
                     # which otherwise owns this histogram)
@@ -1218,9 +1392,20 @@ class FleetRouter:
                         route="/v1/generate", status="200").observe(
                         time.perf_counter() - self._t0)
 
+            def _stream_terminal(self, payload: Dict):
+                """Best-effort terminal line for an already-started
+                stream (the headers are long gone — all that is left
+                is telling the client HOW it ended)."""
+                try:
+                    self.wfile.write((json.dumps(payload)
+                                      + "\n").encode())
+                    self.wfile.flush()
+                except Exception:  # noqa: BLE001 — client gone too
+                    pass
+
         return Handler
 
-    def _open_stream(self, body: Dict) -> Tuple[str, object]:
+    def _open_stream(self, body: Dict, exclude=()) -> Tuple[str, object]:
         """Open a streaming generate on a policy-chosen replica —
         the same :meth:`_foreach_candidate` retry semantics as blocking
         dispatch (retries are safe until the first token is forwarded,
@@ -1243,4 +1428,82 @@ class FleetRouter:
             self._m_routed.labels(replica=url, policy=how).inc()
             return url, resp
 
-        return self._foreach_candidate(body, attempt)
+        return self._foreach_candidate(body, attempt, exclude=exclude)
+
+    def _resume_stream(self, body: Dict, emitted: List[int], exclude=()):
+        """Open a CONTINUATION stream for an interrupted generate.
+
+        In ``"prefix"`` mode (token prompts only) the replacement
+        replica is told the whole story: the original prompt plus every
+        journaled token becomes the new prompt, ``resume_from`` declares
+        the journaled suffix to be already-emitted output, and
+        ``max_new_tokens`` shrinks to the unspent budget — the sibling
+        re-prefills (often a prefix-cache chain hit) and decodes ONLY
+        new tokens, so the handler's index dedupe never fires. Falls
+        back to ``"recompute"`` (same request from scratch, the handler
+        skips already-sent indices) for text prompts, empty journals,
+        or a journal entry lost to ``max_tracked`` pressure.
+
+        Returns ``(url, response, base, mode)`` where ``base`` is the
+        global index of the new upstream's first emission; ``None``
+        when the budget is already fully delivered (only the terminal
+        line was lost); raises when no sibling could take it.
+        """
+        mode = self.stream_resume
+        new = dict(body)
+        prompt = body.get("prompt")
+        max_new = body.get("max_new_tokens")
+        base = 0
+        if (mode == "prefix" and emitted
+                and isinstance(prompt, (list, tuple))
+                and isinstance(max_new, int)):
+            remaining = max_new - len(emitted)
+            if remaining < 1:
+                return None
+            new["prompt"] = list(prompt) + [int(t) for t in emitted]
+            new["max_new_tokens"] = remaining
+            new["resume_from"] = len(emitted)
+            base = len(emitted)
+        else:
+            mode = "recompute"
+            new.pop("resume_from", None)
+        url, resp = self._open_stream(new, exclude=exclude)
+        return url, resp, base, mode
+
+    # ------------------------------------------------------ stream journal
+    # Per-stream token journals, keyed by fleet id like _records: the
+    # crash-safe half of streaming. _records only covers SUBMITS (the
+    # orphan sweep re-posts them whole); a live stream's partial output
+    # exists nowhere but here, so this ring is what lets a mid-stream
+    # replica death resume instead of restart. Bounded identically to
+    # _records; an entry lost to bound pressure only downgrades that
+    # stream's resume from "prefix" to "recompute".
+    def _journal_open(self, url: str, body: Dict) -> int:
+        with self._records_lock:
+            fid = self._next_fid
+            self._next_fid += 1
+            self._journal[fid] = {"url": url, "tokens": []}
+            while len(self._journal) > self.max_tracked:
+                self._journal.popitem(last=False)
+            return fid
+
+    def _journal_token(self, fid: int, idx: int, tok: int) -> None:
+        """Record token ``tok`` at global index ``idx`` — appends must
+        stay contiguous, so a recompute upstream re-delivering indices
+        the journal already holds is a no-op."""
+        rec = self._journal.get(fid)
+        if rec is not None and idx == len(rec["tokens"]):
+            rec["tokens"].append(int(tok))
+
+    def _journal_tokens(self, fid: int) -> List[int]:
+        rec = self._journal.get(fid)
+        return [] if rec is None else list(rec["tokens"])
+
+    def _journal_retarget(self, fid: int, url: str) -> None:
+        rec = self._journal.get(fid)
+        if rec is not None:
+            rec["url"] = url
+
+    def _journal_close(self, fid: int) -> None:
+        with self._records_lock:
+            self._journal.pop(fid, None)

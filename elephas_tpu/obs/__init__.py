@@ -1,8 +1,9 @@
 """Unified observability: metrics registry, Prometheus exposition,
 trace spans, distributed trace context, structured events, the
-engine-loop continuous profiler, and the SLO/burn-rate plane (see
-:mod:`.metrics`, :mod:`.trace`, :mod:`.context`, :mod:`.events`,
-:mod:`.profiler`, :mod:`.slo`; the metric catalog lives in
+engine-loop continuous profiler, its stall watchdog, and the
+SLO/burn-rate plane (see :mod:`.metrics`, :mod:`.trace`,
+:mod:`.context`, :mod:`.events`, :mod:`.profiler`, :mod:`.watchdog`,
+:mod:`.slo`; the metric catalog lives in
 ``docs/sources/observability.md`` and the tracing story in
 ``docs/sources/tracing.md``)."""
 from .context import (TRACEPARENT_LEN, TraceContext, current_context,
@@ -19,6 +20,7 @@ from .slo import SLOObjective, SLOTracker
 from .trace import (RING_SIZE, SPAN_METRIC, clear_slow_spans,
                     recent_slow_spans, record_span,
                     set_slow_span_threshold, span, span_if_counted)
+from .watchdog import EngineWatchdog
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "percentile", "observe_scrape",
@@ -31,4 +33,5 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "parse_traceparent", "TRACEPARENT_LEN", "EventLog",
            "FlightRecorder", "default_event_log", "emit",
            "recent_events", "clear_events", "EVENT_RING_SIZE",
-           "LoopProfiler", "PHASES", "SLOObjective", "SLOTracker"]
+           "LoopProfiler", "PHASES", "EngineWatchdog", "SLOObjective",
+           "SLOTracker"]

@@ -418,6 +418,9 @@ class DecodeEngine:
         self._temp = np.full(self.max_slots, self.temperature, np.float32)
         self._topk = np.zeros(self.max_slots, np.int32)    # 0 = off
         self._topp = np.ones(self.max_slots, np.float32)   # 1 = off
+        # per-slot request seed (-1 = unseeded: the engine's shared
+        # key samples, exactly as before per-request seeds existed)
+        self._slot_seed = np.full(self.max_slots, -1, np.int32)
         self._rid = [None] * self.max_slots
         # multi-tenant QoS: the policy object (None = plain FIFO) and
         # the admission queue enforcing it; per-slot tenant/priority/
@@ -440,6 +443,9 @@ class DecodeEngine:
         # rid -> {"outputs": [...], "preempts": n} for requests
         # preempted mid-decode and re-queued for resume
         self._resume: Dict[int, Dict] = {}
+        # rid -> per-request RNG seed: rid-keyed (not queue-item state)
+        # so it survives preemption re-queues; dropped at retirement
+        self._seed: Dict[int, int] = {}
         self._outputs: Dict = {}
         self._done: Dict = {}
         # rid -> [tokens]: admission-time tokens awaiting step() — a
@@ -681,7 +687,7 @@ class DecodeEngine:
         cfg = config
         temp = self.temperature
 
-        def _sample_tok(logits, temps, topk, topp, key):
+        def _sample_tok(logits, temps, topk, topp, seeds, pos, key):
             # per-slot sampling settings: each request samples at its
             # own temperature (0 = greedy) / top-k / top-p inside one
             # batched step — all branches are computed and where() picks
@@ -700,24 +706,45 @@ class DecodeEngine:
                 need, lambda x: _filter_logits_rows(x, topk, topp),
                 lambda x: x, logits / safe)
             sampled = jax.random.categorical(sub, filtered, axis=-1)
+            # per-request seeds (seed >= 0): the row's key is a pure
+            # function of (seed, absolute position of the token being
+            # sampled) — independent of batch composition, engine-key
+            # history, and sibling slots — so a request resumed on
+            # ANOTHER replica (or after preemption) re-samples its
+            # remaining tokens identically. Unseeded rows keep the
+            # shared engine key bit-for-bit as before.
+            any_seeded = jnp.any((seeds >= 0) & (temps > 0))
+
+            def _seeded_rows(f):
+                row_keys = jax.vmap(lambda s, p: jax.random.fold_in(
+                    jax.random.PRNGKey(s), p + 1))(seeds, pos)
+                return jax.vmap(jax.random.categorical)(row_keys, f)
+
+            seeded = jax.lax.cond(any_seeded, _seeded_rows,
+                                  lambda f: sampled, filtered)
+            sampled = jnp.where(seeds >= 0, seeded, sampled)
             tok = jnp.where(temps > 0, sampled,
                             jnp.argmax(logits, axis=-1))
             return tok.astype(jnp.int32), key
 
-        def _one_step(params, cache, last, pos, temps, topk, topp, key):
+        def _one_step(params, cache, last, pos, temps, topk, topp,
+                      seeds, key):
             logits, cache = decode_step(params, cache, last, pos, cfg)
-            tok, key = _sample_tok(logits, temps, topk, topp, key)
+            tok, key = _sample_tok(logits, temps, topk, topp, seeds,
+                                   pos, key)
             return tok, cache, key
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _step(params, cache, last, pos, temps, topk, topp, key):
+        def _step(params, cache, last, pos, temps, topk, topp, seeds,
+                  key):
             return _one_step(params, cache, last, pos, temps, topk, topp,
-                             key)
+                             seeds, key)
 
         n_sync = self.steps_per_sync
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _multi_step(params, cache, last, pos, temps, topk, topp, key):
+        def _multi_step(params, cache, last, pos, temps, topk, topp,
+                        seeds, key):
             # steps_per_sync decode steps in one lax.scan: each slot's
             # chain stays autoregressive (its sampled token feeds the
             # next step), so per-slot output is exactly the solo decode;
@@ -728,7 +755,8 @@ class DecodeEngine:
             def body(carry, _):
                 cache, last, pos, key = carry
                 tok, cache, key = _one_step(params, cache, last, pos,
-                                            temps, topk, topp, key)
+                                            temps, topk, topp, seeds,
+                                            key)
                 return (cache, tok, pos + 1, key), tok
 
             (cache, _, _, key), toks = jax.lax.scan(
@@ -739,26 +767,27 @@ class DecodeEngine:
             from .models.paged_decode import decode_step_paged
 
             def _one_step_paged(params, pool, tables, last, pos, temps,
-                                topk, topp, key):
+                                topk, topp, seeds, key):
                 logits, pool = decode_step_paged(params, pool, tables,
                                                  last, pos, cfg)
-                tok, key = _sample_tok(logits, temps, topk, topp, key)
+                tok, key = _sample_tok(logits, temps, topk, topp, seeds,
+                                       pos, key)
                 return tok, pool, key
 
             @partial(jax.jit, donate_argnums=(1,))
             def _step_paged(params, pool, tables, last, pos, temps,
-                            topk, topp, key):
+                            topk, topp, seeds, key):
                 return _one_step_paged(params, pool, tables, last, pos,
-                                       temps, topk, topp, key)
+                                       temps, topk, topp, seeds, key)
 
             @partial(jax.jit, donate_argnums=(1,))
             def _multi_step_paged(params, pool, tables, last, pos, temps,
-                                  topk, topp, key):
+                                  topk, topp, seeds, key):
                 def body(carry, _):
                     pool, last, pos, key = carry
                     tok, pool, key = _one_step_paged(
                         params, pool, tables, last, pos, temps, topk,
-                        topp, key)
+                        topp, seeds, key)
                     return (pool, tok, pos + 1, key), tok
 
                 (pool, _, _, key), toks = jax.lax.scan(
@@ -912,6 +941,7 @@ class DecodeEngine:
                      temps=jnp.asarray(self._temp),
                      topk=jnp.asarray(self._topk),
                      topp=jnp.asarray(self._topp),
+                     seeds=jnp.asarray(self._slot_seed),
                      key=jax.random.PRNGKey(0))
         # the step fns donate the cache argument, so warming on the
         # engine's OWN cache (idle: every slot free, paged writes land
@@ -929,7 +959,8 @@ class DecodeEngine:
             _, self.pool, _ = fn(
                 self.params, self.pool, jnp.asarray(self._tables),
                 dummy["last"], dummy["pos"], dummy["temps"],
-                dummy["topk"], dummy["topp"], dummy["key"])
+                dummy["topk"], dummy["topp"], dummy["seeds"],
+                dummy["key"])
         elif self.draft_config is not None:
             out = self._spec_step_fn(
                 self.params, self.draft_params, self.cache,
@@ -942,7 +973,7 @@ class DecodeEngine:
             _, self.cache, _ = fn(
                 self.params, self.cache, dummy["last"], dummy["pos"],
                 dummy["temps"], dummy["topk"], dummy["topp"],
-                dummy["key"])
+                dummy["seeds"], dummy["key"])
         for length in sorted(set(int(n) for n in prompt_lengths)):
             if not 1 <= length < self.max_len:
                 raise ValueError(f"prompt length {length} out of range")
@@ -1578,7 +1609,9 @@ class DecodeEngine:
                admit: bool = True,
                deadline_ms: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority=None) -> int:
+               priority=None,
+               seed: Optional[int] = None,
+               resume_from: int = 0) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
         ``temperature``/``top_k``/``top_p`` override the engine defaults
@@ -1606,10 +1639,28 @@ class DecodeEngine:
         int) — with a ``qos`` policy configured these drive weighted
         fair queueing, per-tenant quotas (a breach sheds with the
         quota-aware 429), and priority preemption; without one they
-        are attribution only."""
+        are attribution only.
+
+        ``seed`` pins THIS request's sampling RNG: each sampled token's
+        key derives purely from ``(seed, absolute position)``, so the
+        same seeded request replays the same output on any engine —
+        and a request resumed elsewhere (``resume_from``) continues
+        sampling exactly the sequence the original would have emitted.
+        Plain stepping only (speculative mode shares one engine key).
+        Greedy requests ignore it.
+
+        ``resume_from=N`` declares the LAST ``N`` tokens of ``prompt``
+        to be output this request already emitted elsewhere (a killed
+        replica's journaled stream, a checkpointed session): admission
+        prefills the full sequence as a forced prefix — often a
+        prefix-cache chain hit — and the request's output starts with
+        those ``N`` tokens followed by ``max_new_tokens`` freshly
+        decoded ones, exactly as the uninterrupted request would have
+        continued (token-identical under greedy decoding)."""
         return self._submit_impl(prompt, max_new_tokens, temperature,
                                  top_k, top_p, admit, deadline_ms, None,
-                                 tenant, priority)
+                                 tenant, priority, seed=seed,
+                                 resume_from=resume_from)
 
     def submit_prefilled(self, prompt: Sequence[int],
                          max_new_tokens: int, kv_blocks, first_token: int,
@@ -1621,7 +1672,9 @@ class DecodeEngine:
                          weights_version: Optional[int] = None,
                          tenant: Optional[str] = None,
                          priority=None,
-                         submitted_at: Optional[float] = None) -> int:
+                         submitted_at: Optional[float] = None,
+                         seed: Optional[int] = None,
+                         resume_from: int = 0) -> int:
         """Queue a request whose prefill ALREADY HAPPENED off-engine —
         the decode half of disaggregated serving. ``kv_blocks`` is the
         prompt's KV state in wire-block form
@@ -1701,23 +1754,42 @@ class DecodeEngine:
             deadline_ms,
             (blocks, int(first_token),
              None if weights_version is None else int(weights_version)),
-            tenant, priority, submitted_at=submitted_at)
+            tenant, priority, submitted_at=submitted_at, seed=seed,
+            resume_from=resume_from)
 
     def _submit_impl(self, prompt, max_new_tokens, temperature, top_k,
                      top_p, admit, deadline_ms, prefilled,
                      tenant=None, priority=None,
-                     submitted_at=None) -> int:
+                     submitted_at=None, seed=None,
+                     resume_from=0) -> int:
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
                 raise ValueError("per-request sampling settings are not "
                                  "supported in speculative mode")
+        if seed is not None:
+            if self.draft_config is not None:
+                raise ValueError("per-request seeds are not supported "
+                                 "in speculative mode (the accept/"
+                                 "resample rule samples every slot "
+                                 "from one engine key)")
+            seed = int(seed)
+            if not 0 <= seed < 2 ** 31:
+                raise ValueError(
+                    f"seed must be in [0, 2**31), got {seed}")
         validate_sampling_overrides(temperature, top_k, top_p)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        resume_from = int(resume_from)
+        if resume_from and not 0 < resume_from < prompt.size:
+            raise ValueError(
+                f"resume_from ({resume_from}) must leave at least one "
+                f"true prompt token below the {prompt.size}-token "
+                "prompt (it counts already-emitted output folded into "
+                "the prompt's tail)")
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         prio = (self.qos.priority(tenant, priority)
                 if self.qos is not None
@@ -1787,6 +1859,17 @@ class DecodeEngine:
                                else {}))
         if prefilled is not None:
             self._prefilled_kv[rid] = prefilled
+        if seed is not None:
+            self._seed[rid] = seed
+        if resume_from:
+            # ride the preemption-resume machinery: admission pops this
+            # entry, pre-seeds the request's outputs with the forced
+            # prefix (so result()/streams carry the FULL output and the
+            # router's token-index dedupe works), sets _slot_prior, and
+            # emits the ``resumed`` flight-recorder event
+            self._resume[rid] = {
+                "outputs": [int(t) for t in prompt[-resume_from:]],
+                "preempts": 0}
         if deadline_ms is not None:
             self._deadline[rid] = self._clock() + deadline_ms / 1000.0
         self._queue.append(QueuedRequest(
@@ -1838,7 +1921,8 @@ class DecodeEngine:
                        temperature: Optional[float] = None,
                        top_k: Optional[int] = None,
                        top_p: Optional[float] = None,
-                       block_size: int = 64) -> Dict:
+                       block_size: int = 64,
+                       seed: Optional[int] = None) -> Dict:
         """Run this engine's prefix-aware prefill path for ``prompt``
         and EXPORT the result instead of occupying a slot — the prefill
         half of disaggregated serving. Rides exactly the machinery an
@@ -1896,7 +1980,8 @@ class DecodeEngine:
                 self._prefill_fn, self.params, entry, 2,
                 self._fresh_row_fn)
             prefix_tokens = 0 if entry is None else int(entry[0].size)
-        t0 = self._sample_first(logits, temp, topk, topp)
+        t0 = self._sample_first(logits, temp, topk, topp, seed=seed,
+                                fold=int(prompt.size))
         blocks = export_kv_blocks(row, int(prompt.size), int(block_size))
         return {"first_token": t0, "kv_blocks": blocks,
                 "block_size": int(block_size),
@@ -2001,6 +2086,7 @@ class DecodeEngine:
             self._trace_ctx.pop(rid, None)
             self._prefilled_kv.pop(rid, None)
             self._resume.pop(rid, None)
+            self._seed.pop(rid, None)
             # a preempted-then-re-queued request may still hold an
             # un-surfaced admission token: the next step() must not
             # report tokens for a cancelled rid
@@ -2028,6 +2114,7 @@ class DecodeEngine:
                 self._admit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
                 self._trace_ctx.pop(rid, None)
+                self._seed.pop(rid, None)
                 self._ttft_origin.pop(rid, None)
                 self._last_tok_t.pop(rid, None)
                 self._ttft_val.pop(rid, None)
@@ -2056,6 +2143,7 @@ class DecodeEngine:
             self._prefilled_kv.pop(rid, None)
             t_sub = self._submit_t.pop(rid, None)
             saved = self._resume.pop(rid, None)
+            self._seed.pop(rid, None)
             self._trace_ctx.pop(rid, None)
             self._ttft_origin.pop(rid, None)
             self._last_tok_t.pop(rid, None)
@@ -2227,7 +2315,11 @@ class DecodeEngine:
                 # weights.swapped events between its step events)
                 weights_version=self.weights_version,
                 queue_wait_s=(None if t_sub is None
-                              else round(self._admit_t[rid] - t_sub, 6)))
+                              else round(self._admit_t[rid] - t_sub, 6)),
+                # the sampling seed, when pinned — the repro handle: a
+                # trace reader can replay THIS request's exact output
+                **({"seed": self._seed[rid]}
+                   if rid in self._seed else {}))
             # per-request context restore: this loop runs on the engine
             # thread, but prefill (and any span/fault/event it emits)
             # belongs to the request whose context was captured at
@@ -2293,6 +2385,7 @@ class DecodeEngine:
             self._temp[slot] = temp
             self._topk[slot] = topk
             self._topp[slot] = topp
+            self._slot_seed[slot] = self._seed.get(rid, -1)
             if self.qos is not None:
                 self._m_tenant_admitted.labels(
                     tenant=self.qos.label(item.tenant)).inc()
@@ -2450,6 +2543,7 @@ class DecodeEngine:
         self._slot_tenant[slot] = None
         self._slot_priority[slot] = 0
         self._slot_wv[slot] = 0
+        self._slot_seed[slot] = -1
 
     def _admit_prefill(self, rid: int, slot: int, prompt: np.ndarray,
                        temp: float, topk: int, topp: float) -> int:
@@ -2467,7 +2561,9 @@ class DecodeEngine:
                 # the cache served (some of) the TARGET's prefill; the
                 # draft's KV is never cached and recomputes in full
                 self._install_draft_row(slot, prompt)
-            t0 = self._sample_first(logits, temp, topk, topp)
+            t0 = self._sample_first(logits, temp, topk, topp,
+                                    seed=self._seed.get(rid),
+                                    fold=int(prompt.size))
             self.recorder.record(
                 rid, "prefill", prompt_tokens=int(prompt.size),
                 prefix_tokens=max(reused, reg_used),
@@ -2498,7 +2594,9 @@ class DecodeEngine:
                                           slot)
         if self.draft_config is not None:
             self._install_draft_row(slot, prompt, entry=entry)
-        t0 = self._sample_first(logits, temp, topk, topp)
+        t0 = self._sample_first(logits, temp, topk, topp,
+                                seed=self._seed.get(rid),
+                                fold=int(prompt.size))
         self.recorder.record(
             rid, "prefill", prompt_tokens=int(prompt.size),
             prefix_tokens=(0 if entry is None else int(entry[0].size)),
@@ -2564,7 +2662,9 @@ class DecodeEngine:
             # served the TARGET cache only — the draft recomputes its
             # whole-prompt KV into its contiguous cache
             self._install_draft_row(slot, prompt)
-        t0 = self._sample_first(logits, temp, topk, topp)
+        t0 = self._sample_first(logits, temp, topk, topp,
+                                seed=self._seed.get(rid),
+                                fold=int(prompt.size))
         self.recorder.record(
             rid, "prefill", prompt_tokens=int(prompt.size),
             # whichever layer served: the chain's blocks or the
@@ -2597,13 +2697,23 @@ class DecodeEngine:
                                                   d_row, slot)
 
     def _sample_first(self, logits, temp: float, topk: int,
-                      topp: float) -> int:
+                      topp: float, seed: Optional[int] = None,
+                      fold: int = 0) -> int:
         """Sample the admission-time first token from final-position
         prefill logits ``(vocab,)`` — the host-side mirror of the step
         fns' ``_sample_tok`` (same filter order: temperature scales,
-        then top-k/top-p on the scaled logits)."""
+        then top-k/top-p on the scaled logits). A per-request ``seed``
+        derives the key from ``fold_in(PRNGKey(seed), fold)`` where
+        ``fold`` is the sampled token's absolute sequence position —
+        the same rule the step fns use, so a resumed request's
+        admission token re-samples exactly what the original decode
+        emitted at that position."""
         if temp > 0:
-            self._key, sub = jax.random.split(self._key)
+            if seed is not None:
+                sub = jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                                         int(fold))
+            else:
+                self._key, sub = jax.random.split(self._key)
             filt = _filter_logits_rows(
                 logits[None] / temp,
                 jnp.asarray([topk], jnp.int32),
@@ -2716,6 +2826,7 @@ class DecodeEngine:
         self._release_blocks(slot)
         self._clear_slot_meta(slot)
         self._deadline.pop(rid, None)
+        self._seed.pop(rid, None)
         now = time.monotonic()
         t_sub = self._submit_t.pop(rid, None)
         t_adm = self._admit_t.pop(rid, now)
@@ -2994,13 +3105,14 @@ class DecodeEngine:
                             jnp.asarray(self._last), jnp.asarray(pos),
                             jnp.asarray(self._temp),
                             jnp.asarray(self._topk),
-                            jnp.asarray(self._topp), self._key)
+                            jnp.asarray(self._topp),
+                            jnp.asarray(self._slot_seed), self._key)
                 else:
                     toks, self.cache, self._key = self._multi_step_fn(
                         self.params, self.cache, jnp.asarray(self._last),
                         jnp.asarray(pos), jnp.asarray(self._temp),
                         jnp.asarray(self._topk), jnp.asarray(self._topp),
-                        self._key)
+                        jnp.asarray(self._slot_seed), self._key)
                 toks = np.asarray(toks)                   # (B, K)
             with self._psec("emit"):
                 for slot in np.nonzero(active)[0]:
@@ -3020,13 +3132,14 @@ class DecodeEngine:
                     self.params, self.pool, jnp.asarray(self._tables),
                     jnp.asarray(self._last), jnp.asarray(pos),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), self._key)
+                    jnp.asarray(self._topp),
+                    jnp.asarray(self._slot_seed), self._key)
             else:
                 toks, self.cache, self._key = self._step_fn(
                     self.params, self.cache, jnp.asarray(self._last),
                     jnp.asarray(pos), jnp.asarray(self._temp),
                     jnp.asarray(self._topk), jnp.asarray(self._topp),
-                    self._key)
+                    jnp.asarray(self._slot_seed), self._key)
             toks = np.asarray(toks)
         with self._psec("emit"):
             for slot in np.nonzero(active)[0]:

@@ -186,6 +186,26 @@ class ServingServer:
         due), ``GET /slo`` serves its snapshot, and ``/stats`` carries
         it as the ``slo`` block — which is what the fleet membership
         prober lifts for the router's fleet-level ``GET /slo``.
+    :param watchdog: engine-loop stall watchdog
+        (:class:`~elephas_tpu.obs.EngineWatchdog`): ``True`` (the
+        default) builds one on the server registry riding the engine's
+        profiler, ``False`` disables it, or pass a constructed
+        instance (its ``on_stall``/``on_recover`` are bound to this
+        server's readiness). The engine loop beats it once per
+        iteration; a stall flips ``/ready`` to 503
+        ``{"status": "stalled"}`` so the fleet prober evicts this
+        replica as *draining* (in-flight work kept, new submits
+        routed away) instead of waiting out probe timeouts, and a
+        beat returning un-flips it. See ``watchdog_stall_s`` /
+        ``watchdog_abort_s`` and the "Surviving replica crashes"
+        runbook in ``docs/sources/serving-operations.md``.
+    :param watchdog_stall_s: beat age that declares a stall (only for
+        the server-built watchdog). Set above the longest healthy
+        iteration — a cold XLA compile is the usual ceiling.
+    :param watchdog_abort_s: hard bound: past this beat age the
+        process aborts (crash-only discipline; the replica supervisor
+        restarts it). ``None`` (default) never aborts — required for
+        in-process multi-replica pools sharing one process.
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
@@ -194,7 +214,9 @@ class ServingServer:
                  default_deadline_ms: Optional[float] = None,
                  max_body_bytes: int = 1 << 20,
                  registry: Optional[MetricsRegistry] = None,
-                 slo=None):
+                 slo=None, watchdog=True,
+                 watchdog_stall_s: float = 10.0,
+                 watchdog_abort_s: Optional[float] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         # optional SLO tracker (obs/slo.py) over the engine's registry:
@@ -220,9 +242,16 @@ class ServingServer:
             # tenant/priority on an engine without them must fail
             # loudly, never be silently dropped
             self._engine_has_tenant = "tenant" in submit_params
+            # crash-safe resume fields: per-request RNG seed and the
+            # forced-prefix resume offset the fleet router submits when
+            # it moves a killed replica's generation to a sibling
+            self._engine_has_seed = "seed" in submit_params
+            self._engine_has_resume = "resume_from" in submit_params
         except (TypeError, ValueError):
             self._engine_has_deadline = True   # assume the full engine
             self._engine_has_tenant = True
+            self._engine_has_seed = True
+            self._engine_has_resume = True
         self._host, self._port = host, int(port)
         self._lock = threading.Lock()          # guards every engine call
         self._cond = threading.Condition(self._lock)
@@ -272,6 +301,29 @@ class ServingServer:
         # drain budget while work it should cancel runs to completion)
         self._drain_deadline: Optional[float] = None
         self._drain_done: Optional[threading.Event] = None
+        # engine-loop stall watchdog: the loop beats it once per
+        # iteration (idle included), its monitor thread flips /ready to
+        # the "stalled" 503 past watchdog_stall_s, and a returning beat
+        # un-flips it (see the ctor docstring)
+        self._stalled = False
+        if watchdog is True:
+            from .obs.watchdog import EngineWatchdog
+
+            self.watchdog: Optional[EngineWatchdog] = EngineWatchdog(
+                stall_after_s=watchdog_stall_s,
+                abort_after_s=watchdog_abort_s, registry=reg,
+                profiler=getattr(engine, "profiler", None))
+        else:
+            self.watchdog = watchdog or None
+        if self.watchdog is not None:
+            self.watchdog.on_stall = self._on_engine_stall
+            self.watchdog.on_recover = self._on_engine_recover
+
+    def _on_engine_stall(self, attrs: Dict) -> None:
+        self._stalled = True
+
+    def _on_engine_recover(self, attrs: Dict) -> None:
+        self._stalled = False
 
     # ---------------------------------------------------------- lifecycle
     @property
@@ -446,6 +498,13 @@ class ServingServer:
                                          "error": failure})
                     elif server._draining or server._stop.is_set():
                         self._json(503, {"status": "draining"})
+                    elif server._stalled:
+                        # the watchdog declared the engine loop stuck:
+                        # still reachable (this thread answered), so
+                        # the fleet prober evicts this replica as
+                        # UNREADY — draining semantics, in-flight work
+                        # kept — instead of waiting out probe timeouts
+                        self._json(503, {"status": "stalled"})
                     elif not server._ready:
                         self._json(503, {"status": "warming"})
                     else:
@@ -455,6 +514,11 @@ class ServingServer:
                         stats = dict(server.engine.stats)
                         stats["requests_drained"] = server._n_drained
                         stats["draining"] = server._draining
+                    if server.watchdog is not None:
+                        # outside the lock — the watchdog has its own
+                        # (and "is the loop stuck" must not queue
+                        # behind the stuck loop's lock)
+                        stats["watchdog"] = server.watchdog.status()
                     if server.slo is not None:
                         # outside the lock: the tracker serves its
                         # last snapshot under its own lock, and the
@@ -595,6 +659,8 @@ class ServingServer:
         ]
         for t in self._threads:
             t.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
         return self
 
     def begin_drain(self):
@@ -628,6 +694,10 @@ class ServingServer:
             # terminal lines (a stalled client must not wedge stop)
             done.wait(timeout=float(drain_timeout) + 10)
         self._stop.set()
+        if self.watchdog is not None:
+            # before the loop joins: a stopping loop's beats ending is
+            # shutdown, not a stall to alert on
+            self.watchdog.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -734,6 +804,13 @@ class ServingServer:
                         self.slo.maybe_evaluate()
                     except Exception:  # noqa: BLE001
                         pass
+                if self.watchdog is not None:
+                    # one beat per iteration, idle included — the LOOP
+                    # heartbeat is the liveness signal (the profiler's
+                    # iteration stamp goes stale on a healthy idle
+                    # engine; it supplies stall ATTRIBUTION, not
+                    # detection)
+                    self.watchdog.beat()
                 if not first_pass_done:
                     # ready only after a FULL first iteration — a loop
                     # whose very first step will crash must never show
@@ -797,6 +874,16 @@ class ServingServer:
                     raise ValueError(f"this engine does not support "
                                      f"per-request {field}")
                 kwargs[field] = body[field]
+        if body.get("seed") is not None:
+            if not self._engine_has_seed:
+                raise ValueError("this engine does not support "
+                                 "per-request seeds")
+            kwargs["seed"] = int(body["seed"])
+        if body.get("resume_from"):
+            if not self._engine_has_resume:
+                raise ValueError("this engine does not support "
+                                 "mid-generation resume")
+            kwargs["resume_from"] = int(body["resume_from"])
         with self._cond:
             if self._draining or self._stop.is_set():
                 raise _HTTPError(503, {"error": "server is draining; "
