@@ -588,6 +588,124 @@ def measure_crash_resume(n_replicas=3, max_new_tokens=24,
                       "arrival gap"}
 
 
+def measure_resilience(n_replicas=3, n_requests=40, gray_delay_s=0.08,
+                       smoke=False):
+    """Network-resilience row: one replica behind a one-way partition
+    (router->replica traffic blackholes) and another on a gray link
+    (every dispatch and probe toward it eats ``gray_delay_s``), under
+    sustained blocking load — measured WITH the resilience plane
+    (retry budgets, circuit breakers, gray-failure demotion) and
+    WITHOUT (``resilience=False``, the pre-plane router). The plane's
+    story: the gray replica is demoted and drained, so the tail stops
+    paying the slow link; request amplification (dispatches per client
+    request) stays bounded by the retry-rate cap in both arms here,
+    but only the plane *enforces* it."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from elephas_tpu.fleet import FleetRouter, ReplicaPool
+    from elephas_tpu.fleet.resilience import CircuitBreaker
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs.metrics import MetricsRegistry
+    from elephas_tpu.serving_engine import DecodeEngine
+    from elephas_tpu.utils.faults import (FaultEvent, FaultPlan,
+                                          clear_plan, install_plan)
+
+    if smoke:
+        n_requests = 10
+    c = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                          d_model=32, d_ff=64, max_seq_len=64,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+
+    def _post(port, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return _json.loads(resp.read())
+
+    def run(resilient):
+        pool = ReplicaPool(lambda: DecodeEngine(params, c, max_slots=4),
+                           n=n_replicas).start()
+        part = pool.urls[0].replace("http://", "")
+        lag = pool.urls[1].replace("http://", "")
+        rng = np.random.default_rng(0)
+        reg = MetricsRegistry()
+        lats, failures = [], 0
+        try:
+            with FleetRouter(
+                    pool.urls, probe_interval=0.2, evict_after=2,
+                    hedge=False, registry=reg, resilience=resilient,
+                    circuit_breaker=CircuitBreaker(
+                        failure_threshold=1, open_for_s=1.0,
+                        registry=reg, scope="replica"),
+                    degrade_latency_s=gray_delay_s / 2,
+                    degrade_drain_after=4) as router:
+                deadline = time.time() + 10
+                while (time.time() < deadline and
+                       len(router.membership.ring_nodes()) < n_replicas):
+                    time.sleep(0.05)
+                for _ in range(3):       # warm prefill/decode compiles
+                    p = [int(t) for t in rng.integers(0, 300, 6)]
+                    _post(router.port, {"prompt": p, "max_new_tokens": 2})
+                base = router.stats()["requests_rerouted"]
+                install_plan(FaultPlan([
+                    FaultEvent("fleet.post_replica", "partition",
+                               times=None, delay=0.0, peer=part),
+                    FaultEvent("fleet.probe", "partition", times=None,
+                               delay=0.0, peer=part),
+                    FaultEvent("fleet.post_replica", "delay", times=None,
+                               delay=gray_delay_s, peer=lag),
+                    FaultEvent("fleet.probe", "delay", times=None,
+                               delay=gray_delay_s, peer=lag),
+                ], seed=5))
+                for _ in range(n_requests):
+                    p = [int(t) for t in rng.integers(0, 300, 6)]
+                    t0 = time.perf_counter()
+                    try:
+                        _post(router.port,
+                              {"prompt": p, "max_new_tokens": 2})
+                    except urllib.error.HTTPError:
+                        failures += 1
+                    lats.append(time.perf_counter() - t0)
+                stats = router.stats()
+                rerouted = stats["requests_rerouted"] - base
+                hedged = stats["hedge"]["requests_hedged"]
+        finally:
+            clear_plan()
+            pool.stop()
+        lats.sort()
+        p99 = lats[min(len(lats) - 1, int(round(0.99 * (len(lats) - 1))))]
+        amp = (n_requests + rerouted + hedged) / n_requests
+        return p99, failures, amp
+
+    p99_with, fail_with, amp_with = run(True)
+    p99_without, fail_without, amp_without = run(False)
+    return {"metric": "resilience_p99_latency_s",
+            "value": round(p99_with, 4),
+            "unit": "s p99 request latency under partition + gray "
+                    "replica (resilience plane ON)",
+            "without_plane_p99_s": round(p99_without, 4),
+            "p99_speedup": round(p99_without / max(p99_with, 1e-9), 2),
+            "amplification_with": round(amp_with, 3),
+            "amplification_without": round(amp_without, 3),
+            "failed_requests_with": fail_with,
+            "failed_requests_without": fail_without,
+            "requests": n_requests, "replicas": n_replicas,
+            "config": f"{n_replicas} in-process replicas; replica 0 "
+                      "one-way partitioned, replica 1 behind "
+                      f"{gray_delay_s * 1000:.0f} ms injected link "
+                      "delay; blocking generates, amplification = "
+                      "dispatches per client request"}
+
+
 class _UniformSlowStep:
     """Engine shim: every step() stalls a fixed amount — scales one
     replica's capacity DOWN so a tiny CPU model saturates under a few
@@ -2359,6 +2477,8 @@ if __name__ == "__main__":
         _emit(measure_slo_plane(smoke=smoke))
     if which in ("crash_resume", "all"):
         _emit(measure_crash_resume(smoke=smoke))
+    if which in ("resilience", "all"):
+        _emit(measure_resilience(smoke=smoke))
     if which in ("ssm", "all"):
         _emit(measure_ssm())
     if which in ("mfu", "all"):

@@ -116,6 +116,7 @@ PAGES = [
     ("Serving fleet API", "elephas_tpu.fleet",
      ["FleetRouter", "ReplicaMembership", "HashRing", "ReplicaPool",
       "ReplicaSupervisor", "RestartPolicy",
+      "RetryPolicy", "RetryBudget", "CircuitBreaker",
       "FleetAutoscaler", "TierPolicy", "ReplicaPoolTier",
       "DisaggDecodeTier", "DisaggPrefillTier"]),
     ("Disaggregated serving API", "elephas_tpu.disagg",

@@ -34,6 +34,10 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..fleet.resilience import (MAX_STALE_KV_RETRIES as
+                                _MAX_STALE_KV_RETRIES)
+from ..fleet.resilience import (PREFILL_RETRY_BUDGET, CircuitBreaker)
+from ..fleet.resilience import STALE_KV_RETRY_S as _STALE_KV_RETRY_S
 from ..obs.context import current_context
 from ..obs.events import FlightRecorder
 from ..obs.events import emit as emit_event
@@ -103,6 +107,11 @@ class DisaggEngine:
         # constants at the gate)
         self._stale_retry: deque = deque()
         self._results: Dict[int, Dict] = {}   # disagg-terminal outcomes
+        # per-prefill-worker circuit breaker: a worker failing jobs
+        # repeatedly is skipped by dispatch while siblings exist, then
+        # probed with one job after the cooldown
+        self._prefill_circuits = CircuitBreaker(
+            registry=reg, scope="prefill_worker", clock=clock)
         self._m_requests = reg.counter(
             "disagg_requests_total",
             "requests accepted by the disaggregated front end").labels()
@@ -275,9 +284,16 @@ class DisaggEngine:
 
     # ------------------------------------------------------------ dispatch
     def _dispatch(self, job: PrefillJob) -> None:
-        """Least-backlogged live worker, or park until one returns."""
+        """Least-backlogged live worker, or park until one returns.
+        Workers whose circuit is OPEN are skipped while an allowed
+        sibling exists; with every circuit open the full candidate
+        list is used (fail-static beats parking forever)."""
         candidates = sorted((w for w in self.workers if w.alive),
                             key=lambda w: w.backlog())
+        allowed = [w for w in candidates
+                   if self._prefill_circuits.allow(w.name)]
+        if allowed:
+            candidates = allowed
         for worker in candidates:
             try:
                 worker.submit(job)
@@ -296,47 +312,60 @@ class DisaggEngine:
     #: retry budget per request: a job failing this many times is
     #: systemically broken (every worker rejects it, or the receiver is
     #: unreachable) — it terminates with an ``expired`` outcome instead
-    #: of recomputing the same prefill in a hot loop forever
-    MAX_PREFILL_RETRIES = 8
+    #: of recomputing the same prefill in a hot loop forever. Sourced
+    #: from the fleet-wide defaults in :mod:`..fleet.resilience`.
+    MAX_PREFILL_RETRIES = PREFILL_RETRY_BUDGET
 
     #: spacing for version-mismatch KV re-dispatches: the rollout
     #: window where the prefill tier lags the decode tier heals on the
     #: prefill subscribers' poll cadence (default 0.25 s), so retrying
     #: hotter than this only burns prefill compute and wire bytes on
     #: frames guaranteed to bounce
-    STALE_KV_RETRY_S = 0.05
+    STALE_KV_RETRY_S = _STALE_KV_RETRY_S
     #: spaced mismatch retries before a job falls through to the
     #: systemic :data:`MAX_PREFILL_RETRIES` path (>= 10 s of rollout
     #: window at the default spacing) — a prefill tier that never
     #: converges is a dead subscriber, not a rollout
-    MAX_STALE_KV_RETRIES = 200
+    MAX_STALE_KV_RETRIES = _MAX_STALE_KV_RETRIES
 
     def _job_failed(self, job: PrefillJob, worker: str, error: str):
         """A worker failed a job (its own thread calls this): re-queue
         on a sibling — the client request is retried, never failed —
         up to :data:`MAX_PREFILL_RETRIES`, past which it terminates
-        (an unbounded deterministic failure must not spin a core)."""
+        (an unbounded deterministic failure must not spin a core). A
+        job whose propagated deadline has already passed terminates
+        NOW — a retry could never answer in time, so re-prefilling is
+        pure waste — with the expiry attributed to its stage."""
         with self._lock:
             st = self._stage.get(job.rid)
             if st is None or st["state"] != "queued":
                 return            # cancelled, or a duplicate completion
             st["retries"] += 1
             exhausted = st["retries"] >= self.MAX_PREFILL_RETRIES
-            if exhausted:
+            past_deadline = (not exhausted
+                             and job.deadline is not None
+                             and self._clock() >= job.deadline)
+            terminal = exhausted or past_deadline
+            if terminal:
                 st["state"] = "done"
                 self._release_stage_locked(st)
-                self._results[job.rid] = {"tokens": [], "timeout": True,
-                                          "expired": True,
-                                          "error": error}
+                self._results[job.rid] = {
+                    "tokens": [], "timeout": True, "expired": True,
+                    "stage": ("prefill_retries_exhausted" if exhausted
+                              else "prefill_retry_past_deadline"),
+                    "error": error}
+        self._prefill_circuits.record_failure(worker)
         self._m_retries.inc()
         emit_event("disagg.prefill_retried", rid=job.rid, worker=worker,
-                   error=error, exhausted=exhausted)
+                   error=error, exhausted=terminal)
         self.recorder.record(job.rid, "prefill_retry", worker=worker,
                              error=error)
-        if exhausted:
-            self.recorder.record(job.rid, "expired",
-                                 stage="prefill_retries_exhausted",
-                                 error=error)
+        if terminal:
+            self.recorder.record(
+                job.rid, "expired",
+                stage=("prefill_retries_exhausted" if exhausted
+                       else "prefill_retry_past_deadline"),
+                error=error)
             return
         self._dispatch(job)
 
@@ -538,7 +567,8 @@ class DisaggEngine:
                         self._release_stage_locked(st)
                         self._results[rid] = {"tokens": [],
                                               "timeout": True,
-                                              "expired": True}
+                                              "expired": True,
+                                              "stage": "kv_import"}
                     self.recorder.record(rid, "expired",
                                          stage="kv_import")
                     continue
@@ -618,6 +648,12 @@ class DisaggEngine:
                 continue
             self._m_frames.labels(codec=codec).inc()
             self._m_kv_bytes.labels(codec=codec).inc(nbytes)
+            # a delivered-and-installed frame is the worker's health
+            # proof: closes its circuit (and resolves a half-open
+            # probe claim) after a failure streak
+            worker_name = meta.get("worker")
+            if worker_name is not None:
+                self._prefill_circuits.record_success(str(worker_name))
             with self._lock:
                 if self._stage.get(rid) is not st:
                     # cancelled between the check above and the decode

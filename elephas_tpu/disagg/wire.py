@@ -38,6 +38,7 @@ import numpy as np
 
 from ..models.quantization import dequantize_kv_frames, quantize_kv_frames
 from ..obs.context import use_context
+from ..utils.faults import InjectedPartition, fault_network
 from ..utils.sockets import (KV_ACK, KV_OPCODE, LENGTH_BYTES,
                              TRACE_OPCODE, recv_exact, receive_traceparent,
                              send_kv_payload, send_trace_context)
@@ -228,6 +229,11 @@ class KVShipper:
         propagates — the caller's retry-the-prefill-elsewhere signal.
         ``ctx`` (a TraceContext) rides ahead of the frame when given."""
         addr = (addr[0], int(addr[1]))
+        if fault_network("disagg.kv_ship", peer=f"{addr[0]}:{addr[1]}"):
+            # a dropped ship surfaces exactly like a vanished peer: the
+            # prefill worker's retry-elsewhere signal
+            raise InjectedPartition(
+                f"injected drop toward {addr[0]}:{addr[1]}")
         payload = encode_kv_frame(meta, arrays, quant=quant)
         sock, fresh = self._checkout(addr)
         try:

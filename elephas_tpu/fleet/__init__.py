@@ -19,6 +19,12 @@ membership, and an in-process replica pool for tests.
   pool: dead-evicted replicas respawn after :class:`~.pool.
   RestartPolicy` exponential backoff; crash-loopers are quarantined
   (``fleet.replica_crashlooping``) and the autoscaler replaces them.
+- :mod:`~.resilience` — the network-resilience policy layer:
+  :class:`~.resilience.RetryPolicy` (jittered exponential backoff,
+  per-request retry budgets, a fleet-wide retry-rate cap) and
+  :class:`~.resilience.CircuitBreaker` (closed/open/half-open per
+  peer), plus the ONE documented home for every retry/backoff constant
+  in the tree.
 - :class:`~.autoscaler.FleetAutoscaler` — the demand-driven control
   loop over it all: reads the per-tier queue-wait/shed/backlog signals
   off the membership prober, scales decode replicas and prefill
@@ -34,9 +40,11 @@ from .autoscaler import (DisaggDecodeTier, DisaggPrefillTier,
 from .hashring import HashRing
 from .membership import ReplicaMembership, ReplicaState
 from .pool import ReplicaPool, ReplicaSupervisor, RestartPolicy
+from .resilience import CircuitBreaker, RetryBudget, RetryPolicy
 from .router import FleetRouter
 
 __all__ = ["FleetRouter", "HashRing", "ReplicaMembership",
            "ReplicaState", "ReplicaPool", "ReplicaSupervisor",
            "RestartPolicy", "FleetAutoscaler", "TierPolicy",
-           "ReplicaPoolTier", "DisaggDecodeTier", "DisaggPrefillTier"]
+           "ReplicaPoolTier", "DisaggDecodeTier", "DisaggPrefillTier",
+           "RetryPolicy", "RetryBudget", "CircuitBreaker"]

@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs.events import emit as emit_event
 from ..obs.metrics import MetricsRegistry
+from ..utils.faults import fault_network
 from .hashring import DEFAULT_VNODES, HashRing
 
 __all__ = ["ReplicaMembership", "ReplicaState"]
@@ -92,6 +93,16 @@ class ReplicaState:
         # the router's fleet GET /slo (None when the replica runs no
         # tracker)
         self.slo: Optional[Dict] = None
+        # gray-failure signals: binary ready says nothing about a
+        # replica that answers /ready but sits behind a lagged link or
+        # drops half its traffic. Probe-latency and request-error-rate
+        # EWMAs fill that gap; `degraded` demotes routing weight and
+        # (persisting) drains the replica from the ring.
+        self.probe_latency_ewma_s: Optional[float] = None
+        self.probe_ewma_samples = 0
+        self.error_ewma = 0.0       # fed by the router's per-attempt
+        self.degraded = False       # outcomes via note_request_outcome
+        self.degraded_probes = 0    # consecutive passes spent degraded
 
     @property
     def load(self) -> float:
@@ -106,7 +117,11 @@ class ReplicaState:
                "in_flight": self.in_flight,
                "load": self.load,
                "requests_shed": self.requests_shed,
-               "requests_finished": self.requests_finished}
+               "requests_finished": self.requests_finished,
+               "degraded": self.degraded,
+               "error_ewma": round(self.error_ewma, 4)}
+        if self.probe_latency_ewma_s is not None:
+            out["probe_latency_ewma_s"] = self.probe_latency_ewma_s
         if self.queue_wait_p99_s is not None:
             out["queue_wait_p50_s"] = self.queue_wait_p50_s
             out["queue_wait_p99_s"] = self.queue_wait_p99_s
@@ -139,9 +154,34 @@ class ReplicaMembership:
         counters and the ring-size/ready gauges land here).
     :param on_evict: ``fn(url, reason)`` called AFTER an eviction,
         outside the membership lock (the router re-routes orphaned
-        submits from it; reason is ``"dead"`` or ``"unready"``).
+        submits from it; reason is ``"dead"``, ``"unready"``, or
+        ``"degraded"``).
     :param on_join: ``fn(url)`` likewise for joins.
+    :param degrade_latency_s: probe-latency EWMA threshold (seconds)
+        past which a replica is DEGRADED: still in the ring, but its
+        routing weight is demoted by ``degrade_load_penalty``. The
+        default is deliberately conservative (a healthy loopback probe
+        is ~1ms; 0.5s is a genuinely sick link) — tighten it per fleet.
+        ``None`` disables gray-failure demotion entirely.
+    :param degrade_error_rate: request-error-rate EWMA threshold (the
+        router feeds per-attempt outcomes via
+        :meth:`note_request_outcome`; failed probes count too).
+    :param degrade_load_penalty: load-score penalty a degraded replica
+        carries — enough to push it past the router's spill threshold
+        so new work prefers healthy siblings.
+    :param degrade_drain_after: consecutive degraded probe passes
+        before the replica is drained from the ring (reason
+        ``"degraded"``; never drains the last ready replica). It
+        rejoins through the normal hysteresis once its EWMAs recover
+        below half the trip thresholds.
     """
+
+    #: EWMA smoothing factor for probe latency / error rate (weight of
+    #: the newest sample; ~3 probes to cross a threshold 2x the signal)
+    DEGRADE_EWMA_ALPHA = 0.3
+    #: probes required before the latency EWMA is trusted (a single
+    #: cold-start spike must not demote a replica)
+    DEGRADE_MIN_SAMPLES = 3
 
     def __init__(self, urls, probe_interval: float = 1.0,
                  join_after: int = 1, evict_after: int = 2,
@@ -149,9 +189,15 @@ class ReplicaMembership:
                  vnodes: int = DEFAULT_VNODES,
                  registry: Optional[MetricsRegistry] = None,
                  on_evict: Optional[Callable[[str, str], None]] = None,
-                 on_join: Optional[Callable[[str], None]] = None):
+                 on_join: Optional[Callable[[str], None]] = None,
+                 degrade_latency_s: Optional[float] = 0.5,
+                 degrade_error_rate: float = 0.5,
+                 degrade_load_penalty: float = 8.0,
+                 degrade_drain_after: int = 10):
         if join_after < 1 or evict_after < 1:
             raise ValueError("join_after and evict_after must be >= 1")
+        if degrade_drain_after < 1:
+            raise ValueError("degrade_drain_after must be >= 1")
         self._urls = [str(u).rstrip("/") for u in urls]
         if len(set(self._urls)) != len(self._urls):
             raise ValueError("duplicate replica urls")
@@ -159,6 +205,11 @@ class ReplicaMembership:
         self.join_after = int(join_after)
         self.evict_after = int(evict_after)
         self.probe_timeout = float(probe_timeout)
+        self.degrade_latency_s = (None if degrade_latency_s is None
+                                  else float(degrade_latency_s))
+        self.degrade_error_rate = float(degrade_error_rate)
+        self.degrade_load_penalty = float(degrade_load_penalty)
+        self.degrade_drain_after = int(degrade_drain_after)
         self._on_evict = on_evict
         self._on_join = on_join
         # extra eviction subscribers beyond the router's own hook (the
@@ -194,6 +245,15 @@ class ReplicaMembership:
         reg.gauge("fleet_replicas_ready",
                   "replicas currently routable").set_function(
             lambda: float(len(self.ready_urls())))
+        reg.gauge("fleet_replicas_degraded",
+                  "replicas currently demoted for gray failure "
+                  "(probe-latency / error-rate EWMA past threshold)"
+                  ).set_function(self._degraded_count)
+
+    def _degraded_count(self) -> float:
+        with self._lock:
+            return float(sum(1 for s in self._replicas.values()
+                             if s.degraded))
 
     # ----------------------------------------------------------- lifecycle
     def start(self):
@@ -220,27 +280,37 @@ class ReplicaMembership:
                 pass           # anything a dying replica throws at it
 
     # ------------------------------------------------------------- probing
-    def _probe_one(self, url: str) -> Tuple[bool, bool, Optional[Dict]]:
-        """(reachable, ready, stats) for one replica. ``stats`` is the
-        replica's /stats payload when it answered, or None when the
-        read failed — None means KEEP the previous load snapshot: a
-        replica so busy its /stats times out is the opposite of idle,
-        and overwriting its backlog with zeros would aim the spill
-        logic straight at the most overloaded replica."""
+    def _probe_one(self, url: str
+                   ) -> Tuple[bool, bool, Optional[Dict], float]:
+        """(reachable, ready, stats, latency_s) for one replica.
+        ``stats`` is the replica's /stats payload when it answered, or
+        None when the read failed — None means KEEP the previous load
+        snapshot: a replica so busy its /stats times out is the
+        opposite of idle, and overwriting its backlog with zeros would
+        aim the spill logic straight at the most overloaded replica.
+        ``latency_s`` is the wall time of the /ready round trip — the
+        gray-failure latency signal (includes injected chaos delay)."""
+        t0 = time.monotonic()
         try:
+            # (site, peer)-keyed network chaos: a one-way partition or
+            # lagged link toward one replica hits its probes too
+            if fault_network("fleet.probe", peer=url):
+                return False, False, None, time.monotonic() - t0
             with urllib.request.urlopen(url + "/ready",
                                         timeout=self.probe_timeout):
                 pass
         except urllib.error.HTTPError:
-            return True, False, None   # answered, but 503/500: unready
+            # answered, but 503/500: unready
+            return True, False, None, time.monotonic() - t0
         except Exception:  # noqa: BLE001 — URLError, socket, protocol
-            return False, False, None
+            return False, False, None, time.monotonic() - t0
+        latency = time.monotonic() - t0
         try:
             with urllib.request.urlopen(url + "/stats",
                                         timeout=self.probe_timeout) as r:
-                return True, True, json.loads(r.read())
+                return True, True, json.loads(r.read()), latency
         except Exception:  # noqa: BLE001 — ready without stats is fine
-            return True, True, None
+            return True, True, None, latency
 
     def probe_once(self):
         """One full pass: probe every candidate (concurrently), apply
@@ -251,14 +321,32 @@ class ReplicaMembership:
                             self._probe_pool.map(self._probe_one, urls)))
         joined: List[str] = []
         evicted: List[Tuple[str, str]] = []
+        degraded_events: List[Tuple[str, Dict]] = []
+        recovered: List[str] = []
         now = time.monotonic()
         with self._lock:
-            for url, (reachable, ready, stats) in outcomes.items():
+            ready_count = sum(1 for s in self._replicas.values()
+                              if s.ready)
+            for url, (reachable, ready, stats, latency) in \
+                    outcomes.items():
                 st = self._replicas.get(url)
                 if st is None:
                     continue    # removed while this pass was probing it
                 st.reachable = reachable
                 st.last_probe_at = now
+                self._update_gray_locked(st, reachable, ready, latency,
+                                         degraded_events, recovered)
+                gray_drained = False
+                if ready and st.degraded and \
+                        st.degraded_probes >= self.degrade_drain_after \
+                        and (ready_count > 1 or not st.ready):
+                    # persistent gray failure: drain it from the ring
+                    # (treat this pass as failed) — but never drain the
+                    # LAST ready replica, and let it back in through
+                    # the normal join hysteresis once it recovers
+                    ready = False
+                    reachable = True
+                    gray_drained = True
                 if ready:
                     st.consec_ok += 1
                     st.consec_fail = 0
@@ -278,12 +366,65 @@ class ReplicaMembership:
                     if st.ready and st.consec_fail >= self.evict_after:
                         st.ready = False
                         self.ring.remove(url)
+                        ready_count -= 1
                         evicted.append(
-                            (url, "unready" if reachable else "dead"))
+                            (url, ("degraded" if gray_drained else
+                                   "unready") if reachable else "dead"))
         for url in joined:
             self._joined(url)
         for url, reason in evicted:
             self._evicted(url, reason)
+        for url, attrs in degraded_events:
+            emit_event("fleet.replica_degraded", replica=url, **attrs)
+        for url in recovered:
+            emit_event("fleet.replica_recovered", replica=url)
+
+    def _update_gray_locked(self, st: ReplicaState, reachable: bool,
+                            ready: bool, latency: float,
+                            degraded_events: List[Tuple[str, Dict]],
+                            recovered: List[str]) -> None:
+        """Fold one probe outcome into the replica's gray-failure
+        EWMAs and re-evaluate its degraded flag (trip at the
+        thresholds, recover below HALF of them — flapping in and out
+        of demotion every pass would be its own instability)."""
+        if self.degrade_latency_s is None:
+            return
+        a = self.DEGRADE_EWMA_ALPHA
+        if ready:
+            prev = st.probe_latency_ewma_s
+            st.probe_latency_ewma_s = (latency if prev is None
+                                       else a * latency + (1 - a) * prev)
+            st.probe_ewma_samples += 1
+            # a clean probe decays the error EWMA too: a drained
+            # replica gets no router traffic, so without this it could
+            # never climb back out of an error-rate demotion
+            st.error_ewma *= (1 - a)
+        elif not reachable:
+            # only a WIRE-level failure (timeout, refusal, partition)
+            # is error evidence — a replica deliberately answering 503
+            # (draining, warming) is behaving, not gray-failing
+            st.error_ewma = a * 1.0 + (1 - a) * st.error_ewma
+        lat_bad = (st.probe_latency_ewma_s is not None
+                   and st.probe_ewma_samples >= self.DEGRADE_MIN_SAMPLES
+                   and st.probe_latency_ewma_s >= self.degrade_latency_s)
+        err_bad = st.error_ewma >= self.degrade_error_rate
+        if not st.degraded and (lat_bad or err_bad):
+            st.degraded = True
+            st.degraded_probes = 0
+            degraded_events.append((st.url, {
+                "probe_latency_ewma_s": st.probe_latency_ewma_s,
+                "error_ewma": round(st.error_ewma, 4),
+                "reason": "latency" if lat_bad else "error_rate"}))
+        elif st.degraded:
+            st.degraded_probes += 1
+            lat_ok = (st.probe_latency_ewma_s is None
+                      or st.probe_latency_ewma_s
+                      < 0.5 * self.degrade_latency_s)
+            err_ok = st.error_ewma < 0.5 * self.degrade_error_rate
+            if lat_ok and err_ok:
+                st.degraded = False
+                st.degraded_probes = 0
+                recovered.append(st.url)
 
     @staticmethod
     def _capture_health_locked(st: ReplicaState, stats: Dict) -> None:
@@ -451,18 +592,48 @@ class ReplicaMembership:
             st = self._replicas.get(str(url).rstrip("/"))
             return st is not None and st.reachable
 
+    def _eff_load_locked(self, st: ReplicaState) -> float:
+        """Routing-weight view of load: a degraded replica carries the
+        demotion penalty, so spill comparisons and least-loaded picks
+        shed work toward healthy siblings without evicting it."""
+        return st.load + (self.degrade_load_penalty if st.degraded
+                          else 0.0)
+
     def load(self, url: str) -> float:
         with self._lock:
             st = self._replicas.get(url)
-            return float("inf") if st is None else st.load
+            return float("inf") if st is None \
+                else self._eff_load_locked(st)
 
     def least_loaded(self, exclude=()) -> Optional[str]:
         """The ready replica with the smallest load score (stats backlog
-        + this router's outstanding dispatches); None when none ready."""
+        + this router's outstanding dispatches, plus the gray-failure
+        demotion penalty); None when none ready."""
         with self._lock:
-            ready = [(self._replicas[u].load, u) for u in self._urls
+            ready = [(self._eff_load_locked(self._replicas[u]), u)
+                     for u in self._urls
                      if self._replicas[u].ready and u not in exclude]
         return min(ready)[1] if ready else None
+
+    def is_degraded(self, url: str) -> bool:
+        with self._lock:
+            st = self._replicas.get(str(url).rstrip("/"))
+            return st is not None and st.degraded
+
+    def note_request_outcome(self, url: str, ok: bool) -> None:
+        """Fold one proxied-request outcome into the replica's
+        error-rate EWMA — the router calls this per dispatch attempt,
+        so a replica dropping half its traffic degrades even while its
+        /ready probes stay green."""
+        if self.degrade_latency_s is None:
+            return
+        url = str(url).rstrip("/")
+        a = self.DEGRADE_EWMA_ALPHA
+        with self._lock:
+            st = self._replicas.get(url)
+            if st is not None:
+                st.error_ewma = (a * (0.0 if ok else 1.0)
+                                 + (1 - a) * st.error_ewma)
 
     def record_dispatch(self, url: str, delta: int):
         """Track this router's outstanding requests at ``url`` — the

@@ -35,6 +35,8 @@ from typing import Callable, Dict, List, Optional, Set
 from ..obs.context import new_root, use_context
 from ..obs.events import emit as emit_event
 from ..serving_http import ServingServer
+from .resilience import (CRASHLOOP_THRESHOLD, CRASHLOOP_WINDOW_S,
+                         RESTART_BACKOFF_BASE_S, RESTART_BACKOFF_MAX_S)
 
 __all__ = ["ReplicaPool", "ReplicaSupervisor", "RestartPolicy"]
 
@@ -255,10 +257,10 @@ class RestartPolicy:
         factory replica instead of resurrecting a poisoned one.
     """
 
-    def __init__(self, backoff_base_s: float = 0.5,
-                 backoff_max_s: float = 30.0,
-                 crashloop_window_s: float = 60.0,
-                 crashloop_threshold: int = 3):
+    def __init__(self, backoff_base_s: float = RESTART_BACKOFF_BASE_S,
+                 backoff_max_s: float = RESTART_BACKOFF_MAX_S,
+                 crashloop_window_s: float = CRASHLOOP_WINDOW_S,
+                 crashloop_threshold: int = CRASHLOOP_THRESHOLD):
         if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
             raise ValueError(
                 f"need 0 < backoff_base_s <= backoff_max_s, got "

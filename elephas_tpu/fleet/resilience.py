@@ -1,0 +1,482 @@
+"""Shared network-resilience primitives: retry policy + circuit breaker.
+
+Every hop in the serving stack retries: the router walks candidate
+replicas (``FleetRouter._foreach_candidate``), the disagg engine
+re-dispatches failed prefills to sibling workers, the weight-sync
+subscriber re-polls the parameter server, and the PS clients wrap every
+RPC in ``_with_retry``. Until this module each of those loops carried
+its own constants and its own (subtly different) backoff — and none of
+them shared a budget, so a partial partition could be amplified into a
+retry storm several times the offered load. This module is the one
+place those policies live:
+
+- :class:`RetryPolicy` — jittered (decorrelated) exponential backoff, a
+  per-request attempt budget (:class:`RetryBudget`), and a fleet-wide
+  retry-rate cap generalizing the hedging 10% pattern: over a sliding
+  window, retries may be at most ``rate_cap`` of all dispatches, so
+  with the default cap of 0.5 retries can never more than ~2x-amplify
+  offered load no matter how gray the network gets.
+- :class:`CircuitBreaker` — closed/open/half-open per peer (replica,
+  prefill worker, PS shard). Trips on a consecutive-failure run or on
+  the error rate over a bounded outcome window; while open every call
+  is refused locally (no wire traffic); after ``open_for_s`` one probe
+  request is let through (half-open) and its outcome decides between
+  closing and re-opening.
+
+The **consolidated retry/backoff constants** below are the single
+source of truth; ``parameter/client.py``, ``disagg/engine.py``, and
+``fleet/pool.py`` import them instead of carrying their own copies, so
+the numbers cannot drift between layers. Tune here, not at call sites.
+
+Metrics (on the injected registry): ``fleet_retries_allowed_total``,
+``fleet_retries_budgeted_total{reason}`` (retries *denied* by the
+budget: per-request attempts, fleet rate cap, or an expired deadline),
+``fleet_circuit_state{peer,scope}`` (0 closed / 1 half-open / 2 open),
+``fleet_circuit_opened_total{scope}``. Events: ``fleet.circuit_opened``
+/ ``fleet.circuit_closed``.
+
+``docs/sources/serving-operations.md`` ("Surviving network partitions
+and gray failures") is the operator runbook for tuning these knobs.
+"""
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from ..obs.events import emit as emit_event
+from ..obs.metrics import MetricsRegistry, default_registry
+
+__all__ = [
+    "RetryPolicy", "RetryBudget", "CircuitBreaker", "backoff_pause_s",
+    "jittered_retry_after_ms",
+    "RETRY_BACKOFF_BASE_S", "RETRY_BACKOFF_MAX_S", "RETRY_MAX_RETRIES",
+    "RETRY_RATE_CAP", "HEDGE_RATE_CAP", "PREFILL_RETRY_BUDGET",
+    "STALE_KV_RETRY_S", "MAX_STALE_KV_RETRIES",
+    "RESTART_BACKOFF_BASE_S", "RESTART_BACKOFF_MAX_S",
+    "CRASHLOOP_WINDOW_S", "CRASHLOOP_THRESHOLD",
+    "RETRY_AFTER_JITTER_FRAC",
+]
+
+# --------------------------------------------------------------------
+# Consolidated retry/backoff constants (single documented home).
+# --------------------------------------------------------------------
+
+#: first backoff pause for a transient RPC failure (parameter-plane
+#: clients; seed of the decorrelated-jitter sequence)
+RETRY_BACKOFF_BASE_S = 0.2
+#: ceiling on any single backoff pause (parameter-plane clients)
+RETRY_BACKOFF_MAX_S = 5.0
+#: per-request retry budget for point RPCs (parameter-plane clients:
+#: 1 initial attempt + this many retries)
+RETRY_MAX_RETRIES = 3
+#: fleet-wide retry-rate cap: retries may be at most this fraction of
+#: all dispatches in the sliding window, bounding request amplification
+#: at 1/(1-cap) — 0.5 means retries can at most double offered load
+RETRY_RATE_CAP = 0.5
+#: the hedging variant of the same cap (a hedge is a speculative
+#: retry): at most 10% of requests may grow a second arm
+HEDGE_RATE_CAP = 0.10
+#: per-request budget for re-dispatching a failed prefill to sibling
+#: workers (disagg engine)
+PREFILL_RETRY_BUDGET = 8
+#: pause before re-queueing a KV import whose weight generation lags
+#: the decode engine (disagg engine)
+STALE_KV_RETRY_S = 0.05
+#: how many stale-generation requeues before the request is failed
+#: (disagg engine; bounds a wedged weight plane)
+MAX_STALE_KV_RETRIES = 200
+#: first pause before respawning a dead replica (fleet supervisor)
+RESTART_BACKOFF_BASE_S = 0.5
+#: ceiling on the supervisor's exponential restart backoff
+RESTART_BACKOFF_MAX_S = 30.0
+#: sliding window for counting replica deaths toward crash-loop
+#: quarantine (fleet supervisor)
+CRASHLOOP_WINDOW_S = 60.0
+#: deaths inside the window that quarantine the slot (fleet supervisor)
+CRASHLOOP_THRESHOLD = 3
+#: spread applied to the router's surfaced 429 ``retry_after_ms`` hint
+#: (uniform in [1, 1 + frac]) so shed clients don't synchronize into a
+#: thundering herd against a just-recovered pool
+RETRY_AFTER_JITTER_FRAC = 0.5
+
+# process-wide jitter source for call sites that don't inject their
+# own; intentionally unseeded (backoff jitter must differ across
+# processes — determinism-seeking tests pass their own ``rng``)
+_JITTER_RNG = random.Random()
+
+
+def backoff_pause_s(prev_pause: float,
+                    base: float = RETRY_BACKOFF_BASE_S,
+                    cap: float = RETRY_BACKOFF_MAX_S,
+                    rng: Optional[random.Random] = None) -> float:
+    """One step of capped decorrelated-jitter backoff (AWS-style):
+    ``min(cap, uniform(base, prev * 3))``. Unlike plain exponential+
+    jitter this decorrelates concurrent clients quickly while keeping
+    the expected pause growing geometrically. Pass ``prev_pause=0`` for
+    the first retry."""
+    rng = rng or _JITTER_RNG
+    return min(cap, rng.uniform(base, max(base, prev_pause * 3.0)))
+
+
+def jittered_retry_after_ms(hint_ms: float,
+                            frac: float = RETRY_AFTER_JITTER_FRAC,
+                            rng: Optional[random.Random] = None) -> int:
+    """Spread a surfaced ``retry_after_ms`` hint by ``uniform(1, 1 +
+    frac)`` so every client shed in the same overload burst does not
+    come back in the same instant and re-shed the pool."""
+    rng = rng or _JITTER_RNG
+    return max(1, int(hint_ms * (1.0 + rng.random() * frac)))
+
+
+class RetryPolicy:
+    """Fleet-wide retry accounting + per-request budgets.
+
+    One instance guards one dispatch surface (the router's candidate
+    walk, the PS client's RPCs, ...). It tracks a sliding window of
+    dispatch outcomes — first attempts vs retries — and refuses a
+    retry whenever granting it would push the retry fraction of the
+    window above ``rate_cap``. Per-request limits (attempt count,
+    deadline) live on the :class:`RetryBudget` minted by
+    :meth:`for_request`.
+
+    :param max_attempts: default total attempts per request (1 initial
+        + retries).
+    :param backoff_base_s: / :param backoff_max_s: decorrelated-jitter
+        backoff parameters (see :func:`backoff_pause_s`).
+    :param rate_cap: max fraction of windowed dispatches that may be
+        retries; bounds amplification at ``1/(1-rate_cap)``.
+    :param window: sliding-window length (dispatches).
+    :param min_samples: below this many windowed dispatches the rate
+        cap is not enforced (cold-start: a lone failing request must
+        still get its retries).
+    :param rng: jitter source; inject a seeded ``random.Random`` for
+        deterministic tests.
+    """
+
+    def __init__(self, max_attempts: int = 1 + RETRY_MAX_RETRIES,
+                 backoff_base_s: float = RETRY_BACKOFF_BASE_S,
+                 backoff_max_s: float = RETRY_BACKOFF_MAX_S,
+                 rate_cap: float = RETRY_RATE_CAP,
+                 window: int = 512, min_samples: int = 20,
+                 rng: Optional[random.Random] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "fleet"):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        if not 0.0 <= rate_cap < 1.0:
+            raise ValueError(f"rate_cap must be in [0, 1), got {rate_cap}")
+        self.max_attempts = int(max_attempts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.rate_cap = float(rate_cap)
+        self.min_samples = int(min_samples)
+        self.name = name
+        self._rng = rng or _JITTER_RNG
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=int(window))  # True = retry
+        self._retries_in_window = 0
+        reg = registry or default_registry()
+        self._m_allowed = reg.counter(
+            "fleet_retries_allowed_total",
+            "retries granted by the shared retry budget",
+            labels=("policy",)).labels(policy=name)
+        self._m_budgeted = reg.counter(
+            "fleet_retries_budgeted_total",
+            "retries DENIED by the shared budget, by exhausted limit",
+            labels=("policy", "reason"))
+
+    # -- windowed accounting ------------------------------------------
+    def record_first(self) -> None:
+        """Record one offered (non-retry) dispatch into the window."""
+        with self._lock:
+            self._push(False)
+
+    def _push(self, is_retry: bool) -> None:
+        if len(self._window) == self._window.maxlen and self._window[0]:
+            self._retries_in_window -= 1
+        self._window.append(is_retry)
+        if is_retry:
+            self._retries_in_window += 1
+
+    def allow_retry(self) -> bool:
+        """Claim one retry slot against the fleet-wide rate cap.
+        Granting records the retry into the window immediately (the
+        claim IS the dispatch intent), so concurrent claimants cannot
+        jointly overshoot the cap."""
+        with self._lock:
+            total = len(self._window)
+            if total >= self.min_samples:
+                if (self._retries_in_window + 1) > self.rate_cap * (total + 1):
+                    self._m_budgeted.labels(
+                        policy=self.name, reason="rate_cap").inc()
+                    return False
+            self._push(True)
+        self._m_allowed.inc()
+        return True
+
+    def retry_fraction(self) -> float:
+        """Current retry fraction of the sliding window (0 when empty)."""
+        with self._lock:
+            return (self._retries_in_window / len(self._window)
+                    if self._window else 0.0)
+
+    def pause_s(self, prev_pause: float = 0.0) -> float:
+        """One decorrelated-jitter pause under this policy's bounds."""
+        return backoff_pause_s(prev_pause, self.backoff_base_s,
+                               self.backoff_max_s, self._rng)
+
+    def deny(self, reason: str) -> None:
+        """Account a retry denied by a limit the caller checked itself
+        (per-request ``attempts`` / ``deadline`` live on the budget)."""
+        self._m_budgeted.labels(policy=self.name, reason=reason).inc()
+
+    def for_request(self, deadline: Optional[float] = None,
+                    max_attempts: Optional[int] = None,
+                    clock: Callable[[], float] = time.monotonic
+                    ) -> "RetryBudget":
+        """Mint the per-request budget for one logical request.
+        ``deadline`` is absolute on ``clock``'s timeline (monotonic)."""
+        return RetryBudget(self, deadline=deadline, clock=clock,
+                           max_attempts=max_attempts or self.max_attempts)
+
+
+class RetryBudget:
+    """Per-request attempt/deadline budget minted by
+    :meth:`RetryPolicy.for_request`. Call :meth:`start` before the
+    first attempt and :meth:`allow_retry` before every subsequent one;
+    when a retry is denied :attr:`denied_reason` says which limit ran
+    out (``attempts`` / ``rate_cap`` / ``deadline``) for 504 stage
+    attribution."""
+
+    def __init__(self, policy: RetryPolicy, deadline: Optional[float],
+                 clock: Callable[[], float], max_attempts: int):
+        self.policy = policy
+        self.deadline = deadline
+        self.clock = clock
+        self.max_attempts = max_attempts
+        self.attempts = 0
+        self.denied_reason: Optional[str] = None
+        self._prev_pause = 0.0
+
+    def start(self) -> None:
+        """Record the request's initial (non-retry) attempt."""
+        self.attempts += 1
+        self.policy.record_first()
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - self.clock()
+
+    def expired(self) -> bool:
+        rem = self.remaining_s()
+        return rem is not None and rem <= 0.0
+
+    def allow_retry(self) -> bool:
+        """Claim one more attempt; checks (in order) the propagated
+        deadline, the per-request attempt count, and the fleet-wide
+        retry-rate cap."""
+        if self.expired():
+            self.denied_reason = "deadline"
+            self.policy.deny("deadline")
+            return False
+        if self.attempts >= self.max_attempts:
+            self.denied_reason = "attempts"
+            self.policy.deny("attempts")
+            return False
+        if not self.policy.allow_retry():
+            self.denied_reason = "rate_cap"
+            return False
+        self.attempts += 1
+        return True
+
+    def pause_s(self) -> float:
+        """Next backoff pause, clipped to the remaining deadline (a
+        pause that would sleep past the request's death is pointless)."""
+        pause = self.policy.pause_s(self._prev_pause)
+        self._prev_pause = pause
+        rem = self.remaining_s()
+        if rem is not None:
+            pause = max(0.0, min(pause, rem))
+        return pause
+
+
+class _Circuit:
+    __slots__ = ("state", "outcomes", "fails_in_window", "consec_fail",
+                 "opened_at", "probing")
+
+    def __init__(self, window: int):
+        self.state = "closed"
+        self.outcomes = deque(maxlen=window)  # True = failure
+        self.fails_in_window = 0
+        self.consec_fail = 0
+        self.opened_at = 0.0
+        self.probing = False
+
+
+class CircuitBreaker:
+    """Per-peer closed/open/half-open circuit breaker.
+
+    One instance guards one class of peers (``scope`` names it:
+    replicas, prefill workers, PS shards); peers are keyed by any
+    stable string (URL, worker name, shard address). The circuit for a
+    peer **opens** after ``failure_threshold`` consecutive failures, or
+    when the failure rate over the last ``window`` outcomes reaches
+    ``error_rate_threshold`` (with at least ``min_samples`` outcomes —
+    this is the arm that catches gray peers that fail 50% of calls
+    without ever failing 5 in a row). While open, :meth:`allow` refuses
+    instantly — no wire traffic reaches a peer known to be bad. After
+    ``open_for_s`` the circuit goes **half-open** and exactly one
+    caller wins the probe slot; its outcome closes the circuit (full
+    reset) or re-opens it for another ``open_for_s``.
+
+    ``clock`` is injectable so tests can step time deterministically.
+    """
+
+    _STATE_VALUE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def __init__(self, failure_threshold: int = 5,
+                 error_rate_threshold: float = 0.5,
+                 window: int = 20, min_samples: int = 8,
+                 open_for_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None,
+                 scope: str = "replica"):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1, got "
+                             f"{failure_threshold}")
+        if not 0.0 < error_rate_threshold <= 1.0:
+            raise ValueError("error_rate_threshold must be in (0, 1], got "
+                             f"{error_rate_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self.error_rate_threshold = float(error_rate_threshold)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.open_for_s = float(open_for_s)
+        self.clock = clock
+        self.scope = scope
+        self._lock = threading.Lock()
+        self._circuits: Dict[str, _Circuit] = {}
+        reg = registry or default_registry()
+        self._g_state = reg.gauge(
+            "fleet_circuit_state",
+            "per-peer circuit state: 0 closed, 1 half-open, 2 open",
+            labels=("scope", "peer"))
+        self._m_opened = reg.counter(
+            "fleet_circuit_opened_total",
+            "circuit-open transitions (peer refused further traffic)",
+            labels=("scope",)).labels(scope=scope)
+
+    def _circ(self, peer: str) -> _Circuit:
+        circ = self._circuits.get(peer)
+        if circ is None:
+            circ = self._circuits[peer] = _Circuit(self.window)
+            self._set_gauge(peer, "closed")
+        return circ
+
+    def _set_gauge(self, peer: str, state: str) -> None:
+        try:
+            self._g_state.labels(scope=self.scope, peer=peer).set(
+                self._STATE_VALUE[state])
+        except ValueError:
+            pass  # label-cardinality cap: circuit still works untracked
+
+    def allow(self, peer: str) -> bool:
+        """May one call be dispatched to ``peer`` right now? In
+        half-open state this CLAIMS the single probe slot, so exactly
+        one caller gets True until the probe's outcome is recorded."""
+        with self._lock:
+            circ = self._circ(peer)
+            if circ.state == "closed":
+                return True
+            if circ.state == "open":
+                if self.clock() - circ.opened_at < self.open_for_s:
+                    return False
+                circ.state = "half_open"
+                circ.probing = True
+                self._set_gauge(peer, "half_open")
+                return True
+            # half-open: one probe in flight at a time
+            if circ.probing:
+                return False
+            circ.probing = True
+            return True
+
+    def record_success(self, peer: str) -> None:
+        with self._lock:
+            circ = self._circ(peer)
+            if circ.state == "half_open":
+                # probe succeeded: full reset
+                self._circuits[peer] = _Circuit(self.window)
+                self._set_gauge(peer, "closed")
+                emit_event("fleet.circuit_closed", scope=self.scope,
+                           peer=peer)
+                return
+            circ.consec_fail = 0
+            self._record_outcome(circ, False)
+
+    def record_failure(self, peer: str) -> None:
+        opened = False
+        with self._lock:
+            circ = self._circ(peer)
+            if circ.state == "half_open":
+                circ.probing = False
+                circ.state = "open"
+                circ.opened_at = self.clock()
+                self._set_gauge(peer, "open")
+                opened = True
+            elif circ.state == "closed":
+                circ.consec_fail += 1
+                self._record_outcome(circ, True)
+                n = len(circ.outcomes)
+                rate = circ.fails_in_window / n if n else 0.0
+                if (circ.consec_fail >= self.failure_threshold
+                        or (n >= self.min_samples
+                            and rate >= self.error_rate_threshold)):
+                    circ.state = "open"
+                    circ.opened_at = self.clock()
+                    circ.probing = False
+                    self._set_gauge(peer, "open")
+                    opened = True
+        if opened:
+            self._m_opened.inc()
+            emit_event("fleet.circuit_opened", scope=self.scope, peer=peer)
+
+    @staticmethod
+    def _record_outcome(circ: _Circuit, failed: bool) -> None:
+        if (len(circ.outcomes) == circ.outcomes.maxlen
+                and circ.outcomes[0]):
+            circ.fails_in_window -= 1
+        circ.outcomes.append(failed)
+        if failed:
+            circ.fails_in_window += 1
+
+    def state(self, peer: str) -> str:
+        """Current state (``closed`` / ``open`` / ``half_open``). An
+        open circuit whose cool-down has elapsed reads as half-open —
+        the state the next :meth:`allow` would act in."""
+        with self._lock:
+            circ = self._circuits.get(peer)
+            if circ is None:
+                return "closed"
+            if (circ.state == "open"
+                    and self.clock() - circ.opened_at >= self.open_for_s):
+                return "half_open"
+            return circ.state
+
+    def forget(self, peer: str) -> None:
+        """Drop a peer's circuit entirely (it left the fleet)."""
+        with self._lock:
+            self._circuits.pop(peer, None)
+            self._set_gauge(peer, "closed")
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            return {
+                peer: {"state": circ.state,
+                       "consec_fail": circ.consec_fail,
+                       "window_failure_rate": (
+                           circ.fails_in_window / len(circ.outcomes)
+                           if circ.outcomes else 0.0)}
+                for peer, circ in self._circuits.items()}

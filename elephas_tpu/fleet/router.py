@@ -111,7 +111,10 @@ from ..obs.events import emit as emit_event
 from ..obs.metrics import (MetricsRegistry, counter_baseline,
                            observe_scrape, percentile, since_baseline)
 from ..serving_http import QuietThreadingHTTPServer, retry_after_header
+from ..utils.faults import InjectedPartition, fault_network
 from .membership import ReplicaMembership
+from .resilience import (HEDGE_RATE_CAP, CircuitBreaker, RetryPolicy,
+                         jittered_retry_after_ms)
 
 __all__ = ["FleetRouter"]
 
@@ -229,12 +232,19 @@ class FleetRouter:
                  vnodes: int = 64, hedge: bool = True,
                  hedge_quantile: float = 0.95,
                  hedge_min_s: float = 0.05,
-                 hedge_max_fraction: float = 0.10,
+                 hedge_max_fraction: float = HEDGE_RATE_CAP,
                  hedge_min_samples: int = 20,
                  hedge_poll_s: float = 0.01,
                  stream_resume: str = "prefix",
                  stream_max_resumes: int = 4,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 resilience: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 circuit_breaker: Optional[CircuitBreaker] = None,
+                 degrade_latency_s: Optional[float] = 0.5,
+                 degrade_error_rate: float = 0.5,
+                 degrade_load_penalty: float = 8.0,
+                 degrade_drain_after: int = 10):
         if policy not in ("prefix_hash", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         if stream_resume not in ("prefix", "recompute", "off"):
@@ -253,11 +263,30 @@ class FleetRouter:
             raise ValueError("need at least one replica url")
         self.registry = reg = (registry if registry is not None
                                else MetricsRegistry())
+        # the network-resilience plane: shared retry budget (fleet-wide
+        # rate cap bounds request amplification), per-replica circuit
+        # breakers, and gray-failure demotion in the membership prober.
+        # resilience=False runs the pre-plane behavior — the bench
+        # row's "without" arm, never a production setting
+        self.resilience = bool(resilience)
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy(registry=reg, name="router")
+        self.circuits = circuit_breaker if circuit_breaker is not None \
+            else CircuitBreaker(registry=reg, scope="replica")
+        self._m_deadline = reg.counter(
+            "fleet_deadline_exceeded_total",
+            "requests whose propagated deadline expired at the router, "
+            "by the stage that noticed", labels=("stage",))
         self.membership = ReplicaMembership(
             self._urls, probe_interval=probe_interval,
             join_after=join_after, evict_after=evict_after,
             probe_timeout=probe_timeout, vnodes=vnodes, registry=reg,
-            on_evict=self._on_evict)
+            on_evict=self._on_evict,
+            degrade_latency_s=(degrade_latency_s if self.resilience
+                               else None),
+            degrade_error_rate=degrade_error_rate,
+            degrade_load_penalty=degrade_load_penalty,
+            degrade_drain_after=degrade_drain_after)
         self._m_routed = reg.counter(
             "fleet_requests_routed_total",
             "requests proxied, by replica and placement decision",
@@ -458,24 +487,74 @@ class FleetRouter:
                 return least, "spill"
         return owner, "hash"
 
+    # ----------------------------------------------------------- deadlines
+    def _deadline_of(self, body: Dict) -> Optional[float]:
+        """The request's absolute deadline on the monotonic clock,
+        anchored ONCE at its first dispatch (stamped into the body as
+        ``_deadline_mono``, stripped before the wire) — every retry,
+        hedge, and dead-replica resubmission of the stored body then
+        measures against the ORIGINAL arrival, not its own start."""
+        dl = body.get("_deadline_mono")
+        if dl is not None:
+            return float(dl)
+        ms = body.get("deadline_ms")
+        if ms is None:
+            return None
+        dl = time.monotonic() + float(ms) / 1000.0
+        body["_deadline_mono"] = dl
+        return dl
+
+    def _deadline_expired(self, stage: str,
+                          deadline: Optional[float]) -> None:
+        """504 with stage attribution — the one way a deadline death
+        surfaces, so an operator can tell "expired before any replica
+        saw it" from "expired mid-retry" from "expired re-homing"."""
+        self._m_deadline.labels(stage=stage).inc()
+        emit_event("fleet.deadline_exceeded", stage=stage)
+        raise _HTTPError(504, {
+            "status": "expired", "stage": stage,
+            "error": f"deadline expired at router stage {stage!r}; no "
+                     "further retries or hedges were dispatched"})
+
     # -------------------------------------------------------------- proxy
-    def _headers(self) -> Dict[str, str]:
+    def _headers(self,
+                 deadline: Optional[float] = None) -> Dict[str, str]:
         headers = {"Content-Type": "application/json"}
         ctx = current_context()
         if ctx is not None:
             # one trace id spans client -> router -> replica -> PS
             headers["traceparent"] = ctx.to_traceparent()
+        if deadline is not None:
+            # the REMAINING budget rides to the replica: time already
+            # burned on routing/retries must not be re-granted there
+            remaining_ms = max(1, int((deadline - time.monotonic())
+                                      * 1000.0))
+            headers["X-Deadline-Ms"] = str(remaining_ms)
         return headers
 
-    def _post_replica(self, url: str, path: str, body: Dict) -> Dict:
+    @staticmethod
+    def _wire_body(body: Dict) -> bytes:
+        """Serialize for the replica, stripping router-internal keys
+        (the deadline anchor propagates via ``X-Deadline-Ms``)."""
+        if "_deadline_mono" in body:
+            body = {k: v for k, v in body.items()
+                    if k != "_deadline_mono"}
+        return json.dumps(body).encode()
+
+    def _post_replica(self, url: str, path: str, body: Dict,
+                      deadline: Optional[float] = None) -> Dict:
+        if fault_network("fleet.post_replica", peer=url):
+            raise InjectedPartition(f"injected drop toward {url}")
         req = urllib.request.Request(url + path,
-                                     data=json.dumps(body).encode(),
-                                     headers=self._headers())
+                                     data=self._wire_body(body),
+                                     headers=self._headers(deadline))
         with urllib.request.urlopen(req,
                                     timeout=self.proxy_timeout) as resp:
             return json.loads(resp.read())
 
     def _get_replica(self, url: str, path: str) -> Dict:
+        if fault_network("fleet.get_replica", peer=url):
+            raise InjectedPartition(f"injected drop toward {url}")
         req = urllib.request.Request(url + path, headers=self._headers())
         with urllib.request.urlopen(req,
                                     timeout=self.proxy_timeout) as resp:
@@ -504,6 +583,8 @@ class FleetRouter:
         retry-on-sibling (it died / is draining) vs forward-the-error
         (it is healthy and meant what it said)."""
         try:
+            if fault_network("fleet.probe", peer=url):
+                return False     # dropped probe: indistinguishable from down
             with urllib.request.urlopen(
                     url + "/ready",
                     timeout=self.membership.probe_timeout):
@@ -511,7 +592,8 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 — refused, 503, wedged: not ok
             return False
 
-    def _foreach_candidate(self, body: Dict, attempt, exclude=()):
+    def _foreach_candidate(self, body: Dict, attempt, exclude=(),
+                           stage: str = "dispatch"):
         """The fleet's one retry/error-classification loop, shared by
         blocking dispatch and stream opening (their failure semantics
         must never diverge). ``attempt(url, how)`` performs one try
@@ -528,19 +610,68 @@ class FleetRouter:
           evidence and the request retries (it never started prefill
           anywhere else); a HEALTHY replica's 4xx/5xx is forwarded.
         - connect/reset/timeout: evict and retry.
+
+        Resilience-plane gates (when :attr:`resilience` is on): a
+        candidate whose circuit is OPEN is skipped without a wire
+        attempt; FAILURE-DRIVEN retries (dead replica, connect error)
+        claim the request's :class:`~.resilience.RetryBudget` — capped
+        per-request and by the fleet-wide retry-rate so retries never
+        more than ~2x-amplify offered load. 429-shed / draining
+        walk-ons stay free: they are placement, bounded by pool size,
+        and consume no replica work. A propagated deadline is checked
+        before EVERY attempt; expiry surfaces as a 504 attributed to
+        ``stage`` and dispatches nothing further.
         """
         key = self._route_key(body)
+        deadline = self._deadline_of(body)
+        budget = (self.retry_policy.for_request(deadline)
+                  if self.resilience else None)
         tried: set = set(exclude)   # a hedge must not double up on the
         retry_hints: List[int] = []  # arm it exists to outrun
+        circuit_skips = 0
+        started = False
+
+        def _failure_retry(url: str) -> None:
+            """Common dead-candidate bookkeeping + budget claim; raises
+            the edge outcome when the budget denies another attempt."""
+            nonlocal tried
+            if self.resilience:
+                self.circuits.record_failure(url)
+                self.membership.note_request_outcome(url, ok=False)
+            self._replica_dead(url)
+            self._m_rerouted.inc()
+            tried.add(url)
+            if budget is not None and not budget.allow_retry():
+                if budget.denied_reason == "deadline":
+                    self._deadline_expired(stage, deadline)
+                raise _HTTPError(503, {
+                    "error": "retry budget exhausted",
+                    "denied_by": budget.denied_reason,
+                    "stage": stage, "attempts": budget.attempts})
+
         for _ in range(len(self._urls) + 1):
+            if deadline is not None and time.monotonic() >= deadline:
+                self._deadline_expired(stage, deadline)
             pick = self._pick(key, tried)
             if pick is None:
                 break
             url, how = pick
+            if self.resilience and not self.circuits.allow(url):
+                circuit_skips += 1
+                tried.add(url)
+                continue
+            if budget is not None and not started:
+                budget.start()
+                started = True
             try:
-                return attempt(url, how)
+                result = attempt(url, how)
             except urllib.error.HTTPError as err:
                 detail = _error_payload(err)
+                # any wire-level answer proves the peer reachable —
+                # required so a half-open probe's claim resolves even
+                # when the reply is a shed or a genuine client error
+                if self.resilience:
+                    self.circuits.record_success(url)
                 if err.code == 429:
                     retry_hints.append(
                         int(detail.get("retry_after_ms", 100)))
@@ -550,32 +681,39 @@ class FleetRouter:
                     tried.add(url)
                     continue
                 if not self._replica_alive(url):
-                    self._replica_dead(url)
-                    self._m_rerouted.inc()
-                    tried.add(url)
+                    _failure_retry(url)
                     continue
                 raise _HTTPError(err.code, detail)   # genuine 4xx/5xx
             except _HTTPError:
                 raise
             except Exception:  # noqa: BLE001 — refused/reset/timeout
-                self._replica_dead(url)
-                self._m_rerouted.inc()
-                tried.add(url)
+                _failure_retry(url)
                 continue
+            if self.resilience:
+                self.circuits.record_success(url)
+                self.membership.note_request_outcome(url, ok=True)
+            return result
         if retry_hints:
             # the pool is saturated: back off at least as long as the
-            # most backlogged replica asked — ms field AND the standard
-            # Retry-After header, like a single replica's own 429
+            # most backlogged replica asked — jittered upward so the
+            # herd the 429 just created does not re-arrive in lockstep
+            hint = max(retry_hints)
+            if self.resilience:
+                hint = jittered_retry_after_ms(hint)
             raise _HTTPError(429, {
                 "error": "every ready replica is at capacity",
-                "retry_after_ms": max(retry_hints)},
-                headers=retry_after_header(max(retry_hints)))
+                "retry_after_ms": hint},
+                headers=retry_after_header(hint))
+        if circuit_skips:
+            raise _HTTPError(503, {
+                "error": "all remaining candidates have open circuits",
+                "circuit_open": circuit_skips, "stage": stage})
         raise _HTTPError(503, {
             "error": "no ready replicas in the fleet",
             "replicas_ready": 0})
 
-    def _dispatch(self, path: str, body: Dict,
-                  exclude=()) -> Tuple[str, Dict]:
+    def _dispatch(self, path: str, body: Dict, exclude=(),
+                  stage: str = "dispatch") -> Tuple[str, Dict]:
         """POST ``body`` to a policy-chosen replica, retrying across the
         pool on replica failure/saturation. Returns ``(url, payload)``
         of the successful response; raises :class:`_HTTPError` with the
@@ -583,13 +721,15 @@ class FleetRouter:
         def attempt(url, how):
             self.membership.record_dispatch(url, +1)
             try:
-                payload = self._post_replica(url, path, body)
+                payload = self._post_replica(
+                    url, path, body, deadline=self._deadline_of(body))
             finally:
                 self.membership.record_dispatch(url, -1)
             self._m_routed.labels(replica=url, policy=how).inc()
             return url, payload
 
-        return self._foreach_candidate(body, attempt, exclude=exclude)
+        return self._foreach_candidate(body, attempt, exclude=exclude,
+                                       stage=stage)
 
     # -------------------------------------------------- submit bookkeeping
     def _track(self, url: str, backend_rid: int, body: Dict) -> int:
@@ -641,8 +781,18 @@ class FleetRouter:
                 return rec is not None and not rec["orphan"]
             rec["rerouting"] = True
             body = rec["body"]
+        deadline = self._deadline_of(body)
+        if deadline is not None and time.monotonic() >= deadline:
+            # expired while orphaned: do NOT resubmit — the next result
+            # poll is the authority that surfaces the 504
+            with self._records_lock:
+                rec = self._records.get(fid)
+                if rec is not None:
+                    rec["rerouting"] = False
+            return False
         try:
-            url, payload = self._dispatch("/v1/submit", body)
+            url, payload = self._dispatch("/v1/submit", body,
+                                          stage="reroute")
         except _HTTPError:
             with self._records_lock:
                 rec = self._records.get(fid)
@@ -702,8 +852,9 @@ class FleetRouter:
 
     def _hedge_submit(self, body: Dict, exclude=(),
                       is_hedge: bool = False) -> Dict:
-        url, payload = self._dispatch("/v1/submit", body,
-                                      exclude=exclude)
+        url, payload = self._dispatch(
+            "/v1/submit", body, exclude=exclude,
+            stage="hedge" if is_hedge else "generate")
         # the arm owns one unit of in-flight load on its replica for
         # its WHOLE life, exactly as the blocking proxy held it: the
         # spill decision and the autoscaler's depth signal must see a
@@ -779,7 +930,8 @@ class FleetRouter:
                     "error": "arm cancelled while re-homing"})
             try:
                 url, payload = self._dispatch("/v1/submit", body,
-                                              exclude=set(others))
+                                              exclude=set(others),
+                                              stage="reroute")
             except _HTTPError as err:
                 return "error", err
             # transfer the in-flight claim to the new replica
@@ -827,6 +979,7 @@ class FleetRouter:
         edges, dead-replica re-route) because every submit goes
         through :meth:`_dispatch`."""
         t0 = time.perf_counter()
+        deadline = self._deadline_of(body)
         threshold = self._hedge_threshold_s()
         outcomes: "queue.Queue" = queue.Queue()
         stop = threading.Event()
@@ -861,9 +1014,17 @@ class FleetRouter:
             while True:
                 elapsed = time.perf_counter() - t0
                 remaining = self.proxy_timeout - elapsed
+                if deadline is not None:
+                    remaining = min(remaining,
+                                    deadline - time.monotonic())
                 if remaining <= 0:
+                    # past the budget NOTHING further is dispatched —
+                    # in-flight arms are cancelled, no hedge launches
                     for arm in arms:
                         self._cancel_arm_async(arm)
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        self._deadline_expired("generate", deadline)
                     raise _HTTPError(504, {
                         "error": "generate exceeded the router's "
                                  f"proxy_timeout ({self.proxy_timeout}s)",
@@ -935,11 +1096,13 @@ class FleetRouter:
         # late) and proxy the old blocking way
         if self.hedge and len(self.membership.ready_urls()) >= 2:
             return self._generate_hedged(body)
-        _, payload = self._dispatch("/v1/generate", body)
+        _, payload = self._dispatch("/v1/generate", body,
+                                    stage="generate")
         return payload
 
     def _do_submit(self, body: Dict) -> Dict:
-        url, payload = self._dispatch("/v1/submit", body)
+        url, payload = self._dispatch("/v1/submit", body,
+                                      stage="submit")
         return {"id": self._track(url, payload["id"], body)}
 
     def _do_result(self, fid: int) -> Dict:
@@ -953,6 +1116,15 @@ class FleetRouter:
                          "cancelled, or its result was already "
                          "fetched)"})
         if rec["orphan"]:
+            deadline = self._deadline_of(rec["body"])
+            if (deadline is not None
+                    and time.monotonic() >= deadline):
+                # expired while orphaned: terminal — nothing was (or
+                # will be) resubmitted, surface the 504 with the stage
+                # that was holding it
+                with self._records_lock:
+                    self._records.pop(fid, None)
+                self._deadline_expired("reroute", deadline)
             # its replica died and the eviction-time reroute hasn't
             # re-homed it yet; try (or wait out a concurrent claim)
             if not self._reroute(fid):
@@ -1073,6 +1245,12 @@ class FleetRouter:
             "replicas_evicted": int(
                 since_baseline(since, self.membership._m_evicted)),
             "requests_tracked": tracked,
+            "resilience": self.resilience,
+            "circuits": (self.circuits.snapshot()
+                         if self.resilience else {}),
+            "retry_fraction": (
+                round(self.retry_policy.retry_fraction(), 4)
+                if self.resilience else 0.0),
             "stream_resume": self.stream_resume,
             "streams_interrupted": int(
                 since_baseline(since, self._m_stream_interrupted)),
@@ -1205,6 +1383,22 @@ class FleetRouter:
                     hdr_tenant = self.headers.get("X-Tenant")
                     if hdr_tenant and body.get("tenant") is None:
                         body["tenant"] = hdr_tenant
+                    # X-Deadline-Ms merges the same way (the TIGHTER
+                    # of header and body wins): the stamped body is
+                    # what every retry/hedge/resubmission measures
+                    # against, so the budget rides every hop
+                    hdr_deadline = self.headers.get("X-Deadline-Ms")
+                    if hdr_deadline is not None:
+                        try:
+                            hdr_ms = float(hdr_deadline)
+                        except ValueError:
+                            self._json(400, {
+                                "error": "invalid X-Deadline-Ms "
+                                         f"header {hdr_deadline!r}"})
+                            return
+                        body_ms = body.get("deadline_ms")
+                        if body_ms is None or hdr_ms < float(body_ms):
+                            body["deadline_ms"] = hdr_ms
                     try:
                         if (url.path == "/v1/generate"
                                 and body.get("stream")):
@@ -1415,9 +1609,11 @@ class FleetRouter:
         must weigh on the spill signal for its whole life, not just its
         opening handshake."""
         def attempt(url, how):
-            req = urllib.request.Request(url + "/v1/generate",
-                                         data=json.dumps(body).encode(),
-                                         headers=self._headers())
+            if fault_network("fleet.open_stream", peer=url):
+                raise InjectedPartition(f"injected drop toward {url}")
+            req = urllib.request.Request(
+                url + "/v1/generate", data=self._wire_body(body),
+                headers=self._headers(self._deadline_of(body)))
             self.membership.record_dispatch(url, +1)
             try:
                 resp = urllib.request.urlopen(req,
@@ -1428,7 +1624,8 @@ class FleetRouter:
             self._m_routed.labels(replica=url, policy=how).inc()
             return url, resp
 
-        return self._foreach_candidate(body, attempt, exclude=exclude)
+        return self._foreach_candidate(body, attempt, exclude=exclude,
+                                       stage="stream")
 
     def _resume_stream(self, body: Dict, emitted: List[int], exclude=()):
         """Open a CONTINUATION stream for an interrupted generate.

@@ -18,6 +18,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..fleet.resilience import (RETRY_BACKOFF_BASE_S, RETRY_BACKOFF_MAX_S,
+                                RETRY_MAX_RETRIES, backoff_pause_s)
 from ..obs.context import current_context
 from ..obs.metrics import default_registry
 from ..utils.delta_compression import quantize_delta
@@ -35,14 +37,17 @@ from ..utils.tensor_codec import (KIND_DELTA, KIND_DELTA_Q8, decode_weights,
 DEFAULT_TIMEOUT = 120.0
 
 #: transient-failure policy: attempts = 1 + MAX_RETRIES, sleeping a
-#: decorrelated-jittered pause between tries (see :func:`_retry_pause`)
-MAX_RETRIES = 3
-BACKOFF = 0.2
+#: decorrelated-jittered pause between tries (see :func:`_retry_pause`).
+#: The values live in :mod:`elephas_tpu.fleet.resilience` — the ONE
+#: documented home for every retry/backoff constant in the tree — and
+#: are re-exported here under their historical names.
+MAX_RETRIES = RETRY_MAX_RETRIES
+BACKOFF = RETRY_BACKOFF_BASE_S
 
 #: ceiling on any single retry pause (seconds): jitter may triple the
 #: previous pause, so without a cap a long retry budget could sleep
 #: arbitrarily far past the point the server came back
-BACKOFF_CAP = 5.0
+BACKOFF_CAP = RETRY_BACKOFF_MAX_S
 
 #: process-wide RNG for retry jitter — deliberately NOT seeded, and
 #: shared so even same-process subscribers draw different pauses
@@ -58,8 +63,9 @@ def _retry_pause(prev: float, base: float, cap: float = BACKOFF_CAP,
     in expectation but every draw is independent — a FLEET of subscribers
     whose shared parameter shard died does not retry in lockstep and
     stampede the freshly promoted standby the way the old deterministic
-    ``base * 2**attempt`` schedule did."""
-    return min(float(cap), rng.uniform(base, max(base, prev * 3.0)))
+    ``base * 2**attempt`` schedule did. Thin wrapper over the shared
+    :func:`~elephas_tpu.fleet.resilience.backoff_pause_s`."""
+    return backoff_pause_s(prev, base=base, cap=cap, rng=rng)
 
 
 class UnknownTxnError(RuntimeError):

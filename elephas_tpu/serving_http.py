@@ -590,6 +590,21 @@ class ServingServer:
                 if hdr_tenant and body.get("tenant") is None:
                     body["tenant"] = hdr_tenant
                 self._tenant = body.get("tenant")
+                # X-Deadline-Ms carries the REMAINING budget from an
+                # upstream router; the tighter of header and body wins
+                # — a deadline can only shrink as it propagates
+                hdr_deadline = self.headers.get("X-Deadline-Ms")
+                if hdr_deadline is not None:
+                    try:
+                        hdr_ms = float(hdr_deadline)
+                    except ValueError:
+                        self._json(400, {
+                            "error": "invalid X-Deadline-Ms header "
+                                     f"{hdr_deadline!r}"})
+                        return
+                    body_ms = body.get("deadline_ms")
+                    if body_ms is None or hdr_ms < float(body_ms):
+                        body["deadline_ms"] = hdr_ms
                 try:
                     if url.path == "/v1/generate" and body.get("stream"):
                         # submit FIRST: validation errors still answer a
@@ -990,6 +1005,7 @@ class ServingServer:
         if info.get("expired"):
             raise _HTTPError(504, {
                 "status": "expired",
+                "stage": info.get("stage", "queued"),
                 "error": "deadline expired before the request reached "
                          "prefill (shed from the queue)"})
         out = {"status": "done", "tokens": info["tokens"]}

@@ -27,6 +27,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..obs.context import TRACEPARENT_LEN, TraceContext, parse_traceparent
+from .faults import InjectedPartition, fault_network
 from .tensor_codec import (KIND_WEIGHTS, MAX_FRAME_BYTES, alloc_frame,
                            decode, encode)
 
@@ -86,6 +87,16 @@ def determine_master(port: int = 4000) -> str:
     return host + ":" + str(port)
 
 
+def _peer_of(sock: socket.socket) -> str:
+    """``host:port`` of the connected peer, for (site, peer)-keyed
+    network chaos; evaluated lazily (only when a fault plan is live)."""
+    try:
+        name = sock.getpeername()
+        return f"{name[0]}:{name[1]}" if len(name) >= 2 else str(name)
+    except OSError:
+        return "?"
+
+
 def recv_exact(sock: socket.socket, num_bytes: int) -> memoryview:
     """Read exactly ``num_bytes`` via ``recv_into`` a single preallocated
     buffer — one allocation per message, no chunk-list join, and no
@@ -99,6 +110,9 @@ def recv_exact(sock: socket.socket, num_bytes: int) -> memoryview:
     uninitialized-buffer contract: the buffer is returned only once
     every byte has been received. All fixed-length reads in the
     parameter plane route through here."""
+    if fault_network("net.recv", peer=lambda: _peer_of(sock), sock=sock):
+        # a dropped inbound frame IS a timeout from the reader's side
+        raise InjectedPartition("injected drop at site 'net.recv'")
     view = alloc_frame(num_bytes)
     got = 0
     while got < num_bytes:
@@ -146,6 +160,8 @@ def send_payload(sock: socket.socket, payload) -> None:
     (the cached-snapshot fast path: zero encode work, one or two
     ``sendall`` syscalls). ``payload`` may be ``bytes`` or the writable
     ``memoryview`` the zero-copy encoder returns."""
+    if fault_network("net.send", peer=lambda: _peer_of(sock), sock=sock):
+        return  # dropped: the bytes vanish, the peer blocks on its read
     if _use_native(sock):
         from . import native
 
@@ -188,6 +204,9 @@ def send_kv_payload(sock: socket.socket, payload) -> None:
     receiver's :data:`KV_ACK`. Raises :class:`ConnectionError` when the
     peer vanishes mid-transfer or answers a wrong ack byte — the
     shipper's retry signal."""
+    if fault_network("net.kv_send", peer=lambda: _peer_of(sock), sock=sock):
+        # a dropped KV frame surfaces as the shipper's ack timeout
+        raise InjectedPartition("injected drop at site 'net.kv_send'")
     sock.sendall(KV_OPCODE)
     send_payload(sock, payload)
     ack = bytes(recv_exact(sock, 1))

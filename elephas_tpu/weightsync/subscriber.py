@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..fleet.resilience import (RETRY_BACKOFF_MAX_S, CircuitBreaker,
+                                backoff_pause_s)
 from ..obs.context import current_trace_id
 from ..obs.events import emit as emit_event
 from ..obs.metrics import MetricsRegistry
@@ -135,6 +137,10 @@ class WeightSubscriber:
         if reg is None:
             reg = MetricsRegistry()
         self.registry = reg
+        # circuit breaker over the parameter plane: a PS shard that
+        # fails polls repeatedly is left alone for the cooldown (no
+        # wire attempt at all), then probed with ONE poll
+        self._circuit = CircuitBreaker(registry=reg, scope="ps_shard")
         self._m_polls = reg.counter(
             "weightsync_polls_total",
             "version polls against the parameter plane").labels()
@@ -201,11 +207,26 @@ class WeightSubscriber:
         self.stop()
 
     def _poll_loop(self):
-        while not self._stop.wait(self.poll_interval):
+        # failure-paced cadence: consecutive failures stretch the next
+        # wait with decorrelated jitter (a fleet of subscribers that
+        # all lost one shard must not re-poll it in lockstep); any
+        # success snaps back to the configured interval. The circuit
+        # skips the wire entirely while open, then probes once.
+        pause = self.poll_interval
+        while not self._stop.wait(pause):
+            if not self._circuit.allow(self.name):
+                pause = self.poll_interval
+                continue
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 — a flapping PS must not
                 self._m_errors.inc()   # kill the subscriber thread
+                self._circuit.record_failure(self.name)
+                pause = backoff_pause_s(pause, base=self.poll_interval,
+                                        cap=RETRY_BACKOFF_MAX_S)
+            else:
+                self._circuit.record_success(self.name)
+                pause = self.poll_interval
 
     # -------------------------------------------------------------- polls
     @property

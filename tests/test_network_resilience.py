@@ -1,0 +1,493 @@
+"""Network resilience plane: seeded chaos determinism, retry budgets
+and the fleet-wide retry-rate cap, circuit breaker state machine,
+deadline propagation with 504 stage attribution, exactly-once orphan
+re-homing under a concurrent reroute storm, and the full chaos
+acceptance — a one-way partition plus a lagged replica under sustained
+load with zero failed requests and bounded amplification."""
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.fleet import FleetRouter, ReplicaPool
+from elephas_tpu.fleet.resilience import (CircuitBreaker, RetryPolicy,
+                                          jittered_retry_after_ms)
+from elephas_tpu.models.transformer import (TransformerConfig, generate,
+                                            init_params)
+from elephas_tpu.obs.events import recent_events
+from elephas_tpu.obs.metrics import MetricsRegistry
+from elephas_tpu.serving_engine import DecodeEngine
+from elephas_tpu.utils.faults import (FaultEvent, FaultPlan,
+                                      InjectedPartition, clear_plan,
+                                      fault_network, install_plan)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = TransformerConfig(vocab_size=300, num_layers=2, num_heads=4,
+                               d_model=32, d_ff=64, max_seq_len=48,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def _ref(params, config, prompt, n):
+    return list(np.asarray(
+        generate(params, jnp.asarray(prompt)[None], n, config))[0])
+
+
+def _post(port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _http_error(fn):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        fn()
+    return exc.value.code, json.loads(exc.value.read())
+
+
+# ---------------------------------------------------- chaos determinism
+def test_seeded_network_chaos_is_deterministic():
+    """The same seeded plan, driven through the same call sequence,
+    fires the same events at the same hit indices — the property every
+    chaos test in this file leans on."""
+    def drive(plan):
+        install_plan(plan)
+        outcomes = []
+        try:
+            for i in range(30):
+                peer = f"10.0.0.{i % 3}:9000"
+                try:
+                    dropped = fault_network("net.send", peer=peer)
+                    outcomes.append("drop" if dropped else "pass")
+                except InjectedPartition:
+                    outcomes.append("partition")
+        finally:
+            clear_plan()
+        return outcomes, plan.fired()
+
+    def mkplan():
+        return FaultPlan([
+            FaultEvent("net.send", "drop", p=0.4, times=None),
+            FaultEvent("net.send", "partition", after=3, times=2,
+                       delay=0.0, peer="10.0.0.1"),
+        ], seed=7)
+
+    out_a, fired_a = drive(mkplan())
+    out_b, fired_b = drive(mkplan())
+    assert out_a == out_b
+    assert fired_a == fired_b
+    assert "partition" in out_a      # the peer-keyed event actually hit
+    assert any(o == "drop" for o in out_a)
+    # a different seed reshuffles the probabilistic drops
+    plan_c = FaultPlan([FaultEvent("net.send", "drop", p=0.4,
+                                   times=None)], seed=8)
+    out_c, _ = drive(plan_c)
+    assert out_c != ["drop" if o == "drop" else "pass" for o in out_a]
+
+
+def test_peer_keyed_partition_is_one_way():
+    """A partition keyed to one peer never fires toward another — the
+    (site, peer) key is what makes a ONE-WAY partition expressible."""
+    plan = FaultPlan([FaultEvent("fleet.post_replica", "partition",
+                                 times=None, delay=0.0,
+                                 peer="127.0.0.1:7001")])
+    install_plan(plan)
+    try:
+        with pytest.raises(InjectedPartition):
+            fault_network("fleet.post_replica", peer="127.0.0.1:7001")
+        assert not fault_network("fleet.post_replica",
+                                 peer="127.0.0.1:7002")
+    finally:
+        clear_plan()
+    # netchaos metric counted only the partitioned call
+    assert plan.fired("fleet.post_replica") == [
+        ("fleet.post_replica", 0, "partition")]
+
+
+# ----------------------------------------------------- retry policy/budget
+def test_retry_budget_attempts_and_deadline():
+    reg = MetricsRegistry()
+    policy = RetryPolicy(max_attempts=3, rng=random.Random(1),
+                         registry=reg, name="t")
+    clock = [0.0]
+    budget = policy.for_request(deadline=10.0, clock=lambda: clock[0])
+    budget.start()
+    assert budget.allow_retry() and budget.allow_retry()
+    assert not budget.allow_retry()          # 3 attempts spent
+    assert budget.denied_reason == "attempts"
+    # a fresh budget dies on the deadline instead
+    clock[0] = 11.0
+    b2 = policy.for_request(deadline=10.0, clock=lambda: clock[0])
+    b2.start()
+    assert b2.expired() and not b2.allow_retry()
+    assert b2.denied_reason == "deadline"
+
+
+def test_retry_rate_cap_bounds_amplification():
+    """With rate_cap=0.5 the windowed retry fraction can never exceed
+    half, i.e. total dispatches <= 2x offered load — no matter how
+    failure-happy the callers are."""
+    policy = RetryPolicy(max_attempts=100, rate_cap=0.5, window=128,
+                         min_samples=10, rng=random.Random(2),
+                         registry=MetricsRegistry(), name="cap")
+    offered = retried = 0
+    for _ in range(60):
+        b = policy.for_request()
+        b.start()
+        offered += 1
+        # every request tries to retry three times
+        for _ in range(3):
+            if b.allow_retry():
+                retried += 1
+    assert policy.retry_fraction() <= 0.5 + 1e-9
+    assert (offered + retried) <= 2 * offered
+    assert retried > 0                   # the cap throttles, not blocks
+
+
+def test_backoff_pause_is_jittered_and_capped():
+    policy = RetryPolicy(backoff_base_s=0.1, backoff_max_s=1.0,
+                         rng=random.Random(3),
+                         registry=MetricsRegistry(), name="b")
+    pauses = []
+    prev = 0.0
+    for _ in range(50):
+        prev = policy.pause_s(prev)
+        pauses.append(prev)
+    assert all(0.1 <= p <= 1.0 for p in pauses)
+    assert len(set(pauses)) > 10         # jittered, not a fixed ladder
+
+
+def test_jittered_retry_after_hint_spreads_upward():
+    rng = random.Random(5)
+    hints = [jittered_retry_after_ms(100, rng=rng) for _ in range(200)]
+    assert all(100 <= h <= 150 for h in hints)
+    assert len(set(hints)) > 20          # the herd is actually spread
+
+
+# --------------------------------------------------------- circuit breaker
+def test_circuit_breaker_state_machine():
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=3, open_for_s=5.0,
+                        clock=lambda: clock[0],
+                        registry=MetricsRegistry(), scope="replica")
+    url = "http://r0"
+    assert cb.allow(url) and cb.state(url) == "closed"
+    for _ in range(3):
+        cb.record_failure(url)
+    assert cb.state(url) == "open"
+    assert not cb.allow(url)             # refused locally, no wire
+    evts = recent_events(event="fleet.circuit_opened")
+    assert any(e["peer"] == url for e in evts)
+    # cooldown elapses: exactly ONE caller wins the half-open probe
+    clock[0] = 6.0
+    assert cb.state(url) == "half_open"
+    assert cb.allow(url)
+    assert not cb.allow(url)             # the probe slot is claimed
+    cb.record_success(url)               # probe succeeded -> closed
+    assert cb.state(url) == "closed"
+    assert any(e["peer"] == url
+               for e in recent_events(event="fleet.circuit_closed"))
+    # and a failing probe re-opens for another cooldown
+    for _ in range(3):
+        cb.record_failure(url)
+    clock[0] = 12.0
+    assert cb.allow(url)
+    cb.record_failure(url)
+    assert cb.state(url) == "open" and not cb.allow(url)
+
+
+def test_circuit_breaker_error_rate_arm():
+    """A gray peer failing half its calls trips the error-rate arm
+    without ever failing failure_threshold in a row."""
+    cb = CircuitBreaker(failure_threshold=10, error_rate_threshold=0.5,
+                        window=10, min_samples=8,
+                        registry=MetricsRegistry(), scope="replica")
+    for _ in range(5):
+        cb.record_failure("gray")
+        cb.record_success("gray")
+    assert cb.state("gray") == "open"
+
+
+# ------------------------------------------------------ deadline propagation
+def test_deadline_expired_504_carries_stage_and_dispatches_nothing(model):
+    params, config = model
+    pool = ReplicaPool(lambda: DecodeEngine(params, config, max_slots=2),
+                       n=1).start()
+    try:
+        with FleetRouter(pool.urls, probe_interval=0.1,
+                         hedge=False) as router:
+            routed_before = router.stats()["replicas"]
+            code, body = _http_error(lambda: _post(
+                router.port, "/v1/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                 "deadline_ms": 0}))
+            assert code == 504
+            assert body["status"] == "expired"
+            assert body["stage"] == "generate"
+            # NOTHING was dispatched for the dead-on-arrival request
+            routed_after = router.stats()["replicas"]
+            assert all(
+                routed_after[u]["routes"] == routed_before[u]["routes"]
+                for u in routed_after)
+            # the header is the body field's equal (tighter one wins)
+            code, body = _http_error(lambda: _post(
+                router.port, "/v1/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 2},
+                headers={"X-Deadline-Ms": "0"}))
+            assert code == 504 and body["stage"] == "generate"
+            # malformed header: clean 400, not a dropped connection
+            code, body = _http_error(lambda: _post(
+                router.port, "/v1/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 2},
+                headers={"X-Deadline-Ms": "soon"}))
+            assert code == 400
+            # a generous deadline changes nothing
+            out = _post(router.port, "/v1/generate",
+                        {"prompt": [5, 6, 7], "max_new_tokens": 2,
+                         "deadline_ms": 60000})
+            assert out["tokens"] == _ref(params, config, [5, 6, 7], 2)
+    finally:
+        pool.stop()
+
+
+def test_expired_orphan_504_attributes_reroute_stage(model):
+    """A submit whose replica dies and whose deadline passes while
+    orphaned answers 504 {stage: reroute} — and is never resubmitted
+    to a sibling (no retry after the propagated deadline expired)."""
+    params, config = model
+    marker = [11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21]  # unique len
+    pool = ReplicaPool(lambda: DecodeEngine(params, config, max_slots=2),
+                       n=3).start()
+    try:
+        with FleetRouter(pool.urls, probe_interval=30, hedge=False,
+                         degrade_latency_s=None) as router:
+            fid = _post(router.port, "/v1/submit",
+                        {"prompt": marker, "max_new_tokens": 2,
+                         "deadline_ms": 150})["id"]
+            with router._records_lock:
+                victim = router._records[fid]["url"]
+            pool.kill(pool.urls.index(victim))
+            time.sleep(0.3)              # the deadline dies with it
+            deadline = time.time() + 10
+            code = body = None
+            while time.time() < deadline:
+                try:
+                    out = _get(router.port, f"/v1/result?id={fid}")
+                except urllib.error.HTTPError as err:
+                    code, body = err.code, json.loads(err.read())
+                    break
+                assert out["status"] == "pending", out
+                time.sleep(0.05)
+            assert code == 504, (code, body)
+            assert body["status"] == "expired"
+            assert body["stage"] == "reroute"
+            # exactly ZERO sibling ever saw the marker prompt
+            for i, eng in enumerate(pool.engines):
+                if pool.urls[i] == victim:
+                    continue
+                traces = eng.recorder.recent(limit=64)
+                assert not any(
+                    e.get("prompt_tokens") == len(marker)
+                    for t in traces for e in t["events"]), (i, traces)
+    finally:
+        pool.stop()
+
+
+# ------------------------------------------- exactly-once orphan re-homing
+def test_orphan_reroute_storm_resubmits_exactly_once(model):
+    """The regression: an orphaned submit attacked by the eviction-time
+    background sweep AND a storm of concurrent result polls must be
+    resubmitted exactly once (the ``rerouting`` claim) — a duplicate
+    would burn a sibling's slot decoding a result nobody can fetch."""
+    params, config = model
+    marker = [3] * 17                            # unique prompt length
+    pool = ReplicaPool(lambda: DecodeEngine(params, config, max_slots=2),
+                       n=3).start()
+    try:
+        with FleetRouter(pool.urls, probe_interval=30, hedge=False,
+                         degrade_latency_s=None) as router:
+            fid = _post(router.port, "/v1/submit",
+                        {"prompt": marker, "max_new_tokens": 3})["id"]
+            with router._records_lock:
+                victim = router._records[fid]["url"]
+            pool.kill(pool.urls.index(victim))
+            # the flap heals mid-eviction: _replica_dead fires the
+            # background sweep while a storm of polls races it
+            barrier = threading.Barrier(9)
+            done_payloads = []
+            done_lock = threading.Lock()
+
+            def poll():
+                barrier.wait()
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    try:
+                        out = router._do_result(fid)
+                    except Exception:  # noqa: BLE001 — a sibling
+                        return         # already fetched the result
+                    if out.get("status") == "done":
+                        with done_lock:
+                            done_payloads.append(out)
+                        return
+                    time.sleep(0.01)
+
+            threads = [threading.Thread(target=poll) for _ in range(8)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            router._replica_dead(victim)
+            for t in threads:
+                t.join()
+            # _do_result pops the record once done, so exactly one
+            # poller walks away with the payload — and it is correct
+            assert len(done_payloads) == 1, done_payloads
+            assert done_payloads[0]["tokens"] == _ref(
+                params, config, marker, 3)
+            # flight recorders across the SURVIVORS: exactly one
+            # timeline ever started for the marker prompt
+            seen = 0
+            for i, eng in enumerate(pool.engines):
+                if pool.urls[i] == victim:
+                    continue
+                seen += sum(
+                    1 for t in eng.recorder.recent(limit=64)
+                    if any(e.get("prompt_tokens") == len(marker)
+                           for e in t["events"]))
+            assert seen == 1, f"expected exactly-once resubmit, got {seen}"
+    finally:
+        pool.stop()
+
+
+# ------------------------------------------------------- chaos acceptance
+def test_fleet_survives_partition_and_gray_replica(model):
+    """The acceptance drill: replica 0 behind a one-way partition
+    (dispatches AND probes toward it blackhole), replica 1 on a lagged
+    link (100 ms probe latency). Sustained load completes with ZERO
+    failed requests and <= 2x request amplification; the partitioned
+    replica's circuit opens, then recovers to closed once the plan
+    clears; the lagged replica emits ``fleet.replica_degraded`` and
+    sheds routing weight. Deterministic under the seeded plan."""
+    params, config = model
+    pool = ReplicaPool(lambda: DecodeEngine(params, config, max_slots=4),
+                       n=3).start()
+    part, lagged = pool.urls[0], pool.urls[1]
+    peer_part = part.replace("http://", "")
+    peer_lag = lagged.replace("http://", "")
+    plan = FaultPlan([
+        # one-way partition toward replica 0: router->replica traffic
+        # vanishes (requests, probes, health rechecks)
+        FaultEvent("fleet.post_replica", "partition", times=None,
+                   delay=0.0, peer=peer_part),
+        FaultEvent("fleet.probe", "partition", times=None, delay=0.0,
+                   peer=peer_part),
+        # lagged link toward replica 1: probes crawl, replica answers
+        FaultEvent("fleet.probe", "delay", times=None, delay=0.1,
+                   jitter=0.02, peer=peer_lag),
+    ], seed=11)
+    rng = np.random.default_rng(17)
+    reg = MetricsRegistry()
+    try:
+        with FleetRouter(
+                pool.urls, probe_interval=0.15, evict_after=2,
+                join_after=2, hedge=False, registry=reg,
+                # threshold 1: the first partition failure also marks
+                # the replica dead (out of the ring), so it is the only
+                # failure the circuit will ever see while partitioned
+                circuit_breaker=CircuitBreaker(
+                    failure_threshold=1, open_for_s=0.4, registry=reg,
+                    scope="replica"),
+                degrade_latency_s=0.05, degrade_drain_after=10_000,
+        ) as router:
+            # healthy warm-up so every replica is in the ring
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and len(router.membership.ring_nodes()) < 3):
+                time.sleep(0.05)
+            assert len(router.membership.ring_nodes()) == 3
+            for _ in range(3):
+                p = [int(t) for t in rng.integers(0, 300, 6)]
+                _post(router.port, "/v1/generate",
+                      {"prompt": p, "max_new_tokens": 2})
+            base_rerouted = router.stats()["requests_rerouted"]
+            # a prompt whose hash OWNER is the partitioned replica:
+            # sent first, it guarantees a dispatch actually crosses
+            # the partition (instead of the prober quietly evicting
+            # the replica before any request hashes to it)
+            while True:
+                hot = [int(t) for t in rng.integers(0, 300, 6)]
+                key = router._route_key({"prompt": hot})
+                if next(iter(router.membership.route_chain(key)),
+                        None) == part:
+                    break
+            install_plan(plan)
+            n = 14
+            for i in range(n):
+                p = (hot if i == 0
+                     else [int(t) for t in rng.integers(0, 300, 6)])
+                out = _post(router.port, "/v1/generate",
+                            {"prompt": p, "max_new_tokens": 2})
+                # ZERO failed requests, and every answer is correct
+                assert out["tokens"] == _ref(params, config, p, 2)
+            stats = router.stats()
+            rerouted = stats["requests_rerouted"] - base_rerouted
+            hedged = stats["hedge"]["requests_hedged"]
+            assert (n + rerouted + hedged) <= 2 * n, (rerouted, hedged)
+            # the partitioned replica's circuit OPENED at some point
+            opened = recent_events(event="fleet.circuit_opened")
+            assert any(e["peer"] == part for e in opened), opened
+            # the lagged replica is demoted: degraded event + the
+            # routing weight penalty shows in its effective load
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                if router.membership.is_degraded(lagged):
+                    break
+                time.sleep(0.1)
+            assert router.membership.is_degraded(lagged)
+            degraded = recent_events(event="fleet.replica_degraded")
+            assert any(e["replica"] == lagged for e in degraded)
+            assert router.membership.load(lagged) >= 8.0  # the penalty
+            # plan clears: the partitioned replica heals, rejoins, and
+            # its circuit probes back to CLOSED under live traffic
+            clear_plan()
+            deadline = time.time() + 15
+            closed = False
+            while time.time() < deadline:
+                p = [int(t) for t in rng.integers(0, 300, 6)]
+                out = _post(router.port, "/v1/generate",
+                            {"prompt": p, "max_new_tokens": 2})
+                assert out["tokens"] == _ref(params, config, p, 2)
+                if router.circuits.state(part) == "closed":
+                    closed = True
+                    break
+                time.sleep(0.1)
+            assert closed, router.circuits.snapshot()
+            assert plan.fired()          # the chaos actually happened
+    finally:
+        clear_plan()
+        pool.stop()
