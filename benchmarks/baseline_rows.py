@@ -1726,6 +1726,120 @@ def measure_prefix_cache(smoke=False):
                       "block cache, steady-state pass measured"}
 
 
+def measure_kv_tiered(smoke=False):
+    """Tiered KV row: multi-turn chat sessions whose combined trailing
+    KV working set is a multiple of the device pool, spill+sessions on
+    vs off on the same paged engine. With spill OFF, eviction discards
+    a parked chain and every turn-2 admission re-prefills its whole
+    conversation (cold TTFT); with spill+sessions ON, retirement
+    persists the trailing chain and the next turn promotes it back
+    (warm TTFT = remainder-only prefill + host->device copies). Both
+    configurations drain identical traffic with outputs asserted
+    token-identical, and neither sheds a request. The acceptance
+    scalar is ``warm_ttft_speedup`` (>= 3x on the dev box at the full
+    sizing, where the working set is ~10x the pool)."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.obs import percentile
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        layers, d_model, d_ff, vocab = 2, 64, 128, 500
+        n_sessions, turn1_len, follow_len = 8, 48, 8
+    else:
+        layers, d_model, d_ff, vocab = 4, 768, 1536, 2000
+        n_sessions, turn1_len, follow_len = 21, 448, 16
+    block, max_slots, max_new = 16, 2, 8
+    # the resumable-session shape: a LONG first turn (the document /
+    # conversation history) and a short follow-up — the trailing chain
+    # covers ~90% of turn 2's prompt, which is what sessions buy
+    t2_len = turn1_len + max_new + follow_len
+    per_req = -(-(t2_len + max_new) // block)
+    # pool sized for slot concurrency ONLY — the parked working set
+    # (every session's trailing chain) is deliberately a multiple of
+    # it (~10x at the full sizing), so spill-off eviction MUST discard
+    # conversation KV
+    n_blocks = 1 + max_slots * per_req
+    working = n_sessions * ((turn1_len + max_new) // block)
+    c = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                          num_heads=8, d_model=d_model, d_ff=d_ff,
+                          max_seq_len=t2_len + max_new,
+                          dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    turn1 = [list(rng.integers(0, vocab, turn1_len))
+             for _ in range(n_sessions)]
+    turn2_user = [list(rng.integers(0, vocab, follow_len))
+                  for _ in range(n_sessions)]
+
+    def ttft(eng, rids):
+        return [e["duration_s"]
+                for r in rids
+                for e in (eng.request_trace(r) or {"events": []})[
+                    "events"] if e["event"] == "prefill"]
+
+    def run(spill_on):
+        eng = DecodeEngine(params, c, max_slots=max_slots,
+                           paged=(n_blocks, block))
+        if spill_on:
+            eng.enable_kv_spill(host_capacity_blocks=4 * working)
+            eng.enable_session_store()
+        r1 = [eng.submit(np.asarray(t), max_new, session=f"s{i}")
+              for i, t in enumerate(turn1)]
+        while eng.pending:
+            eng.step()
+        outs1 = [eng.result(r) for r in r1]
+        prompts2 = [np.asarray(turn1[i] + outs1[i] + turn2_user[i])
+                    for i in range(n_sessions)]
+        start = time.perf_counter()
+        r2 = [eng.submit(p, max_new, session=f"s{i}")
+              for i, p in enumerate(prompts2)]
+        while eng.pending:
+            eng.step()
+        elapsed = time.perf_counter() - start
+        outs2 = [eng.result(r) for r in r2]
+        st = eng.stats
+        assert st.get("requests_shed", 0) == 0, "a request was shed"
+        return {"outs": outs1 + outs2, "ttft2": ttft(eng, r2),
+                "tps2": n_sessions * max_new / elapsed, "stats": st}
+
+    off = run(False)
+    on = run(True)
+    assert on["outs"] == off["outs"], \
+        "spill-on outputs diverged from spill-off"
+    kt = on["stats"]["kv_tiers"]
+    assert kt["session"]["hits"] == n_sessions, \
+        f"every turn-2 should resume its session: {kt['session']}"
+    warm = percentile(on["ttft2"], 0.5)
+    cold = percentile(off["ttft2"], 0.5)
+    return {"metric": "kv_tiered_warm_ttft_ms",
+            "value": round(warm * 1000, 3),
+            "unit": "ms (turn-2 admission prefill, spill+sessions on)",
+            "cold_ttft_ms": round(cold * 1000, 3),
+            "warm_ttft_speedup": round(cold / max(warm, 1e-9), 2),
+            "turn2_tokens_per_sec": round(on["tps2"], 1),
+            "turn2_tokens_per_sec_off": round(off["tps2"], 1),
+            "demotions_host": kt["host"]["demotions"],
+            "promotions": kt.get("promotions", {}),
+            "session_hits": kt["session"]["hits"],
+            "session_blocks": kt["session"]["blocks"],
+            "working_set_blocks": working,
+            "pool_blocks": n_blocks - 1,
+            "working_set_ratio": round(working / (n_blocks - 1), 2),
+            "outputs_token_identical": True,
+            "requests_shed": 0,
+            "config": f"L{layers} d{d_model} ff{d_ff} V{vocab} f32 "
+                      f"paged ({n_blocks}x{block}), {n_sessions} "
+                      f"2-turn sessions: {turn1_len}-tok history + "
+                      f"{follow_len}-tok follow-up, {max_new} new "
+                      f"toks, {max_slots} slots, host spill + "
+                      "in-process session store"}
+
+
 def measure_speculative(smoke=False):
     """Speculative serving row: a decode-bound workload (short prompts,
     long generations) through one paged engine, speculative on vs off
@@ -2463,6 +2577,8 @@ if __name__ == "__main__":
         _emit(measure_fleet_router(smoke=smoke))
     if which in ("prefix_cache", "all"):
         _emit(measure_prefix_cache(smoke=smoke))
+    if which in ("kv_tiered", "all"):
+        _emit(measure_kv_tiered(smoke=smoke))
     if which in ("disagg", "all"):
         _emit(measure_disagg(smoke=smoke))
     if which in ("weight_swap", "all"):

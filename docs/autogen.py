@@ -131,6 +131,9 @@ PAGES = [
       "import_kv_blocks"]),
     ("KV block cache", "elephas_tpu.models.block_cache",
      ["BlockCache", "BlockEntry", "chain_keys"]),
+    ("Tiered KV API", "elephas_tpu.kvtier",
+     ["TieredSpill", "HostTier", "StorageTier", "SpilledBlock",
+      "SessionStore", "encode_payload", "decode_payload"]),
     ("SSMModel", "elephas_tpu.models.ssm_model", ["SSMModel"]),
     ("Selective SSM (Mamba-style)", "elephas_tpu.models.ssm",
      ["SSMConfig", "init_ssm_params", "ssm_forward", "ssm_lm_loss",
@@ -233,6 +236,7 @@ def main(out_dir: str = None):
               "  - Disaggregated serving: disaggregated-serving.md",
               "  - Live weights: live-weights.md",
               "  - Speculative serving: speculative-serving.md",
+              "  - Tiered KV: tiered-kv.md",
               "  - Fault tolerance: fault-tolerance.md",
               "  - Observability: observability.md",
               "  - Distributed tracing: tracing.md"]

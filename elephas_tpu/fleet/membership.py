@@ -93,6 +93,9 @@ class ReplicaState:
         # the router's fleet GET /slo (None when the replica runs no
         # tracker)
         self.slo: Optional[Dict] = None
+        # tiered-KV occupancy/session block (/stats "kv_tiers") —
+        # None on replicas without spill or a session store
+        self.kv_tiers: Optional[Dict] = None
         # gray-failure signals: binary ready says nothing about a
         # replica that answers /ready but sits behind a lagged link or
         # drops half its traffic. Probe-latency and request-error-rate
@@ -133,6 +136,8 @@ class ReplicaState:
             # the per-replica /stats snapshot keeps just the verdict;
             # the full objective detail lives on the router's /slo
             out["slo_firing"] = list(self.slo.get("firing", ()))
+        if self.kv_tiers is not None:
+            out["kv_tiers"] = self.kv_tiers
         return out
 
 
@@ -448,6 +453,8 @@ class ReplicaMembership:
                 else None
             slo = stats.get("slo")
             st.slo = dict(slo) if isinstance(slo, dict) else None
+            kvt = stats.get("kv_tiers")
+            st.kv_tiers = dict(kvt) if isinstance(kvt, dict) else None
         except (TypeError, ValueError):
             pass   # a malformed /stats field must not kill the prober
 
@@ -767,6 +774,32 @@ class ReplicaMembership:
             # changed (an evict-then-rejoin re-adds a replica's whole
             # history as one fake spike)
             decode["ready_urls"] = sorted(s.url for s in ready)
+            # tiered-KV fleet view: summed session hit/miss totals over
+            # replicas that report them (per-replica counters, unlike
+            # the shared prefill tier — so SUM is right), plus summed
+            # host-tier occupancy: the fleet-wide RAM the spill plane
+            # is holding. A cross-replica session resume lands as a hit
+            # on whichever replica the ring picked, so only the sum
+            # describes the feature's effectiveness.
+            kvt = [s.kv_tiers for s in ready if s.kv_tiers]
+            if kvt:
+                sessions = [t.get("session") for t in kvt
+                            if isinstance(t.get("session"), dict)]
+                kv: Dict = {
+                    "replicas": len(kvt),
+                    "host_blocks": sum(
+                        int(t.get("host", {}).get("blocks", 0))
+                        for t in kvt),
+                    "host_bytes": sum(
+                        int(t.get("host", {}).get("bytes", 0))
+                        for t in kvt),
+                }
+                if sessions:
+                    kv["session_hits"] = sum(
+                        int(s.get("hits", 0)) for s in sessions)
+                    kv["session_misses"] = sum(
+                        int(s.get("misses", 0)) for s in sessions)
+                decode["kv_tiers"] = kv
             out = {"decode": decode}
             reports = [s.prefill for s in ready if s.prefill]
         if reports:

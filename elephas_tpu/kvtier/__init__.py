@@ -1,0 +1,17 @@
+"""Tiered KV spill + resumable sessions under the block cache.
+
+See :mod:`~elephas_tpu.kvtier.tiers` for the tier semantics and the
+lossy-parity rule, :mod:`~elephas_tpu.kvtier.spill` for the
+demote/promote manager the engine binds, and
+:mod:`~elephas_tpu.kvtier.session` for cross-request session
+persistence. Engines opt in via
+``ServingEngine.enable_kv_spill(...)`` /
+``ServingEngine.enable_session_store(...)``.
+"""
+from .session import SessionStore
+from .spill import TieredSpill
+from .tiers import (HostTier, SpilledBlock, StorageTier, decode_payload,
+                    encode_payload)
+
+__all__ = ["SpilledBlock", "HostTier", "StorageTier", "TieredSpill",
+           "SessionStore", "encode_payload", "decode_payload"]

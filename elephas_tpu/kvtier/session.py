@@ -1,0 +1,103 @@
+"""SessionStore: resumable cross-request KV sessions.
+
+A chat conversation is a growing token prefix: turn N+1's prompt
+starts with turn N's prompt + completion. When a request tagged with a
+``session`` id retires, the engine persists the full blocks of its
+final sequence here, keyed by the same content-addressed chain digests
+the block cache uses (seeded by ``weights_version``). Turn N+1's
+admission chain walk then finds those keys — on ANY replica sharing
+the backend — and admits as a chain hit instead of re-prefilling the
+whole history.
+
+Two backends:
+
+* ``url=None`` — an in-process :class:`~elephas_tpu.kvtier.tiers.HostTier`
+  (exact f32). Sharing one instance across engines is exactly the
+  cross-replica resume topology, which is how the tests oracle it.
+* ``url="..."`` — a :class:`~elephas_tpu.kvtier.tiers.StorageTier` over
+  the :mod:`~elephas_tpu.utils.storage` registry. Default
+  ``compress="none"`` keeps resume exact; ``"q8"`` trades 0.386x bytes
+  for lossy promotion (the engine then taints the resuming slot — see
+  the parity rule in :mod:`~elephas_tpu.kvtier.tiers`).
+
+Invalidation is free by construction: chains hash under the weights
+version, so a hot-swap makes every stored key unmatchable. There is
+deliberately no per-session index to keep consistent — the store is a
+flat content-addressed block map, and "the session" is just whichever
+suffix of its chain is still resolvable.
+"""
+from typing import Dict, Optional
+
+from .tiers import HostTier, SpilledBlock, StorageTier
+
+__all__ = ["SessionStore"]
+
+
+class SessionStore:
+    """Content-addressed persistence for conversation tail KV."""
+
+    def __init__(self, url: Optional[str] = None, store=None,
+                 compress: str = "none",
+                 capacity_blocks: Optional[int] = 16384):
+        self.url = url
+        if url is None:
+            self._host: Optional[HostTier] = HostTier(
+                capacity_blocks=capacity_blocks)
+            self._storage: Optional[StorageTier] = None
+        else:
+            self._host = None
+            self._storage = StorageTier(url, store=store, compress=compress,
+                                        capacity_blocks=capacity_blocks)
+        self.saves = 0
+        self.loads = 0
+        self._sessions: Dict[str, int] = {}  # session id -> blocks at last save
+
+    def has(self, key: bytes) -> bool:
+        if self._host is not None:
+            return self._host.has(key)
+        return self._storage.has(key)
+
+    def put_block(self, key: bytes, payload: Dict, tokens: int) -> int:
+        """Persist one exact full block; returns payload bytes stored
+        (0 if the key was already present)."""
+        if self._host is not None:
+            if self._host.has(key):
+                return 0
+            block = SpilledBlock(key, payload, tokens, lossy=False)
+            self._host.put(block)
+            self.saves += 1
+            return block.nbytes
+        written = self._storage.put(key, payload, tokens)
+        if written:
+            self.saves += 1
+        return written
+
+    def get_block(self, key: bytes) -> Optional[SpilledBlock]:
+        if self._host is not None:
+            block = self._host.get(key)
+        else:
+            block = self._storage.get(key)
+        if block is not None:
+            self.loads += 1
+        return block
+
+    def note_session(self, session_id: str, blocks: int) -> None:
+        """Bookkeeping only — how long the session's chain was at its
+        last save. Surfaced in stats; never consulted for correctness
+        (the chain walk is)."""
+        self._sessions[str(session_id)] = int(blocks)
+
+    def clear(self) -> None:
+        if self._host is not None:
+            self._host.clear()
+        else:
+            self._storage.clear()
+        self._sessions.clear()
+
+    def stats(self) -> Dict[str, int]:
+        tier = (self._host if self._host is not None
+                else self._storage).stats()
+        return {"blocks": tier["blocks"], "bytes": tier["bytes"],
+                "saves": self.saves, "loads": self.loads,
+                "sessions": len(self._sessions),
+                "backend": "host" if self._host is not None else "storage"}

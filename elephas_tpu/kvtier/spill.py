@@ -1,0 +1,143 @@
+"""TieredSpill: the demote/promote manager the serving engine binds
+under its :class:`~elephas_tpu.models.block_cache.BlockCache`.
+
+The engine's eviction hook hands every victim block here instead of
+discarding it; admission chain walks call :meth:`lookup` for the keys
+the device cache missed. Demotion always lands in the host tier first
+(exact f32), and host-capacity overflow cascades into the storage tier
+(Q8 by default) — so the lossy copy is only ever created from an exact
+one, never from another lossy copy.
+"""
+import threading
+from typing import Dict, Optional
+
+from .tiers import HostTier, SpilledBlock, StorageTier
+
+__all__ = ["TieredSpill"]
+
+
+class TieredSpill:
+    """Two-level spill hierarchy: host RAM over optional object storage.
+
+    :param host_capacity_blocks: bound on host-resident spilled blocks
+        (``None`` = unbounded).
+    :param storage_url: object-store prefix for the cold tier, e.g.
+        ``"mirror://kv-spill"``; ``None`` disables it (host overflow is
+        then dropped, matching pre-spill eviction behaviour).
+    :param storage_compress: ``"q8"`` (default, lossy) or ``"none"``
+        for the storage tier's payload codec.
+    :param storage_capacity_blocks: bound on this process's storage
+        writes.
+
+    Thread-safe: demotion runs on the engine loop while admission walks
+    may run on submitter threads; one lock covers both tiers.
+    """
+
+    def __init__(self, host_capacity_blocks: Optional[int] = 4096,
+                 storage_url: Optional[str] = None,
+                 storage_store=None,
+                 storage_compress: str = "q8",
+                 storage_capacity_blocks: Optional[int] = None):
+        self._lock = threading.Lock()
+        self.storage: Optional[StorageTier] = None
+        if storage_url is not None:
+            self.storage = StorageTier(
+                storage_url, store=storage_store,
+                compress=storage_compress,
+                capacity_blocks=storage_capacity_blocks)
+        self.host = HostTier(capacity_blocks=host_capacity_blocks,
+                             on_evict=self._spill_to_storage)
+        # counters mirrored into engine metrics by bind_metrics
+        self.demotions: Dict[str, int] = {"host": 0, "storage": 0}
+        self.demoted_bytes: Dict[str, int] = {"host": 0, "storage": 0}
+        self._m_demotions = None
+        self._m_bytes = None
+
+    # -- metrics ----------------------------------------------------------
+    def bind_metrics(self, demotions_family=None, bytes_family=None):
+        """Attach labeled counter families (label: ``tier``) so tier
+        movement shows up in the engine's registry without the tiers
+        importing obs."""
+        self._m_demotions = demotions_family
+        self._m_bytes = bytes_family
+
+    def _count_demotion(self, tier: str, nbytes: int) -> None:
+        self.demotions[tier] += 1
+        self.demoted_bytes[tier] += nbytes
+        if self._m_demotions is not None:
+            self._m_demotions.labels(tier=tier).inc()
+        if self._m_bytes is not None and nbytes:
+            self._m_bytes.labels(tier=tier).inc(nbytes)
+
+    # -- demotion ---------------------------------------------------------
+    def _spill_to_storage(self, block: SpilledBlock) -> None:
+        # HostTier overflow callback — called under self._lock (overflow
+        # only happens inside put(), which demote() wraps).
+        if self.storage is None or block.lossy:
+            return
+        written = self.storage.put(block.key, block.payload, block.tokens)
+        self._count_demotion("storage", written)
+
+    def demote(self, key: bytes, payload: Dict, tokens: int) -> None:
+        """Catch an evicted block. ``payload`` must be EXACT
+        (``{layer: (k, v)}`` f32/bf16 host arrays) — lossy data never
+        enters through this path."""
+        block = SpilledBlock(key, payload, int(tokens), lossy=False)
+        with self._lock:
+            self._count_demotion("host", block.nbytes)
+            self.host.put(block)
+
+    # -- promotion --------------------------------------------------------
+    def lookup(self, key: bytes):
+        """Fall-through read: host first (exact, free), then storage
+        (possibly lossy). Returns ``(block, tier_name)`` or ``None``.
+        Does NOT remove the block — the engine calls :meth:`consumed`
+        once the promotion actually installed."""
+        with self._lock:
+            block = self.host.get(key)
+            if block is not None:
+                return block, "host"
+            if self.storage is not None:
+                block = self.storage.get(key)
+                if block is not None:
+                    return block, "storage"
+        return None
+
+    def has(self, key: bytes) -> bool:
+        with self._lock:
+            if self.host.has(key):
+                return True
+            return self.storage is not None and self.storage.has(key)
+
+    def consumed(self, key: bytes) -> None:
+        """A promotion installed this key on device: drop the host copy
+        (device is canonical again; re-eviction re-demotes). Storage
+        copies stay — they are the cross-replica durability layer."""
+        with self._lock:
+            self.host.pop(key)
+
+    # -- lifecycle --------------------------------------------------------
+    def clear_host(self) -> None:
+        """Weight hot-swap: old-version chains can never match again, so
+        return the RAM immediately instead of waiting for LRU age-out.
+        (Storage entries are equally unreachable and age out under the
+        write-capacity LRU.)"""
+        with self._lock:
+            self.host.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self.host.clear()
+            if self.storage is not None:
+                self.storage.clear()
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            out = {"host": self.host.stats()}
+            out["host"]["demotions"] = self.demotions["host"]
+            out["host"]["demoted_bytes"] = self.demoted_bytes["host"]
+            if self.storage is not None:
+                out["storage"] = self.storage.stats()
+                out["storage"]["demotions"] = self.demotions["storage"]
+                out["storage"]["demoted_bytes"] = self.demoted_bytes["storage"]
+            return out

@@ -1,0 +1,325 @@
+"""Spill tiers under the content-addressed KV block cache.
+
+When pool pressure evicts a parked prefix from
+:class:`~elephas_tpu.models.block_cache.BlockCache`, the block's KV —
+prefill work that QoS park-and-resume and speculative parking
+deliberately saved — used to be discarded. These tiers catch it
+instead: a :class:`HostTier` keeps the payload in host RAM in the SAME
+``{layer: (k, v)}`` format the host-mode cache already trades (each
+array ``(kv_heads, block_size, head_dim)``), and an optional
+:class:`StorageTier` spills host overflow to a
+:class:`~elephas_tpu.utils.storage.ObjectStore`, Q8-compressed on the
+way down via :func:`~elephas_tpu.models.quantization.quantize_kv`
+(0.386x wire bytes). Promotion is the one host-to-device copy the
+host-mode cache trades on every hit — far cheaper than re-prefilling
+the prefix.
+
+Keys are the block cache's CHAIN keys: each 16-byte digest describes
+the entire token prefix up to its block, seeded by the engine's live
+``weights_version``. Tier entries therefore inherit the cache's
+hot-swap invalidation for free — post-swap chains hash differently, so
+old-version spilled blocks simply stop matching (the engine still
+clears the host tier on swap to return the RAM now instead of at LRU
+age-out).
+
+Lossy parity rule (the hazard the PR 10 review flagged): a Q8
+round-tripped payload is content-addressed by its ORIGINAL tokens but
+carries ``lossy=True``. Only LOSSLESS payloads may ever re-register
+under their chain key on promotion; a lossy block — when an engine
+opts into promoting it at all — stays private to the admitting slot
+and taints it, so nothing computed over dequantized KV is ever served
+as the exact content its tokens address. Demotion sources are always
+exact (device pool blocks or host f32 payloads — lossy blocks never
+become cache entries), so quantization error never compounds across
+demote/promote cycles.
+"""
+import io
+from collections import OrderedDict
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..models.quantization import dequantize_kv, kv_payload_nbytes, quantize_kv
+from ..utils.storage import get_store
+
+__all__ = ["SpilledBlock", "HostTier", "StorageTier", "encode_payload",
+           "decode_payload"]
+
+
+class SpilledBlock:
+    """One spilled KV block: chain key -> host payload.
+
+    ``payload`` is ``{layer_name: (k, v)}`` numpy arrays of shape
+    ``(kv_heads, block_size, head_dim)`` — the host-mode cache's
+    payload format, which is also exactly one paged pool block per
+    layer. ``tokens`` is the prompt length the block's CHAIN covers
+    (``(i+1) * block_size`` for chain position ``i``), mirroring
+    :class:`~elephas_tpu.models.block_cache.BlockEntry`. ``lossy``
+    marks a payload that round-tripped Q8 — see the module docstring's
+    parity rule."""
+
+    __slots__ = ("key", "payload", "tokens", "lossy", "nbytes")
+
+    def __init__(self, key: bytes, payload: Dict, tokens: int,
+                 lossy: bool = False):
+        self.key = key
+        self.payload = payload
+        self.tokens = int(tokens)
+        self.lossy = bool(lossy)
+        self.nbytes = kv_payload_nbytes(payload)
+
+
+# --------------------------------------------------------------------------
+# npz payload codec — the storage tier's object format. One object per
+# block: per layer either raw f32 (k_<layer>/v_<layer>) or Q8 pairs
+# (qk_/sk_/qv_/sv_), plus the chain-coverage token count. Lossiness is
+# a property of the CONTENT (which key family is present), never a
+# sidecar flag that could drift from it.
+# --------------------------------------------------------------------------
+
+def encode_payload(payload: Dict, tokens: int,
+                   compress: str = "none") -> bytes:
+    """Serialize a block payload to npz bytes. ``compress="q8"``
+    stores int8 data + f32 scales per k/v tensor
+    (:func:`~elephas_tpu.models.quantization.quantize_kv`);
+    ``"none"`` stores f32 (bf16 inputs are widened — lossless with
+    respect to the stored values)."""
+    arrays: Dict[str, np.ndarray] = {"tokens": np.int64(tokens)}
+    if compress == "q8":
+        for name, (k, v) in payload.items():
+            qk, sk = quantize_kv(k)
+            qv, sv = quantize_kv(v)
+            arrays[f"qk_{name}"] = qk
+            arrays[f"sk_{name}"] = sk
+            arrays[f"qv_{name}"] = qv
+            arrays[f"sv_{name}"] = sv
+    elif compress == "none":
+        for name, (k, v) in payload.items():
+            arrays[f"k_{name}"] = np.asarray(k, np.float32)
+            arrays[f"v_{name}"] = np.asarray(v, np.float32)
+    else:
+        raise ValueError(f"unknown spill compression {compress!r} "
+                         "(expected 'q8' or 'none')")
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_payload(data: bytes) -> Tuple[Dict, int, bool]:
+    """Inverse of :func:`encode_payload`: ``(payload f32, tokens,
+    lossy)`` — Q8 content dequantizes here, flagged lossy."""
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        files = set(z.files)
+        tokens = int(z["tokens"])
+        payload: Dict = {}
+        lossy = any(f.startswith("qk_") for f in files)
+        if lossy:
+            for f in files:
+                if f.startswith("qk_"):
+                    name = f[3:]
+                    payload[name] = (
+                        dequantize_kv(z[f"qk_{name}"], z[f"sk_{name}"]),
+                        dequantize_kv(z[f"qv_{name}"], z[f"sv_{name}"]))
+        else:
+            for f in files:
+                if f.startswith("k_"):
+                    name = f[2:]
+                    payload[name] = (np.asarray(z[f"k_{name}"]),
+                                     np.asarray(z[f"v_{name}"]))
+    return payload, tokens, lossy
+
+
+class HostTier:
+    """Bounded host-RAM tier: an LRU dict of :class:`SpilledBlock`.
+
+    :param capacity_blocks: bound on resident blocks (``None`` =
+        unbounded — the in-process session backend). Inserting past it
+        evicts the LRU block through ``on_evict``.
+    :param on_evict: callback ``(block)`` for capacity overflow — the
+        :class:`~elephas_tpu.kvtier.TieredSpill` manager chains the
+        storage tier here; ``None`` drops the overflow (exactly what
+        cache eviction did before the spill plane existed).
+    """
+
+    def __init__(self, capacity_blocks: Optional[int] = 4096,
+                 on_evict: Optional[Callable] = None):
+        self.capacity = (None if capacity_blocks is None
+                         else int(capacity_blocks))
+        if self.capacity is not None and self.capacity < 1:
+            raise ValueError("host tier capacity must be >= 1 block")
+        self._on_evict = on_evict
+        self._blocks: "OrderedDict[bytes, SpilledBlock]" = OrderedDict()
+        self._nbytes = 0
+        self.puts = 0
+        self.gets = 0
+        self.evictions = 0
+
+    def put(self, block: SpilledBlock) -> None:
+        old = self._blocks.pop(block.key, None)
+        if old is not None:
+            self._nbytes -= old.nbytes
+        self._blocks[block.key] = block
+        self._nbytes += block.nbytes
+        self.puts += 1
+        if self.capacity is not None:
+            while len(self._blocks) > self.capacity:
+                _, victim = self._blocks.popitem(last=False)
+                self._nbytes -= victim.nbytes
+                self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(victim)
+
+    def get(self, key: bytes) -> Optional[SpilledBlock]:
+        block = self._blocks.get(key)
+        if block is not None:
+            self._blocks.move_to_end(key)
+            self.gets += 1
+        return block
+
+    def has(self, key: bytes) -> bool:
+        return key in self._blocks
+
+    def pop(self, key: bytes) -> Optional[SpilledBlock]:
+        """Remove without the overflow callback (a promotion made the
+        device copy canonical again; re-eviction re-demotes)."""
+        block = self._blocks.pop(key, None)
+        if block is not None:
+            self._nbytes -= block.nbytes
+        return block
+
+    def clear(self) -> None:
+        self._blocks.clear()
+        self._nbytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def stats(self) -> Dict[str, int]:
+        return {"blocks": len(self._blocks), "bytes": self._nbytes,
+                "capacity_blocks": (0 if self.capacity is None
+                                    else self.capacity),
+                "puts": self.puts, "gets": self.gets,
+                "evictions": self.evictions}
+
+
+class StorageTier:
+    """Object-store tier: one npz object per chain key under
+    ``<url>/<key hex>.npz``, resolved through the
+    :mod:`~elephas_tpu.utils.storage` scheme registry (tests and
+    shared-filesystem deployments register a
+    :class:`~elephas_tpu.utils.storage.LocalMirrorStore`).
+
+    ``compress="q8"`` (default) quantizes on the way down — promoted
+    payloads come back dequantized and flagged ``lossy``; ``"none"``
+    stores f32 and round-trips exact. ``capacity_blocks`` bounds THIS
+    process's writes (LRU-deleted past it); the bucket itself may be
+    shared across replicas, so lookups fall back to ``store.exists``
+    for keys some other replica wrote."""
+
+    def __init__(self, url: str, store=None, compress: str = "q8",
+                 capacity_blocks: Optional[int] = None):
+        if compress not in ("q8", "none"):
+            raise ValueError(f"unknown spill compression {compress!r}")
+        self.url = str(url).rstrip("/")
+        self.store = store if store is not None else get_store(self.url)
+        self.compress = compress
+        self.capacity = (None if capacity_blocks is None
+                         else int(capacity_blocks))
+        # keys THIS process wrote, LRU order, -> object bytes (capacity
+        # enforcement + occupancy stats; the shared bucket may hold more)
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self._nbytes = 0
+        self.puts = 0
+        self.gets = 0
+        self.deletes = 0
+
+    def _url_for(self, key: bytes) -> str:
+        return f"{self.url}/{key.hex()}.npz"
+
+    def has(self, key: bytes) -> bool:
+        if key in self._index:
+            return True
+        try:
+            return bool(self.store.exists(self._url_for(key)))
+        except Exception:  # noqa: BLE001 — an unreachable store is a
+            return False   # miss, never an admission failure
+
+    def put(self, key: bytes, payload: Dict, tokens: int) -> int:
+        """Write one block; returns bytes written (0 when the key is
+        already present — content-addressing makes rewrites no-ops)."""
+        if key in self._index:
+            self._index.move_to_end(key)
+            return 0
+        data = encode_payload(payload, tokens, self.compress)
+        try:
+            self.store.write_bytes(self._url_for(key), data)
+        except Exception:  # noqa: BLE001 — spill is best-effort: a
+            return 0       # failed write costs a future re-prefill only
+        self._index[key] = len(data)
+        self._nbytes += len(data)
+        self.puts += 1
+        if self.capacity is not None:
+            while len(self._index) > self.capacity:
+                victim, size = self._index.popitem(last=False)
+                self._nbytes -= size
+                self.deletes += 1
+                try:
+                    self.store.delete(self._url_for(victim))
+                except Exception:  # noqa: BLE001
+                    pass
+        return len(data)
+
+    def get(self, key: bytes) -> Optional[SpilledBlock]:
+        url = self._url_for(key)
+        if key not in self._index:
+            try:
+                if not self.store.exists(url):
+                    return None
+            except Exception:  # noqa: BLE001
+                return None
+        try:
+            data = self.store.read_bytes(url)
+        except Exception:  # noqa: BLE001 — deleted under us / flaky
+            self._drop_index(key)
+            return None
+        payload, tokens, lossy = decode_payload(data)
+        self.gets += 1
+        if key in self._index:
+            self._index.move_to_end(key)
+        return SpilledBlock(key, payload, tokens, lossy=lossy)
+
+    def _drop_index(self, key: bytes) -> None:
+        size = self._index.pop(key, None)
+        if size is not None:
+            self._nbytes -= size
+
+    def clear(self) -> None:
+        """Delete THIS process's writes (the shared bucket may hold
+        other replicas' blocks — those age out under their own
+        writers' capacity)."""
+        for key in list(self._index):
+            try:
+                self.store.delete(self._url_for(key))
+            except Exception:  # noqa: BLE001
+                pass
+            self.deletes += 1
+        self._index.clear()
+        self._nbytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def stats(self) -> Dict[str, int]:
+        return {"blocks": len(self._index), "bytes": self._nbytes,
+                "capacity_blocks": (0 if self.capacity is None
+                                    else self.capacity),
+                "puts": self.puts, "gets": self.gets,
+                "deletes": self.deletes}

@@ -59,7 +59,8 @@ from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
 __all__ = ["init_paged_pool", "decode_step_paged", "decode_block_paged",
            "install_row_paged", "gather_blocks_to_row",
            "validate_paged_config", "export_kv_blocks",
-           "import_kv_blocks"]
+           "import_kv_blocks", "export_pool_blocks",
+           "install_pool_blocks"]
 
 
 def validate_paged_config(config: TransformerConfig):
@@ -242,6 +243,58 @@ def import_kv_blocks(arrays: Sequence[np.ndarray], length: int,
             parts[part] = full
         row[f"layer_{i}"] = parts
     return row
+
+
+def export_pool_blocks(pool: Dict, block_ids: Sequence[int]) -> List[Dict]:
+    """Read pool blocks out to host payload dicts: one ``{layer: (k,
+    v)}`` dict per id (each array ``(kv_heads, block_size, head_dim)``
+    — the block cache's host payload format). One device->host gather
+    per layer tensor regardless of block count. The KV spill tier's
+    demotion read (:mod:`elephas_tpu.kvtier`) and the session store's
+    persistence read."""
+    ids = [int(b) for b in block_ids]
+    if not ids:
+        return []
+    idx = jnp.asarray(ids)
+    per_layer = {name: (np.asarray(lc["k"][idx]), np.asarray(lc["v"][idx]))
+                 for name, lc in pool.items()}
+    out: List[Dict] = []
+    for i in range(len(ids)):
+        out.append({name: (np.ascontiguousarray(ks[i]),
+                           np.ascontiguousarray(vs[i]))
+                    for name, (ks, vs) in per_layer.items()})
+    return out
+
+
+def install_pool_blocks(pool: Dict, payloads: Sequence[Dict],
+                        block_ids: Sequence[int]) -> Dict:
+    """Inverse of :func:`export_pool_blocks`: scatter host payload
+    dicts into ``block_ids``' pool blocks (the spill tier's PROMOTION
+    write — the same one host->device copy per block the host-mode
+    cache trades on every hit). Payloads are cast to the pool dtype.
+    One jit specialization per block count."""
+    if len(payloads) != len(block_ids):
+        raise ValueError(f"{len(payloads)} payloads for "
+                         f"{len(block_ids)} block ids")
+    if not payloads:
+        return pool
+    stacked = {}
+    for name, lc in pool.items():
+        dt = lc["k"].dtype
+        stacked[name] = {
+            "k": jnp.asarray(np.stack([np.asarray(p[name][0], np.float32)
+                                       for p in payloads]), dt),
+            "v": jnp.asarray(np.stack([np.asarray(p[name][1], np.float32)
+                                       for p in payloads]), dt)}
+    return _install_blocks_jit(pool, stacked,
+                               jnp.asarray([int(b) for b in block_ids]))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _install_blocks_jit(pool, blocks, block_ids):
+    return {name: {"k": lc["k"].at[block_ids].set(blocks[name]["k"]),
+                   "v": lc["v"].at[block_ids].set(blocks[name]["v"])}
+            for name, lc in pool.items()}
 
 
 def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,

@@ -34,7 +34,9 @@ import numpy as np
 
 __all__ = ["QTensor", "quantize_weight", "quantize_lm_params",
            "dequantize_lm_params", "quantize_kv", "dequantize_kv",
-           "quantize_kv_frames", "dequantize_kv_frames", "KV_Q8_EPS"]
+           "quantize_kv_frames", "dequantize_kv_frames",
+           "quantize_kv_payload", "dequantize_kv_payload",
+           "kv_payload_nbytes", "KV_Q8_EPS"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -218,6 +220,31 @@ def dequantize_kv_frames(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
                          f"got {len(arrays)} arrays")
     return [dequantize_kv(q, s)
             for q, s in zip(arrays[0::2], arrays[1::2])]
+
+
+def quantize_kv_payload(payload: Dict) -> Dict:
+    """Q8-quantize a block-cache payload (``{layer: (k, v)}`` host
+    arrays): each tensor becomes its :func:`quantize_kv` ``(data,
+    scale)`` pair — ``{layer: ((qk, sk), (qv, sv))}``. The KV spill
+    tier's storage codec (:mod:`elephas_tpu.kvtier`)."""
+    return {name: (quantize_kv(k), quantize_kv(v))
+            for name, (k, v) in payload.items()}
+
+
+def dequantize_kv_payload(qpayload: Dict) -> Dict:
+    """Inverse of :func:`quantize_kv_payload` (f32 payload). Every
+    element honors the :func:`quantize_kv` ``scale / 2`` error bound —
+    the round trip is LOSSY, so consumers must treat the result under
+    the spill tier's lossy-parity rule."""
+    return {name: (dequantize_kv(*qk), dequantize_kv(*qv))
+            for name, (qk, qv) in qpayload.items()}
+
+
+def kv_payload_nbytes(payload: Dict) -> int:
+    """Host bytes held by a block-cache payload dict (``{layer: (k,
+    v)}``) — the spill tiers' occupancy accounting unit."""
+    return int(sum(np.asarray(k).nbytes + np.asarray(v).nbytes
+                   for k, v in payload.values()))
 
 
 def dequantize_lm_params(params: Dict) -> Dict:

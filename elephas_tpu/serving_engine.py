@@ -334,7 +334,8 @@ class DecodeEngine:
                  prefix_cache_block_size: Optional[int] = None,
                  prefix_cache_capacity: Optional[int] = None,
                  qos: Optional[TenantQoS] = None,
-                 profiler: Union[None, bool, LoopProfiler] = None):
+                 profiler: Union[None, bool, LoopProfiler] = None,
+                 kv_spill=None, session_store=None):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -440,6 +441,36 @@ class DecodeEngine:
         # slot's KV only parks when the engine still serves that
         # version (post-swap chain keys would address old-weight KV)
         self._slot_wv = np.zeros(self.max_slots, np.int64)
+        # tiered KV spill + resumable sessions (:mod:`~elephas_tpu.
+        # kvtier`) — wired up after the prefix-cache block below;
+        # the slot state lives here with its siblings. _slot_lossy
+        # taints a slot that admitted over a LOSSY (Q8-round-tripped)
+        # promoted block: nothing it computes may register, park, or
+        # persist under chain keys (the lossy-parity rule).
+        self._kv_spill = None
+        self._session_store = None
+        self._lossy_promote = False
+        # (rid, version, start_block, promos) — the tier walk's memo,
+        # invalidated whenever the DEVICE hit count at the same rid
+        # changes (another admission may have registered more of the
+        # chain while this candidate waited, shifting the walk start)
+        self._promo_memo: Optional[Tuple] = None
+        # per-admission demotion tally: set around the allocation loop
+        # so a large allocation's evictions flush as ONE kv_demote
+        # event instead of flooding the per-rid recorder cap
+        self._demote_accum: Optional[Dict[str, int]] = None
+        self._m_spill_demote = None
+        self._m_spill_promote = None
+        self._m_spill_bytes = None
+        self._m_session_hits = None
+        self._m_session_misses = None
+        self._slot_lossy = [False] * self.max_slots
+        # slot -> [(SpilledBlock, source_tier)] claimed by _admit's
+        # tier walk, consumed by the admission prefill's install
+        self._slot_promos: Dict[int, List] = {}
+        # rid -> session id (rid-keyed so it survives preemption
+        # re-queues, like _seed); dropped at retirement/cancel
+        self._session: Dict[int, str] = {}
         # rid -> {"outputs": [...], "preempts": n} for requests
         # preempted mid-decode and re-queued for resume
         self._resume: Dict[int, Dict] = {}
@@ -863,6 +894,23 @@ class DecodeEngine:
             raise ValueError("prefix_cache_block_size/"
                              "prefix_cache_capacity given with "
                              "prefix_cache disabled")
+        # tiered KV spill / resumable sessions: True = defaults, a
+        # dict = enable_* kwargs, an instance = share it (the shared-
+        # instance form is the cross-replica session topology)
+        if kv_spill:
+            if kv_spill is True:
+                self.enable_kv_spill()
+            elif isinstance(kv_spill, dict):
+                self.enable_kv_spill(**kv_spill)
+            else:
+                self.enable_kv_spill(spill=kv_spill)
+        if session_store:
+            if session_store is True:
+                self.enable_session_store()
+            elif isinstance(session_store, dict):
+                self.enable_session_store(**session_store)
+            else:
+                self.enable_session_store(store=session_store)
         # construction-time baselines: an INJECTED shared registry may
         # already carry a predecessor engine's totals (weight-reload
         # flow) — stats must report THIS engine's deltas, never pooled
@@ -1224,10 +1272,247 @@ class DecodeEngine:
             if (e := ref()) is not None and e._kv_cache is not None
             else 0.0)
 
+    # ------------------------------------------------- tiered KV spill
+    def enable_kv_spill(self, spill=None, *,
+                        host_capacity_blocks: Optional[int] = 4096,
+                        storage_url: Optional[str] = None,
+                        storage_compress: str = "q8",
+                        storage_capacity_blocks: Optional[int] = None,
+                        lossy_promote: bool = False):
+        """Turn on the tiered KV spill plane (:mod:`~elephas_tpu.
+        kvtier`): block-cache evictions DEMOTE to host RAM (and
+        optionally to ``storage_url``'s object store, Q8-compressed)
+        instead of discarding, and admission chain walks fall through
+        device → host → storage, promoting spilled blocks back with
+        one host→device copy each. Implies the prefix cache. Call
+        BEFORE traffic, like :meth:`enable_prefix_cache`.
+
+        ``lossy_promote`` opts in to promoting Q8 (storage-tier)
+        blocks: the dequantized KV serves the admitting request —
+        saving its re-prefill at a bounded-error cost — but the slot
+        is tainted so nothing computed over it ever registers, parks,
+        or persists under chain keys (lossy-parity rule; default off
+        keeps outputs bit-identical to spill-off). Returns the
+        :class:`~elephas_tpu.kvtier.TieredSpill` (pass ``spill`` to
+        share one across engines)."""
+        if self._kv_spill is not None:
+            return self._kv_spill
+        if self._kv_cache is None:
+            self.enable_prefix_cache()
+        from .kvtier import TieredSpill
+
+        if spill is None:
+            spill = TieredSpill(
+                host_capacity_blocks=host_capacity_blocks,
+                storage_url=storage_url,
+                storage_compress=storage_compress,
+                storage_capacity_blocks=storage_capacity_blocks)
+        self._kv_spill = spill
+        self._lossy_promote = bool(lossy_promote)
+        self._ensure_spill_metrics()
+        spill.bind_metrics(self._m_spill_demote, self._m_spill_bytes)
+        return spill
+
+    def enable_session_store(self, store=None, *,
+                             url: Optional[str] = None,
+                             compress: str = "none",
+                             capacity_blocks: Optional[int] = 16384):
+        """Turn on resumable cross-request sessions (:mod:`~elephas_tpu.
+        kvtier`): a request submitted with ``session=<id>`` persists
+        its final sequence's full KV blocks here at retirement, keyed
+        by content-addressed chain + ``weights_version``, and a later
+        request for the same conversation admits as a chain hit — on
+        ANY engine sharing the backend (pass one
+        :class:`~elephas_tpu.kvtier.SessionStore` instance to several
+        engines, or point them at one ``url``). Persistence needs a
+        paged engine (blocks are exported straight off the pool);
+        lookup/promotion works on any engine with the prefix cache.
+        Hot-swap invalidation is free by construction — post-swap
+        chains hash differently. Implies the prefix cache."""
+        if self._session_store is not None:
+            return self._session_store
+        if self._kv_cache is None:
+            self.enable_prefix_cache()
+        from .kvtier import SessionStore
+
+        if store is None:
+            store = SessionStore(url=url, compress=compress,
+                                 capacity_blocks=capacity_blocks)
+        self._session_store = store
+        self._ensure_spill_metrics()
+        return store
+
+    def _ensure_spill_metrics(self) -> None:
+        """The spill/session metric families, shared by both enable
+        paths (promotions may source from either plane). Baselined
+        like every engine counter so stats stays per-engine on an
+        injected shared registry."""
+        if self._m_spill_promote is not None:
+            return
+        reg = self.registry
+        self._m_spill_demote = reg.counter(
+            "serving_kv_spill_demotions_total",
+            "KV blocks demoted into a spill tier, by destination tier",
+            labels=("tier",))
+        self._m_spill_promote = reg.counter(
+            "serving_kv_spill_promotions_total",
+            "spilled KV blocks promoted back to device, by source "
+            "tier ('session' = the session store)", labels=("tier",))
+        self._m_spill_bytes = reg.counter(
+            "serving_kv_spill_bytes_total",
+            "payload bytes written into a spill tier, by tier",
+            labels=("tier",))
+        self._m_session_hits = reg.counter(
+            "serving_kv_session_hits_total",
+            "session-tagged admissions that reused >= 1 chain block "
+            "(device, spill, or session tier)").labels()
+        self._m_session_misses = reg.counter(
+            "serving_kv_session_misses_total",
+            "session-tagged admissions with a walkable chain and "
+            "zero reuse (cold resume: full re-prefill)").labels()
+        self._spill_stat_base = counter_baseline(
+            self._m_session_hits, self._m_session_misses)
+        import weakref
+
+        ref = weakref.ref(self)
+        g_blocks = reg.gauge(
+            "serving_kv_tier_blocks",
+            "KV blocks resident per spill/session tier",
+            labels=("tier",))
+        g_bytes = reg.gauge(
+            "serving_kv_tier_bytes",
+            "payload bytes resident per spill/session tier",
+            labels=("tier",))
+
+        def _tier_stat(tier, field):
+            e = ref()
+            if e is None:
+                return 0.0
+            if tier == "session":
+                return (float(e._session_store.stats()[field])
+                        if e._session_store is not None else 0.0)
+            spill = e._kv_spill
+            if spill is None:
+                return 0.0
+            if tier == "storage" and spill.storage is None:
+                return 0.0
+            src = spill.host if tier == "host" else spill.storage
+            return float(len(src) if field == "blocks" else src.nbytes)
+
+        for tier in ("host", "storage", "session"):
+            g_blocks.labels(tier=tier).set_function(
+                partial(_tier_stat, tier, "blocks"))
+            g_bytes.labels(tier=tier).set_function(
+                partial(_tier_stat, tier, "bytes"))
+
+    def _pool_block_payload(self, bid: int) -> Dict:
+        """One pool block as a host payload dict — the demotion read.
+        Must run BEFORE the block id is reused (i.e. inside the
+        eviction callback, before the free list hands it out)."""
+        return {name: (np.asarray(lc["k"][bid]), np.asarray(lc["v"][bid]))
+                for name, lc in self.pool.items()}
+
     def _on_cache_evict(self, entry) -> None:
+        spill = self._kv_spill
+        if spill is not None and int(getattr(entry, "tokens", 0)) > 0:
+            # demote instead of discard. Inside an allocation loop
+            # (_demote_accum set) paged payloads are STAGED and read
+            # out in one batched per-layer gather at the flush — a
+            # per-eviction device read syncs the stream once per block
+            # and dominates warm-TTFT otherwise. The staged block id
+            # may rejoin the free list and even be re-allocated to the
+            # admitting request, but its pool contents are untouched
+            # until that request installs — which happens strictly
+            # after the flush.
+            if self.paged is not None:
+                if self._demote_accum is not None:
+                    self._demote_accum.setdefault("staged", []).append(
+                        (entry.key, int(entry.payload),
+                         int(entry.tokens)))
+                else:
+                    # eviction outside an admission (register_prefix
+                    # pressure): read out NOW, before the id rejoins
+                    # the free list. Sources are always EXACT — lossy
+                    # blocks never become cache entries.
+                    spill.demote(
+                        entry.key,
+                        self._pool_block_payload(int(entry.payload)),
+                        entry.tokens)
+            else:
+                spill.demote(entry.key, entry.payload, entry.tokens)
+                if self._demote_accum is not None:
+                    self._demote_accum["blocks"] = (
+                        self._demote_accum.get("blocks", 0) + 1)
         if self.paged is not None:
             self._free_block_ids.append(entry.payload)
         self._m_kv_evictions.inc()
+
+    def _flush_demotions(self, accum) -> int:
+        """Batch-demote the evictions an allocation loop staged: ONE
+        device->host gather per layer for every staged block (the
+        export_pool_blocks path), then the per-key spill puts. Returns
+        the number of blocks demoted (staged + contiguous-mode
+        immediates)."""
+        staged = accum.get("staged", ())
+        if staged:
+            from .models.paged_decode import export_pool_blocks
+
+            payloads = export_pool_blocks(
+                self.pool, [bid for _, bid, _ in staged])
+            for (key, _, tokens), payload in zip(staged, payloads):
+                self._kv_spill.demote(key, payload, tokens)
+        return accum.get("blocks", 0) + len(staged)
+
+    def _tier_lookup(self, key: bytes):
+        """One chain key's spill/session resolution: ``(block,
+        source_tier)`` or ``None`` — spill tiers first (host RAM beats
+        a storage read), then the session store."""
+        if self._kv_spill is not None:
+            found = self._kv_spill.lookup(key)
+            if found is not None:
+                return found
+        if self._session_store is not None:
+            block = self._session_store.get_block(key)
+            if block is not None:
+                return block, "session"
+        return None
+
+    def _tier_walk(self, rid: Optional[int], keys, start: int,
+                   allow_lossy: bool = False) -> List:
+        """Continue an admission's chain walk past the device cache:
+        the longest run of consecutive ``keys`` resolvable in the
+        spill tiers / session store, as ``[(SpilledBlock, tier)]``.
+        Memoized per (rid, version, start): a queue head waiting for
+        capacity re-walks every step, and the tier reads (a storage
+        GET per key) are the expensive half. ``start`` — the device
+        hit count — keys the memo because another admission may
+        register more of the chain while this candidate waits; promos
+        computed at the old offset would then overlap the new hits."""
+        if self._kv_spill is None and self._session_store is None:
+            return []
+        memo = self._promo_memo
+        if (rid is not None and memo is not None and memo[0] == rid
+                and memo[1] == self.weights_version
+                and memo[2] == start):
+            return memo[3]
+        promos: List = []
+        for key in keys:
+            found = self._tier_lookup(key)
+            if found is None:
+                break
+            block, src = found
+            if block.lossy:
+                if allow_lossy:
+                    # a lossy block still ends the walk: everything
+                    # after it is served freshly anyway once the slot
+                    # is tainted, and stopping bounds the blast radius
+                    promos.append((block, src))
+                break
+            promos.append((block, src))
+        if rid is not None:
+            self._promo_memo = (rid, self.weights_version, start,
+                                promos)
+        return promos
 
     def _cache_chain_keys(self, prompt: np.ndarray):
         """(walk_keys, insert_keys) for ``prompt``: insert keys cover
@@ -1276,6 +1561,11 @@ class DecodeEngine:
         block moves from the slot's PRIVATE list to its SHARED list,
         refcounted by this slot from birth — a same-prefix request
         admitted one step later already hits."""
+        if self._slot_lossy[slot]:
+            # the slot admitted over a lossy promoted block: its fresh
+            # blocks were computed attending to dequantized KV and must
+            # never register as the exact content their tokens address
+            return
         cache, bs = self._kv_cache, self._kv_cache_bs
         nfull = prompt.size // bs
         if nfull <= skip:
@@ -1355,7 +1645,12 @@ class DecodeEngine:
         cache, bs = self._kv_cache, self._kv_cache_bs
         walk_keys, ins_keys = self._chain_keys_for(rid, prompt)
         hits = cache.match_chain(walk_keys)
-        j = len(hits)
+        # host-mode tier fall-through: LOSSLESS spilled blocks only
+        # (the payload joins the row head exactly like a cache hit, and
+        # re-registers below — a lossy payload could do neither without
+        # slot-taint machinery the contiguous engine doesn't carry)
+        promos = self._tier_walk(rid, walk_keys[len(hits):], len(hits))
+        j = len(hits) + len(promos)
         entry = self._match_prefix(prompt)
         reg_len = 0 if entry is None else int(entry[0].size)
         reg_used = 0
@@ -1371,7 +1666,7 @@ class DecodeEngine:
                 prompt, self._extend_fn, self._extend_owned_fn,
                 self._prefill_fn, self.params, entry, 2,
                 self._fresh_row_fn)
-            j, reused = 0, 0
+            j, reused, promos = 0, 0, []
         elif j > 0:
             for e in hits:
                 cache.touch(e)
@@ -1381,9 +1676,27 @@ class DecodeEngine:
             cache.record_walk(j, True)
             if rid is not None:
                 self.recorder.record(rid, "kv_cache_hit", blocks=j,
-                                     tokens_reused=reused)
-            row = self._host_cache_row(hits)
+                                     tokens_reused=reused,
+                                     promoted=len(promos))
+            row = self._host_cache_row(
+                hits + [blk for blk, _ in promos])
             logits, row = self._extend_remainder(row, prompt, reused)
+            for blk, src in promos:
+                if self._m_spill_promote is not None:
+                    self._m_spill_promote.labels(tier=src).inc()
+                if cache.get(blk.key) is None:
+                    # exact payload: re-register under the chain key
+                    # so the next same-chain admission device-hits
+                    cache.insert(blk.key, blk.payload, blk.tokens)
+                if self._kv_spill is not None:
+                    self._kv_spill.consumed(blk.key)
+            if promos:
+                self._promo_memo = None
+                if rid is not None:
+                    self.recorder.record(rid, "kv_promote",
+                                         blocks=len(promos))
+                emit_event("serving.kv_promote", rid=rid,
+                           blocks=len(promos))
         else:
             if walk_keys:
                 self._m_kv_misses.inc()
@@ -1477,6 +1790,13 @@ class DecodeEngine:
             # version); an in-use old block stays referenced until its
             # request retires, then parks, never to be served again.
             self._kv_cache.unpin_all()
+        if self._kv_spill is not None:
+            # spilled blocks share the construction: old-version chains
+            # can never match again, so the host tier's RAM comes back
+            # NOW rather than at LRU age-out (storage entries are
+            # equally unreachable and age out under write-capacity LRU)
+            self._kv_spill.clear_host()
+        self._promo_memo = None
         if self._prefixes:
             # re-pin every registered prefix under the new weights;
             # register_prefix re-sorts, so matching behavior is
@@ -1611,7 +1931,8 @@ class DecodeEngine:
                tenant: Optional[str] = None,
                priority=None,
                seed: Optional[int] = None,
-               resume_from: int = 0) -> int:
+               resume_from: int = 0,
+               session: Optional[str] = None) -> int:
         """Queue a request; returns its id. Admission happens lazily on
         the next :meth:`step` (or immediately if a slot is free).
         ``temperature``/``top_k``/``top_p`` override the engine defaults
@@ -1656,11 +1977,21 @@ class DecodeEngine:
         prefix-cache chain hit — and the request's output starts with
         those ``N`` tokens followed by ``max_new_tokens`` freshly
         decoded ones, exactly as the uninterrupted request would have
-        continued (token-identical under greedy decoding)."""
+        continued (token-identical under greedy decoding).
+
+        ``session`` names a resumable conversation (needs
+        :meth:`enable_session_store`): at retirement the request's
+        final sequence's full KV blocks persist, content-addressed by
+        chain + ``weights_version``, and the conversation's NEXT
+        request — whose prompt starts with this one's prompt +
+        completion — admits as a chain hit on any engine sharing the
+        store, paying a short remainder prefill instead of the whole
+        history's."""
         return self._submit_impl(prompt, max_new_tokens, temperature,
                                  top_k, top_p, admit, deadline_ms, None,
                                  tenant, priority, seed=seed,
-                                 resume_from=resume_from)
+                                 resume_from=resume_from,
+                                 session=session)
 
     def submit_prefilled(self, prompt: Sequence[int],
                          max_new_tokens: int, kv_blocks, first_token: int,
@@ -1761,7 +2092,7 @@ class DecodeEngine:
                      top_p, admit, deadline_ms, prefilled,
                      tenant=None, priority=None,
                      submitted_at=None, seed=None,
-                     resume_from=0) -> int:
+                     resume_from=0, session=None) -> int:
         if (temperature is not None or top_k is not None
                 or top_p is not None):
             if self.draft_config is not None:
@@ -1861,6 +2192,8 @@ class DecodeEngine:
             self._prefilled_kv[rid] = prefilled
         if seed is not None:
             self._seed[rid] = seed
+        if session is not None:
+            self._session[rid] = str(session)
         if resume_from:
             # ride the preemption-resume machinery: admission pops this
             # entry, pre-seeds the request's outputs with the forced
@@ -1877,7 +2210,8 @@ class DecodeEngine:
             self.temperature if temperature is None
             else float(temperature),
             0 if top_k is None else int(top_k),
-            1.0 if top_p is None else float(top_p), tenant, prio))
+            1.0 if top_p is None else float(top_p), tenant, prio,
+            session=None if session is None else str(session)))
         self._queued_tokens += int(prompt.size)
         self._tenant_gauge(tenant)
         if admit:
@@ -2087,6 +2421,7 @@ class DecodeEngine:
             self._prefilled_kv.pop(rid, None)
             self._resume.pop(rid, None)
             self._seed.pop(rid, None)
+            self._session.pop(rid, None)
             # a preempted-then-re-queued request may still hold an
             # un-surfaced admission token: the next step() must not
             # report tokens for a cancelled rid
@@ -2115,6 +2450,7 @@ class DecodeEngine:
                 self._deadline.pop(rid, None)
                 self._trace_ctx.pop(rid, None)
                 self._seed.pop(rid, None)
+                self._session.pop(rid, None)
                 self._ttft_origin.pop(rid, None)
                 self._last_tok_t.pop(rid, None)
                 self._ttft_val.pop(rid, None)
@@ -2144,6 +2480,7 @@ class DecodeEngine:
             t_sub = self._submit_t.pop(rid, None)
             saved = self._resume.pop(rid, None)
             self._seed.pop(rid, None)
+            self._session.pop(rid, None)
             self._trace_ctx.pop(rid, None)
             self._ttft_origin.pop(rid, None)
             self._last_tok_t.pop(rid, None)
@@ -2245,6 +2582,7 @@ class DecodeEngine:
                 needed = -(-(nxt_prompt.size + nxt_max_new
                              + self._slack) // bsz)
                 hits = []
+                promos = []
                 if (self._kv_cache is not None
                         and nxt_rid not in self._prefilled_kv):
                     # cached full blocks need no allocation: the slot's
@@ -2252,7 +2590,15 @@ class DecodeEngine:
                     walk_keys, _ = self._chain_keys_for(nxt_rid,
                                                         nxt_prompt)
                     hits = self._kv_cache.match_chain(walk_keys)
-                    if hits:
+                    # HBM miss != re-prefill: the walk falls through to
+                    # the spill tiers / session store. Promoted blocks
+                    # DO allocate (they install into private blocks),
+                    # so they don't change `needed` below — they trade
+                    # the remainder's prefill FLOPs, not its HBM.
+                    promos = self._tier_walk(
+                        nxt_rid, walk_keys[len(hits):], len(hits),
+                        allow_lossy=self._lossy_promote)
+                    if hits or promos:
                         # longest registered match still wins: when the
                         # pinned ROW covers more than the block chain
                         # (a sub-block tail, or a partially pinned
@@ -2267,10 +2613,10 @@ class DecodeEngine:
                         # sub-block tail's worth of reuse.
                         reg = self._match_prefix(nxt_prompt)
                         if (reg is not None and int(reg[0].size)
-                                > len(hits) * bsz
+                                > (len(hits) + len(promos)) * bsz
                                 and needed <= self.paged[0] - 1
                                 - self._kv_cache.pinned_count()):
-                            hits = []
+                            hits, promos = [], []
                 avail = len(self._free_block_ids)
                 if self._kv_cache is not None:
                     # parked (zero-ref) cached blocks are reclaimable —
@@ -2292,8 +2638,21 @@ class DecodeEngine:
                 for e in hits:
                     self._kv_cache.acquire(e)
                 self._slot_cached[slot] = list(hits)
+                # demotions this allocation triggers flush as ONE
+                # kv_demote event (per-block events would flood the
+                # recorder's per-rid cap on a large allocation)
+                self._demote_accum = {}
                 blocks = [self._alloc_block()
                           for _ in range(needed - len(hits))]
+                accum, self._demote_accum = self._demote_accum, None
+                demoted = self._flush_demotions(accum)
+                if demoted:
+                    self.recorder.record(nxt_rid, "kv_demote",
+                                         blocks=demoted)
+                    emit_event("serving.kv_demote", rid=nxt_rid,
+                               blocks=demoted)
+                if promos:
+                    self._slot_promos[slot] = promos
                 self._slot_blocks[slot] = blocks
                 self._tables[slot, :] = 0      # unused entries -> scratch
                 self._tables[slot, :needed] = (
@@ -2485,11 +2844,13 @@ class DecodeEngine:
             # the resume prompt differs from the one this rid's memo
             # hashed — a stale memo would walk the wrong chain
             self._chain_memo = None
+        if self._promo_memo is not None and self._promo_memo[0] == rid:
+            self._promo_memo = None
         self._resume[rid] = {"outputs": outputs, "preempts": preempts}
         self._queue.appendleft(QueuedRequest(
             rid, seq, remaining, float(self._temp[slot]),
             int(self._topk[slot]), float(self._topp[slot]), tenant,
-            priority))
+            priority, session=self._session.get(rid)))
         self._queued_tokens += int(seq.size)
         self._m_preemptions.inc()
         if self.qos is not None:
@@ -2515,6 +2876,11 @@ class DecodeEngine:
             # computed under other weights — parking it under the
             # CURRENT version's chain keys would serve stale state to
             # a post-swap admission. Free instead of park.
+            return 0
+        if self._slot_lossy[slot]:
+            # lossy-tainted slot (admitted over a dequantized promoted
+            # block): same parity rule as _insert_full_blocks — free,
+            # never park under chain keys
             return 0
         from .models.block_cache import chain_keys
 
@@ -2544,6 +2910,8 @@ class DecodeEngine:
         self._slot_priority[slot] = 0
         self._slot_wv[slot] = 0
         self._slot_seed[slot] = -1
+        self._slot_lossy[slot] = False
+        self._slot_promos.pop(slot, None)
 
     def _admit_prefill(self, rid: int, slot: int, prompt: np.ndarray,
                        temp: float, topk: int, topp: float) -> int:
@@ -2617,19 +2985,39 @@ class DecodeEngine:
                                           install_row_paged)
 
         cache, bs = self._kv_cache, self._kv_cache_bs
-        hits = self._slot_cached[slot]
-        j = len(hits)
+        # COUNT of device hits, not the list: _install_promotions
+        # appends the promoted entries to _slot_cached[slot] (they are
+        # cache-registered, slot-referenced blocks from then on), so
+        # the live list grows past the device-hit prefix
+        nhits = len(self._slot_cached[slot])
+        promos = self._slot_promos.pop(slot, [])
         walk_keys, _ = self._chain_keys_for(rid, prompt)
         nprefill = -(-prompt.size // bs)
+        if promos:
+            # spilled/session blocks claimed by _admit's tier walk:
+            # one host->device copy each into the already-allocated
+            # table entries just past the device hits, then the chain
+            # continues exactly as if they had been device hits
+            self._install_promotions(rid, slot, nhits, promos)
+        j = nhits + len(promos)
+        if (self._session.get(rid) is not None
+                and self._m_session_hits is not None and walk_keys):
+            # resume observability: did this session-tagged admission
+            # find ANY of its chain (device, spill, or session tier)?
+            (self._m_session_hits if j > 0
+             else self._m_session_misses).inc()
         if j > 0:
             reused = j * bs
             self._m_kv_hits.inc()
             self._m_prefix_tokens.inc(reused)
             cache.record_walk(j, True)
             self.recorder.record(rid, "kv_cache_hit", blocks=j,
-                                 tokens_reused=reused)
+                                 tokens_reused=reused,
+                                 promoted=len(promos))
             row = gather_blocks_to_row(
-                self.pool, [e.payload for e in hits], self.max_len)
+                self.pool,
+                [int(b) for b in self._tables[slot, :j]],
+                self.max_len)
             logits, row = self._extend_remainder(row, prompt, reused)
         else:
             # classic path, registered row included (longest match
@@ -2672,6 +3060,50 @@ class DecodeEngine:
             prefix_tokens=int(reused),
             duration_s=round(time.monotonic() - self._admit_t[rid], 6))
         return t0
+
+    def _install_promotions(self, rid: int, slot: int, start: int,
+                            promos: List) -> None:
+        """Install tier-walk promotions into the slot's table entries
+        ``start..start+len(promos)`` (private blocks _admit allocated):
+        one batched host->device scatter, then per block — LOSSLESS
+        payloads re-register under their chain key (device copy is
+        exact content again; the next same-chain admission device-hits)
+        while LOSSY ones stay private and taint the slot (parity rule:
+        nothing computed over dequantized KV may ever enter the cache,
+        park, or persist)."""
+        from .models.paged_decode import install_pool_blocks
+
+        cache = self._kv_cache
+        bids = [int(self._tables[slot, start + i])
+                for i in range(len(promos))]
+        self.pool = install_pool_blocks(
+            self.pool, [blk.payload for blk, _ in promos], bids)
+        tiers: Dict[str, int] = {}
+        for (blk, src), bid in zip(promos, bids):
+            tiers[src] = tiers.get(src, 0) + 1
+            if self._m_spill_promote is not None:
+                self._m_spill_promote.labels(tier=src).inc()
+            if blk.lossy:
+                self._slot_lossy[slot] = True
+            elif cache.get(blk.key) is None:
+                # guard against a duplicate registered between walk
+                # and install (another admission prefilled the same
+                # chain): insert raises on duplicates — keep ours
+                # private then, mirroring _insert_full_blocks
+                entry = cache.insert(blk.key, bid, blk.tokens,
+                                     acquire=True)
+                self._slot_blocks[slot].remove(bid)
+                self._slot_cached[slot].append(entry)
+            if self._kv_spill is not None:
+                # device is canonical again: drop the host copy
+                # (re-eviction re-demotes); storage copies stay as
+                # the cross-replica durability layer
+                self._kv_spill.consumed(blk.key)
+        self._promo_memo = None
+        self.recorder.record(rid, "kv_promote", blocks=len(promos),
+                             tiers=tiers)
+        emit_event("serving.kv_promote", rid=rid, blocks=len(promos),
+                   tiers=tiers)
 
     def _install_draft_row(self, slot: int, prompt: np.ndarray,
                            entry=...) -> None:
@@ -2822,6 +3254,13 @@ class DecodeEngine:
         counter/marker."""
         rid = self._rid[slot]
         self._done[rid] = self._outputs.pop(rid)
+        sid = self._session.pop(rid, None)
+        if sid is not None:
+            # persist the conversation's tail KV BEFORE the blocks
+            # free: the next request for this session admits as a
+            # chain hit, on this replica (parked blocks) or any other
+            # sharing the store (persisted blocks)
+            self._persist_session(slot, rid, sid)
         self._rid[slot] = None
         self._release_blocks(slot)
         self._clear_slot_meta(slot)
@@ -2857,6 +3296,60 @@ class DecodeEngine:
             total_s=(None if t_sub is None else round(now - t_sub, 6)),
             **extra)
         return rid
+
+    def _persist_session(self, slot: int, rid: int, sid: str) -> None:
+        """Write the retiring slot's full KV blocks into the session
+        store, keyed by the FINAL sequence's chain (prompt + emitted
+        tokens, current ``weights_version``) — only keys the store
+        doesn't already hold are exported off the pool. The blocks
+        also park locally, so a same-replica follow-up resumes
+        straight off the device cache without touching the store.
+        Paged engines only (blocks export straight off the pool);
+        best-effort — a failed persist costs the next turn a
+        re-prefill, never this request."""
+        store = self._session_store
+        if (store is None or self.paged is None
+                or self._kv_cache is None or self._slot_lossy[slot]
+                or int(self._slot_wv[slot]) != int(self.weights_version)):
+            return
+        prompt = self._slot_prompt[slot]
+        if prompt is None:
+            return
+        from .models.block_cache import chain_keys
+        from .models.paged_decode import export_pool_blocks
+
+        bs = self._kv_cache_bs
+        # the sequence whose KV the slot holds: prompt + tokens emitted
+        # since admission (a resumed request's prompt already folds in
+        # its earlier output — the _preempt_slot convention), truncated
+        # to the last PROCESSED position (the pending token's KV was
+        # never written)
+        seq = np.concatenate(
+            [prompt,
+             np.asarray(self._done[rid][int(self._slot_prior[slot]):],
+                        np.int32)])
+        seq_kv = seq[:int(self._pos[slot]) + 1]
+        nfull = seq_kv.size // bs
+        if nfull == 0:
+            return
+        keys = chain_keys(seq_kv[:nfull * bs], bs, self.weights_version)
+        missing = [i for i, k in enumerate(keys) if not store.has(k)]
+        if missing:
+            payloads = export_pool_blocks(
+                self.pool, [int(self._tables[slot, i]) for i in missing])
+            nbytes = 0
+            for i, payload in zip(missing, payloads):
+                nbytes += store.put_block(keys[i], payload,
+                                          (i + 1) * bs)
+            if self._m_spill_bytes is not None and nbytes:
+                self._m_spill_bytes.labels(tier="session").inc(nbytes)
+        store.note_session(sid, nfull)
+        self.recorder.record(rid, "session_saved", session=sid,
+                             blocks=nfull, new_blocks=len(missing))
+        # park the slot's private full blocks under the same chain:
+        # free same-replica resume, reclaimable under pool pressure
+        # (where eviction now demotes instead of discarding)
+        self._park_slot_blocks(slot, seq_kv)
 
     def _finish(self, slot: int):
         self._retire_slot(slot, "finished")
@@ -2912,6 +3405,25 @@ class DecodeEngine:
             ks = self._kv_cache.stats()
             ks["block_size"] = self._kv_cache_bs
             out["kv_cache"] = ks
+        if self._kv_spill is not None or self._session_store is not None:
+            tiers: Dict[str, Dict] = {}
+            if self._kv_spill is not None:
+                tiers.update(self._kv_spill.stats())
+            if self._session_store is not None:
+                ss = self._session_store.stats()
+                ss["hits"] = int(since_baseline(
+                    self._spill_stat_base, self._m_session_hits))
+                ss["misses"] = int(since_baseline(
+                    self._spill_stat_base, self._m_session_misses))
+                tiers["session"] = ss
+            if self._m_spill_promote is not None:
+                promotions = {
+                    labels[0]: int(child.value)
+                    for labels, child in
+                    self._m_spill_promote.series().items()}
+                if promotions:
+                    tiers["promotions"] = promotions
+            out["kv_tiers"] = tiers
         if self.qos is not None:
             out["preemptions"] = int(
                 self._since_init(self._m_preemptions))

@@ -247,11 +247,16 @@ class ServingServer:
             # it moves a killed replica's generation to a sibling
             self._engine_has_seed = "seed" in submit_params
             self._engine_has_resume = "resume_from" in submit_params
+            # resumable-session tag (tiered KV): persists the trailing
+            # chain at retirement so the next request in the session
+            # admits as a chain hit
+            self._engine_has_session = "session" in submit_params
         except (TypeError, ValueError):
             self._engine_has_deadline = True   # assume the full engine
             self._engine_has_tenant = True
             self._engine_has_seed = True
             self._engine_has_resume = True
+            self._engine_has_session = True
         self._host, self._port = host, int(port)
         self._lock = threading.Lock()          # guards every engine call
         self._cond = threading.Condition(self._lock)
@@ -899,6 +904,11 @@ class ServingServer:
                 raise ValueError("this engine does not support "
                                  "mid-generation resume")
             kwargs["resume_from"] = int(body["resume_from"])
+        if body.get("session") is not None:
+            if not self._engine_has_session:
+                raise ValueError("this engine does not support "
+                                 "resumable sessions")
+            kwargs["session"] = str(body["session"])
         with self._cond:
             if self._draining or self._stop.is_set():
                 raise _HTTPError(503, {"error": "server is draining; "

@@ -74,6 +74,10 @@ class QueuedRequest(NamedTuple):
     top_p: float
     tenant: str
     priority: int
+    #: resumable-session id (:mod:`~elephas_tpu.kvtier`), or ``None``.
+    #: Informational here — the engine keys its live session map by rid;
+    #: carrying it on the queue record keeps preemption requeues whole.
+    session: Optional[str] = None
 
 
 class TenantQoS:

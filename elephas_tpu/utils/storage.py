@@ -70,6 +70,34 @@ class ObjectStore:
     def write_text(self, url: str, text: str):
         raise NotImplementedError
 
+    # binary object I/O (KV spill tier payloads): default stages through
+    # a temp file over put_file/get_file so every store — including
+    # user-registered ones predating these methods — gets it for free;
+    # stores with a direct path (LocalMirrorStore) override
+    def read_bytes(self, url: str) -> bytes:
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".bin",
+                                         delete=False) as f:
+            tmp = f.name
+        try:
+            self.get_file(url, tmp)
+            return Path(tmp).read_bytes()
+        finally:
+            Path(tmp).unlink(missing_ok=True)
+
+    def write_bytes(self, url: str, data: bytes):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".bin",
+                                         delete=False) as f:
+            f.write(data)
+            tmp = f.name
+        try:
+            self.put_file(tmp, url)
+        finally:
+            Path(tmp).unlink(missing_ok=True)
+
 
 class CliObjectStore(ObjectStore):
     """Object store backed by a copy CLI (``gsutil`` / ``aws s3``).
@@ -208,6 +236,14 @@ class LocalMirrorStore(ObjectStore):
         dest = self._path(url)
         dest.parent.mkdir(parents=True, exist_ok=True)
         dest.write_text(text)
+
+    def read_bytes(self, url: str) -> bytes:
+        return self._path(url).read_bytes()
+
+    def write_bytes(self, url: str, data: bytes):
+        dest = self._path(url)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(data)
 
 
 _REGISTRY: Dict[str, ObjectStore] = {}
