@@ -319,9 +319,19 @@ class PrefillWorker:
             # appends serialize with wait_samples(): iterating a deque
             # another thread is appending to raises RuntimeError
             self.wait_window.append(wait)
+        if job.ctx is not None:
+            # the tier's queue time, retroactively, on the request's
+            # tree — otherwise it reads as unattributed TTFT
+            from ..obs.spans import add_span
+            add_span("disagg.prefill_queue", time.time() - wait, wait,
+                     stage="admission_wait", ctx=job.ctx,
+                     worker=self.name)
         from ..obs.context import use_context
+        from ..obs.spans import start_span
 
-        with use_context(job.ctx):
+        with use_context(job.ctx), \
+                start_span("disagg.prefill", stage="prefill",
+                           worker=self.name):
             fault_site("disagg.prefill")
             out = self.engine.export_prefill(
                 job.prompt, temperature=job.temperature,
@@ -379,13 +389,19 @@ class PrefillWorker:
             try:
                 if job.abandoned:
                     continue       # finally still clears the slot
-                from ..obs.context import use_context
+                from ..obs.context import current_context, use_context
+                from ..obs.spans import start_span
 
-                with use_context(job.ctx):
+                with use_context(job.ctx), \
+                        start_span("disagg.ship", stage="kv_wire",
+                                   worker=self.name):
                     fault_site("disagg.ship")
+                    # forward the SHIP SPAN's context (not the job's):
+                    # the receiver-side install then parents to this
+                    # wire hop on the request's tree
                     nbytes = self.shipper.ship(
                         job.target, meta, kv_blocks, quant=self.quant,
-                        ctx=job.ctx)
+                        ctx=current_context())
                 self._m_prefills.inc()
                 emit_event("disagg.prefill_shipped", rid=job.rid,
                            worker=self.name, bytes=nbytes,

@@ -108,6 +108,7 @@ from urllib.parse import parse_qs, urlparse
 from ..obs.context import (current_context, new_root, parse_traceparent,
                            use_context)
 from ..obs.events import emit as emit_event
+from ..obs.spans import start_span
 from ..obs.metrics import (MetricsRegistry, counter_baseline,
                            observe_scrape, percentile, since_baseline)
 from ..serving_http import QuietThreadingHTTPServer, retry_after_header
@@ -121,8 +122,8 @@ __all__ = ["FleetRouter"]
 #: route label domain for the fleet_http_* metrics (unknown paths fold
 #: into "other" so a scanner cannot grow label cardinality)
 _KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/slo",
-                 "/v1/result", "/v1/generate", "/v1/submit",
-                 "/v1/cancel", "/v1/requests/:id/trace")
+                 "/debug/traces", "/v1/result", "/v1/generate",
+                 "/v1/submit", "/v1/cancel", "/v1/requests/:id/trace")
 
 _TRACE_ROUTE_RE = re.compile(r"^/v1/requests/(\d+)/trace$")
 
@@ -664,7 +665,15 @@ class FleetRouter:
                 budget.start()
                 started = True
             try:
-                result = attempt(url, how)
+                # every attempt is its own child span (retries and
+                # hedge arms get DISTINCT span ids under one trace);
+                # the span's context is active while the proxy builds
+                # its headers, so the replica's tree parents to THIS
+                # attempt, and router-side time not covered by a
+                # deeper replica span bills to edge_queue
+                with start_span("fleet.attempt", stage="edge_queue",
+                                replica=url, policy=how, op=stage):
+                    result = attempt(url, how)
             except urllib.error.HTTPError as err:
                 detail = _error_payload(err)
                 # any wire-level answer proves the peer reachable —
@@ -737,7 +746,12 @@ class FleetRouter:
             fid = self._next_fid
             self._next_fid += 1
             self._records[fid] = {"url": url, "rid": int(backend_rid),
-                                  "body": body, "orphan": False}
+                                  "body": body, "orphan": False,
+                                  # the submitter's trace context: a
+                                  # dead-replica resubmission runs on a
+                                  # background thread and must rejoin
+                                  # the request's tree
+                                  "ctx": current_context()}
             while len(self._records) > self.max_tracked:
                 self._records.popitem(last=False)    # abandoned submits
             self._trace_map[fid] = (url, int(backend_rid))
@@ -781,6 +795,7 @@ class FleetRouter:
                 return rec is not None and not rec["orphan"]
             rec["rerouting"] = True
             body = rec["body"]
+            ctx = rec.get("ctx")
         deadline = self._deadline_of(body)
         if deadline is not None and time.monotonic() >= deadline:
             # expired while orphaned: do NOT resubmit — the next result
@@ -791,8 +806,14 @@ class FleetRouter:
                     rec["rerouting"] = False
             return False
         try:
-            url, payload = self._dispatch("/v1/submit", body,
-                                          stage="reroute")
+            # restore the submit-time context on this background
+            # thread: the resubmission's attempt span (second home)
+            # lands on the SAME tree as the original dispatch's
+            with use_context(ctx), \
+                    start_span("fleet.orphan_resubmit",
+                               stage="edge_queue", fid=fid):
+                url, payload = self._dispatch("/v1/submit", body,
+                                              stage="reroute")
         except _HTTPError:
             with self._records_lock:
                 rec = self._records.get(fid)
@@ -984,6 +1005,10 @@ class FleetRouter:
         outcomes: "queue.Queue" = queue.Queue()
         stop = threading.Event()
         arms: List[Dict] = []
+        # arm threads do not inherit the handler's contextvars:
+        # capture the request context so their polls — and a dead-
+        # replica resubmission — stay on the request's trace
+        hctx = current_context()
 
         def run_arm(arm):
             # cadence backs off toward a 50 ms ceiling: a long
@@ -991,16 +1016,17 @@ class FleetRouter:
             # poll takes the serving lock) for its whole life — the
             # fine cadence only matters around the finish line
             interval = self.hedge_poll_s
-            while not stop.is_set():
-                others = [a["url"] for a in arms if a is not arm]
-                status, out = self._poll_arm(arm, body, others)
-                if status != "pending":
-                    outcomes.put((arm, status, out))
-                    return
-                if stop.wait(interval):
-                    return
-                interval = min(interval * 1.25,
-                               max(self.hedge_poll_s, 0.05))
+            with use_context(hctx):
+                while not stop.is_set():
+                    others = [a["url"] for a in arms if a is not arm]
+                    status, out = self._poll_arm(arm, body, others)
+                    if status != "pending":
+                        outcomes.put((arm, status, out))
+                        return
+                    if stop.wait(interval):
+                        return
+                    interval = min(interval * 1.25,
+                                   max(self.hedge_poll_s, 0.05))
 
         def launch(arm):
             arms.append(arm)
@@ -1259,6 +1285,67 @@ class FleetRouter:
             "streams_journaled": len(self._journal),
         }
 
+    def debug_traces(self, limit: int = 32) -> Dict:
+        """``GET /debug/traces``: fleet-wide span-tree surface. Merges
+        the router's own tail-retained traces with every ready
+        replica's (same endpoint, proxied), deduplicating by trace —
+        and by span id within a trace, since in-process replicas share
+        one default span store — then recomputes each merged tree's
+        critical-path decomposition and the fleet percentile
+        attribution ("62% of p99 TTFT is spill promotion") over all of
+        them. Mirrors ``/slo``'s aggregate-at-the-router pattern."""
+        from ..obs.critical_path import aggregate, decompose
+        from ..obs.spans import Span, default_span_store
+
+        limit = max(1, min(int(limit), 256))
+        merged: "OrderedDict[str, Dict]" = OrderedDict()
+        for rec in default_span_store().retained(limit=limit):
+            rec["sources"] = ["router"]
+            merged[rec["trace_id"]] = rec
+        replicas_read = 0
+        for url in self.membership.ready_urls():
+            try:
+                payload = self._get_replica(
+                    url, f"/debug/traces?limit={limit}")
+            except Exception:  # noqa: BLE001 — a replica that cannot
+                continue       # answer must not fail the fleet surface
+            replicas_read += 1
+            for rec in payload.get("traces", ()):
+                tid = rec.get("trace_id")
+                if not tid:
+                    continue
+                prev = merged.get(tid)
+                if prev is None:
+                    rec.pop("critical_path", None)
+                    rec["sources"] = [url]
+                    merged[tid] = rec
+                else:
+                    seen = {s.get("span_id") for s in prev["spans"]}
+                    prev["spans"].extend(
+                        s for s in rec.get("spans", ())
+                        if s.get("span_id") not in seen)
+                    if url not in prev["sources"]:
+                        prev["sources"].append(url)
+                    for k in ("latency_s", "ttft_s", "reason"):
+                        if prev.get(k) is None and rec.get(k) is not None:
+                            prev[k] = rec[k]
+        decomps = []
+        for rec in merged.values():
+            d = decompose(
+                [Span.from_dict(s) for s in rec.get("spans", ())],
+                ttft_s=rec.get("ttft_s"), total_s=rec.get("latency_s"))
+            rec["critical_path"] = d
+            if d is not None:
+                decomps.append(d)
+        return {
+            "traces": list(merged.values()),
+            "aggregation": {
+                "ttft": aggregate(decomps, window="ttft"),
+                "total": aggregate(decomps, window="total"),
+            },
+            "replicas_read": replicas_read,
+        }
+
     # ------------------------------------------------------------ handler
     def _make_handler(self):
         router = self
@@ -1339,6 +1426,13 @@ class FleetRouter:
                     # surface the autoscaler, the canary controller,
                     # and an operator all read
                     self._json(200, router.membership.slo_summary())
+                elif url.path == "/debug/traces":
+                    limit = parse_qs(url.query).get("limit")
+                    try:
+                        limit = int(limit[0]) if limit else 32
+                    except ValueError:
+                        limit = 32
+                    self._json(200, router.debug_traces(limit=limit))
                 elif url.path == "/metrics":
                     t0 = time.perf_counter()
                     body = router.registry.render().encode()

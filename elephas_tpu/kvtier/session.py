@@ -28,6 +28,7 @@ suffix of its chain is still resolvable.
 """
 from typing import Dict, Optional
 
+from ..obs.spans import start_span
 from .tiers import HostTier, SpilledBlock, StorageTier
 
 __all__ = ["SessionStore"]
@@ -59,27 +60,34 @@ class SessionStore:
 
     def put_block(self, key: bytes, payload: Dict, tokens: int) -> int:
         """Persist one exact full block; returns payload bytes stored
-        (0 if the key was already present)."""
-        if self._host is not None:
-            if self._host.has(key):
-                return 0
-            block = SpilledBlock(key, payload, tokens, lossy=False)
-            self._host.put(block)
-            self.saves += 1
-            return block.nbytes
-        written = self._storage.put(key, payload, tokens)
-        if written:
-            self.saves += 1
-        return written
+        (0 if the key was already present). Runs as a ``session_save``
+        span under the retiring request's trace context (the engine
+        installs it around session persistence)."""
+        with start_span("kvtier.session_put", stage="session_save",
+                        tokens=int(tokens)):
+            if self._host is not None:
+                if self._host.has(key):
+                    return 0
+                block = SpilledBlock(key, payload, tokens, lossy=False)
+                self._host.put(block)
+                self.saves += 1
+                return block.nbytes
+            written = self._storage.put(key, payload, tokens)
+            if written:
+                self.saves += 1
+            return written
 
     def get_block(self, key: bytes) -> Optional[SpilledBlock]:
-        if self._host is not None:
-            block = self._host.get(key)
-        else:
-            block = self._storage.get(key)
-        if block is not None:
-            self.loads += 1
-        return block
+        """A chain walk's session read — a ``session_restore`` span
+        under the admitting request's trace context."""
+        with start_span("kvtier.session_get", stage="session_restore"):
+            if self._host is not None:
+                block = self._host.get(key)
+            else:
+                block = self._storage.get(key)
+            if block is not None:
+                self.loads += 1
+            return block
 
     def note_session(self, session_id: str, blocks: int) -> None:
         """Bookkeeping only — how long the session's chain was at its

@@ -11,6 +11,7 @@ one, never from another lossy copy.
 import threading
 from typing import Dict, Optional
 
+from ..obs.spans import start_span
 from .tiers import HostTier, SpilledBlock, StorageTier
 
 __all__ = ["TieredSpill"]
@@ -81,27 +82,38 @@ class TieredSpill:
     def demote(self, key: bytes, payload: Dict, tokens: int) -> None:
         """Catch an evicted block. ``payload`` must be EXACT
         (``{layer: (k, v)}`` f32/bf16 host arrays) — lossy data never
-        enters through this path."""
+        enters through this path.
+
+        Runs as a ``spill_demote`` span when the caller installed the
+        owning request's trace context (the engine's admission loop
+        does) — a no-op otherwise, so background demotions stay free."""
         block = SpilledBlock(key, payload, int(tokens), lossy=False)
-        with self._lock:
-            self._count_demotion("host", block.nbytes)
-            self.host.put(block)
+        with start_span("kvtier.demote", stage="spill_demote",
+                        tokens=int(tokens)):
+            with self._lock:
+                self._count_demotion("host", block.nbytes)
+                self.host.put(block)
 
     # -- promotion --------------------------------------------------------
     def lookup(self, key: bytes):
         """Fall-through read: host first (exact, free), then storage
         (possibly lossy). Returns ``(block, tier_name)`` or ``None``.
         Does NOT remove the block — the engine calls :meth:`consumed`
-        once the promotion actually installed."""
-        with self._lock:
-            block = self.host.get(key)
-            if block is not None:
-                return block, "host"
-            if self.storage is not None:
-                block = self.storage.get(key)
+        once the promotion actually installed.
+
+        The read runs as a ``spill_promote`` span under the admitting
+        request's trace context (the storage GET is the expensive half
+        of a promotion; the engine's batched install is the other)."""
+        with start_span("kvtier.lookup", stage="spill_promote"):
+            with self._lock:
+                block = self.host.get(key)
                 if block is not None:
-                    return block, "storage"
-        return None
+                    return block, "host"
+                if self.storage is not None:
+                    block = self.storage.get(key)
+                    if block is not None:
+                        return block, "storage"
+            return None
 
     def has(self, key: bytes) -> bool:
         with self._lock:

@@ -9,6 +9,7 @@ SLO/burn-rate plane (see :mod:`.metrics`, :mod:`.trace`,
 from .context import (TRACEPARENT_LEN, TraceContext, current_context,
                       current_trace_id, new_root, parse_traceparent,
                       reset_context, set_context, use_context)
+from .critical_path import STAGES, aggregate, build_tree, decompose
 from .events import (EVENT_RING_SIZE, EventLog, FlightRecorder,
                      clear_events, default_event_log, emit, recent_events)
 from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS,
@@ -17,6 +18,9 @@ from .metrics import (DEFAULT_BUCKETS, MAX_LABEL_SETS,
                       percentile)
 from .profiler import PHASES, LoopProfiler
 from .slo import SLOObjective, SLOTracker
+from .spans import (Span, SpanStore, add_span, current_span_id,
+                    default_span_store, set_span_plane_enabled,
+                    span_plane_enabled, start_span)
 from .trace import (RING_SIZE, SPAN_METRIC, clear_slow_spans,
                     recent_slow_spans, record_span,
                     set_slow_span_threshold, span, span_if_counted)
@@ -34,4 +38,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "FlightRecorder", "default_event_log", "emit",
            "recent_events", "clear_events", "EVENT_RING_SIZE",
            "LoopProfiler", "PHASES", "EngineWatchdog", "SLOObjective",
-           "SLOTracker"]
+           "SLOTracker", "Span", "SpanStore", "add_span",
+           "current_span_id", "default_span_store",
+           "set_span_plane_enabled", "span_plane_enabled", "start_span",
+           "STAGES", "aggregate", "build_tree", "decompose"]

@@ -55,12 +55,18 @@ class TraceContext:
     byte (bit 0 = sampled; this layer records unconditionally and keeps
     the flags only to round-trip them)."""
 
-    __slots__ = ("trace_id", "span_id", "flags")
+    __slots__ = ("trace_id", "span_id", "flags", "parent_id")
 
-    def __init__(self, trace_id: str, span_id: str, flags: int = 1):
+    def __init__(self, trace_id: str, span_id: str, flags: int = 1,
+                 parent_id: Optional[str] = None):
         self.trace_id = trace_id
         self.span_id = span_id
         self.flags = int(flags) & 0xFF
+        #: span id this hop descends from (None at a root or across a
+        #: wire — the remote side's parent is the traceparent's span_id
+        #: itself). Not part of the header and excluded from equality;
+        #: ``obs.spans`` uses it to parent-link span trees.
+        self.parent_id = parent_id
 
     def to_traceparent(self) -> str:
         """The W3C header value (version 00)."""
@@ -68,8 +74,10 @@ class TraceContext:
 
     def child(self) -> "TraceContext":
         """Same trace, fresh span id — the hop a component makes before
-        forwarding the context over a wire it owns."""
-        return TraceContext(self.trace_id, os.urandom(8).hex(), self.flags)
+        forwarding the context over a wire it owns. The child remembers
+        this context's span id as its ``parent_id``."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(), self.flags,
+                            parent_id=self.span_id)
 
     def __eq__(self, other):
         return (isinstance(other, TraceContext)

@@ -213,6 +213,13 @@ class FlightRecorder:
     trace routes read timelines without that lock by design.
     """
 
+    #: timeline events that mean the request's lifecycle ended — an
+    #: evicted entry whose LAST event is one of these was "retired",
+    #: anything else was still in flight ("active") when truncated
+    TERMINAL_EVENTS = frozenset(
+        {"finished", "expired", "timed_out", "cancelled", "failed",
+         "shed", "resumed_elsewhere"})
+
     def __init__(self, max_requests: int = 256, max_events: int = 64):
         if max_requests < 1 or max_events < 1:
             raise ValueError("max_requests and max_events must be >= 1")
@@ -220,6 +227,28 @@ class FlightRecorder:
         self.max_events = int(max_events)
         self._lock = threading.Lock()
         self._entries: "OrderedDict[int, Dict]" = OrderedDict()
+        #: eviction counts by state ("active" | "retired") — silent
+        #: truncation otherwise reads as "request never existed"
+        self.evictions: Dict[str, int] = {"active": 0, "retired": 0}
+        self._eviction_counters: Dict[str, object] = {}
+
+    def bind_eviction_counter(self, family) -> None:
+        """Bind a ``flight_recorder_evictions_total`` counter family
+        (labelled by ``state``); every future eviction increments the
+        matching child alongside the local tally."""
+        self._eviction_counters = {
+            state: family.labels(state=state)
+            for state in ("active", "retired")}
+
+    def _evict_oldest_locked(self) -> None:
+        _, entry = self._entries.popitem(last=False)
+        events = entry["events"]
+        last = events[-1]["event"] if events else None
+        state = "retired" if last in self.TERMINAL_EVENTS else "active"
+        self.evictions[state] += 1
+        counter = self._eviction_counters.get(state)
+        if counter is not None:
+            counter.inc()
 
     def start(self, rid: int, trace_id: Optional[str] = None,
               **attrs) -> None:
@@ -235,7 +264,7 @@ class FlightRecorder:
                                   "events": deque(maxlen=self.max_events)}
             self._entries.move_to_end(rid)
             while len(self._entries) > self.max_requests:
-                self._entries.popitem(last=False)
+                self._evict_oldest_locked()
         self.record(rid, "queued", **attrs)
 
     def record(self, rid: int, event: str, **attrs) -> None:

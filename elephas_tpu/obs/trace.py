@@ -83,6 +83,12 @@ def record_span(name: str, duration_s: float, histogram=None,
         entry.update(attrs)
         with _ring_lock:
             _ring.append(entry)
+    if current_trace_id() is not None:
+        # a flat span recorded under a request context also lands on
+        # that request's tree (obs.spans no-ops when the span plane is
+        # off or no context is active)
+        from .spans import add_span
+        add_span(name, time.time() - duration_s, duration_s, **attrs)
 
 
 @contextlib.contextmanager

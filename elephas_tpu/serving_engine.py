@@ -109,6 +109,7 @@ from .obs.events import emit as emit_event
 from .obs.metrics import (MetricsRegistry, counter_baseline,
                           since_baseline)
 from .obs.profiler import LoopProfiler
+from .obs.spans import add_span, default_span_store, start_span
 from .obs.trace import span_if_counted
 from .serving_qos import (DEFAULT_TENANT, FairQueue, QueuedRequest,
                           TenantQoS)
@@ -537,6 +538,13 @@ class DecodeEngine:
             "serving_requests_timed_out_total",
             "deadline passed mid-decode — partial output returned"
             ).labels()
+        # flight-recorder ring evictions, split by whether the evicted
+        # request was still in flight: a truncated ACTIVE timeline is
+        # the one that reads as "request never existed"
+        self.recorder.bind_eviction_counter(reg.counter(
+            "flight_recorder_evictions_total",
+            "flight-recorder timelines evicted by the ring bound, "
+            "by request state at eviction", labels=("state",)))
         # gauge callbacks hold a WEAK reference: with an injected
         # long-lived registry, a discarded engine (weight reload) must
         # not be pinned — with its params — by its own scrape callbacks
@@ -559,8 +567,8 @@ class DecodeEngine:
             ).labels()
         self._m_request_latency = reg.histogram(
             "serving_request_latency_seconds",
-            "submit-to-retirement wall time per finished request"
-            ).labels()
+            "submit-to-retirement wall time per finished request",
+            exemplars=True).labels()
         # labeled by serving tier: a disaggregated deployment's headline
         # claim — decode-tier queue wait free of prefill head-of-line
         # blocking — must be readable straight off /metrics, next to the
@@ -2180,7 +2188,13 @@ class DecodeEngine:
         # the id now, and _admit restores the context per request
         ctx = current_context()
         if ctx is not None:
-            self._trace_ctx[rid] = ctx
+            # the request's tree root is a CHILD of the submitter's
+            # span: every span this engine records for rid (admission
+            # wait, spill promote/demote, prefill, decode) parents to
+            # the request-root span id, and the root span itself is
+            # materialized retroactively at retirement
+            self._trace_ctx[rid] = ctx.child()
+            ctx = self._trace_ctx[rid]
         self.recorder.start(rid,
                             trace_id=None if ctx is None else ctx.trace_id,
                             prompt_tokens=int(prompt.size),
@@ -2417,7 +2431,12 @@ class DecodeEngine:
             self._queued_tokens -= int(item.prompt.size)
             self._submit_t.pop(rid, None)
             self._deadline.pop(rid, None)
-            self._trace_ctx.pop(rid, None)
+            cctx = self._trace_ctx.pop(rid, None)
+            if cctx is not None:
+                # close the tree (client-initiated, not an SLO story:
+                # retained only if it ranks slowest-k, i.e. never
+                # without a latency — this is the store's GC path)
+                default_span_store().finish(cctx.trace_id)
             self._prefilled_kv.pop(rid, None)
             self._resume.pop(rid, None)
             self._seed.pop(rid, None)
@@ -2448,7 +2467,9 @@ class DecodeEngine:
                 self._submit_t.pop(rid, None)
                 self._admit_t.pop(rid, None)
                 self._deadline.pop(rid, None)
-                self._trace_ctx.pop(rid, None)
+                cctx = self._trace_ctx.pop(rid, None)
+                if cctx is not None:
+                    default_span_store().finish(cctx.trace_id)
                 self._seed.pop(rid, None)
                 self._session.pop(rid, None)
                 self._ttft_origin.pop(rid, None)
@@ -2481,7 +2502,15 @@ class DecodeEngine:
             saved = self._resume.pop(rid, None)
             self._seed.pop(rid, None)
             self._session.pop(rid, None)
-            self._trace_ctx.pop(rid, None)
+            ectx = self._trace_ctx.pop(rid, None)
+            if ectx is not None:
+                # a deadline miss is exactly the SLO-violating trace
+                # the tail-based store exists to keep
+                default_span_store().finish(
+                    ectx.trace_id,
+                    latency_s=(None if t_sub is None
+                               else time.monotonic() - t_sub),
+                    violated=True)
             self._ttft_origin.pop(rid, None)
             self._last_tok_t.pop(rid, None)
             self._ttft_val.pop(rid, None)
@@ -2594,10 +2623,14 @@ class DecodeEngine:
                     # the spill tiers / session store. Promoted blocks
                     # DO allocate (they install into private blocks),
                     # so they don't change `needed` below — they trade
-                    # the remainder's prefill FLOPs, not its HBM.
-                    promos = self._tier_walk(
-                        nxt_rid, walk_keys[len(hits):], len(hits),
-                        allow_lossy=self._lossy_promote)
+                    # the remainder's prefill FLOPs, not its HBM. The
+                    # walk's tier reads (a storage GET per key) run
+                    # under the candidate's trace context so the spill
+                    # layer's spans land on its tree.
+                    with use_context(self._trace_ctx.get(nxt_rid)):
+                        promos = self._tier_walk(
+                            nxt_rid, walk_keys[len(hits):], len(hits),
+                            allow_lossy=self._lossy_promote)
                     if hits or promos:
                         # longest registered match still wins: when the
                         # pinned ROW covers more than the block chain
@@ -2645,7 +2678,16 @@ class DecodeEngine:
                 blocks = [self._alloc_block()
                           for _ in range(needed - len(hits))]
                 accum, self._demote_accum = self._demote_accum, None
-                demoted = self._flush_demotions(accum)
+                if accum.get("staged") or accum.get("blocks"):
+                    # demotions bill to the ADMITTING request (its
+                    # allocation forced them): flush under its context
+                    # as a spill_demote stage span
+                    with use_context(self._trace_ctx.get(nxt_rid)), \
+                            start_span("serving.kv_demote",
+                                       stage="spill_demote"):
+                        demoted = self._flush_demotions(accum)
+                else:
+                    demoted = self._flush_demotions(accum)
                 if demoted:
                     self.recorder.record(nxt_rid, "kv_demote",
                                          blocks=demoted)
@@ -2679,6 +2721,13 @@ class DecodeEngine:
                 # trace reader can replay THIS request's exact output
                 **({"seed": self._seed[rid]}
                    if rid in self._seed else {}))
+            if t_sub is not None and rid in self._trace_ctx:
+                # queue time as a retroactive stage span: monotonic
+                # wait projected back from the current wall clock
+                wait_s = self._admit_t[rid] - t_sub
+                add_span("serving.admission_wait", time.time() - wait_s,
+                         wait_s, stage="admission_wait",
+                         ctx=self._trace_ctx[rid])
             # per-request context restore: this loop runs on the engine
             # thread, but prefill (and any span/fault/event it emits)
             # belongs to the request whose context was captured at
@@ -2715,7 +2764,9 @@ class DecodeEngine:
                     # a Q8 frame's dequantized KV is content-addressed
                     # by TOKENS — letting a later LOCAL admission hit
                     # lossy blocks would break its cache-off parity
-                    with self._psec("prefill"):
+                    with self._psec("prefill"), \
+                            start_span("serving.kv_install",
+                                       stage="prefill"):
                         t0 = self._install_prefilled(slot, prompt, pre)
                     self.recorder.record(
                         rid, "kv_install",
@@ -2723,7 +2774,9 @@ class DecodeEngine:
                         duration_s=round(
                             time.monotonic() - self._admit_t[rid], 6))
                 else:
-                    with self._psec("prefill"):
+                    with self._psec("prefill"), \
+                            start_span("serving.prefill",
+                                       stage="prefill"):
                         t0 = self._admit_prefill(rid, slot, prompt,
                                                  temp, topk, topp)
             self._rid[slot] = rid
@@ -3074,31 +3127,33 @@ class DecodeEngine:
         from .models.paged_decode import install_pool_blocks
 
         cache = self._kv_cache
-        bids = [int(self._tables[slot, start + i])
-                for i in range(len(promos))]
-        self.pool = install_pool_blocks(
-            self.pool, [blk.payload for blk, _ in promos], bids)
-        tiers: Dict[str, int] = {}
-        for (blk, src), bid in zip(promos, bids):
-            tiers[src] = tiers.get(src, 0) + 1
-            if self._m_spill_promote is not None:
-                self._m_spill_promote.labels(tier=src).inc()
-            if blk.lossy:
-                self._slot_lossy[slot] = True
-            elif cache.get(blk.key) is None:
-                # guard against a duplicate registered between walk
-                # and install (another admission prefilled the same
-                # chain): insert raises on duplicates — keep ours
-                # private then, mirroring _insert_full_blocks
-                entry = cache.insert(blk.key, bid, blk.tokens,
-                                     acquire=True)
-                self._slot_blocks[slot].remove(bid)
-                self._slot_cached[slot].append(entry)
-            if self._kv_spill is not None:
-                # device is canonical again: drop the host copy
-                # (re-eviction re-demotes); storage copies stay as
-                # the cross-replica durability layer
-                self._kv_spill.consumed(blk.key)
+        with start_span("serving.kv_promote", stage="spill_promote",
+                        blocks=len(promos)):
+            bids = [int(self._tables[slot, start + i])
+                    for i in range(len(promos))]
+            self.pool = install_pool_blocks(
+                self.pool, [blk.payload for blk, _ in promos], bids)
+            tiers: Dict[str, int] = {}
+            for (blk, src), bid in zip(promos, bids):
+                tiers[src] = tiers.get(src, 0) + 1
+                if self._m_spill_promote is not None:
+                    self._m_spill_promote.labels(tier=src).inc()
+                if blk.lossy:
+                    self._slot_lossy[slot] = True
+                elif cache.get(blk.key) is None:
+                    # guard against a duplicate registered between walk
+                    # and install (another admission prefilled the same
+                    # chain): insert raises on duplicates — keep ours
+                    # private then, mirroring _insert_full_blocks
+                    entry = cache.insert(blk.key, bid, blk.tokens,
+                                         acquire=True)
+                    self._slot_blocks[slot].remove(bid)
+                    self._slot_cached[slot].append(entry)
+                if self._kv_spill is not None:
+                    # device is canonical again: drop the host copy
+                    # (re-eviction re-demotes); storage copies stay as
+                    # the cross-replica durability layer
+                    self._kv_spill.consumed(blk.key)
         self._promo_memo = None
         self.recorder.record(rid, "kv_promote", blocks=len(promos),
                              tiers=tiers)
@@ -3259,8 +3314,16 @@ class DecodeEngine:
             # persist the conversation's tail KV BEFORE the blocks
             # free: the next request for this session admits as a
             # chain hit, on this replica (parked blocks) or any other
-            # sharing the store (persisted blocks)
-            self._persist_session(slot, rid, sid)
+            # sharing the store (persisted blocks). The save runs
+            # under the retiring request's trace context — it is this
+            # request's time — as a session_save stage span.
+            if self._session_store is not None:
+                with use_context(self._trace_ctx.get(rid)), \
+                        start_span("serving.session_save",
+                                   stage="session_save", session=sid):
+                    self._persist_session(slot, rid, sid)
+            else:
+                self._persist_session(slot, rid, sid)
         self._rid[slot] = None
         self._release_blocks(slot)
         self._clear_slot_meta(slot)
@@ -3269,11 +3332,17 @@ class DecodeEngine:
         now = time.monotonic()
         t_sub = self._submit_t.pop(rid, None)
         t_adm = self._admit_t.pop(rid, now)
+        ctx = self._trace_ctx.get(rid)
         if t_sub is not None:
             self._latency_window.append((t_adm - t_sub, now - t_sub,
                                          len(self._done[rid])))
             self._m_queue_wait.observe(t_adm - t_sub)
-            self._m_request_latency.observe(now - t_sub)
+            # exemplar-enabled: a p99 latency bucket names the trace
+            # whose retained tree explains it
+            self._m_request_latency.observe(
+                now - t_sub,
+                trace_id=None if ctx is None else ctx.trace_id)
+        self._finish_trace(rid, ctx, outcome, now, t_sub)
         self._trace_ctx.pop(rid, None)
         extra = {}
         a_p = self._accept.pop(rid, None)
@@ -3296,6 +3365,45 @@ class DecodeEngine:
             total_s=(None if t_sub is None else round(now - t_sub, 6)),
             **extra)
         return rid
+
+    def _finish_trace(self, rid: int, ctx, outcome: str, now: float,
+                      t_sub: Optional[float]) -> None:
+        """Materialize the request's retroactive spans — the
+        ``serving.request`` root (the span id every live span under
+        this request already parents to) and the decode stage (first
+        token -> last token) — then hand the tree to the span store's
+        tail-based retention decision. A request submitted without a
+        trace context never touched the store and has nothing to
+        finish."""
+        if ctx is None:
+            return
+        origin = self._ttft_origin.get(rid, t_sub)
+        if origin is None:
+            origin = t_sub
+        ttft = self._ttft_val.get(rid)
+        if origin is not None:
+            total = now - origin
+            wall0 = time.time() - total
+            root_attrs = {"rid": rid, "outcome": outcome}
+            if ttft is not None:
+                root_attrs["ttft_s"] = round(ttft, 6)
+            add_span("serving.request", wall0, total, ctx=ctx,
+                     span_id=ctx.span_id, parent_id=ctx.parent_id,
+                     **root_attrs)
+            last_tok = self._last_tok_t.get(rid)
+            if ttft is not None and last_tok is not None:
+                dec = last_tok - (origin + ttft)
+                if dec > 0:
+                    add_span("serving.decode", wall0 + ttft, dec,
+                             stage="decode", ctx=ctx)
+        default_span_store().finish(
+            ctx.trace_id,
+            latency_s=None if origin is None else now - origin,
+            ttft_s=ttft,
+            # a missed deadline IS the SLO violation the tail keeps
+            violated=outcome in ("expired", "timed_out"),
+            errored=outcome not in ("finished", "expired", "timed_out",
+                                    "cancelled"))
 
     def _persist_session(self, slot: int, rid: int, sid: str) -> None:
         """Write the retiring slot's full KV blocks into the session

@@ -112,7 +112,7 @@ class QuietThreadingHTTPServer(ThreadingHTTPServer):
 #: cardinality past the registry's bound
 _KNOWN_ROUTES = ("/health", "/ready", "/stats", "/metrics", "/slo",
                  "/v1/result", "/v1/generate", "/v1/submit",
-                 "/v1/cancel", "/debug/trace/recent",
+                 "/v1/cancel", "/debug/trace/recent", "/debug/traces",
                  "/v1/requests/:id/trace")
 
 #: per-request flight-recorder route: the id is normalized out of the
@@ -566,6 +566,19 @@ class ServingServer:
                     except ValueError:
                         limit = 32
                     self._json(200, server._recent_traces(limit))
+                elif url.path == "/debug/traces":
+                    # span-tree plane: tail-retained trees + critical-
+                    # path attribution. Lock-free like the recorder
+                    # routes — the span store has its own lock.
+                    q = parse_qs(url.query)
+                    tid = q.get("trace_id")
+                    limit = q.get("limit")
+                    try:
+                        limit = int(limit[0]) if limit else 32
+                    except ValueError:
+                        limit = 32
+                    self._json(200, server._debug_traces(
+                        trace_id=tid[0] if tid else None, limit=limit))
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -1096,3 +1109,37 @@ class ServingServer:
         if fn is None:
             return {"requests": []}
         return {"requests": fn(max(1, min(int(limit), 256)))}
+
+    def _debug_traces(self, trace_id: Optional[str] = None,
+                      limit: int = 32) -> Dict:
+        """``GET /debug/traces``: the tail-retained span TREES (SLO
+        violations, errors, slowest-k) with their critical-path
+        decompositions and the store's percentile attribution —
+        "which plane ate the time" as one read. ``?trace_id=`` narrows
+        to one tree (retained or still in flight)."""
+        from .obs.critical_path import aggregate, decompose
+        from .obs.spans import Span, default_span_store
+
+        store = default_span_store()
+        if trace_id:
+            spans = store.spans_of(trace_id)
+            traces = [{"trace_id": trace_id,
+                       "spans": [s.to_dict() for s in spans]}]
+        else:
+            traces = store.retained(limit=max(1, min(int(limit), 256)))
+        decomps = []
+        for rec in traces:
+            d = decompose([Span.from_dict(s) for s in rec["spans"]],
+                          ttft_s=rec.get("ttft_s"),
+                          total_s=rec.get("latency_s"))
+            rec["critical_path"] = d
+            if d is not None:
+                decomps.append(d)
+        return {
+            "traces": traces,
+            "aggregation": {
+                "ttft": aggregate(decomps, window="ttft"),
+                "total": aggregate(decomps, window="total"),
+            },
+            "store": store.stats(),
+        }

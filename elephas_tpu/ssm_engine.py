@@ -110,6 +110,12 @@ class SSMEngine:
         self._m_finished = reg.counter(
             "serving_requests_finished_total",
             "requests retired at eos or budget").labels()
+        # same eviction visibility as DecodeEngine's recorder: a
+        # truncated timeline must read as truncated, not absent
+        self.recorder.bind_eviction_counter(reg.counter(
+            "flight_recorder_evictions_total",
+            "flight-recorder timelines evicted by the ring bound, "
+            "by request state at eviction", labels=("state",)))
         # weak ref, like DecodeEngine's gauges: an injected shared
         # registry must not pin a discarded engine via its callbacks
         import weakref

@@ -1,0 +1,501 @@
+"""Distributed span-tree tracing: hierarchical spans parented through
+the existing trace-context plumbing, tail-based retention (SLO
+violators / errors / slowest-k kept, ordinary traffic dropped),
+critical-path decomposition whose stage sums match the measured
+windows, slow spill-promotion surfacing as the dominant stage on
+``GET /debug/traces``, retry / hedge / orphan-resubmit arms sharing
+one trace id with distinct child span ids, the flight recorder's
+active/retired eviction split, and request-latency exemplars linking
+a ``/metrics`` bucket to a retained trace."""
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.disagg import DisaggEngine, PrefillWorker
+from elephas_tpu.fleet import FleetRouter, ReplicaPool
+from elephas_tpu.kvtier.tiers import HostTier
+from elephas_tpu.models.transformer import TransformerConfig, init_params
+from elephas_tpu.obs import (FlightRecorder, MetricsRegistry, Span,
+                             SpanStore, add_span, build_tree,
+                             current_span_id, decompose,
+                             default_span_store, new_root,
+                             set_span_plane_enabled, start_span,
+                             use_context)
+from elephas_tpu.serving_engine import DecodeEngine
+from elephas_tpu.serving_http import ServingServer
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = TransformerConfig(vocab_size=97, num_layers=2, num_heads=4,
+                               d_model=32, d_ff=64, max_seq_len=64,
+                               dtype=jnp.float32)
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+@pytest.fixture(autouse=True)
+def clean_store():
+    """Every test starts from an empty shared store with no SLO bounds
+    and the plane ON (in-process replicas all share the default)."""
+    store = default_span_store()
+    store.clear()
+    store.slo_ttft_bound_s = None
+    store.slo_latency_bound_s = None
+    set_span_plane_enabled(True)
+    yield store
+    store.clear()
+
+
+def _post(port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _spans(rec):
+    return [Span.from_dict(d) for d in rec["spans"]]
+
+
+def _retained_rec(trace_id):
+    rec = next((r for r in default_span_store().retained()
+                if r["trace_id"] == trace_id), None)
+    assert rec is not None, \
+        f"trace {trace_id} not retained: {default_span_store().stats()}"
+    return rec
+
+
+# ------------------------------------------------------ span mechanics
+
+def test_nested_spans_parent_to_the_active_context():
+    """start_span() installs a child context, so nesting — and any
+    retro add_span under the same context — yields one connected
+    forest with correct parent links."""
+    ctx = new_root()
+    with use_context(ctx):
+        with start_span("outer") as octx:
+            assert octx.trace_id == ctx.trace_id
+            assert octx.parent_id == ctx.span_id
+            assert current_span_id() == octx.span_id
+            with start_span("inner", stage="prefill") as ictx:
+                assert ictx.parent_id == octx.span_id
+        assert current_span_id() == ctx.span_id
+        add_span("retro", time.time() - 0.01, 0.01, stage="decode")
+    spans = default_span_store().spans_of(ctx.trace_id)
+    names = {s.name: s for s in spans}
+    assert set(names) == {"outer", "inner", "retro"}
+    assert names["inner"].parent_id == names["outer"].span_id
+    assert names["outer"].parent_id == ctx.span_id
+    assert names["retro"].parent_id == ctx.span_id
+    roots = build_tree(spans)
+    assert {r["span"].name for r in roots} == {"outer", "retro"}
+    outer = next(r for r in roots if r["span"].name == "outer")
+    assert [c["span"].name for c in outer["children"]] == ["inner"]
+
+
+def test_span_plane_switch_and_contextless_noop():
+    """No active context -> no spans (background work must not mint
+    root traces); plane off -> start_span/add_span/finish all no-op."""
+    store = default_span_store()
+    with start_span("stray") as got:
+        assert got is None
+    assert store.stats()["active_traces"] == 0
+    set_span_plane_enabled(False)
+    try:
+        ctx = new_root()
+        with use_context(ctx):
+            with start_span("off") as inner:
+                assert inner is None
+            add_span("off2", time.time(), 0.001)
+        assert store.finish(ctx.trace_id, latency_s=9.9,
+                            errored=True) is None
+        assert store.stats()["active_traces"] == 0
+        assert store.stats()["retained_traces"] == 0
+    finally:
+        set_span_plane_enabled(True)
+
+
+# ------------------------------------------------- tail-based retention
+
+def _one_trace(store, latency_s, **finish_kw):
+    ctx = new_root()
+    add_span("serving.request", time.time() - latency_s, latency_s,
+             ctx=ctx, span_id=ctx.span_id, store=store)
+    return ctx, store.finish(ctx.trace_id, latency_s=latency_s,
+                             **finish_kw)
+
+
+def test_tail_retention_keeps_bad_and_drops_ordinary():
+    store = SpanStore(max_traces=32, retain_max=16, slowest_k=2)
+    # errors and SLO violations always retain
+    err_ctx, reason = _one_trace(store, 0.01, errored=True)
+    assert reason == "error"
+    store.slo_ttft_bound_s = 0.5
+    slo_ctx, reason = _one_trace(store, 0.02, ttft_s=0.9)
+    assert reason == "slo_violation"
+    # the first k finished traces seed the slowest-k ring
+    _, r1 = _one_trace(store, 0.20)
+    _, r2 = _one_trace(store, 0.30)
+    assert r1 == r2 == "slowest_k"
+    # an ordinary fast request drops entirely
+    fast_ctx, reason = _one_trace(store, 0.05)
+    assert reason is None
+    assert fast_ctx.trace_id not in store.retained_ids()
+    assert store.spans_of(fast_ctx.trace_id) == []
+    # a slower one displaces the fastest of the slowest-k
+    slow_ctx, reason = _one_trace(store, 0.40)
+    assert reason == "slowest_k"
+    kept = store.retained_ids()
+    assert slow_ctx.trace_id in kept
+    assert err_ctx.trace_id in kept and slo_ctx.trace_id in kept
+    st = store.stats()
+    assert st["dropped_total"] == 1
+    assert st["retained_total"] == {"error": 1, "slo_violation": 1,
+                                    "slowest_k": 3}
+    assert st["retained_traces"] == 4          # one slowest_k displaced
+    # a second finish on a retained trace (hedged duplicate) merges
+    late = Span(err_ctx.trace_id, "ab" * 8, err_ctx.span_id,
+                "serving.decode", "decode", time.time(), 0.002)
+    store.add(late)                            # grafts, not a new trace
+    assert store.finish(err_ctx.trace_id, latency_s=5.0) == "error"
+    rec = next(r for r in store.retained()
+               if r["trace_id"] == err_ctx.trace_id)
+    assert rec["latency_s"] == 5.0
+    assert any(s["span_id"] == "ab" * 8 for s in rec["spans"])
+
+
+def test_unfinished_trace_eviction_is_bounded_and_counted():
+    store = SpanStore(max_traces=2)
+    for _ in range(3):
+        ctx = new_root()
+        add_span("x", time.time(), 0.001, ctx=ctx, store=store)
+    st = store.stats()
+    assert st["active_traces"] == 2
+    assert st["evicted_unfinished_total"] == 1
+
+
+# ------------------------------------- engine tree + latency exemplars
+
+def test_engine_request_tree_decomposes_and_exemplar_links_trace(model):
+    """One engine request under a client context yields a tree rooted
+    at ``serving.request`` whose TTFT/total decompositions sum within
+    tolerance, and the request-latency histogram's exemplar names the
+    retained trace."""
+    params, config = model
+    rng = np.random.default_rng(3)
+    eng = DecodeEngine(params, config, max_slots=1)
+    ctx = new_root()
+    with use_context(ctx):
+        rid = eng.submit(np.asarray(rng.integers(0, 97, 12)), 6)
+    while eng.pending:
+        eng.step()
+    assert len(eng.result(rid)) == 6
+    rec = _retained_rec(ctx.trace_id)
+    assert rec["reason"] == "slowest_k" and rec["ttft_s"] > 0
+    spans = _spans(rec)
+    names = {s.name for s in spans}
+    assert {"serving.request", "serving.admission_wait",
+            "serving.prefill", "serving.decode"} <= names
+    roots = build_tree(spans)
+    assert len(roots) == 1
+    assert roots[0]["span"].name == "serving.request"
+    kids = {c["span"].name for c in roots[0]["children"]}
+    assert {"serving.admission_wait", "serving.prefill",
+            "serving.decode"} <= kids
+    d = decompose(spans, ttft_s=rec["ttft_s"], total_s=rec["latency_s"])
+    assert d["ok"], d
+    assert d["root_span_id"] == roots[0]["span"].span_id
+    assert d["stages_ttft"].get("prefill", 0) > 0
+    assert d["stages_total"].get("decode", 0) > 0
+    # exemplar: the p99 bucket names this very trace
+    snap = eng.registry.get(
+        "serving_request_latency_seconds").labels()._snapshot()
+    assert any(e["trace_id"] == ctx.trace_id
+               for e in snap["exemplars"].values())
+    assert f'trace_id="{ctx.trace_id}"' \
+        in eng.registry.render(exemplars=True)
+
+
+# ------------------------------- slow spill promotion on /debug/traces
+
+def test_slow_spill_promotion_dominates_debug_traces(model, monkeypatch):
+    """The acceptance drill: tiered-KV traffic with an injected slow
+    host-tier promotion — the traced request's TTFT decomposition bills
+    the stall to ``spill_promote``, the sums hold within 5%, and the
+    fleet aggregation on ``GET /debug/traces`` names it dominant."""
+    params, config = model
+    rng = np.random.default_rng(5)
+    cold = [np.asarray(rng.integers(0, 97, 24)) for _ in range(3)]
+    fresh = np.asarray(rng.integers(0, 97, 33))
+    eng = DecodeEngine(params, config, max_slots=1, paged=(13, 8))
+    eng.enable_kv_spill(host_capacity_blocks=64)
+    eng.warmup(prompt_lengths=[24, 33])
+    with ServingServer(eng) as srv:
+        # round 1 compiles every path this test exercises — including
+        # the chain-hit re-admission of cold[0] — so the traced
+        # request's prefill stage is steady-state, not a compile storm
+        round1 = [(c, 8) for c in cold] + [(fresh, 6), (cold[0], 8)]
+        # round 2 re-parks and re-demotes cold[0]'s blocks under fresh
+        # pool pressure, setting up the traced promotion
+        round2 = [(cold[1], 8), (cold[2], 8), (fresh, 6)]
+        for p, n in round1 + round2:
+            _post(srv.port, "/v1/generate",
+                  {"prompt": [int(t) for t in p], "max_new_tokens": n})
+        # warm-round traces out of the aggregation; the bound makes
+        # the stalled request an SLO violator (ordinary traffic past
+        # this point would drop — tail-based retention)
+        store = default_span_store()
+        store.clear()
+        store.slo_ttft_bound_s = 0.1
+        # the returning prompt's chain walk promotes demoted blocks
+        # back from host RAM — each get now stalls
+        orig_get = HostTier.get
+
+        def slow_get(self, key):
+            time.sleep(0.12)
+            return orig_get(self, key)
+
+        monkeypatch.setattr(HostTier, "get", slow_get)
+        trace_id = "ab" * 16
+        tp = f"00-{trace_id}-{'cd' * 8}-01"
+        out = _post(srv.port, "/v1/generate",
+                    {"prompt": [int(t) for t in cold[0]],
+                     "max_new_tokens": 8},
+                    headers={"traceparent": tp})
+        assert len(out["tokens"]) == 8
+        assert eng.stats["kv_tiers"]["promotions"]["host"] >= 1
+        debug = _get(srv.port, "/debug/traces")
+    rec = next(t for t in debug["traces"] if t["trace_id"] == trace_id)
+    assert rec["reason"] == "slo_violation"
+    cp = rec["critical_path"]
+    assert cp["ok"], cp                       # sums within 5% tolerance
+    assert cp["ttft_s"] > 0.1                 # the stall landed in TTFT
+    promote = cp["stages_ttft"].get("spill_promote", 0.0)
+    assert promote >= 0.4 * cp["ttft_s"], cp["stages_ttft"]
+    names = {s["name"] for s in rec["spans"]}
+    assert "kvtier.lookup" in names
+    agg = debug["aggregation"]["ttft"]
+    assert agg["dominant_stage"] == "spill_promote", agg
+    assert debug["store"]["retained_traces"] >= 1
+
+
+# ------------------------------------------ disagg stage decomposition
+
+def test_disagg_trace_tree_stage_sum_matches_ttft(model):
+    """A disaggregated request's tree spans prefill worker -> KV wire
+    -> decode engine, rooted at ``serving.request``, and the stage
+    decomposition of both windows sums within the 5% tolerance."""
+    params, config = model
+    rng = np.random.default_rng(7)
+    worker = PrefillWorker(DecodeEngine(params, config, max_slots=1),
+                           quant=False, block_size=8,
+                           name="prefill-0").start()
+    decode = DecodeEngine(params, config, max_slots=2, tier="decode")
+    deng = DisaggEngine(decode, [worker])
+    try:
+        ctx = new_root()
+        with use_context(ctx):
+            rid = deng.submit(
+                [int(t) for t in rng.integers(0, 97, 24)], 6)
+        deadline = time.monotonic() + 60
+        info = None
+        while info is None and time.monotonic() < deadline:
+            if deng.pending:
+                deng.step()
+            info = deng.result_info(rid)
+            time.sleep(0.002)
+        assert info is not None and len(info["tokens"]) == 6
+    finally:
+        deng.stop()
+        if worker.alive:
+            worker.stop()
+    rec = _retained_rec(ctx.trace_id)
+    spans = _spans(rec)
+    names = {s.name for s in spans}
+    assert {"disagg.prefill_queue", "disagg.prefill", "disagg.ship",
+            "serving.request"} <= names
+    assert rec["ttft_s"] is not None and rec["latency_s"] is not None
+    d = decompose(spans, ttft_s=rec["ttft_s"], total_s=rec["latency_s"])
+    assert d["ok"], d                         # the 5% acceptance bound
+    root = next(s for s in spans if s.span_id == d["root_span_id"])
+    assert root.name == "serving.request"
+    # prefill compute and the KV wire hop both land inside TTFT
+    assert d["stages_ttft"].get("prefill", 0) > 0, d["stages_ttft"]
+    assert d["stages_ttft"].get("kv_wire", 0) > 0, d["stages_ttft"]
+    assert all(s.trace_id == ctx.trace_id for s in spans)
+
+
+# ------------------------------- resilience plane: retries and hedges
+
+def test_orphan_resubmit_tree_shows_both_homes(model):
+    """A submit orphaned by its replica's death is resubmitted under
+    the SAME trace: the tree holds the original ``fleet.attempt`` on
+    the victim, a ``fleet.orphan_resubmit`` span, and a child attempt
+    on the sibling — distinct span ids, one trace id."""
+    params, config = model
+    rng = np.random.default_rng(11)
+    trace_id = "be" * 16
+    tp = f"00-{trace_id}-{'cd' * 8}-01"
+    pool = ReplicaPool(
+        lambda: DecodeEngine(params, config, max_slots=2), n=2).start()
+    try:
+        with FleetRouter(pool.urls, probe_interval=0.2,
+                         evict_after=2, hedge=False) as router:
+            prompt = [int(t) for t in rng.integers(0, 97, 5)]
+            fid = _post(router.port, "/v1/submit",
+                        {"prompt": prompt, "max_new_tokens": 4},
+                        headers={"traceparent": tp})["id"]
+            with router._records_lock:
+                victim_url = router._records[fid]["url"]
+            pool.kill(router._urls.index(victim_url))
+            deadline = time.time() + 30
+            out = None
+            while time.time() < deadline:
+                out = _get(router.port, f"/v1/result?id={fid}")
+                if out["status"] == "done":
+                    break
+                time.sleep(0.05)
+            assert out is not None and out["status"] == "done"
+    finally:
+        pool.stop()
+    spans = default_span_store().spans_of(trace_id)
+    attempts = [s for s in spans if s.name == "fleet.attempt"
+                and s.attrs.get("op") in ("submit", "reroute")]
+    assert len({s.span_id for s in attempts}) == len(attempts) >= 2
+    homes = {s.attrs["replica"] for s in attempts}
+    assert victim_url in homes and len(homes) >= 2
+    orphan = [s for s in spans if s.name == "fleet.orphan_resubmit"]
+    assert len(orphan) == 1
+    re_attempts = [s for s in attempts if s.attrs["op"] == "reroute"]
+    assert re_attempts
+    assert all(s.parent_id == orphan[0].span_id for s in re_attempts)
+    assert all(s.trace_id == trace_id for s in spans)
+
+
+class _SlowStep:
+    """Engine shim for a degraded replica: every step() stalls, so the
+    hedge path has a tail to cut."""
+
+    def __init__(self, engine, delay_s):
+        self._engine = engine
+        self._delay_s = float(delay_s)
+
+    def step(self):
+        time.sleep(self._delay_s)
+        return self._engine.step()
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def test_hedged_duplicates_share_trace_with_distinct_spans(model):
+    """Both arms of a hedged generate record ``fleet.attempt`` spans
+    under ONE trace id with distinct span ids and distinct replica
+    homes — and the replica-side request root parents to its arm's
+    attempt span (the forwarded traceparent carries the span id)."""
+    params, config = model
+    slow_delay, builds = 0.15, []
+
+    def factory():
+        eng = DecodeEngine(params, config, max_slots=2)
+        if not builds:                     # replica 0 is the slow one
+            eng = _SlowStep(eng, slow_delay)
+        builds.append(eng)
+        return eng
+
+    pool = ReplicaPool(factory, n=2).start()
+    router = FleetRouter(pool.urls, probe_interval=0.2, join_after=1,
+                         hedge=True, hedge_quantile=0.5,
+                         hedge_min_s=0.3, hedge_min_samples=4,
+                         hedge_max_fraction=1.0,
+                         hedge_poll_s=0.005).start()
+    try:
+        slow_url, fast_url = pool.urls[0], pool.urls[1]
+        deadline = time.monotonic() + 15
+        while router.membership.ring_size() < 2:
+            assert time.monotonic() < deadline, "replicas never joined"
+            time.sleep(0.02)
+
+        def owner_of(prompt):
+            chain = router.membership.route_chain(
+                router._route_key({"prompt": prompt}))
+            return chain[0] if chain else None
+
+        rng = np.random.default_rng(13)
+
+        def prompt_owned_by(url):
+            while True:
+                p = [int(t) for t in rng.integers(0, 97, 6)]
+                if owner_of(p) == url:
+                    return p
+
+        # warm the rolling window on the healthy replica only
+        for _ in range(4):
+            _post(router.port, "/v1/generate",
+                  {"prompt": prompt_owned_by(fast_url),
+                   "max_new_tokens": 4})
+        assert router._hedge_threshold_s() is not None
+
+        trace_id = "da" * 16
+        tp = f"00-{trace_id}-{'cd' * 8}-01"
+        out = _post(router.port, "/v1/generate",
+                    {"prompt": prompt_owned_by(slow_url),
+                     "max_new_tokens": 6},
+                    headers={"traceparent": tp})
+        assert len(out["tokens"]) == 6
+        assert router.stats()["hedge"]["requests_hedged"] == 1
+    finally:
+        router.stop()
+        pool.stop()
+    spans = default_span_store().spans_of(trace_id)
+    attempts = {s.attrs.get("op"): s for s in spans
+                if s.name == "fleet.attempt"}
+    assert "generate" in attempts and "hedge" in attempts, \
+        sorted(s.name for s in spans)
+    primary, hedge = attempts["generate"], attempts["hedge"]
+    assert primary.span_id != hedge.span_id
+    assert primary.trace_id == hedge.trace_id == trace_id
+    assert primary.attrs["replica"] != hedge.attrs["replica"]
+    # the winner's engine-side request root is a CHILD of its arm's
+    # attempt span: the forwarded traceparent carried the span id
+    roots = [s for s in spans if s.name == "serving.request"]
+    assert roots
+    arm_ids = {primary.span_id, hedge.span_id}
+    assert all(s.parent_id in arm_ids for s in roots)
+
+
+# ------------------------------------- flight-recorder eviction split
+
+def test_flight_recorder_eviction_counter_splits_active_retired():
+    """Evicting a timeline whose last event is terminal counts as
+    ``retired``; evicting one still in flight counts as ``active`` —
+    both on the local tally AND the bound counter family."""
+    reg = MetricsRegistry()
+    rec = FlightRecorder(max_requests=2, max_events=8)
+    fam = reg.counter("flight_recorder_evictions_total",
+                      "flight-recorder ring evictions by state",
+                      labels=("state",))
+    rec.bind_eviction_counter(fam)
+    rec.start(1)
+    rec.record(1, "finished")
+    rec.start(2)                               # never finishes
+    rec.start(3)                               # evicts 1 -> retired
+    assert rec.evictions == {"active": 0, "retired": 1}
+    rec.start(4)                               # evicts 2 -> active
+    assert rec.evictions == {"active": 1, "retired": 1}
+    vals = {labels[0]: int(c.value) for labels, c in fam.series().items()}
+    assert vals == {"active": 1, "retired": 1}
