@@ -1934,6 +1934,40 @@ def measure_speculative(smoke=False):
         "speculative outputs diverged from plain decoding"
     assert results["spec"]["outs"] == results["spec_nocache"]["outs"], \
         "prefix-cache-on speculative outputs diverged from cache-off"
+    # --- adaptive-vs-fixed gamma under a draft-staleness sweep: the
+    # draft's trunk is crushed to near-noise mid-run (the deterministic
+    # stand-in for "re-distilled against a target several swaps ago"),
+    # collapsing acceptance. The fixed engine keeps proposing gamma
+    # tokens per round and throwing most away; the adaptive engine's
+    # controller walks gamma to the floor within a few rounds and stops
+    # paying for rejected drafts. A verify pass is exact at ANY depth,
+    # so both must stay token-identical with the plain-decode outputs.
+    stale_draft = jax.tree_util.tree_map(lambda a: a * 0.05, draft)
+
+    def staleness_run(adaptive):
+        eng = DecodeEngine(params, c, max_slots=max_slots,
+                           paged=(n_blocks, block), draft_params=draft,
+                           draft_config=dc, gamma=gamma,
+                           adaptive_gamma=adaptive)
+        drain(eng)                       # compile + warm, fresh draft
+        eng.stage_draft_params(stale_draft, version=2)
+        drain(eng)                       # adaptive: walk down + compile
+        #                                  the visited depths' programs
+        eng.stage_draft_params(stale_draft, version=3)
+        #                                  ^ resets adaptive gamma to the
+        #                                  ceiling: the measured pass
+        #                                  includes the walk-down
+        outs, tps = drain(eng)
+        return {"outs": outs, "tps": tps, "stats": eng.stats}
+
+    stale_fixed = staleness_run(False)
+    stale_adaptive = staleness_run(True)
+    assert stale_fixed["outs"] == results["off"]["outs"], \
+        "stale-draft fixed-gamma outputs diverged"
+    assert stale_adaptive["outs"] == results["off"]["outs"], \
+        "stale-draft adaptive-gamma outputs diverged"
+    assert stale_adaptive["stats"]["gamma"] < gamma, \
+        "adaptive gamma did not move off the ceiling under staleness"
     on, off = results["spec"], results["off"]
     ks = on["stats"]["kv_cache"]
     return {"metric": "speculative_tokens_per_sec_ratio",
@@ -1952,13 +1986,143 @@ def measure_speculative(smoke=False):
                 off["stats"]["tokens_per_step"], 2),
             "cache_hits": ks["hits"],
             "outputs_token_identical": True,
+            "stale_adaptive_vs_fixed": round(
+                stale_adaptive["tps"] / stale_fixed["tps"], 3),
+            "stale_tokens_per_sec_adaptive": round(
+                stale_adaptive["tps"], 1),
+            "stale_tokens_per_sec_fixed": round(stale_fixed["tps"], 1),
+            "stale_gamma_end": stale_adaptive["stats"]["gamma"],
+            "stale_acceptance": (
+                None if stale_adaptive["stats"]["draft_acceptance"]
+                is None
+                else round(stale_adaptive["stats"]["draft_acceptance"],
+                           3)),
             "config": f"target L{layers} d{d_model} ff{d_ff} V{vocab} "
                       f"f32 paged ({n_blocks}x{block}), draft L1 "
                       f"(shared trunk, extra layers x0.02), gamma "
                       f"{gamma}, {n_requests} reqs x {prompt_len}-tok "
                       f"prompts / {max_new} new toks, {max_slots} "
                       "slots, prefix cache on (A/B'd vs off), "
-                      "steady-state pass measured"}
+                      "steady-state pass measured; staleness sweep: "
+                      "draft trunk x0.05 staged mid-run, adaptive "
+                      "(floor 1) vs fixed gamma at equal traffic"}
+
+
+def measure_adaptive_sched(smoke=False):
+    """Adaptive-scheduling row: a long-prompt burst admitted OVER live
+    decodes, chunked-prefill interleaving on vs off at equal traffic.
+    Run-to-completion admission stalls every in-flight decode for the
+    whole chunk loop — the stall lands squarely in the live requests'
+    inter-token p99. Interleaving feeds the same chunks between decode
+    steps under the profiler-derived budget, so live inter-token
+    latency stays ~flat and the burst's TTFT degrades gracefully
+    instead. Both runs drain identical traffic twice (pass 1 compiles,
+    pass 2 measured) and outputs are asserted token-identical — the
+    scheduler moves WHEN chunks run, never what they compute. The
+    acceptance scalar is the live-decode inter-token p99 ratio
+    (off/on, >= 3x on the dev box)."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from elephas_tpu.models.transformer import (TransformerConfig,
+                                                init_params)
+    from elephas_tpu.serving_engine import DecodeEngine
+
+    if smoke:
+        layers, d_model, d_ff, vocab, heads = 2, 64, 128, 500, 4
+        live_n, live_prompt, live_new = 2, 8, 24
+        burst_n, burst_prompt, burst_new, chunk = 1, 64, 4, 8
+    else:
+        layers, d_model, d_ff, vocab, heads = 4, 256, 1024, 8000, 8
+        live_n, live_prompt, live_new = 4, 16, 64
+        burst_n, burst_prompt, burst_new, chunk = 2, 384, 16, 32
+    block = 16
+    slots = live_n + burst_n
+    max_len = burst_prompt + burst_new + block
+    per_req = -(-max_len // block)
+    n_blocks = 1 + slots * per_req
+    c = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                          num_heads=heads, d_model=d_model, d_ff=d_ff,
+                          max_seq_len=max_len, dtype=jnp.float32)
+    params = init_params(c, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    live_prompts = [rng.integers(0, vocab, live_prompt)
+                    for _ in range(live_n)]
+    burst_prompts = [rng.integers(0, vocab, burst_prompt)
+                     for _ in range(burst_n)]
+
+    def run(eng):
+        """One traffic pass: live decodes reach steady state, the long
+        burst lands on top, per-step host stamps collect the live
+        requests' inter-token gaps and the burst's TTFT."""
+        live = [eng.submit(p, live_new) for p in live_prompts]
+        last: dict = {}
+        for _ in range(4):
+            out = eng.step()
+            now = time.perf_counter()
+            # stamp (don't measure) the pre-burst steps: the FIRST
+            # post-burst gap — the one the admission stall lands in —
+            # must have a predecessor stamp to measure against
+            for r in live:
+                if out.get(r):
+                    last[r] = now
+        t_burst = time.perf_counter()
+        burst = [eng.submit(p, burst_new) for p in burst_prompts]
+        gaps: list = []
+        ttfts: list = []
+        while eng.pending:
+            out = eng.step()
+            now = time.perf_counter()
+            for r in live:
+                if out.get(r):
+                    if r in last:
+                        gaps.append(now - last[r])
+                    last[r] = now
+            for r in burst:
+                if out.get(r) and r not in last:
+                    ttfts.append(now - t_burst)
+                    last[r] = now
+        outs = [list(eng.result(r)) for r in live + burst]
+        return outs, gaps, ttfts
+
+    results = {}
+    for label, interleave in (("off", False), ("on", True)):
+        eng = DecodeEngine(params, c, max_slots=slots,
+                           paged=(n_blocks, block), prefill_chunk=chunk,
+                           prefix_cache=False,
+                           interleave_prefill=interleave)
+        run(eng)                              # compile pass
+        outs, gaps, ttfts = run(eng)          # measured pass
+        results[label] = {
+            "outs": outs,
+            "p99": float(np.quantile(gaps, 0.99)),
+            "ttft": float(np.mean(ttfts)),
+            "decode_util": eng.profiler.utilization()["decode"],
+            "chunks": eng.stats.get("prefill_chunks_interleaved", 0)}
+    on, off = results["on"], results["off"]
+    assert on["outs"] == off["outs"], \
+        "interleaved outputs diverged from run-to-completion"
+    assert on["chunks"] > 0, "interleaving scheduler never engaged"
+    return {"metric": "adaptive_sched_inter_token_p99_ratio",
+            "value": round(off["p99"] / on["p99"], 2),
+            "unit": "x (live-decode inter-token p99, interleave "
+                    "off / on, equal traffic)",
+            "inter_token_p99_ms": round(on["p99"] * 1e3, 3),
+            "inter_token_p99_ms_off": round(off["p99"] * 1e3, 3),
+            "burst_ttft_ms": round(on["ttft"] * 1e3, 1),
+            "burst_ttft_ms_off": round(off["ttft"] * 1e3, 1),
+            "decode_utilization": round(on["decode_util"], 3),
+            "decode_utilization_off": round(off["decode_util"], 3),
+            "chunks_interleaved": int(on["chunks"]),
+            "outputs_token_identical": True,
+            "config": f"L{layers} d{d_model} ff{d_ff} V{vocab} f32 "
+                      f"paged ({n_blocks}x{block}), {live_n} live reqs "
+                      f"x {live_prompt}-tok prompts / {live_new} new "
+                      f"toks + {burst_n} burst reqs x {burst_prompt}-"
+                      f"tok prompts, prefill_chunk {chunk}, "
+                      "profiler-budgeted interleave vs "
+                      "run-to-completion, steady-state pass measured"}
 
 
 def measure_tenant_qos(smoke=False):
@@ -2728,6 +2892,8 @@ if __name__ == "__main__":
         _emit(measure_weight_swap(smoke=smoke))
     if which in ("speculative", "all"):
         _emit(measure_speculative(smoke=smoke))
+    if which in ("adaptive_sched", "all"):
+        _emit(measure_adaptive_sched(smoke=smoke))
     if which in ("tenant_qos", "all"):
         _emit(measure_tenant_qos(smoke=smoke))
     if which in ("autoscaler", "all"):

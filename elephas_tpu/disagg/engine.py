@@ -822,6 +822,27 @@ class DisaggEngine:
         can force one, mirroring DecodeEngine's surface)."""
         return self.decode.apply_staged_params()
 
+    @property
+    def gamma(self) -> Optional[int]:
+        """The decode engine's CURRENT speculative depth (the adaptive
+        controller's operating point; equal to the ctor gamma on
+        fixed-depth engines, None on non-speculative decode workers).
+        A fleet prober comparing this against ``gamma_ceiling`` in
+        `/stats` sees draft staleness the moment the controller reacts,
+        without waiting for the acceptance alert."""
+        if getattr(self.decode, "draft_config", None) is None:
+            return None
+        return int(self.decode._gamma_now)
+
+    @property
+    def kernel(self) -> str:
+        """The decode engine's RESOLVED attention kernel ("gather", or
+        "pallas" when the paged-decode Pallas kernel is actually
+        compiled for this backend — a requested-but-fallen-back engine
+        reports "gather" here and flags ``kernel_requested`` in
+        `/stats`)."""
+        return str(getattr(self.decode, "kernel", "gather"))
+
     # ---------------------------------------------------------------- misc
     def register_prefix(self, tokens) -> None:
         """Register a shared prompt prefix on EVERY prefill worker's

@@ -52,7 +52,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import (NEG_INF, TransformerConfig, _alibi_slopes,
+from .transformer import (NEG_INF, TransformerConfig, _alibi_slope_list,
+                          _alibi_slopes,
                           _apply_rope, _mlp_apply, _norm,
                           _sinusoidal_table, head_logits)
 
@@ -299,13 +300,28 @@ def _install_blocks_jit(pool, blocks, block_ids):
 
 def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,
                       tokens: jnp.ndarray, pos,
-                      config: TransformerConfig) -> Tuple[jnp.ndarray,
-                                                          Dict]:
+                      config: TransformerConfig,
+                      kernel: str = "gather",
+                      interpret=None) -> Tuple[jnp.ndarray, Dict]:
     """One autoregressive step over the block pool: token ids ``(B,)``
     at per-row positions ``pos`` ``(B,)``; ``tables`` is ``(B,
     max_blocks)`` of block ids. Returns (logits ``(B, vocab)``, updated
     pool). The paged mirror of
-    :func:`~elephas_tpu.models.transformer.decode_step`."""
+    :func:`~elephas_tpu.models.transformer.decode_step`.
+
+    ``kernel`` selects the attention inner loop: ``"gather"`` (default)
+    materializes each row's blocks into attention order and runs a
+    full-row masked softmax; ``"pallas"`` runs
+    :func:`~elephas_tpu.ops.paged_attention.paged_decode_attention`,
+    which fuses the block gather into a flash-style online-softmax
+    kernel (no gathered copy — the decode hot-path saving). The two
+    agree to float rounding (the online softmax associates the
+    reduction differently), pinned by the variant-matrix parity tests.
+    ``interpret`` is threaded to the Pallas kernel (tests force the
+    interpreter off-TPU; production callers leave it ``None``)."""
+    if kernel not in ("gather", "pallas"):
+        raise ValueError(f"unknown paged decode kernel {kernel!r}; "
+                         "expected 'gather' or 'pallas'")
     c = config
     b = tokens.shape[0]
     first = next(iter(pool.values()))["k"]
@@ -355,27 +371,39 @@ def decode_step_paged(params: Dict, pool: Dict, tables: jnp.ndarray,
         pv = lc["v"].at[widx].set(v_new[:, :, 0])
         new_pool[f"layer_{i}"] = {"k": pk, "v": pv}
 
-        # gather each row's blocks into attention order: (B, MB, H, bs,
-        # D) -> (B, H, MB*bs, D). The one extra O(cache) pass paged
-        # mode pays; positions beyond the row's allocation land on
-        # stale/scratch data and are masked
-        ck = jnp.swapaxes(pk[tables], 1, 2).reshape(
-            b, c.kv_heads, length, c.head_dim)
-        cv = jnp.swapaxes(pv[tables], 1, 2).reshape(
-            b, c.kv_heads, length, c.head_dim)
+        if kernel == "pallas":
+            # fused path: the kernel's index maps stream each table
+            # block straight from the pool — no gathered copy
+            from ..ops.paged_attention import paged_decode_attention
+            o = paged_decode_attention(
+                q[:, :, 0], pk, pv, tables, pos,
+                window=c.attention_window,
+                alibi_slopes=(_alibi_slope_list(c.num_heads)
+                              if c.positional == "alibi" else None),
+                interpret=interpret)[:, :, None, :]
+        else:
+            # gather each row's blocks into attention order: (B, MB, H,
+            # bs, D) -> (B, H, MB*bs, D). The one extra O(cache) pass
+            # paged mode pays; positions beyond the row's allocation
+            # land on stale/scratch data and are masked
+            ck = jnp.swapaxes(pk[tables], 1, 2).reshape(
+                b, c.kv_heads, length, c.head_dim)
+            cv = jnp.swapaxes(pv[tables], 1, 2).reshape(
+                b, c.kv_heads, length, c.head_dim)
 
-        qg = q.reshape(b, c.kv_heads, groups, 1, c.head_dim)
-        scores = jnp.einsum("bngsk,bntk->bngst", qg, ck) * scale
-        if c.positional == "alibi":
-            dist = (pos[:, None] - kpos[None, :]).astype(jnp.float32)
-            ab = (-_alibi_slopes(c.num_heads)[None, :, None, None]
-                  * dist[:, None, None]).reshape(b, c.kv_heads, groups,
-                                                 1, length)
-            scores = scores + ab
-        scores = jnp.where(mask[:, None, None, None, :], scores, NEG_INF)
-        weights = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bngst,bntk->bngsk", weights, cv)
-        o = o.reshape(b, c.num_heads, 1, c.head_dim)
+            qg = q.reshape(b, c.kv_heads, groups, 1, c.head_dim)
+            scores = jnp.einsum("bngsk,bntk->bngst", qg, ck) * scale
+            if c.positional == "alibi":
+                dist = (pos[:, None] - kpos[None, :]).astype(jnp.float32)
+                ab = (-_alibi_slopes(c.num_heads)[None, :, None, None]
+                      * dist[:, None, None]).reshape(b, c.kv_heads,
+                                                     groups, 1, length)
+                scores = scores + ab
+            scores = jnp.where(mask[:, None, None, None, :], scores,
+                               NEG_INF)
+            weights = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bngst,bntk->bngsk", weights, cv)
+            o = o.reshape(b, c.num_heads, 1, c.head_dim)
         x = x + jnp.einsum("bhsk,hkd->bsd", o,
                            layer["attn"]["wo"].astype(c.dtype))
         x = _mlp_apply(layer, x, c)

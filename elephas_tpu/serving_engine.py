@@ -179,6 +179,28 @@ INTER_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 #: stateless, so one instance serves every section site)
 _NULL_SECTION = contextlib.nullcontext()
 
+#: adaptive-gamma controller: EWMA smoothing of per-round pooled
+#: acceptance. 0.4 weights the last ~4 rounds — fast enough to catch a
+#: draft going stale mid-request, smooth enough that one unlucky round
+#: doesn't move the depth
+GAMMA_EWMA_ALPHA = 0.4
+
+#: adaptive-gamma controller: rounds between depth adjustments (and
+#: each adjustment moves ONE step). Hysteresis against chattering —
+#: recompiles are cached per depth, but verify-cost thrash is not free
+GAMMA_ADJUST_EVERY = 4
+
+#: interleaved prefill: iterations between prefill-budget recomputes.
+#: The budget reads the profiler's utilization(), which walks the
+#: ring-buffer under a lock — cheap, but not every-iteration cheap
+#: against a sub-millisecond decode step (<2% overhead budget)
+PREFILL_BUDGET_EVERY = 16
+
+#: interleaved prefill: most chunks one iteration may feed. The budget
+#: scales from 1 (decode-saturated loop — in-flight requests first) up
+#: to this (decode mostly idle — drain the pending prompt fast)
+MAX_INTERLEAVE_CHUNKS = 4
+
 
 def validate_sampling_overrides(temperature, top_k, top_p) -> None:
     """THE per-request sampling validation — shared by every submit
@@ -312,6 +334,38 @@ class DecodeEngine:
         tokens/s (the ``slo_plane`` bench row), cheap enough to be
         always-on. Pass ``False`` to disable (the bench A/B baseline)
         or an instance to share one across wrappers.
+    :param kernel: paged decode-attention inner loop: ``"gather"``
+        (default — materialize each row's blocks, full-row softmax) or
+        ``"pallas"`` (fused block-gather flash kernel,
+        :mod:`~elephas_tpu.ops.paged_attention`; TPU only — off-TPU the
+        engine falls back to gather with a ``serving.kernel_fallback``
+        event, and ``stats["kernel"]`` reports what actually runs).
+    :param kernel_interpret: force (``True``) the Pallas interpreter
+        for the ``"pallas"`` kernel, disabling the off-TPU fallback —
+        a test/debug path, orders of magnitude slower than either
+        production path.
+    :param adaptive_gamma: steer the speculation depth per engine from
+        measured draft acceptance: ``gamma`` becomes the CEILING (all
+        capacity/slack accounting stays sized to it, so shrinking is
+        always safe) and the operating depth walks between
+        ``gamma_min`` and the ceiling as the acceptance EWMA moves — a
+        stale draft shrinks gamma within a few rounds (recovering the
+        wasted draft steps long before fleet-level acceptance alerts),
+        and a draft re-stage resets it to the ceiling. Greedy engines
+        stay token-identical under ANY gamma schedule (the verify emit
+        is an exact argmax-prefix match).
+    :param gamma_min: adaptive gamma's floor (default 1 = one draft
+        token per round at zero acceptance).
+    :param interleave_prefill: schedule chunked admission prefills
+        BETWEEN decode steps instead of running each to completion at
+        admission: every engine iteration feeds at most a budgeted
+        number of ``prefill_chunk``-token chunks (budget derived from
+        the profiler's decode-phase utilization), so a long prompt's
+        admission no longer stalls in-flight decodes — their
+        inter-token latency stays flat while the long request's TTFT
+        degrades gracefully. Requires ``prefill_chunk``. Outputs are
+        token-identical to run-to-completion admission (same chunk
+        shapes, same math; slots are isolated).
     """
 
     #: flight-recorder decode sampling: one ``step`` timeline event per
@@ -336,7 +390,11 @@ class DecodeEngine:
                  prefix_cache_capacity: Optional[int] = None,
                  qos: Optional[TenantQoS] = None,
                  profiler: Union[None, bool, LoopProfiler] = None,
-                 kv_spill=None, session_store=None):
+                 kv_spill=None, session_store=None,
+                 kernel: str = "gather",
+                 kernel_interpret: Optional[bool] = None,
+                 adaptive_gamma: bool = False, gamma_min: int = 1,
+                 interleave_prefill: bool = False):
         self.params = params
         self.config = config
         self.max_slots = int(max_slots)
@@ -362,10 +420,34 @@ class DecodeEngine:
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = int(gamma)
+        # adaptive speculative gamma: ``self.gamma`` is the CEILING —
+        # every capacity rule (verify slack, the paged per-slot block
+        # budget) stays sized to it, so the acceptance controller can
+        # only ever SHRINK the speculation depth below what admission
+        # reserved, never outgrow it. ``_gamma_now`` is the operating
+        # depth, steered per engine from measured acceptance (see
+        # ``_steer_gamma``); fixed-gamma engines keep it pinned.
+        self.adaptive_gamma = bool(adaptive_gamma)
+        self.gamma_min = int(gamma_min)
+        if self.adaptive_gamma and draft_config is None:
+            raise ValueError("adaptive_gamma requires a draft model "
+                             "(draft_params/draft_config)")
+        if draft_config is not None and not (
+                1 <= self.gamma_min <= self.gamma):
+            raise ValueError(f"gamma_min {self.gamma_min} must satisfy "
+                             f"1 <= gamma_min <= gamma ({self.gamma})")
+        self._gamma_now = self.gamma
+        # EWMA of per-round batch acceptance fraction (None until the
+        # first speculative round samples it) + rounds since the last
+        # gamma adjustment (hysteresis: move at most one step every
+        # GAMMA_ADJUST_EVERY rounds)
+        self._accept_ewma: Optional[float] = None
+        self._rounds_since_adjust = 0
         # verify slack: a speculative round writes up to gamma positions
         # past the last emitted token, so every capacity rule (the
         # max_len bound AND the paged per-slot block budget) reserves
-        # gamma extra positions per slot
+        # gamma extra positions per slot — the CEILING, under adaptive
+        # gamma, so shrinking mid-flight is always safe
         self._slack = self.gamma if draft_config is not None else 0
         self.steps_per_sync = int(steps_per_sync)
         if self.steps_per_sync < 1:
@@ -387,6 +469,48 @@ class DecodeEngine:
             self.paged = (num_blocks, block_size)
             # per-slot table width: enough blocks to cover max_len
             self._mb = -(-self.max_len // block_size)
+        # paged decode-attention kernel selection: "gather" (default)
+        # materializes each row's blocks; "pallas" fuses the gather into
+        # a flash-style online-softmax kernel
+        # (:mod:`~elephas_tpu.ops.paged_attention`). The compiled kernel
+        # needs a TPU: elsewhere the engine FALLS BACK to gather (a
+        # ``serving.kernel_fallback`` event; ``stats["kernel"]`` reports
+        # what actually runs) unless ``kernel_interpret=True`` forces
+        # the Pallas interpreter — a test/debug path, orders of
+        # magnitude slower than either production path.
+        self.kernel_requested = str(kernel)
+        if self.kernel_requested not in ("gather", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r}; expected "
+                             "'gather' or 'pallas'")
+        if self.kernel_requested == "pallas" and self.paged is None:
+            raise ValueError("kernel='pallas' is the paged decode-"
+                             "attention kernel; it requires "
+                             "paged=(num_blocks, block_size)")
+        self._kernel_interpret = kernel_interpret
+        self.kernel = self.kernel_requested
+        if self.kernel == "pallas" and not kernel_interpret:
+            from .ops.paged_attention import pallas_supported
+
+            if not pallas_supported():
+                self.kernel = "gather"
+                emit_event("serving.kernel_fallback",
+                           requested="pallas",
+                           backend=jax.default_backend())
+        # chunked-prefill interleaving (ctor docstring): pending
+        # admissions whose prompt is still being fed chunk-by-chunk
+        # between decode steps. slot -> state dict (see
+        # _begin_interleaved_prefill for the fields); the slot is
+        # RESERVED (excluded from _free_slots) but not yet decoding.
+        self.interleave_prefill = bool(interleave_prefill)
+        if self.interleave_prefill and self.prefill_chunk is None:
+            raise ValueError("interleave_prefill requires prefill_chunk")
+        self._pending_prefill: Dict[int, Dict] = {}
+        # chunks-per-iteration budget, recomputed from the profiler's
+        # decode-phase utilization every PREFILL_BUDGET_EVERY iterations
+        # (one utilization() ring walk costs ~the profiler's whole
+        # per-step budget, so it is cached, not read per step)
+        self._prefill_budget = 1
+        self._budget_age = 0
         if self.steps_per_sync > 1 and draft_config is not None:
             raise ValueError("steps_per_sync > 1 applies to plain "
                              "stepping; speculative mode already "
@@ -648,6 +772,19 @@ class DecodeEngine:
                          if (e := ref()) is not None
                          and (p := e._since_init(e._m_proposed))
                          else float("nan")))
+            # the adaptive controller's operating depth (== the ctor
+            # gamma, constantly, when adaptive_gamma is off). Watching
+            # this gauge against serving_speculative_acceptance shows
+            # the control loop working: an acceptance dip drags gamma
+            # down within a few rounds, a draft re-stage snaps it back
+            # to the ceiling
+            reg.gauge(
+                "serving_gamma",
+                "speculative depth currently proposed per round "
+                "(adaptive engines steer this between gamma_min and "
+                "the ctor gamma ceiling)").set_function(
+                lambda: (float(e._gamma_now) if (e := ref()) is not None
+                         else 0.0))
         # rid -> [accepted, proposed] draft-token counts for the
         # request's flight-recorder terminal event (per-request
         # acceptance observability; survives preemption — keyed by rid)
@@ -658,6 +795,11 @@ class DecodeEngine:
                       ).set_function(
                 lambda: float(len(e._free_block_ids))
                 if (e := ref()) is not None else 0.0)
+        self._m_interleaved = reg.counter(
+            "serving_prefill_chunks_interleaved_total",
+            "prompt-prefill chunks fed between decode steps by the "
+            "interleaving scheduler (0 on run-to-completion engines)"
+            ).labels()
         # live weight plane: params staged by a WeightSubscriber (any
         # thread) swap in atomically between decode steps — the same
         # point KV installs use. weights_version names what the engine
@@ -805,10 +947,14 @@ class DecodeEngine:
         if self.paged is not None:
             from .models.paged_decode import decode_step_paged
 
+            kern, kern_interp = self.kernel, self._kernel_interpret
+
             def _one_step_paged(params, pool, tables, last, pos, temps,
                                 topk, topp, seeds, key):
                 logits, pool = decode_step_paged(params, pool, tables,
-                                                 last, pos, cfg)
+                                                 last, pos, cfg,
+                                                 kernel=kern,
+                                                 interpret=kern_interp)
                 tok, key = _sample_tok(logits, temps, topk, topp, seeds,
                                        pos, key)
                 return tok, pool, key
@@ -936,22 +1082,41 @@ class DecodeEngine:
         if draft_config is not None:
             from .models.speculative import speculative_round
 
-            dcfg, g = draft_config, self.gamma
+            dcfg = draft_config
 
-            @partial(jax.jit, donate_argnums=(2, 3))
-            def _spec_step(params, draft_params, cache, d_cache, last,
-                           pos, key):
-                emit, a, nxt, cache, d_cache, key = speculative_round(
-                    params, draft_params, cache, d_cache, last, pos, g,
-                    cfg, dcfg, jnp.float32(temp if temp > 0 else 1.0),
-                    key, not temp > 0)
-                return emit, a, nxt, cache, d_cache, key
+            # per-gamma compiled speculative rounds: gamma is baked into
+            # the traced program (the draft-propose python loop), so an
+            # adaptive engine holds one executable per depth it has
+            # visited — bounded by [gamma_min, gamma], compiled lazily.
+            # Fixed-gamma engines only ever build the ceiling's.
+            def _make_spec_step(g):
+                @partial(jax.jit, donate_argnums=(2, 3))
+                def _spec_step(params, draft_params, cache, d_cache,
+                               last, pos, key):
+                    emit, a, nxt, cache, d_cache, key = (
+                        speculative_round(
+                            params, draft_params, cache, d_cache, last,
+                            pos, g, cfg, dcfg,
+                            jnp.float32(temp if temp > 0 else 1.0),
+                            key, not temp > 0))
+                    return emit, a, nxt, cache, d_cache, key
+
+                return _spec_step
+
+            self._spec_fns: Dict[int, object] = {}
+
+            def _spec_step_for(g: int):
+                fn = self._spec_fns.get(g)
+                if fn is None:
+                    fn = self._spec_fns[g] = _make_spec_step(g)
+                return fn
+
+            self._spec_step_for = _spec_step_for
 
             @jax.jit
             def _prefill_draft(draft_params, prompt):
                 return prefill_cache(draft_params, prompt, dcfg, max_len)
 
-            self._spec_step_fn = _spec_step
             # _install handles any cache pytree (jit specializes per
             # structure), so the draft cache reuses it
             self._install_draft_fn = _install
@@ -963,21 +1128,36 @@ class DecodeEngine:
             if self.paged is not None:
                 from .models.speculative import speculative_round_paged
 
-                @partial(jax.jit, donate_argnums=(2, 3))
-                def _spec_step_paged(params, draft_params, pool, d_cache,
-                                     tables, last, pos, key):
-                    # paged speculative round: the target verifies into
-                    # the slots' own block tables (verify slack budgeted
-                    # at admission); the draft cache stays contiguous
-                    emit, a, nxt, pool, d_cache, key = (
-                        speculative_round_paged(
-                            params, draft_params, pool, tables, d_cache,
-                            last, pos, g, cfg, dcfg,
-                            jnp.float32(temp if temp > 0 else 1.0), key,
-                            not temp > 0))
-                    return emit, a, nxt, pool, d_cache, key
+                def _make_spec_step_paged(g):
+                    @partial(jax.jit, donate_argnums=(2, 3))
+                    def _spec_step_paged(params, draft_params, pool,
+                                         d_cache, tables, last, pos,
+                                         key):
+                        # paged speculative round: the target verifies
+                        # into the slots' own block tables (verify slack
+                        # budgeted at admission — at the gamma CEILING,
+                        # so every depth <= it fits); the draft cache
+                        # stays contiguous
+                        emit, a, nxt, pool, d_cache, key = (
+                            speculative_round_paged(
+                                params, draft_params, pool, tables,
+                                d_cache, last, pos, g, cfg, dcfg,
+                                jnp.float32(temp if temp > 0 else 1.0),
+                                key, not temp > 0))
+                        return emit, a, nxt, pool, d_cache, key
 
-                self._spec_step_paged_fn = _spec_step_paged
+                    return _spec_step_paged
+
+                self._spec_fns_paged: Dict[int, object] = {}
+
+                def _spec_step_paged_for(g: int):
+                    fn = self._spec_fns_paged.get(g)
+                    if fn is None:
+                        fn = self._spec_fns_paged[g] = (
+                            _make_spec_step_paged(g))
+                    return fn
+
+                self._spec_step_paged_for = _spec_step_paged_for
 
     # ------------------------------------------------------------ warmup
     def warmup(self, prompt_lengths: Sequence[int] = ()):
@@ -990,7 +1170,8 @@ class DecodeEngine:
         into free slots' cache rows, which the next admission
         overwrites); afterwards the first real request pays no jit
         latency for any warmed shape."""
-        if any(r is not None for r in self._rid) or self._queue:
+        if (any(r is not None for r in self._rid) or self._queue
+                or self._pending_prefill):
             raise RuntimeError("warmup() needs an idle engine")
         dummy = dict(last=jnp.zeros(self.max_slots, jnp.int32),
                      pos=jnp.zeros(self.max_slots, jnp.int32),
@@ -1004,7 +1185,7 @@ class DecodeEngine:
         # on scratch block 0) costs zero extra device memory — an
         # engine sized to fill the chip can still warm up
         if self.paged is not None and self.draft_config is not None:
-            out = self._spec_step_paged_fn(
+            out = self._spec_step_paged_for(self._gamma_now)(
                 self.params, self.draft_params, self.pool,
                 self.draft_cache, jnp.asarray(self._tables),
                 dummy["last"], dummy["pos"], dummy["key"])
@@ -1018,7 +1199,7 @@ class DecodeEngine:
                 dummy["topk"], dummy["topp"], dummy["seeds"],
                 dummy["key"])
         elif self.draft_config is not None:
-            out = self._spec_step_fn(
+            out = self._spec_step_for(self._gamma_now)(
                 self.params, self.draft_params, self.cache,
                 self.draft_cache, dummy["last"], dummy["pos"],
                 dummy["key"])
@@ -1837,6 +2018,13 @@ class DecodeEngine:
         t0 = time.monotonic()
         self.draft_params = draft_params
         self.draft_weights_version = int(version)
+        # a fresh draft resets the adaptive-gamma controller to the
+        # ceiling: the EWMA's memory of the STALE draft's acceptance
+        # would otherwise hold the depth down for dozens of rounds
+        # after the cause is gone
+        self._gamma_now = self.gamma
+        self._accept_ewma = None
+        self._rounds_since_adjust = 0
         if self._prefixes:
             fresh = []
             for entry in self._prefixes:
@@ -2453,6 +2641,27 @@ class DecodeEngine:
             self._ttft_val.pop(rid, None)
             self.recorder.record(rid, "cancelled", stage="queued")
             return True
+        for slot, st in list(self._pending_prefill.items()):
+            if st["rid"] != rid:
+                continue
+            # mid-interleaved-prefill: the chunks already computed are
+            # discarded with the slot's blocks — nothing was emitted yet
+            self._abort_pending_prefill(slot)
+            self._submit_t.pop(rid, None)
+            self._admit_t.pop(rid, None)
+            self._deadline.pop(rid, None)
+            cctx = self._trace_ctx.pop(rid, None)
+            if cctx is not None:
+                default_span_store().finish(cctx.trace_id)
+            self._seed.pop(rid, None)
+            self._session.pop(rid, None)
+            self._fresh.pop(rid, None)
+            self._accept.pop(rid, None)
+            self._ttft_origin.pop(rid, None)
+            self._last_tok_t.pop(rid, None)
+            self._ttft_val.pop(rid, None)
+            self.recorder.record(rid, "cancelled", stage="prefilling")
+            return True
         for slot, r in enumerate(self._rid):
             # the explicit None guard matters: a caller holding a
             # None/absent id must not "cancel" a FREE slot (None == None)
@@ -2481,7 +2690,11 @@ class DecodeEngine:
         return False
 
     def _free_slots(self) -> List[int]:
-        return [s for s in range(self.max_slots) if self._rid[s] is None]
+        # a slot mid-interleaved-prefill is reserved, not free: its rid
+        # is unset (the decode loop must treat it as inactive) but its
+        # blocks/cache row belong to the pending request
+        return [s for s in range(self.max_slots)
+                if self._rid[s] is None and s not in self._pending_prefill]
 
     def _shed_expired_queued(self):
         """Drop every queued request whose deadline already passed —
@@ -2585,6 +2798,7 @@ class DecodeEngine:
                 self.apply_staged_params()
         self._shed_expired_queued()
         self._enforce_active_deadlines()
+        self._enforce_pending_deadlines()
         while len(self._queue):
             slots = self._free_slots()
             if not slots:
@@ -2774,6 +2988,16 @@ class DecodeEngine:
                         duration_s=round(
                             time.monotonic() - self._admit_t[rid], 6))
                 else:
+                    if self._interleave_ok(slot, prompt):
+                        # defer the chunk loop: the slot is reserved
+                        # (blocks allocated, hit chain claimed) but its
+                        # prompt feeds between the coming decode steps
+                        # — _interleave_prefills() finishes the
+                        # admission when the last chunk lands
+                        self._begin_interleaved_prefill(
+                            rid, slot, item, prompt, resume, temp,
+                            topk, topp)
+                        continue
                     with self._psec("prefill"), \
                             start_span("serving.prefill",
                                        stage="prefill"):
@@ -3113,6 +3337,295 @@ class DecodeEngine:
             prefix_tokens=int(reused),
             duration_s=round(time.monotonic() - self._admit_t[rid], 6))
         return t0
+
+    # ------------------------------------------- interleaved prefill
+    def _interleave_ok(self, slot: int, prompt: np.ndarray) -> bool:
+        """Should THIS admission's chunk loop defer between decode
+        steps? Only worth it when decodes are actually in flight (an
+        empty engine prefills fastest run-to-completion) and more than
+        one chunk of compute remains after prefix/cache reuse. The
+        contiguous host-cache path stays run-to-completion: its payload
+        import and insert steps are woven through the compute. The
+        decision never affects output tokens — both paths feed
+        identical chunk shapes — only who waits for whom."""
+        if (not self.interleave_prefill
+                or not any(r is not None for r in self._rid)):
+            return False
+        if self.paged is None and self._kv_cache is not None:
+            return False
+        if self.paged is not None and self._kv_cache is not None:
+            est = (len(self._slot_cached[slot])
+                   + len(self._slot_promos.get(slot, []))
+                   ) * self._kv_cache_bs
+            if est == 0:
+                entry = self._match_prefix(prompt)
+                est = 0 if entry is None else int(entry[0].size)
+        else:
+            entry = self._match_prefix(prompt)
+            est = 0 if entry is None else int(entry[0].size)
+        return prompt.size - est > self.prefill_chunk
+
+    def _begin_interleaved_prefill(self, rid: int, slot: int, item,
+                                   prompt: np.ndarray, resume,
+                                   temp: float, topk: int,
+                                   topp: float) -> None:
+        """The front half of admission, minus the chunk loop: claim
+        whatever serves the prompt head (cache-hit chain, tier
+        promotions, or a registered prefix row) exactly as the
+        run-to-completion paths do, then park the admission as pending
+        state for :meth:`_interleave_prefills` to advance. The slot's
+        table resets to the scratch sink while pending — inactive
+        slots' decode-step garbage writes land on block 0, and this
+        slot's REAL blocks (some shared with live decodes via the
+        cache) must not take them."""
+        reused, j, entry, row, owned = 0, 0, None, None, True
+        if self.paged is not None and self._kv_cache is not None:
+            from .models.paged_decode import gather_blocks_to_row
+
+            bs = self._kv_cache_bs
+            nhits = len(self._slot_cached[slot])
+            promos = self._slot_promos.pop(slot, [])
+            walk_keys, _ = self._chain_keys_for(rid, prompt)
+            if promos:
+                self._install_promotions(rid, slot, nhits, promos)
+            j = nhits + len(promos)
+            if (self._session.get(rid) is not None
+                    and self._m_session_hits is not None and walk_keys):
+                (self._m_session_hits if j > 0
+                 else self._m_session_misses).inc()
+            if j > 0:
+                reused = j * bs
+                self._m_kv_hits.inc()
+                self._m_prefix_tokens.inc(reused)
+                self._kv_cache.record_walk(j, True)
+                self.recorder.record(rid, "kv_cache_hit", blocks=j,
+                                     tokens_reused=reused,
+                                     promoted=len(promos))
+                row = gather_blocks_to_row(
+                    self.pool,
+                    [int(b) for b in self._tables[slot, :j]],
+                    self.max_len)
+            else:
+                entry = self._match_prefix(prompt)
+                if entry is not None:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_tokens.inc(int(entry[0].size))
+                    reused = int(entry[0].size)
+                elif walk_keys:
+                    self._m_kv_misses.inc()
+                    self._kv_cache.record_walk(0, True)
+        else:
+            entry = self._match_prefix(prompt)
+            if entry is not None:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(int(entry[0].size))
+                reused = int(entry[0].size)
+        if row is None:
+            row = (self._fresh_row_fn() if entry is None else entry[2])
+            owned = entry is None
+        self._pending_prefill[slot] = dict(
+            rid=rid, item=item, resume=resume, prompt=prompt,
+            temp=temp, topk=topk, topp=topp, row=row,
+            suffix=prompt[int(reused):], cursor=0, first=True,
+            owned=owned, entry=entry, logits=None, reused=int(reused),
+            j=j, wv0=int(self.weights_version),
+            table=(self._tables[slot].copy()
+                   if self.paged is not None else None),
+            t0=time.monotonic(), ctx=self._trace_ctx.get(rid))
+        if self.paged is not None:
+            self._tables[slot, :] = 0
+
+    def _refresh_prefill_budget(self) -> None:
+        """Recompute the chunks-per-iteration budget from the
+        profiler's decode-phase share of wall time, every
+        :data:`PREFILL_BUDGET_EVERY` iterations (``utilization()``
+        walks the ring under a lock — reading it per step would spend
+        the profiler's <2% overhead budget on the scheduler). Decode
+        saturating the loop → 1 chunk/step (in-flight inter-token
+        latency wins); decode mostly waiting → up to
+        :data:`MAX_INTERLEAVE_CHUNKS` (drain the prompt, TTFT wins).
+        Profiler off → the conservative 1."""
+        self._budget_age += 1
+        if self._budget_age < PREFILL_BUDGET_EVERY:
+            return
+        self._budget_age = 0
+        if self.profiler is None:
+            self._prefill_budget = 1
+            return
+        decode = self.profiler.utilization().get("decode", 0.0)
+        self._prefill_budget = max(
+            1, int(round((1.0 - decode) * MAX_INTERLEAVE_CHUNKS)))
+
+    def _interleave_prefills(self) -> None:
+        """Advance pending interleaved prefills by at most the current
+        chunk budget (total, across pending slots — oldest first, so
+        the earliest admission reaches its first token soonest), and
+        complete any whose last chunk landed."""
+        self._refresh_prefill_budget()
+        budget = self._prefill_budget
+        for slot in list(self._pending_prefill):
+            while budget > 0:
+                budget -= 1
+                if self._feed_prefill_chunk(slot):
+                    self._finish_interleaved_prefill(slot)
+                    break
+            if budget <= 0:
+                return
+
+    def _feed_prefill_chunk(self, slot: int) -> bool:
+        """Feed ONE ``prefill_chunk``-sized block of the pending
+        prompt. Chunk boundaries and fn choice (the first chunk over a
+        registered row must not donate it) mirror
+        :meth:`_extend_chunked` exactly, so the interleaved admission
+        computes the identical program sequence — identical compiles,
+        identical logits — as run-to-completion, just spread across
+        iterations. Returns True when the suffix is exhausted."""
+        st = self._pending_prefill[slot]
+        suffix, cur = st["suffix"], st["cursor"]
+        blk = suffix[cur:cur + self.prefill_chunk]
+        fn = (self._extend_owned_fn if (st["owned"] or not st["first"])
+              else self._extend_fn)
+        with use_context(st["ctx"]):
+            st["logits"], st["row"] = fn(
+                self.params, st["row"], jnp.asarray(blk[None]),
+                jnp.int32(st["reused"] + cur))
+        st["cursor"] = cur + int(blk.size)
+        st["first"] = False
+        self._m_interleaved.inc()
+        return st["cursor"] >= suffix.size
+
+    def _finish_interleaved_prefill(self, slot: int) -> None:
+        """The back half of admission, once every chunk has fed:
+        install the finished row, register fresh cache blocks, draft
+        prefill, first-token sample, and all the slot bookkeeping the
+        run-to-completion path does inline."""
+        st = self._pending_prefill.pop(slot)
+        rid, prompt, item = st["rid"], st["prompt"], st["item"]
+        resume = st["resume"]
+        with use_context(st["ctx"]):
+            if self.paged is not None:
+                from .models.paged_decode import install_row_paged
+
+                self._tables[slot] = st["table"]
+                nprefill = -(-prompt.size // self.paged[1])
+                self.pool = install_row_paged(
+                    self.pool, st["row"], self._tables[slot], nprefill,
+                    start=st["j"])
+                # a weight swap landed mid-pendency: the row mixes KV
+                # from two versions — registering it under the NEW
+                # version's chain keys would poison the cache
+                if (self._kv_cache is not None
+                        and int(self.weights_version) == st["wv0"]):
+                    self._insert_full_blocks(slot, prompt,
+                                             skip=st["j"], rid=rid)
+            else:
+                self.cache = self._install_fn(self.cache, st["row"],
+                                              slot)
+            if self.draft_config is not None:
+                if self.paged is not None and self._kv_cache is not None:
+                    self._install_draft_row(slot, prompt)
+                else:
+                    self._install_draft_row(slot, prompt,
+                                            entry=st["entry"])
+            t0 = self._sample_first(st["logits"][0], st["temp"],
+                                    st["topk"], st["topp"],
+                                    seed=self._seed.get(rid),
+                                    fold=int(prompt.size))
+            now = time.monotonic()
+            if st["ctx"] is not None:
+                # the prefill stage span, retroactive: begin-to-finish
+                # wall time — the interleaved decode steps inside it
+                # are exactly the graceful-TTFT trade the scheduler made
+                dur = now - st["t0"]
+                add_span("serving.prefill", time.time() - dur, dur,
+                         stage="prefill", interleaved=True,
+                         ctx=st["ctx"])
+            self.recorder.record(
+                rid, "prefill", prompt_tokens=int(prompt.size),
+                prefix_tokens=st["reused"], interleaved=True,
+                duration_s=round(now - self._admit_t[rid], 6))
+        self._rid[slot] = rid
+        self._outputs[rid] = [] if resume is None else resume["outputs"]
+        self._slot_prompt[slot] = prompt
+        self._slot_prior[slot] = len(self._outputs[rid])
+        self._slot_tenant[slot] = item.tenant
+        self._slot_priority[slot] = item.priority
+        # the version the row was (mostly) computed under — a
+        # mid-pendency swap leaves this != weights_version, which the
+        # park/persist guards already treat as "do not cache"
+        self._slot_wv[slot] = st["wv0"]
+        self._pos[slot] = prompt.size - 1
+        self._last[slot] = t0
+        self._budget[slot] = item.max_new
+        self._temp[slot] = st["temp"]
+        self._topk[slot] = st["topk"]
+        self._topp[slot] = st["topp"]
+        self._slot_seed[slot] = self._seed.get(rid, -1)
+        if self.qos is not None:
+            self._m_tenant_admitted.labels(
+                tenant=self.qos.label(item.tenant)).inc()
+        if resume is not None:
+            self.recorder.record(
+                rid, "resumed", tokens_so_far=len(self._outputs[rid]),
+                remaining_tokens=int(item.max_new),
+                preemptions=resume["preempts"])
+        if self._record(slot, t0):
+            self._fresh.setdefault(rid, []).append(t0)
+
+    def _abort_pending_prefill(self, slot: int) -> Dict:
+        """Drop a pending interleaved prefill (cancel/deadline): the
+        slot's table restores so its private blocks free and its
+        claimed hit chain releases, exactly like an active slot's
+        teardown. Returns the pending state for the caller's
+        request-level bookkeeping."""
+        st = self._pending_prefill.pop(slot)
+        if self.paged is not None:
+            self._tables[slot] = st["table"]
+        self._release_blocks(slot)
+        self._clear_slot_meta(slot)
+        return st
+
+    def _enforce_pending_deadlines(self) -> None:
+        """Retire pending interleaved prefills whose deadline passed —
+        the mid-prefill mirror of :meth:`_enforce_active_deadlines`
+        (``timed_out``: the request WAS admitted; a preempted-resumed
+        one keeps its earlier tokens as the partial output)."""
+        if not self._deadline or not self._pending_prefill:
+            return
+        now = self._clock()
+        for slot in list(self._pending_prefill):
+            rid = self._pending_prefill[slot]["rid"]
+            if self._deadline.get(rid, now + 1) > now:
+                continue
+            st = self._abort_pending_prefill(slot)
+            saved = st["resume"]
+            self._done[rid] = ([] if saved is None
+                               else saved["outputs"])
+            self._timed_out.add(rid)
+            self._m_timed_out.inc()
+            self._deadline.pop(rid, None)
+            self._seed.pop(rid, None)
+            self._session.pop(rid, None)
+            t_sub = self._submit_t.pop(rid, None)
+            self._admit_t.pop(rid, None)
+            self._fresh.pop(rid, None)
+            a_p = self._accept.pop(rid, None)
+            ctx = self._trace_ctx.pop(rid, None)
+            if ctx is not None:
+                default_span_store().finish(
+                    ctx.trace_id,
+                    latency_s=(None if t_sub is None
+                               else time.monotonic() - t_sub),
+                    violated=True)
+            self._ttft_origin.pop(rid, None)
+            self._last_tok_t.pop(rid, None)
+            self._ttft_val.pop(rid, None)
+            self.recorder.record(
+                rid, "timed_out", stage="prefilling",
+                tokens=len(self._done[rid]),
+                **({} if a_p is None
+                   else {"draft_accepted": a_p[0],
+                         "draft_proposed": a_p[1]}))
 
     def _install_promotions(self, rid: int, slot: int, start: int,
                             promos: List) -> None:
@@ -3610,6 +4123,19 @@ class DecodeEngine:
             out["speculative_rounds"] = int(
                 self._since_init(self._m_spec_rounds))
             out["draft_weights_version"] = int(self.draft_weights_version)
+            # operating depth vs ceiling: equal unless adaptive_gamma
+            # has steered down (the gap IS the staleness signal)
+            out["gamma"] = int(self._gamma_now)
+            out["gamma_ceiling"] = int(self.gamma)
+        # resolved attention kernel ("pallas" only when it will really
+        # run compiled; a fallback shows requested != kernel here)
+        out["kernel"] = self.kernel
+        if self.kernel != self.kernel_requested:
+            out["kernel_requested"] = self.kernel_requested
+        if self.interleave_prefill:
+            out["prefill_chunks_interleaved"] = int(
+                self._since_init(self._m_interleaved))
+            out["pending_prefills"] = len(self._pending_prefill)
         return out
 
     def _since_init(self, metric) -> float:
@@ -3632,6 +4158,7 @@ class DecodeEngine:
                       or self._staged_draft is not None)
         return (len(self._queue)
                 + sum(r is not None for r in self._rid)
+                + len(self._pending_prefill)
                 + len(self._fresh)
                 + (1 if staged else 0))
 
@@ -3640,6 +4167,44 @@ class DecodeEngine:
         when profiling is off — the hot path pays one attribute read)."""
         prof = self.profiler
         return _NULL_SECTION if prof is None else prof.section(phase)
+
+    def _steer_gamma(self, accepted: int, proposed: int) -> None:
+        """One control-loop tick of the adaptive speculative depth.
+
+        Feeds this round's pooled acceptance into an EWMA and, at most
+        every :data:`GAMMA_ADJUST_EVERY` rounds, moves ``_gamma_now``
+        ONE step toward the depth that acceptance currently pays for:
+        with per-token acceptance rate ``a``, proposing beyond
+        ``~a * ceiling`` drafts tokens the verifier will mostly throw
+        away, while proposing fewer leaves accepted tokens on the
+        table. The one-step/hysteresis pairing keeps the loop from
+        chattering between adjacent depths on acceptance noise, yet an
+        acceptance collapse (stale draft) still walks gamma from the
+        ceiling to the floor in ``GAMMA_ADJUST_EVERY * (ceiling -
+        floor)`` rounds — minutes before a draft_acceptance_min alert
+        would fire. Token streams are unaffected by ANY depth schedule:
+        greedy verification emits the exact argmax prefix at every
+        depth, so steering changes only how much verify work each
+        emitted token costs.
+        """
+        if not proposed:
+            return
+        acc = accepted / proposed
+        self._accept_ewma = (acc if self._accept_ewma is None else
+                             GAMMA_EWMA_ALPHA * acc
+                             + (1.0 - GAMMA_EWMA_ALPHA)
+                             * self._accept_ewma)
+        self._rounds_since_adjust += 1
+        if self._rounds_since_adjust < GAMMA_ADJUST_EVERY:
+            return
+        self._rounds_since_adjust = 0
+        target = max(self.gamma_min,
+                     min(self.gamma,
+                         1 + int(self._accept_ewma * self.gamma + 0.5)))
+        if target > self._gamma_now:
+            self._gamma_now += 1
+        elif target < self._gamma_now:
+            self._gamma_now -= 1
 
     def step(self) -> Dict[int, List[int]]:
         """Advance every active slot — by one token (plain mode) or by
@@ -3666,6 +4231,12 @@ class DecodeEngine:
         # records it and /health turns red), 'delay' = a slow step
         fault_site("serving.step")
         self._admit()
+        if self._pending_prefill:
+            # feed this iteration's chunk budget BEFORE reading _fresh:
+            # an admission completing here surfaces its first token in
+            # this very step, matching run-to-completion semantics
+            with self._psec("prefill"):
+                self._interleave_prefills()
         emitted = {rid: list(toks) for rid, toks in self._fresh.items()}
         self._fresh = {}
         active = np.asarray([r is not None for r in self._rid])
@@ -3678,26 +4249,34 @@ class DecodeEngine:
         self._m_steps.inc()
         if self.draft_config is not None:
             # speculative round: every active slot advances by its own
-            # 1 + accepted tokens in one dispatch
+            # 1 + accepted tokens in one dispatch. The round runs at the
+            # adaptive operating depth (== self.gamma for fixed-gamma
+            # engines); verify slack was budgeted at the ceiling, so any
+            # depth <= it writes safely
+            g_now = self._gamma_now
             with self._psec("decode"):
                 if self.paged is not None:
                     (emit, acc, nxt, self.pool, self.draft_cache,
-                     self._key) = self._spec_step_paged_fn(
+                     self._key) = self._spec_step_paged_for(g_now)(
                         self.params, self.draft_params, self.pool,
                         self.draft_cache, jnp.asarray(self._tables),
                         jnp.asarray(self._last), jnp.asarray(pos),
                         self._key)
                 else:
                     (emit, acc, nxt, self.cache, self.draft_cache,
-                     self._key) = self._spec_step_fn(
+                     self._key) = self._spec_step_for(g_now)(
                         self.params, self.draft_params, self.cache,
                         self.draft_cache, jnp.asarray(self._last),
                         jnp.asarray(pos), self._key)
                 emit, acc, nxt = (np.asarray(emit), np.asarray(acc),
                                   np.asarray(nxt))
-            self._m_accepted.inc(int(acc[active].sum()))
-            self._m_proposed.inc(self.gamma * int(active.sum()))
-            self._m_spec_rounds.inc(int(active.sum()))
+            n_active = int(active.sum())
+            n_accepted = int(acc[active].sum())
+            self._m_accepted.inc(n_accepted)
+            self._m_proposed.inc(g_now * n_active)
+            self._m_spec_rounds.inc(n_active)
+            if self.adaptive_gamma:
+                self._steer_gamma(n_accepted, g_now * n_active)
             with self._psec("emit"):
                 for slot in np.nonzero(active)[0]:
                     rid = self._rid[slot]
@@ -3705,7 +4284,7 @@ class DecodeEngine:
                     # terminal event (engine counters above are pooled)
                     a_p = self._accept.setdefault(rid, [0, 0])
                     a_p[0] += int(acc[slot])
-                    a_p[1] += self.gamma
+                    a_p[1] += g_now
                     self._pos[slot] += 1 + acc[slot]
                     self._last[slot] = nxt[slot]
                     for tok in emit[slot, :acc[slot] + 1]:
